@@ -1,0 +1,29 @@
+"""janus_tpu: a TPU-native DAP-07 aggregator framework.
+
+A ground-up re-design of the capabilities of Janus (the Rust DAP aggregator,
+see /root/reference) for TPU hardware: the per-report VDAF hot path
+(Prio3 FLP prove/query/decide + output-share accumulation, which the
+reference runs serially per report on CPU via the external `prio` crate,
+cf. reference aggregator/src/aggregator/aggregation_job_driver.rs:329-402)
+becomes batched field arithmetic over `[batch, ...]` uint64 arrays in
+JAX/XLA, with Pallas kernels for the hottest ops.
+
+Layering (mirrors SURVEY.md section 1):
+  fields/    -- Field64 / Field128 modular arithmetic (limb tricks on u64 lanes)
+  vdaf/      -- XOF, NTT, FLP, Prio3, ping-pong topology  (L0)
+  messages/  -- DAP-07 TLS-syntax wire structs            (L1)
+  core/      -- HPKE, clocks, retries, auth, registry     (L2)
+  datastore/ -- transactional store, lease queue, crypter (L3)
+  aggregator/-- protocol handlers + job drivers           (L4/L5)
+  client.py, collector.py                                 (L6)
+
+64-bit integer support is required throughout (field elements live in
+uint64 lanes; XLA lowers them to 32-bit pairs on TPU), so importing this
+package enables jax_enable_x64.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+"""Finite-field arithmetic for VDAF (Prio3) on TPU.
+
+Two fields, chosen to match the VDAF-07 ciphersuite the reference consumes
+through the `prio` crate (reference core/src/task.rs:114-650 dispatches
+Prio3 types whose fields are Field64/Field128):
+
+  Field64  : p = 2^64 - 2^32 + 1          ("Goldilocks", 2-adicity 32)
+  Field128 : p = 2^128 - 7*2^66 + 1       (2-adicity 66)
+
+`field` holds host-side (Python int) implementations used for constant
+precomputation and as the differential-test oracle; `jfield` holds the
+batched JAX implementations (uint64 limb lanes) that run on TPU.
+"""
+
+from .field import Field64, Field128  # noqa: F401
+from .jfield import JF64, JF128  # noqa: F401
+
+JFIELD_FOR = {Field64: JF64, Field128: JF128}

@@ -1,0 +1,415 @@
+"""Batched field arithmetic in JAX over uint64 limb lanes.
+
+Design: a field-vector value is a tuple of uint64 arrays (the limbs), all
+with identical shape. Field64 values are 1-tuples, Field128 values are
+2-tuples (lo, hi). Structural ops (reshape/concat/take/...) map over the
+limb tuple, so FLP/NTT code is generic over the field.
+
+Why tuples-of-u64 rather than a trailing limb dim: tuples are pytrees, so
+every jax transform (jit/vmap/shard_map) handles them natively, and XLA
+sees plain elementwise u64 graphs it can fuse. On TPU, u64 ops lower to
+u32 pairs; the Pallas kernels in janus_tpu/ops later specialize the same
+math to native u32 where it is hot.
+
+Reduction strategy exploits the sparse moduli (no Montgomery needed):
+  Field64:  2^64 ≡ 2^32 - 1,  2^96 ≡ -1          (mod p)
+  Field128: 2^128 ≡ 7*2^66 - 1                   (mod p)
+
+The reference does this math on CPU inside the `prio` crate, one report at
+a time (reference aggregator/src/aggregator/aggregation_job_driver.rs:363,
+aggregator.rs:1777); here every op is elementwise over arbitrarily-shaped
+batches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .field import Field64, Field128
+
+U64 = jnp.uint64
+_M32 = np.uint64(0xFFFFFFFF)
+_ZERO = np.uint64(0)
+_ONE = np.uint64(1)
+
+
+def _u64(x: int) -> np.uint64:
+    return np.uint64(x & 0xFFFFFFFFFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# u64 multiprecision primitives (all elementwise over arrays)
+# ---------------------------------------------------------------------------
+
+
+def mul64wide(x, y):
+    """Full 64x64 -> 128-bit product as (lo, hi) u64 arrays."""
+    xl = x & _M32
+    xh = x >> 32
+    yl = y & _M32
+    yh = y >> 32
+    ll = xl * yl
+    lh = xl * yh
+    hl = xh * yl
+    hh = xh * yh
+    mid = lh + (ll >> 32)  # cannot wrap: <= (2^32-1)^2 + 2^32-1 < 2^64
+    mid2 = mid + hl
+    carry = (mid2 < mid).astype(U64)
+    lo = (ll & _M32) | (mid2 << 32)
+    hi = hh + (mid2 >> 32) + (carry << 32)
+    return lo, hi
+
+
+def adc(a, b, c):
+    """a + b + c with c in {0,1}; returns (sum, carry in {0,1})."""
+    s1 = a + b
+    c1 = (s1 < a).astype(U64)
+    s2 = s1 + c
+    c2 = (s2 < s1).astype(U64)
+    return s2, c1 + c2
+
+
+def sbb(a, b, brw):
+    """a - b - brw with brw in {0,1}; returns (diff, borrow in {0,1})."""
+    d1 = a - b
+    b1 = (a < b).astype(U64)
+    d2 = d1 - brw
+    b2 = (d1 < brw).astype(U64)
+    return d2, b1 + b2
+
+
+def add_limbs(a, b):
+    """Add equal-length limb lists; returns (limbs, carry_out)."""
+    out = []
+    c = _ZERO
+    for x, y in zip(a, b):
+        s, c = adc(x, y, c)
+        out.append(s)
+    return out, c
+
+
+def sub_limbs(a, b):
+    """Subtract equal-length limb lists; returns (limbs, borrow_out)."""
+    out = []
+    brw = _ZERO
+    for x, y in zip(a, b):
+        d, brw = sbb(x, y, brw)
+        out.append(d)
+    return out, brw
+
+
+def shl_limbs(a, k, out_len):
+    """Shift limb list left by k bits (k < 64*out_len), zero-extended."""
+    word = k // 64
+    bit = k % 64
+    ext = [jnp.zeros_like(a[0])] * word + list(a)
+    ext += [jnp.zeros_like(a[0])] * (out_len + 1 - len(ext))
+    if bit == 0:
+        return ext[:out_len]
+    nb = np.uint64(bit)
+    inb = np.uint64(64 - bit)
+    out = []
+    for i in range(out_len):
+        lo = ext[i] << nb
+        hi = (ext[i - 1] >> inb) if i > 0 else jnp.zeros_like(a[0])
+        out.append(lo | hi)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Field64 (Goldilocks)
+# ---------------------------------------------------------------------------
+
+_P64 = _u64(Field64.MODULUS)
+_EPS64 = _u64(2**32 - 1)  # 2^64 mod p
+
+
+def _f64_reduce_wide(lo, hi):
+    """Reduce a 128-bit value (lo, hi) mod p64. Uses 2^96 ≡ -1, 2^64 ≡ 2^32-1."""
+    hl = hi & _M32
+    hh = hi >> 32
+    # x ≡ lo + hl*(2^32-1) - hh  (mod p)
+    t = (hl << 32) - hl
+    s = lo + t
+    wrapped = s < lo
+    s = jnp.where(wrapped, s + _EPS64, s)
+    r = s - hh
+    borrowed = s < hh
+    r = jnp.where(borrowed, r - _EPS64, r)
+    r = jnp.where(r >= _P64, r - _P64, r)
+    return r
+
+
+class JF64:
+    """Batched Field64 ops. Values are 1-tuples of uint64 arrays, reduced."""
+
+    HOST = Field64
+    LIMBS = 1
+    MODULUS = Field64.MODULUS
+
+    @staticmethod
+    def add(a, b):
+        (x,), (y,) = a, b
+        s = x + y
+        s = jnp.where(s < x, s + _EPS64, s)
+        s = jnp.where(s >= _P64, s - _P64, s)
+        return (s,)
+
+    @staticmethod
+    def sub(a, b):
+        (x,), (y,) = a, b
+        d = x - y
+        d = jnp.where(x < y, d - _EPS64, d)
+        d = jnp.where(d >= _P64, d - _P64, d)
+        return (d,)
+
+    @staticmethod
+    def neg(a):
+        (x,) = a
+        return (jnp.where(x == _ZERO, _ZERO, _P64 - x),)
+
+    @staticmethod
+    def mul(a, b):
+        (x,), (y,) = a, b
+        return (_f64_reduce_wide(*mul64wide(x, y)),)
+
+    @staticmethod
+    def from_ints(arr) -> tuple:
+        a = np.asarray(arr, dtype=np.uint64)
+        assert (a < np.uint64(Field64.MODULUS)).all()
+        return (jnp.asarray(a),)
+
+    @staticmethod
+    def to_ints(v) -> np.ndarray:
+        (x,) = v
+        return np.asarray(jax.device_get(x), dtype=np.uint64).astype(object)
+
+
+# ---------------------------------------------------------------------------
+# Field128
+# ---------------------------------------------------------------------------
+
+_P128_LO = _u64(Field128.MODULUS & 0xFFFFFFFFFFFFFFFF)
+_P128_HI = _u64(Field128.MODULUS >> 64)
+
+
+def _ge128(alo, ahi, blo, bhi):
+    return (ahi > bhi) | ((ahi == bhi) & (alo >= blo))
+
+
+def _f128_fold(limbs, hi_len):
+    """Given value as limb list [l0, l1, h...], fold H*2^128 ≡ H*(7*2^66 - 1).
+
+    limbs: list of 2 + hi_len u64 arrays. Returns a shorter limb list.
+    """
+    L = limbs[:2]
+    H = limbs[2 : 2 + hi_len]
+    # 7H = (H << 3) - H, over hi_len+1 limbs
+    h8 = shl_limbs(H, 3, hi_len + 1)
+    h7, _ = sub_limbs(h8, H + [jnp.zeros_like(H[0])])
+    # (7H) << 66, positioned at limb offset; total value = L + 7H<<66 - H
+    sh = shl_limbs(h7, 66, hi_len + 3)
+    acc, _ = add_limbs(sh, L + [jnp.zeros_like(L[0])] * (hi_len + 1))
+    acc, _ = sub_limbs(acc, H + [jnp.zeros_like(H[0])] * 3)
+    # trim known-zero top limbs conservatively: caller knows the bound
+    return acc
+
+
+def _f128_reduce256(r0, r1, r2, r3):
+    """Reduce a 256-bit value to a Field128 element (lo, hi)."""
+    # fold 1: H = (r2, r3) < 2^128 -> result < 2^198 (4 limbs, top <= 2^6)
+    a = _f128_fold([r0, r1, r2, r3], 2)[:4]
+    # fold 2: H = (a2, a3) < 2^70 -> result < 2^140 (3 limbs)
+    b = _f128_fold(a, 2)[:3]
+    # fold 3: H = (b2) < 2^12 -> result < 2^128 + 2^82 (3 limbs, top in {0,1})
+    c = _f128_fold([b[0], b[1], b[2]], 1)[:3]
+    lo, hi, top = c
+    # if top bit set: value - p = value - 2^128 + 7*2^66 - 1
+    seven66_lo = _u64((7 * 2**66) & 0xFFFFFFFFFFFFFFFF)
+    seven66_hi = _u64((7 * 2**66) >> 64)
+    add_lo, cc = adc(lo, seven66_lo, _ZERO)
+    add_hi = hi + seven66_hi + cc  # < 2^64: value-2^128 < 2^82, +7*2^66 stays tiny
+    d_lo, bb = sbb(add_lo, _ONE, _ZERO)
+    d_hi = add_hi - bb
+    one = top != _ZERO
+    lo = jnp.where(one, d_lo, lo)
+    hi = jnp.where(one, d_hi, hi)
+    # final conditional subtract (at most once)
+    ge = _ge128(lo, hi, _P128_LO, _P128_HI)
+    s_lo, bb = sbb(lo, _P128_LO, _ZERO)
+    s_hi = hi - _P128_HI - bb
+    lo = jnp.where(ge, s_lo, lo)
+    hi = jnp.where(ge, s_hi, hi)
+    return lo, hi
+
+
+class JF128:
+    """Batched Field128 ops. Values are (lo, hi) tuples of uint64 arrays."""
+
+    HOST = Field128
+    LIMBS = 2
+    MODULUS = Field128.MODULUS
+
+    @staticmethod
+    def add(a, b):
+        (alo, ahi), (blo, bhi) = a, b
+        lo, c = adc(alo, blo, _ZERO)
+        hi1 = ahi + bhi
+        w1 = (hi1 < ahi).astype(U64)
+        hi = hi1 + c
+        w2 = (hi < hi1).astype(U64)
+        overflow = (w1 + w2) != _ZERO  # bit 128 set: a+b = 2^128 + (lo,hi)
+        # subtract p when overflow or >= p; with overflow, 2^128 - p = 7*2^66 - 1
+        seven66m1_lo = _u64((7 * 2**66 - 1) & 0xFFFFFFFFFFFFFFFF)
+        seven66m1_hi = _u64((7 * 2**66 - 1) >> 64)
+        o_lo, cc = adc(lo, seven66m1_lo, _ZERO)
+        o_hi = hi + seven66m1_hi + cc
+        lo = jnp.where(overflow, o_lo, lo)
+        hi = jnp.where(overflow, o_hi, hi)
+        ge = _ge128(lo, hi, _P128_LO, _P128_HI)
+        s_lo, bb = sbb(lo, _P128_LO, _ZERO)
+        s_hi = hi - _P128_HI - bb
+        return (jnp.where(ge, s_lo, lo), jnp.where(ge, s_hi, hi))
+
+    @staticmethod
+    def sub(a, b):
+        (alo, ahi), (blo, bhi) = a, b
+        lo, brw = sbb(alo, blo, _ZERO)
+        hi1, brw2 = sbb(ahi, bhi, brw)
+        underflow = brw2 != _ZERO
+        # add p back on underflow
+        p_lo, cc = adc(lo, _P128_LO, _ZERO)
+        p_hi = hi1 + _P128_HI + cc
+        return (jnp.where(underflow, p_lo, lo), jnp.where(underflow, p_hi, hi1))
+
+    @staticmethod
+    def neg(a):
+        (lo, hi) = a
+        z = (lo == _ZERO) & (hi == _ZERO)
+        n_lo, bb = sbb(_P128_LO, lo, _ZERO)
+        n_hi = _P128_HI - hi - bb
+        return (jnp.where(z, _ZERO, n_lo), jnp.where(z, _ZERO, n_hi))
+
+    @staticmethod
+    def mul(a, b):
+        (a0, a1), (b0, b1) = a, b
+        l00, h00 = mul64wide(a0, b0)
+        l01, h01 = mul64wide(a0, b1)
+        l10, h10 = mul64wide(a1, b0)
+        l11, h11 = mul64wide(a1, b1)
+        r0 = l00
+        r1, c1 = adc(h00, l01, _ZERO)
+        r1, c2 = adc(r1, l10, _ZERO)
+        r2, c3 = adc(h01, h10, c1)
+        r2, c4 = adc(r2, l11, c2)
+        r3 = h11 + c3 + c4
+        return _f128_reduce256(r0, r1, r2, r3)
+
+    @staticmethod
+    def from_ints(arr) -> tuple:
+        a = np.asarray(arr, dtype=object)
+        ints = np.vectorize(int, otypes=[object])(a)
+        assert (ints < Field128.MODULUS).all() if ints.size else True
+        lo = (ints & ((1 << 64) - 1)).astype(np.uint64)
+        hi = (ints >> 64).astype(np.uint64)
+        return (jnp.asarray(lo), jnp.asarray(hi))
+
+    @staticmethod
+    def to_ints(v) -> np.ndarray:
+        lo, hi = (np.asarray(jax.device_get(x), dtype=np.uint64) for x in v)
+        return lo.astype(object) + (hi.astype(object) << 64)
+
+
+# ---------------------------------------------------------------------------
+# Generic helpers over limb tuples (field-agnostic)
+# ---------------------------------------------------------------------------
+
+
+def fmap(fn, *vals):
+    """Apply an array fn limb-wise over field values."""
+    return tuple(fn(*limbs) for limbs in zip(*vals))
+
+
+def fzeros(jf, shape):
+    return tuple(jnp.zeros(shape, dtype=U64) for _ in range(jf.LIMBS))
+
+
+def fshape(v):
+    return v[0].shape
+
+
+def fwhere(mask, a, b):
+    """Select field values by boolean mask (broadcast against element shape)."""
+    return tuple(jnp.where(mask, x, y) for x, y in zip(a, b))
+
+
+def fconst(jf, value: int, shape=()):
+    """Broadcast a host int constant to a field value of given shape."""
+    value %= jf.MODULUS
+    limbs = []
+    for i in range(jf.LIMBS):
+        limbs.append(jnp.full(shape, _u64((value >> (64 * i)) & 0xFFFFFFFFFFFFFFFF)))
+    return tuple(limbs)
+
+
+def fpow_const(jf, x, e: int):
+    """x^e for a host-known exponent via square-and-multiply (unrolled)."""
+    result = None
+    base = x
+    while e:
+        if e & 1:
+            result = base if result is None else jf.mul(result, base)
+        e >>= 1
+        if e:
+            base = jf.mul(base, base)
+    if result is None:
+        return fconst(jf, 1, fshape(x))
+    return result
+
+
+def finv(jf, x):
+    return fpow_const(jf, x, jf.MODULUS - 2)
+
+
+def fsum(jf, v, axis):
+    """Sum a field value along an axis via log-depth halving (mod-add tree)."""
+    axis = axis % v[0].ndim
+    n = v[0].shape[axis]
+    if n == 0:
+        shape = list(v[0].shape)
+        del shape[axis]
+        return fzeros(jf, tuple(shape))
+    # pad to a power of two with zeros, then halve
+    m = 1 << (n - 1).bit_length()
+    if m != n:
+        pad = [(0, 0)] * v[0].ndim
+        pad[axis] = (0, m - n)
+        v = fmap(lambda x: jnp.pad(x, pad), v)
+    while m > 1:
+        half = m // 2
+        a = fmap(lambda x: jax.lax.slice_in_dim(x, 0, half, axis=axis), v)
+        b = fmap(lambda x: jax.lax.slice_in_dim(x, half, m, axis=axis), v)
+        v = jf.add(a, b)
+        m = half
+    return fmap(lambda x: jnp.squeeze(x, axis=axis), v)
+
+
+def fdot(jf, a, b, axis=-1):
+    """Inner product along an axis."""
+    return fsum(jf, jf.mul(a, b), axis=axis)
+
+
+@partial(jax.jit, static_argnums=0)
+def _jit_mul(jf, a, b):
+    return jf.mul(a, b)
+
+
+def is_zero(v):
+    m = v[0] == _ZERO
+    for x in v[1:]:
+        m = m & (x == _ZERO)
+    return m

@@ -1,0 +1,25 @@
+"""Test configuration: force the CPU backend with 8 virtual devices.
+
+Multi-chip sharding is validated on a virtual device mesh
+(xla_force_host_platform_device_count), mirroring how the driver
+dry-runs the multichip path; real-TPU runs happen via bench.py.
+
+Note: the environment preimports jax in every process (sitecustomize)
+with JAX_PLATFORMS=axon, so we must override via jax.config, not just
+env vars, and before any backend is initialized.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

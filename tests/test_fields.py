@@ -1,0 +1,121 @@
+"""Differential tests: JAX field ops vs Python-int oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from janus_tpu.fields import Field64, Field128, JF64, JF128
+from janus_tpu.fields import jfield as jf
+
+
+CASES = [(Field64, JF64), (Field128, JF128)]
+
+
+def _rand_elems(field, n, rng):
+    # bias toward edge cases
+    p = field.MODULUS
+    edge = [0, 1, 2, p - 1, p - 2, (p - 1) // 2, 2**32, 2**32 - 1, 2**64 - 1 if p > 2**64 else 0, p >> 1]
+    vals = [e % p for e in edge]
+    vals += [rng.randrange(p) for _ in range(n - len(vals))]
+    return vals[:n]
+
+
+@pytest.mark.parametrize("field,jfield", CASES)
+def test_add_sub_mul_neg(field, jfield):
+    rng = random.Random(1234)
+    n = 256
+    a = _rand_elems(field, n, rng)
+    b = _rand_elems(field, n, rng)
+    rng.shuffle(b)
+    ja = jfield.from_ints(a)
+    jb = jfield.from_ints(b)
+
+    got = jfield.to_ints(jfield.add(ja, jb))
+    want = [field.add(x, y) for x, y in zip(a, b)]
+    assert list(got) == want
+
+    got = jfield.to_ints(jfield.sub(ja, jb))
+    want = [field.sub(x, y) for x, y in zip(a, b)]
+    assert list(got) == want
+
+    got = jfield.to_ints(jfield.mul(ja, jb))
+    want = [field.mul(x, y) for x, y in zip(a, b)]
+    assert list(got) == want
+
+    got = jfield.to_ints(jfield.neg(ja))
+    want = [field.neg(x) for x in a]
+    assert list(got) == want
+
+
+@pytest.mark.parametrize("field,jfield", CASES)
+def test_pow_inv(field, jfield):
+    rng = random.Random(99)
+    a = [rng.randrange(1, field.MODULUS) for _ in range(32)]
+    ja = jfield.from_ints(a)
+    got = jfield.to_ints(jf.finv(jfield, ja))
+    want = [field.inv(x) for x in a]
+    assert list(got) == want
+
+    e = rng.randrange(field.MODULUS)
+    got = jfield.to_ints(jf.fpow_const(jfield, ja, e))
+    want = [field.pow(x, e) for x in a]
+    assert list(got) == want
+
+
+@pytest.mark.parametrize("field,jfield", CASES)
+def test_fsum_fdot(field, jfield):
+    rng = random.Random(7)
+    n = 77  # non-power-of-two
+    a = [rng.randrange(field.MODULUS) for _ in range(n)]
+    b = [rng.randrange(field.MODULUS) for _ in range(n)]
+    ja = jfield.from_ints(a)
+    jb = jfield.from_ints(b)
+    got = jfield.to_ints(jf.fsum(jfield, ja, axis=0))
+    assert int(got) == sum(a) % field.MODULUS
+    got = jfield.to_ints(jf.fdot(jfield, ja, jb, axis=0))
+    assert int(got) == sum(x * y for x, y in zip(a, b)) % field.MODULUS
+
+
+@pytest.mark.parametrize("field,jfield", CASES)
+def test_root_of_unity_on_device(field, jfield):
+    # w^order == 1 and w^(order/2) == p-1 computed on device
+    order = 1 << 16
+    w = field.root_of_unity(order)
+    jw = jfield.from_ints([w])
+    got = jfield.to_ints(jf.fpow_const(jfield, jw, order))
+    assert int(got[0]) == 1
+    got = jfield.to_ints(jf.fpow_const(jfield, jw, order // 2))
+    assert int(got[0]) == field.MODULUS - 1
+
+
+@pytest.mark.parametrize("field,jfield", CASES)
+def test_mul_fuzz_wide(field, jfield):
+    rng = random.Random(4321)
+    n = 2048
+    a = [rng.randrange(field.MODULUS) for _ in range(n)]
+    b = [rng.randrange(field.MODULUS) for _ in range(n)]
+    got = jfield.to_ints(jfield.mul(jfield.from_ints(a), jfield.from_ints(b)))
+    want = [(x * y) % field.MODULUS for x, y in zip(a, b)]
+    assert list(got) == want
+
+
+def test_encode_decode_roundtrip():
+    rng = random.Random(5)
+    for field in (Field64, Field128):
+        for _ in range(20):
+            v = rng.randrange(field.MODULUS)
+            assert field.decode(field.encode(v)) == v
+        with pytest.raises(ValueError):
+            field.decode(b"\xff" * field.ENCODED_SIZE)
+
+
+def test_shapes_and_where():
+    a = JF128.from_ints(np.arange(12).reshape(3, 4))
+    b = JF128.from_ints(np.zeros((3, 4), dtype=int))
+    s = JF128.add(a, b)
+    assert jf.fshape(s) == (3, 4)
+    m = np.array([True, False, True, False])
+    w = jf.fwhere(m, a, b)
+    got = JF128.to_ints(w)
+    assert got[0, 0] == 0 and got[0, 1] == 0 and got[1, 2] == 6
