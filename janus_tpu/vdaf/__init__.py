@@ -1,0 +1,12 @@
+"""VDAF engine: XOF, FLP proof system, Prio3, ping-pong topology.
+
+This package owns the math the reference outsources to the external
+`prio` crate (SURVEY.md section 2.2): batched shard / prepare_init /
+prepare_next / aggregate / unshard over `[batch, ...]` arrays.
+
+Two implementations live side by side:
+  reference.py  -- host, Python ints, exact and slow; the oracle, and
+                   the path used by clients/tools for single reports.
+  engine.py     -- batched JAX (device) implementation of the hot path,
+                   differential-tested against reference.py.
+"""

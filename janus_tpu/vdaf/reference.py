@@ -1,0 +1,753 @@
+"""Host reference implementation of Prio3 (FLP + XOF + secret sharing).
+
+This is the exact-semantics oracle for the batched TPU engine
+(janus_tpu.vdaf.engine), and the implementation used by clients/tools
+for single reports. It owns the capability the reference outsources to
+the external `prio` crate (SURVEY.md section 2.2): Prio3 Count / Sum /
+SumVec / Histogram over Field64/Field128 with the FLP proof system of
+BBCG+19 as specified by the VDAF drafts.
+
+Structure of the FLP ("fully linear proof"):
+  * A validity circuit C evaluates arithmetic over the input, calling
+    nonlinear *gadgets* (degree-2 polynomials here) some number of times.
+  * prove(): the prover interpolates per-wire polynomials through the
+    gadget-call inputs (plus a random wire seed at alpha^0) and includes
+    each gadget's composed output polynomial in the proof.
+  * query(): each verifier evaluates C on its additive share, reading
+    gadget outputs from the proof polynomial (linear), and emits a
+    verifier share: [circuit output, wire evals at random t, proof
+    poly eval at t] per gadget.
+  * decide(): on the combined verifier message, the circuit output must
+    be 0 and each gadget identity G(wires(t)) == proofpoly(t) must hold.
+
+Divergence note (documented, performance-motivated): the joint-rand
+part binder for *seed-expanded* helper shares hashes the 16-byte seed
+rather than the expanded share encoding; the seed uniquely determines
+the share, so binding is preserved while keeping hashing O(1) per
+report. The reference's hot loop pays the full hash on CPU
+(aggregator/src/aggregator.rs:1633-1797 does all of this per report).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..fields.field import Field, Field64, Field128
+from .xof import (
+    SEED_SIZE,
+    USAGE_JOINT_RAND_PART,
+    USAGE_JOINT_RAND_SEED,
+    USAGE_JOINT_RANDOMNESS,
+    USAGE_MEASUREMENT_SHARE,
+    USAGE_PROOF_SHARE,
+    USAGE_PROVE_RANDOMNESS,
+    USAGE_QUERY_RANDOMNESS,
+    XofShake128,
+    dst,
+)
+
+VERIFY_KEY_SIZE = SEED_SIZE
+EVAL_POINT_CANDIDATES = 4  # fixed draw per gadget; first t with t^m != 1 wins
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+# ---------------------------------------------------------------------------
+# Host NTT (small sizes; constants feed the device NTT too)
+# ---------------------------------------------------------------------------
+
+
+def ntt(field: type[Field], coeffs: list[int], n: int) -> list[int]:
+    """Evaluate a polynomial (len <= n coeffs) at the n-th roots w^0..w^{n-1}."""
+    a = list(coeffs) + [0] * (n - len(coeffs))
+    _ntt_inplace(field, a, field.root_of_unity(n))
+    return a
+
+
+def intt(field: type[Field], evals: list[int]) -> list[int]:
+    """Inverse: values at w^0..w^{n-1} -> coefficients."""
+    n = len(evals)
+    a = list(evals)
+    _ntt_inplace(field, a, field.inv(field.root_of_unity(n)))
+    n_inv = field.inv(n)
+    return [field.mul(x, n_inv) for x in a]
+
+
+def _ntt_inplace(field: type[Field], a: list[int], root: int) -> None:
+    n = len(a)
+    assert n & (n - 1) == 0
+    p = field.MODULUS
+    # bit-reversal permutation
+    j = 0
+    for i in range(1, n):
+        bit = n >> 1
+        while j & bit:
+            j ^= bit
+            bit >>= 1
+        j |= bit
+        if i < j:
+            a[i], a[j] = a[j], a[i]
+    length = 2
+    while length <= n:
+        w_len = pow(root, n // length, p)
+        for start in range(0, n, length):
+            w = 1
+            for k in range(length // 2):
+                u = a[start + k]
+                v = a[start + k + length // 2] * w % p
+                a[start + k] = (u + v) % p
+                a[start + k + length // 2] = (u - v) % p
+                w = w * w_len % p
+        length <<= 1
+
+
+def poly_eval(field: type[Field], coeffs: list[int], x: int) -> int:
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % field.MODULUS
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Gadgets
+# ---------------------------------------------------------------------------
+
+
+class Gadget:
+    arity: int
+    degree: int
+
+    def eval(self, field: type[Field], inputs: list[int]) -> int:
+        raise NotImplementedError
+
+
+class Mul(Gadget):
+    arity = 2
+    degree = 2
+
+    def eval(self, field, inputs):
+        return field.mul(inputs[0], inputs[1])
+
+
+class PolyEval(Gadget):
+    """p(x) for a fixed polynomial p; arity 1."""
+
+    arity = 1
+
+    def __init__(self, coeffs: list[int]):
+        self.coeffs = coeffs
+        self.degree = len(coeffs) - 1
+
+    def eval(self, field, inputs):
+        return poly_eval(field, self.coeffs, inputs[0])
+
+
+class ParallelSum(Gadget):
+    """sum_{c} inner(inputs[c*k : (c+1)*k]) for an inner gadget of arity k."""
+
+    def __init__(self, inner: Gadget, count: int):
+        self.inner = inner
+        self.count = count
+        self.arity = inner.arity * count
+        self.degree = inner.degree
+
+    def eval(self, field, inputs):
+        k = self.inner.arity
+        acc = 0
+        for c in range(self.count):
+            acc = field.add(acc, self.inner.eval(field, inputs[c * k : (c + 1) * k]))
+        return acc
+
+
+# ---------------------------------------------------------------------------
+# Validity circuits
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GadgetUse:
+    gadget: Gadget
+    calls: int
+
+    @property
+    def wire_poly_len(self) -> int:  # m
+        return next_pow2(1 + self.calls)
+
+    @property
+    def gadget_poly_len(self) -> int:  # degree*(m-1) + 1 coefficients
+        return self.gadget.degree * (self.wire_poly_len - 1) + 1
+
+
+class Circuit:
+    """A validity circuit. Subclasses define encode/truncate/decode and the
+    gadget-call schedule. Constraint (relied on by query()): gadget inputs
+    are affine in (input, joint_rand-scaled input terms) and never depend
+    on other gadget outputs; the final output is affine in gadget outputs.
+    """
+
+    FIELD: type[Field]
+    input_len: int
+    joint_rand_len: int
+    output_len: int
+    gadget_uses: list[GadgetUse]
+    # measurement type tag for registries
+    algo_id: int
+
+    @property
+    def prove_rand_len(self) -> int:
+        return sum(g.gadget.arity for g in self.gadget_uses)
+
+    @property
+    def query_rand_len(self) -> int:
+        return EVAL_POINT_CANDIDATES * len(self.gadget_uses)
+
+    @property
+    def proof_len(self) -> int:
+        return sum(g.gadget.arity + g.gadget_poly_len for g in self.gadget_uses)
+
+    @property
+    def verifier_len(self) -> int:
+        return 1 + sum(g.gadget.arity + 1 for g in self.gadget_uses)
+
+    # --- measurement plumbing ---
+    def encode(self, measurement) -> list[int]:
+        raise NotImplementedError
+
+    def truncate(self, input_: list[int]) -> list[int]:
+        raise NotImplementedError
+
+    def decode(self, output: list[int], num_measurements: int):
+        raise NotImplementedError
+
+    # --- circuit schedule ---
+    def gadget_inputs(self, inp: list[int], joint_rand: list[int], shares_inv: int):
+        """Per gadget-use: list over calls of input lists (arity long)."""
+        raise NotImplementedError
+
+    def finish(
+        self,
+        inp: list[int],
+        joint_rand: list[int],
+        gadget_outputs: list[list[int]],
+        shares_inv: int,
+    ) -> int:
+        """Affine combination producing the single circuit output."""
+        raise NotImplementedError
+
+
+class Count(Circuit):
+    """measurement in {0,1}; check x*x - x == 0. Field64, one Mul call."""
+
+    FIELD = Field64
+    input_len = 1
+    joint_rand_len = 0
+    output_len = 1
+    algo_id = 0x00000000
+
+    def __init__(self):
+        self.gadget_uses = [GadgetUse(Mul(), 1)]
+
+    def encode(self, measurement):
+        assert measurement in (0, 1)
+        return [measurement]
+
+    def truncate(self, input_):
+        return list(input_)
+
+    def decode(self, output, num_measurements):
+        return output[0]
+
+    def gadget_inputs(self, inp, joint_rand, shares_inv):
+        return [[[inp[0], inp[0]]]]
+
+    def finish(self, inp, joint_rand, gadget_outputs, shares_inv):
+        return self.FIELD.sub(gadget_outputs[0][0], inp[0])
+
+
+class Sum(Circuit):
+    """measurement in [0, 2^bits); input = bit decomposition.
+
+    Bit check via PolyEval(x^2 - x) per bit, combined with powers of one
+    joint-rand element.
+    """
+
+    FIELD = Field128
+    joint_rand_len = 1
+    output_len = 1
+    algo_id = 0x00000001
+
+    def __init__(self, bits: int):
+        self.bits = bits
+        self.input_len = bits
+        p = self.FIELD.MODULUS
+        self.gadget_uses = [GadgetUse(PolyEval([0, p - 1, 1]), bits)]  # x^2 - x
+
+    def encode(self, measurement):
+        assert 0 <= measurement < (1 << self.bits)
+        return [(measurement >> j) & 1 for j in range(self.bits)]
+
+    def truncate(self, input_):
+        F = self.FIELD
+        acc = 0
+        for j, b in enumerate(input_):
+            acc = F.add(acc, F.mul(pow(2, j, F.MODULUS), b))
+        return [acc]
+
+    def decode(self, output, num_measurements):
+        return output[0]
+
+    def gadget_inputs(self, inp, joint_rand, shares_inv):
+        return [[[x] for x in inp]]
+
+    def finish(self, inp, joint_rand, gadget_outputs, shares_inv):
+        F = self.FIELD
+        r = joint_rand[0]
+        acc = 0
+        rp = r
+        for out in gadget_outputs[0]:
+            acc = F.add(acc, F.mul(rp, out))
+            rp = F.mul(rp, r)
+        return acc
+
+
+class SumVec(Circuit):
+    """Vector of `length` values, each in [0, 2^bits).
+
+    Input is length*bits bit entries. Bit check: sum_i s_i * x_i * (x_i-1)
+    == 0 with s_i = r^{i+1}, evaluated chunk-wise through a
+    ParallelSum(Mul, chunk_length) gadget (the structural analog of the
+    reference's sqrt-chunked ParallelSum gadget, core/src/task.rs:84-86).
+    """
+
+    FIELD = Field128
+    joint_rand_len = 1
+    algo_id = 0x00000002
+
+    def __init__(self, length: int, bits: int, chunk_length: int | None = None):
+        self.length = length
+        self.bits = bits
+        self.input_len = length * bits
+        self.output_len = length
+        self.chunk_length = chunk_length or optimal_chunk_length(self.input_len)
+        calls = (self.input_len + self.chunk_length - 1) // self.chunk_length
+        self.gadget_uses = [GadgetUse(ParallelSum(Mul(), self.chunk_length), calls)]
+
+    def encode(self, measurement):
+        assert len(measurement) == self.length
+        out = []
+        for v in measurement:
+            assert 0 <= v < (1 << self.bits)
+            out.extend((v >> j) & 1 for j in range(self.bits))
+        return out
+
+    def truncate(self, input_):
+        F = self.FIELD
+        out = []
+        for i in range(self.length):
+            acc = 0
+            for j in range(self.bits):
+                acc = F.add(
+                    acc, F.mul(pow(2, j, F.MODULUS), input_[i * self.bits + j])
+                )
+            out.append(acc)
+        return out
+
+    def decode(self, output, num_measurements):
+        return list(output)
+
+    def gadget_inputs(self, inp, joint_rand, shares_inv):
+        F = self.FIELD
+        r = joint_rand[0]
+        n = self.input_len
+        ch = self.chunk_length
+        calls = self.gadget_uses[0].calls
+        rp = r
+        out = []
+        for k in range(calls):
+            call_inputs = []
+            for c in range(ch):
+                i = k * ch + c
+                if i < n:
+                    call_inputs += [F.mul(rp, inp[i]), F.sub(inp[i], neg_share_const(F, shares_inv))]
+                    rp = F.mul(rp, r)
+                else:
+                    call_inputs += [0, 0]
+            out.append(call_inputs)
+        return [out]
+
+    def finish(self, inp, joint_rand, gadget_outputs, shares_inv):
+        F = self.FIELD
+        acc = 0
+        for out in gadget_outputs[0]:
+            acc = F.add(acc, out)
+        return acc
+
+
+def neg_share_const(field: type[Field], shares_inv: int) -> int:
+    """The share of the public constant 1 held by each aggregator."""
+    return shares_inv
+
+
+class Histogram(Circuit):
+    """One-hot vector of `length` buckets.
+
+    Two checks combined with joint randomness: every entry is a bit
+    (ParallelSum chunked as in SumVec, randomized by powers of jr[0]),
+    and the entries sum to one (weighted by jr[1]).
+    """
+
+    FIELD = Field128
+    joint_rand_len = 2
+    algo_id = 0x00000003
+
+    def __init__(self, length: int, chunk_length: int | None = None):
+        self.length = length
+        self.input_len = length
+        self.output_len = length
+        self.chunk_length = chunk_length or optimal_chunk_length(length)
+        calls = (length + self.chunk_length - 1) // self.chunk_length
+        self.gadget_uses = [GadgetUse(ParallelSum(Mul(), self.chunk_length), calls)]
+
+    def encode(self, measurement):
+        assert 0 <= measurement < self.length
+        return [1 if i == measurement else 0 for i in range(self.length)]
+
+    def truncate(self, input_):
+        return list(input_)
+
+    def decode(self, output, num_measurements):
+        return list(output)
+
+    def gadget_inputs(self, inp, joint_rand, shares_inv):
+        F = self.FIELD
+        r = joint_rand[0]
+        ch = self.chunk_length
+        calls = self.gadget_uses[0].calls
+        rp = r
+        out = []
+        for k in range(calls):
+            call_inputs = []
+            for c in range(ch):
+                i = k * ch + c
+                if i < self.length:
+                    call_inputs += [
+                        F.mul(rp, inp[i]),
+                        F.sub(inp[i], neg_share_const(F, shares_inv)),
+                    ]
+                    rp = F.mul(rp, r)
+                else:
+                    call_inputs += [0, 0]
+            out.append(call_inputs)
+        return [out]
+
+    def finish(self, inp, joint_rand, gadget_outputs, shares_inv):
+        F = self.FIELD
+        bit_check = 0
+        for out in gadget_outputs[0]:
+            bit_check = F.add(bit_check, out)
+        sum_check = F.sub(sum(inp) % F.MODULUS, shares_inv)  # sum - 1 (shared)
+        return F.add(bit_check, F.mul(joint_rand[1], sum_check))
+
+
+def optimal_chunk_length(measurement_length: int) -> int:
+    """sqrt-ish chunk size balancing gadget arity vs calls (the same
+    heuristic the reference applies, core/src/task.rs:84-86)."""
+    return max(1, int(measurement_length**0.5))
+
+
+# ---------------------------------------------------------------------------
+# FLP generic prove / query / decide
+# ---------------------------------------------------------------------------
+
+
+def flp_prove(circ: Circuit, inp: list[int], prove_rand: list[int], joint_rand: list[int]) -> list[int]:
+    F = circ.FIELD
+    all_gadget_inputs = circ.gadget_inputs(inp, joint_rand, 1)
+    proof: list[int] = []
+    pr = iter(prove_rand)
+    for use, calls_inputs in zip(circ.gadget_uses, all_gadget_inputs):
+        g = use.gadget
+        m = use.wire_poly_len
+        seeds = [next(pr) for _ in range(g.arity)]
+        wire_polys = []
+        for j in range(g.arity):
+            evals = [seeds[j]] + [ci[j] for ci in calls_inputs]
+            evals += [0] * (m - len(evals))
+            wire_polys.append(intt(F, _to_domain_order(F, evals, m)))
+        n2 = next_pow2(g.degree * (m - 1) + 1)
+        wire_evals = [ntt(F, wp, n2) for wp in wire_polys]
+        gadget_evals = [
+            g.eval(F, [wire_evals[j][i] for j in range(g.arity)]) for i in range(n2)
+        ]
+        gpoly = intt(F, gadget_evals)
+        keep = use.gadget_poly_len
+        assert all(c == 0 for c in gpoly[keep:]), "gadget poly degree overflow"
+        proof += seeds + gpoly[:keep]
+    assert len(proof) == circ.proof_len
+    return proof
+
+
+def _to_domain_order(field: type[Field], evals: list[int], m: int) -> list[int]:
+    """Wire values are indexed seed@alpha^0, call k@alpha^{k+1}; the NTT
+    domain is exactly that order, so this is the identity (kept for
+    clarity/symmetry with the device engine)."""
+    assert len(evals) == m
+    return evals
+
+
+def flp_query(
+    circ: Circuit,
+    inp_share: list[int],
+    proof_share: list[int],
+    query_rand: list[int],
+    joint_rand: list[int],
+    num_shares: int,
+) -> list[int]:
+    F = circ.FIELD
+    shares_inv = F.inv(num_shares)
+    all_gadget_inputs = circ.gadget_inputs(inp_share, joint_rand, shares_inv)
+    qr = iter(query_rand)
+    pf_pos = 0
+    verifier: list[int] = []
+    gadget_outputs = []
+    per_gadget_tail: list[int] = []
+    for use, calls_inputs in zip(circ.gadget_uses, all_gadget_inputs):
+        g = use.gadget
+        m = use.wire_poly_len
+        seeds = proof_share[pf_pos : pf_pos + g.arity]
+        pf_pos += g.arity
+        gcoeffs = proof_share[pf_pos : pf_pos + use.gadget_poly_len]
+        pf_pos += use.gadget_poly_len
+        alpha = F.root_of_unity(m)
+        t = _pick_eval_point([next(qr) for _ in range(EVAL_POINT_CANDIDATES)], F, m)
+        # gadget outputs at call points alpha^{k+1}
+        outs = [poly_eval(F, gcoeffs, pow(alpha, k + 1, F.MODULUS)) for k in range(use.calls)]
+        gadget_outputs.append(outs)
+        # wire polys (shares) and their evals at t
+        for j in range(g.arity):
+            evals = [seeds[j]] + [ci[j] for ci in calls_inputs]
+            evals += [0] * (m - len(evals))
+            wp = intt(F, evals)
+            per_gadget_tail.append(poly_eval(F, wp, t))
+        per_gadget_tail.append(poly_eval(F, gcoeffs, t))
+    v = circ.finish(inp_share, joint_rand, gadget_outputs, shares_inv)
+    verifier = [v] + per_gadget_tail
+    assert len(verifier) == circ.verifier_len
+    return verifier
+
+
+def _pick_eval_point(candidates: list[int], field: type[Field], m: int) -> int:
+    for t in candidates:
+        if pow(t, m, field.MODULUS) != 1:
+            return t
+    raise ValueError("no valid FLP evaluation point in candidate draw")
+
+
+def flp_decide(circ: Circuit, verifier: list[int]) -> bool:
+    F = circ.FIELD
+    if verifier[0] % F.MODULUS != 0:
+        return False
+    idx = 1
+    for use in circ.gadget_uses:
+        g = use.gadget
+        wires = verifier[idx : idx + g.arity]
+        y = verifier[idx + g.arity]
+        idx += g.arity + 1
+        if g.eval(F, wires) != y % F.MODULUS:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Prio3 VDAF (multi-share; DAP uses exactly 2: leader=0, helper=1)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LeaderShare:
+    measurement_share: list[int]
+    proof_share: list[int]
+    joint_rand_blind: bytes | None
+
+
+@dataclass
+class HelperShare:
+    seed: bytes
+    joint_rand_blind: bytes | None
+
+
+@dataclass
+class PrepState:
+    out_share: list[int]
+    corrected_joint_rand_seed: bytes | None
+
+
+@dataclass
+class PrepShare:
+    verifier_share: list[int]
+    joint_rand_part: bytes | None
+
+
+class Prio3:
+    NUM_SHARES = 2
+    ROUNDS = 1
+
+    def __init__(self, circuit: Circuit):
+        self.circuit = circuit
+
+    # --- domain separation ---
+    def _dst(self, usage: int) -> bytes:
+        return dst(self.circuit.algo_id, usage)
+
+    @property
+    def uses_joint_rand(self) -> bool:
+        return self.circuit.joint_rand_len > 0
+
+    @property
+    def rand_size(self) -> int:
+        n = 2  # prove seed + helper seed
+        if self.uses_joint_rand:
+            n += self.NUM_SHARES  # blinds
+        return n * SEED_SIZE
+
+    # --- sharding (client side) ---
+    def shard(self, measurement, nonce: bytes, rand: bytes | None = None):
+        circ = self.circuit
+        F = circ.FIELD
+        if rand is None:
+            rand = secrets.token_bytes(self.rand_size)
+        assert len(rand) == self.rand_size
+        seeds = [rand[i : i + SEED_SIZE] for i in range(0, len(rand), SEED_SIZE)]
+        prove_seed, helper_seed = seeds[0], seeds[1]
+        blinds = seeds[2:] if self.uses_joint_rand else [None, None]
+
+        inp = circ.encode(measurement)
+        helper_meas = self._expand(helper_seed, USAGE_MEASUREMENT_SHARE, b"\x01", circ.input_len)
+        leader_meas = [F.sub(x, h) for x, h in zip(inp, helper_meas)]
+
+        joint_rand: list[int] = []
+        parts: list[bytes] = []
+        if self.uses_joint_rand:
+            parts = [
+                self._joint_rand_part(0, blinds[0], nonce, self._encode_vec(leader_meas)),
+                self._joint_rand_part(1, blinds[1], nonce, helper_seed),
+            ]
+            jr_seed = self._joint_rand_seed(parts)
+            joint_rand = prng_next_vec(F, jr_seed, self._dst(USAGE_JOINT_RANDOMNESS), b"", circ.joint_rand_len)
+
+        prove_rand = prng_next_vec(
+            F, prove_seed, self._dst(USAGE_PROVE_RANDOMNESS), b"", circ.prove_rand_len
+        )
+        proof = flp_prove(circ, inp, prove_rand, joint_rand)
+        helper_proof = self._expand(helper_seed, USAGE_PROOF_SHARE, b"\x01", circ.proof_len)
+        leader_proof = [F.sub(x, h) for x, h in zip(proof, helper_proof)]
+
+        public_share = parts if self.uses_joint_rand else []
+        shares = [
+            LeaderShare(leader_meas, leader_proof, blinds[0]),
+            HelperShare(helper_seed, blinds[1]),
+        ]
+        return public_share, shares
+
+    # --- preparation (aggregator side) ---
+    def prepare_init(
+        self,
+        verify_key: bytes,
+        agg_id: int,
+        nonce: bytes,
+        public_share: list[bytes],
+        input_share,
+    ) -> tuple[PrepState, PrepShare]:
+        circ = self.circuit
+        F = circ.FIELD
+        if isinstance(input_share, HelperShare):
+            meas = self._expand(input_share.seed, USAGE_MEASUREMENT_SHARE, b"\x01", circ.input_len)
+            proof = self._expand(input_share.seed, USAGE_PROOF_SHARE, b"\x01", circ.proof_len)
+            blind = input_share.joint_rand_blind
+            part_binder = input_share.seed
+        else:
+            meas = input_share.measurement_share
+            proof = input_share.proof_share
+            blind = input_share.joint_rand_blind
+            part_binder = self._encode_vec(meas)
+
+        joint_rand: list[int] = []
+        corrected_seed = None
+        own_part = None
+        if self.uses_joint_rand:
+            own_part = self._joint_rand_part(agg_id, blind, nonce, part_binder)
+            parts = list(public_share)
+            parts[agg_id] = own_part
+            corrected_seed = self._joint_rand_seed(parts)
+            joint_rand = prng_next_vec(
+                F, corrected_seed, self._dst(USAGE_JOINT_RANDOMNESS), b"", circ.joint_rand_len
+            )
+
+        query_rand = prng_next_vec(
+            F, verify_key, self._dst(USAGE_QUERY_RANDOMNESS), nonce, circ.query_rand_len
+        )
+        verifier_share = flp_query(circ, meas, proof, query_rand, joint_rand, self.NUM_SHARES)
+        state = PrepState(circ.truncate(meas), corrected_seed)
+        return state, PrepShare(verifier_share, own_part)
+
+    def prepare_shares_to_prep(self, prep_shares: list[PrepShare]) -> bytes | None:
+        """Combine prep shares; returns the prep message. Raises on invalid."""
+        circ = self.circuit
+        F = circ.FIELD
+        verifier = [0] * circ.verifier_len
+        for ps in prep_shares:
+            verifier = [F.add(a, b) for a, b in zip(verifier, ps.verifier_share)]
+        if not flp_decide(circ, verifier):
+            raise VdafError("FLP check failed: report invalid")
+        if self.uses_joint_rand:
+            return self._joint_rand_seed([ps.joint_rand_part for ps in prep_shares])
+        return None
+
+    def prepare_next(self, state: PrepState, prep_msg: bytes | None) -> list[int]:
+        """Final transition: returns the output share. Raises on invalid."""
+        if self.uses_joint_rand and prep_msg != state.corrected_joint_rand_seed:
+            raise VdafError("joint randomness check failed: report invalid")
+        return state.out_share
+
+    # --- aggregation / unsharding ---
+    def aggregate(self, out_shares: list[list[int]]) -> list[int]:
+        F = self.circuit.FIELD
+        agg = [0] * self.circuit.output_len
+        for s in out_shares:
+            agg = [F.add(a, b) for a, b in zip(agg, s)]
+        return agg
+
+    def unshard(self, agg_shares: list[list[int]], num_measurements: int):
+        F = self.circuit.FIELD
+        agg = [0] * self.circuit.output_len
+        for s in agg_shares:
+            agg = [F.add(a, b) for a, b in zip(agg, s)]
+        return self.circuit.decode(agg, num_measurements)
+
+    # --- internals ---
+    def _expand(self, seed: bytes, usage: int, binder: bytes, length: int) -> list[int]:
+        return prng_next_vec(self.circuit.FIELD, seed, self._dst(usage), binder, length)
+
+    def _joint_rand_part(self, agg_id: int, blind: bytes, nonce: bytes, share_binder: bytes) -> bytes:
+        return XofShake128.derive_seed(
+            blind, self._dst(USAGE_JOINT_RAND_PART), bytes([agg_id]) + nonce + share_binder
+        )
+
+    def _joint_rand_seed(self, parts: list[bytes]) -> bytes:
+        return XofShake128.derive_seed(
+            b"\x00" * SEED_SIZE, self._dst(USAGE_JOINT_RAND_SEED), b"".join(parts)
+        )
+
+    def _encode_vec(self, vec: list[int]) -> bytes:
+        return self.circuit.FIELD.encode_vec(vec)
+
+
+class VdafError(Exception):
+    pass
+
+
+def prng_next_vec(field, seed, dst_, binder, length):
+    return XofShake128(seed, dst_, binder).next_vec(field, length)

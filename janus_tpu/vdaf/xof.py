@@ -1,0 +1,91 @@
+"""XOF (extendable output function) for VDAF: SHAKE128-based.
+
+Mirrors the XofShake128 construction of VDAF-07 (the VDAF draft the
+reference's `prio` 0.15 dependency implements; SURVEY.md section 2.2
+"XOF (SHAKE128-family) share/joint-randomness expansion"):
+
+    stream = SHAKE128( byte(len(dst)) || dst || seed || binder )
+
+Field-element sampling reads ENCODED_SIZE-byte little-endian chunks and
+rejects values >= p (rejection probability ~2^-32 for both fields).
+
+The device-side equivalent (janus_tpu.vdaf.keccak_jax) implements the
+same stream semantics with a batched Keccak-f[1600] permutation so that
+helper share expansion never leaves the TPU; this module is the host
+oracle and the path used for small per-report derivations.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+SEED_SIZE = 16
+
+# Domain-separation usage tags (one byte each), following the Prio3
+# usage enumeration. The exact byte values are internal to this
+# framework's two cooperating aggregators; both sides derive them from
+# here.
+USAGE_SHARD_RAND = 1
+USAGE_MEASUREMENT_SHARE = 2
+USAGE_PROOF_SHARE = 3
+USAGE_JOINT_RANDOMNESS = 4
+USAGE_PROVE_RANDOMNESS = 5
+USAGE_QUERY_RANDOMNESS = 6
+USAGE_JOINT_RAND_SEED = 7
+USAGE_JOINT_RAND_PART = 8
+
+ALGO_CLASS_VDAF = 0
+
+
+def dst(algo_id: int, usage: int, version: int = 7) -> bytes:
+    """Domain-separation tag: class || version || algo id || usage."""
+    return (
+        bytes([ALGO_CLASS_VDAF, version])
+        + algo_id.to_bytes(4, "big")
+        + usage.to_bytes(2, "big")
+    )
+
+
+class XofShake128:
+    SEED_SIZE = SEED_SIZE
+
+    def __init__(self, seed: bytes, dst_: bytes, binder: bytes = b""):
+        assert len(seed) == SEED_SIZE
+        assert len(dst_) < 256
+        self._shake = hashlib.shake_128()
+        self._shake.update(bytes([len(dst_)]) + dst_ + seed + binder)
+        self._buf = b""
+        self._pos = 0
+
+    def update(self, binder: bytes) -> None:
+        assert self._pos == 0, "cannot absorb after squeezing"
+        self._shake.update(binder)
+
+    def next(self, n: int) -> bytes:
+        need = self._pos + n
+        if need > len(self._buf):
+            # hashlib has no incremental squeeze; re-digest with headroom.
+            self._buf = self._shake.digest(max(need, 2 * len(self._buf), 512))
+        out = self._buf[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def next_vec(self, field, length: int) -> list[int]:
+        """Sample `length` field elements by rejection sampling."""
+        out: list[int] = []
+        size = field.ENCODED_SIZE
+        while len(out) < length:
+            chunk = self.next(size)
+            v = int.from_bytes(chunk, "little")
+            if v < field.MODULUS:
+                out.append(v)
+        return out
+
+    @classmethod
+    def derive_seed(cls, seed: bytes, dst_: bytes, binder: bytes = b"") -> bytes:
+        return cls(seed, dst_, binder).next(SEED_SIZE)
+
+
+def prng_expand(field, seed: bytes, dst_: bytes, binder: bytes, length: int):
+    """Expand a seed into a vector of field elements (host path)."""
+    return XofShake128(seed, dst_, binder).next_vec(field, length)

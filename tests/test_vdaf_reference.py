@@ -1,0 +1,144 @@
+"""End-to-end tests of the host Prio3 reference implementation.
+
+Mirrors the reference's transcript-style testing (golden transcripts via
+run_vdaf, reference core/src/test_util/mod.rs:50-235): both parties'
+states/messages are computed locally, so multi-party protocol logic is
+tested without a cluster.
+"""
+
+import secrets
+
+import pytest
+
+from janus_tpu.vdaf.reference import (
+    Count,
+    Histogram,
+    Prio3,
+    Sum,
+    SumVec,
+    VdafError,
+)
+
+NONCE = bytes(range(16))
+VK = b"\x07" * 16
+
+
+def run_prio3(vdaf: Prio3, measurements, tamper=None):
+    """Full shard->prepare->aggregate->unshard transcript for a list of
+    measurements; returns the unsharded aggregate result."""
+    out_shares = [[], []]
+    for m in measurements:
+        nonce = secrets.token_bytes(16)
+        public_share, shares = vdaf.shard(m, nonce)
+        if tamper:
+            tamper(public_share, shares)
+        states, prep_shares = [], []
+        for agg_id in (0, 1):
+            st, ps = vdaf.prepare_init(VK, agg_id, nonce, public_share, shares[agg_id])
+            states.append(st)
+            prep_shares.append(ps)
+        prep_msg = vdaf.prepare_shares_to_prep(prep_shares)
+        for agg_id in (0, 1):
+            out_shares[agg_id].append(vdaf.prepare_next(states[agg_id], prep_msg))
+    agg_shares = [vdaf.aggregate(out_shares[0]), vdaf.aggregate(out_shares[1])]
+    return vdaf.unshard(agg_shares, len(measurements))
+
+
+def test_count_roundtrip():
+    vdaf = Prio3(Count())
+    assert run_prio3(vdaf, [1, 0, 1, 1, 0, 1]) == 4
+
+
+def test_sum_roundtrip():
+    vdaf = Prio3(Sum(bits=16))
+    assert run_prio3(vdaf, [100, 200, 65535, 0]) == 65835
+
+
+def test_sumvec_roundtrip():
+    vdaf = Prio3(SumVec(length=5, bits=4))
+    got = run_prio3(vdaf, [[1, 2, 3, 4, 5], [15, 0, 1, 0, 2]])
+    assert got == [16, 2, 4, 4, 7]
+
+
+def test_histogram_roundtrip():
+    vdaf = Prio3(Histogram(length=10))
+    got = run_prio3(vdaf, [3, 3, 7, 0, 9, 3])
+    assert got == [1, 0, 0, 3, 0, 0, 0, 1, 0, 1]
+
+
+def test_invalid_count_rejected():
+    # A count measurement that is neither 0 nor 1 must fail the FLP.
+    vdaf = Prio3(Count())
+    circ = vdaf.circuit
+
+    orig_encode = circ.encode
+    circ.encode = lambda m: [7]  # invalid: 7^2 - 7 != 0
+    try:
+        with pytest.raises(VdafError):
+            run_prio3(vdaf, [1])
+    finally:
+        circ.encode = orig_encode
+
+
+def test_invalid_sum_bit_rejected():
+    vdaf = Prio3(Sum(bits=8))
+    circ = vdaf.circuit
+    orig_encode = circ.encode
+    circ.encode = lambda m: [2] + [0] * 7  # entry not a bit
+    try:
+        with pytest.raises(VdafError):
+            run_prio3(vdaf, [1])
+    finally:
+        circ.encode = orig_encode
+
+
+def test_invalid_histogram_two_hot_rejected():
+    vdaf = Prio3(Histogram(length=4))
+    circ = vdaf.circuit
+    orig_encode = circ.encode
+    circ.encode = lambda m: [1, 1, 0, 0]  # two-hot: sum check must fail
+    try:
+        with pytest.raises(VdafError):
+            run_prio3(vdaf, [0])
+    finally:
+        circ.encode = orig_encode
+
+
+def test_tampered_share_rejected():
+    vdaf = Prio3(Sum(bits=8))
+
+    def tamper(public_share, shares):
+        shares[0].measurement_share[0] = (shares[0].measurement_share[0] + 1) % vdaf.circuit.FIELD.MODULUS
+
+    with pytest.raises(VdafError):
+        run_prio3(vdaf, [5], tamper=tamper)
+
+
+def test_tampered_joint_rand_hint_rejected():
+    vdaf = Prio3(Sum(bits=8))
+
+    # Corrupting a joint-rand hint must be caught by the seed check in
+    # prepare_next (the hint path), even though the FLP itself may pass.
+    nonce = secrets.token_bytes(16)
+    public_share, shares = vdaf.shard(5, nonce)
+    public_share[0] = bytes(16)
+    states, prep_shares = [], []
+    for agg_id in (0, 1):
+        st, ps = vdaf.prepare_init(VK, agg_id, nonce, public_share, shares[agg_id])
+        states.append(st)
+        prep_shares.append(ps)
+    try:
+        prep_msg = vdaf.prepare_shares_to_prep(prep_shares)
+    except VdafError:
+        return  # acceptable: FLP fails because parties used different jr
+    with pytest.raises(VdafError):
+        # agg 1 used the corrupted hint for the leader part; its corrected
+        # seed cannot match the true prep message.
+        vdaf.prepare_next(states[1], prep_msg)
+
+
+def test_sumvec_chunking_nondivisible():
+    # length*bits = 21, chunk default sqrt(21)=4 -> padded final call
+    vdaf = Prio3(SumVec(length=7, bits=3, chunk_length=4))
+    got = run_prio3(vdaf, [[1, 2, 3, 4, 5, 6, 7]])
+    assert got == [1, 2, 3, 4, 5, 6, 7]
