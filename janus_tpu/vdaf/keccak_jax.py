@@ -1,0 +1,198 @@
+"""Batched Keccak-f[1600] / SHAKE128 in JAX for on-device XOF expansion.
+
+The VDAF hot path needs, per report, hundreds of KB of XOF output to
+expand helper measurement/proof shares from 16-byte seeds (the
+reference does this on CPU inside `prio`'s Xof, one report at a time,
+invoked from aggregator/src/aggregator.rs:1775-1797). Keccak is pure
+64-bit bitwise logic, which vectorizes perfectly across a report batch:
+the state is 25 u64 lanes per report, and every round is elementwise
+XOR/rotate/and-not over [batch, 25]-shaped lanes. On TPU the u64 ops
+lower to u32 pairs on the VPU; throughput scales with batch size.
+
+Stream framing matches janus_tpu.vdaf.xof exactly (all absorbed
+messages are u64-lane-aligned by construction), so host and device
+produce byte-identical streams — tested in tests/test_keccak.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U64 = jnp.uint64
+
+RATE_BYTES = 168  # SHAKE128
+RATE_LANES = RATE_BYTES // 8  # 21
+
+_RC = np.array(
+    [
+        0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+        0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+        0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+        0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+        0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+        0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+        0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+        0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+    ],
+    dtype=np.uint64,
+)
+
+# rotation offsets indexed [x][y]
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+
+def _rotl(x, r: int):
+    if r == 0:
+        return x
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def keccak_f1600(state: list):
+    """One permutation. state: 25 u64 arrays (lane (x,y) at index x + 5*y)."""
+    a = list(state)
+    for rnd in range(24):
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        a = [a[i] ^ d[i % 5] for i in range(25)]
+        # rho + pi: B[y, 2x+3y] = rot(A[x, y])
+        b = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], _ROT[x][y])
+        # chi
+        a = [
+            b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y])
+            for y in range(5)
+            for x in range(5)
+        ]
+        # iota
+        a[0] = a[0] ^ _RC[rnd]
+    return a
+
+
+def shake128_squeeze_lanes(msg_lanes, out_blocks: int):
+    """SHAKE128 over pre-padded messages; returns raw squeezed lanes.
+
+    msg_lanes: [batch, n_blocks, 21] u64 — the message already padded to
+    whole rate blocks (use pad_message_lanes). Returns
+    [batch, out_blocks, 21] u64 of output stream lanes.
+    """
+    batch = msg_lanes.shape[0]
+    n_blocks = msg_lanes.shape[1]
+    state = [jnp.zeros((batch,), dtype=U64) for _ in range(25)]
+    for blk in range(n_blocks):
+        for lane in range(RATE_LANES):
+            state[lane] = state[lane] ^ msg_lanes[:, blk, lane]
+        state = keccak_f1600(state)
+    outs = []
+    for blk in range(out_blocks):
+        if blk > 0:
+            state = keccak_f1600(state)
+        outs.append(jnp.stack(state[:RATE_LANES], axis=-1))
+    return jnp.stack(outs, axis=1)
+
+
+def pad_message_lanes(parts, msg_len_bytes: int, batch: int):
+    """Assemble a padded SHAKE128 message as [batch, n_blocks, 21] lanes.
+
+    parts: list of (lane_offset, lanes) where lanes is a [batch, k] u64
+    array (dynamic content) or a host bytes object of length 8*k (static
+    content). msg_len_bytes must be a multiple of 8 (guaranteed by the
+    lane-aligned stream framing in janus_tpu.vdaf.xof).
+    """
+    assert msg_len_bytes % 8 == 0
+    msg_lanes_n = msg_len_bytes // 8
+    n_blocks = msg_lanes_n // RATE_LANES + 1  # always room for padding
+    total = n_blocks * RATE_LANES
+    cols = [jnp.zeros((batch,), dtype=U64)] * total
+    for off, content in parts:
+        if isinstance(content, (bytes, bytearray)):
+            assert len(content) % 8 == 0
+            for i in range(len(content) // 8):
+                v = int.from_bytes(content[8 * i : 8 * i + 8], "little")
+                cols[off + i] = jnp.full((batch,), np.uint64(v), dtype=U64)
+        else:
+            for i in range(content.shape[-1]):
+                cols[off + i] = content[:, i].astype(U64)
+    # SHAKE padding: 0x1F at msg end, 0x80 at last byte of the block
+    pad_lane = msg_lanes_n
+    cols[pad_lane] = cols[pad_lane] ^ np.uint64(0x1F)
+    cols[total - 1] = cols[total - 1] ^ np.uint64(0x80 << 56)
+    lanes = jnp.stack(cols, axis=-1)
+    return lanes.reshape(batch, n_blocks, RATE_LANES)
+
+
+def bytes_to_lanes(data: bytes) -> np.ndarray:
+    assert len(data) % 8 == 0
+    return np.frombuffer(data, dtype="<u8").astype(np.uint64)
+
+
+# ---------------------------------------------------------------------------
+# Field-element sampling (rejection with static-shape compaction)
+# ---------------------------------------------------------------------------
+
+SAMPLE_SLACK = 8  # extra candidates; P[>=8 rejections] ~ (n choose 8) * 2^-256
+
+
+def sample_count_blocks(jf, length: int) -> int:
+    """Number of SHAKE output blocks needed to sample `length` elements."""
+    cand = length + SAMPLE_SLACK
+    lanes_needed = cand * jf.LIMBS
+    return (lanes_needed + RATE_LANES - 1) // RATE_LANES
+
+
+def sample_field_vec(jf, stream_lanes, length: int):
+    """Rejection-sample `length` field elements from squeezed lanes.
+
+    stream_lanes: [batch, out_blocks, 21] u64. Emulates the host
+    semantics exactly: consume LIMBS-lane little-endian chunks in order,
+    skipping values >= p; take the first `length` accepted.
+    Returns a field value of shape [batch, length].
+    """
+    batch = stream_lanes.shape[0]
+    flat = stream_lanes.reshape(batch, -1)
+    cand = min(length + SAMPLE_SLACK, flat.shape[1] // jf.LIMBS)
+    limbs = tuple(flat[:, i : cand * jf.LIMBS : jf.LIMBS] for i in range(jf.LIMBS))
+    # accept mask: value < p
+    if jf.LIMBS == 1:
+        p0 = np.uint64(jf.MODULUS)
+        accept = limbs[0] < p0
+    else:
+        lo, hi = limbs
+        p_lo = np.uint64(jf.MODULUS & 0xFFFFFFFFFFFFFFFF)
+        p_hi = np.uint64(jf.MODULUS >> 64)
+        accept = (hi < p_hi) | ((hi == p_hi) & (lo < p_lo))
+    # output slot each accepted candidate lands at (strictly increasing)
+    idx = jnp.cumsum(accept.astype(jnp.int32), axis=1) - 1
+    slot = jnp.where(accept, idx, cand)  # rejected -> out of bounds, dropped
+    # scatter candidate index i into out_idx[b, slot[b, i]]
+    bidx = jnp.broadcast_to(jnp.arange(batch, dtype=jnp.int32)[:, None], slot.shape)
+    cidx = jnp.broadcast_to(jnp.arange(cand, dtype=jnp.int32)[None, :], slot.shape)
+    out_idx = jnp.zeros((batch, length), dtype=jnp.int32)
+    out_idx = out_idx.at[bidx, slot].max(cidx, mode="drop")
+    gathered = tuple(jnp.take_along_axis(limb, out_idx, axis=1) for limb in limbs)
+    return gathered
+
+
+def expand_field_vec(jf, msg_parts, msg_len_bytes: int, batch: int, length: int):
+    """XOF-expand per-report messages straight to field vectors on device."""
+    lanes = pad_message_lanes(msg_parts, msg_len_bytes, batch)
+    out = shake128_squeeze_lanes(lanes, sample_count_blocks(jf, length))
+    return sample_field_vec(jf, out, length)
+
+
+@partial(jax.jit, static_argnums=(0, 2, 3))
+def _jit_expand(jf, lanes, out_blocks, length):
+    out = shake128_squeeze_lanes(lanes, out_blocks)
+    return sample_field_vec(jf, out, length)

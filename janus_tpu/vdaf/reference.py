@@ -48,6 +48,7 @@ from .xof import (
 )
 
 VERIFY_KEY_SIZE = SEED_SIZE
+AGG1 = (1).to_bytes(8, "little")  # helper aggregator id, lane-aligned
 EVAL_POINT_CANDIDATES = 4  # fixed draw per gadget; first t with t^m != 1 wins
 
 
@@ -625,7 +626,7 @@ class Prio3:
         blinds = seeds[2:] if self.uses_joint_rand else [None, None]
 
         inp = circ.encode(measurement)
-        helper_meas = self._expand(helper_seed, USAGE_MEASUREMENT_SHARE, b"\x01", circ.input_len)
+        helper_meas = self._expand(helper_seed, USAGE_MEASUREMENT_SHARE, AGG1, circ.input_len)
         leader_meas = [F.sub(x, h) for x, h in zip(inp, helper_meas)]
 
         joint_rand: list[int] = []
@@ -642,7 +643,7 @@ class Prio3:
             F, prove_seed, self._dst(USAGE_PROVE_RANDOMNESS), b"", circ.prove_rand_len
         )
         proof = flp_prove(circ, inp, prove_rand, joint_rand)
-        helper_proof = self._expand(helper_seed, USAGE_PROOF_SHARE, b"\x01", circ.proof_len)
+        helper_proof = self._expand(helper_seed, USAGE_PROOF_SHARE, AGG1, circ.proof_len)
         leader_proof = [F.sub(x, h) for x, h in zip(proof, helper_proof)]
 
         public_share = parts if self.uses_joint_rand else []
@@ -664,8 +665,8 @@ class Prio3:
         circ = self.circuit
         F = circ.FIELD
         if isinstance(input_share, HelperShare):
-            meas = self._expand(input_share.seed, USAGE_MEASUREMENT_SHARE, b"\x01", circ.input_len)
-            proof = self._expand(input_share.seed, USAGE_PROOF_SHARE, b"\x01", circ.proof_len)
+            meas = self._expand(input_share.seed, USAGE_MEASUREMENT_SHARE, AGG1, circ.input_len)
+            proof = self._expand(input_share.seed, USAGE_PROOF_SHARE, AGG1, circ.proof_len)
             blind = input_share.joint_rand_blind
             part_binder = input_share.seed
         else:
@@ -733,7 +734,7 @@ class Prio3:
 
     def _joint_rand_part(self, agg_id: int, blind: bytes, nonce: bytes, share_binder: bytes) -> bytes:
         return XofShake128.derive_seed(
-            blind, self._dst(USAGE_JOINT_RAND_PART), bytes([agg_id]) + nonce + share_binder
+            blind, self._dst(USAGE_JOINT_RAND_PART), agg_id.to_bytes(8, "little") + nonce + share_binder
         )
 
     def _joint_rand_seed(self, parts: list[bytes]) -> bytes:

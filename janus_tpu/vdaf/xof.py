@@ -1,10 +1,19 @@
 """XOF (extendable output function) for VDAF: SHAKE128-based.
 
-Mirrors the XofShake128 construction of VDAF-07 (the VDAF draft the
+Modeled on the XofShake128 construction of VDAF-07 (the VDAF draft the
 reference's `prio` 0.15 dependency implements; SURVEY.md section 2.2
-"XOF (SHAKE128-family) share/joint-randomness expansion"):
+"XOF (SHAKE128-family) share/joint-randomness expansion"), with one
+TPU-motivated framing change:
 
-    stream = SHAKE128( byte(len(dst)) || dst || seed || binder )
+    stream = SHAKE128( dst16 || seed || binder )
+
+where dst16 is the domain-separation tag zero-padded to 16 bytes, and
+all binder layouts used by Prio3 are multiples of 8 bytes (agg ids are
+carried as 8-byte little-endian words). Every field of every absorbed
+message is therefore u64-lane-aligned, which lets the batched device
+Keccak (janus_tpu.vdaf.keccak_jax) pack messages as [batch, 21] uint64
+lane arrays with no byte-straddling shifts. Host and device produce
+byte-identical streams.
 
 Field-element sampling reads ENCODED_SIZE-byte little-endian chunks and
 rejects values >= p (rejection probability ~2^-32 for both fields).
@@ -35,15 +44,18 @@ USAGE_JOINT_RAND_SEED = 7
 USAGE_JOINT_RAND_PART = 8
 
 ALGO_CLASS_VDAF = 0
+DST_SIZE = 16
 
 
 def dst(algo_id: int, usage: int, version: int = 7) -> bytes:
-    """Domain-separation tag: class || version || algo id || usage."""
-    return (
+    """Domain-separation tag: class || version || algo id || usage,
+    zero-padded to DST_SIZE so it occupies exactly two u64 lanes."""
+    raw = (
         bytes([ALGO_CLASS_VDAF, version])
         + algo_id.to_bytes(4, "big")
         + usage.to_bytes(2, "big")
     )
+    return raw.ljust(DST_SIZE, b"\x00")
 
 
 class XofShake128:
@@ -51,9 +63,9 @@ class XofShake128:
 
     def __init__(self, seed: bytes, dst_: bytes, binder: bytes = b""):
         assert len(seed) == SEED_SIZE
-        assert len(dst_) < 256
+        assert len(dst_) <= DST_SIZE
         self._shake = hashlib.shake_128()
-        self._shake.update(bytes([len(dst_)]) + dst_ + seed + binder)
+        self._shake.update(dst_.ljust(DST_SIZE, b"\x00") + seed + binder)
         self._buf = b""
         self._pos = 0
 

@@ -1,0 +1,97 @@
+"""Device XOF (batched Keccak) vs host hashlib: byte-identical streams."""
+
+import hashlib
+
+import numpy as np
+
+from janus_tpu.fields import Field64, Field128, JF64, JF128
+from janus_tpu.vdaf import keccak_jax as kj
+from janus_tpu.vdaf.xof import XofShake128, dst, USAGE_MEASUREMENT_SHARE
+
+
+def test_shake128_matches_hashlib():
+    batch = 3
+    msgs = [bytes([i]) * 48 for i in range(batch)]  # 48 bytes = 6 lanes
+    lanes = np.stack([kj.bytes_to_lanes(m) for m in msgs])
+    import jax.numpy as jnp
+
+    padded = kj.pad_message_lanes([(0, jnp.asarray(lanes))], 48, batch)
+    out = kj.shake128_squeeze_lanes(padded, 3)  # 3 blocks = 504 bytes
+    out = np.asarray(out)
+    for i, m in enumerate(msgs):
+        want = hashlib.shake_128(m).digest(3 * 168)
+        got = out[i].reshape(-1).astype("<u8").tobytes()
+        assert got == want, f"stream mismatch for message {i}"
+
+
+def test_multiblock_absorb_matches_hashlib():
+    # message longer than one rate block (2 blocks = 336 bytes)
+    batch = 2
+    msgs = [bytes(range(200)) + bytes([i]) * 136 for i in range(batch)]
+    lanes = np.stack([kj.bytes_to_lanes(m) for m in msgs])
+    import jax.numpy as jnp
+
+    padded = kj.pad_message_lanes([(0, jnp.asarray(lanes))], len(msgs[0]), batch)
+    out = np.asarray(kj.shake128_squeeze_lanes(padded, 2))
+    for i, m in enumerate(msgs):
+        want = hashlib.shake_128(m).digest(2 * 168)
+        got = out[i].reshape(-1).astype("<u8").tobytes()
+        assert got == want
+
+
+def test_exact_block_boundary_padding():
+    # message exactly one rate block long: padding must go to block 2
+    batch = 1
+    msg = bytes(range(168))
+    import jax.numpy as jnp
+
+    lanes = kj.bytes_to_lanes(msg)[None, :]
+    padded = kj.pad_message_lanes([(0, jnp.asarray(lanes))], 168, batch)
+    assert padded.shape[1] == 2
+    out = np.asarray(kj.shake128_squeeze_lanes(padded, 1))
+    want = hashlib.shake_128(msg).digest(168)
+    assert out[0].reshape(-1).astype("<u8").tobytes() == want
+
+
+def test_field_sampling_matches_host():
+    d = dst(0x42, USAGE_MEASUREMENT_SHARE)
+    for field, jf in [(Field64, JF64), (Field128, JF128)]:
+        batch = 4
+        length = 33
+        seeds = [bytes([i]) * 16 for i in range(batch)]
+        binder = (1).to_bytes(8, "little") + bytes(range(16))
+        # host
+        want = [
+            XofShake128(s, d, binder).next_vec(field, length) for s in seeds
+        ]
+        # device: message = dst16 || seed || binder
+        import jax.numpy as jnp
+
+        seed_lanes = jnp.asarray(
+            np.stack([kj.bytes_to_lanes(s) for s in seeds])
+        )
+        msg_len = 16 + 16 + len(binder)
+        parts = [(0, d), (2, seed_lanes), (4, binder)]
+        got = kj.expand_field_vec(jf, parts, msg_len, batch, length)
+        got_ints = jf.to_ints(got)
+        for b in range(batch):
+            assert [int(x) for x in got_ints[b]] == want[b], (field, b)
+
+
+def test_rejection_path_exercised():
+    # Craft a stream position where a candidate is rejected: brute-force a
+    # seed whose early chunk for Field64 is >= p (prob ~2^-32 per chunk is
+    # too rare; instead verify the compaction logic on synthetic lanes).
+    import jax.numpy as jnp
+
+    # synthetic stream: candidate 0 invalid (>= p), candidates 1.. valid
+    p = Field64.MODULUS
+    lanes = np.zeros((1, 2, 21), dtype=np.uint64)
+    lanes[0, 0, 0] = np.uint64(p)  # rejected
+    for i in range(1, 21):
+        lanes[0, 0, i] = np.uint64(i)
+    for i in range(21):
+        lanes[0, 1, i] = np.uint64(100 + i)
+    got = kj.sample_field_vec(JF64, jnp.asarray(lanes), 25)
+    vals = [int(x) for x in JF64.to_ints(got)[0]]
+    assert vals == [*range(1, 21), 100, 101, 102, 103, 104]
