@@ -1,0 +1,65 @@
+"""DAP-07 wire messages with TLS-syntax encoding.
+
+Python equivalent of the reference's `messages` crate
+(messages/src/lib.rs:58-2850): every DAP struct with byte-exact
+TLS-syntax Encode/Decode, the TimeInterval/FixedSize query-type
+abstraction (messages/src/lib.rs:1929-2040), and the DAP problem-type
+registry (messages/src/problem_type.rs:5-47).
+
+The hot path never touches these Python codecs per report — report
+batches are decoded column-wise into arrays by the aggregator layer —
+but protocol conformance (byte-exact round-trips) is defined here and
+locked by tests/test_messages.py.
+"""
+
+from .codec import Decoder, Encoder, DecodeError
+from .core import (
+    AggregateShare,
+    AggregateShareAad,
+    AggregateShareReq,
+    AggregationJobContinueReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    AggregationJobStep,
+    BatchId,
+    BatchSelector,
+    Collection,
+    CollectionJobId,
+    CollectionReq,
+    Duration,
+    Extension,
+    ExtensionType,
+    FixedSize,
+    FixedSizeQuery,
+    HpkeAeadId,
+    HpkeCiphertext,
+    HpkeConfig,
+    HpkeConfigId,
+    HpkeConfigList,
+    HpkeKdfId,
+    HpkeKemId,
+    InputShareAad,
+    Interval,
+    PartialBatchSelector,
+    PlaintextInputShare,
+    PrepareContinue,
+    PrepareError,
+    PrepareInit,
+    PrepareResp,
+    PrepareStepResult,
+    Query,
+    Report,
+    ReportId,
+    ReportIdChecksum,
+    ReportMetadata,
+    ReportShare,
+    Role,
+    TaskId,
+    Time,
+    TimeInterval,
+    QUERY_TYPES,
+)
+from .problem_type import DapProblemType
+
+__all__ = [n for n in dir() if not n.startswith("_")]

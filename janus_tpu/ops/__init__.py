@@ -1,0 +1,5 @@
+"""Device-side numerical kernels (NTT, polynomial ops) for the VDAF engine."""
+
+from .ntt import intt_batched, ntt_batched, poly_eval_powers, powers
+
+__all__ = ["ntt_batched", "intt_batched", "poly_eval_powers", "powers"]

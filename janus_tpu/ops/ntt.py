@@ -1,0 +1,151 @@
+"""Batched radix-2 NTT over limb-tuple field values.
+
+The FLP proof system evaluates/interpolates wire and gadget polynomials
+on power-of-two root-of-unity domains (reference: the external `prio`
+crate's FFT, consumed per-report from
+aggregator/src/aggregator/aggregation_job_driver.rs:363; SURVEY.md
+section 2.2). Here a transform processes an arbitrary leading batch
+shape at once: values are tuples of u64 limb arrays shaped
+``[..., n]`` and every butterfly is an elementwise field op over the
+whole batch, so XLA tiles it onto the VPU lanes with no per-report
+loop.
+
+Structure per stage (classic iterative Cooley-Tukey, decimation in
+time, after a static bit-reversal gather):
+
+    a.reshape(..., n // L, L) ->  u = a[..., :L/2],  v = a[..., L/2:]
+    a' = concat(u + w*v, u - w*v)
+
+with the twiddle vector ``w`` a host-precomputed constant, broadcast
+across the batch. log2(n) stages total; all shapes static, everything
+fuses under jit.
+
+Twiddle/permutation plans are cached per (field, n, direction). The
+domain order matches the host oracle in janus_tpu.vdaf.reference (ntt /
+intt on Python ints), which the differential tests compare against.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.jfield import fconst, fmap
+
+
+def _bitrev_perm(n: int) -> np.ndarray:
+    bits = (n - 1).bit_length()
+    perm = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        r = 0
+        for b in range(bits):
+            r |= ((i >> b) & 1) << (bits - 1 - b)
+        perm[i] = r
+    return perm
+
+
+def _int_to_limbs(value: int, limbs: int) -> tuple:
+    return tuple(
+        np.uint64((value >> (64 * i)) & 0xFFFFFFFFFFFFFFFF) for i in range(limbs)
+    )
+
+
+@lru_cache(maxsize=None)
+def _plan(jf, n: int, inverse: bool):
+    """Host-precomputed (perm, per-stage twiddles, n_inv) for one size."""
+    F = jf.HOST
+    root = F.root_of_unity(n)
+    if inverse:
+        root = F.inv(root)
+    perm = _bitrev_perm(n)
+    stages = []
+    length = 2
+    while length <= n:
+        w_len = F.pow(root, n // length)
+        tw = np.empty((jf.LIMBS, length // 2), dtype=np.uint64)
+        w = 1
+        for k in range(length // 2):
+            for li, limb in enumerate(_int_to_limbs(w, jf.LIMBS)):
+                tw[li, k] = limb
+            w = F.mul(w, w_len)
+        stages.append(tuple(tw[li] for li in range(jf.LIMBS)))
+        length <<= 1
+    n_inv = F.inv(n) if inverse else None
+    return perm, stages, n_inv
+
+
+def _transform(jf, v, n: int, inverse: bool):
+    perm, stages, n_inv = _plan(jf, n, inverse)
+    batch_shape = v[0].shape[:-1]
+    a = fmap(lambda x: jnp.take(x, jnp.asarray(perm), axis=-1), v)
+    length = 2
+    for tw in stages:
+        half = length // 2
+        a = fmap(lambda x: x.reshape(batch_shape + (n // length, length)), a)
+        u = fmap(lambda x: x[..., :half], a)
+        w = tuple(jnp.asarray(t) for t in tw)  # [half], broadcasts over batch
+        wv = jf.mul(fmap(lambda x: x[..., half:], a), w)
+        a = fmap(
+            lambda p, q: jnp.concatenate([p, q], axis=-1), jf.add(u, wv), jf.sub(u, wv)
+        )
+        a = fmap(lambda x: x.reshape(batch_shape + (n,)), a)
+        length <<= 1
+    if inverse:
+        a = jf.mul(a, fconst(jf, n_inv))
+    return a
+
+
+def ntt_batched(jf, coeffs, n: int):
+    """Evaluate polynomials at the n-th roots w^0..w^{n-1}.
+
+    coeffs: field value [..., k] with k <= n; zero-padded to n.
+    """
+    k = coeffs[0].shape[-1]
+    assert k <= n and n & (n - 1) == 0
+    if k < n:
+        pad = [(0, 0)] * (coeffs[0].ndim - 1) + [(0, n - k)]
+        coeffs = fmap(lambda x: jnp.pad(x, pad), coeffs)
+    return _transform(jf, coeffs, n, inverse=False)
+
+
+def intt_batched(jf, evals):
+    """Inverse: values at w^0..w^{n-1} -> coefficients. Last axis = n."""
+    n = evals[0].shape[-1]
+    assert n & (n - 1) == 0
+    return _transform(jf, evals, n, inverse=True)
+
+
+def powers(jf, x, n: int):
+    """[x^0, x^1, ..., x^{n-1}] along a new trailing axis, log-depth.
+
+    x: field value of shape [...]; returns [..., n].
+    """
+    assert n >= 1
+    one = fconst(jf, 1, x[0].shape)
+    acc = fmap(lambda a, b: jnp.stack([a, b], axis=-1), one, x)  # [..., 2]
+    cur = 2
+    while cur < n:
+        # acc holds x^0..x^{cur-1}; extend with acc * x^cur
+        last = fmap(lambda a: a[..., -1], acc)
+        xc = jf.mul(last, x)  # x^cur
+        ext = jf.mul(acc, fmap(lambda a: a[..., None], xc))
+        acc = fmap(lambda a, b: jnp.concatenate([a, b], axis=-1), acc, ext)
+        cur *= 2
+    if cur != n:
+        acc = fmap(lambda a: a[..., :n], acc)
+    return acc
+
+
+def poly_eval_powers(jf, coeffs, pw):
+    """Evaluate polynomials given precomputed point powers.
+
+    coeffs: [..., k]; pw: [..., m] powers of the evaluation point with
+    m >= k. Returns [...]: sum_i coeffs[i] * x^i.
+    """
+    from ..fields.jfield import fsum
+
+    k = coeffs[0].shape[-1]
+    pwk = fmap(lambda a: a[..., :k], pw)
+    return fsum(jf, jf.mul(coeffs, pwk), axis=-1)

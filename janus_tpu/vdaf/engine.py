@@ -1,0 +1,338 @@
+"""Batched FLP prove/query/decide on device — the TPU heart.
+
+The reference runs the FLP per report, serially, on CPU inside the
+external `prio` crate (invoked from
+aggregator/src/aggregator/aggregation_job_driver.rs:329-402 and
+aggregator/src/aggregator.rs:1775-1797). Here one traced computation
+processes a whole report batch: every value is a limb-tuple field array
+with a leading [batch] axis, wire/gadget polynomial interpolation is
+the batched NTT of janus_tpu.ops.ntt, and gadget evaluation is
+elementwise — so XLA sees large fused elementwise graphs it can tile
+onto the VPU, with throughput scaling in the batch dimension.
+
+Semantics are byte/element-identical to the host oracle
+(janus_tpu.vdaf.reference), enforced by differential tests. All four
+Prio3 circuits (Count/Sum/SumVec/Histogram) have exactly one gadget
+use of degree 2; the adapters below encode each circuit's gadget-call
+schedule as static reshapes over the batch.
+
+Per-report validity never branches: invalid reports yield a False lane
+in the decision mask and are dropped at accumulation time (masked
+aggregate), which is the static-shape answer to the reference's
+per-report error handling (SURVEY.md section 7, "Ragged/failure-laden
+batches").
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.jfield import (
+    JF64,
+    JF128,
+    fconst,
+    fmap,
+    fpow_const,
+    fsum,
+    is_zero,
+)
+from ..ops.ntt import intt_batched, ntt_batched, poly_eval_powers, powers
+from .reference import (
+    EVAL_POINT_CANDIDATES,
+    Circuit,
+    Count,
+    Histogram,
+    Sum,
+    SumVec,
+    next_pow2,
+)
+
+
+def jf_for(circuit: Circuit):
+    return {8: JF64, 16: JF128}[circuit.FIELD.ENCODED_SIZE]
+
+
+# ---------------------------------------------------------------------------
+# Per-circuit batched adapters
+# ---------------------------------------------------------------------------
+
+
+class BatchedCircuit:
+    """Vectorized gadget schedule for one validity circuit.
+
+    All methods take/return limb-tuple field values with a leading
+    [batch] axis. `calls_inputs` returns [batch, calls, arity];
+    `gadget_eval` consumes wires with arity on axis 1 ([batch, arity,
+    ...]) and returns the gadget output with that axis dropped.
+    """
+
+    def __init__(self, circ: Circuit):
+        self.circ = circ
+        self.jf = jf_for(circ)
+        use = circ.gadget_uses[0]
+        assert len(circ.gadget_uses) == 1, "Prio3 circuits have one gadget use"
+        self.arity = use.gadget.arity
+        self.calls = use.calls
+        self.m = use.wire_poly_len
+        self.gp_len = use.gadget_poly_len
+        self.n2 = next_pow2(self.gp_len)
+
+    # --- measurement plumbing (host-side, numpy-vectorized) ---
+    def encode_batch(self, measurements) -> np.ndarray:
+        """[batch] measurements -> [batch, input_len] uint64 (< p)."""
+        raise NotImplementedError
+
+    # --- schedule ---
+    def calls_inputs(self, inp, joint_rand, shares_inv: int):
+        raise NotImplementedError
+
+    def gadget_eval(self, wires):
+        raise NotImplementedError
+
+    def finish(self, inp, joint_rand, gadget_outs, shares_inv: int):
+        raise NotImplementedError
+
+    def truncate(self, inp):
+        raise NotImplementedError
+
+    # --- helpers ---
+    def _sic(self, shares_inv: int, shape=()):
+        return fconst(self.jf, shares_inv, shape)
+
+
+class BCount(BatchedCircuit):
+    def encode_batch(self, measurements):
+        a = np.asarray(measurements, dtype=np.uint64)
+        assert ((a == 0) | (a == 1)).all()
+        return a[:, None]
+
+    def calls_inputs(self, inp, joint_rand, shares_inv):
+        # [[x, x]]: one call, arity 2
+        return fmap(lambda x: x[:, :, None].repeat(2, axis=2), inp)
+
+    def gadget_eval(self, wires):
+        jf = self.jf
+        w0 = fmap(lambda x: x[:, 0], wires)
+        w1 = fmap(lambda x: x[:, 1], wires)
+        return jf.mul(w0, w1)
+
+    def finish(self, inp, joint_rand, gadget_outs, shares_inv):
+        jf = self.jf
+        return jf.sub(fmap(lambda x: x[:, 0], gadget_outs), fmap(lambda x: x[:, 0], inp))
+
+    def truncate(self, inp):
+        return inp
+
+
+class BSum(BatchedCircuit):
+    def encode_batch(self, measurements):
+        a = np.asarray(measurements, dtype=np.uint64)
+        bits = self.circ.bits
+        if bits < 64:
+            assert (a < (np.uint64(1) << np.uint64(bits))).all()
+        return (a[:, None] >> np.arange(bits, dtype=np.uint64)[None, :]) & np.uint64(1)
+
+    def calls_inputs(self, inp, joint_rand, shares_inv):
+        return fmap(lambda x: x[:, :, None], inp)  # [batch, bits, 1]
+
+    def gadget_eval(self, wires):
+        jf = self.jf
+        x = fmap(lambda w: w[:, 0], wires)
+        return jf.sub(jf.mul(x, x), x)  # x^2 - x
+
+    def finish(self, inp, joint_rand, gadget_outs, shares_inv):
+        jf = self.jf
+        r = fmap(lambda x: x[:, 0], joint_rand)
+        pw = powers(jf, r, self.calls + 1)  # [batch, calls+1]
+        rp = fmap(lambda x: x[..., 1:], pw)  # r^1..r^calls
+        return fsum(jf, jf.mul(rp, gadget_outs), axis=-1)
+
+    def truncate(self, inp):
+        jf = self.jf
+        two_pows = _two_power_consts(jf, self.circ.bits)
+        return fmap(
+            lambda x: x[:, None],
+            fsum(jf, self.jf.mul(inp, two_pows), axis=-1),
+        )
+
+
+class _BChunked(BatchedCircuit):
+    """Shared ParallelSum(Mul, chunk) schedule of SumVec and Histogram."""
+
+    def _pair_inputs(self, inp, joint_rand, shares_inv):
+        """(r^{i+1} x_i, x_i - shares_inv) pairs -> [batch, calls, 2*chunk]."""
+        jf = self.jf
+        n = self.circ.input_len
+        ch = self.circ.chunk_length
+        r = fmap(lambda x: x[:, 0], joint_rand)
+        pw = powers(jf, r, n + 1)
+        rp = fmap(lambda x: x[..., 1:], pw)  # [batch, n]: r^1..r^n
+        a = jf.mul(rp, inp)
+        b = jf.sub(inp, self._sic(shares_inv))
+        # interleave (a_i, b_i) then pad to calls*chunk pairs
+        pairs = fmap(
+            lambda x, y: jnp.stack([x, y], axis=-1).reshape(x.shape[0], -1), a, b
+        )
+        total = self.calls * ch * 2
+        pad = total - pairs[0].shape[-1]
+        if pad:
+            pairs = fmap(lambda x: jnp.pad(x, ((0, 0), (0, pad))), pairs)
+        return fmap(lambda x: x.reshape(x.shape[0], self.calls, 2 * ch), pairs)
+
+    def calls_inputs(self, inp, joint_rand, shares_inv):
+        return self._pair_inputs(inp, joint_rand, shares_inv)
+
+    def gadget_eval(self, wires):
+        # wires [batch, 2*chunk, ...] -> sum_c w[2c]*w[2c+1]
+        jf = self.jf
+        ch = self.circ.chunk_length
+        shaped = fmap(
+            lambda w: w.reshape((w.shape[0], ch, 2) + w.shape[2:]), wires
+        )
+        x = fmap(lambda w: w[:, :, 0], shaped)
+        y = fmap(lambda w: w[:, :, 1], shaped)
+        return fsum(jf, jf.mul(x, y), axis=1)
+
+
+class BSumVec(_BChunked):
+    def encode_batch(self, measurements):
+        a = np.asarray(measurements, dtype=np.uint64)  # [batch, length]
+        bits = self.circ.bits
+        out = (a[:, :, None] >> np.arange(bits, dtype=np.uint64)[None, None, :]) & np.uint64(1)
+        return out.reshape(a.shape[0], -1)
+
+    def finish(self, inp, joint_rand, gadget_outs, shares_inv):
+        return fsum(self.jf, gadget_outs, axis=-1)
+
+    def truncate(self, inp):
+        jf = self.jf
+        bits = self.circ.bits
+        length = self.circ.length
+        v = fmap(lambda x: x.reshape(x.shape[0], length, bits), inp)
+        return fsum(jf, jf.mul(v, _two_power_consts(jf, bits)), axis=-1)
+
+
+class BHistogram(_BChunked):
+    def encode_batch(self, measurements):
+        a = np.asarray(measurements, dtype=np.int64)
+        assert ((0 <= a) & (a < self.circ.length)).all()
+        out = np.zeros((a.shape[0], self.circ.length), dtype=np.uint64)
+        out[np.arange(a.shape[0]), a] = 1
+        return out
+
+    def finish(self, inp, joint_rand, gadget_outs, shares_inv):
+        jf = self.jf
+        bit_check = fsum(jf, gadget_outs, axis=-1)
+        sum_check = jf.sub(fsum(jf, inp, axis=-1), self._sic(shares_inv))
+        jr1 = fmap(lambda x: x[:, 1], joint_rand)
+        return jf.add(bit_check, jf.mul(jr1, sum_check))
+
+    def truncate(self, inp):
+        return inp
+
+
+_ADAPTERS = {Count: BCount, Sum: BSum, SumVec: BSumVec, Histogram: BHistogram}
+
+
+def _two_power_consts(jf, bits: int):
+    """[2^0, ..., 2^{bits-1}] mod p as a device field constant."""
+    tp = np.array([pow(2, j, jf.MODULUS) for j in range(bits)], dtype=object)
+    return tuple(
+        jnp.asarray(((tp >> (64 * i)) & ((1 << 64) - 1)).astype(np.uint64))
+        for i in range(jf.LIMBS)
+    )
+
+
+def batched_circuit(circ: Circuit) -> BatchedCircuit:
+    return _ADAPTERS[type(circ)](circ)
+
+
+# ---------------------------------------------------------------------------
+# FLP prove / query / decide (batched)
+# ---------------------------------------------------------------------------
+
+
+def _wire_polys(bc: BatchedCircuit, seeds, ci):
+    """Interpolate wire polynomials: [batch, arity, m] coefficients.
+
+    seeds: [batch, arity] (prove rand or proof-share head); ci: calls
+    inputs [batch, calls, arity]. Wire j's values on the NTT domain are
+    [seed_j, ci[0][j], ..., ci[calls-1][j], 0...].
+    """
+    jf = bc.jf
+    ci_t = fmap(lambda x: jnp.swapaxes(x, 1, 2), ci)  # [batch, arity, calls]
+    evals = fmap(
+        lambda s, c: jnp.concatenate([s[:, :, None], c], axis=-1), seeds, ci_t
+    )
+    if 1 + bc.calls < bc.m:
+        pad = bc.m - (1 + bc.calls)
+        evals = fmap(lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, pad))), evals)
+    return intt_batched(jf, evals)
+
+
+def flp_prove_batched(bc: BatchedCircuit, inp, prove_rand, joint_rand):
+    """proof [batch, proof_len] matching reference.flp_prove element-wise."""
+    jf = bc.jf
+    ci = bc.calls_inputs(inp, joint_rand, 1)
+    wp = _wire_polys(bc, prove_rand, ci)
+    wire_evals = ntt_batched(jf, wp, bc.n2)  # [batch, arity, n2]
+    gadget_evals = bc.gadget_eval(wire_evals)  # [batch, n2]
+    gpoly = intt_batched(jf, gadget_evals)
+    gpoly = fmap(lambda x: x[..., : bc.gp_len], gpoly)
+    return fmap(lambda s, g: jnp.concatenate([s, g], axis=-1), prove_rand, gpoly)
+
+
+def _pick_eval_point(jf, cands, m: int):
+    """First candidate t (of EVAL_POINT_CANDIDATES) with t^m != 1."""
+    tm = fpow_const(jf, cands, m)  # [batch, 4]
+    ok = ~is_zero(jf.sub(tm, fconst(jf, 1, tm[0].shape)))
+    idx = jnp.argmax(ok, axis=-1)  # first True (0 if none; prob ~2^-128)
+    return fmap(lambda x: jnp.take_along_axis(x, idx[:, None], axis=-1)[:, 0], cands)
+
+
+def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, joint_rand, num_shares: int):
+    """verifier share [batch, verifier_len] matching reference.flp_query."""
+    jf = bc.jf
+    F = bc.circ.FIELD
+    shares_inv = F.inv(num_shares)
+    ci = bc.calls_inputs(inp_share, joint_rand, shares_inv)
+    seeds = fmap(lambda x: x[..., : bc.arity], proof_share)
+    gcoeffs = fmap(lambda x: x[..., bc.arity : bc.arity + bc.gp_len], proof_share)
+
+    assert query_rand[0].shape[-1] == EVAL_POINT_CANDIDATES
+    t = _pick_eval_point(jf, query_rand, bc.m)
+
+    # gadget outputs at call points alpha^{k+1}: fold mod x^m - 1, NTT_m
+    folds = -(-bc.gp_len // bc.m)
+    padded = fmap(lambda x: jnp.pad(x, ((0, 0), (0, folds * bc.m - bc.gp_len))), gcoeffs)
+    gfold = fsum(jf, fmap(lambda x: x.reshape(x.shape[0], folds, bc.m), padded), axis=1)
+    gevals = ntt_batched(jf, gfold, bc.m)  # values at alpha^0..alpha^{m-1}
+    outs = fmap(lambda x: x[..., 1 : bc.calls + 1], gevals)
+
+    # wire polys from proof-share seeds; evaluate everything at t
+    wp = _wire_polys(bc, seeds, ci)  # [batch, arity, m]
+    pw = powers(jf, t, max(bc.m, bc.gp_len))  # [batch, >=m]
+    pw_b = fmap(lambda x: x[:, None, :], pw)
+    wire_t = poly_eval_powers(jf, wp, pw_b)  # [batch, arity]
+    proof_t = poly_eval_powers(jf, gcoeffs, pw)  # [batch]
+
+    v = bc.finish(inp_share, joint_rand, outs, shares_inv)  # [batch]
+    return fmap(
+        lambda a, b, c: jnp.concatenate([a[:, None], b, c[:, None]], axis=-1),
+        v,
+        wire_t,
+        proof_t,
+    )
+
+
+def flp_decide_batched(bc: BatchedCircuit, verifier):
+    """Boolean accept mask [batch] over combined verifier messages."""
+    jf = bc.jf
+    v0 = fmap(lambda x: x[:, 0], verifier)
+    wires = fmap(lambda x: x[:, 1 : 1 + bc.arity], verifier)
+    y = fmap(lambda x: x[:, 1 + bc.arity], verifier)
+    circuit_ok = is_zero(v0)
+    g = bc.gadget_eval(wires)
+    gadget_ok = is_zero(jf.sub(g, y))
+    return circuit_ok & gadget_ok

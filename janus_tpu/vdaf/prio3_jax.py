@@ -1,0 +1,299 @@
+"""Batched device Prio3: shard / prepare / aggregate over report batches.
+
+The reference's hot path runs one report at a time through the `prio`
+crate's Prio3 (leader: aggregation_job_driver.rs:363,580; helper:
+aggregator.rs:1777-1797). Here every step is a single traced JAX
+computation over [batch]-leading arrays:
+
+  - seeds/nonces/XOF-derived values are [batch, 2] uint64 lane arrays
+    (16-byte strings in little-endian u64 lanes),
+  - field vectors are limb-tuple values (janus_tpu.fields.jfield),
+  - XOF expansion runs on device via the batched Keccak
+    (janus_tpu.vdaf.keccak_jax) with the same lane-aligned stream
+    framing as the host XofShake128 — host and device are
+    byte-identical, so a host-sharded report verifies on device and
+    vice versa (differential-tested).
+
+Validity is a boolean lane mask throughout; invalid reports never
+break the batch (SURVEY.md section 7 "Ragged/failure-laden batches").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..fields.jfield import fmap
+from .engine import (
+    BatchedCircuit,
+    batched_circuit,
+    flp_decide_batched,
+    flp_prove_batched,
+    flp_query_batched,
+)
+from .keccak_jax import (
+    pad_message_lanes,
+    sample_count_blocks,
+    sample_field_vec,
+    shake128_squeeze_lanes,
+)
+from .reference import AGG1, Circuit
+from .xof import (
+    DST_SIZE,
+    SEED_SIZE,
+    USAGE_JOINT_RAND_PART,
+    USAGE_JOINT_RAND_SEED,
+    USAGE_JOINT_RANDOMNESS,
+    USAGE_MEASUREMENT_SHARE,
+    USAGE_PROOF_SHARE,
+    USAGE_PROVE_RANDOMNESS,
+    USAGE_QUERY_RANDOMNESS,
+    dst,
+)
+
+AGG0 = (0).to_bytes(8, "little")
+SEED_LANES = SEED_SIZE // 8  # 2
+DST_LANES = DST_SIZE // 8  # 2
+
+
+def bytes_to_lane_batch(rows: list[bytes]) -> np.ndarray:
+    """[batch] of 8k-byte strings -> [batch, k] u64 lanes."""
+    return np.stack([np.frombuffer(r, dtype="<u8") for r in rows]).astype(np.uint64)
+
+
+def lanes_to_bytes(lanes) -> list[bytes]:
+    a = np.asarray(lanes, dtype="<u8")
+    return [row.tobytes() for row in a]
+
+
+def field_value_to_enc_lanes(jf, v):
+    """Field vector [batch, n] -> little-endian encoded lanes [batch, n*LIMBS].
+
+    Matches Field.encode_vec byte-for-byte: each element is ENCODED_SIZE
+    little-endian bytes, i.e. its limbs lo..hi in lane order.
+    """
+    if jf.LIMBS == 1:
+        return v[0]
+    return jnp.stack(v, axis=-1).reshape(v[0].shape[0], -1)
+
+
+class Prio3Batched:
+    """Batched device Prio3 for one validity circuit.
+
+    Instances are hashable-by-identity and meant to be constructed once
+    per task (cache them; every method is pure and jit-safe).
+    """
+
+    NUM_SHARES = 2
+
+    def __init__(self, circuit: Circuit):
+        self.circ = circuit
+        self.bc: BatchedCircuit = batched_circuit(circuit)
+        self.jf = self.bc.jf
+
+    # --- XOF plumbing (device) ---
+    def _dst(self, usage: int) -> bytes:
+        return dst(self.circ.algo_id, usage)
+
+    def _expand_vec(self, usage: int, seed_lanes, binder_parts, binder_len: int, length: int):
+        """Field vector [batch, length] from per-report seeds + binder."""
+        batch = seed_lanes.shape[0]
+        parts = [(0, self._dst(usage)), (DST_LANES, seed_lanes)]
+        off = DST_LANES + SEED_LANES
+        for rel_off, content in binder_parts:
+            parts.append((off + rel_off, content))
+        msg_len = DST_SIZE + SEED_SIZE + binder_len
+        lanes = pad_message_lanes(parts, msg_len, batch)
+        out = shake128_squeeze_lanes(lanes, sample_count_blocks(self.jf, length))
+        return sample_field_vec(self.jf, out, length)
+
+    def _derive_seed(self, usage: int, seed_lanes, binder_parts, binder_len: int):
+        """[batch, 2] output seed lanes."""
+        batch = seed_lanes.shape[0] if hasattr(seed_lanes, "shape") else binder_parts[0][1].shape[0]
+        parts = [(0, self._dst(usage))]
+        if isinstance(seed_lanes, (bytes, bytearray)):
+            parts.append((DST_LANES, bytes(seed_lanes)))
+        else:
+            parts.append((DST_LANES, seed_lanes))
+        off = DST_LANES + SEED_LANES
+        for rel_off, content in binder_parts:
+            parts.append((off + rel_off, content))
+        msg_len = DST_SIZE + SEED_SIZE + binder_len
+        lanes = pad_message_lanes(parts, msg_len, batch)
+        out = shake128_squeeze_lanes(lanes, 1)
+        return out[:, 0, :SEED_LANES]
+
+    def _expand_share(self, seed_lanes, usage: int, length: int):
+        """Expand helper measurement/proof share: binder = AGG1."""
+        return self._expand_vec(usage, seed_lanes, [(0, AGG1)], 8, length)
+
+    def _joint_rand_part(self, agg_id: int, blind_lanes, nonce_lanes, share_binder_lanes):
+        """derive_seed(blind, ..., agg_id8 + nonce + share_binder)."""
+        agg = AGG0 if agg_id == 0 else AGG1
+        n_binder_lanes = share_binder_lanes.shape[-1]
+        return self._derive_seed(
+            USAGE_JOINT_RAND_PART,
+            blind_lanes,
+            [(0, agg), (1, nonce_lanes), (1 + SEED_LANES, share_binder_lanes)],
+            8 + SEED_SIZE + 8 * n_binder_lanes,
+        )
+
+    def _joint_rand_seed(self, part0_lanes, part1_lanes):
+        return self._derive_seed(
+            USAGE_JOINT_RAND_SEED,
+            b"\x00" * SEED_SIZE,
+            [(0, part0_lanes), (SEED_LANES, part1_lanes)],
+            2 * SEED_SIZE,
+        )
+
+    def _joint_rand(self, jr_seed_lanes):
+        return self._expand_vec(
+            USAGE_JOINT_RANDOMNESS, jr_seed_lanes, [], 0, self.circ.joint_rand_len
+        )
+
+    def _query_rand(self, verify_key: bytes, nonce_lanes):
+        batch = nonce_lanes.shape[0]
+        assert len(verify_key) == SEED_SIZE
+        parts = [
+            (0, self._dst(USAGE_QUERY_RANDOMNESS)),
+            (DST_LANES, verify_key),
+            (DST_LANES + SEED_LANES, nonce_lanes),
+        ]
+        msg_len = DST_SIZE + SEED_SIZE + SEED_SIZE
+        lanes = pad_message_lanes(parts, msg_len, batch)
+        out = shake128_squeeze_lanes(
+            lanes, sample_count_blocks(self.jf, self.circ.query_rand_len)
+        )
+        return sample_field_vec(self.jf, out, self.circ.query_rand_len)
+
+    @property
+    def uses_joint_rand(self) -> bool:
+        return self.circ.joint_rand_len > 0
+
+    # ------------------------------------------------------------------
+    # shard (client / load-generator side, batched on device)
+    # ------------------------------------------------------------------
+    def shard(self, inp, nonce_lanes, rand_lanes):
+        """Shard a batch of encoded measurements.
+
+        inp: field value [batch, input_len] (from bc.encode_batch);
+        nonce_lanes: [batch, 2]; rand_lanes: [batch, n_seeds, 2] with
+        n_seeds = 2 (+2 with joint rand): prove, helper(, blind0, blind1).
+
+        Returns dict with public_parts [batch, 2, 2] (or None),
+        leader_meas, leader_proof (field values), and passthrough
+        helper_seed/blind lanes.
+        """
+        circ = self.circ
+        jf = self.jf
+        prove_seed = rand_lanes[:, 0]
+        helper_seed = rand_lanes[:, 1]
+        helper_meas = self._expand_share(helper_seed, USAGE_MEASUREMENT_SHARE, circ.input_len)
+        leader_meas = jf.sub(inp, helper_meas)
+
+        public_parts = None
+        joint_rand = ()
+        blind0 = blind1 = None
+        if self.uses_joint_rand:
+            blind0 = rand_lanes[:, 2]
+            blind1 = rand_lanes[:, 3]
+            enc = field_value_to_enc_lanes(jf, leader_meas)
+            part0 = self._joint_rand_part(0, blind0, nonce_lanes, enc)
+            part1 = self._joint_rand_part(1, blind1, nonce_lanes, helper_seed)
+            jr_seed = self._joint_rand_seed(part0, part1)
+            joint_rand = self._joint_rand(jr_seed)
+            public_parts = jnp.stack([part0, part1], axis=1)
+
+        prove_rand = self._expand_vec(
+            USAGE_PROVE_RANDOMNESS, prove_seed, [], 0, circ.prove_rand_len
+        )
+        proof = flp_prove_batched(self.bc, inp, prove_rand, joint_rand)
+        helper_proof = self._expand_share(helper_seed, USAGE_PROOF_SHARE, circ.proof_len)
+        leader_proof = jf.sub(proof, helper_proof)
+        return {
+            "public_parts": public_parts,
+            "leader_meas": leader_meas,
+            "leader_proof": leader_proof,
+            "helper_seed": helper_seed,
+            "blind0": blind0,
+            "blind1": blind1,
+        }
+
+    # ------------------------------------------------------------------
+    # prepare (aggregator side)
+    # ------------------------------------------------------------------
+    def prepare_init_leader(self, verify_key: bytes, nonce_lanes, public_parts, meas, proof, blind0):
+        """Leader prepare-init over a batch.
+
+        Returns (out_share, corrected_seed_lanes|None, verifier, own_part|None).
+        """
+        return self._prepare_init(
+            verify_key, 0, nonce_lanes, public_parts, meas, proof, blind0, None
+        )
+
+    def prepare_init_helper(self, verify_key: bytes, nonce_lanes, public_parts, helper_seed, blind1):
+        circ = self.circ
+        meas = self._expand_share(helper_seed, USAGE_MEASUREMENT_SHARE, circ.input_len)
+        proof = self._expand_share(helper_seed, USAGE_PROOF_SHARE, circ.proof_len)
+        return self._prepare_init(
+            verify_key, 1, nonce_lanes, public_parts, meas, proof, blind1, helper_seed
+        )
+
+    def _prepare_init(self, verify_key, agg_id, nonce_lanes, public_parts, meas, proof, blind, helper_seed):
+        circ = self.circ
+        jf = self.jf
+        corrected_seed = None
+        own_part = None
+        joint_rand = ()
+        if self.uses_joint_rand:
+            if agg_id == 0:
+                binder = field_value_to_enc_lanes(jf, meas)
+            else:
+                binder = helper_seed
+            own_part = self._joint_rand_part(agg_id, blind, nonce_lanes, binder)
+            other = public_parts[:, 1 - agg_id]
+            parts = (own_part, other) if agg_id == 0 else (other, own_part)
+            corrected_seed = self._joint_rand_seed(*parts)
+            joint_rand = self._joint_rand(corrected_seed)
+        query_rand = self._query_rand(verify_key, nonce_lanes)
+        verifier = flp_query_batched(
+            self.bc, meas, proof, query_rand, joint_rand, self.NUM_SHARES
+        )
+        out_share = self.bc.truncate(meas)
+        return out_share, corrected_seed, verifier, own_part
+
+    def prep_shares_to_prep(self, verifier0, verifier1, part0=None, part1=None):
+        """Combine both verifier shares: (accept_mask [batch], prep_msg_lanes|None)."""
+        jf = self.jf
+        verifier = jf.add(verifier0, verifier1)
+        mask = flp_decide_batched(self.bc, verifier)
+        prep_msg = None
+        if self.uses_joint_rand:
+            prep_msg = self._joint_rand_seed(part0, part1)
+        return mask, prep_msg
+
+    def prepare_finish(self, corrected_seed, prep_msg, mask):
+        """Final joint-randomness equality check, folded into the mask."""
+        if self.uses_joint_rand:
+            eq = jnp.all(prep_msg == corrected_seed, axis=-1)
+            mask = mask & eq
+        return mask
+
+    # ------------------------------------------------------------------
+    # aggregate / unshard
+    # ------------------------------------------------------------------
+    def aggregate(self, out_shares, mask):
+        """Masked sum over the batch axis -> aggregate share [output_len].
+
+        Invalid lanes contribute zero (the static-shape equivalent of the
+        reference skipping failed reports at accumulate time,
+        aggregator/src/aggregator/accumulator.rs:76-122).
+        """
+        from ..fields.jfield import fsum
+
+        jf = self.jf
+        masked = fmap(lambda x: jnp.where(mask[:, None], x, jnp.zeros_like(x)), out_shares)
+        return fsum(jf, masked, axis=0)
+
+    def merge_agg_shares(self, a, b):
+        return self.jf.add(a, b)

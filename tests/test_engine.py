@@ -1,0 +1,171 @@
+"""Differential tests: batched device FLP engine vs the host oracle.
+
+Mirrors the reference's golden-transcript strategy (SURVEY.md section 4:
+`run_vdaf` in core/src/test_util/mod.rs) — every batched output is
+compared element-wise against the scalar host implementation.
+"""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from janus_tpu.fields.field import Field64, Field128
+from janus_tpu.ops.ntt import intt_batched, ntt_batched, powers, poly_eval_powers
+from janus_tpu.vdaf import reference as ref
+from janus_tpu.vdaf.engine import (
+    batched_circuit,
+    flp_decide_batched,
+    flp_prove_batched,
+    flp_query_batched,
+)
+from janus_tpu.fields.jfield import JF64, JF128
+
+RNG = np.random.default_rng(0x1A05)
+
+
+def rand_elems(field, shape):
+    flat = [int(RNG.integers(0, field.MODULUS % (1 << 63))) for _ in range(int(np.prod(shape)))]
+    # cover high range too
+    for i in range(0, len(flat), 3):
+        flat[i] = (flat[i] * 3 + field.MODULUS - 7) % field.MODULUS
+    return np.array(flat, dtype=object).reshape(shape)
+
+
+def to_dev(jf, arr):
+    return jf.from_ints(arr)
+
+
+@pytest.mark.parametrize("jf,field", [(JF64, Field64), (JF128, Field128)])
+@pytest.mark.parametrize("n", [2, 8, 64, 256])
+def test_ntt_matches_host(jf, field, n):
+    batch = 3
+    coeffs = rand_elems(field, (batch, n))
+    got = jf.to_ints(ntt_batched(jf, to_dev(jf, coeffs), n))
+    for b in range(batch):
+        want = ref.ntt(field, list(coeffs[b]), n)
+        assert list(got[b]) == want
+    # round trip
+    back = jf.to_ints(intt_batched(jf, ntt_batched(jf, to_dev(jf, coeffs), n)))
+    assert (back == coeffs).all()
+
+
+@pytest.mark.parametrize("jf,field", [(JF64, Field64), (JF128, Field128)])
+def test_powers_and_eval(jf, field):
+    batch, n = 4, 13
+    x = rand_elems(field, (batch,))
+    pw = jf.to_ints(powers(jf, to_dev(jf, x), n))
+    for b in range(batch):
+        assert list(pw[b]) == [field.pow(int(x[b]), k) for k in range(n)]
+    coeffs = rand_elems(field, (batch, n))
+    ev = jf.to_ints(poly_eval_powers(jf, to_dev(jf, coeffs), powers(jf, to_dev(jf, x), n)))
+    for b in range(batch):
+        assert ev[b] == ref.poly_eval(field, list(coeffs[b]), int(x[b]))
+
+
+CIRCUITS = [
+    ref.Count(),
+    ref.Sum(bits=8),
+    ref.SumVec(length=5, bits=4),
+    ref.Histogram(length=10),
+]
+
+
+@pytest.mark.parametrize("circ", CIRCUITS, ids=lambda c: type(c).__name__)
+def test_flp_prove_query_decide_differential(circ):
+    batch = 6
+    bc = batched_circuit(circ)
+    jf = bc.jf
+    F = circ.FIELD
+
+    # random valid-ish inputs: mix valid encodings and garbage
+    inps, proofs, prove_rands, joint_rands, query_rands = [], [], [], [], []
+    for b in range(batch):
+        if b % 2 == 0:
+            meas = {
+                ref.Count: lambda: b % 2,
+                ref.Sum: lambda: b * 37 % 256,
+                ref.SumVec: lambda: [(b + i) % 16 for i in range(5)],
+                ref.Histogram: lambda: b % 10,
+            }[type(circ)]()
+            inp = circ.encode(meas)
+        else:
+            inp = [int(x) for x in rand_elems(F, (circ.input_len,))]
+        pr = [int(x) for x in rand_elems(F, (circ.prove_rand_len,))]
+        jr = [int(x) for x in rand_elems(F, (circ.joint_rand_len,))]
+        qr = [int(x) for x in rand_elems(F, (circ.query_rand_len,))]
+        inps.append(inp)
+        prove_rands.append(pr)
+        joint_rands.append(jr)
+        query_rands.append(qr)
+        proofs.append(ref.flp_prove(circ, inp, pr, jr))
+
+    d_inp = to_dev(jf, np.array(inps, dtype=object))
+    d_pr = to_dev(jf, np.array(prove_rands, dtype=object))
+    d_jr = to_dev(jf, np.array(joint_rands, dtype=object).reshape(batch, circ.joint_rand_len))
+    d_qr = to_dev(jf, np.array(query_rands, dtype=object))
+
+    got_proofs = jf.to_ints(flp_prove_batched(bc, d_inp, d_pr, d_jr))
+    for b in range(batch):
+        assert list(got_proofs[b]) == proofs[b], f"proof mismatch report {b}"
+
+    # query each share of a 2-party additive split, batched, vs host
+    inp_split0 = [[int(x) for x in rand_elems(F, (circ.input_len,))] for _ in range(batch)]
+    inp_split1 = [
+        [F.sub(x, s) for x, s in zip(inps[b], inp_split0[b])] for b in range(batch)
+    ]
+    pf_split0 = [[int(x) for x in rand_elems(F, (circ.proof_len,))] for _ in range(batch)]
+    pf_split1 = [
+        [F.sub(x, s) for x, s in zip(proofs[b], pf_split0[b])] for b in range(batch)
+    ]
+
+    ver_shares_host = [[], []]
+    for b in range(batch):
+        ver_shares_host[0].append(
+            ref.flp_query(circ, inp_split0[b], pf_split0[b], query_rands[b], joint_rands[b], 2)
+        )
+        ver_shares_host[1].append(
+            ref.flp_query(circ, inp_split1[b], pf_split1[b], query_rands[b], joint_rands[b], 2)
+        )
+
+    for si, (inp_s, pf_s) in enumerate([(inp_split0, pf_split0), (inp_split1, pf_split1)]):
+        got = jf.to_ints(
+            flp_query_batched(
+                bc,
+                to_dev(jf, np.array(inp_s, dtype=object)),
+                to_dev(jf, np.array(pf_s, dtype=object)),
+                d_qr,
+                d_jr,
+                2,
+            )
+        )
+        for b in range(batch):
+            assert list(got[b]) == ver_shares_host[si][b], f"verifier mismatch share {si} report {b}"
+
+    # combine + decide
+    combined = [
+        [F.add(a, c) for a, c in zip(ver_shares_host[0][b], ver_shares_host[1][b])]
+        for b in range(batch)
+    ]
+    want_valid = [ref.flp_decide(circ, v) for v in combined]
+    d_combined = to_dev(jf, np.array(combined, dtype=object))
+    got_valid = np.asarray(flp_decide_batched(bc, d_combined))
+    assert list(got_valid) == want_valid
+    # sanity: the valid encodings accept, garbage rejects (w.h.p.)
+    for b in range(batch):
+        if b % 2 == 0:
+            assert want_valid[b], f"valid report {b} rejected"
+
+
+@pytest.mark.parametrize("circ", CIRCUITS, ids=lambda c: type(c).__name__)
+def test_encode_batch_matches_host(circ):
+    bc = batched_circuit(circ)
+    meas = {
+        ref.Count: [0, 1, 1],
+        ref.Sum: [0, 255, 129],
+        ref.SumVec: [[0, 1, 2, 3, 4], [15, 0, 15, 0, 15], [7, 7, 7, 7, 7]],
+        ref.Histogram: [0, 9, 5],
+    }[type(circ)]
+    got = bc.encode_batch(meas)
+    for i, m in enumerate(meas):
+        assert [int(x) for x in got[i]] == circ.encode(m)

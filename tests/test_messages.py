@@ -1,0 +1,154 @@
+"""DAP message round-trips + fixed byte-layout vectors.
+
+Mirrors the reference's message tests (messages/src/lib.rs inline test
+modules use hex golden vectors; SURVEY.md section 4.1).
+"""
+
+import pytest
+
+from janus_tpu import messages as m
+
+
+def rt(obj, cls=None, *args):
+    raw = obj.to_bytes()
+    back = (cls or type(obj)).from_bytes(raw, *args)
+    assert back == obj
+    return raw
+
+
+def test_fixed_length_ids():
+    for cls in (m.TaskId, m.BatchId, m.ReportId, m.AggregationJobId, m.CollectionJobId):
+        v = cls.random()
+        assert len(rt(v)) == cls.SIZE
+        with pytest.raises(ValueError):
+            cls(b"\x00")
+
+
+def test_time_interval_layout():
+    iv = m.Interval(m.Time(0x0102030405060708), m.Duration(0x1122334455667788))
+    raw = rt(iv)
+    assert raw == bytes.fromhex("0102030405060708" "1122334455667788")
+    assert iv.end == m.Time(0x0102030405060708 + 0x1122334455667788)
+    assert iv.contains(m.Time(0x0102030405060709))
+    assert not iv.contains(iv.end)
+
+
+def test_time_rounding():
+    t = m.Time(12345)
+    assert t.to_batch_interval_start(m.Duration(100)) == m.Time(12300)
+    assert m.Interval(m.Time(200), m.Duration(400)).aligned_to(m.Duration(100))
+    assert not m.Interval(m.Time(250), m.Duration(400)).aligned_to(m.Duration(100))
+
+
+def test_checksum_xor_combine():
+    a, b = m.ReportId(b"a" * 16), m.ReportId(b"b" * 16)
+    ca = m.ReportIdChecksum.for_report_id(a)
+    cb = m.ReportIdChecksum.for_report_id(b)
+    combined = ca.combined_with(cb)
+    assert combined == m.ReportIdChecksum().updated_with(a).updated_with(b)
+    assert combined.combined_with(cb) == ca  # XOR involution
+    rt(combined)
+
+
+def test_hpke_structs():
+    cfg = m.HpkeConfig(
+        m.HpkeConfigId(7),
+        m.HpkeKemId.X25519_HKDF_SHA256,
+        m.HpkeKdfId.HKDF_SHA256,
+        m.HpkeAeadId.AES_128_GCM,
+        b"\x01" * 32,
+    )
+    raw = rt(cfg)
+    assert raw[:7] == bytes.fromhex("07" "0020" "0001" "0001")
+    rt(m.HpkeConfigList((cfg, cfg)))
+    ct = m.HpkeCiphertext(m.HpkeConfigId(7), b"enc-key", b"payload")
+    rt(ct)
+
+
+def test_report_roundtrip():
+    meta = m.ReportMetadata(m.ReportId.random(), m.Time(1700000000))
+    ct = m.HpkeCiphertext(m.HpkeConfigId(1), b"ek", b"pl")
+    rep = m.Report(meta, b"public", ct, ct)
+    rt(rep)
+    pis = m.PlaintextInputShare((m.Extension(m.ExtensionType.TBD, b"x"),), b"payload")
+    rt(pis)
+    aad = m.InputShareAad(m.TaskId.random(), meta, b"public")
+    rt(aad)
+
+
+def test_queries_and_selectors():
+    iv = m.Interval(m.Time(1000), m.Duration(100))
+    rt(m.Query.time_interval(iv))
+    rt(m.Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.CURRENT_BATCH)))
+    bid = m.BatchId.random()
+    rt(m.Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.BY_BATCH_ID, bid)))
+    rt(m.PartialBatchSelector.time_interval())
+    rt(m.PartialBatchSelector.fixed_size(bid))
+    rt(m.BatchSelector.time_interval(iv))
+    rt(m.BatchSelector.fixed_size(bid))
+    rt(m.CollectionReq(m.Query.time_interval(iv), b"param"))
+
+
+def test_aggregation_job_messages():
+    meta = m.ReportMetadata(m.ReportId.random(), m.Time(1700000000))
+    ct = m.HpkeCiphertext(m.HpkeConfigId(1), b"ek", b"pl")
+    share = m.ReportShare(meta, b"pub", ct)
+    init = m.PrepareInit(share, b"ping-pong-msg")
+    req = m.AggregationJobInitializeReq(b"", m.PartialBatchSelector.time_interval(), (init, init))
+    rt(req)
+
+    resp = m.AggregationJobResp(
+        (
+            m.PrepareResp(meta.report_id, m.PrepareStepResult.cont(b"msg")),
+            m.PrepareResp(meta.report_id, m.PrepareStepResult.finished()),
+            m.PrepareResp(
+                meta.report_id,
+                m.PrepareStepResult.reject(m.PrepareError.VDAF_PREP_ERROR),
+            ),
+        )
+    )
+    rt(resp)
+
+    cont = m.AggregationJobContinueReq(
+        m.AggregationJobStep(1),
+        (m.PrepareContinue(meta.report_id, b"m"),),
+    )
+    rt(cont)
+    assert m.AggregationJobStep(0).increment() == m.AggregationJobStep(1)
+
+
+def test_collection_and_share_messages():
+    iv = m.Interval(m.Time(1000), m.Duration(100))
+    ct = m.HpkeCiphertext(m.HpkeConfigId(1), b"ek", b"pl")
+    rt(m.Collection(m.PartialBatchSelector.time_interval(), 5, iv, ct, ct))
+    rt(
+        m.AggregateShareReq(
+            m.BatchSelector.time_interval(iv), b"", 5, m.ReportIdChecksum(b"\x05" * 32)
+        )
+    )
+    rt(m.AggregateShare(ct))
+    rt(m.AggregateShareAad(m.TaskId.random(), b"p", m.BatchSelector.time_interval(iv)))
+
+
+def test_decode_errors():
+    with pytest.raises(m.DecodeError):
+        m.Interval.from_bytes(b"\x00" * 15)
+    with pytest.raises(m.DecodeError):
+        m.Interval.from_bytes(b"\x00" * 17)  # trailing byte
+    with pytest.raises(m.DecodeError):
+        m.Query.from_bytes(b"\x09")  # unknown query type
+    with pytest.raises(m.DecodeError):
+        m.Role.from_bytes(b"\x0a")
+
+
+def test_roles():
+    assert m.Role.from_bytes(b"\x02") == m.Role.LEADER
+    assert m.Role.LEADER.to_bytes() == b"\x02"
+
+
+def test_problem_types():
+    pt = m.DapProblemType.REPORT_REJECTED
+    assert pt.type_uri == "urn:ietf:params:ppm:dap:error:reportRejected"
+    assert m.DapProblemType.from_uri(pt.type_uri) is pt
+    doc = pt.document(task_id="abc", detail="nope")
+    assert doc["type"].endswith("reportRejected") and doc["taskid"] == "abc"
