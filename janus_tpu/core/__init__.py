@@ -1,0 +1,30 @@
+"""Shared runtime utilities: HPKE, clocks, auth tokens, retries.
+
+Python equivalent of the reference's `core` crate (SURVEY.md section
+2.3). The VDAF registry lives in janus_tpu.vdaf.registry.
+"""
+
+from .hpke import (
+    HpkeApplicationInfo,
+    HpkeKeypair,
+    Label,
+    generate_hpke_config_and_private_key,
+    hpke_open,
+    hpke_seal,
+)
+from .time_util import Clock, MockClock, RealClock
+from .auth import AuthenticationToken, DAP_AUTH_HEADER
+
+__all__ = [
+    "HpkeApplicationInfo",
+    "HpkeKeypair",
+    "Label",
+    "generate_hpke_config_and_private_key",
+    "hpke_open",
+    "hpke_seal",
+    "Clock",
+    "MockClock",
+    "RealClock",
+    "AuthenticationToken",
+    "DAP_AUTH_HEADER",
+]

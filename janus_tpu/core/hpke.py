@@ -1,0 +1,173 @@
+"""HPKE (RFC 9180) single-shot seal/open with DAP application labels.
+
+Equivalent of reference core/src/hpke.rs:27-120: base-mode
+DHKEM(X25519, HKDF-SHA256) + HKDF-SHA256 + AES-128-GCM, with the
+DAP-07 application-info labels ("dap-07 input share",
+"dap-07 aggregate share") and sender/recipient roles bound into the
+key schedule info.
+
+KEM/AEAD primitives come from the `cryptography` package (the
+reference's equivalent dependency is the hpke-dispatch crate); the
+HKDF labeling is implemented here to match RFC 9180 exactly.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import hmac
+import secrets
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from ..messages import HpkeAeadId, HpkeCiphertext, HpkeConfig, HpkeConfigId, HpkeKdfId, HpkeKemId, Role
+
+# suite constants: DHKEM(X25519, HKDF-SHA256)=0x0020, HKDF-SHA256=0x0001, AES-128-GCM=0x0001
+KEM_ID = 0x0020
+KDF_ID = 0x0001
+AEAD_ID = 0x0001
+NK = 16  # AES-128 key
+NN = 12  # GCM nonce
+NH = 32  # SHA-256
+NSECRET = 32
+
+_SUITE_ID = b"HPKE" + KEM_ID.to_bytes(2, "big") + KDF_ID.to_bytes(2, "big") + AEAD_ID.to_bytes(2, "big")
+_KEM_SUITE_ID = b"KEM" + KEM_ID.to_bytes(2, "big")
+
+
+class HpkeError(Exception):
+    pass
+
+
+def _hmac_sha256(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, hashlib.sha256).digest()
+
+
+def _labeled_extract(suite_id: bytes, salt: bytes, label: bytes, ikm: bytes) -> bytes:
+    return _hmac_sha256(salt, b"HPKE-v1" + suite_id + label + ikm)
+
+
+def _labeled_expand(suite_id: bytes, prk: bytes, label: bytes, info: bytes, length: int) -> bytes:
+    labeled_info = length.to_bytes(2, "big") + b"HPKE-v1" + suite_id + label + info
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = _hmac_sha256(prk, t + labeled_info + bytes([i]))
+        out += t
+        i += 1
+    return out[:length]
+
+
+def _extract_and_expand(dh: bytes, kem_context: bytes) -> bytes:
+    eae_prk = _labeled_extract(_KEM_SUITE_ID, b"", b"eae_prk", dh)
+    return _labeled_expand(_KEM_SUITE_ID, eae_prk, b"shared_secret", kem_context, NSECRET)
+
+
+def _key_schedule(shared_secret: bytes, info: bytes) -> tuple[bytes, bytes]:
+    """Base mode key schedule -> (key, base_nonce)."""
+    psk_id_hash = _labeled_extract(_SUITE_ID, b"", b"psk_id_hash", b"")
+    info_hash = _labeled_extract(_SUITE_ID, b"", b"info_hash", info)
+    key_schedule_context = b"\x00" + psk_id_hash + info_hash
+    secret = _labeled_extract(_SUITE_ID, shared_secret, b"secret", b"")
+    key = _labeled_expand(_SUITE_ID, secret, b"key", key_schedule_context, NK)
+    base_nonce = _labeled_expand(_SUITE_ID, secret, b"base_nonce", key_schedule_context, NN)
+    return key, base_nonce
+
+
+class Label(enum.Enum):
+    """DAP application-info labels (reference core/src/hpke.rs:45)."""
+
+    INPUT_SHARE = b"dap-07 input share"
+    AGGREGATE_SHARE = b"dap-07 aggregate share"
+
+
+@dataclass(frozen=True)
+class HpkeApplicationInfo:
+    """label || sender role || recipient role (reference core/src/hpke.rs:62)."""
+
+    label: Label
+    sender: Role
+    recipient: Role
+
+    def bytes(self) -> bytes:
+        return self.label.value + bytes([self.sender.value, self.recipient.value])
+
+
+@dataclass(frozen=True)
+class HpkeKeypair:
+    config: HpkeConfig
+    private_key: bytes  # raw X25519 scalar
+
+    def config_id(self) -> HpkeConfigId:
+        return self.config.id
+
+
+def generate_hpke_config_and_private_key(config_id: int = 0) -> HpkeKeypair:
+    """reference core/src/hpke.rs generate_hpke_config_and_private_key."""
+    sk = X25519PrivateKey.generate()
+    pk_bytes = sk.public_key().public_bytes_raw()
+    config = HpkeConfig(
+        HpkeConfigId(config_id),
+        HpkeKemId.X25519_HKDF_SHA256,
+        HpkeKdfId.HKDF_SHA256,
+        HpkeAeadId.AES_128_GCM,
+        pk_bytes,
+    )
+    return HpkeKeypair(config, sk.private_bytes_raw())
+
+
+def _check_config(config: HpkeConfig) -> None:
+    if (
+        config.kem_id != HpkeKemId.X25519_HKDF_SHA256
+        or config.kdf_id != HpkeKdfId.HKDF_SHA256
+        or config.aead_id != HpkeAeadId.AES_128_GCM
+    ):
+        raise HpkeError(f"unsupported HPKE ciphersuite {config}")
+
+
+def hpke_seal(
+    config: HpkeConfig,
+    application_info: HpkeApplicationInfo,
+    plaintext: bytes,
+    aad: bytes,
+) -> HpkeCiphertext:
+    """Single-shot base-mode seal to `config`'s public key."""
+    _check_config(config)
+    pk_r = X25519PublicKey.from_public_bytes(config.public_key)
+    sk_e = X25519PrivateKey.generate()
+    enc = sk_e.public_key().public_bytes_raw()
+    dh = sk_e.exchange(pk_r)
+    shared_secret = _extract_and_expand(dh, enc + config.public_key)
+    key, base_nonce = _key_schedule(shared_secret, application_info.bytes())
+    ct = AESGCM(key).encrypt(base_nonce, plaintext, aad)
+    return HpkeCiphertext(config.id, enc, ct)
+
+
+def hpke_open(
+    keypair: HpkeKeypair,
+    application_info: HpkeApplicationInfo,
+    ciphertext: HpkeCiphertext,
+    aad: bytes,
+) -> bytes:
+    """Single-shot base-mode open with the recipient private key."""
+    _check_config(keypair.config)
+    if ciphertext.config_id != keypair.config.id:
+        raise HpkeError(
+            f"config id mismatch: {ciphertext.config_id} != {keypair.config.id}"
+        )
+    sk_r = X25519PrivateKey.from_private_bytes(keypair.private_key)
+    pk_e = X25519PublicKey.from_public_bytes(ciphertext.encapsulated_key)
+    dh = sk_r.exchange(pk_e)
+    kem_context = ciphertext.encapsulated_key + keypair.config.public_key
+    shared_secret = _extract_and_expand(dh, kem_context)
+    key, base_nonce = _key_schedule(shared_secret, application_info.bytes())
+    try:
+        return AESGCM(key).decrypt(base_nonce, ciphertext.payload, aad)
+    except Exception as e:  # InvalidTag
+        raise HpkeError(f"decryption failed: {e}") from e

@@ -1,0 +1,62 @@
+"""HTTP retry with exponential backoff.
+
+Equivalent of reference core/src/retries.rs:30-72
+(retry_http_request + test variants): retries transport errors and
+retryable status codes (5xx, 429) with capped exponential backoff and
+jitter.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Backoff:
+    initial: float = 0.1
+    multiplier: float = 2.0
+    max_interval: float = 5.0
+    max_elapsed: float = 30.0
+    jitter: float = 0.25
+
+    @classmethod
+    def test(cls) -> "Backoff":
+        """Fast backoff for tests (reference test_util variants)."""
+        return cls(initial=0.001, max_interval=0.01, max_elapsed=0.25)
+
+
+RETRYABLE_STATUS = {429, 500, 502, 503, 504}
+
+
+def is_retryable_status(status: int) -> bool:
+    return status in RETRYABLE_STATUS
+
+
+def retry_http_request(do_request, backoff: Backoff = Backoff(), sleep=time.sleep):
+    """Call do_request() until success or budget exhausted.
+
+    do_request returns (status:int, body) or raises OSError-likes for
+    transport failures. Returns the last (status, body); raises the
+    last transport error if every attempt failed by exception.
+    """
+    interval = backoff.initial
+    elapsed = 0.0
+    last_exc = None
+    while True:
+        try:
+            status, body = do_request()
+            if not is_retryable_status(status):
+                return status, body
+            last_exc = None
+        except (OSError, ConnectionError) as e:
+            last_exc = e
+        if elapsed + interval > backoff.max_elapsed:
+            if last_exc is not None:
+                raise last_exc
+            return status, body
+        delay = interval * (1 + random.uniform(-backoff.jitter, backoff.jitter))
+        sleep(delay)
+        elapsed += delay
+        interval = min(interval * backoff.multiplier, backoff.max_interval)

@@ -1,0 +1,41 @@
+"""Durable protocol state store.
+
+Equivalent of reference aggregator_core/src/datastore.rs (SURVEY.md
+section 2.4): a transactional facade with typed operations over the
+DAP schema (tasks, client reports, aggregation jobs + leases, report
+aggregations, sharded batch aggregations, collection jobs, aggregate
+share jobs, batches, outstanding batches, global HPKE keys), with
+AES-GCM encryption-at-rest for secret columns (`Crypter`,
+datastore.rs:4889) and lease-based work queues
+(acquire_incomplete_*_jobs, datastore.rs:1836).
+
+Backend is SQLite here (no Postgres driver ships in this image); the
+SQL and the op surface are kept Postgres-shaped — `FOR UPDATE SKIP
+LOCKED` becomes a single-statement UPDATE..RETURNING claim, REPEATABLE
+READ + serialization-retry becomes BEGIN IMMEDIATE + busy-retry — so a
+server-Postgres backend is a drop-in (SURVEY.md section 7 step 4). All
+protocol state is durable, so any worker resumes any job mid-step
+(checkpoint/resume, SURVEY.md section 5).
+"""
+
+from .models import (
+    AcquiredAggregationJob,
+    AcquiredCollectionJob,
+    AggregateShareJob,
+    AggregationJobModel,
+    AggregationJobState,
+    Batch,
+    BatchAggregation,
+    BatchAggregationState,
+    BatchState,
+    CollectionJobModel,
+    CollectionJobState,
+    LeaderStoredReport,
+    Lease,
+    OutstandingBatch,
+    ReportAggregationModel,
+    ReportAggregationState,
+)
+from .store import Crypter, Datastore, EphemeralDatastore
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,1076 @@
+"""Datastore: transactional facade + typed ops + Crypter.
+
+Equivalent of reference aggregator_core/src/datastore.rs:107-4960.
+Mapping of reference semantics onto SQLite (see package docstring):
+
+  - `run_tx` retry on serialization failure (datastore.rs:216-305) ->
+    BEGIN IMMEDIATE + bounded retry on SQLITE_BUSY.
+  - `FOR UPDATE ... SKIP LOCKED` lease acquire (datastore.rs:1836-1905)
+    -> one UPDATE ... WHERE ... RETURNING statement per claim, which is
+    atomic under SQLite's writer lock.
+  - `Crypter` AES-128-GCM encryption at rest with AAD =
+    table||row||column and multi-key rotation (datastore.rs:4889-4960).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import sqlite3
+import tempfile
+import threading
+import time as _time
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from ..messages import (
+    AggregationJobId,
+    BatchId,
+    CollectionJobId,
+    HpkeCiphertext,
+    Interval,
+    PrepareError,
+    Duration,
+    ReportId,
+    ReportIdChecksum,
+    TaskId,
+    Time,
+)
+from ..task import Task
+from .models import (
+    AcquiredAggregationJob,
+    AcquiredCollectionJob,
+    AggregateShareJob,
+    AggregationJobModel,
+    AggregationJobState,
+    Batch,
+    BatchAggregation,
+    BatchAggregationState,
+    BatchState,
+    CollectionJobModel,
+    CollectionJobState,
+    LeaderStoredReport,
+    Lease,
+    OutstandingBatch,
+    ReportAggregationModel,
+    ReportAggregationState,
+)
+
+SCHEMA_VERSION = 1
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS schema_version (version INTEGER NOT NULL);
+
+CREATE TABLE IF NOT EXISTS tasks (
+    task_id BLOB PRIMARY KEY,
+    role INTEGER NOT NULL,
+    task_expiration INTEGER,
+    doc BLOB NOT NULL            -- encrypted serialized Task
+);
+
+CREATE TABLE IF NOT EXISTS client_reports (
+    task_id BLOB NOT NULL,
+    report_id BLOB NOT NULL,
+    client_time INTEGER NOT NULL,
+    public_share BLOB,
+    leader_input_share BLOB,     -- encrypted
+    helper_encrypted_input_share BLOB,
+    aggregation_started INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (task_id, report_id)
+);
+-- partial-index analog of ...up.sql:157 (unaggregated lookup)
+CREATE INDEX IF NOT EXISTS client_reports_unaggregated
+    ON client_reports (task_id, client_time) WHERE aggregation_started = 0;
+
+CREATE TABLE IF NOT EXISTS aggregation_jobs (
+    task_id BLOB NOT NULL,
+    job_id BLOB NOT NULL,
+    aggregation_parameter BLOB NOT NULL,
+    partial_batch_identifier BLOB NOT NULL,
+    client_interval_start INTEGER NOT NULL,
+    client_interval_duration INTEGER NOT NULL,
+    state TEXT NOT NULL,
+    step INTEGER NOT NULL DEFAULT 0,
+    last_request_hash BLOB,
+    lease_expiry INTEGER NOT NULL DEFAULT 0,
+    lease_token BLOB,
+    lease_attempts INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (task_id, job_id)
+);
+-- analog of the state_and_lease_expiry index (...up.sql:168-189)
+CREATE INDEX IF NOT EXISTS aggregation_jobs_lease
+    ON aggregation_jobs (state, lease_expiry) WHERE state = 'in_progress';
+
+CREATE TABLE IF NOT EXISTS report_aggregations (
+    task_id BLOB NOT NULL,
+    job_id BLOB NOT NULL,
+    report_id BLOB NOT NULL,
+    client_time INTEGER NOT NULL,
+    ord INTEGER NOT NULL,
+    state TEXT NOT NULL,
+    prep_blob BLOB,              -- encrypted
+    prepare_error INTEGER,
+    PRIMARY KEY (task_id, job_id, ord)
+);
+CREATE INDEX IF NOT EXISTS report_aggregations_by_report
+    ON report_aggregations (task_id, report_id);
+
+CREATE TABLE IF NOT EXISTS batch_aggregations (
+    task_id BLOB NOT NULL,
+    batch_identifier BLOB NOT NULL,
+    aggregation_parameter BLOB NOT NULL,
+    ord INTEGER NOT NULL,
+    state TEXT NOT NULL,
+    aggregate_share BLOB,
+    report_count INTEGER NOT NULL DEFAULT 0,
+    client_interval_start INTEGER NOT NULL DEFAULT 0,
+    client_interval_duration INTEGER NOT NULL DEFAULT 0,
+    checksum BLOB NOT NULL,
+    PRIMARY KEY (task_id, batch_identifier, aggregation_parameter, ord)
+);
+
+CREATE TABLE IF NOT EXISTS collection_jobs (
+    task_id BLOB NOT NULL,
+    collection_job_id BLOB NOT NULL,
+    query BLOB NOT NULL,
+    aggregation_parameter BLOB NOT NULL,
+    batch_identifier BLOB NOT NULL,
+    state TEXT NOT NULL,
+    report_count INTEGER,
+    client_interval_start INTEGER,
+    client_interval_duration INTEGER,
+    leader_aggregate_share BLOB,           -- encrypted
+    helper_encrypted_aggregate_share BLOB,
+    lease_expiry INTEGER NOT NULL DEFAULT 0,
+    lease_token BLOB,
+    lease_attempts INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (task_id, collection_job_id)
+);
+
+CREATE TABLE IF NOT EXISTS aggregate_share_jobs (
+    task_id BLOB NOT NULL,
+    batch_identifier BLOB NOT NULL,
+    aggregation_parameter BLOB NOT NULL,
+    helper_aggregate_share BLOB NOT NULL,  -- encrypted
+    report_count INTEGER NOT NULL,
+    checksum BLOB NOT NULL,
+    PRIMARY KEY (task_id, batch_identifier, aggregation_parameter)
+);
+
+CREATE TABLE IF NOT EXISTS batches (
+    task_id BLOB NOT NULL,
+    batch_identifier BLOB NOT NULL,
+    aggregation_parameter BLOB NOT NULL,
+    state TEXT NOT NULL,
+    outstanding_aggregation_jobs INTEGER NOT NULL DEFAULT 0,
+    client_interval_start INTEGER NOT NULL DEFAULT 0,
+    client_interval_duration INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (task_id, batch_identifier, aggregation_parameter)
+);
+
+CREATE TABLE IF NOT EXISTS outstanding_batches (
+    task_id BLOB NOT NULL,
+    batch_id BLOB NOT NULL,
+    time_bucket_start INTEGER,
+    filled INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (task_id, batch_id)
+);
+
+CREATE TABLE IF NOT EXISTS global_hpke_keys (
+    config_id INTEGER PRIMARY KEY,
+    config BLOB NOT NULL,
+    private_key BLOB NOT NULL,   -- encrypted
+    state TEXT NOT NULL DEFAULT 'pending',
+    updated_at INTEGER NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS taskprov_peer_aggregators (
+    endpoint TEXT NOT NULL,
+    role INTEGER NOT NULL,
+    doc BLOB NOT NULL,           -- encrypted serialized PeerAggregator
+    PRIMARY KEY (endpoint, role)
+);
+"""
+
+
+class Crypter:
+    """AES-128-GCM at rest, AAD = table||row||column, multi-key rotation
+    (reference datastore.rs:4889-4960): encrypt under keys[0], try all
+    keys on decrypt."""
+
+    NONCE = 12
+
+    def __init__(self, keys: list[bytes] | None = None):
+        keys = keys if keys is not None else [secrets.token_bytes(16)]
+        assert keys and all(len(k) == 16 for k in keys)
+        self._keys = [AESGCM(k) for k in keys]
+
+    @staticmethod
+    def aad(table: str, row: bytes, column: str) -> bytes:
+        return table.encode() + b"/" + row + b"/" + column.encode()
+
+    def encrypt(self, table: str, row: bytes, column: str, plaintext: bytes) -> bytes:
+        nonce = secrets.token_bytes(self.NONCE)
+        return nonce + self._keys[0].encrypt(nonce, plaintext, self.aad(table, row, column))
+
+    def decrypt(self, table: str, row: bytes, column: str, data: bytes) -> bytes:
+        nonce, ct = data[: self.NONCE], data[self.NONCE :]
+        aad = self.aad(table, row, column)
+        last = None
+        for key in self._keys:
+            try:
+                return key.decrypt(nonce, ct, aad)
+            except Exception as e:  # InvalidTag
+                last = e
+        raise ValueError(f"datastore decryption failed: {last}")
+
+
+class TxConflict(Exception):
+    pass
+
+
+class Transaction:
+    """One open transaction; exposes every typed op. Obtained from
+    Datastore.run_tx / Datastore.tx()."""
+
+    def __init__(self, conn: sqlite3.Connection, crypter: Crypter, clock):
+        self._c = conn
+        self._crypter = crypter
+        self._clock = clock
+
+    # ---- tasks (reference datastore.rs:528-1160) ----
+    def put_task(self, task: Task) -> None:
+        import json
+
+        doc = json.dumps(task.to_dict()).encode()
+        enc = self._crypter.encrypt("tasks", task.task_id.data, "doc", doc)
+        self._c.execute(
+            "INSERT INTO tasks (task_id, role, task_expiration, doc) VALUES (?,?,?,?)",
+            (
+                task.task_id.data,
+                int(task.role),
+                task.task_expiration.seconds if task.task_expiration else None,
+                enc,
+            ),
+        )
+
+    def get_task(self, task_id: TaskId) -> Task | None:
+        import json
+
+        row = self._c.execute(
+            "SELECT doc FROM tasks WHERE task_id = ?", (task_id.data,)
+        ).fetchone()
+        if row is None:
+            return None
+        doc = self._crypter.decrypt("tasks", task_id.data, "doc", row[0])
+        return Task.from_dict(json.loads(doc))
+
+    def get_task_ids(self) -> list[TaskId]:
+        return [
+            TaskId(r[0]) for r in self._c.execute("SELECT task_id FROM tasks ORDER BY task_id")
+        ]
+
+    def get_tasks(self) -> list[Task]:
+        return [t for t in (self.get_task(tid) for tid in self.get_task_ids()) if t]
+
+    def delete_task(self, task_id: TaskId) -> None:
+        for table in (
+            "tasks",
+            "client_reports",
+            "aggregation_jobs",
+            "report_aggregations",
+            "batch_aggregations",
+            "collection_jobs",
+            "aggregate_share_jobs",
+            "batches",
+            "outstanding_batches",
+        ):
+            self._c.execute(f"DELETE FROM {table} WHERE task_id = ?", (task_id.data,))
+
+    # ---- client reports (reference datastore.rs:1162-1723) ----
+    def put_client_report(self, report: LeaderStoredReport) -> bool:
+        """Returns False if the report id already exists (replay)."""
+        row_key = report.task_id.data + report.report_id.data
+        lis = self._crypter.encrypt(
+            "client_reports", row_key, "leader_input_share", report.leader_input_share
+        )
+        try:
+            self._c.execute(
+                "INSERT INTO client_reports (task_id, report_id, client_time, public_share,"
+                " leader_input_share, helper_encrypted_input_share) VALUES (?,?,?,?,?,?)",
+                (
+                    report.task_id.data,
+                    report.report_id.data,
+                    report.client_time.seconds,
+                    report.public_share,
+                    lis,
+                    report.helper_encrypted_input_share.to_bytes(),
+                ),
+            )
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+    def get_client_report(self, task_id: TaskId, report_id: ReportId) -> LeaderStoredReport | None:
+        row = self._c.execute(
+            "SELECT client_time, public_share, leader_input_share, helper_encrypted_input_share"
+            " FROM client_reports WHERE task_id = ? AND report_id = ?",
+            (task_id.data, report_id.data),
+        ).fetchone()
+        if row is None:
+            return None
+        row_key = task_id.data + report_id.data
+        return LeaderStoredReport(
+            task_id,
+            report_id,
+            Time(row[0]),
+            row[1],
+            self._crypter.decrypt("client_reports", row_key, "leader_input_share", row[2]),
+            HpkeCiphertext.from_bytes(row[3]),
+        )
+
+    def check_report_replayed(self, task_id: TaskId, report_id: ReportId) -> bool:
+        return (
+            self._c.execute(
+                "SELECT 1 FROM client_reports WHERE task_id = ? AND report_id = ?",
+                (task_id.data, report_id.data),
+            ).fetchone()
+            is not None
+        )
+
+    def get_unaggregated_client_reports_for_task(
+        self, task_id: TaskId, limit: int
+    ) -> list[tuple[ReportId, Time]]:
+        """Claims up to `limit` unaggregated reports (marks them started),
+        like datastore.rs:1331 get_unaggregated_client_report_ids_for_task."""
+        rows = self._c.execute(
+            "UPDATE client_reports SET aggregation_started = 1"
+            " WHERE (task_id, report_id) IN ("
+            "   SELECT task_id, report_id FROM client_reports"
+            "   WHERE task_id = ? AND aggregation_started = 0"
+            "   ORDER BY client_time LIMIT ?)"
+            " RETURNING report_id, client_time",
+            (task_id.data, limit),
+        ).fetchall()
+        return [(ReportId(r[0]), Time(r[1])) for r in rows]
+
+    def mark_reports_unaggregated(self, task_id: TaskId, report_ids: list[ReportId]) -> None:
+        self._c.executemany(
+            "UPDATE client_reports SET aggregation_started = 0 WHERE task_id = ? AND report_id = ?",
+            [(task_id.data, r.data) for r in report_ids],
+        )
+
+    def count_client_reports_for_interval(self, task_id: TaskId, interval: Interval) -> int:
+        return self._c.execute(
+            "SELECT COUNT(*) FROM client_reports WHERE task_id = ? AND client_time >= ? AND client_time < ?",
+            (task_id.data, interval.start.seconds, interval.end.seconds),
+        ).fetchone()[0]
+
+    def count_client_reports_for_task(self, task_id: TaskId) -> tuple[int, int]:
+        """(total, aggregated) — powers the ops API task metrics
+        (reference datastore.rs:1101 get_task_metrics)."""
+        row = self._c.execute(
+            "SELECT COUNT(*), COALESCE(SUM(aggregation_started), 0) FROM client_reports WHERE task_id = ?",
+            (task_id.data,),
+        ).fetchone()
+        return row[0], row[1]
+
+    def delete_expired_client_reports(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
+        cur = self._c.execute(
+            "DELETE FROM client_reports WHERE (task_id, report_id) IN ("
+            " SELECT task_id, report_id FROM client_reports"
+            " WHERE task_id = ? AND client_time < ? LIMIT ?)",
+            (task_id.data, cutoff.seconds, limit),
+        )
+        return cur.rowcount
+
+    # ---- aggregation jobs (reference datastore.rs:1724-2051) ----
+    def put_aggregation_job(self, job: AggregationJobModel) -> None:
+        self._c.execute(
+            "INSERT INTO aggregation_jobs (task_id, job_id, aggregation_parameter,"
+            " partial_batch_identifier, client_interval_start, client_interval_duration,"
+            " state, step, last_request_hash) VALUES (?,?,?,?,?,?,?,?,?)",
+            (
+                job.task_id.data,
+                job.job_id.data,
+                job.aggregation_parameter,
+                job.partial_batch_identifier,
+                job.client_timestamp_interval.start.seconds,
+                job.client_timestamp_interval.duration.seconds,
+                job.state.value,
+                job.step,
+                job.last_request_hash,
+            ),
+        )
+
+    def get_aggregation_job(self, task_id: TaskId, job_id: AggregationJobId) -> AggregationJobModel | None:
+        row = self._c.execute(
+            "SELECT aggregation_parameter, partial_batch_identifier, client_interval_start,"
+            " client_interval_duration, state, step, last_request_hash"
+            " FROM aggregation_jobs WHERE task_id = ? AND job_id = ?",
+            (task_id.data, job_id.data),
+        ).fetchone()
+        if row is None:
+            return None
+        return AggregationJobModel(
+            task_id,
+            job_id,
+            row[0],
+            row[1],
+            Interval(Time(row[2]), Duration(row[3])),
+            AggregationJobState(row[4]),
+            row[5],
+            row[6],
+        )
+
+    def update_aggregation_job(self, job: AggregationJobModel) -> None:
+        self._c.execute(
+            "UPDATE aggregation_jobs SET state = ?, step = ?, last_request_hash = ?"
+            " WHERE task_id = ? AND job_id = ?",
+            (job.state.value, job.step, job.last_request_hash, job.task_id.data, job.job_id.data),
+        )
+
+    def get_aggregation_jobs_for_task(self, task_id: TaskId) -> list[AggregationJobModel]:
+        rows = self._c.execute(
+            "SELECT job_id FROM aggregation_jobs WHERE task_id = ? ORDER BY job_id",
+            (task_id.data,),
+        ).fetchall()
+        return [self.get_aggregation_job(task_id, AggregationJobId(r[0])) for r in rows]
+
+    def acquire_incomplete_aggregation_jobs(
+        self, lease_duration: Duration, limit: int
+    ) -> list[AcquiredAggregationJob]:
+        """Lease-based claim (reference datastore.rs:1836: FOR UPDATE
+        SKIP LOCKED + gen_random_bytes(16) token)."""
+        now = self._clock.now().seconds
+        out = []
+        rows = self._c.execute(
+            "SELECT task_id, job_id FROM aggregation_jobs"
+            " WHERE state = 'in_progress' AND lease_expiry <= ?"
+            " ORDER BY lease_expiry LIMIT ?",
+            (now, limit),
+        ).fetchall()
+        for task_id, job_id in rows:
+            token = secrets.token_bytes(16)
+            cur = self._c.execute(
+                "UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = ?,"
+                " lease_attempts = lease_attempts + 1"
+                " WHERE task_id = ? AND job_id = ? AND state = 'in_progress' AND lease_expiry <= ?"
+                " RETURNING lease_attempts",
+                (now + lease_duration.seconds, token, task_id, job_id, now),
+            ).fetchone()
+            if cur is not None:
+                out.append(
+                    AcquiredAggregationJob(
+                        TaskId(task_id),
+                        AggregationJobId(job_id),
+                        Lease(token, Time(now + lease_duration.seconds), cur[0]),
+                    )
+                )
+        return out
+
+    def release_aggregation_job(self, acquired: AcquiredAggregationJob) -> None:
+        """reference datastore.rs:1905; raises TxConflict if the lease
+        was lost (expired + re-acquired elsewhere)."""
+        cur = self._c.execute(
+            "UPDATE aggregation_jobs SET lease_expiry = 0, lease_token = NULL, lease_attempts = 0"
+            " WHERE task_id = ? AND job_id = ? AND lease_token = ?",
+            (acquired.task_id.data, acquired.job_id.data, acquired.lease.token),
+        )
+        if cur.rowcount != 1:
+            raise TxConflict("lease token mismatch on release")
+
+    # ---- report aggregations (reference datastore.rs:2052-2455) ----
+    def put_report_aggregation(self, ra: ReportAggregationModel) -> None:
+        row_key = ra.task_id.data + ra.job_id.data + ra.ord.to_bytes(8, "big")
+        blob = (
+            self._crypter.encrypt("report_aggregations", row_key, "prep_blob", ra.prep_blob)
+            if ra.prep_blob
+            else b""
+        )
+        self._c.execute(
+            "INSERT INTO report_aggregations (task_id, job_id, report_id, client_time, ord,"
+            " state, prep_blob, prepare_error) VALUES (?,?,?,?,?,?,?,?)",
+            (
+                ra.task_id.data,
+                ra.job_id.data,
+                ra.report_id.data,
+                ra.client_time.seconds,
+                ra.ord,
+                ra.state.value,
+                blob,
+                int(ra.prepare_error) if ra.prepare_error is not None else None,
+            ),
+        )
+
+    def update_report_aggregation(self, ra: ReportAggregationModel) -> None:
+        row_key = ra.task_id.data + ra.job_id.data + ra.ord.to_bytes(8, "big")
+        blob = (
+            self._crypter.encrypt("report_aggregations", row_key, "prep_blob", ra.prep_blob)
+            if ra.prep_blob
+            else b""
+        )
+        self._c.execute(
+            "UPDATE report_aggregations SET state = ?, prep_blob = ?, prepare_error = ?"
+            " WHERE task_id = ? AND job_id = ? AND ord = ?",
+            (
+                ra.state.value,
+                blob,
+                int(ra.prepare_error) if ra.prepare_error is not None else None,
+                ra.task_id.data,
+                ra.job_id.data,
+                ra.ord,
+            ),
+        )
+
+    def get_report_aggregations_for_job(
+        self, task_id: TaskId, job_id: AggregationJobId
+    ) -> list[ReportAggregationModel]:
+        rows = self._c.execute(
+            "SELECT report_id, client_time, ord, state, prep_blob, prepare_error"
+            " FROM report_aggregations WHERE task_id = ? AND job_id = ? ORDER BY ord",
+            (task_id.data, job_id.data),
+        ).fetchall()
+        out = []
+        for r in rows:
+            row_key = task_id.data + job_id.data + r[2].to_bytes(8, "big")
+            blob = (
+                self._crypter.decrypt("report_aggregations", row_key, "prep_blob", r[4])
+                if r[4]
+                else b""
+            )
+            out.append(
+                ReportAggregationModel(
+                    task_id,
+                    job_id,
+                    ReportId(r[0]),
+                    Time(r[1]),
+                    r[2],
+                    ReportAggregationState(r[3]),
+                    blob,
+                    PrepareError(r[5]) if r[5] is not None else None,
+                )
+            )
+        return out
+
+    def count_report_aggregations_for_report(self, task_id: TaskId, report_id: ReportId) -> int:
+        return self._c.execute(
+            "SELECT COUNT(*) FROM report_aggregations WHERE task_id = ? AND report_id = ?",
+            (task_id.data, report_id.data),
+        ).fetchone()[0]
+
+    # ---- batch aggregations (reference datastore.rs:3020-3368) ----
+    def put_batch_aggregation(self, ba: BatchAggregation) -> None:
+        try:
+            self._c.execute(
+                "INSERT INTO batch_aggregations (task_id, batch_identifier, aggregation_parameter,"
+                " ord, state, aggregate_share, report_count, client_interval_start,"
+                " client_interval_duration, checksum) VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (
+                    ba.task_id.data,
+                    ba.batch_identifier,
+                    ba.aggregation_parameter,
+                    ba.ord,
+                    ba.state.value,
+                    ba.aggregate_share,
+                    ba.report_count,
+                    ba.client_timestamp_interval.start.seconds,
+                    ba.client_timestamp_interval.duration.seconds,
+                    ba.checksum.data,
+                ),
+            )
+        except sqlite3.IntegrityError as e:
+            # unique violation -> retryable conflict (reference accumulator.rs:173-199)
+            raise TxConflict(str(e)) from e
+
+    def update_batch_aggregation(self, ba: BatchAggregation) -> None:
+        self._c.execute(
+            "UPDATE batch_aggregations SET state = ?, aggregate_share = ?, report_count = ?,"
+            " client_interval_start = ?, client_interval_duration = ?, checksum = ?"
+            " WHERE task_id = ? AND batch_identifier = ? AND aggregation_parameter = ? AND ord = ?",
+            (
+                ba.state.value,
+                ba.aggregate_share,
+                ba.report_count,
+                ba.client_timestamp_interval.start.seconds,
+                ba.client_timestamp_interval.duration.seconds,
+                ba.checksum.data,
+                ba.task_id.data,
+                ba.batch_identifier,
+                ba.aggregation_parameter,
+                ba.ord,
+            ),
+        )
+
+    def get_batch_aggregation(
+        self, task_id: TaskId, batch_identifier: bytes, agg_param: bytes, ord: int
+    ) -> BatchAggregation | None:
+        row = self._c.execute(
+            "SELECT state, aggregate_share, report_count, client_interval_start,"
+            " client_interval_duration, checksum FROM batch_aggregations"
+            " WHERE task_id = ? AND batch_identifier = ? AND aggregation_parameter = ? AND ord = ?",
+            (task_id.data, batch_identifier, agg_param, ord),
+        ).fetchone()
+        if row is None:
+            return None
+        return BatchAggregation(
+            task_id,
+            batch_identifier,
+            agg_param,
+            ord,
+            BatchAggregationState(row[0]),
+            row[1],
+            row[2],
+            Interval(Time(row[3]), Duration(row[4])),
+            ReportIdChecksum(row[5]),
+        )
+
+    def get_batch_aggregations_for_batch(
+        self, task_id: TaskId, batch_identifier: bytes, agg_param: bytes
+    ) -> list[BatchAggregation]:
+        rows = self._c.execute(
+            "SELECT ord FROM batch_aggregations WHERE task_id = ? AND batch_identifier = ?"
+            " AND aggregation_parameter = ? ORDER BY ord",
+            (task_id.data, batch_identifier, agg_param),
+        ).fetchall()
+        return [
+            self.get_batch_aggregation(task_id, batch_identifier, agg_param, r[0]) for r in rows
+        ]
+
+    def get_batch_aggregations_intersecting_interval(
+        self, task_id: TaskId, interval: Interval
+    ) -> list[BatchAggregation]:
+        """Time-interval collection: find shard rows whose batch interval
+        falls inside the collection interval (reference
+        query_type.rs:204 CollectableQueryType)."""
+        rows = self._c.execute(
+            "SELECT DISTINCT batch_identifier, aggregation_parameter FROM batch_aggregations"
+            " WHERE task_id = ?",
+            (task_id.data,),
+        ).fetchall()
+        out = []
+        for bid, param in rows:
+            biv = Interval.from_bytes(bid)
+            if biv.start >= interval.start and biv.end <= interval.end:
+                out.extend(self.get_batch_aggregations_for_batch(task_id, bid, param))
+        return out
+
+    def mark_batch_aggregations_collected(
+        self, task_id: TaskId, batch_identifier: bytes, agg_param: bytes
+    ) -> None:
+        self._c.execute(
+            "UPDATE batch_aggregations SET state = 'collected'"
+            " WHERE task_id = ? AND batch_identifier = ? AND aggregation_parameter = ?",
+            (task_id.data, batch_identifier, agg_param),
+        )
+
+    def delete_expired_batch_aggregations(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
+        cur = self._c.execute(
+            "DELETE FROM batch_aggregations WHERE (task_id, batch_identifier, aggregation_parameter, ord) IN ("
+            " SELECT task_id, batch_identifier, aggregation_parameter, ord FROM batch_aggregations"
+            " WHERE task_id = ? AND client_interval_start + client_interval_duration < ? LIMIT ?)",
+            (task_id.data, cutoff.seconds, limit),
+        )
+        return cur.rowcount
+
+    # ---- collection jobs (reference datastore.rs:2456-3019) ----
+    def put_collection_job(self, job: CollectionJobModel) -> None:
+        self._c.execute(
+            "INSERT INTO collection_jobs (task_id, collection_job_id, query, aggregation_parameter,"
+            " batch_identifier, state) VALUES (?,?,?,?,?,?)",
+            (
+                job.task_id.data,
+                job.collection_job_id.data,
+                job.query,
+                job.aggregation_parameter,
+                job.batch_identifier,
+                job.state.value,
+            ),
+        )
+
+    def get_collection_job(
+        self, task_id: TaskId, collection_job_id: CollectionJobId
+    ) -> CollectionJobModel | None:
+        row = self._c.execute(
+            "SELECT query, aggregation_parameter, batch_identifier, state, report_count,"
+            " client_interval_start, client_interval_duration, leader_aggregate_share,"
+            " helper_encrypted_aggregate_share FROM collection_jobs"
+            " WHERE task_id = ? AND collection_job_id = ?",
+            (task_id.data, collection_job_id.data),
+        ).fetchone()
+        if row is None:
+            return None
+        row_key = task_id.data + collection_job_id.data
+        las = (
+            self._crypter.decrypt("collection_jobs", row_key, "leader_aggregate_share", row[7])
+            if row[7]
+            else None
+        )
+        return CollectionJobModel(
+            task_id,
+            collection_job_id,
+            row[0],
+            row[1],
+            row[2],
+            CollectionJobState(row[3]),
+            row[4],
+            Interval(Time(row[5]), Duration(row[6])) if row[5] is not None else None,
+            las,
+            row[8],
+        )
+
+    def find_collection_job_by_query(self, task_id: TaskId, query: bytes) -> CollectionJobModel | None:
+        """Idempotent collection-job creation (reference aggregator.rs:2233)."""
+        row = self._c.execute(
+            "SELECT collection_job_id FROM collection_jobs WHERE task_id = ? AND query = ?",
+            (task_id.data, query),
+        ).fetchone()
+        return self.get_collection_job(task_id, CollectionJobId(row[0])) if row else None
+
+    def update_collection_job(self, job: CollectionJobModel) -> None:
+        row_key = job.task_id.data + job.collection_job_id.data
+        las = (
+            self._crypter.encrypt(
+                "collection_jobs", row_key, "leader_aggregate_share", job.leader_aggregate_share
+            )
+            if job.leader_aggregate_share
+            else None
+        )
+        self._c.execute(
+            "UPDATE collection_jobs SET state = ?, report_count = ?, client_interval_start = ?,"
+            " client_interval_duration = ?, leader_aggregate_share = ?, helper_encrypted_aggregate_share = ?"
+            " WHERE task_id = ? AND collection_job_id = ?",
+            (
+                job.state.value,
+                job.report_count,
+                job.client_timestamp_interval.start.seconds if job.client_timestamp_interval else None,
+                job.client_timestamp_interval.duration.seconds if job.client_timestamp_interval else None,
+                las,
+                job.helper_encrypted_aggregate_share,
+                job.task_id.data,
+                job.collection_job_id.data,
+            ),
+        )
+
+    def acquire_incomplete_collection_jobs(
+        self, lease_duration: Duration, limit: int
+    ) -> list[AcquiredCollectionJob]:
+        """reference datastore.rs:2853."""
+        now = self._clock.now().seconds
+        rows = self._c.execute(
+            "SELECT task_id, collection_job_id FROM collection_jobs"
+            " WHERE state = 'collectable' AND lease_expiry <= ?"
+            " ORDER BY lease_expiry LIMIT ?",
+            (now, limit),
+        ).fetchall()
+        out = []
+        for task_id, cj_id in rows:
+            token = secrets.token_bytes(16)
+            cur = self._c.execute(
+                "UPDATE collection_jobs SET lease_expiry = ?, lease_token = ?,"
+                " lease_attempts = lease_attempts + 1"
+                " WHERE task_id = ? AND collection_job_id = ? AND state = 'collectable' AND lease_expiry <= ?"
+                " RETURNING lease_attempts",
+                (now + lease_duration.seconds, token, task_id, cj_id, now),
+            ).fetchone()
+            if cur is not None:
+                out.append(
+                    AcquiredCollectionJob(
+                        TaskId(task_id),
+                        CollectionJobId(cj_id),
+                        Lease(token, Time(now + lease_duration.seconds), cur[0]),
+                    )
+                )
+        return out
+
+    def release_collection_job(self, acquired: AcquiredCollectionJob) -> None:
+        cur = self._c.execute(
+            "UPDATE collection_jobs SET lease_expiry = 0, lease_token = NULL, lease_attempts = 0"
+            " WHERE task_id = ? AND collection_job_id = ? AND lease_token = ?",
+            (acquired.task_id.data, acquired.collection_job_id.data, acquired.lease.token),
+        )
+        if cur.rowcount != 1:
+            raise TxConflict("lease token mismatch on release")
+
+    # ---- aggregate share jobs (reference datastore.rs:3369-3706) ----
+    def put_aggregate_share_job(self, job: AggregateShareJob) -> None:
+        row_key = job.task_id.data + job.batch_identifier
+        share = self._crypter.encrypt(
+            "aggregate_share_jobs", row_key, "helper_aggregate_share", job.helper_aggregate_share
+        )
+        self._c.execute(
+            "INSERT INTO aggregate_share_jobs (task_id, batch_identifier, aggregation_parameter,"
+            " helper_aggregate_share, report_count, checksum) VALUES (?,?,?,?,?,?)",
+            (
+                job.task_id.data,
+                job.batch_identifier,
+                job.aggregation_parameter,
+                share,
+                job.report_count,
+                job.checksum.data,
+            ),
+        )
+
+    def get_aggregate_share_job(
+        self, task_id: TaskId, batch_identifier: bytes, agg_param: bytes
+    ) -> AggregateShareJob | None:
+        row = self._c.execute(
+            "SELECT helper_aggregate_share, report_count, checksum FROM aggregate_share_jobs"
+            " WHERE task_id = ? AND batch_identifier = ? AND aggregation_parameter = ?",
+            (task_id.data, batch_identifier, agg_param),
+        ).fetchone()
+        if row is None:
+            return None
+        row_key = task_id.data + batch_identifier
+        return AggregateShareJob(
+            task_id,
+            batch_identifier,
+            agg_param,
+            self._crypter.decrypt("aggregate_share_jobs", row_key, "helper_aggregate_share", row[0]),
+            row[1],
+            ReportIdChecksum(row[2]),
+        )
+
+    def count_aggregate_share_jobs_for_batch(self, task_id: TaskId, batch_identifier: bytes) -> int:
+        return self._c.execute(
+            "SELECT COUNT(*) FROM aggregate_share_jobs WHERE task_id = ? AND batch_identifier = ?",
+            (task_id.data, batch_identifier),
+        ).fetchone()[0]
+
+    # ---- batches (reference datastore.rs:3944-4161) ----
+    def put_batch(self, batch: Batch) -> None:
+        self._c.execute(
+            "INSERT INTO batches (task_id, batch_identifier, aggregation_parameter, state,"
+            " outstanding_aggregation_jobs, client_interval_start, client_interval_duration)"
+            " VALUES (?,?,?,?,?,?,?)",
+            (
+                batch.task_id.data,
+                batch.batch_identifier,
+                batch.aggregation_parameter,
+                batch.state.value,
+                batch.outstanding_aggregation_jobs,
+                batch.client_timestamp_interval.start.seconds,
+                batch.client_timestamp_interval.duration.seconds,
+            ),
+        )
+
+    def get_batch(
+        self, task_id: TaskId, batch_identifier: bytes, agg_param: bytes
+    ) -> Batch | None:
+        row = self._c.execute(
+            "SELECT state, outstanding_aggregation_jobs, client_interval_start,"
+            " client_interval_duration FROM batches"
+            " WHERE task_id = ? AND batch_identifier = ? AND aggregation_parameter = ?",
+            (task_id.data, batch_identifier, agg_param),
+        ).fetchone()
+        if row is None:
+            return None
+        return Batch(
+            task_id,
+            batch_identifier,
+            agg_param,
+            BatchState(row[0]),
+            row[1],
+            Interval(Time(row[2]), Duration(row[3])),
+        )
+
+    def update_batch(self, batch: Batch) -> None:
+        self._c.execute(
+            "UPDATE batches SET state = ?, outstanding_aggregation_jobs = ?,"
+            " client_interval_start = ?, client_interval_duration = ?"
+            " WHERE task_id = ? AND batch_identifier = ? AND aggregation_parameter = ?",
+            (
+                batch.state.value,
+                batch.outstanding_aggregation_jobs,
+                batch.client_timestamp_interval.start.seconds,
+                batch.client_timestamp_interval.duration.seconds,
+                batch.task_id.data,
+                batch.batch_identifier,
+                batch.aggregation_parameter,
+            ),
+        )
+
+    # ---- outstanding batches (reference datastore.rs:3707-3943) ----
+    def put_outstanding_batch(self, ob: OutstandingBatch) -> None:
+        self._c.execute(
+            "INSERT INTO outstanding_batches (task_id, batch_id, time_bucket_start) VALUES (?,?,?)",
+            (
+                ob.task_id.data,
+                ob.batch_id.data,
+                ob.time_bucket_start.seconds if ob.time_bucket_start else None,
+            ),
+        )
+
+    def get_outstanding_batches(
+        self, task_id: TaskId, time_bucket_start: Time | None = None
+    ) -> list[OutstandingBatch]:
+        if time_bucket_start is None:
+            rows = self._c.execute(
+                "SELECT batch_id, time_bucket_start FROM outstanding_batches"
+                " WHERE task_id = ? AND filled = 0",
+                (task_id.data,),
+            ).fetchall()
+        else:
+            rows = self._c.execute(
+                "SELECT batch_id, time_bucket_start FROM outstanding_batches"
+                " WHERE task_id = ? AND filled = 0 AND time_bucket_start = ?",
+                (task_id.data, time_bucket_start.seconds),
+            ).fetchall()
+        return [
+            OutstandingBatch(task_id, BatchId(r[0]), Time(r[1]) if r[1] is not None else None)
+            for r in rows
+        ]
+
+    def mark_outstanding_batch_filled(self, task_id: TaskId, batch_id: BatchId) -> None:
+        self._c.execute(
+            "UPDATE outstanding_batches SET filled = 1 WHERE task_id = ? AND batch_id = ?",
+            (task_id.data, batch_id.data),
+        )
+
+    # ---- global HPKE keys (reference datastore.rs:4316-4435) ----
+    def put_global_hpke_keypair(self, keypair, state: str = "pending") -> None:
+        row_key = bytes([keypair.config.id.id])
+        enc = self._crypter.encrypt("global_hpke_keys", row_key, "private_key", keypair.private_key)
+        self._c.execute(
+            "INSERT INTO global_hpke_keys (config_id, config, private_key, state, updated_at)"
+            " VALUES (?,?,?,?,?)",
+            (keypair.config.id.id, keypair.config.to_bytes(), enc, state, self._clock.now().seconds),
+        )
+
+    def get_global_hpke_keypairs(self) -> list[tuple]:
+        """[(HpkeKeypair, state)] — import deferred to avoid cycles."""
+        from ..core.hpke import HpkeKeypair
+        from ..messages import HpkeConfig
+
+        out = []
+        for cid, cfg, sk, state in self._c.execute(
+            "SELECT config_id, config, private_key, state FROM global_hpke_keys"
+        ):
+            row_key = bytes([cid])
+            out.append(
+                (
+                    HpkeKeypair(
+                        HpkeConfig.from_bytes(cfg),
+                        self._crypter.decrypt("global_hpke_keys", row_key, "private_key", sk),
+                    ),
+                    state,
+                )
+            )
+        return out
+
+    def set_global_hpke_keypair_state(self, config_id: int, state: str) -> None:
+        self._c.execute(
+            "UPDATE global_hpke_keys SET state = ?, updated_at = ? WHERE config_id = ?",
+            (state, self._clock.now().seconds, config_id),
+        )
+
+    def delete_global_hpke_keypair(self, config_id: int) -> None:
+        self._c.execute("DELETE FROM global_hpke_keys WHERE config_id = ?", (config_id,))
+
+    # ---- GC (reference datastore.rs:4162-4315) ----
+    def delete_expired_aggregation_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
+        rows = self._c.execute(
+            "SELECT job_id FROM aggregation_jobs WHERE task_id = ?"
+            " AND client_interval_start + client_interval_duration < ? LIMIT ?",
+            (task_id.data, cutoff.seconds, limit),
+        ).fetchall()
+        n = 0
+        for (job_id,) in rows:
+            self._c.execute(
+                "DELETE FROM report_aggregations WHERE task_id = ? AND job_id = ?",
+                (task_id.data, job_id),
+            )
+            cur = self._c.execute(
+                "DELETE FROM aggregation_jobs WHERE task_id = ? AND job_id = ?",
+                (task_id.data, job_id),
+            )
+            n += cur.rowcount
+        return n
+
+    def delete_expired_collection_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
+        # aggregate_share_jobs carry no client-time column in this schema;
+        # they are removed with the task (delete_task), matching the row
+        # budget the reference applies per GC pass.
+        return self._c.execute(
+            "DELETE FROM collection_jobs WHERE (task_id, collection_job_id) IN ("
+            " SELECT task_id, collection_job_id FROM collection_jobs"
+            " WHERE task_id = ? AND client_interval_start IS NOT NULL"
+            " AND client_interval_start + client_interval_duration < ? LIMIT ?)",
+            (task_id.data, cutoff.seconds, limit),
+        ).rowcount
+
+
+class Datastore:
+    """Connection manager + transaction runner (reference datastore.rs:107)."""
+
+    MAX_RETRIES = 16
+
+    def __init__(self, path: str, crypter: Crypter, clock):
+        self._path = path
+        self._crypter = crypter
+        self._clock = clock
+        self._local = threading.local()
+        conn = self._connect()
+        with conn:
+            conn.executescript(_SCHEMA)
+            row = conn.execute("SELECT version FROM schema_version").fetchone()
+            if row is None:
+                conn.execute("INSERT INTO schema_version (version) VALUES (?)", (SCHEMA_VERSION,))
+            elif row[0] != SCHEMA_VERSION:
+                # reference: supported_schema_versions! check (datastore.rs:103)
+                raise RuntimeError(f"unsupported schema version {row[0]}")
+
+    def _connect(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30.0, uri=self._path.startswith("file:"))
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            conn.execute("PRAGMA foreign_keys=ON")
+            self._local.conn = conn
+        return conn
+
+    def run_tx(self, fn, name: str = "tx"):
+        """Run fn(Transaction) with retry on busy/conflict
+        (reference run_tx_with_name, datastore.rs:216-242)."""
+        for attempt in range(self.MAX_RETRIES):
+            conn = self._connect()
+            try:
+                conn.execute("BEGIN IMMEDIATE")
+                tx = Transaction(conn, self._crypter, self._clock)
+                result = fn(tx)
+                conn.commit()
+                return result
+            except (sqlite3.OperationalError, TxConflict) as e:
+                conn.rollback()
+                if attempt == self.MAX_RETRIES - 1:
+                    raise
+                _time.sleep(0.002 * (1 << min(attempt, 6)))
+            except BaseException:
+                conn.rollback()
+                raise
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+class EphemeralDatastore:
+    """Per-test datastore on a temp file (the analog of the reference's
+    ephemeral postgres testcontainer, datastore/test_util.rs:26-120)."""
+
+    def __init__(self, clock=None, crypter: Crypter | None = None):
+        from ..core.time_util import MockClock
+
+        self._dir = tempfile.TemporaryDirectory(prefix="janus-tpu-ds-")
+        self.clock = clock if clock is not None else MockClock()
+        self.crypter = crypter or Crypter()
+        self.datastore = Datastore(
+            os.path.join(self._dir.name, "ds.sqlite"), self.crypter, self.clock
+        )
+
+    def cleanup(self) -> None:
+        self.datastore.close()
+        self._dir.cleanup()

@@ -1,0 +1,5 @@
+"""Multi-chip parallelism: device meshes + sharded aggregation steps."""
+
+from .api import helper_init_step, jit_two_party_step, make_mesh, two_party_step
+
+__all__ = ["make_mesh", "two_party_step", "helper_init_step", "jit_two_party_step"]

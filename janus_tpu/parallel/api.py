@@ -1,0 +1,121 @@
+"""Sharded VDAF aggregation over a jax.sharding.Mesh.
+
+The reference scales horizontally with DB-leased worker replicas and
+rayon threads inside `prio` (SURVEY.md section 2.10). The TPU-native
+equivalents built here:
+
+  - **dp** (data parallel): the report batch axis. Reports are
+    independent, so prepare/accumulate shards trivially; the final
+    accumulate is a tree-reduce that XLA lowers to an all-reduce over
+    ICI (the analog of the reference's batch_aggregation_shard_count
+    write-sharding, accumulator.rs:92 — shards here are devices).
+  - **sp** (vector parallel): the measurement-vector axis for large
+    SumVec/Histogram tasks — the structural analog of sequence/context
+    parallelism (SURVEY.md section 5 "Long-context"): out-share columns
+    live sharded across devices and are only gathered at collection
+    time.
+
+No NCCL/MPI translation: shardings are declared with NamedSharding and
+XLA inserts the collectives (scaling-book recipe: pick a mesh, annotate
+shardings, let XLA do the rest).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..vdaf.registry import VdafInstance, prio3_batched
+
+
+def make_mesh(dp: int, sp: int = 1, devices=None) -> Mesh:
+    """A (dp, sp) device mesh; dp*sp must equal the device count used."""
+    if devices is None:
+        devices = jax.devices()
+    n = dp * sp
+    assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+    arr = np.array(devices[:n]).reshape(dp, sp)
+    return Mesh(arr, axis_names=("dp", "sp"))
+
+
+def two_party_step(inst: VdafInstance, verify_key: bytes):
+    """The full two-party device step over one report batch.
+
+    Returns a pure function (jit it, or use jit_two_party_step to bind
+    a mesh) mapping column-batched report arrays to both aggregate
+    shares + the accepted-report count. This is the framework's
+    "training step": everything the reference does per report in
+    leader_initialized + helper_initialized + ping-pong finish +
+    accumulate (aggregation_job_driver.rs:329-402,530-726;
+    aggregator.rs:1775-1826), fused into one traced computation.
+    """
+    p3 = prio3_batched(inst)
+
+    def step(nonce_lanes, public_parts, leader_meas, leader_proof, blind0, helper_seed, blind1):
+        out0, seed0, ver0, part0 = p3.prepare_init_leader(
+            verify_key, nonce_lanes, public_parts, leader_meas, leader_proof, blind0
+        )
+        out1, seed1, ver1, part1 = p3.prepare_init_helper(
+            verify_key, nonce_lanes, public_parts, helper_seed, blind1
+        )
+        mask, prep_msg = p3.prep_shares_to_prep(ver0, ver1, part0, part1)
+        mask = p3.prepare_finish(seed0, prep_msg, mask)
+        mask = p3.prepare_finish(seed1, prep_msg, mask)
+        agg0 = p3.aggregate(out0, mask)
+        agg1 = p3.aggregate(out1, mask)
+        count = mask.sum()
+        return agg0, agg1, count
+
+    return step
+
+
+def helper_init_step(inst: VdafInstance, verify_key: bytes):
+    """Helper-side prepare_init only (the serving hot path,
+    aggregator.rs:1775-1797): seeds in, verifier share + out share out."""
+    p3 = prio3_batched(inst)
+
+    def step(nonce_lanes, public_parts, helper_seed, blind1):
+        out1, seed1, ver1, part1 = p3.prepare_init_helper(
+            verify_key, nonce_lanes, public_parts, helper_seed, blind1
+        )
+        return out1, seed1, ver1, part1
+
+    return step
+
+
+def _field_spec(mesh, jf, batch_spec, tail_spec):
+    return tuple(NamedSharding(mesh, P(batch_spec, tail_spec)) for _ in range(jf.LIMBS))
+
+
+def jit_two_party_step(inst: VdafInstance, verify_key: bytes, mesh: Mesh):
+    """jit the two-party step with report-batch sharding over 'dp' and
+    vector sharding over 'sp'; aggregate shares come back replicated
+    (XLA inserts the ICI all-reduce for the masked accumulate)."""
+    p3 = prio3_batched(inst)
+    jf = p3.jf
+    dp = NamedSharding(mesh, P("dp"))
+    dp2 = NamedSharding(mesh, P("dp", None))
+    dp3 = NamedSharding(mesh, P("dp", None, None))
+    meas_sh = _field_spec(mesh, jf, "dp", "sp")
+    proof_sh = _field_spec(mesh, jf, "dp", None)
+    rep_vec = tuple(NamedSharding(mesh, P("sp")) for _ in range(jf.LIMBS))
+    rep = NamedSharding(mesh, P())
+
+    in_shardings = (
+        dp2,  # nonce lanes
+        dp3 if p3.uses_joint_rand else None,  # public parts
+        meas_sh,  # leader meas
+        proof_sh,  # leader proof
+        dp2 if p3.uses_joint_rand else None,  # blind0
+        dp2,  # helper seed
+        dp2 if p3.uses_joint_rand else None,  # blind1
+    )
+    out_shardings = (rep_vec, rep_vec, rep)
+    return jax.jit(
+        two_party_step(inst, verify_key),
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+    )

@@ -57,7 +57,7 @@ def _rotl(x, r: int):
     return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
 
 
-def keccak_f1600(state: list):
+def keccak_f1600(state):
     """One permutation. state: 25 u64 arrays (lane (x,y) at index x + 5*y)."""
     a = list(state)
     for rnd in range(24):
@@ -78,7 +78,18 @@ def keccak_f1600(state: list):
         ]
         # iota
         a[0] = a[0] ^ _RC[rnd]
-    return a
+    return tuple(a)
+
+
+def _absorb_block(state, block_lanes):
+    """XOR one rate block ([batch, 21]) into the state and permute."""
+    state = list(state)
+    for lane in range(RATE_LANES):
+        state[lane] = state[lane] ^ block_lanes[:, lane]
+    return keccak_f1600(state)
+
+
+_UNROLL_BLOCKS = 4  # small messages stay unrolled; long ones lax.scan
 
 
 def shake128_squeeze_lanes(msg_lanes, out_blocks: int):
@@ -87,20 +98,35 @@ def shake128_squeeze_lanes(msg_lanes, out_blocks: int):
     msg_lanes: [batch, n_blocks, 21] u64 — the message already padded to
     whole rate blocks (use pad_message_lanes). Returns
     [batch, out_blocks, 21] u64 of output stream lanes.
+
+    Absorb/squeeze are lax.scan loops over blocks (the permutation is
+    inherently sequential per report), so the traced graph stays O(1)
+    in stream length — a SumVec-100k share expansion is ~1.5k blocks
+    and must not unroll.
     """
     batch = msg_lanes.shape[0]
     n_blocks = msg_lanes.shape[1]
-    state = [jnp.zeros((batch,), dtype=U64) for _ in range(25)]
-    for blk in range(n_blocks):
-        for lane in range(RATE_LANES):
-            state[lane] = state[lane] ^ msg_lanes[:, blk, lane]
-        state = keccak_f1600(state)
-    outs = []
-    for blk in range(out_blocks):
-        if blk > 0:
-            state = keccak_f1600(state)
-        outs.append(jnp.stack(state[:RATE_LANES], axis=-1))
-    return jnp.stack(outs, axis=1)
+    state = tuple(jnp.zeros((batch,), dtype=U64) for _ in range(25))
+    if n_blocks <= _UNROLL_BLOCKS:
+        for blk in range(n_blocks):
+            state = _absorb_block(state, msg_lanes[:, blk])
+    else:
+        xs = jnp.moveaxis(msg_lanes, 1, 0)  # [n_blocks, batch, 21]
+        state, _ = jax.lax.scan(lambda st, blk: (_absorb_block(st, blk), None), state, xs)
+    if out_blocks <= _UNROLL_BLOCKS:
+        outs = []
+        for blk in range(out_blocks):
+            if blk > 0:
+                state = keccak_f1600(state)
+            outs.append(jnp.stack(state[:RATE_LANES], axis=-1))
+        return jnp.stack(outs, axis=1)
+
+    def squeeze(st, _):
+        ys = jnp.stack(st[:RATE_LANES], axis=-1)
+        return keccak_f1600(st), ys
+
+    _, ys = jax.lax.scan(squeeze, state, None, length=out_blocks)
+    return jnp.moveaxis(ys, 0, 1)
 
 
 def pad_message_lanes(parts, msg_len_bytes: int, batch: int):
@@ -108,28 +134,39 @@ def pad_message_lanes(parts, msg_len_bytes: int, batch: int):
 
     parts: list of (lane_offset, lanes) where lanes is a [batch, k] u64
     array (dynamic content) or a host bytes object of length 8*k (static
-    content). msg_len_bytes must be a multiple of 8 (guaranteed by the
-    lane-aligned stream framing in janus_tpu.vdaf.xof).
+    content), in ascending offset order (gaps are zero-filled).
+    msg_len_bytes must be a multiple of 8 (guaranteed by the
+    lane-aligned stream framing in janus_tpu.vdaf.xof). Assembly is
+    whole-array concatenation so the traced graph stays O(#parts), not
+    O(message length).
     """
     assert msg_len_bytes % 8 == 0
     msg_lanes_n = msg_len_bytes // 8
     n_blocks = msg_lanes_n // RATE_LANES + 1  # always room for padding
     total = n_blocks * RATE_LANES
-    cols = [jnp.zeros((batch,), dtype=U64)] * total
-    for off, content in parts:
+    segs = []
+    pos = 0
+    for off, content in sorted(parts, key=lambda p: p[0]):
+        assert off >= pos, "overlapping message parts"
+        if off > pos:
+            segs.append(jnp.zeros((batch, off - pos), dtype=U64))
+            pos = off
         if isinstance(content, (bytes, bytearray)):
             assert len(content) % 8 == 0
-            for i in range(len(content) // 8):
-                v = int.from_bytes(content[8 * i : 8 * i + 8], "little")
-                cols[off + i] = jnp.full((batch,), np.uint64(v), dtype=U64)
+            lanes = np.frombuffer(bytes(content), dtype="<u8").astype(np.uint64)
+            segs.append(jnp.broadcast_to(jnp.asarray(lanes), (batch, lanes.size)))
+            pos += lanes.size
         else:
-            for i in range(content.shape[-1]):
-                cols[off + i] = content[:, i].astype(U64)
-    # SHAKE padding: 0x1F at msg end, 0x80 at last byte of the block
-    pad_lane = msg_lanes_n
-    cols[pad_lane] = cols[pad_lane] ^ np.uint64(0x1F)
-    cols[total - 1] = cols[total - 1] ^ np.uint64(0x80 << 56)
-    lanes = jnp.stack(cols, axis=-1)
+            segs.append(content.astype(U64))
+            pos += content.shape[-1]
+    assert pos <= msg_lanes_n
+    # zero fill to message end, then SHAKE padding: 0x1F at msg end,
+    # 0x80 at the last byte of the last block (may share a lane).
+    tail = np.zeros(total - pos, dtype=np.uint64)
+    tail[msg_lanes_n - pos] ^= np.uint64(0x1F)
+    tail[-1] ^= np.uint64(0x80) << np.uint64(56)
+    segs.append(jnp.broadcast_to(jnp.asarray(tail), (batch, tail.size)))
+    lanes = jnp.concatenate(segs, axis=1)
     return lanes.reshape(batch, n_blocks, RATE_LANES)
 
 

@@ -1,0 +1,108 @@
+"""VDAF instance registry + dispatch.
+
+Equivalent of the reference's `VdafInstance` enum and `vdaf_dispatch!`
+macro (core/src/task.rs:24-650): a serializable description of a VDAF
+configuration that resolves to concrete host/device implementations.
+A table lookup replaces the Rust macro (SURVEY.md section 7 step 3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+from .prio3_jax import Prio3Batched
+from .reference import (
+    Circuit,
+    Count,
+    Histogram,
+    Prio3,
+    Sum,
+    SumVec,
+    optimal_chunk_length,
+)
+
+VERIFY_KEY_LENGTH = 16  # reference core/src/task.rs:15
+
+
+@dataclass(frozen=True)
+class VdafInstance:
+    """One VDAF configuration; hashable so dispatch results are cached."""
+
+    kind: str  # "count" | "sum" | "sumvec" | "histogram"
+    bits: int = 0
+    length: int = 0
+    chunk_length: int = 0  # 0 -> sqrt heuristic (core/src/task.rs:84-86)
+
+    # --- constructors mirroring the reference enum variants ---
+    @classmethod
+    def count(cls) -> "VdafInstance":
+        return cls("count")
+
+    @classmethod
+    def sum(cls, bits: int) -> "VdafInstance":
+        return cls("sum", bits=bits)
+
+    @classmethod
+    def sum_vec(cls, length: int, bits: int, chunk_length: int = 0) -> "VdafInstance":
+        return cls("sumvec", bits=bits, length=length, chunk_length=chunk_length)
+
+    @classmethod
+    def histogram(cls, length: int, chunk_length: int = 0) -> "VdafInstance":
+        return cls("histogram", length=length, chunk_length=chunk_length)
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind}
+        for k in ("bits", "length", "chunk_length"):
+            if getattr(self, k):
+                d[k] = getattr(self, k)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VdafInstance":
+        return cls(
+            d["kind"],
+            bits=d.get("bits", 0),
+            length=d.get("length", 0),
+            chunk_length=d.get("chunk_length", 0),
+        )
+
+
+@lru_cache(maxsize=None)
+def circuit_for(inst: VdafInstance) -> Circuit:
+    ch = inst.chunk_length or None
+    if inst.kind == "count":
+        return Count()
+    if inst.kind == "sum":
+        return Sum(bits=inst.bits)
+    if inst.kind == "sumvec":
+        return SumVec(length=inst.length, bits=inst.bits, chunk_length=ch)
+    if inst.kind == "histogram":
+        return Histogram(length=inst.length, chunk_length=ch)
+    raise ValueError(f"unknown VDAF kind {inst.kind!r}")
+
+
+@lru_cache(maxsize=None)
+def prio3_host(inst: VdafInstance) -> Prio3:
+    """Host (scalar) implementation: clients, tools, oracles."""
+    return Prio3(circuit_for(inst))
+
+
+@lru_cache(maxsize=None)
+def prio3_batched(inst: VdafInstance) -> Prio3Batched:
+    """Device (batched) implementation: the aggregator hot path.
+
+    Cached so repeated dispatch returns the identical instance and jit
+    caches keyed on it never recompile.
+    """
+    return Prio3Batched(circuit_for(inst))
+
+
+__all__ = [
+    "VERIFY_KEY_LENGTH",
+    "VdafInstance",
+    "circuit_for",
+    "prio3_host",
+    "prio3_batched",
+    "optimal_chunk_length",
+]

@@ -1,0 +1,55 @@
+"""Report-batch generation utilities (tests, benchmarks, load drivers).
+
+The analog of the reference's transcript generator
+(core/src/test_util/mod.rs:50 run_vdaf) adapted to column batches:
+produce every array the two-party device step consumes, via the
+batched device shard (so generating 1M reports is itself a device op).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import VdafInstance, prio3_batched
+
+
+def random_measurements(inst: VdafInstance, batch: int, rng: np.random.Generator):
+    if inst.kind == "count":
+        return rng.integers(0, 2, size=batch)
+    if inst.kind == "sum":
+        hi = min(inst.bits, 62)
+        return rng.integers(0, 1 << hi, size=batch)
+    if inst.kind == "sumvec":
+        hi = min(inst.bits, 62)
+        return rng.integers(0, 1 << hi, size=(batch, inst.length))
+    if inst.kind == "histogram":
+        return rng.integers(0, inst.length, size=batch)
+    raise ValueError(inst.kind)
+
+
+def make_report_batch(inst: VdafInstance, measurements, seed: int = 0):
+    """Shard a batch of measurements on device.
+
+    Returns (step_args, measurements) where step_args is the positional
+    tuple for parallel.api.two_party_step: (nonce_lanes, public_parts,
+    leader_meas, leader_proof, blind0, helper_seed, blind1).
+    """
+    p3 = prio3_batched(inst)
+    rng = np.random.default_rng(seed)
+    batch = len(measurements)
+    inp_np = p3.bc.encode_batch(measurements)
+    inp = p3.jf.from_ints(inp_np.astype(object))
+    nonce_lanes = rng.integers(0, 1 << 63, size=(batch, 2), dtype=np.uint64)
+    n_seeds = 4 if p3.uses_joint_rand else 2
+    rand_lanes = rng.integers(0, 1 << 63, size=(batch, n_seeds, 2), dtype=np.uint64)
+    sh = p3.shard(inp, nonce_lanes, rand_lanes)
+    args = (
+        nonce_lanes,
+        sh["public_parts"],
+        sh["leader_meas"],
+        sh["leader_proof"],
+        sh["blind0"],
+        sh["helper_seed"],
+        sh["blind1"],
+    )
+    return args, measurements
