@@ -1,0 +1,19 @@
+"""Aggregator: protocol handlers, job runners, HTTP shell.
+
+Equivalent of reference aggregator/src/ (SURVEY.md section 2.5): the
+per-request protocol brain (core.py), device-batch execution cache
+(engine_cache.py), accumulator, job drivers (aggregation_job_driver,
+collection_job_driver) over the generic lease JobDriver, the
+aggregation-job creator, garbage collector, and the DAP HTTP layer
+(http_handlers.py).
+
+Execution model change vs the reference: everywhere the reference
+iterates per report calling scalar field math, these handlers stage
+columnar batches and invoke one jitted device computation
+(SURVEY.md section 7 "Architecture stance").
+"""
+
+from .core import Aggregator, Config
+from .errors import AggregatorError
+
+__all__ = ["Aggregator", "Config", "AggregatorError"]

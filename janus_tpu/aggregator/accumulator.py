@@ -1,0 +1,156 @@
+"""Accumulator: merge verified output shares into sharded batch rows.
+
+Equivalent of reference aggregator/src/aggregator/accumulator.rs: an
+in-memory map batch-identifier -> (aggregate share, report count,
+checksum, client interval), flushed in the writing transaction to a
+random shard row 0..shard_count (contention control; accumulator.rs:92)
+with unique-violation converted into a retryable conflict
+(accumulator.rs:173-199).
+
+Difference from the reference: the per-batch share here arrives as one
+already-reduced device vector per (job, batch bucket) — the device did
+the per-report summation (masked tree reduce) — so the host only
+merges a handful of vectors per job, not one per report.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from ..messages import Duration, Interval, ReportIdChecksum, TaskId, Time
+from ..task import Task
+from ..vdaf.registry import circuit_for
+from .errors import AggregatorError
+from ..datastore.models import BatchAggregation, BatchAggregationState
+
+
+def add_encoded_aggregate_shares(field, a: bytes | None, b: bytes | None) -> bytes | None:
+    """Element-wise mod-p sum of two encoded field vectors."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    va = field.decode_vec(a)
+    vb = field.decode_vec(b)
+    assert len(va) == len(vb)
+    return field.encode_vec([field.add(x, y) for x, y in zip(va, vb)])
+
+
+def accumulate_batched(task, engine, accumulator: "Accumulator", out_shares, accept, metadatas) -> None:
+    """Group accepted lanes by batch bucket; one masked device reduce per
+    bucket (replaces the reference's per-report Accumulator::update loop,
+    accumulator.rs:76-122)."""
+    import numpy as np
+
+    from ..messages import Interval
+
+    n = len(metadatas)
+    if n == 0:
+        return
+    field = accumulator.field
+    buckets: dict[bytes, list[int]] = {}
+    for i, md in enumerate(metadatas):
+        if not accept[i]:
+            continue
+        start = md.time.to_batch_interval_start(task.time_precision)
+        bid = Interval(start, task.time_precision).to_bytes()
+        buckets.setdefault(bid, []).append(i)
+    for bid, lanes in buckets.items():
+        bucket_mask = np.zeros(n, dtype=bool)
+        bucket_mask[lanes] = True
+        share_ints = engine.aggregate(out_shares, bucket_mask)
+        checksum = ReportIdChecksum()
+        lo = hi = None
+        for i in lanes:
+            checksum = checksum.updated_with(metadatas[i].report_id)
+            t = metadatas[i].time
+            lo = t if lo is None or t < lo else lo
+            hi = t if hi is None or t > hi else hi
+        interval = Interval(lo.to_batch_interval_start(task.time_precision), task.time_precision)
+        accumulator.update(bid, field.encode_vec(share_ints), len(lanes), checksum, interval)
+
+
+class Accumulator:
+    """reference accumulator.rs:32."""
+
+    def __init__(self, task: Task, shard_count: int = 1):
+        self.task = task
+        self.field = circuit_for(task.vdaf).FIELD
+        self.shard_count = shard_count
+        # batch_identifier bytes -> [share bytes | None, count, checksum, interval | None]
+        self._state: dict[bytes, list] = {}
+
+    def update(
+        self,
+        batch_identifier: bytes,
+        aggregate_share: bytes | None,
+        report_count: int,
+        checksum: ReportIdChecksum,
+        client_interval: Interval,
+    ) -> None:
+        """Merge one already-reduced contribution (device output)."""
+        ent = self._state.get(batch_identifier)
+        if ent is None:
+            self._state[batch_identifier] = [aggregate_share, report_count, checksum, client_interval]
+            return
+        ent[0] = add_encoded_aggregate_shares(self.field, ent[0], aggregate_share)
+        ent[1] += report_count
+        ent[2] = ent[2].combined_with(checksum)
+        ent[3] = Interval.merged(ent[3], client_interval)
+
+    def update_single(self, batch_identifier: bytes, out_share: list[int], report_id, client_time: Time) -> None:
+        """Scalar convenience path (tests, small flows)."""
+        self.update(
+            batch_identifier,
+            self.field.encode_vec(out_share),
+            1,
+            ReportIdChecksum.for_report_id(report_id),
+            Interval(
+                client_time.to_batch_interval_start(self.task.time_precision),
+                self.task.time_precision,
+            ),
+        )
+
+    def flush_to_datastore(self, tx) -> None:
+        """Merge into a random shard row per batch (reference :133-215).
+
+        Raises AggregatorError if a touched batch was already collected
+        (reports must not land in collected batches).
+        """
+        for batch_identifier, (share, count, checksum, interval) in self._state.items():
+            ord_ = secrets.randbelow(self.shard_count)
+            existing = tx.get_batch_aggregation(
+                self.task.task_id, batch_identifier, b"", ord_
+            )
+            if existing is None:
+                tx.put_batch_aggregation(
+                    BatchAggregation(
+                        self.task.task_id,
+                        batch_identifier,
+                        b"",
+                        ord_,
+                        BatchAggregationState.AGGREGATING,
+                        share,
+                        count,
+                        interval,
+                        checksum,
+                    )
+                )
+                continue
+            if existing.state == BatchAggregationState.COLLECTED:
+                raise AggregatorError(
+                    f"batch {batch_identifier.hex()[:16]} already collected"
+                )
+            merged = BatchAggregation(
+                self.task.task_id,
+                batch_identifier,
+                b"",
+                ord_,
+                existing.state,
+                add_encoded_aggregate_shares(self.field, existing.aggregate_share, share),
+                existing.report_count + count,
+                Interval.merged(existing.client_timestamp_interval, interval),
+                existing.checksum.combined_with(checksum),
+            )
+            tx.update_batch_aggregation(merged)
+        self._state.clear()

@@ -1,0 +1,159 @@
+"""Aggregation job creator (leader only).
+
+Equivalent of reference aggregator/src/aggregator/aggregation_job_creator.rs:
+44-705: periodically sweep every leader task, pack unaggregated client
+reports into aggregation jobs of [min, max] size, and create the job +
+report-aggregation rows. Fixed-size tasks additionally assign reports
+to outstanding batches (BatchCreator, batch_creator.rs:32).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..datastore.models import (
+    AggregationJobModel,
+    AggregationJobState,
+    OutstandingBatch,
+    ReportAggregationModel,
+    ReportAggregationState,
+)
+from ..datastore.store import Datastore
+from ..messages import (
+    AggregationJobId,
+    BatchId,
+    Duration,
+    Interval,
+    PartialBatchSelector,
+    Role,
+    Time,
+    TimeInterval,
+)
+from ..task import Task
+
+
+@dataclass
+class AggregationJobCreatorConfig:
+    """reference aggregation_job_creator.rs:65-80."""
+
+    min_aggregation_job_size: int = 1
+    max_aggregation_job_size: int = 1024
+
+
+class AggregationJobCreator:
+    def __init__(self, ds: Datastore, cfg: AggregationJobCreatorConfig | None = None):
+        self.ds = ds
+        self.cfg = cfg or AggregationJobCreatorConfig()
+
+    def run_once(self) -> int:
+        """Sweep all leader tasks once; returns number of jobs created."""
+        tasks = self.ds.run_tx(lambda tx: tx.get_tasks(), "creator_tasks")
+        created = 0
+        for task in tasks:
+            if task.role != Role.LEADER:
+                continue
+            created += self.create_jobs_for_task(task)
+        return created
+
+    def create_jobs_for_task(self, task: Task) -> int:
+        if task.query_type.code == TimeInterval.CODE:
+            return self._create_time_interval_jobs(task)
+        return self._create_fixed_size_jobs(task)
+
+    def _claim(self, task: Task):
+        return self.ds.run_tx(
+            lambda tx: tx.get_unaggregated_client_reports_for_task(
+                task.task_id, self.cfg.max_aggregation_job_size
+            ),
+            "creator_claim",
+        )
+
+    def _create_time_interval_jobs(self, task: Task) -> int:
+        """reference create_aggregation_jobs_for_time_interval_task_no_param
+        (:511)."""
+        created = 0
+        while True:
+            claimed = self._claim(task)
+            if len(claimed) < max(1, self.cfg.min_aggregation_job_size):
+                # too few: release claim, try next sweep (reference keeps
+                # sub-min reports unaggregated)
+                if claimed:
+                    self.ds.run_tx(
+                        lambda tx: tx.mark_reports_unaggregated(
+                            task.task_id, [r for r, _ in claimed]
+                        ),
+                        "creator_release",
+                    )
+                return created
+            self._write_job(task, claimed, PartialBatchSelector.time_interval())
+            created += 1
+            if len(claimed) < self.cfg.max_aggregation_job_size:
+                return created
+
+    def _create_fixed_size_jobs(self, task: Task) -> int:
+        """Greedy batch packing toward max_batch_size (reference
+        batch_creator.rs:140-330, simplified: one outstanding batch per
+        time bucket)."""
+        created = 0
+        max_bs = task.query_type.max_batch_size or self.cfg.max_aggregation_job_size
+        while True:
+            claimed = self._claim(task)
+            if len(claimed) < max(1, self.cfg.min_aggregation_job_size):
+                if claimed:
+                    self.ds.run_tx(
+                        lambda tx: tx.mark_reports_unaggregated(
+                            task.task_id, [r for r, _ in claimed]
+                        ),
+                        "creator_release",
+                    )
+                return created
+
+            def assign(tx):
+                window = task.query_type.batch_time_window_size
+                bucket = (
+                    claimed[0][1].to_batch_interval_start(window) if window else None
+                )
+                obs = tx.get_outstanding_batches(task.task_id, bucket)
+                if obs:
+                    return obs[0].batch_id
+                bid = BatchId(secrets.token_bytes(32))
+                tx.put_outstanding_batch(OutstandingBatch(task.task_id, bid, bucket))
+                return bid
+
+            batch_id = self.ds.run_tx(assign, "creator_fixed_assign")
+            self._write_job(task, claimed, PartialBatchSelector.fixed_size(batch_id))
+            created += 1
+            if len(claimed) >= max_bs:
+                self.ds.run_tx(
+                    lambda tx: tx.mark_outstanding_batch_filled(task.task_id, batch_id),
+                    "creator_fixed_fill",
+                )
+            if len(claimed) < self.cfg.max_aggregation_job_size:
+                return created
+
+    def _write_job(self, task: Task, claimed, pbs: PartialBatchSelector) -> None:
+        job_id = AggregationJobId(secrets.token_bytes(16))
+        times = [t.seconds for _, t in claimed]
+        job = AggregationJobModel(
+            task.task_id,
+            job_id,
+            b"",
+            pbs.to_bytes(),
+            Interval(Time(min(times)), Duration(max(times) - min(times) + 1)),
+            AggregationJobState.IN_PROGRESS,
+            0,
+        )
+        ras = [
+            ReportAggregationModel(
+                task.task_id, job_id, rid, t, i, ReportAggregationState.START
+            )
+            for i, (rid, t) in enumerate(claimed)
+        ]
+
+        def write(tx):
+            tx.put_aggregation_job(job)
+            for ra in ras:
+                tx.put_report_aggregation(ra)
+
+        self.ds.run_tx(write, "creator_write_job")

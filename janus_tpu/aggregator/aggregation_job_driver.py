@@ -1,0 +1,309 @@
+"""Aggregation job driver (leader stepper) — the hot path.
+
+Equivalent of reference aggregator/src/aggregator/aggregation_job_driver.rs:
+49-894: acquire leases, read job + report state, run leader prepare,
+PUT the init request to the helper, process its response, accumulate,
+write back, release. The reference's three per-report loops
+(leader_initialized :329-402, transition evaluation :467-496,
+leader_continued + accumulate :530-726) are each one batched device
+call here.
+
+For the 1-round Prio3 VDAFs the whole job completes in a single step:
+init -> helper responds finish/reject per report -> leader verifies the
+prep message (joint-rand seed equality, host-side lane compare) ->
+masked accumulate. Crash anywhere before the final write leaves the
+job in step 0 with reports in START; the re-acquired lease replays the
+init idempotently (helper request-hash dedup).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.retries import Backoff, retry_http_request
+from ..datastore.models import (
+    AcquiredAggregationJob,
+    AggregationJobState,
+    ReportAggregationState,
+)
+from ..datastore.store import Datastore
+from ..messages import (
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    Duration,
+    PartialBatchSelector,
+    PrepareError,
+    PrepareInit,
+    PrepareStepResult,
+    ReportShare,
+    ReportMetadata,
+)
+from ..messages.codec import DecodeError
+from ..task import Task
+from ..vdaf.registry import circuit_for
+from ..vdaf.wire import (
+    PP_FINISH,
+    PP_INITIALIZE,
+    Prio3Wire,
+    decode_field_rows,
+    decode_pingpong,
+    encode_field_rows,
+    encode_pingpong,
+    seeds_to_lanes,
+)
+from .accumulator import Accumulator, accumulate_batched
+from .engine_cache import engine_cache
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class AggregationJobDriverConfig:
+    batch_aggregation_shard_count: int = 1
+    maximum_attempts_before_failure: int = 10
+    http_backoff: Backoff = Backoff()
+
+
+class AggregationJobDriver:
+    """reference aggregation_job_driver.rs:49."""
+
+    def __init__(self, ds: Datastore, http, cfg: AggregationJobDriverConfig | None = None):
+        self.ds = ds
+        self.http = http
+        self.cfg = cfg or AggregationJobDriverConfig()
+
+    # --- JobDriver callbacks (reference :840-894) ---
+    def acquirer(self, lease_duration_s: int = 600):
+        def acquire(limit: int):
+            return self.ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                    Duration(lease_duration_s), limit
+                ),
+                "acquire_agg_jobs",
+            )
+
+        return acquire
+
+    def stepper(self, acquired: AcquiredAggregationJob) -> None:
+        if acquired.lease.attempts > self.cfg.maximum_attempts_before_failure:
+            self.abandon_job(acquired)
+            return
+        try:
+            self.step_aggregation_job(acquired)
+        except Exception:
+            log.exception(
+                "aggregation job %s step failed (attempt %d)",
+                acquired.job_id,
+                acquired.lease.attempts,
+            )
+            raise
+
+    # --- the step (reference :102-726) ---
+    def step_aggregation_job(self, acquired: AcquiredAggregationJob) -> None:
+        # tx1: read everything (reference :144-233)
+        def read(tx):
+            task = tx.get_task(acquired.task_id)
+            job = tx.get_aggregation_job(acquired.task_id, acquired.job_id)
+            ras = tx.get_report_aggregations_for_job(acquired.task_id, acquired.job_id)
+            reports = {}
+            for ra in ras:
+                if ra.state == ReportAggregationState.START:
+                    reports[ra.report_id.data] = tx.get_client_report(
+                        acquired.task_id, ra.report_id
+                    )
+            return task, job, ras, reports
+
+        task, job, ras, reports = self.ds.run_tx(read, "step_agg_job_read")
+        if job is None or task is None:
+            raise RuntimeError("job or task vanished while leased")
+        if job.state != AggregationJobState.IN_PROGRESS:
+            self.ds.run_tx(lambda tx: tx.release_aggregation_job(acquired), "release")
+            return
+
+        wire = Prio3Wire(circuit_for(task.vdaf))
+        engine = engine_cache(task.vdaf, task.vdaf_verify_key)
+
+        pending = [ra for ra in ras if ra.state == ReportAggregationState.START]
+        if not pending:
+            # nothing to do; mark job finished
+            def finish_empty(tx):
+                tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
+                tx.release_aggregation_job(acquired)
+
+            self.ds.run_tx(finish_empty, "step_agg_job_finish_empty")
+            return
+
+        # columnar staging of stored leader shares
+        n = len(pending)
+        meas_rows: list[bytes | None] = [None] * n
+        proof_rows: list[bytes | None] = [None] * n
+        blind_rows: list[bytes | None] = [None] * n
+        part_rows0: list[bytes | None] = [None] * n
+        part_rows1: list[bytes | None] = [None] * n
+        failed = [None] * n  # PrepareError or None
+        circ = wire.circ
+        mlen = circ.input_len * wire.enc_size
+        plen = circ.proof_len * wire.enc_size
+        for i, ra in enumerate(pending):
+            rep = reports.get(ra.report_id.data)
+            if rep is None:
+                failed[i] = PrepareError.REPORT_DROPPED
+                continue
+            payload = rep.leader_input_share
+            if len(payload) != wire.leader_share_len:
+                failed[i] = PrepareError.INVALID_MESSAGE
+                continue
+            meas_rows[i] = payload[:mlen]
+            proof_rows[i] = payload[mlen : mlen + plen]
+            if wire.uses_jr:
+                blind_rows[i] = payload[mlen + plen :]
+                try:
+                    parts = wire.decode_public_share(rep.public_share)
+                    part_rows0[i], part_rows1[i] = parts
+                except DecodeError:
+                    failed[i] = PrepareError.INVALID_MESSAGE
+
+        jf = engine.p3.jf
+        meas, ok_m = decode_field_rows(jf, meas_rows, circ.input_len)
+        proof, ok_p = decode_field_rows(jf, proof_rows, circ.proof_len)
+        nonce_lanes, _ = seeds_to_lanes([ra.report_id.data for ra in pending])
+        ok = ok_m & ok_p & np.array([f is None for f in failed])
+        if wire.uses_jr:
+            blind_lanes, ok_b = seeds_to_lanes(blind_rows)
+            p0, ok_p0 = seeds_to_lanes(part_rows0)
+            p1, ok_p1 = seeds_to_lanes(part_rows1)
+            ok = ok & ok_b & ok_p0 & ok_p1
+            public_parts = np.stack([p0, p1], axis=1)
+        else:
+            blind_lanes = None
+            public_parts = None
+
+        # device: batched leader prepare-init (reference hot loop :329-402)
+        out0, seed0, ver0, part0 = engine.leader_init(
+            nonce_lanes, public_parts, meas, proof, blind_lanes
+        )
+
+        # build + send the init request (reference :404-424)
+        ver0_rows = encode_field_rows(jf, ver0)
+        part0_rows = (
+            [row.tobytes() for row in np.asarray(part0, dtype="<u8")]
+            if wire.uses_jr
+            else [None] * n
+        )
+        prep_inits = []
+        send_idx = []
+        for i, ra in enumerate(pending):
+            if failed[i] is not None or not ok[i]:
+                if failed[i] is None:
+                    failed[i] = PrepareError.INVALID_MESSAGE
+                continue
+            rep = reports[ra.report_id.data]
+            prep_share = wire.encode_prep_share_raw(ver0_rows[i], part0_rows[i])
+            prep_inits.append(
+                PrepareInit(
+                    ReportShare(
+                        ReportMetadata(ra.report_id, ra.client_time),
+                        rep.public_share,
+                        rep.helper_encrypted_input_share,
+                    ),
+                    encode_pingpong(PP_INITIALIZE, None, prep_share),
+                )
+            )
+            send_idx.append(i)
+
+        accept = np.zeros(n, dtype=bool)
+        if prep_inits:
+            req = AggregationJobInitializeReq(
+                job.aggregation_parameter,
+                PartialBatchSelector.from_bytes(job.partial_batch_identifier),
+                tuple(prep_inits),
+            )
+            resp = self._send_init_request(task, acquired.job_id, req)
+            by_id = {pr.report_id: pr for pr in resp.prepare_resps}
+            # process response (reference :530-726), host-side lane checks
+            for k, i in enumerate(send_idx):
+                ra = pending[i]
+                pr = by_id.get(ra.report_id)
+                if pr is None:
+                    failed[i] = PrepareError.INVALID_MESSAGE
+                    continue
+                if pr.result.kind == PrepareStepResult.REJECT:
+                    failed[i] = pr.result.prepare_error or PrepareError.VDAF_PREP_ERROR
+                    continue
+                if pr.result.kind not in (PrepareStepResult.CONTINUE, PrepareStepResult.FINISHED):
+                    failed[i] = PrepareError.INVALID_MESSAGE
+                    continue
+                if wire.uses_jr:
+                    try:
+                        tag, prep_msg, _ = decode_pingpong(pr.result.message)
+                    except DecodeError:
+                        failed[i] = PrepareError.INVALID_MESSAGE
+                        continue
+                    if tag != PP_FINISH or prep_msg is None or len(prep_msg) != 16:
+                        failed[i] = PrepareError.INVALID_MESSAGE
+                        continue
+                    want = np.asarray(seed0[i], dtype="<u8").tobytes()
+                    if prep_msg != want:
+                        failed[i] = PrepareError.VDAF_PREP_ERROR
+                        continue
+                accept[i] = True
+
+        # masked accumulate (reference Accumulator::update :605-627)
+        accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
+        metadatas = [ReportMetadata(ra.report_id, ra.client_time) for ra in pending]
+        accumulate_batched(task, engine, accumulator, out0, accept, metadatas)
+
+        # tx2: write results + release (reference :698-724)
+        new_ras = []
+        for i, ra in enumerate(pending):
+            if accept[i]:
+                new_ras.append(ra.finished())
+            else:
+                new_ras.append(ra.failed(failed[i] or PrepareError.VDAF_PREP_ERROR))
+
+        def write(tx):
+            for ra in new_ras:
+                tx.update_report_aggregation(ra)
+            tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
+            accumulator.flush_to_datastore(tx)
+            tx.release_aggregation_job(acquired)
+
+        self.ds.run_tx(write, "step_agg_job_write")
+
+    def _send_init_request(self, task: Task, job_id, req: AggregationJobInitializeReq) -> AggregationJobResp:
+        import base64
+
+        url = (
+            task.helper_aggregator_endpoint.rstrip("/")
+            + f"/tasks/{base64.urlsafe_b64encode(task.task_id.data).decode().rstrip('=')}"
+            + f"/aggregation_jobs/{base64.urlsafe_b64encode(job_id.data).decode().rstrip('=')}"
+        )
+        headers = {"Content-Type": AggregationJobInitializeReq.MEDIA_TYPE}
+        if task.aggregator_auth_token:
+            headers.update(task.aggregator_auth_token.request_headers())
+        status, body = retry_http_request(
+            lambda: self.http.put(url, req.to_bytes(), headers), self.cfg.http_backoff
+        )
+        if status not in (200, 201):
+            raise RuntimeError(f"helper init failed: HTTP {status}: {body[:300]!r}")
+        return AggregationJobResp.from_bytes(body)
+
+    # --- abandon (reference :728) ---
+    def abandon_job(self, acquired: AcquiredAggregationJob) -> None:
+        def cancel(tx):
+            job = tx.get_aggregation_job(acquired.task_id, acquired.job_id)
+            if job is None:
+                return
+            tx.update_aggregation_job(job.with_state(AggregationJobState.ABANDONED))
+            ras = tx.get_report_aggregations_for_job(acquired.task_id, acquired.job_id)
+            tx.mark_reports_unaggregated(
+                acquired.task_id,
+                [ra.report_id for ra in ras if ra.state == ReportAggregationState.START],
+            )
+            tx.release_aggregation_job(acquired)
+
+        self.ds.run_tx(cancel, "abandon_agg_job")
+        log.warning("abandoned aggregation job %s after max attempts", acquired.job_id)

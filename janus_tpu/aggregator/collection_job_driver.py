@@ -1,0 +1,172 @@
+"""Collection job driver (leader stepper).
+
+Equivalent of reference aggregator/src/aggregator/collection_job_driver.rs:
+40-307: acquire leases on collectable collection jobs, compute the
+leader aggregate share from the batch-aggregation shard rows, POST an
+AggregateShareReq to the helper, store the helper's encrypted share and
+finish the job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from dataclasses import dataclass
+
+from ..core.retries import Backoff, retry_http_request
+from ..datastore.models import AcquiredCollectionJob, CollectionJobState
+from ..datastore.store import Datastore
+from ..messages import (
+    AggregateShare,
+    AggregateShareReq,
+    BatchId,
+    BatchSelector,
+    Duration,
+    Interval,
+    Query,
+    ReportIdChecksum,
+    TimeInterval,
+)
+from ..task import Task
+from ..vdaf.registry import circuit_for
+from .accumulator import add_encoded_aggregate_shares
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class CollectionJobDriverConfig:
+    maximum_attempts_before_failure: int = 10
+    http_backoff: Backoff = Backoff()
+
+
+class CollectionJobDriver:
+    """reference collection_job_driver.rs:40."""
+
+    def __init__(self, ds: Datastore, http, cfg: CollectionJobDriverConfig | None = None):
+        self.ds = ds
+        self.http = http
+        self.cfg = cfg or CollectionJobDriverConfig()
+
+    def acquirer(self, lease_duration_s: int = 600):
+        def acquire(limit: int):
+            return self.ds.run_tx(
+                lambda tx: tx.acquire_incomplete_collection_jobs(
+                    Duration(lease_duration_s), limit
+                ),
+                "acquire_collection_jobs",
+            )
+
+        return acquire
+
+    def stepper(self, acquired: AcquiredCollectionJob) -> None:
+        if acquired.lease.attempts > self.cfg.maximum_attempts_before_failure:
+            self.abandon_job(acquired)
+            return
+        self.step_collection_job(acquired)
+
+    def step_collection_job(self, acquired: AcquiredCollectionJob) -> None:
+        """reference step_collection_job_generic :108-300."""
+
+        def read(tx):
+            task = tx.get_task(acquired.task_id)
+            job = tx.get_collection_job(acquired.task_id, acquired.collection_job_id)
+            return task, job
+
+        task, job = self.ds.run_tx(read, "step_collection_read")
+        if task is None or job is None:
+            raise RuntimeError("collection job vanished while leased")
+        if job.state not in (CollectionJobState.START, CollectionJobState.COLLECTABLE):
+            self.ds.run_tx(lambda tx: tx.release_collection_job(acquired), "release")
+            return
+
+        field = circuit_for(task.vdaf).FIELD
+        query = Query.from_bytes(job.query)
+
+        # tx1: gather + mark collected (reference :160-199)
+        def gather(tx):
+            if query.query_type == TimeInterval.CODE:
+                rows = tx.get_batch_aggregations_intersecting_interval(
+                    task.task_id, Interval.from_bytes(job.batch_identifier)
+                )
+            else:
+                rows = tx.get_batch_aggregations_for_batch(
+                    task.task_id, job.batch_identifier, job.aggregation_parameter
+                )
+            return rows
+
+        rows = self.ds.run_tx(gather, "step_collection_gather")
+        share = None
+        total = 0
+        checksum = ReportIdChecksum()
+        interval = None
+        for row in rows:
+            share = add_encoded_aggregate_shares(field, share, row.aggregate_share)
+            total += row.report_count
+            checksum = checksum.combined_with(row.checksum)
+            interval = (
+                row.client_timestamp_interval
+                if interval is None
+                else Interval.merged(interval, row.client_timestamp_interval)
+            )
+
+        if share is None or total < task.min_batch_size:
+            # not enough reports yet: release and try again later
+            self.ds.run_tx(lambda tx: tx.release_collection_job(acquired), "release")
+            return
+
+        if query.query_type == TimeInterval.CODE:
+            batch_selector = BatchSelector.time_interval(Interval.from_bytes(job.batch_identifier))
+        else:
+            batch_selector = BatchSelector.fixed_size(BatchId(job.batch_identifier))
+        req = AggregateShareReq(batch_selector, job.aggregation_parameter, total, checksum)
+        helper_share = self._send_aggregate_share_request(task, req)
+
+        def mark_and_store(tx):
+            for row in rows:
+                tx.mark_batch_aggregations_collected(
+                    task.task_id, row.batch_identifier, row.aggregation_parameter
+                )
+            tx.update_collection_job(
+                dataclasses.replace(
+                    job,
+                    state=CollectionJobState.FINISHED,
+                    report_count=total,
+                    client_timestamp_interval=interval,
+                    leader_aggregate_share=share,
+                    helper_encrypted_aggregate_share=helper_share.encrypted_aggregate_share.to_bytes(),
+                )
+            )
+            tx.release_collection_job(acquired)
+
+        self.ds.run_tx(mark_and_store, "step_collection_store")
+
+    def _send_aggregate_share_request(self, task: Task, req: AggregateShareReq) -> AggregateShare:
+        import base64
+
+        url = (
+            task.helper_aggregator_endpoint.rstrip("/")
+            + f"/tasks/{base64.urlsafe_b64encode(task.task_id.data).decode().rstrip('=')}/aggregate_shares"
+        )
+        headers = {"Content-Type": AggregateShareReq.MEDIA_TYPE}
+        if task.aggregator_auth_token:
+            headers.update(task.aggregator_auth_token.request_headers())
+        status, body = retry_http_request(
+            lambda: self.http.post(url, req.to_bytes(), headers), self.cfg.http_backoff
+        )
+        if status != 200:
+            raise RuntimeError(f"helper aggregate share failed: HTTP {status}: {body[:300]!r}")
+        return AggregateShare.from_bytes(body)
+
+    def abandon_job(self, acquired: AcquiredCollectionJob) -> None:
+        def cancel(tx):
+            job = tx.get_collection_job(acquired.task_id, acquired.collection_job_id)
+            if job is None:
+                return
+            tx.update_collection_job(
+                dataclasses.replace(job, state=CollectionJobState.ABANDONED)
+            )
+            tx.release_collection_job(acquired)
+
+        self.ds.run_tx(cancel, "abandon_collection_job")
+        log.warning("abandoned collection job %s", acquired.collection_job_id)

@@ -1,0 +1,539 @@
+"""Aggregator protocol handlers (request-scoped brain).
+
+Equivalent of reference aggregator/src/aggregator.rs:156-3033
+(`Aggregator`, `TaskAggregator`, `VdafOps`): hpke_config, upload,
+aggregate_init (helper), aggregate_continue, collection-job CRUD,
+aggregate_share — with the per-report loops of the reference replaced
+by columnar device batches (engine_cache) and lane masks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass, field
+
+from ..core.hpke import HpkeApplicationInfo, HpkeError, Label, hpke_open, hpke_seal
+from ..core.time_util import Clock, RealClock
+from ..datastore.models import (
+    AggregateShareJob,
+    AggregationJobModel,
+    AggregationJobState,
+    ReportAggregationModel,
+    ReportAggregationState,
+)
+from ..datastore.store import Datastore
+from ..messages import (
+    AggregateShare,
+    AggregateShareAad,
+    AggregateShareReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    BatchSelector,
+    Collection,
+    CollectionJobId,
+    CollectionReq,
+    Duration,
+    HpkeCiphertext,
+    HpkeConfigList,
+    InputShareAad,
+    Interval,
+    PlaintextInputShare,
+    PrepareError,
+    PrepareResp,
+    PrepareStepResult,
+    Report,
+    ReportId,
+    ReportIdChecksum,
+    Role,
+    TaskId,
+    Time,
+    TimeInterval,
+)
+from ..messages.codec import DecodeError
+from ..datastore.models import CollectionJobModel, CollectionJobState
+from ..task import Task
+from ..vdaf.registry import circuit_for
+from ..vdaf.wire import (
+    PP_FINISH,
+    PP_INITIALIZE,
+    Prio3Wire,
+    decode_pingpong,
+    encode_field_rows,
+    encode_pingpong,
+    lanes_to_seed_rows,
+    seeds_to_lanes,
+    split_prep_share_columns,
+)
+from . import errors
+from .accumulator import Accumulator, accumulate_batched, add_encoded_aggregate_shares
+from .engine_cache import engine_cache
+
+import numpy as np
+
+
+@dataclass
+class Config:
+    """reference aggregator.rs:186-218."""
+
+    max_upload_batch_size: int = 100
+    max_upload_batch_write_delay_ms: int = 250
+    batch_aggregation_shard_count: int = 1
+    taskprov_enabled: bool = False
+
+
+class TaskAggregator:
+    """Per-task protocol ops (reference aggregator.rs:797)."""
+
+    def __init__(self, task: Task, cfg: Config):
+        self.task = task
+        self.cfg = cfg
+        self.circ = circuit_for(task.vdaf)
+        self.wire = Prio3Wire(self.circ)
+        self.engine = engine_cache(task.vdaf, task.vdaf_verify_key)
+
+    # ------------------------------------------------------------------
+    # hpke config
+    # ------------------------------------------------------------------
+    def hpke_config_list(self) -> HpkeConfigList:
+        return HpkeConfigList(tuple(kp.config for kp in self.task.hpke_keys))
+
+    # ------------------------------------------------------------------
+    # upload (reference aggregator.rs:1325)
+    # ------------------------------------------------------------------
+    def handle_upload(self, ds: Datastore, clock: Clock, report: Report) -> None:
+        task = self.task
+        now = clock.now()
+        # clock skew / expiry checks (reference :1344-1385)
+        if report.metadata.time > now.add(task.tolerable_clock_skew):
+            raise errors.ReportTooEarly("report from the future", task.task_id)
+        if task.task_expiration and report.metadata.time > task.task_expiration:
+            raise errors.ReportRejected("task expired", task.task_id)
+        if task.report_expired(report.metadata.time, now):
+            raise errors.ReportRejected("report expired", task.task_id)
+        try:
+            self.wire.decode_public_share(report.public_share)
+        except DecodeError as e:
+            raise errors.InvalidMessage(f"bad public share: {e}", task.task_id)
+
+        # decrypt + decode the leader input share at upload time (:1391)
+        keypair = task.hpke_keypair(report.leader_encrypted_input_share.config_id)
+        if keypair is None:
+            raise errors.OutdatedHpkeConfig("unknown HPKE config id", task.task_id)
+        aad = InputShareAad(task.task_id, report.metadata, report.public_share).to_bytes()
+        try:
+            plaintext = hpke_open(
+                keypair,
+                HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+                report.leader_encrypted_input_share,
+                aad,
+            )
+            payload = PlaintextInputShare.from_bytes(plaintext).payload
+            self.wire.decode_leader_share(payload)
+        except (HpkeError, DecodeError) as e:
+            raise errors.ReportRejected(f"undecryptable/undecodable share: {e}", task.task_id)
+
+        from ..datastore.models import LeaderStoredReport
+
+        stored = LeaderStoredReport(
+            task.task_id,
+            report.metadata.report_id,
+            report.metadata.time,
+            report.public_share,
+            payload,
+            report.helper_encrypted_input_share,
+        )
+        fresh = ds.run_tx(lambda tx: tx.put_client_report(stored), "upload")
+        if not fresh:
+            raise errors.ReportRejected("report replayed", task.task_id)
+
+    # ------------------------------------------------------------------
+    # helper aggregate init (reference aggregator.rs:1561)
+    # ------------------------------------------------------------------
+    def handle_aggregate_init(
+        self,
+        ds: Datastore,
+        clock: Clock,
+        job_id: AggregationJobId,
+        req: AggregationJobInitializeReq,
+        request_bytes: bytes,
+    ) -> AggregationJobResp:
+        task = self.task
+        request_hash = hashlib.sha256(request_bytes).digest()
+
+        # idempotent replay (reference :1585,1884,1526)
+        existing = ds.run_tx(
+            lambda tx: tx.get_aggregation_job(task.task_id, job_id), "agg_init_check"
+        )
+        if existing is not None:
+            if existing.last_request_hash == request_hash:
+                return self._replay_aggregate_init_response(ds, job_id)
+            raise errors.InvalidMessage("aggregation job id reuse", task.task_id)
+
+        inits = list(req.prepare_inits)
+        n = len(inits)
+        ids = [pi.report_share.metadata.report_id for pi in inits]
+        if len(set(ids)) != n:  # dup report ids (reference :1590)
+            raise errors.InvalidMessage("duplicate report id in init request", task.task_id)
+
+        now = clock.now()
+        prep_err = [None] * n  # per-report PrepareError or None
+
+        # host-side staging: HPKE open + decode columns (the per-report
+        # failure modes become mask lanes; reference :1633-1768)
+        helper_seed_rows: list[bytes | None] = [None] * n
+        blind_rows: list[bytes | None] = [None] * n
+        part_rows0: list[bytes | None] = [None] * n  # public part 0
+        part_rows1: list[bytes | None] = [None] * n
+        leader_prep_rows: list[bytes | None] = [None] * n
+        for i, pi in enumerate(inits):
+            rs = pi.report_share
+            md = rs.metadata
+            if task.task_expiration and md.time > task.task_expiration:
+                prep_err[i] = PrepareError.TASK_EXPIRED
+                continue
+            if task.report_expired(md.time, now):
+                prep_err[i] = PrepareError.REPORT_DROPPED
+                continue
+            keypair = task.hpke_keypair(rs.encrypted_input_share.config_id)
+            if keypair is None:
+                prep_err[i] = PrepareError.HPKE_UNKNOWN_CONFIG_ID
+                continue
+            aad = InputShareAad(task.task_id, md, rs.public_share).to_bytes()
+            try:
+                plaintext = hpke_open(
+                    keypair,
+                    HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
+                    rs.encrypted_input_share,
+                    aad,
+                )
+            except HpkeError:
+                prep_err[i] = PrepareError.HPKE_DECRYPT_ERROR
+                continue
+            try:
+                payload = PlaintextInputShare.from_bytes(plaintext).payload
+                seed, blind = self.wire.decode_helper_share(payload)
+                parts = self.wire.decode_public_share(rs.public_share)
+                tag, _, prep_share = decode_pingpong(pi.message)
+                if tag != PP_INITIALIZE or prep_share is None:
+                    raise DecodeError("expected ping-pong initialize")
+            except DecodeError:
+                prep_err[i] = PrepareError.INVALID_MESSAGE
+                continue
+            helper_seed_rows[i] = seed
+            blind_rows[i] = blind
+            if self.wire.uses_jr:
+                part_rows0[i] = parts[0]
+                part_rows1[i] = parts[1]
+            leader_prep_rows[i] = prep_share
+
+        # replay check against prior aggregations (reference replay semantics)
+        def check_replays(tx):
+            out = set()
+            for i, rid in enumerate(ids):
+                if prep_err[i] is None and tx.count_report_aggregations_for_report(
+                    task.task_id, rid
+                ):
+                    out.add(i)
+            return out
+
+        replayed = ds.run_tx(check_replays, "agg_init_replay")
+        for i in replayed:
+            prep_err[i] = PrepareError.REPORT_REPLAYED
+
+        # columnar staging -> device
+        nonce_lanes, ok_nonce = seeds_to_lanes([rid.data for rid in ids])
+        seed_lanes, ok_seed = seeds_to_lanes(helper_seed_rows)
+        ver0, part0_lanes, ok_prep = split_prep_share_columns(
+            self.wire, self.engine.p3.jf, leader_prep_rows
+        )
+        ver0 = tuple(np.asarray(x) for x in ver0)
+        ok = ok_nonce & ok_seed & ok_prep & np.array([e is None for e in prep_err])
+        if self.wire.uses_jr:
+            blind_lanes, ok_b = seeds_to_lanes(blind_rows)
+            p0_pub, ok_p0 = seeds_to_lanes(part_rows0)
+            p1_pub, ok_p1 = seeds_to_lanes(part_rows1)
+            ok = ok & ok_b & ok_p0 & ok_p1
+            public_parts = np.stack([p0_pub, p1_pub], axis=1)
+        else:
+            blind_lanes = None
+            public_parts = None
+
+        out1, accept, prep_msg_lanes = self.engine.helper_init(
+            nonce_lanes, public_parts, seed_lanes, blind_lanes, ver0, part0_lanes, ok
+        )
+        accept = accept & ok
+        prep_msg_rows = lanes_to_seed_rows(prep_msg_lanes) if self.wire.uses_jr else [b""] * n
+
+        # mark VDAF-rejected lanes
+        for i in range(n):
+            if prep_err[i] is None and not accept[i]:
+                prep_err[i] = PrepareError.VDAF_PREP_ERROR
+
+        # build response + rows
+        resps = []
+        report_aggs = []
+        for i, pi in enumerate(inits):
+            md = pi.report_share.metadata
+            if prep_err[i] is None:
+                result = PrepareStepResult.cont(
+                    encode_pingpong(PP_FINISH, prep_msg_rows[i], None)
+                )
+                state = ReportAggregationState.FINISHED
+                blob = prep_msg_rows[i]
+                err = None
+            else:
+                result = PrepareStepResult.reject(prep_err[i])
+                state = ReportAggregationState.FAILED
+                blob = b""
+                err = prep_err[i]
+            resps.append(PrepareResp(md.report_id, result))
+            report_aggs.append(
+                ReportAggregationModel(
+                    task.task_id, job_id, md.report_id, md.time, i, state, blob, err
+                )
+            )
+
+        # accumulate accepted out shares per batch bucket (reference :1811-1826)
+        accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
+        accumulate_batched(
+            task, self.engine, accumulator, out1, accept, [pi.report_share.metadata for pi in inits]
+        )
+
+        times = [pi.report_share.metadata.time.seconds for pi in inits]
+        job = AggregationJobModel(
+            task.task_id,
+            job_id,
+            req.aggregation_parameter,
+            req.partial_batch_selector.to_bytes(),
+            Interval(Time(min(times)), Duration(max(times) - min(times) + 1)) if times else Interval(Time(0), Duration(1)),
+            AggregationJobState.FINISHED,
+            0,
+            request_hash,
+        )
+
+        def write(tx):
+            tx.put_aggregation_job(job)
+            for ra in report_aggs:
+                tx.put_report_aggregation(ra)
+            accumulator.flush_to_datastore(tx)
+
+        ds.run_tx(write, "aggregate_init")
+        return AggregationJobResp(tuple(resps))
+
+    def _replay_aggregate_init_response(self, ds: Datastore, job_id) -> AggregationJobResp:
+        """Reconstruct the response from stored rows (reference
+        check_aggregation_job_idempotence, aggregator.rs:1526)."""
+        ras = ds.run_tx(
+            lambda tx: tx.get_report_aggregations_for_job(self.task.task_id, job_id),
+            "agg_init_replay_resp",
+        )
+        resps = []
+        for ra in ras:
+            if ra.state == ReportAggregationState.FINISHED:
+                result = PrepareStepResult.cont(encode_pingpong(PP_FINISH, ra.prep_blob, None))
+            else:
+                result = PrepareStepResult.reject(ra.prepare_error or PrepareError.VDAF_PREP_ERROR)
+            resps.append(PrepareResp(ra.report_id, result))
+        return AggregationJobResp(tuple(resps))
+
+    # ------------------------------------------------------------------
+    # collection jobs (leader; reference aggregator.rs:2185-2746)
+    # ------------------------------------------------------------------
+    def handle_create_collection_job(
+        self, ds: Datastore, collection_job_id: CollectionJobId, req: CollectionReq
+    ) -> None:
+        task = self.task
+        if req.query.query_type != task.query_type.code:
+            raise errors.InvalidMessage("query type mismatch", task.task_id)
+        if req.query.query_type == TimeInterval.CODE:
+            interval = req.query.batch_interval
+            if not interval.aligned_to(task.time_precision):
+                raise errors.BatchInvalid("unaligned batch interval", task.task_id)
+            if interval.duration.seconds < task.time_precision.seconds:
+                raise errors.BatchInvalid("batch interval too small", task.task_id)
+            batch_identifier = interval.to_bytes()
+        else:
+            batch_identifier = req.query.fixed_size_query.batch_id.data
+
+        def create(tx):
+            existing = tx.find_collection_job_by_query(task.task_id, req.query.to_bytes())
+            if existing is not None:
+                if existing.collection_job_id != collection_job_id:
+                    raise errors.BatchOverlap("query already collected under another job", task.task_id)
+                return
+            if tx.get_collection_job(task.task_id, collection_job_id) is not None:
+                raise errors.InvalidMessage("collection job id reuse", task.task_id)
+            tx.put_collection_job(
+                CollectionJobModel(
+                    task.task_id,
+                    collection_job_id,
+                    req.query.to_bytes(),
+                    req.aggregation_parameter,
+                    batch_identifier,
+                    CollectionJobState.START,
+                )
+            )
+
+        ds.run_tx(create, "create_collection_job")
+
+    def handle_get_collection_job(self, ds: Datastore, collection_job_id: CollectionJobId):
+        """-> (ready: bool, Collection | None)."""
+        task = self.task
+        job = ds.run_tx(
+            lambda tx: tx.get_collection_job(task.task_id, collection_job_id),
+            "get_collection_job",
+        )
+        if job is None or job.state == CollectionJobState.DELETED:
+            raise errors.UnrecognizedCollectionJob("no such collection job", task.task_id)
+        if job.state in (CollectionJobState.START, CollectionJobState.COLLECTABLE):
+            return False, None
+        if job.state == CollectionJobState.ABANDONED:
+            raise errors.AggregatorError("collection job abandoned", task.task_id)
+        # FINISHED: leader share is sealed to the collector here
+        from ..messages import PartialBatchSelector, Query
+
+        query = Query.from_bytes(job.query)
+        if query.query_type == TimeInterval.CODE:
+            pbs = PartialBatchSelector.time_interval()
+            batch_selector = BatchSelector.time_interval(Interval.from_bytes(job.batch_identifier))
+        else:
+            from ..messages import BatchId
+
+            pbs = PartialBatchSelector.fixed_size(BatchId(job.batch_identifier))
+            batch_selector = BatchSelector.fixed_size(BatchId(job.batch_identifier))
+        aad = AggregateShareAad(task.task_id, job.aggregation_parameter, batch_selector).to_bytes()
+        leader_enc = hpke_seal(
+            task.collector_hpke_config,
+            HpkeApplicationInfo(Label.AGGREGATE_SHARE, Role.LEADER, Role.COLLECTOR),
+            job.leader_aggregate_share,
+            aad,
+        )
+        helper_enc = HpkeCiphertext.from_bytes(job.helper_encrypted_aggregate_share)
+        return True, Collection(
+            pbs, job.report_count, job.client_timestamp_interval, leader_enc, helper_enc
+        )
+
+    def handle_delete_collection_job(self, ds: Datastore, collection_job_id: CollectionJobId) -> None:
+        import dataclasses
+
+        task = self.task
+
+        def delete(tx):
+            job = tx.get_collection_job(task.task_id, collection_job_id)
+            if job is None:
+                raise errors.UnrecognizedCollectionJob("no such collection job", task.task_id)
+            tx.update_collection_job(
+                dataclasses.replace(job, state=CollectionJobState.DELETED)
+            )
+
+        ds.run_tx(delete, "delete_collection_job")
+
+    # ------------------------------------------------------------------
+    # aggregate share (helper; reference aggregator.rs:2747-2980)
+    # ------------------------------------------------------------------
+    def handle_aggregate_share(self, ds: Datastore, req: AggregateShareReq) -> AggregateShare:
+        task = self.task
+        if req.batch_selector.query_type != task.query_type.code:
+            raise errors.InvalidMessage("query type mismatch", task.task_id)
+        if req.batch_selector.query_type == TimeInterval.CODE:
+            interval = req.batch_selector.batch_interval
+            if not interval.aligned_to(task.time_precision):
+                raise errors.BatchInvalid("unaligned batch interval", task.task_id)
+            batch_identifier = interval.to_bytes()
+        else:
+            batch_identifier = req.batch_selector.batch_id.data
+
+        def compute(tx):
+            existing = tx.get_aggregate_share_job(
+                task.task_id, batch_identifier, req.aggregation_parameter
+            )
+            if existing is not None:
+                return existing, False
+            # enforce query count (reference max_batch_query_count)
+            count = tx.count_aggregate_share_jobs_for_batch(task.task_id, batch_identifier)
+            if count >= task.max_batch_query_count:
+                raise errors.BatchQueryCountExceeded("batch queried too many times", task.task_id)
+            # gather the helper's own shard rows
+            if req.batch_selector.query_type == TimeInterval.CODE:
+                rows = tx.get_batch_aggregations_intersecting_interval(
+                    task.task_id, Interval.from_bytes(batch_identifier)
+                )
+            else:
+                rows = tx.get_batch_aggregations_for_batch(
+                    task.task_id, batch_identifier, req.aggregation_parameter
+                )
+            share = None
+            total = 0
+            checksum = ReportIdChecksum()
+            for row in rows:
+                share = add_encoded_aggregate_shares(self.circ.FIELD, share, row.aggregate_share)
+                total += row.report_count
+                checksum = checksum.combined_with(row.checksum)
+                tx.mark_batch_aggregations_collected(
+                    task.task_id, row.batch_identifier, row.aggregation_parameter
+                )
+            if share is None:
+                raise errors.BatchInvalid("no aggregated reports in batch", task.task_id)
+            # leader/helper consistency (reference checksum/count match)
+            if total != req.report_count or checksum != req.checksum:
+                raise errors.BatchMismatch(
+                    f"count/checksum mismatch: ours {total}, leader {req.report_count}",
+                    task.task_id,
+                )
+            if total < task.min_batch_size:
+                raise errors.InvalidBatchSize(f"batch too small: {total}", task.task_id)
+            job = AggregateShareJob(
+                task.task_id,
+                batch_identifier,
+                req.aggregation_parameter,
+                share,
+                total,
+                checksum,
+            )
+            tx.put_aggregate_share_job(job)
+            return job, True
+
+        job, _ = ds.run_tx(compute, "aggregate_share")
+        aad = AggregateShareAad(
+            task.task_id, req.aggregation_parameter, req.batch_selector
+        ).to_bytes()
+        encrypted = hpke_seal(
+            task.collector_hpke_config,
+            HpkeApplicationInfo(Label.AGGREGATE_SHARE, Role.HELPER, Role.COLLECTOR),
+            job.helper_aggregate_share,
+            aad,
+        )
+        return AggregateShare(encrypted)
+
+
+class Aggregator:
+    """Top-level request router over tasks (reference aggregator.rs:156)."""
+
+    def __init__(self, ds: Datastore, clock: Clock | None = None, cfg: Config | None = None):
+        self.ds = ds
+        self.clock = clock or RealClock()
+        self.cfg = cfg or Config()
+        self._task_aggs: dict[bytes, TaskAggregator] = {}
+
+    def task_aggregator_for(self, task_id: TaskId) -> TaskAggregator:
+        ta = self._task_aggs.get(task_id.data)
+        if ta is None:
+            task = self.ds.run_tx(lambda tx: tx.get_task(task_id), "get_task")
+            if task is None:
+                raise errors.UnrecognizedTask("unknown task", task_id)
+            ta = TaskAggregator(task, self.cfg)
+            self._task_aggs[task_id.data] = ta
+        return ta
+
+    # role/auth checks used by the HTTP layer
+    def check_aggregator_auth(self, task: Task, headers) -> None:
+        tok = task.aggregator_auth_token
+        if tok is None or not tok.matches_headers(headers):
+            raise errors.UnauthorizedRequest("bad aggregator auth", task.task_id)
+
+    def check_collector_auth(self, task: Task, headers) -> None:
+        tok = task.collector_auth_token
+        if tok is None or not tok.matches_headers(headers):
+            raise errors.UnauthorizedRequest("bad collector auth", task.task_id)

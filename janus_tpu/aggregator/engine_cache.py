@@ -1,0 +1,127 @@
+"""Jitted device-step cache with batch-size bucketing.
+
+One compiled executable serves many request sizes: batches are padded
+up to the next power-of-two bucket (padding lanes carry mask=False and
+are sliced off), so each (task VDAF, step kind) compiles O(log max
+batch) times total. This is the TPU answer to the reference's
+per-report loop — XLA sees static shapes, reports ride the batch axis.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..vdaf.registry import VdafInstance, prio3_batched
+
+MIN_BUCKET = 32
+
+
+def bucket_size(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad(arr, b: int):
+    if arr is None:
+        return None
+    pad = b - arr.shape[0]
+    if pad == 0:
+        return arr
+    widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(np.asarray(arr), widths)
+
+
+def pad_args(b: int, *args):
+    out = []
+    for a in args:
+        if a is None or isinstance(a, (bytes, int)):
+            out.append(a)
+        elif isinstance(a, tuple):  # field value limbs
+            out.append(tuple(_pad(x, b) for x in a))
+        else:
+            out.append(_pad(a, b))
+    return tuple(out)
+
+
+class EngineCache:
+    """Per (vdaf, verify_key) jitted steps, keyed by batch bucket."""
+
+    def __init__(self, inst: VdafInstance, verify_key: bytes):
+        self.inst = inst
+        self.verify_key = verify_key
+        self.p3 = prio3_batched(inst)
+        self._jits: dict[str, object] = {}
+
+    def _jit(self, name: str, fn):
+        if name not in self._jits:
+            self._jits[name] = jax.jit(fn)
+        return self._jits[name]
+
+    # --- helper side: init + combine + decide in one traced step ---
+    def helper_init(self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
+        """Returns (out1 field value, accept mask, prep_msg lanes) sliced
+        to the true batch size."""
+        p3 = self.p3
+        n = nonce_lanes.shape[0]
+        b = bucket_size(n)
+
+        def step(nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
+            out1, seed1, ver1, part1 = p3.prepare_init_helper(
+                self.verify_key, nonce_lanes, public_parts, helper_seeds, blinds
+            )
+            mask, prep_msg = p3.prep_shares_to_prep(ver0, ver1, part0, part1)
+            mask = p3.prepare_finish(seed1, prep_msg, mask)
+            mask = mask & ok_mask
+            if prep_msg is None:
+                prep_msg = jnp.zeros((nonce_lanes.shape[0], 2), dtype=jnp.uint64)
+            return out1, mask, prep_msg
+
+        fn = self._jit("helper_init", step)
+        args = pad_args(b, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask)
+        out1, mask, prep_msg = fn(*args)
+        out1 = tuple(np.asarray(x)[:n] for x in out1)
+        return out1, np.asarray(mask)[:n], np.asarray(prep_msg)[:n]
+
+    # --- leader side: init only (network round trip follows) ---
+    def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0):
+        p3 = self.p3
+        n = nonce_lanes.shape[0]
+        b = bucket_size(n)
+
+        def step(nonce_lanes, public_parts, meas, proof, blind0):
+            return p3.prepare_init_leader(
+                self.verify_key, nonce_lanes, public_parts, meas, proof, blind0
+            )
+
+        fn = self._jit("leader_init", step)
+        args = pad_args(b, nonce_lanes, public_parts, meas, proof, blind0)
+        out0, seed0, ver0, part0 = fn(*args)
+        out0 = tuple(np.asarray(x)[:n] for x in out0)
+        seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
+        ver0 = tuple(np.asarray(x)[:n] for x in ver0)
+        part0 = np.asarray(part0)[:n] if part0 is not None else None
+        return out0, seed0, ver0, part0
+
+    # --- masked aggregate over the batch axis ---
+    def aggregate(self, out_shares, mask):
+        p3 = self.p3
+        n = mask.shape[0]
+        b = bucket_size(n)
+
+        def step(out_shares, mask):
+            return p3.aggregate(out_shares, mask)
+
+        fn = self._jit("aggregate", step)
+        agg = fn(*pad_args(b, out_shares, mask))
+        return [int(x) for x in p3.jf.to_ints(agg)]
+
+
+@lru_cache(maxsize=256)
+def engine_cache(inst: VdafInstance, verify_key: bytes) -> EngineCache:
+    return EngineCache(inst, verify_key)

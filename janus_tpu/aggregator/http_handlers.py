@@ -1,0 +1,230 @@
+"""DAP HTTP layer: routes, media types, auth, problem details.
+
+Equivalent of reference aggregator/src/aggregator/http_handlers.rs:
+205-268 (trillium router) on the Python stdlib threading HTTP server:
+
+  GET  /hpke_config?task_id=...
+  PUT  /tasks/:task_id/reports
+  PUT  /tasks/:task_id/aggregation_jobs/:aggregation_job_id
+  POST /tasks/:task_id/aggregation_jobs/:aggregation_job_id  (continue)
+  PUT  /tasks/:task_id/collection_jobs/:collection_job_id
+  POST /tasks/:task_id/collection_jobs/:collection_job_id    (poll)
+  DELETE /tasks/:task_id/collection_jobs/:collection_job_id
+  POST /tasks/:task_id/aggregate_shares
+
+Errors map to RFC 7807 problem documents (problem_details.rs).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..messages import (
+    AggregateShareReq,
+    AggregationJobId,
+    AggregationJobInitializeReq,
+    CollectionJobId,
+    CollectionReq,
+    Report,
+    TaskId,
+)
+from ..messages.codec import DecodeError
+from ..core.time_util import Clock
+from .core import Aggregator
+from .errors import AggregatorError, InvalidMessage, UnrecognizedTask
+
+log = logging.getLogger(__name__)
+
+
+def _b64dec(s: str, size: int) -> bytes:
+    raw = base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+    if len(raw) != size:
+        raise DecodeError(f"bad id length {len(raw)}")
+    return raw
+
+
+_ROUTES = [
+    ("GET", re.compile(r"^/hpke_config$"), "hpke_config"),
+    ("PUT", re.compile(r"^/tasks/([^/]+)/reports$"), "upload"),
+    ("PUT", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "aggregate_init"),
+    ("POST", re.compile(r"^/tasks/([^/]+)/aggregation_jobs/([^/]+)$"), "aggregate_continue"),
+    ("PUT", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "collection_create"),
+    ("POST", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "collection_poll"),
+    ("DELETE", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "collection_delete"),
+    ("POST", re.compile(r"^/tasks/([^/]+)/aggregate_shares$"), "aggregate_share"),
+]
+
+
+class DapHttpApp:
+    """Routing + handler glue around an Aggregator."""
+
+    def __init__(self, aggregator: Aggregator):
+        self.agg = aggregator
+
+    def handle(self, method: str, path: str, query: dict, headers, body: bytes):
+        """-> (status, content_type, body_bytes)."""
+        try:
+            for m, rx, name in _ROUTES:
+                if m != method:
+                    continue
+                match = rx.match(path)
+                if match:
+                    return getattr(self, "h_" + name)(match, query, headers, body)
+            return 404, "text/plain", b"not found"
+        except AggregatorError as e:
+            doc = e.problem_document()
+            if doc is None:
+                log.exception("internal aggregator error")
+                return 500, "text/plain", str(e).encode()
+            return (
+                e.status,
+                "application/problem+json",
+                json.dumps(doc).encode(),
+            )
+        except DecodeError as e:
+            return 400, "text/plain", f"undecodable request: {e}".encode()
+        except Exception:
+            log.exception("unhandled error in DAP handler")
+            return 500, "text/plain", b"internal error"
+
+    # --- handlers ---
+    def h_hpke_config(self, match, query, headers, body):
+        tid = query.get("task_id")
+        if tid is None:
+            raise InvalidMessage("task_id query parameter required")
+        ta = self.agg.task_aggregator_for(TaskId(_b64dec(tid, 32)))
+        return 200, "application/dap-hpke-config-list", ta.hpke_config_list().to_bytes()
+
+    def h_upload(self, match, query, headers, body):
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        ta = self.agg.task_aggregator_for(task_id)
+        report = Report.from_bytes(body)
+        ta.handle_upload(self.agg.ds, self.agg.clock, report)
+        return 201, "text/plain", b""
+
+    def h_aggregate_init(self, match, query, headers, body):
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        job_id = AggregationJobId(_b64dec(match.group(2), 16))
+        ta = self.agg.task_aggregator_for(task_id)
+        self.agg.check_aggregator_auth(ta.task, headers)
+        req = AggregationJobInitializeReq.from_bytes(body)
+        resp = ta.handle_aggregate_init(self.agg.ds, self.agg.clock, job_id, req, body)
+        return 200, "application/dap-aggregation-job-resp", resp.to_bytes()
+
+    def h_aggregate_continue(self, match, query, headers, body):
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        ta = self.agg.task_aggregator_for(task_id)
+        self.agg.check_aggregator_auth(ta.task, headers)
+        # all supported VDAFs are 1-round: a continue request is always a
+        # step mismatch (reference aggregation_job_continue.rs:58-84)
+        from .errors import StepMismatch
+
+        raise StepMismatch("no multi-round VDAFs configured", task_id)
+
+    def h_collection_create(self, match, query, headers, body):
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        cj_id = CollectionJobId(_b64dec(match.group(2), 16))
+        ta = self.agg.task_aggregator_for(task_id)
+        self.agg.check_collector_auth(ta.task, headers)
+        req = CollectionReq.from_bytes(body)
+        ta.handle_create_collection_job(self.agg.ds, cj_id, req)
+        return 201, "text/plain", b""
+
+    def h_collection_poll(self, match, query, headers, body):
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        cj_id = CollectionJobId(_b64dec(match.group(2), 16))
+        ta = self.agg.task_aggregator_for(task_id)
+        self.agg.check_collector_auth(ta.task, headers)
+        ready, collection = ta.handle_get_collection_job(self.agg.ds, cj_id)
+        if not ready:
+            return 202, "text/plain", b""
+        return 200, "application/dap-collection", collection.to_bytes()
+
+    def h_collection_delete(self, match, query, headers, body):
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        cj_id = CollectionJobId(_b64dec(match.group(2), 16))
+        ta = self.agg.task_aggregator_for(task_id)
+        self.agg.check_collector_auth(ta.task, headers)
+        ta.handle_delete_collection_job(self.agg.ds, cj_id)
+        return 204, "text/plain", b""
+
+    def h_aggregate_share(self, match, query, headers, body):
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        ta = self.agg.task_aggregator_for(task_id)
+        self.agg.check_aggregator_auth(ta.task, headers)
+        req = AggregateShareReq.from_bytes(body)
+        resp = ta.handle_aggregate_share(self.agg.ds, req)
+        return 200, "application/dap-aggregate-share", resp.to_bytes()
+
+
+class DapServer:
+    """Threaded HTTP server hosting a DapHttpApp (+ /healthz)."""
+
+    def __init__(self, app: DapHttpApp, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self, method):
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                if parts.path == "/healthz":
+                    self._reply(200, "text/plain", b"ok")
+                    return
+                query = dict(parse_qsl(parts.query))
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, ctype, out = outer.app.handle(
+                    method, parts.path, query, dict(self.headers.items()), body
+                )
+                self._reply(status, ctype, out)
+
+            def _reply(self, status, ctype, out):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                if out:
+                    self.wfile.write(out)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def log_message(self, fmt, *args):
+                log.debug("http: " + fmt, *args)
+
+        self.app = app
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}/"
+
+    def start(self) -> "DapServer":
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,85 @@
+"""Generic lease-based job driver loop.
+
+Equivalent of reference aggregator/src/binary_utils/job_driver.rs:25-260:
+acquire a batch of leases, step each job on a bounded worker pool,
+rediscover with an adaptive delay, drain cleanly on shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor, wait
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class JobDriverConfig:
+    """reference aggregator/src/config.rs:121-141."""
+
+    job_discovery_interval_s: float = 0.2
+    max_job_discovery_interval_s: float = 5.0
+    max_concurrent_job_workers: int = 4
+    worker_lease_duration_s: int = 600
+    maximum_attempts_before_failure: int = 10
+
+
+class Stopper:
+    """Cooperative shutdown flag (reference uses trillium Stopper)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def stop(self) -> None:
+        self._event.set()
+
+    @property
+    def stopped(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float) -> None:
+        self._event.wait(timeout)
+
+
+class JobDriver:
+    """reference job_driver.rs:103 (run loop).
+
+    acquirer(limit) -> list of acquired jobs;
+    stepper(acquired) -> None (owns release/cancel).
+    """
+
+    def __init__(self, cfg: JobDriverConfig, acquirer, stepper, stopper: Stopper | None = None):
+        self.cfg = cfg
+        self.acquirer = acquirer
+        self.stepper = stepper
+        self.stopper = stopper or Stopper()
+
+    def run_once(self) -> int:
+        """One acquire+step pass; returns number of jobs stepped."""
+        jobs = self.acquirer(self.cfg.max_concurrent_job_workers)
+        if not jobs:
+            return 0
+        with ThreadPoolExecutor(max_workers=self.cfg.max_concurrent_job_workers) as pool:
+            futures = [pool.submit(self._step_one, j) for j in jobs]
+            wait(futures)
+        return len(jobs)
+
+    def _step_one(self, acquired) -> None:
+        try:
+            self.stepper(acquired)
+        except Exception:
+            log.exception("job step failed (lease will expire and retry)")
+
+    def run(self) -> None:
+        """Adaptive-delay discovery loop until stopped (job_driver.rs:119-186)."""
+        delay = self.cfg.job_discovery_interval_s
+        while not self.stopper.stopped:
+            n = self.run_once()
+            if n > 0:
+                delay = self.cfg.job_discovery_interval_s
+            else:
+                delay = min(delay * 2, self.cfg.max_job_discovery_interval_s)
+            self.stopper.wait(delay)

@@ -1,0 +1,148 @@
+"""DAP client: shard a measurement, HPKE-seal input shares, upload.
+
+Equivalent of reference client/src/lib.rs:58-300 (`ClientParameters`,
+HPKE-config fetch, `prepare_report`, `upload`). Sharding uses the host
+Prio3 (single report); batched load generation uses the device shard
+in janus_tpu.vdaf.testing instead.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from .core.hpke import HpkeApplicationInfo, Label, hpke_seal
+from .core.retries import Backoff, retry_http_request
+from .core.time_util import Clock, RealClock
+from .messages import (
+    Duration,
+    HpkeConfig,
+    HpkeConfigList,
+    InputShareAad,
+    PlaintextInputShare,
+    Report,
+    ReportId,
+    ReportMetadata,
+    Role,
+    TaskId,
+)
+from .vdaf.registry import VdafInstance, circuit_for, prio3_host
+from .vdaf.wire import Prio3Wire
+
+
+@dataclass
+class ClientParameters:
+    """reference client/src/lib.rs:58."""
+
+    task_id: TaskId
+    leader_aggregator_endpoint: str
+    helper_aggregator_endpoint: str
+    time_precision: Duration
+
+    def hpke_config_uri(self, role: Role) -> str:
+        base = (
+            self.leader_aggregator_endpoint
+            if role == Role.LEADER
+            else self.helper_aggregator_endpoint
+        )
+        return base.rstrip("/") + f"/hpke_config?task_id={b64url(self.task_id.data)}"
+
+    def upload_uri(self) -> str:
+        return self.leader_aggregator_endpoint.rstrip("/") + f"/tasks/{b64url(self.task_id.data)}/reports"
+
+
+def b64url(raw: bytes) -> str:
+    import base64
+
+    return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+
+class Client:
+    """reference client/src/lib.rs:182."""
+
+    def __init__(
+        self,
+        parameters: ClientParameters,
+        vdaf: VdafInstance,
+        leader_hpke_config: HpkeConfig,
+        helper_hpke_config: HpkeConfig,
+        clock: Clock | None = None,
+        http=None,
+    ):
+        self.params = parameters
+        self.vdaf = vdaf
+        self.prio3 = prio3_host(vdaf)
+        self.wire = Prio3Wire(circuit_for(vdaf))
+        self.leader_hpke_config = leader_hpke_config
+        self.helper_hpke_config = helper_hpke_config
+        self.clock = clock or RealClock()
+        self.http = http
+
+    @classmethod
+    def with_fetched_configs(cls, parameters: ClientParameters, vdaf: VdafInstance, http, clock=None):
+        """Fetch both aggregators' HPKE config lists (reference :135)."""
+        configs = []
+        for role in (Role.LEADER, Role.HELPER):
+            status, body = retry_http_request(
+                lambda role=role: http.get(parameters.hpke_config_uri(role))
+            )
+            if status != 200:
+                raise RuntimeError(f"hpke_config fetch failed: HTTP {status}")
+            cfg_list = HpkeConfigList.from_bytes(body)
+            if not cfg_list.configs:
+                raise RuntimeError("aggregator advertised no HPKE configs")
+            configs.append(cfg_list.configs[0])
+        return cls(parameters, vdaf, configs[0], configs[1], clock=clock, http=http)
+
+    def prepare_report(self, measurement, when=None) -> Report:
+        """Shard + seal (reference client/src/lib.rs:212-260)."""
+        report_id = ReportId(secrets.token_bytes(16))
+        time = (when or self.clock.now()).to_batch_interval_start(self.params.time_precision)
+        metadata = ReportMetadata(report_id, time)
+
+        public_share_parts, (leader_share, helper_share) = self.prio3.shard(
+            measurement, report_id.data
+        )
+        public_share = self.wire.encode_public_share(public_share_parts)
+        aad = InputShareAad(self.params.task_id, metadata, public_share).to_bytes()
+
+        leader_payload = PlaintextInputShare(
+            (),
+            self.wire.encode_leader_share(
+                leader_share.measurement_share,
+                leader_share.proof_share,
+                leader_share.joint_rand_blind,
+            ),
+        ).to_bytes()
+        helper_payload = PlaintextInputShare(
+            (),
+            self.wire.encode_helper_share(helper_share.seed, helper_share.joint_rand_blind),
+        ).to_bytes()
+
+        leader_ct = hpke_seal(
+            self.leader_hpke_config,
+            HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+            leader_payload,
+            aad,
+        )
+        helper_ct = hpke_seal(
+            self.helper_hpke_config,
+            HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
+            helper_payload,
+            aad,
+        )
+        return Report(metadata, public_share, leader_ct, helper_ct)
+
+    def upload(self, measurement) -> None:
+        """PUT the report to the leader with retries (reference :270)."""
+        report = self.prepare_report(measurement)
+        status, body = retry_http_request(
+            lambda: self.http.put(
+                self.params.upload_uri(),
+                report.to_bytes(),
+                {"Content-Type": Report.MEDIA_TYPE},
+            ),
+            Backoff(),
+        )
+        if status not in (200, 201):
+            raise RuntimeError(f"upload failed: HTTP {status}: {body[:200]!r}")

@@ -1,0 +1,139 @@
+"""DAP collector: create collection jobs, poll, decrypt, unshard.
+
+Equivalent of reference collector/src/lib.rs:155-650
+(`CollectorParameters`, `Collector::collect` = start_collection +
+poll_once/poll_until_complete, HPKE-open of both aggregate shares,
+vdaf.unshard).
+"""
+
+from __future__ import annotations
+
+import secrets
+import time as _time
+from dataclasses import dataclass
+
+from .core.hpke import HpkeApplicationInfo, HpkeKeypair, Label, hpke_open
+from .core.auth import AuthenticationToken
+from .core.retries import Backoff, retry_http_request
+from .messages import (
+    AggregateShareAad,
+    BatchSelector,
+    Collection,
+    CollectionJobId,
+    CollectionReq,
+    Interval,
+    Query,
+    Role,
+    TaskId,
+    TimeInterval,
+)
+from .vdaf.registry import VdafInstance, circuit_for, prio3_host
+from .client import b64url
+
+
+@dataclass
+class CollectorParameters:
+    """reference collector/src/lib.rs:155."""
+
+    task_id: TaskId
+    leader_endpoint: str
+    auth_token: AuthenticationToken
+    hpke_keypair: HpkeKeypair  # collector's own keypair
+
+    def collection_job_uri(self, collection_job_id: CollectionJobId) -> str:
+        return (
+            self.leader_endpoint.rstrip("/")
+            + f"/tasks/{b64url(self.task_id.data)}/collection_jobs/{b64url(collection_job_id.data)}"
+        )
+
+
+@dataclass
+class CollectionResult:
+    """reference collector/src/lib.rs:279 `Collection`."""
+
+    report_count: int
+    interval: Interval
+    aggregate_result: object
+
+
+class CollectionJobNotReady(Exception):
+    pass
+
+
+class Collector:
+    """reference collector/src/lib.rs:359."""
+
+    def __init__(self, params: CollectorParameters, vdaf: VdafInstance, http):
+        self.params = params
+        self.vdaf = vdaf
+        self.prio3 = prio3_host(vdaf)
+        self.http = http
+
+    def start_collection(self, query: Query, agg_param: bytes = b"") -> CollectionJobId:
+        """PUT the CollectionReq (reference :384)."""
+        job_id = CollectionJobId(secrets.token_bytes(16))
+        req = CollectionReq(query, agg_param)
+        headers = {"Content-Type": CollectionReq.MEDIA_TYPE}
+        headers.update(self.params.auth_token.request_headers())
+        status, body = retry_http_request(
+            lambda: self.http.put(
+                self.params.collection_job_uri(job_id), req.to_bytes(), headers
+            )
+        )
+        if status not in (200, 201):
+            raise RuntimeError(f"collection create failed: HTTP {status}: {body[:300]!r}")
+        return job_id
+
+    def poll_once(self, job_id: CollectionJobId, query: Query, agg_param: bytes = b""):
+        """POST-poll the job (reference :440); raises CollectionJobNotReady."""
+        headers = dict(self.params.auth_token.request_headers())
+        status, body = retry_http_request(
+            lambda: self.http.post(self.params.collection_job_uri(job_id), b"", headers)
+        )
+        if status == 202:
+            raise CollectionJobNotReady()
+        if status != 200:
+            raise RuntimeError(f"collection poll failed: HTTP {status}: {body[:300]!r}")
+        collection = Collection.from_bytes(body)
+        return self._unshard(collection, query, agg_param)
+
+    def poll_until_complete(
+        self, job_id: CollectionJobId, query: Query, agg_param: bytes = b"", timeout_s: float = 60.0, poll_interval_s: float = 0.2
+    ) -> CollectionResult:
+        """reference :561."""
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                return self.poll_once(job_id, query, agg_param)
+            except CollectionJobNotReady:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError("collection job did not complete in time")
+                _time.sleep(poll_interval_s)
+
+    def collect(self, query: Query, agg_param: bytes = b"", timeout_s: float = 60.0) -> CollectionResult:
+        """start + poll to completion (reference :619)."""
+        job_id = self.start_collection(query, agg_param)
+        return self.poll_until_complete(job_id, query, agg_param, timeout_s)
+
+    def _unshard(self, collection: Collection, query: Query, agg_param: bytes) -> CollectionResult:
+        """Decrypt both aggregate shares + vdaf.unshard (reference :500-560)."""
+        if query.query_type == TimeInterval.CODE:
+            batch_selector = BatchSelector.time_interval(query.batch_interval)
+        else:
+            batch_selector = BatchSelector.fixed_size(collection.partial_batch_selector.batch_id)
+        aad = AggregateShareAad(self.params.task_id, agg_param, batch_selector).to_bytes()
+        field = circuit_for(self.vdaf).FIELD
+        shares = []
+        for role, ct in (
+            (Role.LEADER, collection.leader_encrypted_agg_share),
+            (Role.HELPER, collection.helper_encrypted_agg_share),
+        ):
+            pt = hpke_open(
+                self.params.hpke_keypair,
+                HpkeApplicationInfo(Label.AGGREGATE_SHARE, role, Role.COLLECTOR),
+                ct,
+                aad,
+            )
+            shares.append(field.decode_vec(pt))
+        result = self.prio3.unshard(shares, collection.report_count)
+        return CollectionResult(collection.report_count, collection.interval, result)

@@ -1,0 +1,34 @@
+"""Minimal HTTP client with the (status, body) convention used by
+retry_http_request. The reference uses reqwest (aggregator.rs:3033
+send_request_to_helper); this wraps urllib for the same purpose.
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+
+
+class HttpClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def request(self, method: str, url: str, body: bytes | None = None, headers: dict | None = None):
+        req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def get(self, url: str, headers: dict | None = None):
+        return self.request("GET", url, None, headers)
+
+    def put(self, url: str, body: bytes, headers: dict | None = None):
+        return self.request("PUT", url, body, headers)
+
+    def post(self, url: str, body: bytes, headers: dict | None = None):
+        return self.request("POST", url, body, headers)
+
+    def delete(self, url: str, headers: dict | None = None):
+        return self.request("DELETE", url, None, headers)

@@ -759,7 +759,7 @@ class Transaction:
         now = self._clock.now().seconds
         rows = self._c.execute(
             "SELECT task_id, collection_job_id FROM collection_jobs"
-            " WHERE state = 'collectable' AND lease_expiry <= ?"
+            " WHERE state IN ('start', 'collectable') AND lease_expiry <= ?"
             " ORDER BY lease_expiry LIMIT ?",
             (now, limit),
         ).fetchall()
@@ -769,7 +769,8 @@ class Transaction:
             cur = self._c.execute(
                 "UPDATE collection_jobs SET lease_expiry = ?, lease_token = ?,"
                 " lease_attempts = lease_attempts + 1"
-                " WHERE task_id = ? AND collection_job_id = ? AND state = 'collectable' AND lease_expiry <= ?"
+                " WHERE task_id = ? AND collection_job_id = ? AND state IN ('start', 'collectable')"
+                " AND lease_expiry <= ?"
                 " RETURNING lease_attempts",
                 (now + lease_duration.seconds, token, task_id, cj_id, now),
             ).fetchone()

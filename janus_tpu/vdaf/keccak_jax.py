@@ -57,28 +57,43 @@ def _rotl(x, r: int):
     return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
 
 
-def keccak_f1600(state):
-    """One permutation. state: 25 u64 arrays (lane (x,y) at index x + 5*y)."""
-    a = list(state)
-    for rnd in range(24):
-        # theta
-        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
-        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
-        a = [a[i] ^ d[i % 5] for i in range(25)]
-        # rho + pi: B[y, 2x+3y] = rot(A[x, y])
-        b = [None] * 25
-        for x in range(5):
-            for y in range(5):
-                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], _ROT[x][y])
-        # chi
-        a = [
-            b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y])
-            for y in range(5)
-            for x in range(5)
-        ]
-        # iota
-        a[0] = a[0] ^ _RC[rnd]
+def _keccak_round(a, rc):
+    """One Keccak round; a: tuple of 25 u64 arrays, rc: scalar constant."""
+    # theta
+    c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+    d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+    a = [a[i] ^ d[i % 5] for i in range(25)]
+    # rho + pi: B[y, 2x+3y] = rot(A[x, y])
+    b = [None] * 25
+    for x in range(5):
+        for y in range(5):
+            b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], _ROT[x][y])
+    # chi
+    a = [
+        b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y])
+        for y in range(5)
+        for x in range(5)
+    ]
+    # iota
+    a[0] = a[0] ^ rc
     return tuple(a)
+
+
+def keccak_f1600(state):
+    """One permutation. state: 25 u64 arrays (lane (x,y) at index x + 5*y).
+
+    The 24 rounds run under lax.scan so the round body is traced and
+    compiled once — an unrolled permutation inflates the XLA graph by
+    ~2k ops per call site, which multiplies out to minutes of compile
+    time across the expansion pipeline.
+    """
+    state = tuple(jnp.asarray(x, dtype=U64) for x in state)
+
+    def body(a, rc):
+        return _keccak_round(a, rc), None
+
+    out, _ = jax.lax.scan(body, state, jnp.asarray(_RC))
+    return out
 
 
 def _absorb_block(state, block_lanes):

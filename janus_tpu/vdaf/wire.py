@@ -1,0 +1,251 @@
+"""Wire encodings for Prio3 shares + the ping-pong prepare protocol,
+and columnar (de)serialization between wire bytes and device arrays.
+
+Capability-equivalent of the reference's reliance on prio's codec for
+input shares / prep shares / prep messages and
+`topology::ping_pong::PingPongMessage` (SURVEY.md section 2.2). Field
+vectors are little-endian fixed-width elements (Field.encode_vec);
+seeds are 16 bytes.
+
+Share payloads (inside HPKE plaintext / PlaintextInputShare.payload):
+  leader: meas_share_vec || proof_share_vec || [blind 16B]
+  helper: seed 16B || [blind 16B]
+Public share: joint-rand parts part0 || part1 (or empty).
+
+Ping-pong messages (PrepareInit.message / PrepareResp continue payload):
+  initialize(0): u8 tag || opaque u32 prep_share
+  continue  (1): u8 tag || opaque u32 prep_msg || opaque u32 prep_share
+  finish    (2): u8 tag || opaque u32 prep_msg
+Prep share: verifier_share_vec || [joint_rand_part 16B]
+Prep message: [joint_rand_seed 16B]
+
+The column codecs below convert whole report batches at once with
+numpy (no per-report Python loops on the hot path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..messages.codec import DecodeError, Decoder, Encoder
+from .prio3_jax import Prio3Batched
+from .reference import Circuit
+
+SEED_SIZE = 16
+
+PP_INITIALIZE = 0
+PP_CONTINUE = 1
+PP_FINISH = 2
+
+
+# ---------------------------------------------------------------------------
+# columnar field-vector codecs (numpy, whole-batch)
+# ---------------------------------------------------------------------------
+
+
+def encode_field_rows(jf, value) -> list[bytes]:
+    """Device field value [batch, n] -> per-row little-endian encodings."""
+    limbs = [np.asarray(x, dtype=np.uint64) for x in value]
+    if len(limbs) == 1:
+        lanes = limbs[0]
+    else:
+        lanes = np.stack(limbs, axis=-1).reshape(limbs[0].shape[0], -1)
+    le = lanes.astype("<u8")
+    return [row.tobytes() for row in le]
+
+
+def decode_field_rows(jf, rows: list[bytes], n: int):
+    """Per-row encodings -> host numpy limb tuple [batch, n] (validated).
+
+    Returns (limb_arrays, ok_mask): rows failing length or range checks
+    get a False mask lane and zeroed content (ragged-batch design,
+    SURVEY.md section 7).
+    """
+    batch = len(rows)
+    enc_size = 8 * jf.LIMBS
+    lanes = np.zeros((batch, n * jf.LIMBS), dtype=np.uint64)
+    ok = np.zeros(batch, dtype=bool)
+    for i, row in enumerate(rows):
+        if row is None or len(row) != n * enc_size:
+            continue
+        lanes[i] = np.frombuffer(row, dtype="<u8")
+        ok[i] = True
+    if jf.LIMBS == 1:
+        limbs = (lanes,)
+        in_range = lanes < np.uint64(jf.MODULUS)
+        ok &= in_range.all(axis=1)
+    else:
+        r = lanes.reshape(batch, n, 2)
+        lo, hi = r[:, :, 0], r[:, :, 1]
+        p_lo = np.uint64(jf.MODULUS & 0xFFFFFFFFFFFFFFFF)
+        p_hi = np.uint64(jf.MODULUS >> 64)
+        in_range = (hi < p_hi) | ((hi == p_hi) & (lo < p_lo))
+        ok &= in_range.all(axis=1)
+        limbs = (np.ascontiguousarray(lo), np.ascontiguousarray(hi))
+    # zero out bad rows so device math stays in range
+    for l in limbs:
+        l[~ok] = 0
+    return limbs, ok
+
+
+def seeds_to_lanes(rows: list[bytes | None]) -> tuple[np.ndarray, np.ndarray]:
+    """16-byte seed rows -> ([batch, 2] u64 lanes, ok mask)."""
+    batch = len(rows)
+    lanes = np.zeros((batch, 2), dtype=np.uint64)
+    ok = np.zeros(batch, dtype=bool)
+    for i, row in enumerate(rows):
+        if row is not None and len(row) == SEED_SIZE:
+            lanes[i] = np.frombuffer(row, dtype="<u8")
+            ok[i] = True
+    return lanes, ok
+
+
+def lanes_to_seed_rows(lanes) -> list[bytes]:
+    return [row.tobytes() for row in np.asarray(lanes, dtype="<u8")]
+
+
+# ---------------------------------------------------------------------------
+# scalar wire codecs (client side / message framing)
+# ---------------------------------------------------------------------------
+
+
+def encode_pingpong(tag: int, prep_msg: bytes | None, prep_share: bytes | None) -> bytes:
+    enc = Encoder()
+    enc.u8(tag)
+    if tag == PP_INITIALIZE:
+        enc.opaque_u32(prep_share)
+    elif tag == PP_CONTINUE:
+        enc.opaque_u32(prep_msg)
+        enc.opaque_u32(prep_share)
+    elif tag == PP_FINISH:
+        enc.opaque_u32(prep_msg)
+    else:
+        raise ValueError(f"bad ping-pong tag {tag}")
+    return enc.bytes()
+
+
+def decode_pingpong(raw: bytes) -> tuple[int, bytes | None, bytes | None]:
+    """-> (tag, prep_msg, prep_share); raises DecodeError."""
+    dec = Decoder(raw)
+    tag = dec.u8()
+    if tag == PP_INITIALIZE:
+        out = (tag, None, dec.opaque_u32())
+    elif tag == PP_CONTINUE:
+        out = (tag, dec.opaque_u32(), dec.opaque_u32())
+    elif tag == PP_FINISH:
+        out = (tag, dec.opaque_u32(), None)
+    else:
+        raise DecodeError(f"bad ping-pong tag {tag}")
+    dec.finish()
+    return out
+
+
+class Prio3Wire:
+    """Per-circuit sizes + scalar encoders (client path uses these)."""
+
+    def __init__(self, circ: Circuit):
+        self.circ = circ
+        self.enc_size = circ.FIELD.ENCODED_SIZE
+        self.uses_jr = circ.joint_rand_len > 0
+
+    # sizes
+    @property
+    def leader_share_len(self) -> int:
+        n = (self.circ.input_len + self.circ.proof_len) * self.enc_size
+        return n + (SEED_SIZE if self.uses_jr else 0)
+
+    @property
+    def helper_share_len(self) -> int:
+        return SEED_SIZE + (SEED_SIZE if self.uses_jr else 0)
+
+    @property
+    def public_share_len(self) -> int:
+        return 2 * SEED_SIZE if self.uses_jr else 0
+
+    @property
+    def prep_share_len(self) -> int:
+        return self.circ.verifier_len * self.enc_size + (SEED_SIZE if self.uses_jr else 0)
+
+    @property
+    def prep_msg_len(self) -> int:
+        return SEED_SIZE if self.uses_jr else 0
+
+    # scalar encoders (ints)
+    def encode_leader_share(self, meas: list[int], proof: list[int], blind: bytes | None) -> bytes:
+        F = self.circ.FIELD
+        out = F.encode_vec(meas) + F.encode_vec(proof)
+        if self.uses_jr:
+            out += blind
+        return out
+
+    def decode_leader_share(self, raw: bytes) -> tuple[list[int], list[int], bytes | None]:
+        F = self.circ.FIELD
+        n = self.circ.input_len * self.enc_size
+        p = self.circ.proof_len * self.enc_size
+        if len(raw) != self.leader_share_len:
+            raise DecodeError("bad leader share length")
+        meas = F.decode_vec(raw[:n])
+        proof = F.decode_vec(raw[n : n + p])
+        blind = raw[n + p :] if self.uses_jr else None
+        return meas, proof, blind
+
+    def encode_helper_share(self, seed: bytes, blind: bytes | None) -> bytes:
+        return seed + (blind if self.uses_jr else b"")
+
+    def decode_helper_share(self, raw: bytes) -> tuple[bytes, bytes | None]:
+        if len(raw) != self.helper_share_len:
+            raise DecodeError("bad helper share length")
+        return raw[:SEED_SIZE], (raw[SEED_SIZE:] if self.uses_jr else None)
+
+    def encode_public_share(self, parts: list[bytes]) -> bytes:
+        return b"".join(parts) if self.uses_jr else b""
+
+    def decode_public_share(self, raw: bytes) -> list[bytes]:
+        if len(raw) != self.public_share_len:
+            raise DecodeError("bad public share length")
+        if not self.uses_jr:
+            return []
+        return [raw[:SEED_SIZE], raw[SEED_SIZE:]]
+
+    def encode_prep_share_raw(self, verifier_bytes: bytes, part: bytes | None) -> bytes:
+        """Column path: verifier row already encoded (encode_field_rows)."""
+        return verifier_bytes + (part if self.uses_jr else b"")
+
+    def encode_prep_share(self, verifier: list[int], part: bytes | None) -> bytes:
+        out = self.circ.FIELD.encode_vec(verifier)
+        if self.uses_jr:
+            out += part
+        return out
+
+    def decode_prep_share(self, raw: bytes) -> tuple[list[int], bytes | None]:
+        if len(raw) != self.prep_share_len:
+            raise DecodeError("bad prep share length")
+        n = self.circ.verifier_len * self.enc_size
+        verifier = self.circ.FIELD.decode_vec(raw[:n])
+        return verifier, (raw[n:] if self.uses_jr else None)
+
+
+def split_prep_share_columns(wire: Prio3Wire, jf, rows: list[bytes | None]):
+    """Batch of encoded prep shares -> (verifier limbs, part lanes, ok).
+
+    Used by the helper to stage the leader's prep shares
+    (PrepareInit.message payloads) into device arrays.
+    """
+    vlen = wire.circ.verifier_len
+    vbytes = vlen * wire.enc_size
+    ver_rows: list[bytes | None] = []
+    part_rows: list[bytes | None] = []
+    for row in rows:
+        if row is None or len(row) != wire.prep_share_len:
+            ver_rows.append(None)
+            part_rows.append(None)
+            continue
+        ver_rows.append(row[:vbytes])
+        part_rows.append(row[vbytes:] if wire.uses_jr else b"\x00" * SEED_SIZE)
+    limbs, ok = decode_field_rows(jf, ver_rows, vlen)
+    if wire.uses_jr:
+        part_lanes, ok2 = seeds_to_lanes(part_rows)
+        ok = ok & ok2
+    else:
+        part_lanes = np.zeros((len(rows), 2), dtype=np.uint64)
+    return limbs, part_lanes, ok

@@ -246,8 +246,10 @@ def test_collection_jobs(eph):
     assert ds.run_tx(lambda tx: tx.find_collection_job_by_query(task.task_id, b"query-bytes")) == cj
     assert ds.run_tx(lambda tx: tx.find_collection_job_by_query(task.task_id, b"other")) is None
 
-    # not collectable yet
-    assert ds.run_tx(lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 10)) == []
+    # START jobs are acquirable (the driver checks readiness itself)
+    acq0 = ds.run_tx(lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 10))
+    assert len(acq0) == 1
+    ds.run_tx(lambda tx: tx.release_collection_job(acq0[0]))
     import dataclasses
 
     cj2 = dataclasses.replace(

@@ -1,0 +1,263 @@
+"""End-to-end two-aggregator protocol test, in-process over loopback HTTP.
+
+The minimum end-to-end slice of SURVEY.md section 7: real client
+sharding + HPKE, leader upload handler, aggregation job creator, the
+batched leader driver stepping against a real helper HTTP handler,
+collection via the collection job driver, collector decrypt + unshard.
+Mirrors the reference's containerized pair test
+(integration_tests/tests/janus.rs:14) at process scope.
+"""
+
+import dataclasses
+
+import pytest
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.aggregation_job_creator import (
+    AggregationJobCreator,
+    AggregationJobCreatorConfig,
+)
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.collector import Collector, CollectorParameters
+from janus_tpu.core.auth import AuthenticationToken
+from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+from janus_tpu.core.http_client import HttpClient
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import Duration, Interval, Query, Role, Time
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+@pytest.fixture()
+def pair():
+    """A leader+helper pair on loopback HTTP with shared task config."""
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    leader_agg = Aggregator(leader_eph.datastore, clock, Config())
+    helper_agg = Aggregator(helper_eph.datastore, clock, Config())
+    leader_srv = DapServer(DapHttpApp(leader_agg)).start()
+    helper_srv = DapServer(DapHttpApp(helper_agg)).start()
+    yield {
+        "clock": clock,
+        "leader": leader_agg,
+        "helper": helper_agg,
+        "leader_srv": leader_srv,
+        "helper_srv": helper_srv,
+        "leader_ds": leader_eph.datastore,
+        "helper_ds": helper_eph.datastore,
+    }
+    leader_srv.stop()
+    helper_srv.stop()
+    leader_eph.cleanup()
+    helper_eph.cleanup()
+
+
+def provision(pair, vdaf):
+    collector_kp = generate_hpke_config_and_private_key(config_id=200)
+    agg_token = AuthenticationToken.random_bearer()
+    col_token = AuthenticationToken.random_bearer()
+    leader_task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+        .with_(
+            leader_aggregator_endpoint=pair["leader_srv"].url,
+            helper_aggregator_endpoint=pair["helper_srv"].url,
+            collector_hpke_config=collector_kp.config,
+            aggregator_auth_token=agg_token,
+            collector_auth_token=col_token,
+            min_batch_size=1,
+        )
+        .build()
+    )
+    helper_task = dataclasses.replace(
+        leader_task,
+        role=Role.HELPER,
+        hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+    )
+    pair["leader_ds"].run_tx(lambda tx: tx.put_task(leader_task))
+    pair["helper_ds"].run_tx(lambda tx: tx.put_task(helper_task))
+    return leader_task, helper_task, collector_kp
+
+
+CASES = [
+    (VdafInstance.count(), [0, 1, 1, 0, 1, 1, 1], 5),
+    (VdafInstance.histogram(length=4), [0, 1, 1, 3, 2, 1, 0], None),
+]
+
+
+@pytest.mark.parametrize("vdaf,measurements,expected", CASES, ids=["count", "histogram"])
+def test_full_protocol_round_trip(pair, vdaf, measurements, expected):
+    leader_task, helper_task, collector_kp = provision(pair, vdaf)
+    http = HttpClient()
+    clock = pair["clock"]
+
+    # --- upload over HTTP (client fetches HPKE configs from both) ---
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+    for m in measurements:
+        client.upload(m)
+
+    total, started = pair["leader_ds"].run_tx(
+        lambda tx: tx.count_client_reports_for_task(leader_task.task_id)
+    )
+    assert total == len(measurements) and started == 0
+
+    # --- create + drive aggregation jobs ---
+    creator = AggregationJobCreator(
+        pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+    )
+    assert creator.run_once() == 1
+
+    driver = AggregationJobDriver(pair["leader_ds"], http)
+    jd = JobDriver(JobDriverConfig(max_concurrent_job_workers=2), driver.acquirer(), driver.stepper)
+    assert jd.run_once() == 1
+
+    # both sides accumulated
+    from janus_tpu.messages import TimeInterval as TI
+
+    for ds, task in ((pair["leader_ds"], leader_task), (pair["helper_ds"], helper_task)):
+        rows = ds.run_tx(
+            lambda tx, task=task: tx.get_batch_aggregations_intersecting_interval(
+                task.task_id, Interval(Time(1_599_998_400 - 3600 * 24), Duration(3600 * 100))
+            )
+        )
+        assert sum(r.report_count for r in rows) == len(measurements)
+
+    # --- collect ---
+    start = Time(clock.now().seconds).to_batch_interval_start(leader_task.time_precision)
+    query = Query.time_interval(
+        Interval(Time(start.seconds - 3600), Duration(2 * 3600))
+    )
+    collector = Collector(
+        CollectorParameters(
+            leader_task.task_id,
+            pair["leader_srv"].url,
+            leader_task.collector_auth_token,
+            collector_kp,
+        ),
+        vdaf,
+        http,
+    )
+    job_id = collector.start_collection(query)
+
+    cdriver = CollectionJobDriver(pair["leader_ds"], http)
+    cjd = JobDriver(JobDriverConfig(max_concurrent_job_workers=1), cdriver.acquirer(), cdriver.stepper)
+    assert cjd.run_once() == 1
+
+    result = collector.poll_once(job_id, query)
+    assert result.report_count == len(measurements)
+    if vdaf.kind == "count":
+        assert result.aggregate_result == expected
+    else:
+        want = [0] * vdaf.length
+        for m in measurements:
+            want[m] += 1
+        assert result.aggregate_result == want
+
+
+def test_upload_rejections(pair):
+    vdaf = VdafInstance.count()
+    leader_task, _, _ = provision(pair, vdaf)
+    http = HttpClient()
+    clock = pair["clock"]
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+
+    # replayed report id -> reportRejected
+    report = client.prepare_report(1)
+    for expected_status in (201, 400):
+        status, body = http.put(
+            params.upload_uri(),
+            report.to_bytes(),
+            {"Content-Type": "application/dap-report"},
+        )
+        assert status == expected_status, body
+
+    # report from the future -> reportTooEarly problem
+    future = client.prepare_report(1, when=clock.now().add(Duration(7200)))
+    status, body = http.put(
+        params.upload_uri(), future.to_bytes(), {"Content-Type": "application/dap-report"}
+    )
+    assert status == 400 and b"reportTooEarly" in body
+
+    # unknown task -> unrecognizedTask
+    import base64
+
+    bogus = base64.urlsafe_b64encode(b"\x99" * 32).decode().rstrip("=")
+    status, body = http.put(
+        pair["leader_srv"].url.rstrip("/") + f"/tasks/{bogus}/reports",
+        report.to_bytes(),
+        {"Content-Type": "application/dap-report"},
+    )
+    assert status == 400 and b"unrecognizedTask" in body
+
+
+def test_helper_auth_and_idempotency(pair):
+    """Bad auth rejected; duplicate init with same body returns same resp."""
+    vdaf = VdafInstance.count()
+    leader_task, helper_task, _ = provision(pair, vdaf)
+    http = HttpClient()
+    clock = pair["clock"]
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+    for m in (1, 0, 1):
+        client.upload(m)
+    AggregationJobCreator(
+        pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+    ).run_once()
+
+    # drive once to produce a real init request via a capturing client
+    captured = {}
+
+    class CapturingHttp(HttpClient):
+        def put(self, url, body, headers=None):
+            if "aggregation_jobs" in url:
+                captured["url"] = url
+                captured["body"] = body
+                captured["headers"] = headers
+            return super().put(url, body, headers)
+
+    driver = AggregationJobDriver(pair["leader_ds"], CapturingHttp())
+    jd = JobDriver(JobDriverConfig(), driver.acquirer(), driver.stepper)
+    assert jd.run_once() == 1
+    assert "body" in captured
+
+    # replay the identical init request: identical response, no double count
+    s1, b1 = http.put(captured["url"], captured["body"], captured["headers"])
+    assert s1 == 200
+    rows = pair["helper_ds"].run_tx(
+        lambda tx: tx.get_batch_aggregations_intersecting_interval(
+            helper_task.task_id, Interval(Time(0), Duration(1 << 40))
+        )
+    )
+    assert sum(r.report_count for r in rows) == 3  # not 6
+
+    # same job id, different body -> invalidMessage
+    s2, b2 = http.put(captured["url"], captured["body"][:-1] + b"\x00", captured["headers"])
+    assert s2 == 400 and b"invalidMessage" in b2
+
+    # bad auth -> unauthorizedRequest
+    bad_headers = dict(captured["headers"])
+    bad_headers["Authorization"] = "Bearer wrong"
+    s3, b3 = http.put(captured["url"], captured["body"], bad_headers)
+    assert s3 == 400 and b"unauthorizedRequest" in b3
