@@ -86,12 +86,22 @@ class Config:
 class TaskAggregator:
     """Per-task protocol ops (reference aggregator.rs:797)."""
 
-    def __init__(self, task: Task, cfg: Config):
+    def __init__(self, task: Task, cfg: Config, global_hpke_keypairs=None):
         self.task = task
         self.cfg = cfg
         self.circ = circuit_for(task.vdaf)
         self.wire = Prio3Wire(self.circ)
         self.engine = engine_cache(task.vdaf, task.vdaf_verify_key)
+        self.global_hpke_keypairs = global_hpke_keypairs
+
+    def _hpke_keypair(self, config_id):
+        """Task keypair, falling back to global keys (reference
+        aggregator.rs:1676 global-key fallback; required for taskprov
+        tasks, which carry no per-task HPKE keys)."""
+        kp = self.task.hpke_keypair(config_id)
+        if kp is None and self.global_hpke_keypairs is not None:
+            kp = self.global_hpke_keypairs.keypair(config_id)
+        return kp
 
     # ------------------------------------------------------------------
     # hpke config
@@ -118,7 +128,7 @@ class TaskAggregator:
             raise errors.InvalidMessage(f"bad public share: {e}", task.task_id)
 
         # decrypt + decode the leader input share at upload time (:1391)
-        keypair = task.hpke_keypair(report.leader_encrypted_input_share.config_id)
+        keypair = self._hpke_keypair(report.leader_encrypted_input_share.config_id)
         if keypair is None:
             raise errors.OutdatedHpkeConfig("unknown HPKE config id", task.task_id)
         aad = InputShareAad(task.task_id, report.metadata, report.public_share).to_bytes()
@@ -196,7 +206,7 @@ class TaskAggregator:
             if task.report_expired(md.time, now):
                 prep_err[i] = PrepareError.REPORT_DROPPED
                 continue
-            keypair = task.hpke_keypair(rs.encrypted_input_share.config_id)
+            keypair = self._hpke_keypair(rs.encrypted_input_share.config_id)
             if keypair is None:
                 prep_err[i] = PrepareError.HPKE_UNKNOWN_CONFIG_ID
                 continue
@@ -512,20 +522,108 @@ class Aggregator:
     """Top-level request router over tasks (reference aggregator.rs:156)."""
 
     def __init__(self, ds: Datastore, clock: Clock | None = None, cfg: Config | None = None):
+        from .cache import GlobalHpkeKeypairCache, PeerAggregatorCache
+
         self.ds = ds
         self.clock = clock or RealClock()
         self.cfg = cfg or Config()
         self._task_aggs: dict[bytes, TaskAggregator] = {}
+        self.global_hpke_keypairs = GlobalHpkeKeypairCache(ds)
+        self.peer_aggregators = PeerAggregatorCache(ds) if self.cfg.taskprov_enabled else None
 
-    def task_aggregator_for(self, task_id: TaskId) -> TaskAggregator:
+    def task_aggregator_for(
+        self, task_id: TaskId, taskprov_task_config=None, headers=None, peer_role: Role = Role.LEADER
+    ) -> TaskAggregator:
+        """peer_role: role the requesting peer plays when provisioning
+        via taskprov — the HTTP handler knows which endpoint was hit
+        (helper endpoints are called by the leader, so Role.LEADER)."""
         ta = self._task_aggs.get(task_id.data)
         if ta is None:
             task = self.ds.run_tx(lambda tx: tx.get_task(task_id), "get_task")
             if task is None:
-                raise errors.UnrecognizedTask("unknown task", task_id)
-            ta = TaskAggregator(task, self.cfg)
+                if self.cfg.taskprov_enabled and taskprov_task_config is not None:
+                    # opt in, then retry (reference aggregator.rs:368-381)
+                    self.taskprov_opt_in(
+                        peer_role, task_id, taskprov_task_config, headers or {}
+                    )
+                    task = self.ds.run_tx(lambda tx: tx.get_task(task_id), "get_task")
+                if task is None:
+                    raise errors.UnrecognizedTask("unknown task", task_id)
+            ta = TaskAggregator(task, self.cfg, self.global_hpke_keypairs)
             self._task_aggs[task_id.data] = ta
         return ta
+
+    # ------------------------------------------------------------------
+    # taskprov (reference aggregator.rs:639-776)
+    # ------------------------------------------------------------------
+    def taskprov_authorize_request(self, peer_role: Role, task_id: TaskId, task_config, headers):
+        """Validate + authenticate a taskprov request against the
+        pre-shared peer; returns the PeerAggregator
+        (reference taskprov_authorize_request, aggregator.rs:724)."""
+        urls = task_config.aggregator_endpoints
+        if len(urls) != 2:
+            raise errors.InvalidMessage(
+                "taskprov configuration is missing one or both aggregators", task_id
+            )
+        peer_url = urls[0] if peer_role == Role.LEADER else urls[1]
+        peer = self.peer_aggregators.get(peer_url, peer_role) if self.peer_aggregators else None
+        if peer is None:
+            raise errors.InvalidTask(f"no such peer aggregator {peer_url}", task_id)
+        if not peer.check_aggregator_auth(headers or {}):
+            raise errors.UnauthorizedRequest("bad taskprov aggregator auth", task_id)
+        if self.clock.now() > task_config.task_expiration:
+            raise errors.InvalidTask("task expired", task_id)
+        return peer
+
+    def taskprov_opt_in(self, peer_role: Role, task_id: TaskId, task_config, headers) -> None:
+        """Provision a task from an in-band TaskConfig
+        (reference taskprov_opt_in, aggregator.rs:641-719)."""
+        from ..messages.taskprov import TaskprovQueryType
+        from ..task import QueryTypeConfig
+
+        peer = self.taskprov_authorize_request(peer_role, task_id, task_config, headers)
+        try:
+            vdaf_instance = task_config.vdaf_config.vdaf_type.to_vdaf_instance()
+        except ValueError as e:
+            raise errors.InvalidTask(str(e), task_id)
+        our_role = Role.HELPER if peer_role == Role.LEADER else Role.LEADER
+        verify_key = peer.derive_vdaf_verify_key(task_id)
+
+        qc = task_config.query_config
+        if qc.query_type == TaskprovQueryType.TIME_INTERVAL:
+            query_type = QueryTypeConfig.time_interval()
+        elif qc.query_type == TaskprovQueryType.FIXED_SIZE:
+            query_type = QueryTypeConfig.fixed_size(max_batch_size=qc.max_batch_size)
+        else:
+            raise errors.InvalidTask(f"unsupported query type {qc.query_type}", task_id)
+
+        task = Task(
+            task_id=task_id,
+            leader_aggregator_endpoint=task_config.leader_url(),
+            helper_aggregator_endpoint=task_config.helper_url(),
+            query_type=query_type,
+            vdaf=vdaf_instance,
+            role=our_role,
+            vdaf_verify_key=verify_key,
+            max_batch_query_count=qc.max_batch_query_count,
+            task_expiration=task_config.task_expiration,
+            report_expiry_age=peer.report_expiry_age,
+            min_batch_size=qc.min_batch_size,
+            time_precision=qc.time_precision,
+            tolerable_clock_skew=peer.tolerable_clock_skew,
+            collector_hpke_config=peer.collector_hpke_config,
+            aggregator_auth_token=None,  # peer tokens authenticate taskprov
+            collector_auth_token=None,
+            hpke_keys=(),  # taskprov tasks use global HPKE keys
+        )
+
+        def put(tx):
+            # concurrent opt-in by another replica is benign (reference
+            # aggregator.rs:699-707): same config -> same task
+            if tx.get_task(task_id) is None:
+                tx.put_task(task)
+
+        self.ds.run_tx(put, "taskprov_put_task")
 
     # role/auth checks used by the HTTP layer
     def check_aggregator_auth(self, task: Task, headers) -> None:

@@ -98,3 +98,10 @@ class BatchOverlap(AggregatorError):
 class StepMismatch(AggregatorError):
     status = 400
     problem = DapProblemType.STEP_MISMATCH
+
+
+class InvalidTask(AggregatorError):
+    """taskprov opt-out (reference error.rs InvalidTask/OptOutReason)."""
+
+    status = 400
+    problem = DapProblemType.INVALID_TASK

@@ -31,6 +31,7 @@ from ..messages import (
     CollectionJobId,
     CollectionReq,
     Report,
+    Role,
     TaskId,
 )
 from ..messages.codec import DecodeError
@@ -66,6 +67,39 @@ class DapHttpApp:
     def __init__(self, aggregator: Aggregator):
         self.agg = aggregator
 
+    def _taskprov_config(self, task_id: TaskId, headers):
+        """Decode + verify the dap-taskprov header (reference
+        http_handlers.rs:575-607 parse_taskprov_header): the taskprov
+        task ID must equal SHA-256 of the encoded TaskConfig."""
+        if not self.agg.cfg.taskprov_enabled:
+            return None
+        from ..messages.taskprov import TASKPROV_HEADER, TaskConfig
+
+        lowered = {k.lower(): v for k, v in headers.items()}
+        raw = lowered.get(TASKPROV_HEADER)
+        if raw is None:
+            return None
+        try:
+            encoded = base64.urlsafe_b64decode(raw + "=" * (-len(raw) % 4))
+        except Exception:
+            raise InvalidMessage("taskprov header could not be decoded", task_id)
+        import hashlib
+
+        if hashlib.sha256(encoded).digest() != task_id.data:
+            raise InvalidMessage(
+                "derived taskprov task ID does not match task config", task_id
+            )
+        return TaskConfig.from_bytes(encoded)
+
+    def _check_helper_auth(self, ta, task_id, headers, taskprov_config):
+        """Aggregator (leader->helper) auth: taskprov peer tokens when
+        the header is present, per-task token otherwise
+        (reference aggregator.rs:420-432)."""
+        if taskprov_config is not None:
+            self.agg.taskprov_authorize_request(Role.LEADER, task_id, taskprov_config, headers)
+        else:
+            self.agg.check_aggregator_auth(ta.task, headers)
+
     def handle(self, method: str, path: str, query: dict, headers, body: bytes):
         """-> (status, content_type, body_bytes)."""
         try:
@@ -87,18 +121,38 @@ class DapHttpApp:
                 json.dumps(doc).encode(),
             )
         except DecodeError as e:
-            return 400, "text/plain", f"undecodable request: {e}".encode()
+            # codec failures are invalidMessage problem documents
+            # (reference error.rs maps Error::MessageDecode)
+            from ..messages.problem_type import DapProblemType
+
+            doc = DapProblemType.INVALID_MESSAGE.document(detail=f"undecodable request: {e}")
+            return 400, "application/problem+json", json.dumps(doc).encode()
         except Exception:
             log.exception("unhandled error in DAP handler")
             return 500, "text/plain", b"internal error"
 
     # --- handlers ---
     def h_hpke_config(self, match, query, headers, body):
+        from ..messages import HpkeConfigList
+
         tid = query.get("task_id")
         if tid is None:
             raise InvalidMessage("task_id query parameter required")
-        ta = self.agg.task_aggregator_for(TaskId(_b64dec(tid, 32)))
-        return 200, "application/dap-hpke-config-list", ta.hpke_config_list().to_bytes()
+        task_id = TaskId(_b64dec(tid, 32))
+        try:
+            ta = self.agg.task_aggregator_for(task_id)
+            configs = ta.hpke_config_list()
+            if not configs.configs:
+                raise UnrecognizedTask("no per-task keys", task_id)
+        except UnrecognizedTask:
+            # taskprov tasks aren't locally provisioned at upload time and
+            # carry no per-task keys: advertise the global keys instead
+            # (reference aggregator.rs:276-280)
+            globals_ = self.agg.global_hpke_keypairs.configs()
+            if not (self.agg.cfg.taskprov_enabled and globals_):
+                raise
+            configs = HpkeConfigList(tuple(globals_))
+        return 200, "application/dap-hpke-config-list", configs.to_bytes()
 
     def h_upload(self, match, query, headers, body):
         task_id = TaskId(_b64dec(match.group(1), 32))
@@ -110,16 +164,19 @@ class DapHttpApp:
     def h_aggregate_init(self, match, query, headers, body):
         task_id = TaskId(_b64dec(match.group(1), 32))
         job_id = AggregationJobId(_b64dec(match.group(2), 16))
-        ta = self.agg.task_aggregator_for(task_id)
-        self.agg.check_aggregator_auth(ta.task, headers)
+        taskprov_config = self._taskprov_config(task_id, headers)
+        # helper endpoint: the provisioning peer is the leader
+        ta = self.agg.task_aggregator_for(task_id, taskprov_config, headers, peer_role=Role.LEADER)
+        self._check_helper_auth(ta, task_id, headers, taskprov_config)
         req = AggregationJobInitializeReq.from_bytes(body)
         resp = ta.handle_aggregate_init(self.agg.ds, self.agg.clock, job_id, req, body)
         return 200, "application/dap-aggregation-job-resp", resp.to_bytes()
 
     def h_aggregate_continue(self, match, query, headers, body):
         task_id = TaskId(_b64dec(match.group(1), 32))
+        taskprov_config = self._taskprov_config(task_id, headers)
         ta = self.agg.task_aggregator_for(task_id)
-        self.agg.check_aggregator_auth(ta.task, headers)
+        self._check_helper_auth(ta, task_id, headers, taskprov_config)
         # all supported VDAFs are 1-round: a continue request is always a
         # step mismatch (reference aggregation_job_continue.rs:58-84)
         from .errors import StepMismatch
@@ -155,8 +212,9 @@ class DapHttpApp:
 
     def h_aggregate_share(self, match, query, headers, body):
         task_id = TaskId(_b64dec(match.group(1), 32))
+        taskprov_config = self._taskprov_config(task_id, headers)
         ta = self.agg.task_aggregator_for(task_id)
-        self.agg.check_aggregator_auth(ta.task, headers)
+        self._check_helper_auth(ta, task_id, headers, taskprov_config)
         req = AggregateShareReq.from_bytes(body)
         resp = ta.handle_aggregate_share(self.agg.ds, req)
         return 200, "application/dap-aggregate-share", resp.to_bytes()

@@ -287,6 +287,49 @@ class Transaction:
         ):
             self._c.execute(f"DELETE FROM {table} WHERE task_id = ?", (task_id.data,))
 
+    # ---- taskprov peer aggregators (reference datastore.rs:4436-4748) ----
+    def put_taskprov_peer_aggregator(self, peer) -> None:
+        import json
+
+        row_key = peer.endpoint.encode() + bytes([int(peer.role)])
+        doc = json.dumps(peer.to_dict()).encode()
+        enc = self._crypter.encrypt("taskprov_peer_aggregators", row_key, "doc", doc)
+        self._c.execute(
+            "INSERT OR REPLACE INTO taskprov_peer_aggregators (endpoint, role, doc)"
+            " VALUES (?,?,?)",
+            (peer.endpoint, int(peer.role), enc),
+        )
+
+    def _decode_peer_aggregator(self, endpoint: str, role: int, doc_enc: bytes):
+        import json
+
+        from ..taskprov import PeerAggregator
+
+        row_key = endpoint.encode() + bytes([int(role)])
+        doc = self._crypter.decrypt("taskprov_peer_aggregators", row_key, "doc", doc_enc)
+        return PeerAggregator.from_dict(json.loads(doc))
+
+    def get_taskprov_peer_aggregator(self, endpoint: str, role):
+        row = self._c.execute(
+            "SELECT doc FROM taskprov_peer_aggregators WHERE endpoint = ? AND role = ?",
+            (endpoint, int(role)),
+        ).fetchone()
+        if row is None:
+            return None
+        return self._decode_peer_aggregator(endpoint, int(role), row[0])
+
+    def get_taskprov_peer_aggregators(self) -> list:
+        rows = self._c.execute(
+            "SELECT endpoint, role, doc FROM taskprov_peer_aggregators ORDER BY endpoint, role"
+        ).fetchall()
+        return [self._decode_peer_aggregator(e, r, d) for e, r, d in rows]
+
+    def delete_taskprov_peer_aggregator(self, endpoint: str, role) -> None:
+        self._c.execute(
+            "DELETE FROM taskprov_peer_aggregators WHERE endpoint = ? AND role = ?",
+            (endpoint, int(role)),
+        )
+
     # ---- client reports (reference datastore.rs:1162-1723) ----
     def put_client_report(self, report: LeaderStoredReport) -> bool:
         """Returns False if the report id already exists (replay)."""

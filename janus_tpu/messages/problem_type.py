@@ -28,6 +28,7 @@ class DapProblemType(enum.Enum):
     BATCH_OVERLAP = "batchOverlap"
     STEP_MISMATCH = "stepMismatch"
     UNRECOGNIZED_COLLECTION_JOB = "unrecognizedCollectionJob"
+    INVALID_TASK = "invalidTask"  # taskprov opt-out
 
     @property
     def type_uri(self) -> str:

@@ -1,0 +1,247 @@
+/* Host-side native XOF: Keccak-f[1600] / SHAKE128 batch expansion.
+ *
+ * The reference keeps XOF share expansion in native code (the prio
+ * crate's XofTurboShake128, consumed from e.g.
+ * aggregator/src/aggregator/aggregation_job_driver.rs:363); this is the
+ * TPU build's equivalent for the *host* side of the pipeline: clients,
+ * tools, and the staging path that feeds device buffers. The device
+ * side has its own batched Keccak (janus_tpu/vdaf/keccak_jax.py).
+ *
+ * Stream framing matches janus_tpu.vdaf.xof.XofShake128 byte-for-byte:
+ *     stream = SHAKE128(dst16 || seed16 || binder)
+ * and field sampling is rejection sampling of ENCODED_SIZE-byte
+ * little-endian chunks (< modulus).
+ *
+ * Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+ * All entry points are thread-safe; the batch expander shards the seed
+ * axis over pthreads.
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+#include <stdlib.h>
+#include <pthread.h>
+
+#define RATE 168 /* SHAKE128 rate in bytes */
+
+static const uint64_t KECCAK_RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+static inline uint64_t rotl64(uint64_t x, int s) {
+  return (x << s) | (x >> (64 - s));
+}
+
+static void keccakf(uint64_t st[25]) {
+  uint64_t bc[5], t;
+  for (int round = 0; round < 24; round++) {
+    /* theta */
+    for (int i = 0; i < 5; i++)
+      bc[i] = st[i] ^ st[i + 5] ^ st[i + 10] ^ st[i + 15] ^ st[i + 20];
+    for (int i = 0; i < 5; i++) {
+      t = bc[(i + 4) % 5] ^ rotl64(bc[(i + 1) % 5], 1);
+      for (int j = 0; j < 25; j += 5) st[j + i] ^= t;
+    }
+    /* rho + pi */
+    static const int rho[24] = {1,  3,  6,  10, 15, 21, 28, 36, 45, 55, 2,  14,
+                                27, 41, 56, 8,  25, 43, 62, 18, 39, 61, 20, 44};
+    static const int pi[24] = {10, 7,  11, 17, 18, 3,  5,  16, 8,  21, 24, 4,
+                               15, 23, 19, 13, 12, 2,  20, 14, 22, 9,  6,  1};
+    t = st[1];
+    for (int i = 0; i < 24; i++) {
+      uint64_t tmp = st[pi[i]];
+      st[pi[i]] = rotl64(t, rho[i]);
+      t = tmp;
+    }
+    /* chi */
+    for (int j = 0; j < 25; j += 5) {
+      for (int i = 0; i < 5; i++) bc[i] = st[j + i];
+      for (int i = 0; i < 5; i++)
+        st[j + i] = bc[i] ^ ((~bc[(i + 1) % 5]) & bc[(i + 2) % 5]);
+    }
+    /* iota */
+    st[0] ^= KECCAK_RC[round];
+  }
+}
+
+typedef struct {
+  uint64_t st[25];
+  size_t pos; /* squeeze position within current rate block */
+} shake_ctx;
+
+/* One-shot absorb (message fully known up front) + pad. */
+static void shake128_absorb(shake_ctx *ctx, const uint8_t *in, size_t inlen) {
+  memset(ctx->st, 0, sizeof(ctx->st));
+  uint8_t *stb = (uint8_t *)ctx->st; /* little-endian hosts only */
+  while (inlen >= RATE) {
+    for (size_t i = 0; i < RATE; i++) stb[i] ^= in[i];
+    keccakf(ctx->st);
+    in += RATE;
+    inlen -= RATE;
+  }
+  for (size_t i = 0; i < inlen; i++) stb[i] ^= in[i];
+  stb[inlen] ^= 0x1f;
+  stb[RATE - 1] ^= 0x80;
+  keccakf(ctx->st);
+  ctx->pos = 0;
+}
+
+static void shake128_squeeze(shake_ctx *ctx, uint8_t *out, size_t n) {
+  const uint8_t *stb = (const uint8_t *)ctx->st;
+  while (n > 0) {
+    if (ctx->pos == RATE) {
+      keccakf(ctx->st);
+      ctx->pos = 0;
+    }
+    size_t take = RATE - ctx->pos;
+    if (take > n) take = n;
+    memcpy(out, stb + ctx->pos, take);
+    out += take;
+    ctx->pos += take;
+    n -= take;
+  }
+}
+
+void janus_shake128(const uint8_t *in, size_t inlen, uint8_t *out,
+                    size_t outlen) {
+  shake_ctx ctx;
+  shake128_absorb(&ctx, in, inlen);
+  shake128_squeeze(&ctx, out, outlen);
+}
+
+/* Rejection-sample `length` field elements from one seed's stream.
+ * limbs = 1 (Field64) or 2 (Field128); element = limbs little-endian u64.
+ * out: length*limbs u64 (element-major: e0.lo, e0.hi, e1.lo, ...). */
+static void expand_one(const uint8_t *dst16, const uint8_t *seed16,
+                       const uint8_t *binder, size_t binder_len, size_t length,
+                       int limbs, uint64_t mod_lo, uint64_t mod_hi,
+                       uint64_t *out) {
+  uint8_t msg_stack[512];
+  uint8_t *msg = msg_stack;
+  size_t msg_len = 32 + binder_len;
+  if (msg_len > sizeof(msg_stack)) msg = (uint8_t *)malloc(msg_len);
+  memcpy(msg, dst16, 16);
+  memcpy(msg + 16, seed16, 16);
+  if (binder_len) memcpy(msg + 32, binder, binder_len);
+  shake_ctx ctx;
+  shake128_absorb(&ctx, msg, msg_len);
+  if (msg != msg_stack) free(msg);
+
+  size_t got = 0;
+  uint8_t chunk[16];
+  while (got < length) {
+    shake128_squeeze(&ctx, chunk, (size_t)(8 * limbs));
+    uint64_t lo, hi = 0;
+    memcpy(&lo, chunk, 8);
+    if (limbs == 2) memcpy(&hi, chunk + 8, 8);
+    int ok;
+    if (limbs == 1)
+      ok = lo < mod_lo;
+    else
+      ok = (hi < mod_hi) || (hi == mod_hi && lo < mod_lo);
+    if (ok) {
+      out[got * limbs] = lo;
+      if (limbs == 2) out[got * limbs + 1] = hi;
+      got++;
+    }
+  }
+}
+
+typedef struct {
+  const uint8_t *dst16;
+  const uint8_t *seeds;   /* n * 16 bytes */
+  const uint8_t *binders; /* n * binder_len bytes (may be NULL) */
+  size_t binder_len;
+  size_t length;
+  int limbs;
+  uint64_t mod_lo, mod_hi;
+  uint64_t *out; /* n * length * limbs */
+  size_t begin, end;
+} expand_job;
+
+static void *expand_worker(void *arg) {
+  expand_job *job = (expand_job *)arg;
+  for (size_t i = job->begin; i < job->end; i++) {
+    expand_one(job->dst16, job->seeds + 16 * i,
+               job->binders ? job->binders + job->binder_len * i : NULL,
+               job->binders ? job->binder_len : 0, job->length, job->limbs,
+               job->mod_lo, job->mod_hi,
+               job->out + i * job->length * job->limbs);
+  }
+  return NULL;
+}
+
+/* Expand n seeds -> [n, length, limbs] u64. binders: per-seed fixed-size
+ * binder block (NULL for empty binders). Returns 0 on success. */
+int janus_expand_field_batch(const uint8_t *dst16, const uint8_t *seeds,
+                             size_t n, const uint8_t *binders,
+                             size_t binder_len, size_t length, int limbs,
+                             uint64_t mod_lo, uint64_t mod_hi, uint64_t *out,
+                             int n_threads) {
+  if (limbs != 1 && limbs != 2) return -1;
+  if (n_threads < 1) n_threads = 1;
+  if ((size_t)n_threads > n) n_threads = (int)(n ? n : 1);
+  if (n == 0) return 0;
+
+  if (n_threads == 1) {
+    expand_job job = {dst16, seeds, binders, binder_len, length,
+                      limbs, mod_lo, mod_hi, out, 0, n};
+    expand_worker(&job);
+    return 0;
+  }
+  pthread_t *tids = (pthread_t *)malloc(sizeof(pthread_t) * n_threads);
+  expand_job *jobs = (expand_job *)malloc(sizeof(expand_job) * n_threads);
+  size_t per = (n + n_threads - 1) / n_threads;
+  int spawned = 0;
+  for (int t = 0; t < n_threads; t++) {
+    size_t b = per * t, e = b + per;
+    if (b >= n) break;
+    if (e > n) e = n;
+    jobs[t] = (expand_job){dst16, seeds, binders, binder_len, length,
+                           limbs, mod_lo, mod_hi, out, b, e};
+    if (pthread_create(&tids[t], NULL, expand_worker, &jobs[t]) != 0) {
+      /* fall back to running this stripe inline */
+      expand_worker(&jobs[t]);
+      tids[t] = 0;
+      continue;
+    }
+    spawned++;
+    (void)spawned;
+  }
+  for (int t = 0; t < n_threads; t++) {
+    size_t b = per * t;
+    if (b >= n) break;
+    if (tids[t]) pthread_join(tids[t], NULL);
+  }
+  free(tids);
+  free(jobs);
+  return 0;
+}
+
+/* Batch derive_seed: out[i] = SHAKE128(dst16 || seed_i || binder_i)[:16].
+ * binders: per-seed fixed-size block (NULL for empty). */
+int janus_derive_seed_batch(const uint8_t *dst16, const uint8_t *seeds,
+                            size_t n, const uint8_t *binders, size_t binder_len,
+                            uint8_t *out) {
+  for (size_t i = 0; i < n; i++) {
+    uint8_t msg_stack[512];
+    uint8_t *msg = msg_stack;
+    size_t msg_len = 32 + binder_len;
+    if (msg_len > sizeof(msg_stack)) msg = (uint8_t *)malloc(msg_len);
+    memcpy(msg, dst16, 16);
+    memcpy(msg + 16, seeds + 16 * i, 16);
+    if (binder_len) memcpy(msg + 32, binders + binder_len * i, binder_len);
+    shake_ctx ctx;
+    shake128_absorb(&ctx, msg, msg_len);
+    if (msg != msg_stack) free(msg);
+    shake128_squeeze(&ctx, out + 16 * i, 16);
+  }
+  return 0;
+}

@@ -751,4 +751,6 @@ class VdafError(Exception):
 
 
 def prng_next_vec(field, seed, dst_, binder, length):
-    return XofShake128(seed, dst_, binder).next_vec(field, length)
+    from .xof import prng_expand
+
+    return prng_expand(field, seed, dst_, binder, length)

@@ -99,5 +99,40 @@ class XofShake128:
 
 
 def prng_expand(field, seed: bytes, dst_: bytes, binder: bytes, length: int):
-    """Expand a seed into a vector of field elements (host path)."""
+    """Expand a seed into a vector of field elements (host path).
+
+    Uses the native C Keccak (janus_tpu.native, the analog of the
+    reference keeping XOF expansion in native code) when available;
+    byte-identical pure-Python fallback otherwise.
+    """
+    out = prng_expand_batch(field, dst_, [seed], [binder] if binder else None, length)
+    if out is not None:
+        return out[0]
     return XofShake128(seed, dst_, binder).next_vec(field, length)
+
+
+def prng_expand_batch(field, dst_: bytes, seeds, binders, length: int):
+    """Expand many seeds at once on host threads -> list of int vectors.
+
+    seeds: list of 16-byte seeds; binders: matching list of equal-length
+    binders (or None for empty binders). Returns None when the native
+    library is unavailable (callers fall back to the scalar path).
+    """
+    from .. import native
+
+    if not native.available():
+        return None
+    limbs = field.ENCODED_SIZE // 8
+    if limbs not in (1, 2) or field.ENCODED_SIZE != 8 * limbs:
+        return None  # native path only supports whole-u64-lane encodings
+    arr = native.expand_field_batch(
+        dst_.ljust(DST_SIZE, b"\x00"), seeds, binders, length, limbs, field.MODULUS
+    )
+    if arr is None:
+        return None
+    if limbs == 1:
+        return [row[:, 0].tolist() for row in arr]
+    return [
+        (row[:, 0].astype(object) + (row[:, 1].astype(object) << 64)).tolist()
+        for row in arr
+    ]

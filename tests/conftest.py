@@ -13,6 +13,13 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent compilation cache: the suite jit-compiles many small
+# programs; caching them across runs keeps `pytest tests/` fast.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/jax_comp_cache")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,323 @@
+"""taskprov tests: wire round-trips, verify-key derivation, datastore
+peers, and the full helper-side in-band provisioning flow over HTTP
+(reference taskprov_tests.rs / aggregator.rs:639-776)."""
+
+import base64
+import dataclasses
+
+import pytest
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.aggregation_job_creator import (
+    AggregationJobCreator,
+    AggregationJobCreatorConfig,
+)
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.collector import Collector, CollectorParameters
+from janus_tpu.core.auth import AuthenticationToken
+from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+from janus_tpu.core.http_client import HttpClient
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import Duration, Interval, Query, Role, Time
+from janus_tpu.messages.taskprov import (
+    TASKPROV_HEADER,
+    DpConfig,
+    QueryConfig,
+    TaskConfig,
+    TaskprovQueryType,
+    VdafConfig,
+    VdafType,
+)
+from janus_tpu.task import QueryTypeConfig, Task, TaskBuilder
+from janus_tpu.taskprov import PeerAggregatorBuilder, hkdf_sha256
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+def sample_task_config(leader_url, helper_url, query_type=TaskprovQueryType.TIME_INTERVAL):
+    qc = QueryConfig(
+        time_precision=Duration(3600),
+        max_batch_query_count=1,
+        min_batch_size=1,
+        query_type=query_type,
+        max_batch_size=100 if query_type == TaskprovQueryType.FIXED_SIZE else None,
+    )
+    return TaskConfig(
+        task_info=b"taskprov e2e test",
+        aggregator_endpoints=(leader_url, helper_url),
+        query_config=qc,
+        task_expiration=Time(2_000_000_000),
+        vdaf_config=VdafConfig(DpConfig(), VdafType.prio3_count()),
+    )
+
+
+class TestWire:
+    @pytest.mark.parametrize(
+        "vt",
+        [
+            VdafType.prio3_count(),
+            VdafType.prio3_sum(32),
+            VdafType.prio3_histogram([10, 20, 30]),
+            VdafType.poplar1(16),
+        ],
+        ids=["count", "sum", "histogram", "poplar1"],
+    )
+    def test_round_trip(self, vt):
+        cfg = sample_task_config("https://l.example/", "https://h.example/")
+        cfg = dataclasses.replace(cfg, vdaf_config=VdafConfig(DpConfig(), vt))
+        assert TaskConfig.from_bytes(cfg.to_bytes()) == cfg
+
+    def test_fixed_size_round_trip(self):
+        cfg = sample_task_config(
+            "https://l.example/", "https://h.example/", TaskprovQueryType.FIXED_SIZE
+        )
+        got = TaskConfig.from_bytes(cfg.to_bytes())
+        assert got.query_config.max_batch_size == 100
+
+    def test_task_id_is_sha256_of_config(self):
+        import hashlib
+
+        cfg = sample_task_config("https://l.example/", "https://h.example/")
+        assert cfg.computed_task_id().data == hashlib.sha256(cfg.to_bytes()).digest()
+
+    def test_vdaf_instance_mapping(self):
+        assert VdafType.prio3_count().to_vdaf_instance() == VdafInstance.count()
+        assert VdafType.prio3_sum(8).to_vdaf_instance() == VdafInstance.sum(8)
+        # bucket boundaries -> +1 buckets
+        assert VdafType.prio3_histogram([1, 2, 3]).to_vdaf_instance() == VdafInstance.histogram(4)
+        with pytest.raises(ValueError):
+            VdafType.poplar1(8).to_vdaf_instance()
+
+
+def test_hkdf_rfc5869_vector1():
+    ikm = bytes.fromhex("0b" * 22)
+    salt = bytes.fromhex("000102030405060708090a0b0c")
+    info = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9")
+    okm = hkdf_sha256(salt, ikm, info, 42)
+    assert okm == bytes.fromhex(
+        "3cb25f25faacd57a90434f64d0362f2a"
+        "2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865"
+    )
+
+
+def test_peer_aggregator_datastore_round_trip():
+    eph = EphemeralDatastore()
+    try:
+        peer = PeerAggregatorBuilder().with_(endpoint="https://peer.example/").build()
+        eph.datastore.run_tx(lambda tx: tx.put_taskprov_peer_aggregator(peer))
+        got = eph.datastore.run_tx(
+            lambda tx: tx.get_taskprov_peer_aggregator("https://peer.example/", Role.LEADER)
+        )
+        assert got == peer
+        all_ = eph.datastore.run_tx(lambda tx: tx.get_taskprov_peer_aggregators())
+        assert all_ == [peer]
+        eph.datastore.run_tx(
+            lambda tx: tx.delete_taskprov_peer_aggregator("https://peer.example/", Role.LEADER)
+        )
+        assert eph.datastore.run_tx(lambda tx: tx.get_taskprov_peer_aggregators()) == []
+    finally:
+        eph.cleanup()
+
+
+def test_derived_verify_key_is_deterministic_and_task_bound():
+    peer = PeerAggregatorBuilder().build()
+    from janus_tpu.messages import TaskId
+
+    t1, t2 = TaskId(b"\x01" * 32), TaskId(b"\x02" * 32)
+    assert peer.derive_vdaf_verify_key(t1) == peer.derive_vdaf_verify_key(t1)
+    assert peer.derive_vdaf_verify_key(t1) != peer.derive_vdaf_verify_key(t2)
+    assert len(peer.derive_vdaf_verify_key(t1)) == 16
+
+
+class TaskprovHeaderHttp(HttpClient):
+    """Leader-side HTTP client that attaches the dap-taskprov header on
+    helper-bound aggregation requests (what a taskprov-aware leader
+    driver sends)."""
+
+    def __init__(self, task_config: TaskConfig):
+        super().__init__()
+        self.header = base64.urlsafe_b64encode(task_config.to_bytes()).decode().rstrip("=")
+
+    def _with_header(self, url, headers):
+        if "aggregation_jobs" in url or "aggregate_shares" in url:
+            headers = dict(headers or {})
+            headers[TASKPROV_HEADER] = self.header
+        return headers
+
+    def put(self, url, body, headers=None):
+        return super().put(url, body, self._with_header(url, headers))
+
+    def post(self, url, body, headers=None):
+        return super().post(url, body, self._with_header(url, headers))
+
+
+def test_helper_side_taskprov_end_to_end():
+    """Helper starts with no task; the first aggregate-init carrying the
+    dap-taskprov header provisions it (global HPKE keys, derived verify
+    key, peer auth), and a full upload->aggregate->collect round trip
+    completes."""
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    try:
+        collector_kp = generate_hpke_config_and_private_key(config_id=200)
+        agg_token = AuthenticationToken.random_bearer()
+        col_token = AuthenticationToken.random_bearer()
+        helper_global_kp = generate_hpke_config_and_private_key(config_id=7)
+        helper_eph.datastore.run_tx(
+            lambda tx: tx.put_global_hpke_keypair(helper_global_kp, state="active")
+        )
+
+        leader_srv = DapServer(DapHttpApp(Aggregator(leader_eph.datastore, clock, Config()))).start()
+
+        # register the leader as a taskprov peer BEFORE the helper starts
+        peer = (
+            PeerAggregatorBuilder()
+            .with_(
+                endpoint=leader_srv.url,
+                role=Role.LEADER,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_tokens=(agg_token,),
+                collector_auth_tokens=(col_token,),
+            )
+            .build()
+        )
+        helper_eph.datastore.run_tx(lambda tx: tx.put_taskprov_peer_aggregator(peer))
+        helper_agg = Aggregator(helper_eph.datastore, clock, Config(taskprov_enabled=True))
+        helper_srv = DapServer(DapHttpApp(helper_agg)).start()
+
+        task_config = sample_task_config(leader_srv.url, helper_srv.url)
+        task_id = task_config.computed_task_id()
+        vdaf = VdafInstance.count()
+
+        # leader provisions its side out-of-band with the derived key
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                task_id=task_id,
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                vdaf_verify_key=peer.derive_vdaf_verify_key(task_id),
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=agg_token,
+                collector_auth_token=col_token,
+                task_expiration=task_config.task_expiration,
+                min_batch_size=1,
+            )
+            .build()
+        )
+        leader_eph.datastore.run_tx(lambda tx: tx.put_task(leader_task))
+
+        http = HttpClient()
+        params = ClientParameters(task_id, leader_srv.url, helper_srv.url, Duration(3600))
+        # client fetches the helper's GLOBAL config (no task provisioned there)
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        measurements = [1, 0, 1, 1]
+        for m in measurements:
+            client.upload(m)
+
+        AggregationJobCreator(
+            leader_eph.datastore, AggregationJobCreatorConfig(min_aggregation_job_size=1)
+        ).run_once()
+
+        taskprov_http = TaskprovHeaderHttp(task_config)
+        driver = AggregationJobDriver(leader_eph.datastore, taskprov_http)
+        assert JobDriver(JobDriverConfig(), driver.acquirer(), driver.stepper).run_once() == 1
+
+        # helper opted in: task exists now with the derived verify key
+        helper_task = helper_eph.datastore.run_tx(lambda tx: tx.get_task(task_id))
+        assert helper_task is not None
+        assert helper_task.role == Role.HELPER
+        assert helper_task.vdaf_verify_key == leader_task.vdaf_verify_key
+        assert helper_task.vdaf == vdaf
+        assert helper_task.hpke_keys == ()
+
+        rows = helper_eph.datastore.run_tx(
+            lambda tx: tx.get_batch_aggregations_intersecting_interval(
+                task_id, Interval(Time(0), Duration(1 << 40))
+            )
+        )
+        assert sum(r.report_count for r in rows) == len(measurements)
+
+        # collect through both aggregators
+        start = clock.now().to_batch_interval_start(Duration(3600))
+        query = Query.time_interval(Interval(Time(start.seconds - 3600), Duration(2 * 3600)))
+        collector = Collector(
+            CollectorParameters(task_id, leader_srv.url, col_token, collector_kp), vdaf, http
+        )
+        job_id = collector.start_collection(query)
+        cdriver = CollectionJobDriver(leader_eph.datastore, taskprov_http)
+        assert JobDriver(JobDriverConfig(), cdriver.acquirer(), cdriver.stepper).run_once() == 1
+        result = collector.poll_once(job_id, query)
+        assert result.report_count == len(measurements)
+        assert result.aggregate_result == sum(measurements)
+
+        leader_srv.stop()
+        helper_srv.stop()
+    finally:
+        leader_eph.cleanup()
+        helper_eph.cleanup()
+
+
+def test_taskprov_rejections():
+    """Unknown peer -> invalidTask; bad auth -> unauthorizedRequest;
+    mismatched task id -> invalidMessage."""
+    clock = MockClock(Time(1_600_000_000))
+    helper_eph = EphemeralDatastore(clock=clock)
+    try:
+        peer = PeerAggregatorBuilder().with_(endpoint="https://leader.example/", role=Role.LEADER).build()
+        helper_eph.datastore.run_tx(lambda tx: tx.put_taskprov_peer_aggregator(peer))
+        helper_agg = Aggregator(helper_eph.datastore, clock, Config(taskprov_enabled=True))
+        app = DapHttpApp(helper_agg)
+
+        def init_req(task_config, headers):
+            tid = task_config.computed_task_id()
+            b64 = base64.urlsafe_b64encode
+            url_tid = b64(tid.data).decode().rstrip("=")
+            hdrs = {
+                TASKPROV_HEADER: b64(task_config.to_bytes()).decode().rstrip("="),
+                **headers,
+            }
+            return app.handle(
+                "PUT",
+                f"/tasks/{url_tid}/aggregation_jobs/{b64(bytes(16)).decode().rstrip('=')}",
+                {},
+                hdrs,
+                b"",
+            )
+
+        good_auth = peer.primary_aggregator_auth_token().request_headers()
+
+        # unknown peer endpoint -> invalidTask (opt-out)
+        cfg_bad_peer = sample_task_config("https://other.example/", "https://helper.example/")
+        status, _, body = init_req(cfg_bad_peer, good_auth)
+        assert status == 400 and b"invalidTask" in body
+
+        # bad auth -> unauthorizedRequest
+        cfg = sample_task_config("https://leader.example/", "https://helper.example/")
+        status, _, body = init_req(cfg, {"Authorization": "Bearer nope"})
+        assert status == 400 and b"unauthorizedRequest" in body
+
+        # expired task -> invalidTask
+        cfg_expired = dataclasses.replace(cfg, task_expiration=Time(1))
+        status, _, body = init_req(cfg_expired, good_auth)
+        assert status == 400 and b"invalidTask" in body
+
+        # task id not matching the config digest -> invalidMessage
+        b64 = base64.urlsafe_b64encode
+        hdrs = {TASKPROV_HEADER: b64(cfg.to_bytes()).decode().rstrip("="), **good_auth}
+        status, _, body = app.handle(
+            "PUT",
+            f"/tasks/{b64(bytes(32)).decode().rstrip('=')}/aggregation_jobs/{b64(bytes(16)).decode().rstrip('=')}",
+            {},
+            hdrs,
+            b"",
+        )
+        assert status == 400 and b"invalidMessage" in body
+    finally:
+        helper_eph.cleanup()
