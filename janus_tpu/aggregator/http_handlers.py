@@ -213,7 +213,9 @@ class DapHttpApp:
     def h_aggregate_share(self, match, query, headers, body):
         task_id = TaskId(_b64dec(match.group(1), 32))
         taskprov_config = self._taskprov_config(task_id, headers)
-        ta = self.agg.task_aggregator_for(task_id)
+        # helper endpoint: allow taskprov re-provisioning here too (the
+        # reference handles taskprov on aggregate_share, aggregator.rs:641)
+        ta = self.agg.task_aggregator_for(task_id, taskprov_config, headers, peer_role=Role.LEADER)
         self._check_helper_auth(ta, task_id, headers, taskprov_config)
         req = AggregateShareReq.from_bytes(body)
         resp = ta.handle_aggregate_share(self.agg.ds, req)

@@ -55,6 +55,8 @@ def _build(lib_path: str) -> bool:
 
 def _load():
     global _lib, _tried
+    if _lib is not None:  # lock-free fast path once loaded
+        return _lib
     with _lock:
         if _lib is not None or _tried:
             return _lib

@@ -42,6 +42,7 @@ from .reference import (
     EVAL_POINT_CANDIDATES,
     Circuit,
     Count,
+    FixedPointVec,
     Histogram,
     Sum,
     SumVec,
@@ -232,7 +233,97 @@ class BHistogram(_BChunked):
         return inp
 
 
-_ADAPTERS = {Count: BCount, Sum: BSum, SumVec: BSumVec, Histogram: BHistogram}
+class BFixedPointVec(_BChunked):
+    """Device twin of reference.FixedPointVec: bit-check calls followed by
+    squared-entry norm calls through the same ParallelSum(Mul) gadget."""
+
+    def encode_batch(self, measurements):
+        circ = self.circ
+        a = np.asarray(measurements, dtype=np.int64)  # [batch, length] signed
+        assert a.ndim == 2 and a.shape[1] == circ.length
+        assert ((-circ.offset <= a) & (a < circ.offset)).all()
+        u = a.astype(np.uint64) + np.uint64(circ.offset)  # offset binary, mod 2^64
+        bits = np.arange(circ.bits, dtype=np.uint64)
+        entry_bits = ((u[:, :, None] >> bits[None, None, :]) & np.uint64(1)).reshape(
+            a.shape[0], -1
+        )
+        norms = (a.astype(object) ** 2).sum(axis=1)  # exact ints (b=64 > u64)
+        assert all(int(n) < (1 << circ.norm_bits) for n in norms), "L2 norm must be < 1"
+        norm_bits = np.array(
+            [[(int(n) >> j) & 1 for j in range(circ.norm_bits)] for n in norms],
+            dtype=np.uint64,
+        )
+        return np.concatenate([entry_bits, norm_bits], axis=1)
+
+    def _interleaved_pairs(self, a, b, n_calls):
+        """(a_i, b_i) pairs padded/reshaped to [batch, n_calls, 2*chunk]."""
+        ch = self.circ.chunk_length
+        pairs = fmap(
+            lambda x, y: jnp.stack([x, y], axis=-1).reshape(x.shape[0], -1), a, b
+        )
+        pad = n_calls * ch * 2 - pairs[0].shape[-1]
+        if pad:
+            pairs = fmap(lambda x: jnp.pad(x, ((0, 0), (0, pad))), pairs)
+        return fmap(lambda x: x.reshape(x.shape[0], n_calls, 2 * ch), pairs)
+
+    def _entry_values(self, inp, shares_inv):
+        """[batch, length] shares of v_e (offset split per share)."""
+        jf = self.jf
+        circ = self.circ
+        v = fmap(
+            lambda x: x[:, : circ.length * circ.bits].reshape(
+                x.shape[0], circ.length, circ.bits
+            ),
+            inp,
+        )
+        u = fsum(jf, jf.mul(v, _two_power_consts(jf, circ.bits)), axis=-1)
+        off = fconst(jf, (circ.offset * shares_inv) % jf.MODULUS)
+        return jf.sub(u, off)
+
+    def calls_inputs(self, inp, joint_rand, shares_inv):
+        jf = self.jf
+        circ = self.circ
+        r = fmap(lambda x: x[:, 0], joint_rand)
+        pw = powers(jf, r, circ.n_bits + 1)
+        rp = fmap(lambda x: x[..., 1:], pw)
+        a = jf.mul(rp, inp)
+        b = jf.sub(inp, self._sic(shares_inv))
+        bit_calls = self._interleaved_pairs(a, b, circ.calls_bits)
+        y = self._entry_values(inp, shares_inv)
+        sq_calls = self._interleaved_pairs(y, y, circ.calls_sq)
+        return fmap(lambda p, q: jnp.concatenate([p, q], axis=1), bit_calls, sq_calls)
+
+    def finish(self, inp, joint_rand, gadget_outs, shares_inv):
+        jf = self.jf
+        circ = self.circ
+        bit_check = fsum(
+            jf, fmap(lambda x: x[:, : circ.calls_bits], gadget_outs), axis=-1
+        )
+        norm = fsum(jf, fmap(lambda x: x[:, circ.calls_bits :], gadget_outs), axis=-1)
+        nb = fmap(lambda x: x[:, circ.length * circ.bits :], inp)
+        claimed = fsum(jf, jf.mul(nb, _two_power_consts(jf, circ.norm_bits)), axis=-1)
+        r1 = fmap(lambda x: x[:, 1], joint_rand)
+        return jf.add(bit_check, jf.mul(r1, jf.sub(norm, claimed)))
+
+    def truncate(self, inp):
+        jf = self.jf
+        circ = self.circ
+        v = fmap(
+            lambda x: x[:, : circ.length * circ.bits].reshape(
+                x.shape[0], circ.length, circ.bits
+            ),
+            inp,
+        )
+        return fsum(jf, jf.mul(v, _two_power_consts(jf, circ.bits)), axis=-1)
+
+
+_ADAPTERS = {
+    Count: BCount,
+    Sum: BSum,
+    SumVec: BSumVec,
+    Histogram: BHistogram,
+    FixedPointVec: BFixedPointVec,
+}
 
 
 def _two_power_consts(jf, bits: int):

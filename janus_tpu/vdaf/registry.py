@@ -15,6 +15,7 @@ from .prio3_jax import Prio3Batched
 from .reference import (
     Circuit,
     Count,
+    FixedPointVec,
     Histogram,
     Prio3,
     Sum,
@@ -29,7 +30,7 @@ VERIFY_KEY_LENGTH = 16  # reference core/src/task.rs:15
 class VdafInstance:
     """One VDAF configuration; hashable so dispatch results are cached."""
 
-    kind: str  # "count" | "sum" | "sumvec" | "histogram"
+    kind: str  # "count" | "sum" | "sumvec" | "histogram" | "fixedpoint" | "countvec"
     bits: int = 0
     length: int = 0
     chunk_length: int = 0  # 0 -> sqrt heuristic (core/src/task.rs:84-86)
@@ -50,6 +51,18 @@ class VdafInstance:
     @classmethod
     def histogram(cls, length: int, chunk_length: int = 0) -> "VdafInstance":
         return cls("histogram", length=length, chunk_length=chunk_length)
+
+    @classmethod
+    def count_vec(cls, length: int, chunk_length: int = 0) -> "VdafInstance":
+        """Vector of counts (the reference's Prio3CountVec: SumVec with
+        bits=1, core/src/task.rs:28-33)."""
+        return cls("countvec", bits=1, length=length, chunk_length=chunk_length)
+
+    @classmethod
+    def fixed_point_vec(cls, length: int, bits: int = 16, chunk_length: int = 0) -> "VdafInstance":
+        """Fixed-point vector sum with bounded L2 norm (the reference's
+        Prio3FixedPoint{16,32,64}BitBoundedL2VecSum, core/src/task.rs:44-49)."""
+        return cls("fixedpoint", bits=bits, length=length, chunk_length=chunk_length)
 
     def to_dict(self) -> dict:
         d = {"kind": self.kind}
@@ -79,6 +92,10 @@ def circuit_for(inst: VdafInstance) -> Circuit:
         return SumVec(length=inst.length, bits=inst.bits, chunk_length=ch)
     if inst.kind == "histogram":
         return Histogram(length=inst.length, chunk_length=ch)
+    if inst.kind == "countvec":
+        return SumVec(length=inst.length, bits=1, chunk_length=ch)
+    if inst.kind == "fixedpoint":
+        return FixedPointVec(length=inst.length, bits=inst.bits, chunk_length=ch)
     raise ValueError(f"unknown VDAF kind {inst.kind!r}")
 
 

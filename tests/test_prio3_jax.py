@@ -25,6 +25,10 @@ CASES = [
     (ref.Sum(bits=8), [0, 255, 7, 200, 33]),
     (ref.SumVec(length=4, bits=4), [[0, 1, 2, 3], [15, 15, 15, 15], [5, 0, 9, 2], [1, 1, 1, 1], [0, 0, 0, 0]]),
     (ref.Histogram(length=7), [0, 6, 3, 3, 1]),
+    (
+        ref.FixedPointVec(length=3, bits=16),
+        [[8192, -8192, 0], [100, -100, 12000], [0, 0, 0], [-16384, 1, 1], [4096, 4096, 4096]],
+    ),
 ]
 
 RNG = np.random.default_rng(0xD1FF)

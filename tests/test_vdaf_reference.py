@@ -12,11 +12,13 @@ import pytest
 
 from janus_tpu.vdaf.reference import (
     Count,
+    FixedPointVec,
     Histogram,
     Prio3,
     Sum,
     SumVec,
     VdafError,
+    fp_encode_floats,
 )
 
 NONCE = bytes(range(16))
@@ -64,6 +66,68 @@ def test_histogram_roundtrip():
     vdaf = Prio3(Histogram(length=10))
     got = run_prio3(vdaf, [3, 3, 7, 0, 9, 3])
     assert got == [1, 0, 0, 3, 0, 0, 0, 1, 0, 1]
+
+
+def test_fixedpoint_roundtrip():
+    vdaf = Prio3(FixedPointVec(length=3, bits=16))
+    m1 = fp_encode_floats([0.25, -0.5, 0.125], 16)
+    m2 = fp_encode_floats([-0.25, 0.25, 0.5], 16)
+    got = run_prio3(vdaf, [m1, m2])
+    assert got == pytest.approx([0.0, -0.25, 0.625], abs=1e-3)
+
+
+def test_fixedpoint_negative_sum_and_64bit():
+    # 64-bit entries: length capped at 3 by the Field128 overflow bound.
+    vdaf = Prio3(FixedPointVec(length=2, bits=64))
+    off = 1 << 63
+    m1 = [-(off // 2), off // 4]  # [-0.5, 0.25]
+    m2 = [-(off // 4), -(off // 2)]  # [-0.25, -0.5]
+    got = run_prio3(vdaf, [m1, m2])
+    assert got == pytest.approx([-0.75, -0.25], abs=1e-9)
+
+
+def test_fixedpoint_norm_overflow_length_rejected():
+    with pytest.raises(ValueError):
+        FixedPointVec(length=4, bits=64)
+
+
+def test_fixedpoint_norm_too_large_rejected():
+    # A vector with L2 norm >= 1 cannot be encoded honestly...
+    circ = FixedPointVec(length=2, bits=16)
+    with pytest.raises(AssertionError):
+        circ.encode([1 << 14, (1 << 15) - 1])
+    circ.encode([1 << 14, 1 << 14])  # norm = 2*2^28 = 2^29 < 2^30: ok
+
+
+def test_fixedpoint_false_norm_claim_rejected():
+    # ...and a dishonest encoding that under-claims the norm must fail
+    # the FLP's recomputed-norm equality check.
+    circ = FixedPointVec(length=2, bits=16)
+    vdaf = Prio3(circ)
+    honest = circ.encode([1 << 14, 1 << 14])
+    forged = honest[: circ.length * circ.bits] + [0] * circ.norm_bits
+    orig_encode = circ.encode
+    circ.encode = lambda m: forged
+    try:
+        with pytest.raises(VdafError):
+            run_prio3(vdaf, [None])
+    finally:
+        circ.encode = orig_encode
+
+
+def test_fixedpoint_entry_bit_forgery_rejected():
+    circ = FixedPointVec(length=2, bits=16)
+    vdaf = Prio3(circ)
+    honest = circ.encode([100, -100])
+    forged = list(honest)
+    forged[0] = 2  # not a bit
+    orig_encode = circ.encode
+    circ.encode = lambda m: forged
+    try:
+        with pytest.raises(VdafError):
+            run_prio3(vdaf, [None])
+    finally:
+        circ.encode = orig_encode
 
 
 def test_invalid_count_rejected():
