@@ -29,6 +29,7 @@ from ..datastore.models import (
     AggregationJobState,
     ReportAggregationState,
 )
+from .. import metrics
 from ..datastore.store import Datastore
 from ..messages import (
     AggregationJobInitializeReq,
@@ -262,7 +263,9 @@ class AggregationJobDriver:
             if accept[i]:
                 new_ras.append(ra.finished())
             else:
-                new_ras.append(ra.failed(failed[i] or PrepareError.VDAF_PREP_ERROR))
+                err = failed[i] or PrepareError.VDAF_PREP_ERROR
+                metrics.aggregate_step_failure_counter.add(type=err.name.lower())
+                new_ras.append(ra.failed(err))
 
         def write(tx):
             for ra in new_ras:
@@ -306,4 +309,5 @@ class AggregationJobDriver:
             tx.release_aggregation_job(acquired)
 
         self.ds.run_tx(cancel, "abandon_agg_job")
+        metrics.job_cancel_counter.add(kind="aggregation")
         log.warning("abandoned aggregation job %s after max attempts", acquired.job_id)

@@ -15,6 +15,7 @@ from dataclasses import dataclass
 
 from ..core.retries import Backoff, retry_http_request
 from ..datastore.models import AcquiredCollectionJob, CollectionJobState
+from .. import metrics
 from ..datastore.store import Datastore
 from ..messages import (
     AggregateShare,
@@ -169,4 +170,5 @@ class CollectionJobDriver:
             tx.release_collection_job(acquired)
 
         self.ds.run_tx(cancel, "abandon_collection_job")
+        metrics.job_cancel_counter.add(kind="collection")
         log.warning("abandoned collection job %s", acquired.collection_job_id)

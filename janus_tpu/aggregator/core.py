@@ -13,6 +13,7 @@ import hashlib
 import secrets
 from dataclasses import dataclass, field
 
+from .. import metrics
 from ..core.hpke import HpkeApplicationInfo, HpkeError, Label, hpke_open, hpke_seal
 from ..core.time_util import Clock, RealClock
 from ..datastore.models import (
@@ -125,6 +126,7 @@ class TaskAggregator:
         try:
             self.wire.decode_public_share(report.public_share)
         except DecodeError as e:
+            metrics.upload_decode_failure_counter.add()
             raise errors.InvalidMessage(f"bad public share: {e}", task.task_id)
 
         # decrypt + decode the leader input share at upload time (:1391)
@@ -142,6 +144,7 @@ class TaskAggregator:
             payload = PlaintextInputShare.from_bytes(plaintext).payload
             self.wire.decode_leader_share(payload)
         except (HpkeError, DecodeError) as e:
+            metrics.upload_decrypt_failure_counter.add()
             raise errors.ReportRejected(f"undecryptable/undecodable share: {e}", task.task_id)
 
         from ..datastore.models import LeaderStoredReport
@@ -281,6 +284,9 @@ class TaskAggregator:
             if prep_err[i] is None and not accept[i]:
                 prep_err[i] = PrepareError.VDAF_PREP_ERROR
 
+        for e in prep_err:
+            if e is not None:
+                metrics.aggregate_step_failure_counter.add(type=e.name.lower())
         # build response + rows
         resps = []
         report_aggs = []

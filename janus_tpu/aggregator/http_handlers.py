@@ -101,7 +101,25 @@ class DapHttpApp:
             self.agg.check_aggregator_auth(ta.task, headers)
 
     def handle(self, method: str, path: str, query: dict, headers, body: bytes):
-        """-> (status, content_type, body_bytes)."""
+        """-> (status, content_type, body_bytes). Wraps _handle with the
+        per-route request counter/latency histogram (the analog of the
+        reference's per-status metrics, http_handlers.rs:266)."""
+        from time import monotonic
+
+        from .. import metrics
+
+        route = "none"
+        for m, rx, name in _ROUTES:
+            if m == method and rx.match(path):
+                route = name
+                break
+        start = monotonic()
+        result = self._handle(method, path, query, headers, body)
+        metrics.http_request_duration.observe(monotonic() - start, route=route)
+        metrics.http_request_counter.add(route=route, status=str(result[0]))
+        return result
+
+    def _handle(self, method: str, path: str, query: dict, headers, body: bytes):
         try:
             for m, rx, name in _ROUTES:
                 if m != method:

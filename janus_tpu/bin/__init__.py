@@ -1,0 +1,2 @@
+"""The five process entry points (reference aggregator/src/bin/):
+`python -m janus_tpu.bin.aggregator` etc."""

@@ -1,0 +1,47 @@
+"""Aggregation job driver process (the leader's hot path).
+
+Equivalent of reference aggregator/src/bin/aggregation_job_driver.rs:
+instantiates the generic JobDriver loop with the AggregationJobDriver's
+acquirer/stepper callbacks.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..aggregator.aggregation_job_driver import (
+    AggregationJobDriver,
+    AggregationJobDriverConfig,
+)
+from ..aggregator.job_driver import JobDriver
+from ..binary_utils import janus_main
+from ..config import JobDriverBinaryConfig
+from ..core.http_client import HttpClient
+
+log = logging.getLogger(__name__)
+
+
+def run(cfg: JobDriverBinaryConfig, ds, stopper):
+    driver = AggregationJobDriver(
+        ds,
+        HttpClient(),
+        AggregationJobDriverConfig(
+            maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure
+        ),
+    )
+    jd = JobDriver(
+        cfg.job_driver,
+        driver.acquirer(cfg.job_driver.worker_lease_duration_s),
+        driver.stepper,
+        stopper,
+    )
+    jd.run()
+    log.info("aggregation job driver shut down")
+
+
+def main(argv=None):
+    return janus_main("DAP aggregation job driver", JobDriverBinaryConfig, run, argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""DAP aggregator HTTP server.
+
+Equivalent of reference aggregator/src/bin/aggregator.rs:29-110: the
+DAP router on `listen_address`, an optional aggregator-api listener on
+a second address, and an optional in-process GC loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..aggregator import Aggregator
+from ..aggregator.garbage_collector import GarbageCollector
+from ..aggregator.http_handlers import DapHttpApp, DapServer
+from ..binary_utils import _split_hostport, janus_main
+from ..config import AggregatorConfig
+from ..core.time_util import RealClock
+
+log = logging.getLogger(__name__)
+
+
+def run(cfg: AggregatorConfig, ds, stopper):
+    clock = RealClock()
+    aggregator = Aggregator(ds, clock, cfg.protocol_config())
+    host, port = _split_hostport(cfg.listen_address)
+    server = DapServer(DapHttpApp(aggregator), host=host, port=port).start()
+    log.info("DAP server listening on %s", server.url)
+
+    api_server = None
+    if cfg.aggregator_api_listen_address:
+        from ..aggregator_api import AggregatorApi, AggregatorApiServer
+
+        api_host, api_port = _split_hostport(cfg.aggregator_api_listen_address)
+        api = AggregatorApi(ds, auth_tokens=cfg.aggregator_api_auth_tokens)
+        api_server = AggregatorApiServer(api, host=api_host, port=api_port).start()
+        log.info("aggregator API listening on %s", api_server.url)
+
+    gc_thread = None
+    if cfg.garbage_collection_interval_s:
+        gc = GarbageCollector(ds, clock)
+
+        def gc_loop():
+            while not stopper.stopped:
+                try:
+                    gc.run_once()
+                except Exception:
+                    log.exception("garbage collection pass failed")
+                stopper.wait(cfg.garbage_collection_interval_s)
+
+        gc_thread = threading.Thread(target=gc_loop, daemon=True)
+        gc_thread.start()
+
+    try:
+        while not stopper.stopped:
+            stopper.wait(1.0)
+    finally:
+        server.stop()
+        if api_server is not None:
+            api_server.stop()
+    log.info("aggregator shut down")
+
+
+def main(argv=None):
+    return janus_main("DAP aggregator server", AggregatorConfig, run, argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Collection job driver process.
+
+Equivalent of reference aggregator/src/bin/collection_job_driver.rs:
+drives leader collection jobs (compute aggregate share, fetch the
+helper's encrypted share, finish the job).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..aggregator.collection_job_driver import (
+    CollectionJobDriver,
+    CollectionJobDriverConfig,
+)
+from ..aggregator.job_driver import JobDriver
+from ..binary_utils import janus_main
+from ..config import JobDriverBinaryConfig
+from ..core.http_client import HttpClient
+
+log = logging.getLogger(__name__)
+
+
+def run(cfg: JobDriverBinaryConfig, ds, stopper):
+    driver = CollectionJobDriver(
+        ds,
+        HttpClient(),
+        CollectionJobDriverConfig(
+            maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure
+        ),
+    )
+    jd = JobDriver(
+        cfg.job_driver,
+        driver.acquirer(cfg.job_driver.worker_lease_duration_s),
+        driver.stepper,
+        stopper,
+    )
+    jd.run()
+    log.info("collection job driver shut down")
+
+
+def main(argv=None):
+    return janus_main("DAP collection job driver", JobDriverBinaryConfig, run, argv)
+
+
+if __name__ == "__main__":
+    main()

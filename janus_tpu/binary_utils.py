@@ -1,0 +1,151 @@
+"""Binary harness shared by the five processes.
+
+Equivalent of reference aggregator/src/binary_utils.rs: `janus_main`
+(config parse -> trace subscriber -> metrics -> datastore -> run),
+the /healthz listener (also serving /metrics Prometheus text), and
+SIGTERM -> Stopper graceful shutdown (binary_utils.rs:40-120,
+docs/DEPLOYING.md:33-39).
+
+Datastore keys come from --datastore-keys or the DATASTORE_KEYS env
+var (comma-separated base64, first key is primary), matching the
+reference's k8s-secret pathway.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import logging
+import os
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .aggregator.job_driver import Stopper
+from .config import CommonConfig, load_config
+from .core.time_util import RealClock
+from .datastore.store import Crypter, Datastore
+from .metrics import REGISTRY
+from .trace import install_trace_subscriber
+
+log = logging.getLogger(__name__)
+
+
+def parse_datastore_keys(raw: str) -> list[bytes]:
+    keys = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pad = "=" * (-len(part) % 4)
+        keys.append(base64.urlsafe_b64decode(part + pad))
+    if not keys:
+        raise ValueError("at least one datastore key is required")
+    for k in keys:
+        if len(k) != 16:
+            raise ValueError("datastore keys must be 16 bytes (AES-128-GCM)")
+    return keys
+
+
+def _split_hostport(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+class HealthServer:
+    """GET /healthz -> 200; GET /metrics -> Prometheus text
+    (reference serves /healthz from binary_utils.rs and metrics via the
+    OTel Prometheus exporter, metrics.rs:53-80)."""
+
+    def __init__(self, addr: str):
+        host, port = _split_hostport(addr)
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    body, ctype = b"", "text/plain"
+                elif self.path == "/metrics":
+                    body, ctype = REGISTRY.render().encode(), "text/plain; version=0.0.4"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "HealthServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def setup_signal_handler(stopper: Stopper) -> None:
+    """SIGTERM/SIGINT -> cooperative stop (binary_utils.rs
+    setup_signal_handler). Only callable from the main thread."""
+
+    def handle(signum, frame):
+        log.info("received signal %s, shutting down", signum)
+        stopper.stop()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+
+
+def janus_main(description: str, config_cls, run, argv=None, install_signals: bool = True):
+    """Shared entry point (reference binary_utils.rs janus_main).
+
+    `run(cfg, ds, stopper)` is the binary body; this harness owns config
+    parsing, logging, the health/metrics listener, the datastore and
+    signal handling.
+    """
+    parser = argparse.ArgumentParser(description=description)
+    parser.add_argument("--config-file", required=True, help="YAML configuration file")
+    parser.add_argument(
+        "--datastore-keys",
+        default=os.environ.get("DATASTORE_KEYS", ""),
+        help="comma-separated base64url AES-128 keys (or DATASTORE_KEYS env)",
+    )
+    args = parser.parse_args(argv)
+
+    cfg = load_config(args.config_file, config_cls)
+    common: CommonConfig = cfg.common
+    install_trace_subscriber(common.logging_config)
+
+    if common.jax_platform:
+        os.environ["JAX_PLATFORMS"] = common.jax_platform
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", common.jax_platform)
+        except Exception:
+            log.exception("could not pin JAX platform %r", common.jax_platform)
+
+    keys = parse_datastore_keys(args.datastore_keys)
+    ds = Datastore(common.database.url, Crypter(keys), RealClock())
+
+    stopper = Stopper()
+    if install_signals:
+        setup_signal_handler(stopper)
+    health = HealthServer(common.health_check_listen_address).start()
+    log.info("health/metrics listener on port %d", health.port)
+    try:
+        return run(cfg, ds, stopper)
+    finally:
+        health.stop()
+        ds.close()

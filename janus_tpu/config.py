@@ -1,0 +1,174 @@
+"""YAML configuration for the five binaries.
+
+Equivalent of reference aggregator/src/config.rs: CommonConfig shared
+by every binary (database, logging, health-check listener), the
+JobDriverConfig knobs (config.rs:121-141) and per-binary sections.
+Secrets (datastore keys) arrive via flags/env, never the YAML file
+(binary_utils.rs:40-66).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import yaml
+
+from .aggregator import Config as AggregatorProtocolConfig
+from .aggregator.aggregation_job_creator import AggregationJobCreatorConfig
+from .aggregator.job_driver import JobDriverConfig
+from .trace import TraceConfiguration
+
+
+@dataclass
+class DbConfig:
+    """reference config.rs:61 (url + connection knobs). The datastore is
+    SQLite-backed here, so `url` is a filesystem path (or ":memory:")."""
+
+    url: str = "janus.sqlite"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DbConfig":
+        return cls(url=str(d.get("url", "janus.sqlite")))
+
+
+@dataclass
+class TaskprovConfig:
+    """reference config.rs:93."""
+
+    enabled: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "TaskprovConfig":
+        return cls(enabled=bool((d or {}).get("enabled", False)))
+
+
+@dataclass
+class CommonConfig:
+    """reference config.rs:28-45."""
+
+    database: DbConfig = field(default_factory=DbConfig)
+    logging_config: TraceConfiguration = field(default_factory=TraceConfiguration)
+    health_check_listen_address: str = "0.0.0.0:9001"
+    # Which JAX backend this process uses (e.g. "cpu", "tpu"). A TPU chip
+    # is single-process: give it to the VDAF hot path (the helper-side
+    # aggregator server, and the leader-side aggregation job driver) and
+    # pin every other process to "cpu". None = leave the environment alone.
+    jax_platform: str | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CommonConfig":
+        return cls(
+            database=DbConfig.from_dict(d.get("database", {})),
+            logging_config=TraceConfiguration.from_dict(d.get("logging_config")),
+            health_check_listen_address=str(
+                d.get("health_check_listen_address", "0.0.0.0:9001")
+            ),
+            jax_platform=d.get("jax_platform"),
+        )
+
+
+def _job_driver_from_dict(d: dict) -> JobDriverConfig:
+    """reference config.rs:121-141 field names."""
+    return JobDriverConfig(
+        job_discovery_interval_s=d.get("min_job_discovery_delay_secs", 0.2),
+        max_job_discovery_interval_s=d.get("max_job_discovery_delay_secs", 5.0),
+        max_concurrent_job_workers=int(d.get("max_concurrent_job_workers", 4)),
+        worker_lease_duration_s=int(d.get("worker_lease_duration_secs", 600)),
+        maximum_attempts_before_failure=int(
+            d.get("maximum_attempts_before_failure", 10)
+        ),
+    )
+
+
+@dataclass
+class AggregatorConfig:
+    """reference aggregator/src/bin/aggregator.rs Config."""
+
+    common: CommonConfig = field(default_factory=CommonConfig)
+    listen_address: str = "0.0.0.0:8080"
+    aggregator_api_listen_address: str | None = None
+    aggregator_api_auth_tokens: tuple[str, ...] = ()
+    max_upload_batch_size: int = 100
+    max_upload_batch_write_delay_ms: int = 250
+    batch_aggregation_shard_count: int = 1
+    taskprov: TaskprovConfig = field(default_factory=TaskprovConfig)
+    garbage_collection_interval_s: float | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AggregatorConfig":
+        gc = d.get("garbage_collection", {}) or {}
+        api = d.get("aggregator_api", {}) or {}
+        return cls(
+            common=CommonConfig.from_dict(d),
+            listen_address=str(d.get("listen_address", "0.0.0.0:8080")),
+            aggregator_api_listen_address=api.get("listen_address"),
+            aggregator_api_auth_tokens=tuple(api.get("auth_tokens", ())),
+            max_upload_batch_size=int(d.get("max_upload_batch_size", 100)),
+            max_upload_batch_write_delay_ms=int(
+                d.get("max_upload_batch_write_delay_ms", 250)
+            ),
+            batch_aggregation_shard_count=int(
+                d.get("batch_aggregation_shard_count", 1)
+            ),
+            taskprov=TaskprovConfig.from_dict(d.get("taskprov_config")),
+            garbage_collection_interval_s=gc.get("gc_frequency_s"),
+        )
+
+    def protocol_config(self) -> AggregatorProtocolConfig:
+        return AggregatorProtocolConfig(
+            max_upload_batch_size=self.max_upload_batch_size,
+            max_upload_batch_write_delay_ms=self.max_upload_batch_write_delay_ms,
+            batch_aggregation_shard_count=self.batch_aggregation_shard_count,
+            taskprov_enabled=self.taskprov.enabled,
+        )
+
+
+@dataclass
+class JobCreatorConfig:
+    """reference aggregator/src/bin/aggregation_job_creator.rs Config."""
+
+    common: CommonConfig = field(default_factory=CommonConfig)
+    aggregation_job_creation_interval_s: float = 1.0
+    min_aggregation_job_size: int = 10
+    max_aggregation_job_size: int = 100
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobCreatorConfig":
+        # (tasks_update_frequency_secs is accepted but unused: the creator
+        # re-reads the task list on every pass, unlike the reference's
+        # long-lived per-task workers, aggregation_job_creator.rs:154)
+        return cls(
+            common=CommonConfig.from_dict(d),
+            aggregation_job_creation_interval_s=float(
+                d.get("aggregation_job_creation_interval_secs", 1.0)
+            ),
+            min_aggregation_job_size=int(d.get("min_aggregation_job_size", 10)),
+            max_aggregation_job_size=int(d.get("max_aggregation_job_size", 100)),
+        )
+
+    def creator_config(self) -> AggregationJobCreatorConfig:
+        return AggregationJobCreatorConfig(
+            min_aggregation_job_size=self.min_aggregation_job_size,
+            max_aggregation_job_size=self.max_aggregation_job_size,
+        )
+
+
+@dataclass
+class JobDriverBinaryConfig:
+    """reference aggregator/src/bin/{aggregation,collection}_job_driver.rs."""
+
+    common: CommonConfig = field(default_factory=CommonConfig)
+    job_driver: JobDriverConfig = field(default_factory=JobDriverConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobDriverBinaryConfig":
+        return cls(
+            common=CommonConfig.from_dict(d),
+            job_driver=_job_driver_from_dict(d),
+        )
+
+
+def load_config(path: str, cls):
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    return cls.from_dict(doc)

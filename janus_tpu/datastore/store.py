@@ -1077,6 +1077,9 @@ class Datastore:
     def run_tx(self, fn, name: str = "tx"):
         """Run fn(Transaction) with retry on busy/conflict
         (reference run_tx_with_name, datastore.rs:216-242)."""
+        from .. import metrics
+
+        start = _time.monotonic()
         for attempt in range(self.MAX_RETRIES):
             conn = self._connect()
             try:
@@ -1084,6 +1087,7 @@ class Datastore:
                 tx = Transaction(conn, self._crypter, self._clock)
                 result = fn(tx)
                 conn.commit()
+                metrics.tx_duration.observe(_time.monotonic() - start, tx=name)
                 return result
             except (sqlite3.OperationalError, TxConflict) as e:
                 conn.rollback()

@@ -1,0 +1,150 @@
+"""Process metrics: counters/histograms + Prometheus text exposition.
+
+Equivalent of the reference's OpenTelemetry metrics layer
+(aggregator/src/metrics.rs:53-80 install_metrics_exporter with a
+Prometheus or OTLP exporter; counter definitions like
+janus_aggregate_step_failure_counter at aggregator.rs:114-154). Here a
+dependency-free registry renders the Prometheus text format, served by
+the health/metrics listener in janus_tpu.binary_utils.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from collections import defaultdict
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple[tuple[str, str], ...], float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def add(self, n: float = 1, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += n
+
+    def get(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        for labels, v in items:
+            lines.append(f"{self.name}{_fmt_labels(labels)} {v}")
+        return "\n".join(lines)
+
+
+# The reference's custom boundaries for DB/HTTP latencies (metrics.rs)
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0,
+)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[tuple[str, str], ...], list[int]] = {}
+        self._sums: dict[tuple[tuple[str, str], ...], float] = defaultdict(float)
+        self._totals: dict[tuple[tuple[str, str], ...], int] = defaultdict(int)
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        # first bucket with bound >= value; == len(buckets) -> only +Inf
+        idx = bisect_left(self.buckets, value)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            if idx < len(self.buckets):
+                counts[idx] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            keys = sorted(self._counts)
+            for key in keys:
+                cum = 0
+                for b, c in zip(self.buckets, self._counts[key]):
+                    cum += c
+                    lbl = _fmt_labels(key + (("le", f"{b:g}"),))
+                    lines.append(f"{self.name}_bucket{lbl} {cum}")
+                lines.append(
+                    f'{self.name}_bucket{_fmt_labels(key + (("le", "+Inf"),))} {self._totals[key]}'
+                )
+                lines.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
+                lines.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help_)
+                self._metrics[name] = m
+            assert isinstance(m, Counter)
+            return m
+
+    def histogram(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets)
+                self._metrics[name] = m
+            assert isinstance(m, Histogram)
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+# Counters mirroring the reference's (aggregator.rs:114-245)
+upload_decrypt_failure_counter = REGISTRY.counter(
+    "janus_upload_decrypt_failures", "reports which failed HPKE decryption at upload"
+)
+upload_decode_failure_counter = REGISTRY.counter(
+    "janus_upload_decode_failures", "reports which failed decoding at upload"
+)
+aggregate_step_failure_counter = REGISTRY.counter(
+    "janus_aggregate_step_failures",
+    "per-report failures during aggregation steps, by type",
+)
+job_cancel_counter = REGISTRY.counter(
+    "janus_job_cancellations", "jobs abandoned after repeated failures"
+)
+http_request_counter = REGISTRY.counter(
+    "janus_http_requests", "DAP HTTP requests by route and status"
+)
+http_request_duration = REGISTRY.histogram(
+    "janus_http_request_duration_seconds", "DAP HTTP request latency"
+)
+tx_duration = REGISTRY.histogram(
+    "janus_database_transaction_duration_seconds", "datastore transaction latency"
+)

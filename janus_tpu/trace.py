@@ -1,0 +1,67 @@
+"""Structured logging setup.
+
+Equivalent of the reference's tracing subscriber installation
+(aggregator/src/trace.rs:44-90): pretty or JSON line format, level
+from config or the JANUS_LOG env var (the RUST_LOG analog). The
+Chrome-trace/tokio-console layers map to the JAX profiler
+(jax.profiler.trace emits Perfetto files); see docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class TraceConfiguration:
+    """reference aggregator/src/trace.rs TraceConfiguration."""
+
+    use_test_writer: bool = False
+    force_json_output: bool = False
+    level: str = "INFO"
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "TraceConfiguration":
+        d = d or {}
+        return cls(
+            use_test_writer=bool(d.get("use_test_writer", False)),
+            force_json_output=bool(d.get("force_json_output", False)),
+            level=str(d.get("level", "INFO")),
+        )
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exception"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def install_trace_subscriber(config: TraceConfiguration | None = None) -> None:
+    """Install the root logging handler (idempotent)."""
+    config = config or TraceConfiguration()
+    level = os.environ.get("JANUS_LOG", config.level).upper()
+    root = logging.getLogger()
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(sys.stderr)
+    if config.force_json_output:
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+    root.addHandler(handler)

@@ -1,0 +1,277 @@
+"""Ops-shell tests: config parsing, metrics, health listener, CLI,
+aggregator API, garbage collector.
+
+Mirrors the reference's config round-trip tests (config.rs:213), CLI
+arg tests (janus_cli.rs verify_clap_app), aggregator_api handler tests
+and garbage_collector.rs tests, at the same altitude (no containers).
+"""
+
+import base64
+import json
+import secrets
+import urllib.request
+
+import pytest
+import yaml
+
+from janus_tpu.aggregator.garbage_collector import GarbageCollector
+from janus_tpu.aggregator_api import AggregatorApi, AggregatorApiServer
+from janus_tpu.bin import janus_cli
+from janus_tpu.binary_utils import HealthServer, parse_datastore_keys
+from janus_tpu.config import (
+    AggregatorConfig,
+    JobCreatorConfig,
+    JobDriverBinaryConfig,
+    load_config,
+)
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import Duration, Role, Time
+from janus_tpu.metrics import REGISTRY, MetricsRegistry
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+# --- config ---
+
+
+def test_aggregator_config_from_sample():
+    cfg = load_config("docs/samples/aggregator.yaml", AggregatorConfig)
+    assert cfg.listen_address == "0.0.0.0:8080"
+    assert cfg.batch_aggregation_shard_count == 32
+    assert cfg.common.database.url == "/var/lib/janus/janus.sqlite"
+    assert cfg.common.health_check_listen_address == "0.0.0.0:9001"
+    assert not cfg.taskprov.enabled
+    pc = cfg.protocol_config()
+    assert pc.max_upload_batch_size == 100
+
+
+def test_job_driver_config_from_sample():
+    cfg = load_config("docs/samples/aggregation_job_driver.yaml", JobDriverBinaryConfig)
+    assert cfg.job_driver.max_concurrent_job_workers == 4
+    assert cfg.job_driver.worker_lease_duration_s == 600
+    assert cfg.job_driver.maximum_attempts_before_failure == 10
+
+
+def test_job_creator_config_from_sample():
+    cfg = load_config("docs/samples/aggregation_job_creator.yaml", JobCreatorConfig)
+    assert cfg.creator_config().min_aggregation_job_size == 10
+    assert cfg.creator_config().max_aggregation_job_size == 500
+
+
+def test_parse_datastore_keys():
+    k = base64.urlsafe_b64encode(b"0123456789abcdef").decode().rstrip("=")
+    assert parse_datastore_keys(f"{k},{k}") == [b"0123456789abcdef"] * 2
+    with pytest.raises(ValueError):
+        parse_datastore_keys("")
+    with pytest.raises(ValueError):
+        parse_datastore_keys(base64.urlsafe_b64encode(b"short").decode())
+
+
+# --- metrics ---
+
+
+def test_metrics_counter_and_histogram_render():
+    reg = MetricsRegistry()
+    c = reg.counter("test_requests", "requests")
+    c.add(status="200")
+    c.add(status="200")
+    c.add(status="400")
+    h = reg.histogram("test_latency", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    assert 'test_requests{status="200"} 2.0' in text
+    assert 'test_requests{status="400"} 1.0' in text
+    assert 'test_latency_bucket{le="0.1"} 1' in text
+    assert 'test_latency_bucket{le="1"} 2' in text
+    assert 'test_latency_bucket{le="+Inf"} 3' in text
+    assert "test_latency_count 3" in text
+
+
+def test_health_server_serves_healthz_and_metrics():
+    REGISTRY.counter("janus_http_requests").add(route="test")
+    srv = HealthServer("127.0.0.1:0").start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/healthz") as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics") as resp:
+            body = resp.read().decode()
+        assert "janus_http_requests" in body
+    finally:
+        srv.stop()
+
+
+# --- janus_cli ---
+
+
+def test_cli_create_datastore_key(capsys):
+    assert janus_cli.main(["create-datastore-key"]) == 0
+    key = capsys.readouterr().out.strip()
+    assert len(base64.urlsafe_b64decode(key + "=" * (-len(key) % 4))) == 16
+
+
+def test_cli_provision_and_list_tasks(tmp_path, capsys):
+    task = TaskBuilder(
+        QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER
+    ).build()
+    tasks_file = tmp_path / "tasks.yaml"
+    tasks_file.write_text(yaml.safe_dump([task.to_dict()]))
+    db = str(tmp_path / "ds.sqlite")
+    key = base64.urlsafe_b64encode(secrets.token_bytes(16)).decode().rstrip("=")
+
+    rc = janus_cli.main(
+        ["provision-tasks", str(tasks_file), "--database", db, "--datastore-keys", key]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out[0]["task_id"] == task.to_dict()["task_id"]
+
+    rc = janus_cli.main(["list-tasks", "--database", db, "--datastore-keys", key])
+    assert rc == 0
+    listing = capsys.readouterr().out
+    assert task.to_dict()["task_id"] in listing
+    assert "role=leader" in listing and "vdaf=count" in listing
+
+
+# --- aggregator API ---
+
+
+@pytest.fixture()
+def api_ds():
+    eph = EphemeralDatastore()
+    yield eph.datastore
+    eph.cleanup()
+
+
+TOKEN = "testtoken"
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+def api_call(api, method, path, doc=None, headers=AUTH, query=None):
+    body = json.dumps(doc).encode() if doc is not None else b""
+    return api.handle(method, path, query or {}, headers, body)
+
+
+def test_api_auth_required(api_ds):
+    api = AggregatorApi(api_ds, auth_tokens=(TOKEN,))
+    status, doc = api_call(api, "GET", "/task_ids", headers={})
+    assert status == 401
+    status, doc = api_call(api, "GET", "/task_ids", headers={"Authorization": "Bearer nope"})
+    assert status == 401
+
+
+def test_api_task_crud_and_metrics(api_ds):
+    api = AggregatorApi(api_ds, auth_tokens=(TOKEN,))
+    task_doc = TaskBuilder(
+        QueryTypeConfig.time_interval(), VdafInstance.sum(bits=8), Role.LEADER
+    ).build().to_dict()
+    status, created = api_call(api, "POST", "/tasks", task_doc)
+    assert status == 201
+    tid = created["task_id"]
+    # private keys never come back
+    assert all(isinstance(k, str) for k in created["hpke_keys"])
+
+    status, got = api_call(api, "GET", f"/tasks/{tid}")
+    assert status == 200 and got["task_id"] == tid
+
+    status, ids = api_call(api, "GET", "/task_ids")
+    assert status == 200 and tid in ids["task_ids"]
+
+    status, m = api_call(api, "GET", f"/tasks/{tid}/metrics")
+    assert status == 200 and m == {"reports": 0, "report_aggregations": 0}
+
+    status, _ = api_call(api, "DELETE", f"/tasks/{tid}")
+    assert status == 204
+    status, _ = api_call(api, "GET", f"/tasks/{tid}")
+    assert status == 404
+
+
+def test_api_post_task_fills_defaults(api_ds):
+    api = AggregatorApi(api_ds, auth_tokens=(TOKEN,))
+    minimal = {
+        "leader_aggregator_endpoint": "https://leader.example.com/",
+        "helper_aggregator_endpoint": "https://helper.example.com/",
+        "query_type": {"code": 1},
+        "vdaf": {"kind": "count"},
+        "role": int(Role.HELPER),
+        "time_precision": 3600,
+    }
+    status, created = api_call(api, "POST", "/tasks", minimal)
+    assert status == 201
+    assert created["vdaf_verify_key"]
+    assert created["hpke_keys"], "helper gets a generated HPKE keypair"
+
+
+def test_api_hpke_config_lifecycle(api_ds):
+    api = AggregatorApi(api_ds, auth_tokens=(TOKEN,))
+    status, kp = api_call(api, "PUT", "/hpke_configs", {})
+    assert status == 201 and kp["state"] == "pending"
+    status, listing = api_call(api, "GET", "/hpke_configs")
+    assert status == 200 and len(listing) == 1
+    cfg_bytes = base64.urlsafe_b64decode(listing[0]["config"])
+    config_id = cfg_bytes[0]
+    status, _ = api_call(api, "PATCH", f"/hpke_configs/{config_id}", {"state": "active"})
+    assert status == 200
+    status, listing = api_call(api, "GET", "/hpke_configs")
+    assert listing[0]["state"] == "active"
+    status, _ = api_call(api, "DELETE", f"/hpke_configs/{config_id}")
+    assert status == 204
+    status, listing = api_call(api, "GET", "/hpke_configs")
+    assert listing == []
+
+
+def test_api_over_http(api_ds):
+    api = AggregatorApi(api_ds, auth_tokens=(TOKEN,))
+    srv = AggregatorApiServer(api).start()
+    try:
+        req = urllib.request.Request(srv.url + "/", headers=AUTH)
+        with urllib.request.urlopen(req) as resp:
+            doc = json.loads(resp.read())
+        assert doc["protocol"] == "DAP-07"
+    finally:
+        srv.stop()
+
+
+# --- garbage collector ---
+
+
+def test_garbage_collector_deletes_expired():
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ds = eph.datastore
+    try:
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+            .with_(report_expiry_age=Duration(100))
+            .build()
+        )
+        ds.run_tx(lambda tx: tx.put_task(task))
+
+        from janus_tpu.datastore.models import LeaderStoredReport
+        from janus_tpu.messages import HpkeCiphertext, HpkeConfigId, ReportId
+
+        def put_report(tx, when):
+            rid = ReportId(secrets.token_bytes(16))
+            tx.put_client_report(
+                LeaderStoredReport(
+                    task_id=task.task_id,
+                    report_id=rid,
+                    client_time=Time(when),
+                    public_share=b"",
+                    leader_input_share=b"x",
+                    helper_encrypted_input_share=HpkeCiphertext(HpkeConfigId(0), b"", b""),
+                )
+            )
+
+        ds.run_tx(lambda tx: put_report(tx, 1_600_000_000 - 1000))  # expired
+        ds.run_tx(lambda tx: put_report(tx, 1_600_000_000 - 10))  # fresh
+
+        gc = GarbageCollector(ds, clock)
+        deleted = gc.run_once()
+        assert deleted["reports"] == 1
+        total, _ = ds.run_tx(lambda tx: tx.count_client_reports_for_task(task.task_id))
+        assert total == 1
+    finally:
+        eph.cleanup()
