@@ -133,9 +133,9 @@ class Client:
         )
         return Report(metadata, public_share, leader_ct, helper_ct)
 
-    def upload(self, measurement) -> None:
+    def upload(self, measurement, when=None) -> None:
         """PUT the report to the leader with retries (reference :270)."""
-        report = self.prepare_report(measurement)
+        report = self.prepare_report(measurement, when=when)
         status, body = retry_http_request(
             lambda: self.http.put(
                 self.params.upload_uri(),

@@ -54,6 +54,7 @@ class CollectionResult:
     report_count: int
     interval: Interval
     aggregate_result: object
+    partial_batch_selector: object = None  # set for fixed-size queries
 
 
 class CollectionJobNotReady(Exception):
@@ -136,4 +137,9 @@ class Collector:
             )
             shares.append(field.decode_vec(pt))
         result = self.prio3.unshard(shares, collection.report_count)
-        return CollectionResult(collection.report_count, collection.interval, result)
+        pbs = (
+            collection.partial_batch_selector
+            if query.query_type != TimeInterval.CODE
+            else None
+        )
+        return CollectionResult(collection.report_count, collection.interval, result, pbs)

@@ -1,0 +1,2 @@
+"""Operator CLIs (reference tools/src/bin/): collect, dap_decode,
+hpke_keygen. Invoke as `python -m janus_tpu.tools.<name>`."""

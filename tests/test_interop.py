@@ -1,0 +1,170 @@
+"""End-to-end test through the interop test API only
+(draft-dcook-ppm-dap-interop-test-design), mirroring the reference's
+interop_binaries/tests/end_to_end.rs:570-905: everything — task setup,
+uploads, collection — goes through the three JSON servers exactly as a
+foreign test harness would drive them."""
+
+import base64
+import json
+import secrets
+import time
+import urllib.request
+
+import pytest
+
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.interop import InteropAggregator, InteropClient, InteropCollector
+from janus_tpu.messages import Time
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def b64(b):
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+@pytest.fixture()
+def stack():
+    """Leader + helper interop aggregators, interop client + collector."""
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    leader = InteropAggregator(leader_eph.datastore, clock=clock)
+    helper = InteropAggregator(helper_eph.datastore, clock=clock)
+    leader_srv = leader.server().start()
+    helper_srv = helper.server().start()
+    leader.start_job_runners()
+    client_srv = InteropClient(clock=clock).server().start()
+    collector_srv = InteropCollector().server().start()
+    yield {
+        "clock": clock,
+        "leader": leader_srv,
+        "helper": helper_srv,
+        "client": client_srv,
+        "collector": collector_srv,
+    }
+    leader.stop()
+    helper.stop()
+    for s in (leader_srv, helper_srv, client_srv, collector_srv):
+        s.stop()
+    leader_eph.cleanup()
+    helper_eph.cleanup()
+
+
+VDAF_CASES = [
+    ({"type": "Prio3Count"}, ["1", "0", "1", "1"], "3"),
+    (
+        {"type": "Prio3SumVec", "bits": "8", "length": "3"},
+        [["1", "2", "3"], ["10", "20", "30"]],
+        ["11", "22", "33"],
+    ),
+]
+
+
+@pytest.mark.parametrize("vdaf_obj,measurements,expected", VDAF_CASES, ids=["count", "sumvec"])
+def test_interop_end_to_end(stack, vdaf_obj, measurements, expected):
+    task_id = b64(secrets.token_bytes(32))
+    verify_key = b64(secrets.token_bytes(16))
+    leader_token = "leader-" + b64(secrets.token_bytes(8))
+    collector_token = "collector-" + b64(secrets.token_bytes(8))
+    leader_url = stack["leader"].url
+    helper_url = stack["helper"].url
+    time_precision = 3600
+
+    # readiness probes
+    for srv in ("leader", "helper", "client", "collector"):
+        post(stack[srv].url + "internal/test/ready", {})
+
+    # endpoint discovery
+    resp = post(
+        leader_url + "internal/test/endpoint_for_task",
+        {"task_id": task_id, "role": "leader"},
+    )
+    assert resp["endpoint"] == "/"
+
+    # collector first: it generates the collector HPKE config
+    resp = post(
+        stack["collector"].url + "internal/test/add_task",
+        {
+            "task_id": task_id,
+            "leader": leader_url,
+            "vdaf": vdaf_obj,
+            "collector_authentication_token": collector_token,
+            "query_type": 1,
+        },
+    )
+    assert resp["status"] == "success", resp
+    collector_hpke_config = resp["collector_hpke_config"]
+
+    common = {
+        "task_id": task_id,
+        "leader": leader_url,
+        "helper": helper_url,
+        "vdaf": vdaf_obj,
+        "leader_authentication_token": leader_token,
+        "vdaf_verify_key": verify_key,
+        "max_batch_query_count": 1,
+        "query_type": 1,
+        "min_batch_size": 1,
+        "time_precision": time_precision,
+        "collector_hpke_config": collector_hpke_config,
+        "task_expiration": None,
+    }
+    resp = post(
+        leader_url + "internal/test/add_task",
+        {**common, "role": "leader", "collector_authentication_token": collector_token},
+    )
+    assert resp["status"] == "success", resp
+    resp = post(helper_url + "internal/test/add_task", {**common, "role": "helper"})
+    assert resp["status"] == "success", resp
+
+    # uploads through the interop client
+    for m in measurements:
+        resp = post(
+            stack["client"].url + "internal/test/upload",
+            {
+                "task_id": task_id,
+                "leader": leader_url,
+                "helper": helper_url,
+                "vdaf": vdaf_obj,
+                "measurement": m,
+                "time_precision": time_precision,
+            },
+        )
+        assert resp["status"] == "success", resp
+
+    # collection through the interop collector
+    now = stack["clock"].now().seconds
+    resp = post(
+        stack["collector"].url + "internal/test/collection_start",
+        {
+            "task_id": task_id,
+            "agg_param": "",
+            "query": {
+                "type": 1,
+                "batch_interval_start": (now // time_precision - 1) * time_precision,
+                "batch_interval_duration": time_precision * 3,
+            },
+        },
+    )
+    assert resp["status"] == "success", resp
+    handle = resp["handle"]
+
+    deadline = time.monotonic() + 300
+    while True:
+        resp = post(
+            stack["collector"].url + "internal/test/collection_poll", {"handle": handle}
+        )
+        if resp["status"] == "complete":
+            break
+        assert time.monotonic() < deadline, "collection did not complete"
+        time.sleep(1)
+    assert resp["report_count"] == str(len(measurements))
+    assert resp["result"] == expected
