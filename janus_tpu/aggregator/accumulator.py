@@ -20,7 +20,6 @@ import secrets
 from ..messages import Duration, Interval, ReportIdChecksum, TaskId, Time
 from ..task import Task
 from ..vdaf.registry import circuit_for
-from .errors import AggregatorError
 from ..datastore.models import BatchAggregation, BatchAggregationState
 
 
@@ -67,7 +66,14 @@ def accumulate_batched(task, engine, accumulator: "Accumulator", out_shares, acc
             lo = t if lo is None or t < lo else lo
             hi = t if hi is None or t > hi else hi
         interval = Interval(lo.to_batch_interval_start(task.time_precision), task.time_precision)
-        accumulator.update(bid, field.encode_vec(share_ints), len(lanes), checksum, interval)
+        accumulator.update(
+            bid,
+            field.encode_vec(share_ints),
+            len(lanes),
+            checksum,
+            interval,
+            [metadatas[i].report_id for i in lanes],
+        )
 
 
 class Accumulator:
@@ -87,16 +93,20 @@ class Accumulator:
         report_count: int,
         checksum: ReportIdChecksum,
         client_interval: Interval,
+        report_ids: list | None = None,
     ) -> None:
         """Merge one already-reduced contribution (device output)."""
         ent = self._state.get(batch_identifier)
         if ent is None:
-            self._state[batch_identifier] = [aggregate_share, report_count, checksum, client_interval]
+            self._state[batch_identifier] = [
+                aggregate_share, report_count, checksum, client_interval, list(report_ids or ())
+            ]
             return
         ent[0] = add_encoded_aggregate_shares(self.field, ent[0], aggregate_share)
         ent[1] += report_count
         ent[2] = ent[2].combined_with(checksum)
         ent[3] = Interval.merged(ent[3], client_interval)
+        ent[4].extend(report_ids or ())
 
     def update_single(self, batch_identifier: bytes, out_share: list[int], report_id, client_time: Time) -> None:
         """Scalar convenience path (tests, small flows)."""
@@ -109,15 +119,30 @@ class Accumulator:
                 client_time.to_batch_interval_start(self.task.time_precision),
                 self.task.time_precision,
             ),
+            [report_id],
         )
 
-    def flush_to_datastore(self, tx) -> None:
+    def flush_to_datastore(self, tx) -> set:
         """Merge into a random shard row per batch (reference :133-215).
 
-        Raises AggregatorError if a touched batch was already collected
-        (reports must not land in collected batches).
+        Returns the report ids that could NOT be merged because their
+        batch was already collected; callers mark those report
+        aggregations failed with PrepareError.BATCH_COLLECTED instead of
+        failing the whole job (reference accumulator.rs:133-215 returns
+        the same unmergeable set).
         """
-        for batch_identifier, (share, count, checksum, interval) in self._state.items():
+        unmerged: set = set()
+        for batch_identifier, (share, count, checksum, interval, rids) in self._state.items():
+            # a COLLECTED row in ANY shard closes the batch
+            collected = any(
+                ba.state == BatchAggregationState.COLLECTED
+                for ba in tx.get_batch_aggregations_for_batch(
+                    self.task.task_id, batch_identifier, b""
+                )
+            )
+            if collected:
+                unmerged.update(r.data for r in rids)
+                continue
             ord_ = secrets.randbelow(self.shard_count)
             existing = tx.get_batch_aggregation(
                 self.task.task_id, batch_identifier, b"", ord_
@@ -137,10 +162,6 @@ class Accumulator:
                     )
                 )
                 continue
-            if existing.state == BatchAggregationState.COLLECTED:
-                raise AggregatorError(
-                    f"batch {batch_identifier.hex()[:16]} already collected"
-                )
             merged = BatchAggregation(
                 self.task.task_id,
                 batch_identifier,
@@ -154,3 +175,4 @@ class Accumulator:
             )
             tx.update_batch_aggregation(merged)
         self._state.clear()
+        return unmerged

@@ -167,6 +167,13 @@ class AggregationJobDriver:
                 except DecodeError:
                     failed[i] = PrepareError.INVALID_MESSAGE
 
+        # test-only fake failure injection on the leader init path
+        # (the reference's dummy_vdaf prep_init_fn hook)
+        if task.vdaf.fails_prep_init:
+            for i in range(n):
+                if failed[i] is None:
+                    failed[i] = PrepareError.VDAF_PREP_ERROR
+
         jf = engine.p3.jf
         meas, ok_m = decode_field_rows(jf, meas_rows, circ.input_len)
         proof, ok_p = decode_field_rows(jf, proof_rows, circ.proof_len)
@@ -252,6 +259,14 @@ class AggregationJobDriver:
                         continue
                 accept[i] = True
 
+        # test-only fake failure at the leader continue/evaluate stage
+        # (the reference's dummy_vdaf prep_step_fn hook)
+        if task.vdaf.fails_prep_step:
+            for i in range(n):
+                if accept[i]:
+                    accept[i] = False
+                    failed[i] = PrepareError.VDAF_PREP_ERROR
+
         # masked accumulate (reference Accumulator::update :605-627)
         accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
         metadatas = [ReportMetadata(ra.report_id, ra.client_time) for ra in pending]
@@ -268,10 +283,15 @@ class AggregationJobDriver:
                 new_ras.append(ra.failed(err))
 
         def write(tx):
+            # flush first: reports whose batch was collected mid-flight
+            # fail individually with BATCH_COLLECTED (reference
+            # flush_to_datastore unmergeable set, accumulator.rs:133-215)
+            unmerged = accumulator.flush_to_datastore(tx)
             for ra in new_ras:
+                if ra.report_id.data in unmerged:
+                    ra = ra.failed(PrepareError.BATCH_COLLECTED)
                 tx.update_report_aggregation(ra)
             tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
-            accumulator.flush_to_datastore(tx)
             tx.release_aggregation_job(acquired)
 
         self.ds.run_tx(write, "step_agg_job_write")

@@ -113,7 +113,9 @@ class TaskAggregator:
     # ------------------------------------------------------------------
     # upload (reference aggregator.rs:1325)
     # ------------------------------------------------------------------
-    def handle_upload(self, ds: Datastore, clock: Clock, report: Report) -> None:
+    def handle_upload(self, ds: Datastore, clock: Clock, report: Report, writer=None) -> None:
+        """`writer`: a ReportWriteBatcher; falls back to a direct
+        single-report transaction when absent (tests, tools)."""
         task = self.task
         now = clock.now()
         # clock skew / expiry checks (reference :1344-1385)
@@ -157,7 +159,10 @@ class TaskAggregator:
             payload,
             report.helper_encrypted_input_share,
         )
-        fresh = ds.run_tx(lambda tx: tx.put_client_report(stored), "upload")
+        if writer is not None:
+            fresh = writer.write_report(stored)  # batched tx (report_writer.rs)
+        else:
+            fresh = ds.run_tx(lambda tx: tx.put_client_report(stored), "upload")
         if not fresh:
             raise errors.ReportRejected("report replayed", task.task_id)
 
@@ -255,6 +260,13 @@ class TaskAggregator:
         for i in replayed:
             prep_err[i] = PrepareError.REPORT_REPLAYED
 
+        # test-only fake VDAF failure injection (the reference's
+        # dummy_vdaf prep_init_fn hook, core/src/test_util/dummy_vdaf.rs:46)
+        if task.vdaf.fails_prep_init:
+            for i in range(n):
+                if prep_err[i] is None:
+                    prep_err[i] = PrepareError.VDAF_PREP_ERROR
+
         # columnar staging -> device
         nonce_lanes, ok_nonce = seeds_to_lanes([rid.data for rid in ids])
         seed_lanes, ok_seed = seeds_to_lanes(helper_seed_rows)
@@ -278,6 +290,11 @@ class TaskAggregator:
         )
         accept = accept & ok
         prep_msg_rows = lanes_to_seed_rows(prep_msg_lanes) if self.wire.uses_jr else [b""] * n
+
+        # test-only fake failure at the step/finish stage (the reference's
+        # dummy_vdaf prep_step_fn hook, core/src/test_util/dummy_vdaf.rs:57)
+        if task.vdaf.fails_prep_step:
+            accept = np.zeros_like(accept)
 
         # mark VDAF-rejected lanes
         for i in range(n):
@@ -330,12 +347,27 @@ class TaskAggregator:
         )
 
         def write(tx):
+            # flush first: reports landing in collected batches become
+            # individual BATCH_COLLECTED rejections (reference :86-105
+            # collected-batch check + flush unmergeable set)
+            unmerged = accumulator.flush_to_datastore(tx)
             tx.put_aggregation_job(job)
             for ra in report_aggs:
+                if ra.report_id.data in unmerged:
+                    ra = ra.failed(PrepareError.BATCH_COLLECTED)
                 tx.put_report_aggregation(ra)
-            accumulator.flush_to_datastore(tx)
+            return unmerged
 
-        ds.run_tx(write, "aggregate_init")
+        unmerged = ds.run_tx(write, "aggregate_init")
+        if unmerged:
+            resps = [
+                PrepareResp(
+                    r.report_id, PrepareStepResult.reject(PrepareError.BATCH_COLLECTED)
+                )
+                if r.report_id.data in unmerged
+                else r
+                for r in resps
+            ]
         return AggregationJobResp(tuple(resps))
 
     def _replay_aggregate_init_response(self, ds: Datastore, job_id) -> AggregationJobResp:
@@ -529,6 +561,7 @@ class Aggregator:
 
     def __init__(self, ds: Datastore, clock: Clock | None = None, cfg: Config | None = None):
         from .cache import GlobalHpkeKeypairCache, PeerAggregatorCache
+        from .report_writer import ReportWriteBatcher
 
         self.ds = ds
         self.clock = clock or RealClock()
@@ -536,6 +569,9 @@ class Aggregator:
         self._task_aggs: dict[bytes, TaskAggregator] = {}
         self.global_hpke_keypairs = GlobalHpkeKeypairCache(ds)
         self.peer_aggregators = PeerAggregatorCache(ds) if self.cfg.taskprov_enabled else None
+        self.report_writer = ReportWriteBatcher(
+            ds, self.cfg.max_upload_batch_size, self.cfg.max_upload_batch_write_delay_ms
+        )
 
     def task_aggregator_for(
         self, task_id: TaskId, taskprov_task_config=None, headers=None, peer_role: Role = Role.LEADER

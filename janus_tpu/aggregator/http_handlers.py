@@ -176,7 +176,7 @@ class DapHttpApp:
         task_id = TaskId(_b64dec(match.group(1), 32))
         ta = self.agg.task_aggregator_for(task_id)
         report = Report.from_bytes(body)
-        ta.handle_upload(self.agg.ds, self.agg.clock, report)
+        ta.handle_upload(self.agg.ds, self.agg.clock, report, self.agg.report_writer)
         return 201, "text/plain", b""
 
     def h_aggregate_init(self, match, query, headers, body):

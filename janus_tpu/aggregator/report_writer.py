@@ -1,0 +1,104 @@
+"""Upload write batching.
+
+Equivalent of reference aggregator/src/aggregator/report_writer.rs:24-165
+(`ReportWriteBatcher`): buffer uploaded reports and flush them in a
+single transaction when `max_batch_size` accumulate or
+`max_write_delay` elapses, fanning the per-report outcome (fresh vs
+replayed) back to each waiting upload request.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..datastore.models import LeaderStoredReport
+from ..datastore.store import Datastore
+
+log = logging.getLogger(__name__)
+
+
+class _Pending:
+    __slots__ = ("report", "event", "fresh", "error")
+
+    def __init__(self, report: LeaderStoredReport):
+        self.report = report
+        self.event = threading.Event()
+        self.fresh: bool | None = None
+        self.error: BaseException | None = None
+
+
+class ReportWriteBatcher:
+    """Blocking writes with batched flushes. Request threads call
+    `write_report` and park until their batch's transaction commits."""
+
+    def __init__(
+        self,
+        ds: Datastore,
+        max_batch_size: int = 100,
+        max_write_delay_ms: int = 250,
+    ):
+        self.ds = ds
+        self.max_batch_size = max_batch_size
+        self.max_write_delay_s = max_write_delay_ms / 1000.0
+        self._lock = threading.Lock()
+        self._buffer: list[_Pending] = []
+        self._timer: threading.Timer | None = None
+
+    def write_report(self, report: LeaderStoredReport, timeout_s: float = 30.0) -> bool:
+        """Queue + wait for the batch commit; returns False on replay."""
+        pending = _Pending(report)
+        with self._lock:
+            self._buffer.append(pending)
+            if len(self._buffer) >= self.max_batch_size:
+                batch = self._take_locked()
+            else:
+                batch = None
+                if self._timer is None:
+                    self._timer = threading.Timer(self.max_write_delay_s, self._flush_timer)
+                    self._timer.daemon = True
+                    self._timer.start()
+        if batch:
+            self._flush(batch)
+        if not pending.event.wait(timeout_s):
+            raise TimeoutError("report write batch did not flush in time")
+        if pending.error is not None:
+            raise pending.error
+        assert pending.fresh is not None
+        return pending.fresh
+
+    def flush_now(self) -> None:
+        """Flush whatever is buffered (tests/shutdown)."""
+        with self._lock:
+            batch = self._take_locked()
+        if batch:
+            self._flush(batch)
+
+    def _take_locked(self) -> list[_Pending]:
+        batch, self._buffer = self._buffer, []
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        return batch
+
+    def _flush_timer(self) -> None:
+        with self._lock:
+            batch = self._take_locked()
+        if batch:
+            self._flush(batch)
+
+    def _flush(self, batch: list[_Pending]) -> None:
+        """One transaction for the whole batch (reference :96-165)."""
+        try:
+            def tx_fn(tx):
+                return [tx.put_client_report(p.report) for p in batch]
+
+            results = self.ds.run_tx(tx_fn, "upload_batch")
+            for p, fresh in zip(batch, results):
+                p.fresh = fresh
+        except BaseException as e:  # fan the failure out to every waiter
+            for p in batch:
+                p.error = e
+        finally:
+            for p in batch:
+                p.event.set()

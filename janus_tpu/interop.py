@@ -271,11 +271,18 @@ class InteropAggregator:
         col_jd = JobDriver(JobDriverConfig(), col_driver.acquirer(15), col_driver.stepper)
 
         def loop():
+            # Only step collection jobs after two consecutive quiet passes
+            # (no new aggregation work): an interop harness uploads then
+            # immediately collects, and collecting while reports are still
+            # being packed would close the batch under them.
+            quiet = 0
             while not self._stopper.stopped:
                 try:
-                    creator.run_once()
-                    agg_jd.run_once()
-                    col_jd.run_once()
+                    created = creator.run_once()
+                    stepped = agg_jd.run_once()
+                    quiet = quiet + 1 if (created == 0 and stepped == 0) else 0
+                    if quiet >= 2:
+                        col_jd.run_once()
                 except Exception:
                     log.exception("interop job runner pass failed")
                 self._stopper.wait(0.3)
@@ -285,6 +292,8 @@ class InteropAggregator:
 
     def stop(self) -> None:
         self._stopper.stop()
+        if self._runner is not None:
+            self._runner.join(timeout=10)
 
     # --- test API handlers ---
     def handle_ready(self, doc: dict) -> dict:

@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--current-batch", action="store_true", help="fixed-size: current batch")
     q.add_argument("--batch-id", help="fixed-size: base64url batch id")
     p.add_argument("--batch-interval-duration", type=int, help="time-interval query duration (s)")
+    p.add_argument(
+        "--timeout",
+        type=float,
+        default=600.0,
+        help="seconds to poll before giving up (first aggregation can be slow)",
+    )
     return p
 
 
@@ -129,7 +135,7 @@ def main(argv=None) -> int:
         raise SystemExit(f"bad key material or task id: {e}")
     params = CollectorParameters(task_id, args.leader, token, keypair)
     collector = Collector(params, vdaf, HttpClient())
-    result = collector.collect(query)
+    result = collector.collect(query, timeout_s=args.timeout)
     if result.partial_batch_selector is not None:
         bid = base64.urlsafe_b64encode(result.partial_batch_selector.batch_id.data)
         print(f"Batch: {bid.decode().rstrip('=')}")

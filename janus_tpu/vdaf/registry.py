@@ -64,6 +64,31 @@ class VdafInstance:
         Prio3FixedPoint{16,32,64}BitBoundedL2VecSum, core/src/task.rs:44-49)."""
         return cls("fixedpoint", bits=bits, length=length, chunk_length=chunk_length)
 
+    # --- test-only fakes (the reference's VdafInstance::Fake* variants,
+    # core/src/task.rs:50-58, backed by dummy_vdaf with injectable
+    # failures, core/src/test_util/dummy_vdaf.rs:17-66). They run the
+    # Count circuit but force per-report prepare failures at the
+    # aggregator dispatch sites, exercising error paths without crypto.
+    @classmethod
+    def fake(cls) -> "VdafInstance":
+        return cls("fake")
+
+    @classmethod
+    def fake_fails_prep_init(cls) -> "VdafInstance":
+        return cls("fake_fails_prep_init")
+
+    @classmethod
+    def fake_fails_prep_step(cls) -> "VdafInstance":
+        return cls("fake_fails_prep_step")
+
+    @property
+    def fails_prep_init(self) -> bool:
+        return self.kind == "fake_fails_prep_init"
+
+    @property
+    def fails_prep_step(self) -> bool:
+        return self.kind == "fake_fails_prep_step"
+
     def to_dict(self) -> dict:
         d = {"kind": self.kind}
         for k in ("bits", "length", "chunk_length"):
@@ -96,6 +121,8 @@ def circuit_for(inst: VdafInstance) -> Circuit:
         return SumVec(length=inst.length, bits=1, chunk_length=ch)
     if inst.kind == "fixedpoint":
         return FixedPointVec(length=inst.length, bits=inst.bits, chunk_length=ch)
+    if inst.kind in ("fake", "fake_fails_prep_init", "fake_fails_prep_step"):
+        return Count()
     raise ValueError(f"unknown VDAF kind {inst.kind!r}")
 
 

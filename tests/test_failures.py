@@ -1,0 +1,210 @@
+"""Failure-path tests: report write batching, fake-VDAF failure
+injection, and job abandonment — the reference's dummy_vdaf +
+TestRuntimeManager strategy (core/src/test_util/dummy_vdaf.rs,
+aggregation_job_driver.rs abandon_failing_aggregation_job:3353)."""
+
+import dataclasses
+import secrets
+import threading
+
+import pytest
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.aggregation_job_creator import (
+    AggregationJobCreator,
+    AggregationJobCreatorConfig,
+)
+from janus_tpu.aggregator.aggregation_job_driver import (
+    AggregationJobDriver,
+    AggregationJobDriverConfig,
+)
+from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+from janus_tpu.core.http_client import HttpClient
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.models import (
+    AggregationJobState,
+    LeaderStoredReport,
+    ReportAggregationState,
+)
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import (
+    HpkeCiphertext,
+    HpkeConfigId,
+    ReportId,
+    Role,
+    Time,
+)
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+def make_report(task, when=1_600_000_000):
+    return LeaderStoredReport(
+        task.task_id,
+        ReportId(secrets.token_bytes(16)),
+        Time(when),
+        b"",
+        b"x",
+        HpkeCiphertext(HpkeConfigId(0), b"", b""),
+    )
+
+
+@pytest.fixture()
+def ds():
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    yield eph.datastore
+    eph.cleanup()
+
+
+def put_task(ds, vdaf, **kw):
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+        .with_(min_batch_size=1, **kw)
+        .build()
+    )
+    ds.run_tx(lambda tx: tx.put_task(task))
+    return task
+
+
+# --- ReportWriteBatcher ---
+
+
+def test_batcher_flushes_at_max_batch_size(ds):
+    task = put_task(ds, VdafInstance.count())
+    batcher = ReportWriteBatcher(ds, max_batch_size=3, max_write_delay_ms=60_000)
+    results = []
+
+    def write():
+        results.append(batcher.write_report(make_report(task)))
+
+    threads = [threading.Thread(target=write) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert results == [True, True, True]
+    total, _ = ds.run_tx(lambda tx: tx.count_client_reports_for_task(task.task_id))
+    assert total == 3
+
+
+def test_batcher_flushes_on_delay_and_reports_replays(ds):
+    task = put_task(ds, VdafInstance.count())
+    batcher = ReportWriteBatcher(ds, max_batch_size=100, max_write_delay_ms=50)
+    report = make_report(task)
+    assert batcher.write_report(report) is True  # flushed by the timer
+    assert batcher.write_report(report) is False  # same id -> replay
+
+
+class _BrokenDs:
+    def run_tx(self, fn, name="tx"):
+        raise RuntimeError("datastore down")
+
+
+def test_batcher_fans_out_errors(ds):
+    task = put_task(ds, VdafInstance.count())
+    batcher = ReportWriteBatcher(_BrokenDs(), max_batch_size=1, max_write_delay_ms=50)
+    with pytest.raises(RuntimeError, match="datastore down"):
+        batcher.write_report(make_report(task))
+
+
+# --- fake VDAF failure injection, end to end ---
+
+
+@pytest.mark.parametrize("kind", ["fake_fails_prep_init", "fake_fails_prep_step"])
+def test_fake_vdaf_failures_fail_all_reports(kind):
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    leader_agg = Aggregator(leader_eph.datastore, clock, Config())
+    helper_agg = Aggregator(helper_eph.datastore, clock, Config())
+    leader_srv = DapServer(DapHttpApp(leader_agg)).start()
+    helper_srv = DapServer(DapHttpApp(helper_agg)).start()
+    try:
+        vdaf = VdafInstance(kind)
+        collector_kp = generate_hpke_config_and_private_key(config_id=200)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+        )
+        leader_eph.datastore.run_tx(lambda tx: tx.put_task(leader_task))
+        helper_eph.datastore.run_tx(lambda tx: tx.put_task(helper_task))
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        for m in [1, 0, 1]:
+            client.upload(m)
+
+        AggregationJobCreator(
+            leader_eph.datastore, AggregationJobCreatorConfig(min_aggregation_job_size=1)
+        ).run_once()
+        drv = AggregationJobDriver(leader_eph.datastore, http)
+        assert JobDriver(JobDriverConfig(), drv.acquirer(), drv.stepper).run_once() == 1
+
+        jobs = leader_eph.datastore.run_tx(
+            lambda tx: tx.get_aggregation_jobs_for_task(leader_task.task_id)
+        )
+        assert len(jobs) == 1 and jobs[0].state == AggregationJobState.FINISHED
+        ras = leader_eph.datastore.run_tx(
+            lambda tx: tx.get_report_aggregations_for_job(
+                leader_task.task_id, jobs[0].job_id
+            )
+        )
+        assert len(ras) == 3
+        assert all(ra.state == ReportAggregationState.FAILED for ra in ras)
+    finally:
+        leader_srv.stop()
+        helper_srv.stop()
+        leader_eph.cleanup()
+        helper_eph.cleanup()
+
+
+# --- abandonment after repeated failures ---
+
+
+def test_aggregation_job_abandoned_after_max_attempts(ds):
+    task = put_task(ds, VdafInstance.count())
+    report = make_report(task, 1_599_998_400)
+    ds.run_tx(lambda tx: tx.put_client_report(report))
+    AggregationJobCreator(ds, AggregationJobCreatorConfig(min_aggregation_job_size=1)).run_once()
+
+    drv = AggregationJobDriver(
+        ds,
+        HttpClient(timeout=0.2),
+        AggregationJobDriverConfig(maximum_attempts_before_failure=2),
+    )
+
+    # every step blows up mid-flight (the reference injects this with a
+    # mockito 500 helper; here the read-phase stand-in is simplest)
+    def boom(acquired):
+        raise RuntimeError("helper unreachable")
+
+    drv.step_aggregation_job = boom
+    jd = JobDriver(JobDriverConfig(), drv.acquirer(0), drv.stepper)
+    for _ in range(4):  # attempts 1,2 fail; attempt 3 crosses the limit
+        jd.run_once()
+
+    jobs = ds.run_tx(lambda tx: tx.get_aggregation_jobs_for_task(task.task_id))
+    assert len(jobs) == 1 and jobs[0].state == AggregationJobState.ABANDONED
+    # reports released back for a future job
+    unagg = ds.run_tx(
+        lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 10)
+    )
+    assert len(unagg) == 1
