@@ -130,17 +130,15 @@ class Accumulator:
         aggregations failed with PrepareError.BATCH_COLLECTED instead of
         failing the whole job (reference accumulator.rs:133-215 returns
         the same unmergeable set).
+
+        Does NOT consume the accumulator state: the surrounding
+        transaction may be retried after a rollback (run_tx retry loop),
+        and a retry must re-flush the same contributions.
         """
         unmerged: set = set()
         for batch_identifier, (share, count, checksum, interval, rids) in self._state.items():
             # a COLLECTED row in ANY shard closes the batch
-            collected = any(
-                ba.state == BatchAggregationState.COLLECTED
-                for ba in tx.get_batch_aggregations_for_batch(
-                    self.task.task_id, batch_identifier, b""
-                )
-            )
-            if collected:
+            if tx.batch_has_collected_shard(self.task.task_id, batch_identifier, b""):
                 unmerged.update(r.data for r in rids)
                 continue
             ord_ = secrets.randbelow(self.shard_count)
@@ -174,5 +172,4 @@ class Accumulator:
                 existing.checksum.combined_with(checksum),
             )
             tx.update_batch_aggregation(merged)
-        self._state.clear()
         return unmerged

@@ -169,7 +169,7 @@ class AggregationJobDriver:
 
         # test-only fake failure injection on the leader init path
         # (the reference's dummy_vdaf prep_init_fn hook)
-        if task.vdaf.fails_prep_init:
+        if task.vdaf.fails_at("init"):
             for i in range(n):
                 if failed[i] is None:
                     failed[i] = PrepareError.VDAF_PREP_ERROR
@@ -261,7 +261,7 @@ class AggregationJobDriver:
 
         # test-only fake failure at the leader continue/evaluate stage
         # (the reference's dummy_vdaf prep_step_fn hook)
-        if task.vdaf.fails_prep_step:
+        if task.vdaf.fails_at("step"):
             for i in range(n):
                 if accept[i]:
                     accept[i] = False

@@ -14,7 +14,11 @@ import logging
 from dataclasses import dataclass
 
 from ..core.retries import Backoff, retry_http_request
-from ..datastore.models import AcquiredCollectionJob, CollectionJobState
+from ..datastore.models import (
+    AcquiredCollectionJob,
+    AggregateShareJob,
+    CollectionJobState,
+)
 from .. import metrics
 from ..datastore.store import Datastore
 from ..messages import (
@@ -115,6 +119,36 @@ class CollectionJobDriver:
             # not enough reports yet: release and try again later
             self.ds.run_tx(lambda tx: tx.release_collection_job(acquired), "release")
             return
+
+        # DP: noise the leader's own share before release. The noised
+        # share is persisted per (batch, agg param) and reused by later
+        # collection jobs over the same batch — fresh noise per query
+        # would let a collector average it away (max_batch_query_count>1).
+        if task.dp_strategy.enabled:
+            from ..dp import add_noise_to_agg_share
+
+            existing = self.ds.run_tx(
+                lambda tx: tx.get_aggregate_share_job(
+                    task.task_id, job.batch_identifier, job.aggregation_parameter
+                ),
+                "leader_noised_share_lookup",
+            )
+            if existing is not None:
+                share = existing.helper_aggregate_share
+            else:
+                share = add_noise_to_agg_share(task.dp_strategy, field, share)
+                noised = AggregateShareJob(
+                    task.task_id,
+                    job.batch_identifier,
+                    job.aggregation_parameter,
+                    share,
+                    total,
+                    checksum,
+                )
+                self.ds.run_tx(
+                    lambda tx: tx.put_aggregate_share_job(noised),
+                    "leader_noised_share_store",
+                )
 
         if query.query_type == TimeInterval.CODE:
             batch_selector = BatchSelector.time_interval(Interval.from_bytes(job.batch_identifier))

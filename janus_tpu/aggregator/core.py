@@ -262,7 +262,7 @@ class TaskAggregator:
 
         # test-only fake VDAF failure injection (the reference's
         # dummy_vdaf prep_init_fn hook, core/src/test_util/dummy_vdaf.rs:46)
-        if task.vdaf.fails_prep_init:
+        if task.vdaf.fails_at("init"):
             for i in range(n):
                 if prep_err[i] is None:
                     prep_err[i] = PrepareError.VDAF_PREP_ERROR
@@ -293,7 +293,7 @@ class TaskAggregator:
 
         # test-only fake failure at the step/finish stage (the reference's
         # dummy_vdaf prep_step_fn hook, core/src/test_util/dummy_vdaf.rs:57)
-        if task.vdaf.fails_prep_step:
+        if task.vdaf.fails_at("step"):
             accept = np.zeros_like(accept)
 
         # mark VDAF-rejected lanes
@@ -532,6 +532,11 @@ class TaskAggregator:
                 )
             if total < task.min_batch_size:
                 raise errors.InvalidBatchSize(f"batch too small: {total}", task.task_id)
+            # DP: noise the helper's share once, before it is persisted or
+            # released (count/checksum stay exact; only the share is noised)
+            from ..dp import add_noise_to_agg_share
+
+            share = add_noise_to_agg_share(task.dp_strategy, self.circ.FIELD, share)
             job = AggregateShareJob(
                 task.task_id,
                 batch_identifier,

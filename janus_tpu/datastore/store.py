@@ -668,6 +668,17 @@ class Transaction:
             ReportIdChecksum(row[5]),
         )
 
+    def batch_has_collected_shard(
+        self, task_id: TaskId, batch_identifier: bytes, param: bytes
+    ) -> bool:
+        """Cheap existence check: is any shard of this batch collected?"""
+        row = self._c.execute(
+            "SELECT 1 FROM batch_aggregations WHERE task_id = ? AND batch_identifier = ?"
+            " AND aggregation_parameter = ? AND state = 'collected' LIMIT 1",
+            (task_id.data, batch_identifier, param),
+        ).fetchone()
+        return row is not None
+
     def get_batch_aggregations_for_batch(
         self, task_id: TaskId, batch_identifier: bytes, agg_param: bytes
     ) -> list[BatchAggregation]:

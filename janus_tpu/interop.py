@@ -271,18 +271,22 @@ class InteropAggregator:
         col_jd = JobDriver(JobDriverConfig(), col_driver.acquirer(15), col_driver.stepper)
 
         def loop():
-            # Only step collection jobs after two consecutive quiet passes
-            # (no new aggregation work): an interop harness uploads then
-            # immediately collects, and collecting while reports are still
-            # being packed would close the batch under them.
+            # Prefer finishing aggregation before stepping collection jobs
+            # (an interop harness uploads then immediately collects), but
+            # bound the deferral so a steady upload trickle cannot starve
+            # collection forever.
             quiet = 0
+            deferred = 0
             while not self._stopper.stopped:
                 try:
                     created = creator.run_once()
                     stepped = agg_jd.run_once()
                     quiet = quiet + 1 if (created == 0 and stepped == 0) else 0
-                    if quiet >= 2:
+                    if quiet >= 2 or deferred >= 20:
                         col_jd.run_once()
+                        deferred = 0
+                    else:
+                        deferred += 1
                 except Exception:
                     log.exception("interop job runner pass failed")
                 self._stopper.wait(0.3)

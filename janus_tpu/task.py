@@ -18,6 +18,12 @@ from .messages import Duration, HpkeConfig, Role, TaskId, Time, TimeInterval, Fi
 from .vdaf.registry import VERIFY_KEY_LENGTH, VdafInstance
 
 
+def _dp_from_dict(d):
+    from .dp import DpStrategy
+
+    return DpStrategy.from_dict(d)
+
+
 @dataclass(frozen=True)
 class QueryTypeConfig:
     """TimeInterval, or FixedSize{max_batch_size, batch_time_window_size}."""
@@ -76,11 +82,18 @@ class Task:
     aggregator_auth_token: AuthenticationToken | None
     collector_auth_token: AuthenticationToken | None
     hpke_keys: tuple[HpkeKeypair, ...] = ()
+    # DP noise each aggregator adds to its own aggregate share at release
+    # (beyond the reference, whose DpMechanism is only Reserved|None)
+    dp_strategy: "DpStrategy" = None  # type: ignore[assignment]
 
     def __post_init__(self):
         assert self.role in (Role.LEADER, Role.HELPER)
         assert len(self.vdaf_verify_key) == VERIFY_KEY_LENGTH
         assert self.time_precision.seconds > 0
+        if self.dp_strategy is None:
+            from .dp import DpStrategy
+
+            object.__setattr__(self, "dp_strategy", DpStrategy())
 
     def peer_endpoint(self) -> str:
         return (
@@ -135,6 +148,7 @@ class Task:
                 }
                 for kp in self.hpke_keys
             ],
+            "dp_strategy": self.dp_strategy.to_dict() if self.dp_strategy.enabled else None,
         }
 
     @classmethod
@@ -178,6 +192,7 @@ class Task:
                 )
                 for k in d.get("hpke_keys", ())
             ),
+            dp_strategy=_dp_from_dict(d.get("dp_strategy")),
         )
 
 

@@ -89,6 +89,12 @@ class VdafInstance:
     def fails_prep_step(self) -> bool:
         return self.kind == "fake_fails_prep_step"
 
+    def fails_at(self, stage: str) -> bool:
+        """Single seam for the fake-failure dispatch sites: stage is
+        "init" (prepare initialization) or "step" (continue/finish)."""
+        assert stage in ("init", "step")
+        return self.fails_prep_init if stage == "init" else self.fails_prep_step
+
     def to_dict(self) -> dict:
         d = {"kind": self.kind}
         for k in ("bits", "length", "chunk_length"):

@@ -1,0 +1,140 @@
+"""Differential-privacy tests: exact discrete-Gaussian sampler sanity,
+share-noising mechanics, and a full two-aggregator round with DP where
+the collected fixed-point result carries both parties' noise."""
+
+import dataclasses
+import math
+from fractions import Fraction
+
+import pytest
+
+from janus_tpu.dp import DpStrategy, add_noise_to_agg_share, discrete_gaussian
+from janus_tpu.fields.field import Field128
+from janus_tpu.vdaf.reference import fp_encode_floats
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+def test_discrete_gaussian_moments():
+    sigma = 5
+    n = 1500
+    xs = [discrete_gaussian(Fraction(sigma)) for _ in range(n)]
+    mean = sum(xs) / n
+    var = sum((x - mean) ** 2 for x in xs) / n
+    # mean standard error ~ sigma/sqrt(n) ~ 0.13; allow 6x
+    assert abs(mean) < 1.0
+    # variance concentrates around sigma^2 = 25
+    assert 15 < var < 40
+
+
+def test_discrete_gaussian_small_sigma_is_tight():
+    xs = [discrete_gaussian(Fraction(1, 2)) for _ in range(200)]
+    assert all(abs(x) <= 5 for x in xs)
+    assert any(x != 0 for x in xs)  # but it is noise
+
+
+def test_add_noise_none_is_identity():
+    share = Field128.encode_vec([1, 2, 3])
+    assert add_noise_to_agg_share(DpStrategy(), Field128, share) == share
+    assert add_noise_to_agg_share(DpStrategy("discrete_gaussian", 0.0), Field128, share) == share
+    assert add_noise_to_agg_share(DpStrategy("discrete_gaussian", 5.0), Field128, None) is None
+
+
+def test_add_noise_perturbs_within_tails():
+    truth = [1000, 2000, 3000]
+    share = Field128.encode_vec(truth)
+    strategy = DpStrategy("discrete_gaussian", 8.0)
+    noised = Field128.decode_vec(add_noise_to_agg_share(strategy, Field128, share))
+    half = Field128.MODULUS // 2
+    for got, want in zip(noised, truth):
+        delta = got - want if got - want < half else got - want - Field128.MODULUS
+        assert abs(delta) < 8 * 10  # 10 sigma
+
+
+def test_dp_end_to_end_fixed_point():
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    leader_srv = DapServer(DapHttpApp(Aggregator(leader_eph.datastore, clock, Config()))).start()
+    helper_srv = DapServer(DapHttpApp(Aggregator(helper_eph.datastore, clock, Config()))).start()
+    try:
+        vdaf = VdafInstance.fixed_point_vec(length=2, bits=16)
+        sigma = 4.0  # raw units; 4/32768 in value space
+        collector_kp = generate_hpke_config_and_private_key(config_id=200)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                min_batch_size=1,
+                dp_strategy=DpStrategy("discrete_gaussian", sigma),
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+        )
+        leader_eph.datastore.run_tx(lambda tx: tx.put_task(leader_task))
+        helper_eph.datastore.run_tx(lambda tx: tx.put_task(helper_task))
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        meas = [[0.25, -0.5], [0.25, 0.25]]
+        for m in meas:
+            client.upload(fp_encode_floats(m, 16))
+
+        AggregationJobCreator(
+            leader_eph.datastore, AggregationJobCreatorConfig(min_aggregation_job_size=1)
+        ).run_once()
+        drv = AggregationJobDriver(leader_eph.datastore, http)
+        JobDriver(JobDriverConfig(), drv.acquirer(), drv.stepper).run_once()
+
+        start = clock.now().to_batch_interval_start(leader_task.time_precision)
+        query = Query.time_interval(Interval(Time(start.seconds - 3600), Duration(2 * 3600)))
+        collector = Collector(
+            CollectorParameters(
+                leader_task.task_id, leader_srv.url, leader_task.collector_auth_token, collector_kp
+            ),
+            vdaf,
+            http,
+        )
+        job_id = collector.start_collection(query)
+        cdrv = CollectionJobDriver(leader_eph.datastore, http)
+        JobDriver(JobDriverConfig(), cdrv.acquirer(), cdrv.stepper).run_once()
+        result = collector.poll_once(job_id, query)
+
+        want = [0.5, -0.25]
+        tol = 12 * sigma * math.sqrt(2) / (1 << 15)  # 12 sigma_total in value space
+        assert result.report_count == 2
+        deltas = [abs(g - w) for g, w in zip(result.aggregate_result, want)]
+        assert all(d <= tol for d in deltas), (result.aggregate_result, want, tol)
+        # and it really is noised (collision with the exact sum is ~impossible...
+        # only with probability ~P[two independent dgauss sums == 0])
+    finally:
+        leader_srv.stop()
+        helper_srv.stop()
+        leader_eph.cleanup()
+        helper_eph.cleanup()
