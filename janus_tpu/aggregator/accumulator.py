@@ -35,10 +35,26 @@ def add_encoded_aggregate_shares(field, a: bytes | None, b: bytes | None) -> byt
     return field.encode_vec([field.add(x, y) for x, y in zip(va, vb)])
 
 
-def accumulate_batched(task, engine, accumulator: "Accumulator", out_shares, accept, metadatas) -> None:
+def fixed_size_batch_id(pbs) -> bytes | None:
+    """BatchId bytes for a fixed-size PartialBatchSelector, else None
+    (time-interval jobs bucket by time window)."""
+    from ..messages import FixedSize
+
+    return pbs.batch_id.data if pbs.query_type == FixedSize.CODE else None
+
+
+def accumulate_batched(
+    task, engine, accumulator: "Accumulator", out_shares, accept, metadatas,
+    batch_identifier: bytes | None = None,
+) -> None:
     """Group accepted lanes by batch bucket; one masked device reduce per
     bucket (replaces the reference's per-report Accumulator::update loop,
-    accumulator.rs:76-122)."""
+    accumulator.rs:76-122).
+
+    `batch_identifier`: for fixed-size tasks, the job's BatchId bytes —
+    every accepted lane lands in that one batch. None (time-interval
+    tasks) buckets lanes by their time_precision window.
+    """
     import numpy as np
 
     from ..messages import Interval
@@ -51,8 +67,11 @@ def accumulate_batched(task, engine, accumulator: "Accumulator", out_shares, acc
     for i, md in enumerate(metadatas):
         if not accept[i]:
             continue
-        start = md.time.to_batch_interval_start(task.time_precision)
-        bid = Interval(start, task.time_precision).to_bytes()
+        if batch_identifier is not None:
+            bid = batch_identifier
+        else:
+            start = md.time.to_batch_interval_start(task.time_precision)
+            bid = Interval(start, task.time_precision).to_bytes()
         buckets.setdefault(bid, []).append(i)
     for bid, lanes in buckets.items():
         bucket_mask = np.zeros(n, dtype=bool)

@@ -92,9 +92,11 @@ class AggregationJobCreator:
                 return created
 
     def _create_fixed_size_jobs(self, task: Task) -> int:
-        """Greedy batch packing toward max_batch_size (reference
-        batch_creator.rs:140-330, simplified: one outstanding batch per
-        time bucket)."""
+        """Batch packing toward max_batch_size (reference
+        batch_creator.rs:140-330): claimed reports top up the fullest
+        unfilled outstanding batch of their time bucket first, spill
+        into new batches, and batches are marked filled exactly when
+        their assigned size reaches max_batch_size."""
         created = 0
         max_bs = task.query_type.max_batch_size or self.cfg.max_aggregation_job_size
         while True:
@@ -109,30 +111,69 @@ class AggregationJobCreator:
                     )
                 return created
 
-            def assign(tx):
-                window = task.query_type.batch_time_window_size
-                bucket = (
-                    claimed[0][1].to_batch_interval_start(window) if window else None
-                )
-                obs = tx.get_outstanding_batches(task.task_id, bucket)
-                if obs:
-                    return obs[0].batch_id
-                bid = BatchId(secrets.token_bytes(32))
-                tx.put_outstanding_batch(OutstandingBatch(task.task_id, bid, bucket))
-                return bid
+            window = task.query_type.batch_time_window_size
+            by_bucket: dict = {}
+            for rid, t in claimed:
+                bucket = t.to_batch_interval_start(window) if window else None
+                by_bucket.setdefault(bucket, []).append((rid, t))
 
-            batch_id = self.ds.run_tx(assign, "creator_fixed_assign")
-            self._write_job(task, claimed, PartialBatchSelector.fixed_size(batch_id))
-            created += 1
-            if len(claimed) >= max_bs:
-                self.ds.run_tx(
-                    lambda tx: tx.mark_outstanding_batch_filled(task.task_id, batch_id),
-                    "creator_fixed_fill",
-                )
+            min_job = max(1, self.cfg.min_aggregation_job_size)
+
+            def assign_and_write(tx):
+                """One transaction: batch accounting AND job rows commit
+                together (a crash between them would otherwise corrupt
+                outstanding-batch sizes and orphan claimed reports)."""
+                n_jobs = 0
+                for bucket, group in by_bucket.items():
+                    remaining = list(group)
+                    obs = tx.get_outstanding_batches(task.task_id, bucket)
+                    while remaining:
+                        if obs:
+                            ob = obs.pop(0)
+                            bid, size = ob.batch_id, ob.size
+                        else:
+                            bid, size = None, 0  # a new batch, created lazily
+                        take = min(max_bs - size, len(remaining))
+                        if take <= 0:
+                            tx.mark_outstanding_batch_filled(task.task_id, bid)
+                            continue
+                        if take < min_job and size + take < max_bs:
+                            # too small for a job and doesn't complete the
+                            # batch: leave these reports for a later pass
+                            tx.mark_reports_unaggregated(
+                                task.task_id, [r for r, _ in remaining]
+                            )
+                            break
+                        if bid is None:
+                            bid = BatchId(secrets.token_bytes(32))
+                            tx.put_outstanding_batch(
+                                OutstandingBatch(task.task_id, bid, bucket)
+                            )
+                        chunk, remaining = remaining[:take], remaining[take:]
+                        new_size = tx.add_to_outstanding_batch(task.task_id, bid, take)
+                        if new_size >= max_bs:
+                            tx.mark_outstanding_batch_filled(task.task_id, bid)
+                        self._write_job_in_tx(
+                            tx, task, chunk, PartialBatchSelector.fixed_size(bid)
+                        )
+                        n_jobs += 1
+                return n_jobs
+
+            n_jobs = self.ds.run_tx(assign_and_write, "creator_fixed_assign")
+            created += n_jobs
+            if n_jobs == 0:
+                # every bucket deferred (sub-min chunks): the same reports
+                # would be re-claimed forever — stop this pass
+                return created
             if len(claimed) < self.cfg.max_aggregation_job_size:
                 return created
 
     def _write_job(self, task: Task, claimed, pbs: PartialBatchSelector) -> None:
+        self.ds.run_tx(
+            lambda tx: self._write_job_in_tx(tx, task, claimed, pbs), "creator_write_job"
+        )
+
+    def _write_job_in_tx(self, tx, task: Task, claimed, pbs: PartialBatchSelector) -> None:
         job_id = AggregationJobId(secrets.token_bytes(16))
         times = [t.seconds for _, t in claimed]
         job = AggregationJobModel(
@@ -144,16 +185,10 @@ class AggregationJobCreator:
             AggregationJobState.IN_PROGRESS,
             0,
         )
-        ras = [
-            ReportAggregationModel(
-                task.task_id, job_id, rid, t, i, ReportAggregationState.START
+        tx.put_aggregation_job(job)
+        for i, (rid, t) in enumerate(claimed):
+            tx.put_report_aggregation(
+                ReportAggregationModel(
+                    task.task_id, job_id, rid, t, i, ReportAggregationState.START
+                )
             )
-            for i, (rid, t) in enumerate(claimed)
-        ]
-
-        def write(tx):
-            tx.put_aggregation_job(job)
-            for ra in ras:
-                tx.put_report_aggregation(ra)
-
-        self.ds.run_tx(write, "creator_write_job")

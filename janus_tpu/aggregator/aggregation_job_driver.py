@@ -55,7 +55,7 @@ from ..vdaf.wire import (
     encode_pingpong,
     seeds_to_lanes,
 )
-from .accumulator import Accumulator, accumulate_batched
+from .accumulator import Accumulator, accumulate_batched, fixed_size_batch_id
 from .engine_cache import engine_cache
 
 log = logging.getLogger(__name__)
@@ -270,7 +270,11 @@ class AggregationJobDriver:
         # masked accumulate (reference Accumulator::update :605-627)
         accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
         metadatas = [ReportMetadata(ra.report_id, ra.client_time) for ra in pending]
-        accumulate_batched(task, engine, accumulator, out0, accept, metadatas)
+        pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
+        fixed_bid = fixed_size_batch_id(pbs)
+        accumulate_batched(
+            task, engine, accumulator, out0, accept, metadatas, batch_identifier=fixed_bid
+        )
 
         # tx2: write results + release (reference :698-724)
         new_ras = []

@@ -68,7 +68,12 @@ from ..vdaf.wire import (
     split_prep_share_columns,
 )
 from . import errors
-from .accumulator import Accumulator, accumulate_batched, add_encoded_aggregate_shares
+from .accumulator import (
+    Accumulator,
+    accumulate_batched,
+    add_encoded_aggregate_shares,
+    fixed_size_batch_id,
+)
 from .engine_cache import engine_cache
 
 import numpy as np
@@ -330,8 +335,15 @@ class TaskAggregator:
 
         # accumulate accepted out shares per batch bucket (reference :1811-1826)
         accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
+        fixed_bid = fixed_size_batch_id(req.partial_batch_selector)
         accumulate_batched(
-            task, self.engine, accumulator, out1, accept, [pi.report_share.metadata for pi in inits]
+            task,
+            self.engine,
+            accumulator,
+            out1,
+            accept,
+            [pi.report_share.metadata for pi in inits],
+            batch_identifier=fixed_bid,
         )
 
         times = [pi.report_share.metadata.time.seconds for pi in inits]
@@ -395,6 +407,9 @@ class TaskAggregator:
         task = self.task
         if req.query.query_type != task.query_type.code:
             raise errors.InvalidMessage("query type mismatch", task.task_id)
+        from ..messages import FixedSizeQuery
+
+        current_batch = False
         if req.query.query_type == TimeInterval.CODE:
             interval = req.query.batch_interval
             if not interval.aligned_to(task.time_precision):
@@ -402,24 +417,56 @@ class TaskAggregator:
             if interval.duration.seconds < task.time_precision.seconds:
                 raise errors.BatchInvalid("batch interval too small", task.task_id)
             batch_identifier = interval.to_bytes()
-        else:
+        elif req.query.fixed_size_query.kind == FixedSizeQuery.BY_BATCH_ID:
             batch_identifier = req.query.fixed_size_query.batch_id.data
+        else:
+            current_batch = True  # batch resolved inside the tx
+            batch_identifier = None
 
         def create(tx):
-            existing = tx.find_collection_job_by_query(task.task_id, req.query.to_bytes())
-            if existing is not None:
-                if existing.collection_job_id != collection_job_id:
-                    raise errors.BatchOverlap("query already collected under another job", task.task_id)
-                return
-            if tx.get_collection_job(task.task_id, collection_job_id) is not None:
-                raise errors.InvalidMessage("collection job id reuse", task.task_id)
+            # current-batch queries are byte-identical across requests, so
+            # their idempotency key is the collection job id, not the query
+            # (reference fixed-size current-batch acquisition,
+            # aggregator.rs:2185-2485 / query_type.rs FixedSize)
+            if current_batch:
+                if tx.get_collection_job(task.task_id, collection_job_id) is not None:
+                    return
+                chosen = None
+                for ob in tx.get_outstanding_batches(task.task_id, include_filled=True):
+                    # gate on ACTUALLY AGGREGATED reports, not assigned ones:
+                    # assigned reports can fail prepare, and consuming a
+                    # batch that can never reach min_batch_size strands it
+                    aggregated = sum(
+                        ba.report_count
+                        for ba in tx.get_batch_aggregations_for_batch(
+                            task.task_id, ob.batch_id.data, req.aggregation_parameter
+                        )
+                    )
+                    if aggregated >= task.min_batch_size:
+                        chosen = ob
+                        break
+                if chosen is None:
+                    raise errors.BatchInvalid(
+                        "no batch ready for collection", task.task_id
+                    )
+                tx.delete_outstanding_batch(task.task_id, chosen.batch_id)
+                bid = chosen.batch_id.data
+            else:
+                existing = tx.find_collection_job_by_query(task.task_id, req.query.to_bytes())
+                if existing is not None:
+                    if existing.collection_job_id != collection_job_id:
+                        raise errors.BatchOverlap("query already collected under another job", task.task_id)
+                    return
+                if tx.get_collection_job(task.task_id, collection_job_id) is not None:
+                    raise errors.InvalidMessage("collection job id reuse", task.task_id)
+                bid = batch_identifier
             tx.put_collection_job(
                 CollectionJobModel(
                     task.task_id,
                     collection_job_id,
                     req.query.to_bytes(),
                     req.aggregation_parameter,
-                    batch_identifier,
+                    bid,
                     CollectionJobState.START,
                 )
             )

@@ -233,3 +233,4 @@ class OutstandingBatch:
     task_id: TaskId
     batch_id: BatchId
     time_bucket_start: Time | None
+    size: int = 0  # reports assigned so far (incl. in-flight)

@@ -56,7 +56,7 @@ from .models import (
     ReportAggregationState,
 )
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS schema_version (version INTEGER NOT NULL);
@@ -172,6 +172,7 @@ CREATE TABLE IF NOT EXISTS outstanding_batches (
     task_id BLOB NOT NULL,
     batch_id BLOB NOT NULL,
     time_bucket_start INTEGER,
+    size INTEGER NOT NULL DEFAULT 0,     -- reports assigned so far
     filled INTEGER NOT NULL DEFAULT 0,
     PRIMARY KEY (task_id, batch_id)
 );
@@ -948,37 +949,68 @@ class Transaction:
     # ---- outstanding batches (reference datastore.rs:3707-3943) ----
     def put_outstanding_batch(self, ob: OutstandingBatch) -> None:
         self._c.execute(
-            "INSERT INTO outstanding_batches (task_id, batch_id, time_bucket_start) VALUES (?,?,?)",
+            "INSERT INTO outstanding_batches (task_id, batch_id, time_bucket_start, size)"
+            " VALUES (?,?,?,?)",
             (
                 ob.task_id.data,
                 ob.batch_id.data,
                 ob.time_bucket_start.seconds if ob.time_bucket_start else None,
+                ob.size,
             ),
         )
 
     def get_outstanding_batches(
-        self, task_id: TaskId, time_bucket_start: Time | None = None
+        self,
+        task_id: TaskId,
+        time_bucket_start: Time | None = None,
+        include_filled: bool = False,
     ) -> list[OutstandingBatch]:
+        # fullest-first: the reference's per-bucket priority queue
+        # (batch_creator.rs:83) tops up the most-filled batch first; a
+        # current-batch collection wants filled batches too (fullest wins)
+        filled_clause = "" if include_filled else " AND filled = 0"
         if time_bucket_start is None:
             rows = self._c.execute(
-                "SELECT batch_id, time_bucket_start FROM outstanding_batches"
-                " WHERE task_id = ? AND filled = 0",
+                "SELECT batch_id, time_bucket_start, size FROM outstanding_batches"
+                f" WHERE task_id = ?{filled_clause} ORDER BY size DESC",
                 (task_id.data,),
             ).fetchall()
         else:
             rows = self._c.execute(
-                "SELECT batch_id, time_bucket_start FROM outstanding_batches"
-                " WHERE task_id = ? AND filled = 0 AND time_bucket_start = ?",
+                "SELECT batch_id, time_bucket_start, size FROM outstanding_batches"
+                f" WHERE task_id = ?{filled_clause} AND time_bucket_start = ?"
+                " ORDER BY size DESC",
                 (task_id.data, time_bucket_start.seconds),
             ).fetchall()
         return [
-            OutstandingBatch(task_id, BatchId(r[0]), Time(r[1]) if r[1] is not None else None)
+            OutstandingBatch(
+                task_id, BatchId(r[0]), Time(r[1]) if r[1] is not None else None, r[2]
+            )
             for r in rows
         ]
+
+    def add_to_outstanding_batch(self, task_id: TaskId, batch_id: BatchId, n: int) -> int:
+        """Record n more reports assigned to the batch; returns new size."""
+        row = self._c.execute(
+            "UPDATE outstanding_batches SET size = size + ? WHERE task_id = ? AND batch_id = ?"
+            " RETURNING size",
+            (n, task_id.data, batch_id.data),
+        ).fetchone()
+        if row is None:
+            raise TxConflict("outstanding batch vanished")
+        return row[0]
 
     def mark_outstanding_batch_filled(self, task_id: TaskId, batch_id: BatchId) -> None:
         self._c.execute(
             "UPDATE outstanding_batches SET filled = 1 WHERE task_id = ? AND batch_id = ?",
+            (task_id.data, batch_id.data),
+        )
+
+    def delete_outstanding_batch(self, task_id: TaskId, batch_id: BatchId) -> None:
+        """Consume a batch chosen by a current-batch collection (reference
+        delete_outstanding_batch, datastore.rs:3707-3943)."""
+        self._c.execute(
+            "DELETE FROM outstanding_batches WHERE task_id = ? AND batch_id = ?",
             (task_id.data, batch_id.data),
         )
 

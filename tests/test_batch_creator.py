@@ -1,0 +1,96 @@
+"""Fixed-size batch packing semantics (reference batch_creator.rs:
+greedy fill toward max_batch_size, fullest-batch-first, filled batches
+retired)."""
+
+import secrets
+
+from janus_tpu.aggregator.aggregation_job_creator import (
+    AggregationJobCreator,
+    AggregationJobCreatorConfig,
+)
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import HpkeCiphertext, HpkeConfigId, ReportId, Role, Time
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+def put_reports(ds, task, n, when=1_600_000_000):
+    from janus_tpu.datastore.models import LeaderStoredReport
+
+    def tx_fn(tx):
+        for _ in range(n):
+            tx.put_client_report(
+                LeaderStoredReport(
+                    task.task_id,
+                    ReportId(secrets.token_bytes(16)),
+                    Time(when),
+                    b"",
+                    b"x",
+                    HpkeCiphertext(HpkeConfigId(0), b"", b""),
+                )
+            )
+
+    ds.run_tx(tx_fn)
+
+
+def test_fixed_size_packing_fills_and_spills():
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    ds = eph.datastore
+    try:
+        task = (
+            TaskBuilder(
+                QueryTypeConfig.fixed_size(max_batch_size=5),
+                VdafInstance.count(),
+                Role.LEADER,
+            )
+            .with_(min_batch_size=1)
+            .build()
+        )
+        ds.run_tx(lambda tx: tx.put_task(task))
+        put_reports(ds, task, 12)
+
+        creator = AggregationJobCreator(
+            ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=100
+            ),
+        )
+        creator.run_once()
+
+        # outstanding batches: two filled (5+5), one open with 2
+        rows = ds.run_tx(
+            lambda tx: tx._c.execute(
+                "SELECT size, filled FROM outstanding_batches WHERE task_id = ?"
+                " ORDER BY size DESC",
+                (task.task_id.data,),
+            ).fetchall()
+        )
+        assert [tuple(r) for r in rows] == [(5, 1), (5, 1), (2, 0)]
+
+        # a later pass tops up the open batch first
+        put_reports(ds, task, 4)
+        creator.run_once()
+        rows = ds.run_tx(
+            lambda tx: tx._c.execute(
+                "SELECT size, filled FROM outstanding_batches WHERE task_id = ?"
+                " ORDER BY size DESC",
+                (task.task_id.data,),
+            ).fetchall()
+        )
+        assert [tuple(r) for r in rows] == [(5, 1), (5, 1), (5, 1), (1, 0)]
+
+        # every report aggregation's job points at a batch with size <= 5
+        jobs = ds.run_tx(lambda tx: tx.get_aggregation_jobs_for_task(task.task_id))
+        per_batch = {}
+        for job in jobs:
+            ras = ds.run_tx(
+                lambda tx, j=job: tx.get_report_aggregations_for_job(task.task_id, j.job_id)
+            )
+            per_batch[job.partial_batch_identifier] = per_batch.get(
+                job.partial_batch_identifier, 0
+            ) + len(ras)
+        assert sum(per_batch.values()) == 16
+        assert all(v <= 5 for v in per_batch.values())
+    finally:
+        eph.cleanup()

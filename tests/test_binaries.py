@@ -1,0 +1,88 @@
+"""Process-level binary tests: each binary boots from a YAML config,
+serves /healthz, and drains cleanly on SIGTERM — the analog of the
+reference's graceful-shutdown suite (aggregator/tests/graceful_shutdown.rs)
+and trycmd CLI goldens (aggregator/tests/cli.rs)."""
+
+import base64
+import os
+import secrets
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BINARIES = [
+    ("aggregator", "listen_address: \"127.0.0.1:{dap_port}\"\n"),
+    ("aggregation_job_creator", "aggregation_job_creation_interval_secs: 0.5\n"),
+    ("aggregation_job_driver", ""),
+    ("collection_job_driver", ""),
+]
+
+
+def wait_healthz(port: int, deadline_s: float = 60.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=2) as r:
+                assert r.status == 200
+                return
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+@pytest.mark.parametrize(
+    "idx,name,extra",
+    [(i, n, e) for i, (n, e) in enumerate(BINARIES)],
+    ids=[b[0] for b in BINARIES],
+)
+def test_binary_boots_and_drains_on_sigterm(tmp_path, idx, name, extra):
+    health_port = 20200 + idx
+    dap_port = health_port + 1000
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        f"database: {{url: {tmp_path}/ds.sqlite}}\n"
+        f"health_check_listen_address: \"127.0.0.1:{health_port}\"\n"
+        "jax_platform: cpu\n" + extra.format(dap_port=dap_port)
+    )
+    key = base64.urlsafe_b64encode(secrets.token_bytes(16)).decode().rstrip("=")
+    env = dict(os.environ, PYTHONPATH=REPO, DATASTORE_KEYS=key, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", f"janus_tpu.bin.{name}", "--config-file", str(cfg)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+    )
+    try:
+        wait_healthz(health_port)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+        assert proc.returncode == 0, out.decode()[-2000:]
+        assert b"shut down" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_janus_cli_help_and_bad_args():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "janus_tpu.bin.janus_cli", "--help"],
+        env=env, capture_output=True, cwd=REPO,
+    )
+    assert out.returncode == 0
+    for cmd in ("provision-tasks", "create-datastore-key", "list-tasks"):
+        assert cmd.encode() in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-m", "janus_tpu.bin.janus_cli", "no-such-command"],
+        env=env, capture_output=True, cwd=REPO,
+    )
+    assert out.returncode != 0

@@ -261,3 +261,75 @@ def test_helper_auth_and_idempotency(pair):
     bad_headers["Authorization"] = "Bearer wrong"
     s3, b3 = http.put(captured["url"], captured["body"], bad_headers)
     assert s3 == 400 and b"unauthorizedRequest" in b3
+
+
+def test_fixed_size_current_batch_round_trip(pair):
+    """Fixed-size task: packing to max_batch_size, current-batch
+    collection consuming batches fullest-first (reference
+    batch_creator.rs + fixed-size CollectableQueryType)."""
+    import janus_tpu.messages as m
+
+    vdaf = VdafInstance.histogram(length=3)
+    collector_kp = generate_hpke_config_and_private_key(config_id=200)
+    leader_task = (
+        TaskBuilder(QueryTypeConfig.fixed_size(max_batch_size=4), vdaf, Role.LEADER)
+        .with_(
+            leader_aggregator_endpoint=pair["leader_srv"].url,
+            helper_aggregator_endpoint=pair["helper_srv"].url,
+            collector_hpke_config=collector_kp.config,
+            min_batch_size=1,
+        )
+        .build()
+    )
+    helper_task = dataclasses.replace(
+        leader_task,
+        role=Role.HELPER,
+        hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+    )
+    pair["leader_ds"].run_tx(lambda tx: tx.put_task(leader_task))
+    pair["helper_ds"].run_tx(lambda tx: tx.put_task(helper_task))
+
+    http = HttpClient()
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, vdaf, http, clock=pair["clock"])
+    for meas in [0, 1, 1, 2, 2, 2]:
+        client.upload(meas)
+
+    AggregationJobCreator(
+        pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+    ).run_once()
+    drv = AggregationJobDriver(pair["leader_ds"], http)
+    JobDriver(JobDriverConfig(), drv.acquirer(), drv.stepper).run_once()
+
+    collector = Collector(
+        CollectorParameters(
+            leader_task.task_id,
+            pair["leader_srv"].url,
+            leader_task.collector_auth_token,
+            collector_kp,
+        ),
+        vdaf,
+        http,
+    )
+    cdrv = CollectionJobDriver(pair["leader_ds"], http)
+    query = Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.CURRENT_BATCH))
+
+    job1 = collector.start_collection(query)
+    JobDriver(JobDriverConfig(), cdrv.acquirer(), cdrv.stepper).run_once()
+    res1 = collector.poll_once(job1, query)
+    assert res1.report_count == 4
+    assert res1.partial_batch_selector is not None
+
+    job2 = collector.start_collection(query)
+    JobDriver(JobDriverConfig(), cdrv.acquirer(), cdrv.stepper).run_once()
+    res2 = collector.poll_once(job2, query)
+    assert res2.report_count == 2
+    assert res2.partial_batch_selector.batch_id != res1.partial_batch_selector.batch_id
+
+    combined = [a + b for a, b in zip(res1.aggregate_result, res2.aggregate_result)]
+    assert combined == [1, 2, 3]
