@@ -69,6 +69,8 @@ def main() -> None:
                     flush=True,
                 )
                 time.sleep(150)
+                if progress["done"] or time.monotonic() - progress["t"] < 150:
+                    return  # the run came back to life during the rest
                 os.environ["JANUS_BENCH_ATTEMPT"] = str(attempt + 1)
             else:
                 print("[bench] accelerator unusable; re-exec on CPU backend", file=sys.stderr, flush=True)

@@ -194,6 +194,12 @@ class TaskAggregator:
                 return self._replay_aggregate_init_response(ds, job_id)
             raise errors.InvalidMessage("aggregation job id reuse", task.task_id)
 
+        if req.partial_batch_selector.query_type != task.query_type.code:
+            # reference rejects PBS/task query-type mismatch as invalidMessage
+            raise errors.InvalidMessage(
+                "partial batch selector query type mismatch", task.task_id
+            )
+
         inits = list(req.prepare_inits)
         n = len(inits)
         ids = [pi.report_share.metadata.report_id for pi in inits]
@@ -429,18 +435,20 @@ class TaskAggregator:
             # (reference fixed-size current-batch acquisition,
             # aggregator.rs:2185-2485 / query_type.rs FixedSize)
             if current_batch:
-                if tx.get_collection_job(task.task_id, collection_job_id) is not None:
-                    return
+                existing = tx.get_collection_job(task.task_id, collection_job_id)
+                if existing is not None:
+                    if existing.query != req.query.to_bytes():
+                        raise errors.InvalidMessage(
+                            "collection job id reuse", task.task_id
+                        )
+                    return  # idempotent retry of the same request
                 chosen = None
                 for ob in tx.get_outstanding_batches(task.task_id, include_filled=True):
                     # gate on ACTUALLY AGGREGATED reports, not assigned ones:
                     # assigned reports can fail prepare, and consuming a
                     # batch that can never reach min_batch_size strands it
-                    aggregated = sum(
-                        ba.report_count
-                        for ba in tx.get_batch_aggregations_for_batch(
-                            task.task_id, ob.batch_id.data, req.aggregation_parameter
-                        )
+                    aggregated = tx.sum_batch_aggregation_report_count(
+                        task.task_id, ob.batch_id.data, req.aggregation_parameter
                     )
                     if aggregated >= task.min_batch_size:
                         chosen = ob
@@ -678,6 +686,11 @@ class Aggregator:
         peer = self.taskprov_authorize_request(peer_role, task_id, task_config, headers)
         try:
             vdaf_instance = task_config.vdaf_config.vdaf_type.to_vdaf_instance()
+            # gate BEFORE persisting: a task whose circuit can never be
+            # built (e.g. Poplar1, which needs nontrivial aggregation
+            # parameters) must be a clean InvalidTask rejection, not a
+            # poisoned stored task that 500s forever
+            circuit_for(vdaf_instance)
         except ValueError as e:
             raise errors.InvalidTask(str(e), task_id)
         our_role = Role.HELPER if peer_role == Role.LEADER else Role.LEADER

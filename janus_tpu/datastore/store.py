@@ -669,6 +669,17 @@ class Transaction:
             ReportIdChecksum(row[5]),
         )
 
+    def sum_batch_aggregation_report_count(
+        self, task_id: TaskId, batch_identifier: bytes, param: bytes
+    ) -> int:
+        """Aggregated report total for a batch, one SELECT across shards."""
+        row = self._c.execute(
+            "SELECT COALESCE(SUM(report_count), 0) FROM batch_aggregations"
+            " WHERE task_id = ? AND batch_identifier = ? AND aggregation_parameter = ?",
+            (task_id.data, batch_identifier, param),
+        ).fetchone()
+        return int(row[0])
+
     def batch_has_collected_shard(
         self, task_id: TaskId, batch_identifier: bytes, param: bytes
     ) -> bool:

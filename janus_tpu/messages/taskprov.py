@@ -180,6 +180,8 @@ class VdafType(Codec):
             # bucket boundaries -> bucket count (top bucket extends to
             # infinity), as the reference translates pre-VDAF-06 configs
             return VdafInstance.histogram(len(self.buckets) + 1)
+        if self.code == VdafTypeCode.POPLAR1:
+            return VdafInstance.poplar1(self.bits)
         raise ValueError(f"unsupported taskprov VdafType {self.code!r}")
 
 

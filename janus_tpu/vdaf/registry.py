@@ -64,6 +64,16 @@ class VdafInstance:
         Prio3FixedPoint{16,32,64}BitBoundedL2VecSum, core/src/task.rs:44-49)."""
         return cls("fixedpoint", bits=bits, length=length, chunk_length=chunk_length)
 
+    @classmethod
+    def poplar1(cls, bits: int) -> "VdafInstance":
+        """Heavy-hitters VDAF (the reference's Poplar1 variant,
+        core/src/task.rs). Declared and implemented
+        (janus_tpu.vdaf.poplar1) but, exactly like the reference,
+        unreachable through the DAP flow: nontrivial aggregation
+        parameters are unsupported (reference README.md:9-11,
+        VdafHasAggregationParameter, aggregator_core/src/lib.rs:44)."""
+        return cls("poplar1", bits=bits)
+
     # --- test-only fakes (the reference's VdafInstance::Fake* variants,
     # core/src/task.rs:50-58, backed by dummy_vdaf with injectable
     # failures, core/src/test_util/dummy_vdaf.rs:17-66). They run the
@@ -129,6 +139,12 @@ def circuit_for(inst: VdafInstance) -> Circuit:
         return FixedPointVec(length=inst.length, bits=inst.bits, chunk_length=ch)
     if inst.kind in ("fake", "fake_fails_prep_init", "fake_fails_prep_step"):
         return Count()
+    if inst.kind == "poplar1":
+        raise ValueError(
+            "Poplar1 requires nontrivial aggregation parameters, which the "
+            "DAP flow does not support (same practical gate as the "
+            "reference); use janus_tpu.vdaf.poplar1 directly"
+        )
     raise ValueError(f"unknown VDAF kind {inst.kind!r}")
 
 

@@ -1,0 +1,94 @@
+"""Poplar1 / IDPF tests: point-function correctness at every level,
+sketch rejection of malformed keys, and the end-to-end heavy-hitters
+loop (the capability the reference declares via its Poplar1 variant,
+core/src/task.rs, but never exercises end-to-end)."""
+
+import pytest
+
+from janus_tpu.vdaf.poplar1 import (
+    Idpf,
+    Poplar1,
+    Poplar1AggParam,
+    heavy_hitters,
+)
+from janus_tpu.vdaf.reference import VdafError
+
+
+def reconstruct(idpf, k0, k1, level, prefixes):
+    F = idpf.field_at(level)
+    v0 = idpf.eval_prefixes(0, k0, level, prefixes)
+    v1 = idpf.eval_prefixes(1, k1, level, prefixes)
+    return [[F.add(a, b) for a, b in zip(x, y)] for x, y in zip(v0, v1)]
+
+
+def test_idpf_point_function_every_level():
+    bits = 6
+    alpha = 0b101101
+    idpf = Idpf(bits)
+    _, k0, k1 = idpf.gen(alpha)
+    for level in range(bits):
+        prefixes = list(range(1 << (level + 1)))
+        vals = reconstruct(idpf, k0, k1, level, prefixes)
+        on_path = alpha >> (bits - 1 - level)
+        for p, v in zip(prefixes, vals):
+            if p == on_path:
+                assert v[0] == 1, (level, p, v)
+            else:
+                assert v[0] == 0, (level, p, v)
+
+
+def test_idpf_shares_are_pseudorandom():
+    idpf = Idpf(4)
+    _, k0, k1 = idpf.gen(0b1010)
+    # a single party's shares should not be trivially zero
+    v0 = idpf.eval_prefixes(0, k0, 3, list(range(16)))
+    assert any(x[0] != 0 for x in v0)
+
+
+def test_poplar1_prefix_counts():
+    bits = 4
+    poplar = Poplar1(bits)
+    measurements = [0b1010, 0b1010, 0b1100, 0b0001]
+    keys = [poplar.shard(m)[1] for m in measurements]
+
+    agg_param = Poplar1AggParam(1, (0b10, 0b11, 0b00))
+    out = {0: [], 1: []}
+    for k0, k1 in keys:
+        st0, m0 = poplar.prepare_init(0, k0, agg_param)
+        st1, m1 = poplar.prepare_init(1, k1, agg_param)
+        out[0].append(poplar.prepare_finish(st0, [m0, m1]))
+        out[1].append(poplar.prepare_finish(st1, [m0, m1]))
+    counts = poplar.unshard(
+        agg_param,
+        [poplar.aggregate(agg_param, out[0]), poplar.aggregate(agg_param, out[1])],
+    )
+    # prefixes of length 2: 10 matches 1010,1010; 11 matches 1100; 00 matches 0001
+    assert counts == [2, 1, 1]
+
+
+def test_poplar1_sketch_rejects_tampered_key():
+    poplar = Poplar1(3)
+    _, (k0, k1) = poplar.shard(0b101)
+    agg_param = Poplar1AggParam(2, tuple(range(8)))
+    st0, m0 = poplar.prepare_init(0, k0, agg_param)
+    st1, m1 = poplar.prepare_init(1, k1, agg_param)
+    # tamper with one party's sketch share
+    m1 = [st1.field.add(m1[0], 1)]
+    with pytest.raises(VdafError):
+        poplar.prepare_finish(st0, [m0, m1])
+
+
+def test_poplar1_agg_param_round_trip():
+    ap = Poplar1AggParam(7, (1, 5, 255, 2**100))
+    assert Poplar1AggParam.decode(ap.encode()) == ap
+
+
+def test_heavy_hitters_loop():
+    bits = 5
+    poplar = Poplar1(bits)
+    population = [0b10110] * 5 + [0b00111] * 4 + [0b10000] * 1 + [0b11111] * 2
+    keys = [poplar.shard(m)[1] for m in population]
+    k0s = [k[0] for k in keys]
+    k1s = [k[1] for k in keys]
+    heavy = heavy_hitters(poplar, k0s, k1s, threshold=3)
+    assert sorted(heavy) == sorted([0b10110, 0b00111])

@@ -89,8 +89,13 @@ class TestWire:
         assert VdafType.prio3_sum(8).to_vdaf_instance() == VdafInstance.sum(8)
         # bucket boundaries -> +1 buckets
         assert VdafType.prio3_histogram([1, 2, 3]).to_vdaf_instance() == VdafInstance.histogram(4)
+        # poplar1 maps to a declared instance; using it in the DAP flow
+        # raises at circuit dispatch (the reference's practical gate)
+        assert VdafType.poplar1(8).to_vdaf_instance() == VdafInstance.poplar1(8)
+        from janus_tpu.vdaf.registry import circuit_for
+
         with pytest.raises(ValueError):
-            VdafType.poplar1(8).to_vdaf_instance()
+            circuit_for(VdafInstance.poplar1(8))
 
 
 def test_hkdf_rfc5869_vector1():
