@@ -1128,6 +1128,26 @@ class Datastore:
             self._local.conn = conn
         return conn
 
+    def tx(self):
+        """Single-attempt transaction as a context manager (no retry):
+        commits on clean exit, rolls back on exception. For callers that
+        want deterministic failures to surface immediately (tests,
+        probes); production paths use run_tx."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            conn = self._connect()
+            conn.execute("BEGIN IMMEDIATE")
+            try:
+                yield Transaction(conn, self._crypter, self._clock)
+                conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
+
+        return cm()
+
     def run_tx(self, fn, name: str = "tx"):
         """Run fn(Transaction) with retry on busy/conflict
         (reference run_tx_with_name, datastore.rs:216-242)."""

@@ -1,0 +1,194 @@
+"""Property tests on the lease/accumulate invariants — the project's
+answer to the reference's concurrency story (REPEATABLE READ + retry,
+documented write-write races; SURVEY.md §5 'race detection')."""
+
+import secrets
+import threading
+
+from janus_tpu.aggregator.accumulator import Accumulator
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.models import (
+    AggregationJobModel,
+    AggregationJobState,
+    LeaderStoredReport,
+)
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import (
+    Duration,
+    HpkeCiphertext,
+    HpkeConfigId,
+    Interval,
+    ReportId,
+    Role,
+    Time,
+)
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+def make_task(ds):
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+        .with_(min_batch_size=1)
+        .build()
+    )
+    ds.run_tx(lambda tx: tx.put_task(task))
+    return task
+
+
+def put_job(ds, task, job_id_bytes):
+    from janus_tpu.messages import AggregationJobId
+
+    job = AggregationJobModel(
+        task.task_id,
+        AggregationJobId(job_id_bytes),
+        b"",
+        b"\x01",  # time-interval PBS body
+        Interval(Time(1_600_000_000), Duration(1)),
+        AggregationJobState.IN_PROGRESS,
+        0,
+    )
+    ds.run_tx(lambda tx: tx.put_aggregation_job(job))
+    return job
+
+
+def test_concurrent_lease_acquisition_never_double_assigns():
+    """N workers racing to acquire M jobs: every job is handed to exactly
+    one worker (the FOR UPDATE SKIP LOCKED analog)."""
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        n_jobs = 24
+        for i in range(n_jobs):
+            put_job(ds, task, i.to_bytes(16, "big"))
+
+        acquired = []
+        lock = threading.Lock()
+
+        def worker():
+            got = ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 8),
+                "acq",
+            )
+            with lock:
+                acquired.extend(got)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+        ids = [a.job_id.data for a in acquired]
+        assert len(ids) == len(set(ids)), "a job was leased to two workers"
+        assert len(ids) == n_jobs  # 6 workers x 8 >= 24: all handed out once
+    finally:
+        eph.cleanup()
+
+
+def test_release_requires_matching_lease_token():
+    """A stale worker (expired lease re-acquired by another) cannot
+    release the new holder's lease."""
+    import pytest
+
+    from janus_tpu.datastore.store import TxConflict
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        (first,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(10), 1)
+        )
+        clock.advance(Duration(60))  # first lease expires
+        (second,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        assert second.lease.token != first.lease.token
+        # a single transaction suffices: the mismatch is deterministic and
+        # run_tx would otherwise burn its full retry budget on it
+        with pytest.raises(TxConflict):
+            with ds.tx() as tx:
+                tx.release_aggregation_job(first)
+        ds.run_tx(lambda tx: tx.release_aggregation_job(second))  # holder can
+    finally:
+        eph.cleanup()
+
+
+def test_concurrent_report_claims_are_disjoint():
+    """Racing creators claim disjoint report sets (aggregation_started
+    flip is atomic per report)."""
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+
+        def put_reports(tx):
+            for _ in range(40):
+                tx.put_client_report(
+                    LeaderStoredReport(
+                        task.task_id,
+                        ReportId(secrets.token_bytes(16)),
+                        Time(1_600_000_000),
+                        b"",
+                        b"x",
+                        HpkeCiphertext(HpkeConfigId(0), b"", b""),
+                    )
+                )
+
+        ds.run_tx(put_reports)
+        claims = []
+        lock = threading.Lock()
+
+        def claim():
+            got = ds.run_tx(
+                lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 15)
+            )
+            with lock:
+                claims.append(got)
+
+        threads = [threading.Thread(target=claim) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        all_ids = [r[0].data for c in claims for r in c]
+        assert len(all_ids) == len(set(all_ids)), "a report was claimed twice"
+        assert len(all_ids) == 40
+    finally:
+        eph.cleanup()
+
+
+def test_accumulator_flush_is_idempotent_under_tx_retry():
+    """Re-flushing the same accumulator state (a retried transaction)
+    yields the same batch rows, not doubled counts."""
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        acc = Accumulator(task, shard_count=1)
+        rid = ReportId(secrets.token_bytes(16))
+        acc.update_single(b"batch-1", [5], rid, Time(1_600_000_000))
+
+        # first attempt rolls back mid-tx, second commits
+        attempts = {"n": 0}
+
+        def flaky(tx):
+            unmerged = acc.flush_to_datastore(tx)
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                from janus_tpu.datastore.store import TxConflict
+
+                raise TxConflict("injected rollback")
+            return unmerged
+
+        ds.run_tx(flaky)
+        rows = ds.run_tx(
+            lambda tx: tx.get_batch_aggregations_for_batch(task.task_id, b"batch-1", b"")
+        )
+        assert len(rows) == 1 and rows[0].report_count == 1
+    finally:
+        eph.cleanup()
