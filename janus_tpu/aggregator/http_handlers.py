@@ -60,6 +60,47 @@ _ROUTES = [
     ("POST", re.compile(r"^/tasks/([^/]+)/aggregate_shares$"), "aggregate_share"),
 ]
 
+# Request body media types per route (reference http_handlers.rs:512-551
+# extracts and enforces the DAP media type on every body-carrying route).
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _request_media_types():
+    from ..messages import (
+        AggregateShareReq as ASR,
+        AggregationJobContinueReq as AJCR,
+        AggregationJobInitializeReq as AJIR,
+        CollectionReq as CR,
+        Report as R,
+    )
+
+    return {
+        "upload": R.MEDIA_TYPE,
+        "aggregate_init": AJIR.MEDIA_TYPE,
+        "aggregate_continue": AJCR.MEDIA_TYPE,
+        "collection_create": CR.MEDIA_TYPE,
+        "aggregate_share": ASR.MEDIA_TYPE,
+    }
+
+# Browser-reachable routes get CORS preflights (reference
+# http_handlers.rs:236-259 adds preflight handlers for hpke_config,
+# upload, and the collector-facing collection_jobs routes).
+_CORS_ROUTES = [
+    (re.compile(r"^/hpke_config$"), "GET"),
+    (re.compile(r"^/tasks/([^/]+)/reports$"), "PUT"),
+    (re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "PUT, POST, DELETE"),
+]
+
+
+def _cors_allow(path: str) -> str | None:
+    """Allowed methods for a CORS-enabled path, else None (single source
+    for both the preflight status and the response headers)."""
+    for rx, allow in _CORS_ROUTES:
+        if rx.match(path):
+            return allow
+    return None
+
 
 class DapHttpApp:
     """Routing + handler glue around an Aggregator."""
@@ -121,11 +162,22 @@ class DapHttpApp:
 
     def _handle(self, method: str, path: str, query: dict, headers, body: bytes):
         try:
+            if method == "OPTIONS":
+                if _cors_allow(path) is not None:
+                    return 204, "text/plain", b""
+                return 404, "text/plain", b"not found"
             for m, rx, name in _ROUTES:
                 if m != method:
                     continue
                 match = rx.match(path)
                 if match:
+                    want = _request_media_types().get(name)
+                    if want is not None:
+                        got = {k.lower(): v for k, v in headers.items()}.get(
+                            "content-type", ""
+                        )
+                        if got.split(";")[0].strip() != want:
+                            return 415, "text/plain", b"unexpected media type"
                     return getattr(self, "h_" + name)(match, query, headers, body)
             return 404, "text/plain", b"not found"
         except AggregatorError as e:
@@ -262,18 +314,35 @@ class DapServer:
                 status, ctype, out = outer.app.handle(
                     method, parts.path, query, dict(self.headers.items()), body
                 )
-                self._reply(status, ctype, out)
+                self._reply(status, ctype, out, method)
 
-            def _reply(self, status, ctype, out):
+            def _reply(self, status, ctype, out, method="GET"):
+                from urllib.parse import urlsplit
+
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(out)))
+                # CORS: browser clients/collectors (reference
+                # http_handlers.rs:236-259 wraps these routes in
+                # trillium_api CORS preflight handlers)
+                self.send_header("Access-Control-Allow-Origin", "*")
+                if method == "OPTIONS":
+                    allow = _cors_allow(urlsplit(self.path).path)
+                    if allow is not None:
+                        self.send_header("Access-Control-Allow-Methods", allow)
+                        self.send_header(
+                            "Access-Control-Allow-Headers",
+                            "content-type, authorization, dap-auth-token",
+                        )
                 self.end_headers()
                 if out:
                     self.wfile.write(out)
 
             def do_GET(self):
                 self._dispatch("GET")
+
+            def do_OPTIONS(self):
+                self._dispatch("OPTIONS")
 
             def do_PUT(self):
                 self._dispatch("PUT")

@@ -7,10 +7,14 @@
  * tools, and the staging path that feeds device buffers. The device
  * side has its own batched Keccak (janus_tpu/vdaf/keccak_jax.py).
  *
- * Stream framing matches janus_tpu.vdaf.xof.XofShake128 byte-for-byte:
- *     stream = SHAKE128(dst16 || seed16 || binder)
- * and field sampling is rejection sampling of ENCODED_SIZE-byte
- * little-endian chunks (< modulus).
+ * Stream framing matches janus_tpu.vdaf.xof.XofCtr128 byte-for-byte
+ * (counter mode; see that module's docstring for the rationale):
+ *     block_i = SHAKE128(dst16 || seed16 || binder' || le64(i))[:168]
+ *     stream  = block_0 || block_1 || ...
+ * where binder' is the binder itself when <= 112 bytes, else its
+ * arity-7 Merkle tree digest (112-byte leaves, single-block node
+ * messages). Field sampling is rejection sampling of ENCODED_SIZE-byte
+ * little-endian chunks (< modulus) off the concatenated stream.
  *
  * Exposed as a plain C ABI for ctypes (no pybind11 in this image).
  * All entry points are thread-safe; the batch expander shards the seed
@@ -116,28 +120,125 @@ void janus_shake128(const uint8_t *in, size_t inlen, uint8_t *out,
   shake128_squeeze(&ctx, out, outlen);
 }
 
+/* --- counter-mode stream (janus_tpu.vdaf.xof.XofCtr128 framing) --- */
+
+#define INLINE_BINDER_MAX 112
+#define TREE_CHUNK 112
+#define TREE_ARITY 7
+#define TREE_DIGEST 16
+#define CTR_PREFIX_MAX (16 + 16 + INLINE_BINDER_MAX)
+
+static const uint8_t TREE_MAGIC[8] = {'J', 'a', 'n', 'u', 's', 'T', 'r', '1'};
+
+static void store_le64(uint8_t *p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = (uint8_t)(v >> (8 * i));
+}
+
+/* Single-block node hash: SHAKE128(magic||level||index||total||chunk)[:16]. */
+static void tree_node(uint64_t level, uint64_t index, const uint8_t total[8],
+                      const uint8_t chunk[TREE_CHUNK], uint8_t out[TREE_DIGEST]) {
+  uint8_t msg[8 + 8 + 8 + 8 + TREE_CHUNK];
+  memcpy(msg, TREE_MAGIC, 8);
+  store_le64(msg + 8, level);
+  store_le64(msg + 16, index);
+  memcpy(msg + 24, total, 8);
+  memcpy(msg + 32, chunk, TREE_CHUNK);
+  shake_ctx ctx;
+  shake128_absorb(&ctx, msg, sizeof(msg));
+  shake128_squeeze(&ctx, out, TREE_DIGEST);
+}
+
+/* Arity-7 Merkle digest of lane-aligned data (> INLINE_BINDER_MAX bytes). */
+static int tree_digest(const uint8_t *data, size_t len, uint8_t out[TREE_DIGEST]) {
+  uint8_t total[8];
+  store_le64(total, (uint64_t)len);
+  size_t n = (len + TREE_CHUNK - 1) / TREE_CHUNK;
+  uint8_t *digs = (uint8_t *)malloc(n * TREE_DIGEST);
+  if (!digs) return -1;
+  for (size_t k = 0; k < n; k++) {
+    uint8_t chunk[TREE_CHUNK];
+    size_t off = k * TREE_CHUNK;
+    size_t take = len - off < TREE_CHUNK ? len - off : TREE_CHUNK;
+    memcpy(chunk, data + off, take);
+    if (take < TREE_CHUNK) memset(chunk + take, 0, TREE_CHUNK - take);
+    tree_node(0, (uint64_t)k, total, chunk, digs + k * TREE_DIGEST);
+  }
+  uint64_t level = 0;
+  while (n > 1) {
+    level++;
+    size_t groups = (n + TREE_ARITY - 1) / TREE_ARITY;
+    for (size_t g = 0; g < groups; g++) {
+      uint8_t chunk[TREE_CHUNK];
+      memset(chunk, 0, TREE_CHUNK);
+      size_t have = n - g * TREE_ARITY;
+      if (have > TREE_ARITY) have = TREE_ARITY;
+      memcpy(chunk, digs + g * TREE_ARITY * TREE_DIGEST, have * TREE_DIGEST);
+      tree_node(level, (uint64_t)g, total, chunk, digs + g * TREE_DIGEST);
+    }
+    n = groups;
+  }
+  memcpy(out, digs, TREE_DIGEST);
+  free(digs);
+  return 0;
+}
+
+typedef struct {
+  uint8_t prefix[CTR_PREFIX_MAX + 8]; /* dst||seed||binder' (+ room for ctr) */
+  size_t prefix_len;
+  uint64_t block;
+  uint8_t buf[RATE];
+  size_t pos;
+} ctr_stream;
+
+/* prefix = dst16 || seed16 || binder' (tree-digesting long binders). */
+static int ctr_init(ctr_stream *s, const uint8_t *dst16, const uint8_t *seed16,
+                    const uint8_t *binder, size_t binder_len) {
+  memcpy(s->prefix, dst16, 16);
+  memcpy(s->prefix + 16, seed16, 16);
+  if (binder_len > INLINE_BINDER_MAX) {
+    if (tree_digest(binder, binder_len, s->prefix + 32) != 0) return -1;
+    s->prefix_len = 32 + TREE_DIGEST;
+  } else {
+    if (binder_len) memcpy(s->prefix + 32, binder, binder_len);
+    s->prefix_len = 32 + binder_len;
+  }
+  s->block = 0;
+  s->pos = RATE; /* force refill */
+  return 0;
+}
+
+static void ctr_read(ctr_stream *s, uint8_t *out, size_t n) {
+  while (n > 0) {
+    if (s->pos == RATE) {
+      store_le64(s->prefix + s->prefix_len, s->block++);
+      shake_ctx ctx;
+      shake128_absorb(&ctx, s->prefix, s->prefix_len + 8);
+      shake128_squeeze(&ctx, s->buf, RATE);
+      s->pos = 0;
+    }
+    size_t take = RATE - s->pos;
+    if (take > n) take = n;
+    memcpy(out, s->buf + s->pos, take);
+    out += take;
+    s->pos += take;
+    n -= take;
+  }
+}
+
 /* Rejection-sample `length` field elements from one seed's stream.
  * limbs = 1 (Field64) or 2 (Field128); element = limbs little-endian u64.
  * out: length*limbs u64 (element-major: e0.lo, e0.hi, e1.lo, ...). */
-static void expand_one(const uint8_t *dst16, const uint8_t *seed16,
-                       const uint8_t *binder, size_t binder_len, size_t length,
-                       int limbs, uint64_t mod_lo, uint64_t mod_hi,
-                       uint64_t *out) {
-  uint8_t msg_stack[512];
-  uint8_t *msg = msg_stack;
-  size_t msg_len = 32 + binder_len;
-  if (msg_len > sizeof(msg_stack)) msg = (uint8_t *)malloc(msg_len);
-  memcpy(msg, dst16, 16);
-  memcpy(msg + 16, seed16, 16);
-  if (binder_len) memcpy(msg + 32, binder, binder_len);
-  shake_ctx ctx;
-  shake128_absorb(&ctx, msg, msg_len);
-  if (msg != msg_stack) free(msg);
+static int expand_one(const uint8_t *dst16, const uint8_t *seed16,
+                      const uint8_t *binder, size_t binder_len, size_t length,
+                      int limbs, uint64_t mod_lo, uint64_t mod_hi,
+                      uint64_t *out) {
+  ctr_stream s;
+  if (ctr_init(&s, dst16, seed16, binder, binder_len) != 0) return -1;
 
   size_t got = 0;
   uint8_t chunk[16];
   while (got < length) {
-    shake128_squeeze(&ctx, chunk, (size_t)(8 * limbs));
+    ctr_read(&s, chunk, (size_t)(8 * limbs));
     uint64_t lo, hi = 0;
     memcpy(&lo, chunk, 8);
     if (limbs == 2) memcpy(&hi, chunk + 8, 8);
@@ -152,6 +253,7 @@ static void expand_one(const uint8_t *dst16, const uint8_t *seed16,
       got++;
     }
   }
+  return 0;
 }
 
 typedef struct {
@@ -164,16 +266,18 @@ typedef struct {
   uint64_t mod_lo, mod_hi;
   uint64_t *out; /* n * length * limbs */
   size_t begin, end;
+  int rc; /* sticky failure flag for this stripe */
 } expand_job;
 
 static void *expand_worker(void *arg) {
   expand_job *job = (expand_job *)arg;
   for (size_t i = job->begin; i < job->end; i++) {
-    expand_one(job->dst16, job->seeds + 16 * i,
-               job->binders ? job->binders + job->binder_len * i : NULL,
-               job->binders ? job->binder_len : 0, job->length, job->limbs,
-               job->mod_lo, job->mod_hi,
-               job->out + i * job->length * job->limbs);
+    if (expand_one(job->dst16, job->seeds + 16 * i,
+                   job->binders ? job->binders + job->binder_len * i : NULL,
+                   job->binders ? job->binder_len : 0, job->length, job->limbs,
+                   job->mod_lo, job->mod_hi,
+                   job->out + i * job->length * job->limbs) != 0)
+      job->rc = -1;
   }
   return NULL;
 }
@@ -192,9 +296,9 @@ int janus_expand_field_batch(const uint8_t *dst16, const uint8_t *seeds,
 
   if (n_threads == 1) {
     expand_job job = {dst16, seeds, binders, binder_len, length,
-                      limbs, mod_lo, mod_hi, out, 0, n};
+                      limbs, mod_lo, mod_hi, out, 0, n, 0};
     expand_worker(&job);
-    return 0;
+    return job.rc;
   }
   pthread_t *tids = (pthread_t *)malloc(sizeof(pthread_t) * n_threads);
   expand_job *jobs = (expand_job *)malloc(sizeof(expand_job) * n_threads);
@@ -205,7 +309,7 @@ int janus_expand_field_batch(const uint8_t *dst16, const uint8_t *seeds,
     if (b >= n) break;
     if (e > n) e = n;
     jobs[t] = (expand_job){dst16, seeds, binders, binder_len, length,
-                           limbs, mod_lo, mod_hi, out, b, e};
+                           limbs, mod_lo, mod_hi, out, b, e, 0};
     if (pthread_create(&tids[t], NULL, expand_worker, &jobs[t]) != 0) {
       /* fall back to running this stripe inline */
       expand_worker(&jobs[t]);
@@ -215,33 +319,31 @@ int janus_expand_field_batch(const uint8_t *dst16, const uint8_t *seeds,
     spawned++;
     (void)spawned;
   }
+  int rc = 0;
   for (int t = 0; t < n_threads; t++) {
     size_t b = per * t;
     if (b >= n) break;
     if (tids[t]) pthread_join(tids[t], NULL);
+    if (jobs[t].rc != 0) rc = -1;
   }
   free(tids);
   free(jobs);
-  return 0;
+  return rc;
 }
 
-/* Batch derive_seed: out[i] = SHAKE128(dst16 || seed_i || binder_i)[:16].
- * binders: per-seed fixed-size block (NULL for empty). */
+/* Batch derive_seed: out[i] = first 16 stream bytes for (seed_i, binder_i)
+ * under the counter-mode framing. binders: per-seed fixed-size block
+ * (NULL for empty). */
 int janus_derive_seed_batch(const uint8_t *dst16, const uint8_t *seeds,
                             size_t n, const uint8_t *binders, size_t binder_len,
                             uint8_t *out) {
   for (size_t i = 0; i < n; i++) {
-    uint8_t msg_stack[512];
-    uint8_t *msg = msg_stack;
-    size_t msg_len = 32 + binder_len;
-    if (msg_len > sizeof(msg_stack)) msg = (uint8_t *)malloc(msg_len);
-    memcpy(msg, dst16, 16);
-    memcpy(msg + 16, seeds + 16 * i, 16);
-    if (binder_len) memcpy(msg + 32, binders + binder_len * i, binder_len);
-    shake_ctx ctx;
-    shake128_absorb(&ctx, msg, msg_len);
-    if (msg != msg_stack) free(msg);
-    shake128_squeeze(&ctx, out + 16 * i, 16);
+    ctr_stream s;
+    if (ctr_init(&s, dst16, seeds + 16 * i,
+                 binders ? binders + binder_len * i : NULL,
+                 binders ? binder_len : 0) != 0)
+      return -1;
+    ctr_read(&s, out + 16 * i, 16);
   }
   return 0;
 }

@@ -4,19 +4,23 @@ The VDAF hot path needs, per report, hundreds of KB of XOF output to
 expand helper measurement/proof shares from 16-byte seeds (the
 reference does this on CPU inside `prio`'s Xof, one report at a time,
 invoked from aggregator/src/aggregator.rs:1775-1797). Keccak is pure
-64-bit bitwise logic, which vectorizes perfectly across a report batch:
-the state is 25 u64 lanes per report, and every round is elementwise
-XOR/rotate/and-not over [batch, 25]-shaped lanes. On TPU the u64 ops
-lower to u32 pairs on the VPU; throughput scales with batch size.
+64-bit bitwise logic, which vectorizes perfectly: the state is 25 u64
+lanes per message, and every round is elementwise XOR/rotate/and-not.
+On TPU the u64 ops lower to u32 pairs on the VPU.
 
-Stream framing matches janus_tpu.vdaf.xof exactly (all absorbed
-messages are u64-lane-aligned by construction), so host and device
-produce byte-identical streams — tested in tests/test_keccak.py.
+The XOF stream framing is **counter mode** (janus_tpu.vdaf.xof, which
+is the host oracle — see its docstring for the design): every 168-byte
+output block is an independent single-block SHAKE128 message
+(dst||seed||binder'||le64(i)), so one `keccak_f1600` call over
+[batch, n_blocks]-shaped lanes produces the *entire* stream of every
+report in a batch — sequential depth 24 rounds regardless of stream
+length. Long binders are bound via an arity-7 Merkle digest whose
+levels are each one batched permutation (`tree_digest_lanes`). All
+messages are u64-lane-aligned by construction; host and device produce
+byte-identical streams — tested in tests/test_keccak.py.
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -159,6 +163,28 @@ def pad_message_lanes(parts, msg_len_bytes: int, batch: int):
     msg_lanes_n = msg_len_bytes // 8
     n_blocks = msg_lanes_n // RATE_LANES + 1  # always room for padding
     total = n_blocks * RATE_LANES
+    lanes = _assemble_segments(parts, msg_lanes_n, batch)
+    # SHAKE padding: 0x1F at msg end, 0x80 at the last byte of the last
+    # block (may share a lane).
+    tail = np.zeros(total - msg_lanes_n, dtype=np.uint64)
+    tail[0] ^= np.uint64(0x1F)
+    tail[-1] ^= np.uint64(0x80) << np.uint64(56)
+    lanes = jnp.concatenate(
+        [lanes, jnp.broadcast_to(jnp.asarray(tail), (batch, tail.size))], axis=1
+    )
+    return lanes.reshape(batch, n_blocks, RATE_LANES)
+
+
+def bytes_to_lanes(data: bytes) -> np.ndarray:
+    assert len(data) % 8 == 0
+    return np.frombuffer(data, dtype="<u8").astype(np.uint64)
+
+
+def _assemble_segments(parts, total_lanes: int, batch: int):
+    """Concatenate (lane_offset, lanes|bytes) parts into [batch, total_lanes].
+
+    Gaps are zero-filled; host bytes are broadcast across the batch.
+    """
     segs = []
     pos = 0
     for off, content in sorted(parts, key=lambda p: p[0]):
@@ -174,20 +200,121 @@ def pad_message_lanes(parts, msg_len_bytes: int, batch: int):
         else:
             segs.append(content.astype(U64))
             pos += content.shape[-1]
-    assert pos <= msg_lanes_n
-    # zero fill to message end, then SHAKE padding: 0x1F at msg end,
-    # 0x80 at the last byte of the last block (may share a lane).
-    tail = np.zeros(total - pos, dtype=np.uint64)
-    tail[msg_lanes_n - pos] ^= np.uint64(0x1F)
-    tail[-1] ^= np.uint64(0x80) << np.uint64(56)
-    segs.append(jnp.broadcast_to(jnp.asarray(tail), (batch, tail.size)))
-    lanes = jnp.concatenate(segs, axis=1)
-    return lanes.reshape(batch, n_blocks, RATE_LANES)
+    assert pos <= total_lanes
+    if pos < total_lanes:
+        segs.append(jnp.zeros((batch, total_lanes - pos), dtype=U64))
+    return jnp.concatenate(segs, axis=1)
 
 
-def bytes_to_lanes(data: bytes) -> np.ndarray:
-    assert len(data) % 8 == 0
-    return np.frombuffer(data, dtype="<u8").astype(np.uint64)
+# ---------------------------------------------------------------------------
+# Counter-mode stream + tree digest (the janus_tpu.vdaf.xof framing)
+# ---------------------------------------------------------------------------
+
+PAD_START = np.uint64(0x1F)
+PAD_END = np.uint64(0x80) << np.uint64(56)
+
+
+def _single_block_keccak(lane_cols):
+    """Permute single-block messages given as a list of 21 lane arrays.
+
+    lane_cols: 21 arrays of identical shape [...] (the rate lanes of the
+    already-padded message). Returns the full 25-lane output state.
+    """
+    zeros = jnp.zeros_like(lane_cols[0])
+    state = tuple(lane_cols) + (zeros,) * 4
+    return keccak_f1600(state)
+
+
+def ctr_stream_lanes(prefix_parts, prefix_len_bytes: int, batch: int, out_blocks: int):
+    """Counter-mode SHAKE128 stream: [batch, out_blocks, 21] u64 lanes.
+
+    prefix_parts: (lane_offset, content) segments of the prefix
+    dst16 || seed || binder' (binder' already inline-size). Every output
+    block is the independent single-block message prefix || le64(i), so
+    the whole stream is ONE batched permutation — this is the load-bearing
+    TPU restructuring over sequential sponge squeezing.
+    """
+    assert prefix_len_bytes % 8 == 0
+    p = prefix_len_bytes // 8
+    assert p + 1 <= RATE_LANES - 1, "prefix + counter must fit one rate block"
+    prefix = _assemble_segments(prefix_parts, p, batch)  # [batch, p]
+    shape = (batch, out_blocks)
+    cols = []
+    for lane in range(RATE_LANES):
+        if lane < p:
+            cols.append(jnp.broadcast_to(prefix[:, lane : lane + 1], shape))
+        elif lane == p:
+            ctr = jnp.arange(out_blocks, dtype=U64)[None, :]
+            cols.append(jnp.broadcast_to(ctr, shape))
+        else:
+            v = np.uint64(0)
+            if lane == p + 1:
+                v |= PAD_START
+            if lane == RATE_LANES - 1:
+                v |= PAD_END
+            cols.append(jnp.broadcast_to(jnp.asarray(v), shape))
+    state = _single_block_keccak(cols)
+    return jnp.stack(state[:RATE_LANES], axis=-1)  # [batch, out_blocks, 21]
+
+
+TREE_MAGIC_LANE = np.frombuffer(b"JanusTr1", dtype="<u8")[0]
+TREE_CHUNK_LANES = 14  # 112 bytes
+TREE_ARITY = 7
+TREE_DIGEST_LANES = 2
+
+
+def _tree_level(chunks, level: int, total_lanes_bytes: int):
+    """Hash one tree level: chunks [batch, n, 14] -> digests [batch, n, 2]."""
+    batch, n, _ = chunks.shape
+    shape = (batch, n)
+    idx = jnp.broadcast_to(jnp.arange(n, dtype=U64)[None, :], shape)
+    consts = {
+        0: np.uint64(TREE_MAGIC_LANE),
+        1: np.uint64(level),
+        3: np.uint64(total_lanes_bytes),
+        18: PAD_START,  # message = 4 + 14 lanes; 0x1f right after
+        20: PAD_END,
+    }
+    cols = []
+    for lane in range(RATE_LANES):
+        if lane == 2:
+            cols.append(idx)
+        elif 4 <= lane < 4 + TREE_CHUNK_LANES:
+            cols.append(chunks[:, :, lane - 4])
+        else:
+            cols.append(
+                jnp.broadcast_to(jnp.asarray(consts.get(lane, np.uint64(0))), shape)
+            )
+    state = _single_block_keccak(cols)
+    return jnp.stack(state[:TREE_DIGEST_LANES], axis=-1)  # [batch, n, 2]
+
+
+def tree_digest_lanes(data_parts, data_len_bytes: int, batch: int):
+    """Arity-7 Merkle digest of lane-aligned data: [batch, 2] u64.
+
+    Byte-identical to janus_tpu.vdaf.xof.tree_digest. Each level is one
+    batched permutation over all of that level's nodes.
+    """
+    assert data_len_bytes % 8 == 0
+    lanes_n = data_len_bytes // 8
+    data = _assemble_segments(data_parts, lanes_n, batch)  # [batch, L]
+    n = -(-lanes_n // TREE_CHUNK_LANES)
+    pad = n * TREE_CHUNK_LANES - lanes_n
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, pad)))
+    chunks = data.reshape(batch, n, TREE_CHUNK_LANES)
+    digs = _tree_level(chunks, 0, data_len_bytes)  # [batch, n, 2]
+    level = 0
+    while n > 1:
+        level += 1
+        groups = -(-n // TREE_ARITY)
+        gpad = groups * TREE_ARITY - n
+        if gpad:
+            digs = jnp.pad(digs, ((0, 0), (0, gpad), (0, 0)))
+        chunks = digs.reshape(batch, groups, TREE_CHUNK_LANES)
+        digs = _tree_level(chunks, level, data_len_bytes)
+        n = groups
+    return digs[:, 0, :]  # [batch, 2]
 
 
 # ---------------------------------------------------------------------------
@@ -237,14 +364,13 @@ def sample_field_vec(jf, stream_lanes, length: int):
     return gathered
 
 
-def expand_field_vec(jf, msg_parts, msg_len_bytes: int, batch: int, length: int):
-    """XOF-expand per-report messages straight to field vectors on device."""
-    lanes = pad_message_lanes(msg_parts, msg_len_bytes, batch)
-    out = shake128_squeeze_lanes(lanes, sample_count_blocks(jf, length))
-    return sample_field_vec(jf, out, length)
+def expand_field_vec(jf, prefix_parts, prefix_len_bytes: int, batch: int, length: int):
+    """XOF-expand per-report prefixes straight to field vectors on device.
 
-
-@partial(jax.jit, static_argnums=(0, 2, 3))
-def _jit_expand(jf, lanes, out_blocks, length):
-    out = shake128_squeeze_lanes(lanes, out_blocks)
+    prefix_parts lay out dst16 || seed || binder' (counter-mode framing,
+    janus_tpu.vdaf.xof); the binder must already be inline-size.
+    """
+    out = ctr_stream_lanes(
+        prefix_parts, prefix_len_bytes, batch, sample_count_blocks(jf, length)
+    )
     return sample_field_vec(jf, out, length)

@@ -10,7 +10,7 @@ computation over [batch]-leading arrays:
   - field vectors are limb-tuple values (janus_tpu.fields.jfield),
   - XOF expansion runs on device via the batched Keccak
     (janus_tpu.vdaf.keccak_jax) with the same lane-aligned stream
-    framing as the host XofShake128 — host and device are
+    framing as the host XofCtr128 — host and device are
     byte-identical, so a host-sharded report verifies on device and
     vice versa (differential-tested).
 
@@ -32,10 +32,10 @@ from .engine import (
     flp_query_batched,
 )
 from .keccak_jax import (
-    pad_message_lanes,
+    ctr_stream_lanes,
     sample_count_blocks,
     sample_field_vec,
-    shake128_squeeze_lanes,
+    tree_digest_lanes,
 )
 from .reference import AGG1, Circuit
 from .xof import (
@@ -90,26 +90,35 @@ class Prio3Batched:
         self.circ = circuit
         self.bc: BatchedCircuit = batched_circuit(circuit)
         self.jf = self.bc.jf
+        self._shard_jit = None
+
+    @property
+    def shard_jit(self):
+        """jit-compiled shard (client/load-generator batches); eager
+        per-op dispatch of the 16k-element circuits is minutes of
+        overhead that the traced version doesn't pay."""
+        if self._shard_jit is None:
+            import jax
+
+            self._shard_jit = jax.jit(self.shard)
+        return self._shard_jit
 
     # --- XOF plumbing (device) ---
     def _dst(self, usage: int) -> bytes:
         return dst(self.circ.algo_id, usage)
 
-    def _expand_vec(self, usage: int, seed_lanes, binder_parts, binder_len: int, length: int):
-        """Field vector [batch, length] from per-report seeds + binder."""
-        batch = seed_lanes.shape[0]
-        parts = [(0, self._dst(usage)), (DST_LANES, seed_lanes)]
-        off = DST_LANES + SEED_LANES
-        for rel_off, content in binder_parts:
-            parts.append((off + rel_off, content))
-        msg_len = DST_SIZE + SEED_SIZE + binder_len
-        lanes = pad_message_lanes(parts, msg_len, batch)
-        out = shake128_squeeze_lanes(lanes, sample_count_blocks(self.jf, length))
-        return sample_field_vec(self.jf, out, length)
+    def _prefix_parts(self, usage: int, seed_lanes, binder_parts, binder_len: int, batch: int):
+        """Counter-mode prefix (dst||seed||binder') as lane segments.
 
-    def _derive_seed(self, usage: int, seed_lanes, binder_parts, binder_len: int):
-        """[batch, 2] output seed lanes."""
-        batch = seed_lanes.shape[0] if hasattr(seed_lanes, "shape") else binder_parts[0][1].shape[0]
+        Binders longer than INLINE_BINDER_MAX are replaced by their tree
+        digest, matching xof.XofCtr128 exactly.
+        """
+        from .xof import INLINE_BINDER_MAX, TREE_DIGEST_SIZE
+
+        if binder_len > INLINE_BINDER_MAX:
+            digest = tree_digest_lanes(binder_parts, binder_len, batch)
+            binder_parts = [(0, digest)]
+            binder_len = TREE_DIGEST_SIZE
         parts = [(0, self._dst(usage))]
         if isinstance(seed_lanes, (bytes, bytearray)):
             parts.append((DST_LANES, bytes(seed_lanes)))
@@ -118,9 +127,26 @@ class Prio3Batched:
         off = DST_LANES + SEED_LANES
         for rel_off, content in binder_parts:
             parts.append((off + rel_off, content))
-        msg_len = DST_SIZE + SEED_SIZE + binder_len
-        lanes = pad_message_lanes(parts, msg_len, batch)
-        out = shake128_squeeze_lanes(lanes, 1)
+        return parts, DST_SIZE + SEED_SIZE + binder_len
+
+    def _expand_vec(self, usage: int, seed_lanes, binder_parts, binder_len: int, length: int):
+        """Field vector [batch, length] from per-report seeds + binder."""
+        batch = seed_lanes.shape[0]
+        parts, prefix_len = self._prefix_parts(
+            usage, seed_lanes, binder_parts, binder_len, batch
+        )
+        out = ctr_stream_lanes(
+            parts, prefix_len, batch, sample_count_blocks(self.jf, length)
+        )
+        return sample_field_vec(self.jf, out, length)
+
+    def _derive_seed(self, usage: int, seed_lanes, binder_parts, binder_len: int):
+        """[batch, 2] output seed lanes."""
+        batch = seed_lanes.shape[0] if hasattr(seed_lanes, "shape") else binder_parts[0][1].shape[0]
+        parts, prefix_len = self._prefix_parts(
+            usage, seed_lanes, binder_parts, binder_len, batch
+        )
+        out = ctr_stream_lanes(parts, prefix_len, batch, 1)
         return out[:, 0, :SEED_LANES]
 
     def _expand_share(self, seed_lanes, usage: int, length: int):
@@ -159,10 +185,9 @@ class Prio3Batched:
             (DST_LANES, verify_key),
             (DST_LANES + SEED_LANES, nonce_lanes),
         ]
-        msg_len = DST_SIZE + SEED_SIZE + SEED_SIZE
-        lanes = pad_message_lanes(parts, msg_len, batch)
-        out = shake128_squeeze_lanes(
-            lanes, sample_count_blocks(self.jf, self.circ.query_rand_len)
+        prefix_len = DST_SIZE + SEED_SIZE + SEED_SIZE
+        out = ctr_stream_lanes(
+            parts, prefix_len, batch, sample_count_blocks(self.jf, self.circ.query_rand_len)
         )
         return sample_field_vec(self.jf, out, self.circ.query_rand_len)
 

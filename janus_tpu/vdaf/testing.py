@@ -49,7 +49,7 @@ def make_report_batch(inst: VdafInstance, measurements, seed: int = 0):
     nonce_lanes = rng.integers(0, 1 << 63, size=(batch, 2), dtype=np.uint64)
     n_seeds = 4 if p3.uses_joint_rand else 2
     rand_lanes = rng.integers(0, 1 << 63, size=(batch, n_seeds, 2), dtype=np.uint64)
-    sh = p3.shard(inp, nonce_lanes, rand_lanes)
+    sh = p3.shard_jit(inp, nonce_lanes, rand_lanes)
     args = (
         nonce_lanes,
         sh["public_parts"],

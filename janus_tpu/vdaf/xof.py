@@ -1,27 +1,50 @@
-"""XOF (extendable output function) for VDAF: SHAKE128-based.
+"""XOF (extendable output function) for VDAF: counter-mode SHAKE128.
 
-Modeled on the XofShake128 construction of VDAF-07 (the VDAF draft the
+Modeled on the XofShake128 construction of VDAF-07 (the XOF the
 reference's `prio` 0.15 dependency implements; SURVEY.md section 2.2
-"XOF (SHAKE128-family) share/joint-randomness expansion"), with one
-TPU-motivated framing change:
+"XOF (SHAKE128-family) share/joint-randomness expansion"), with two
+TPU-motivated framing changes. The exact byte framing is internal to
+this framework's two cooperating aggregators; both sides derive it from
+here, and the device implementation (janus_tpu.vdaf.keccak_jax) is
+byte-identical (differential-tested).
 
-    stream = SHAKE128( dst16 || seed || binder )
+1. **Counter-mode output** instead of sequential sponge squeezing:
 
-where dst16 is the domain-separation tag zero-padded to 16 bytes, and
-all binder layouts used by Prio3 are multiples of 8 bytes (agg ids are
-carried as 8-byte little-endian words). Every field of every absorbed
-message is therefore u64-lane-aligned, which lets the batched device
-Keccak (janus_tpu.vdaf.keccak_jax) pack messages as [batch, 21] uint64
-lane arrays with no byte-straddling shifts. Host and device produce
-byte-identical streams.
+       block_i = SHAKE128(dst16 || seed || binder' || le64(i))[:168]
+       stream  = block_0 || block_1 || ...
 
-Field-element sampling reads ENCODED_SIZE-byte little-endian chunks and
-rejects values >= p (rejection probability ~2^-32 for both fields).
+   Sequential squeezing chains one Keccak permutation per 168-byte
+   block: expanding a SumVec-16k share (256 KB) is ~1.5k permutations
+   that *must run one after another* — on TPU that is pure latency, a
+   tiny [batch, 25]-lane op launched 36k rounds deep. In counter mode
+   every block depends only on (seed, binder, i), so the whole stream
+   of every report in a batch is one batched permutation: the same
+   total permutation count (the prefix always fits one rate block, so
+   absorb+squeeze is a single Keccak-f[1600] per block either way) at
+   sequential depth 24 rounds instead of ~36,000.
 
-The device-side equivalent (janus_tpu.vdaf.keccak_jax) implements the
-same stream semantics with a batched Keccak-f[1600] permutation so that
-helper share expansion never leaves the TPU; this module is the host
-oracle and the path used for small per-report derivations.
+2. **Tree-digested long binders.** The joint-randomness part binds the
+   full encoded leader measurement share (VDAF-07 semantics), which for
+   SumVec is 256 KB absorbed — again inherently sequential in a sponge.
+   Binders longer than 112 bytes are replaced by a 16-byte Merkle
+   digest with 112-byte leaves and arity-7 internal nodes; every node
+   hash is a single-block SHAKE128 message, so each tree *level* is one
+   batched permutation (depth ~log_7(n) instead of n). Node messages
+   carry (magic, level, index, total length), making the tree shape a
+   pure function of the data length — unambiguous padding, standard
+   Merkle collision resistance. A 16-byte digest keeps the reference's
+   security level: Prio3's joint-randomness parts and seeds are 16
+   bytes already.
+
+All binder layouts used by Prio3 are multiples of 8 bytes (agg ids are
+carried as 8-byte little-endian words), so every field of every
+message is u64-lane-aligned and the batched device Keccak packs
+messages as uint64 lane arrays with no byte-straddling shifts.
+
+Field-element sampling reads ENCODED_SIZE-byte little-endian chunks
+from the stream and rejects values >= p (rejection probability ~2^-32
+for both fields). Chunks may straddle block boundaries; the stream is
+the plain concatenation of blocks.
 """
 
 from __future__ import annotations
@@ -46,6 +69,17 @@ USAGE_JOINT_RAND_PART = 8
 ALGO_CLASS_VDAF = 0
 DST_SIZE = 16
 
+RATE = 168  # SHAKE128 rate in bytes
+
+# Binders longer than this are replaced by tree_digest(binder).
+INLINE_BINDER_MAX = 112
+# Tree hash geometry: 112-byte leaves, arity-7 internal nodes
+# (7 x 16-byte digests = 112 bytes), every node message single-block.
+TREE_CHUNK = 112
+TREE_ARITY = 7
+TREE_DIGEST_SIZE = 16
+TREE_MAGIC = b"JanusTr1"
+
 
 def dst(algo_id: int, usage: int, version: int = 7) -> bytes:
     """Domain-separation tag: class || version || algo id || usage,
@@ -58,28 +92,58 @@ def dst(algo_id: int, usage: int, version: int = 7) -> bytes:
     return raw.ljust(DST_SIZE, b"\x00")
 
 
-class XofShake128:
+def _le64(i: int) -> bytes:
+    return i.to_bytes(8, "little")
+
+
+def tree_digest(data: bytes) -> bytes:
+    """16-byte Merkle digest of lane-aligned data (see module docstring)."""
+    assert len(data) % 8 == 0
+    total = _le64(len(data))
+
+    def node(level: int, index: int, payload: bytes) -> bytes:
+        assert len(payload) == TREE_CHUNK
+        msg = TREE_MAGIC + _le64(level) + _le64(index) + total + payload
+        return hashlib.shake_128(msg).digest(TREE_DIGEST_SIZE)
+
+    digs = [
+        node(0, k, data[off : off + TREE_CHUNK].ljust(TREE_CHUNK, b"\x00"))
+        for k, off in enumerate(range(0, len(data), TREE_CHUNK))
+    ]
+    level = 0
+    while len(digs) > 1:
+        level += 1
+        pad = -len(digs) % TREE_ARITY
+        digs.extend([b"\x00" * TREE_DIGEST_SIZE] * pad)
+        digs = [
+            node(level, g, b"".join(digs[g * TREE_ARITY : (g + 1) * TREE_ARITY]))
+            for g in range(len(digs) // TREE_ARITY)
+        ]
+    return digs[0]
+
+
+class XofCtr128:
+    """Counter-mode SHAKE128 XOF (the host oracle for the device Keccak)."""
+
     SEED_SIZE = SEED_SIZE
 
     def __init__(self, seed: bytes, dst_: bytes, binder: bytes = b""):
         assert len(seed) == SEED_SIZE
         assert len(dst_) <= DST_SIZE
-        self._shake = hashlib.shake_128()
-        self._shake.update(dst_.ljust(DST_SIZE, b"\x00") + seed + binder)
+        if len(binder) > INLINE_BINDER_MAX:
+            binder = tree_digest(binder)
+        self._prefix = dst_.ljust(DST_SIZE, b"\x00") + seed + binder
+        assert len(self._prefix) + 8 <= RATE - 1  # always one absorb block
+        self._block = 0
         self._buf = b""
-        self._pos = 0
-
-    def update(self, binder: bytes) -> None:
-        assert self._pos == 0, "cannot absorb after squeezing"
-        self._shake.update(binder)
 
     def next(self, n: int) -> bytes:
-        need = self._pos + n
-        if need > len(self._buf):
-            # hashlib has no incremental squeeze; re-digest with headroom.
-            self._buf = self._shake.digest(max(need, 2 * len(self._buf), 512))
-        out = self._buf[self._pos : self._pos + n]
-        self._pos += n
+        while len(self._buf) < n:
+            self._buf += hashlib.shake_128(
+                self._prefix + _le64(self._block)
+            ).digest(RATE)
+            self._block += 1
+        out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
     def next_vec(self, field, length: int) -> list[int]:
@@ -98,6 +162,11 @@ class XofShake128:
         return cls(seed, dst_, binder).next(SEED_SIZE)
 
 
+# The class named for what the stream is derived from; modules that
+# predate the counter-mode rename import this alias.
+XofShake128 = XofCtr128
+
+
 def prng_expand(field, seed: bytes, dst_: bytes, binder: bytes, length: int):
     """Expand a seed into a vector of field elements (host path).
 
@@ -108,7 +177,7 @@ def prng_expand(field, seed: bytes, dst_: bytes, binder: bytes, length: int):
     out = prng_expand_batch(field, dst_, [seed], [binder] if binder else None, length)
     if out is not None:
         return out[0]
-    return XofShake128(seed, dst_, binder).next_vec(field, length)
+    return XofCtr128(seed, dst_, binder).next_vec(field, length)
 
 
 def prng_expand_batch(field, dst_: bytes, seeds, binders, length: int):

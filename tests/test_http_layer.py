@@ -1,0 +1,55 @@
+"""HTTP-layer conformance: CORS preflights + DAP media-type enforcement
+(reference aggregator/src/aggregator/http_handlers.rs:236-259 CORS
+wrappers, :512-551 media-type extraction)."""
+
+import urllib.request
+
+from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+
+
+class _NoAgg:
+    """Routes under test never reach the aggregator."""
+
+    def __getattr__(self, name):  # pragma: no cover - fail loudly
+        raise AssertionError(f"aggregator reached via {name}")
+
+
+def test_options_preflight_routes():
+    app = DapHttpApp(_NoAgg())
+    status, _, _ = app.handle("OPTIONS", "/hpke_config", {}, {}, b"")
+    assert status == 204
+    status, _, _ = app.handle("OPTIONS", "/tasks/x/reports", {}, {}, b"")
+    assert status == 204
+    status, _, _ = app.handle("OPTIONS", "/tasks/x/collection_jobs/y", {}, {}, b"")
+    assert status == 204
+    # non-CORS route: aggregation jobs are aggregator-to-aggregator
+    status, _, _ = app.handle("OPTIONS", "/tasks/x/aggregation_jobs/y", {}, {}, b"")
+    assert status == 404
+
+
+def test_wrong_media_type_rejected():
+    app = DapHttpApp(_NoAgg())
+    status, _, _ = app.handle(
+        "PUT",
+        "/tasks/x/reports",
+        {},
+        {"Content-Type": "application/json"},
+        b"body",
+    )
+    assert status == 415
+
+
+def test_cors_headers_on_server():
+    app = DapHttpApp(_NoAgg())
+    srv = DapServer(app).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "tasks/x/reports", method="OPTIONS"
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 204
+            assert resp.headers["Access-Control-Allow-Origin"] == "*"
+            assert "PUT" in resp.headers["Access-Control-Allow-Methods"]
+            assert "content-type" in resp.headers["Access-Control-Allow-Headers"]
+    finally:
+        srv.stop()

@@ -60,11 +60,11 @@ def test_field_sampling_matches_host():
         length = 33
         seeds = [bytes([i]) * 16 for i in range(batch)]
         binder = (1).to_bytes(8, "little") + bytes(range(16))
-        # host
+        # host (counter-mode stream)
         want = [
             XofShake128(s, d, binder).next_vec(field, length) for s in seeds
         ]
-        # device: message = dst16 || seed || binder
+        # device: prefix = dst16 || seed || binder
         import jax.numpy as jnp
 
         seed_lanes = jnp.asarray(
@@ -76,6 +76,57 @@ def test_field_sampling_matches_host():
         got_ints = jf.to_ints(got)
         for b in range(batch):
             assert [int(x) for x in got_ints[b]] == want[b], (field, b)
+
+
+def test_ctr_stream_matches_host():
+    # multi-block counter-mode stream, device vs host XofCtr128
+    import jax.numpy as jnp
+
+    from janus_tpu.vdaf.xof import XofCtr128
+
+    d = dst(0x42, USAGE_MEASUREMENT_SHARE)
+    batch = 3
+    seeds = [bytes([7 * i + 1]) * 16 for i in range(batch)]
+    binder = bytes(range(24))
+    seed_lanes = jnp.asarray(np.stack([kj.bytes_to_lanes(s) for s in seeds]))
+    parts = [(0, d), (2, seed_lanes), (4, binder)]
+    out_blocks = 5
+    got = np.asarray(
+        kj.ctr_stream_lanes(parts, 16 + 16 + len(binder), batch, out_blocks)
+    )
+    for i, s in enumerate(seeds):
+        want = XofCtr128(s, d, binder).next(out_blocks * 168)
+        assert got[i].reshape(-1).astype("<u8").tobytes() == want, i
+
+
+def test_tree_digest_matches_host():
+    import jax.numpy as jnp
+
+    from janus_tpu.vdaf.xof import tree_digest
+
+    # sizes spanning: 1 leaf+1, several leaves, multiple tree levels
+    for n_bytes in (120, 1000, 9000, 113 * 112):
+        rng = np.random.default_rng(n_bytes)
+        data = rng.integers(0, 256, size=n_bytes - n_bytes % 8, dtype=np.uint8).tobytes()
+        want = tree_digest(data)
+        lanes = jnp.asarray(kj.bytes_to_lanes(data)[None, :])
+        got = np.asarray(kj.tree_digest_lanes([(0, lanes)], len(data), 1))
+        assert got[0].astype("<u8").tobytes() == want, n_bytes
+
+
+def test_long_binder_derive_matches_host():
+    # derive_seed with binder > INLINE_BINDER_MAX goes through the tree
+    from janus_tpu.vdaf.xof import INLINE_BINDER_MAX, XofCtr128
+
+    d = dst(0x42, USAGE_MEASUREMENT_SHARE)
+    seed = bytes(range(16))
+    binder = bytes(range(256))  # > 112, lane-aligned
+    assert len(binder) > INLINE_BINDER_MAX
+    out = XofCtr128.derive_seed(seed, d, binder)
+    # equal to deriving with the digest inline
+    from janus_tpu.vdaf.xof import tree_digest
+
+    assert out == XofCtr128.derive_seed(seed, d, tree_digest(binder))
 
 
 def test_rejection_path_exercised():
