@@ -26,13 +26,15 @@ import time
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    # Default is the config that measures the two-party hot path AND
-    # reliably reaches the chip today. sumvec(len=1000) — the eventual
-    # north star — compiles for minutes even on CPU and has not yet
-    # completed a compile through the single-process tunnel; it stays
-    # available behind --config sumvec with full watchdog hardening.
-    # (Round-2 target: shrink the sumvec graph; see BASELINE.md.)
-    ap.add_argument("--config", default="count", choices=["count", "sum", "sumvec", "histogram"])
+    # Default is the north-star config (BASELINE.md): SumVec(len=1000,
+    # bits=16) two-party prepare+accumulate. Chip-proven since the
+    # counter-mode XOF + anti-recompute-barrier rework: compiles in
+    # ~173s through the tunnel and sustains ~585 report-shares/s/chip.
+    ap.add_argument(
+        "--config",
+        default="sumvec",
+        choices=["count", "sum", "sumvec", "histogram", "fixedpoint"],
+    )
     ap.add_argument("--batch", type=int, default=0, help="0 = auto per backend")
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--host-reports", type=int, default=2, help="reports for the host baseline")
@@ -125,8 +127,13 @@ def main() -> None:
         "sum": VdafInstance.sum(bits=32),
         "sumvec": VdafInstance.sum_vec(length=1000, bits=16),
         "histogram": VdafInstance.histogram(length=10000),
+        "fixedpoint": VdafInstance.fixed_point_vec(length=1000, bits=16),
     }[args.config]
-    batch = args.batch or ({"count": 8192, "sum": 4096, "sumvec": 512, "histogram": 512}[args.config] if on_accel else {"count": 256, "sum": 128, "sumvec": 16, "histogram": 16}[args.config])
+    batch = args.batch or (
+        {"count": 8192, "sum": 4096, "sumvec": 1024, "histogram": 512, "fixedpoint": 512}[args.config]
+        if on_accel
+        else {"count": 256, "sum": 128, "sumvec": 16, "histogram": 16, "fixedpoint": 16}[args.config]
+    )
 
     rng = np.random.default_rng(0xBE7C)
     meas = random_measurements(inst, batch, rng)
@@ -159,8 +166,9 @@ def main() -> None:
     host = prio3_host(inst)
     host_meas = random_measurements(inst, args.host_reports, rng)
     t0 = time.time()
+    vector_kinds = ("sumvec", "countvec", "fixedpoint")
     for i in range(args.host_reports):
-        m = host_meas[i].tolist() if inst.kind == "sumvec" else int(host_meas[i])
+        m = host_meas[i].tolist() if inst.kind in vector_kinds else int(host_meas[i])
         nonce = bytes(16)
         public, (ls, hs) = host.shard(m, nonce)
         st0, ps0 = host.prepare_init(verify_key, 0, nonce, public, ls)
