@@ -166,9 +166,9 @@ def main() -> None:
     host = prio3_host(inst)
     host_meas = random_measurements(inst, args.host_reports, rng)
     t0 = time.time()
-    vector_kinds = ("sumvec", "countvec", "fixedpoint")
     for i in range(args.host_reports):
-        m = host_meas[i].tolist() if inst.kind in vector_kinds else int(host_meas[i])
+        mi = host_meas[i]
+        m = mi.tolist() if getattr(mi, "ndim", 0) else int(mi)
         nonce = bytes(16)
         public, (ls, hs) = host.shard(m, nonce)
         st0, ps0 = host.prepare_init(verify_key, 0, nonce, public, ls)

@@ -23,6 +23,7 @@ batches.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -32,6 +33,28 @@ import numpy as np
 from .field import Field64, Field128
 
 U64 = jnp.uint64
+
+# Read once at import: the flag participates in tracing, not execution,
+# and jit caches are not keyed on it — toggling mid-process would
+# silently have no effect on already-compiled graphs.
+_NO_BARRIERS = os.environ.get("JANUS_NO_BARRIERS") == "1"
+
+
+def anti_recompute_barrier(x):
+    """Materialization point against XLA fusion recomputing long
+    producer chains (NTT stages, power doublings, reduction levels).
+
+    Measured effects: on the CPU backend the barriers are load-bearing
+    (6x end-to-end on the SumVec step — fusion otherwise duplicates
+    each stage into every consumer); on TPU they are neutral (584.6 vs
+    ~585 reports/s on the SumVec bench). Set JANUS_NO_BARRIERS=1 *at
+    process start* to trace without them.
+    """
+    if _NO_BARRIERS:
+        return x
+    return jax.lax.optimization_barrier(x)
+
+
 _M32 = np.uint64(0xFFFFFFFF)
 _ZERO = np.uint64(0)
 _ONE = np.uint64(1)
@@ -395,7 +418,7 @@ def fsum(jf, v, axis):
         # chain into both slices — measured ~10x on the SumVec verifier
         # where the producer is a 16k-wide field multiply
         if m > 2:
-            v = jax.lax.optimization_barrier(v)
+            v = anti_recompute_barrier(v)
         half = m // 2
         a = fmap(lambda x: jax.lax.slice_in_dim(x, 0, half, axis=axis), v)
         b = fmap(lambda x: jax.lax.slice_in_dim(x, half, m, axis=axis), v)

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..fields.jfield import fconst, fmap
+from ..fields.jfield import anti_recompute_barrier, fconst, fmap
 
 
 def _bitrev_perm(n: int) -> np.ndarray:
@@ -98,7 +98,7 @@ def _transform(jf, v, n: int, inverse: bool):
         # end-to-end on the SumVec query graph); each stage's output is
         # reused by both halves of the next stage, so it must be CSE'd,
         # not inlined.
-        a = jax.lax.optimization_barrier(a)
+        a = anti_recompute_barrier(a)
         length <<= 1
     if inverse:
         a = jf.mul(a, fconst(jf, n_inv))
@@ -143,7 +143,7 @@ def powers(jf, x, n: int):
         # same anti-recomputation barrier as the NTT stages: each
         # doubling feeds the next, and XLA otherwise inlines the chain
         # into every consumer
-        acc = jax.lax.optimization_barrier(acc)
+        acc = anti_recompute_barrier(acc)
         cur *= 2
     if cur != n:
         acc = fmap(lambda a: a[..., :n], acc)

@@ -37,6 +37,7 @@ from ..fields.jfield import (
     fpow_const,
     fsum,
     is_zero,
+    anti_recompute_barrier,
 )
 from ..ops.ntt import intt_batched, ntt_batched, poly_eval_powers, powers
 from .reference import (
@@ -366,7 +367,7 @@ def _wire_polys(bc: BatchedCircuit, seeds, ci):
 def flp_prove_batched(bc: BatchedCircuit, inp, prove_rand, joint_rand):
     """proof [batch, proof_len] matching reference.flp_prove element-wise."""
     jf = bc.jf
-    ci = jax.lax.optimization_barrier(bc.calls_inputs(inp, joint_rand, 1))
+    ci = anti_recompute_barrier(bc.calls_inputs(inp, joint_rand, 1))
     wp = _wire_polys(bc, prove_rand, ci)
     wire_evals = ntt_batched(jf, wp, bc.n2)  # [batch, arity, n2]
     gadget_evals = bc.gadget_eval(wire_evals)  # [batch, n2]
@@ -391,12 +392,12 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
     # the calls-inputs tensor is reused by the wire interpolation AND the
     # evaluation-at-t path; barrier so XLA shares it instead of
     # recomputing the (r-powers x input) products per consumer
-    ci = jax.lax.optimization_barrier(bc.calls_inputs(inp_share, joint_rand, shares_inv))
+    ci = anti_recompute_barrier(bc.calls_inputs(inp_share, joint_rand, shares_inv))
     seeds = fmap(lambda x: x[..., : bc.arity], proof_share)
     gcoeffs = fmap(lambda x: x[..., bc.arity : bc.arity + bc.gp_len], proof_share)
 
     assert query_rand[0].shape[-1] == EVAL_POINT_CANDIDATES
-    t = jax.lax.optimization_barrier(_pick_eval_point(jf, query_rand, bc.m))
+    t = anti_recompute_barrier(_pick_eval_point(jf, query_rand, bc.m))
 
     # gadget outputs at call points alpha^{k+1}: fold mod x^m - 1, NTT_m
     folds = -(-bc.gp_len // bc.m)
@@ -406,8 +407,8 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
     outs = fmap(lambda x: x[..., 1 : bc.calls + 1], gevals)
 
     # wire polys from proof-share seeds; evaluate everything at t
-    wp = jax.lax.optimization_barrier(_wire_polys(bc, seeds, ci))  # [batch, arity, m]
-    pw = jax.lax.optimization_barrier(powers(jf, t, max(bc.m, bc.gp_len)))  # [batch, >=m]
+    wp = anti_recompute_barrier(_wire_polys(bc, seeds, ci))  # [batch, arity, m]
+    pw = anti_recompute_barrier(powers(jf, t, max(bc.m, bc.gp_len)))  # [batch, >=m]
     pw_b = fmap(lambda x: x[:, None, :], pw)
     wire_t = poly_eval_powers(jf, wp, pw_b)  # [batch, arity]
     proof_t = poly_eval_powers(jf, gcoeffs, pw)  # [batch]
