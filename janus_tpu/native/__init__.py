@@ -9,8 +9,9 @@ is available (`native.available()` reports which path is active).
 
 Current contents:
   - xof.c — Keccak-f[1600]/SHAKE128 batch seed expansion with
-    rejection sampling into u64 limb buffers (pthread-parallel across
-    seeds), byte-compatible with janus_tpu.vdaf.xof.XofCtr128
+    oversample-and-reduce field sampling (8*(limbs+1) stream bytes per
+    element, reduced mod p) into u64 limb buffers (pthread-parallel
+    across seeds), byte-compatible with janus_tpu.vdaf.xof.XofCtr128
     (counter-mode framing with tree-digested long binders).
 """
 

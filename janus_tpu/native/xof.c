@@ -13,8 +13,9 @@
  *     stream  = block_0 || block_1 || ...
  * where binder' is the binder itself when <= 112 bytes, else its
  * arity-7 Merkle tree digest (112-byte leaves, single-block node
- * messages). Field sampling is rejection sampling of ENCODED_SIZE-byte
- * little-endian chunks (< modulus) off the concatenated stream.
+ * messages). Field sampling is oversample-and-reduce (RFC 9380
+ * hash-to-field style, matching xof.py): ENCODED_SIZE+8 little-endian
+ * stream bytes per element, reduced mod p (bias <= 2^-64).
  *
  * Exposed as a plain C ABI for ctypes (no pybind11 in this image).
  * All entry points are thread-safe; the batch expander shards the seed
@@ -225,8 +226,40 @@ static void ctr_read(ctr_stream *s, uint8_t *out, size_t n) {
   }
 }
 
-/* Rejection-sample `length` field elements from one seed's stream.
- * limbs = 1 (Field64) or 2 (Field128); element = limbs little-endian u64.
+typedef unsigned __int128 u128;
+
+/* a + b mod p for a, b < p (p any 128-bit modulus with 2^128 mod p = c). */
+static inline u128 add_mod_u128(u128 a, u128 b, u128 p, u128 c) {
+  u128 s = a + b;
+  if (s < a) {
+    /* wrapped past 2^128: 2^128 = p + c, so s = a+b-2^128+c = a+b-p,
+     * which is already < p for a, b < p */
+    return s + c;
+  }
+  if (s >= p) s -= p; /* non-wrap branch: s < 2p needs one subtract */
+  return s;
+}
+
+/* (h*2^128 + L) mod p for the Field128 modulus (2^128 === 7*2^66 - 1). */
+static u128 reduce192_f128(uint64_t h, u128 L, u128 p) {
+  const u128 c = ((u128)7 << 66) - 1; /* 2^128 mod p; c = 27*2^64 + (2^64-1) */
+  const uint64_t c1 = 27, c0 = ~(uint64_t)0;
+  /* h*c = h*c1*2^64 + h*c0; fold the *2^64 term's overflow through c. */
+  u128 hc1 = (u128)h * c1;             /* < 2^69 */
+  u128 hc0 = (u128)h * c0;             /* < 2^128 */
+  uint64_t d1 = (uint64_t)(hc1 >> 64); /* < 32 */
+  u128 d0_64 = (u128)(uint64_t)hc1 << 64;
+  u128 r = L % p;
+  r = add_mod_u128(r, hc0 % p, p, c);
+  r = add_mod_u128(r, ((u128)d1 * c) % p, p, c);
+  r = add_mod_u128(r, d0_64 % p, p, c);
+  return r;
+}
+
+/* Sample `length` field elements from one seed's stream by
+ * oversample-and-reduce: (limbs+1) little-endian u64 lanes per element,
+ * value mod p (janus_tpu.vdaf.xof semantics, bias <= 2^-64).
+ * limbs = 1 (Field64) or 2 (Field128);
  * out: length*limbs u64 (element-major: e0.lo, e0.hi, e1.lo, ...). */
 static int expand_one(const uint8_t *dst16, const uint8_t *seed16,
                       const uint8_t *binder, size_t binder_len, size_t length,
@@ -235,22 +268,21 @@ static int expand_one(const uint8_t *dst16, const uint8_t *seed16,
   ctr_stream s;
   if (ctr_init(&s, dst16, seed16, binder, binder_len) != 0) return -1;
 
-  size_t got = 0;
-  uint8_t chunk[16];
-  while (got < length) {
-    ctr_read(&s, chunk, (size_t)(8 * limbs));
-    uint64_t lo, hi = 0;
-    memcpy(&lo, chunk, 8);
-    if (limbs == 2) memcpy(&hi, chunk + 8, 8);
-    int ok;
-    if (limbs == 1)
-      ok = lo < mod_lo;
-    else
-      ok = (hi < mod_hi) || (hi == mod_hi && lo < mod_lo);
-    if (ok) {
-      out[got * limbs] = lo;
-      if (limbs == 2) out[got * limbs + 1] = hi;
-      got++;
+  uint8_t chunk[24];
+  for (size_t got = 0; got < length; got++) {
+    ctr_read(&s, chunk, (size_t)(8 * (limbs + 1)));
+    uint64_t l0, l1, l2 = 0;
+    memcpy(&l0, chunk, 8);
+    memcpy(&l1, chunk + 8, 8);
+    if (limbs == 2) memcpy(&l2, chunk + 16, 8);
+    if (limbs == 1) {
+      u128 v = ((u128)l1 << 64) | l0;
+      out[got] = (uint64_t)(v % mod_lo);
+    } else {
+      u128 p = ((u128)mod_hi << 64) | mod_lo;
+      u128 r = reduce192_f128(l2, ((u128)l1 << 64) | l0, p);
+      out[got * 2] = (uint64_t)r;
+      out[got * 2 + 1] = (uint64_t)(r >> 64);
     }
   }
   return 0;

@@ -318,50 +318,36 @@ def tree_digest_lanes(data_parts, data_len_bytes: int, batch: int):
 
 
 # ---------------------------------------------------------------------------
-# Field-element sampling (rejection with static-shape compaction)
+# Field-element sampling (oversample-and-reduce; janus_tpu.vdaf.xof)
 # ---------------------------------------------------------------------------
-
-SAMPLE_SLACK = 8  # extra candidates; P[>=8 rejections] ~ (n choose 8) * 2^-256
 
 
 def sample_count_blocks(jf, length: int) -> int:
     """Number of SHAKE output blocks needed to sample `length` elements."""
-    cand = length + SAMPLE_SLACK
-    lanes_needed = cand * jf.LIMBS
+    lanes_needed = length * (jf.LIMBS + 1)
     return (lanes_needed + RATE_LANES - 1) // RATE_LANES
 
 
 def sample_field_vec(jf, stream_lanes, length: int):
-    """Rejection-sample `length` field elements from squeezed lanes.
-
-    stream_lanes: [batch, out_blocks, 21] u64. Emulates the host
-    semantics exactly: consume LIMBS-lane little-endian chunks in order,
-    skipping values >= p; take the first `length` accepted.
-    Returns a field value of shape [batch, length].
+    """Sample `length` field elements by reducing (LIMBS+1)-lane
+    little-endian chunks mod p (bias <= 2^-64 per element; see
+    janus_tpu.vdaf.xof). Pure elementwise limb math — rejection
+    sampling's data-dependent compaction lowered to row-wise gathers
+    and sort-based scatters that were 78% of the two-party SumVec step
+    on chip. stream_lanes: [batch, out_blocks, 21] u64; returns a field
+    value of shape [batch, length].
     """
+    from ..fields.jfield import _f64_reduce_wide, _f128_reduce256
+
     batch = stream_lanes.shape[0]
+    g = jf.LIMBS + 1
     flat = stream_lanes.reshape(batch, -1)
-    cand = min(length + SAMPLE_SLACK, flat.shape[1] // jf.LIMBS)
-    limbs = tuple(flat[:, i : cand * jf.LIMBS : jf.LIMBS] for i in range(jf.LIMBS))
-    # accept mask: value < p
+    assert flat.shape[1] >= length * g
+    lanes = tuple(flat[:, i : length * g : g] for i in range(g))
     if jf.LIMBS == 1:
-        p0 = np.uint64(jf.MODULUS)
-        accept = limbs[0] < p0
-    else:
-        lo, hi = limbs
-        p_lo = np.uint64(jf.MODULUS & 0xFFFFFFFFFFFFFFFF)
-        p_hi = np.uint64(jf.MODULUS >> 64)
-        accept = (hi < p_hi) | ((hi == p_hi) & (lo < p_lo))
-    # output slot each accepted candidate lands at (strictly increasing)
-    idx = jnp.cumsum(accept.astype(jnp.int32), axis=1) - 1
-    slot = jnp.where(accept, idx, cand)  # rejected -> out of bounds, dropped
-    # scatter candidate index i into out_idx[b, slot[b, i]]
-    bidx = jnp.broadcast_to(jnp.arange(batch, dtype=jnp.int32)[:, None], slot.shape)
-    cidx = jnp.broadcast_to(jnp.arange(cand, dtype=jnp.int32)[None, :], slot.shape)
-    out_idx = jnp.zeros((batch, length), dtype=jnp.int32)
-    out_idx = out_idx.at[bidx, slot].max(cidx, mode="drop")
-    gathered = tuple(jnp.take_along_axis(limb, out_idx, axis=1) for limb in limbs)
-    return gathered
+        return (_f64_reduce_wide(lanes[0], lanes[1]),)
+    zero = jnp.zeros_like(lanes[0])
+    return _f128_reduce256(lanes[0], lanes[1], lanes[2], zero)
 
 
 def expand_field_vec(jf, prefix_parts, prefix_len_bytes: int, batch: int, length: int):

@@ -129,20 +129,29 @@ def test_long_binder_derive_matches_host():
     assert out == XofCtr128.derive_seed(seed, d, tree_digest(binder))
 
 
-def test_rejection_path_exercised():
-    # Craft a stream position where a candidate is rejected: brute-force a
-    # seed whose early chunk for Field64 is >= p (prob ~2^-32 per chunk is
-    # too rare; instead verify the compaction logic on synthetic lanes).
+def test_reduction_sampling_semantics():
+    # oversample-and-reduce: element i = (LIMBS+1) lanes little-endian
+    # mod p — including values at/above p, which rejection would skip
     import jax.numpy as jnp
 
-    # synthetic stream: candidate 0 invalid (>= p), candidates 1.. valid
-    p = Field64.MODULUS
-    lanes = np.zeros((1, 2, 21), dtype=np.uint64)
-    lanes[0, 0, 0] = np.uint64(p)  # rejected
-    for i in range(1, 21):
-        lanes[0, 0, i] = np.uint64(i)
-    for i in range(21):
-        lanes[0, 1, i] = np.uint64(100 + i)
-    got = kj.sample_field_vec(JF64, jnp.asarray(lanes), 25)
+    p64 = Field64.MODULUS
+    lanes = np.zeros((1, 1, 21), dtype=np.uint64)
+    lanes[0, 0, 0] = np.uint64(p64)     # elem 0 = p + 2^64*5 -> 5*2^64 mod p... computed below
+    lanes[0, 0, 1] = np.uint64(5)
+    lanes[0, 0, 2] = np.uint64(123)     # elem 1 = 123
+    lanes[0, 0, 3] = np.uint64(0)
+    got = kj.sample_field_vec(JF64, jnp.asarray(lanes), 2)
     vals = [int(x) for x in JF64.to_ints(got)[0]]
-    assert vals == [*range(1, 21), 100, 101, 102, 103, 104]
+    want0 = (p64 + 5 * (1 << 64)) % p64
+    assert vals == [want0, 123]
+
+    p128 = Field128.MODULUS
+    lanes = np.zeros((1, 1, 21), dtype=np.uint64)
+    # elem 0 = l0 + l1*2^64 + l2*2^128
+    lanes[0, 0, 0] = np.uint64(7)
+    lanes[0, 0, 1] = np.uint64(11)
+    lanes[0, 0, 2] = np.uint64(0xDEADBEEF)
+    got = kj.sample_field_vec(JF128, jnp.asarray(lanes), 1)
+    vals = [int(x) for x in JF128.to_ints(got)[0]]
+    want = (7 + 11 * (1 << 64) + 0xDEADBEEF * (1 << 128)) % p128
+    assert vals == [want]

@@ -1,7 +1,7 @@
 """Native C XOF (janus_tpu/native/xof.c) differential tests vs the
 pure-Python SHAKE128 host oracle — every byte of the stream framing and
-the field rejection sampling must agree, since host- and device-side
-parties exchange shares produced by either path."""
+the oversample-and-reduce field sampling must agree, since host- and
+device-side parties exchange shares produced by either path."""
 
 import hashlib
 
