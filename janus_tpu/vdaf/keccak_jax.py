@@ -86,12 +86,20 @@ def _keccak_round(a, rc):
 def keccak_f1600(state):
     """One permutation. state: 25 u64 arrays (lane (x,y) at index x + 5*y).
 
-    The 24 rounds run under lax.scan so the round body is traced and
-    compiled once — an unrolled permutation inflates the XLA graph by
-    ~2k ops per call site, which multiplies out to minutes of compile
-    time across the expansion pipeline.
+    On TPU this dispatches to the Pallas kernel (janus_tpu.ops.
+    keccak_pallas): all 24 rounds stay in VMEM on native u32 halves,
+    one HBM read+write per element. Elsewhere the rounds run under
+    lax.scan so the round body is traced and compiled once — an
+    unrolled permutation inflates the XLA graph by ~2k ops per call
+    site, which multiplies out to minutes of compile time across the
+    expansion pipeline.
     """
+    from ..ops import keccak_pallas
+
     state = tuple(jnp.asarray(x, dtype=U64) for x in state)
+    n = int(np.prod(state[0].shape)) if state[0].shape else 1
+    if keccak_pallas.enabled(n):
+        return keccak_pallas.keccak_f1600_pallas(state)
 
     def body(a, rc):
         return _keccak_round(a, rc), None

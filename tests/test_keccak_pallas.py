@@ -1,0 +1,63 @@
+"""Pallas Keccak kernel vs the scan-based XLA path.
+
+On TPU the kernel runs natively (validated on-chip: bit-exact vs the
+scan path, see janus_tpu/ops/keccak_pallas.py). On CPU it runs in
+pallas interpret mode, which for this 24-round unrolled body takes
+tens of minutes on a single-core host — so these differential tests
+are opt-in via JANUS_PALLAS_TESTS=1 (CI boxes with cores should set
+it)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from janus_tpu.vdaf import keccak_jax as kj
+from janus_tpu.ops import keccak_pallas as kp
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("JANUS_PALLAS_TESTS") != "1"
+    and __import__("jax").default_backend() != "tpu",
+    reason="pallas interpret mode too slow on this host; set JANUS_PALLAS_TESTS=1",
+)
+
+
+@pytest.mark.parametrize("shape", [(4, 129)])  # pads 516 -> 1024 columns
+def test_pallas_permutation_matches_scan(shape):
+    rng = np.random.default_rng(sum(shape))
+    state = tuple(
+        jnp.asarray(rng.integers(0, 1 << 63, size=shape, dtype=np.uint64))
+        for _ in range(25)
+    )
+
+    def scan_path(st):
+        out, _ = __import__("jax").lax.scan(
+            lambda a, rc: (kj._keccak_round(a, rc), None),
+            st,
+            jnp.asarray(kj._RC),
+        )
+        return out
+
+    want = scan_path(state)
+    got = kp.keccak_f1600_pallas(state)  # interpret mode off-TPU
+    for lane, (w, g) in enumerate(zip(want, got)):
+        assert (np.asarray(w) == np.asarray(g)).all(), lane
+
+
+def test_pallas_stream_matches_hashlib(monkeypatch):
+    # force the pallas (interpret) path through the full ctr stream:
+    # both the mode AND the size threshold must be overridden, or the
+    # tiny test stream silently takes the lax.scan path
+    from janus_tpu.vdaf.xof import XofCtr128, dst
+
+    monkeypatch.setattr(kp, "_mode", lambda: "interpret")
+    monkeypatch.setattr(kp, "MIN_COLUMNS", 0)
+    d = dst(0x42, 2)
+    seed = bytes(range(16))
+    seed_lanes = jnp.asarray(kj.bytes_to_lanes(seed)[None, :])
+    parts = [(0, d), (2, seed_lanes)]
+    got = np.asarray(kj.ctr_stream_lanes(parts, 32, 1, 3))
+    want = XofCtr128(seed, d).next(3 * 168)
+    assert got[0].reshape(-1).astype("<u8").tobytes() == want
