@@ -36,6 +36,13 @@ def main() -> None:
         choices=["count", "sum", "sumvec", "histogram", "fixedpoint"],
     )
     ap.add_argument("--batch", type=int, default=0, help="0 = auto per backend")
+    ap.add_argument(
+        "--length",
+        type=int,
+        default=0,
+        help="override the vector length for sumvec/histogram/fixedpoint "
+        "(0 = the BASELINE.md config)",
+    )
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--host-reports", type=int, default=2, help="reports for the host baseline")
     ap.add_argument(
@@ -122,12 +129,15 @@ def main() -> None:
     from janus_tpu.vdaf.testing import make_report_batch, random_measurements
 
     # BASELINE.md measurement configs
+    if args.length and args.config in ("count", "sum"):
+        ap.error(f"--length has no meaning for --config {args.config}")
+    L = args.length
     inst = {
         "count": VdafInstance.count(),
         "sum": VdafInstance.sum(bits=32),
-        "sumvec": VdafInstance.sum_vec(length=1000, bits=16),
-        "histogram": VdafInstance.histogram(length=10000),
-        "fixedpoint": VdafInstance.fixed_point_vec(length=1000, bits=16),
+        "sumvec": VdafInstance.sum_vec(length=L or 1000, bits=16),
+        "histogram": VdafInstance.histogram(length=L or 10000),
+        "fixedpoint": VdafInstance.fixed_point_vec(length=L or 1000, bits=16),
     }[args.config]
     batch = args.batch or (
         {"count": 8192, "sum": 4096, "sumvec": 1024, "histogram": 512, "fixedpoint": 512}[args.config]

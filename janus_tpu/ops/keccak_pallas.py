@@ -17,7 +17,10 @@ over a grid on R. Zero columns permute to garbage and are sliced away.
 
 Enabled on TPU backends by default (JANUS_PALLAS=0 disables, =1 forces
 — the interpreter makes it work on CPU for differential tests);
-everything else falls back to the scan path.
+everything else falls back to the scan path. The flag and backend are
+read once at the first XOF call and cached (jitted graphs embed the
+dispatch decision, so mid-process toggles could not take effect
+anyway); tests that need a different mode patch `_mode` directly.
 """
 
 from __future__ import annotations

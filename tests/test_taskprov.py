@@ -23,7 +23,14 @@ from janus_tpu.core.hpke import generate_hpke_config_and_private_key
 from janus_tpu.core.http_client import HttpClient
 from janus_tpu.core.time_util import MockClock
 from janus_tpu.datastore.store import EphemeralDatastore
-from janus_tpu.messages import Duration, Interval, Query, Role, Time
+from janus_tpu.messages import (
+    AggregationJobInitializeReq,
+    Duration,
+    Interval,
+    Query,
+    Role,
+    Time,
+)
 from janus_tpu.messages.taskprov import (
     TASKPROV_HEADER,
     DpConfig,
@@ -285,6 +292,7 @@ def test_taskprov_rejections():
             b64 = base64.urlsafe_b64encode
             url_tid = b64(tid.data).decode().rstrip("=")
             hdrs = {
+                "Content-Type": AggregationJobInitializeReq.MEDIA_TYPE,
                 TASKPROV_HEADER: b64(task_config.to_bytes()).decode().rstrip("="),
                 **headers,
             }
@@ -315,7 +323,11 @@ def test_taskprov_rejections():
 
         # task id not matching the config digest -> invalidMessage
         b64 = base64.urlsafe_b64encode
-        hdrs = {TASKPROV_HEADER: b64(cfg.to_bytes()).decode().rstrip("="), **good_auth}
+        hdrs = {
+            "Content-Type": AggregationJobInitializeReq.MEDIA_TYPE,
+            TASKPROV_HEADER: b64(cfg.to_bytes()).decode().rstrip("="),
+            **good_auth,
+        }
         status, _, body = app.handle(
             "PUT",
             f"/tasks/{b64(bytes(32)).decode().rstrip('=')}/aggregation_jobs/{b64(bytes(16)).decode().rstrip('=')}",
