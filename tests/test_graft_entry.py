@@ -4,12 +4,13 @@ verbatim; a regression here is invisible to the rest of the suite)."""
 
 import importlib.util
 import sys
+from pathlib import Path
+
+_ENTRY_PATH = Path(__file__).resolve().parents[1] / "__graft_entry__.py"
 
 
 def _load_entry_module():
-    spec = importlib.util.spec_from_file_location(
-        "__graft_entry__", "/root/repo/__graft_entry__.py"
-    )
+    spec = importlib.util.spec_from_file_location("__graft_entry__", str(_ENTRY_PATH))
     mod = importlib.util.module_from_spec(spec)
     sys.modules.setdefault("__graft_entry__", mod)
     spec.loader.exec_module(mod)
