@@ -24,7 +24,165 @@ import sys
 import time
 
 
+def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
+    """End-to-end served throughput: reports through the real helper +
+    leader HTTP handlers (HPKE opens, wire decode, SQLite writes, the
+    device engine) on an in-process loopback pair.
+
+    Measures what the device-step bench deliberately excludes — the
+    serving shell around the engine (VERDICT Weak #4; the reference's
+    hot path aggregator.rs:1561-1890 includes all of it).
+    """
+    import time as _time
+
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.client import ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.testing import make_wire_reports, random_measurements
+
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    leader_agg = Aggregator(leader_eph.datastore, clock, Config())
+    helper_agg = Aggregator(helper_eph.datastore, clock, Config())
+    leader_srv = DapServer(DapHttpApp(leader_agg)).start()
+    helper_srv = DapServer(DapHttpApp(helper_agg)).start()
+    try:
+        collector_kp = generate_hpke_config_and_private_key(config_id=200)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), inst, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = _dc.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+        )
+        leader_eph.datastore.run_tx(lambda tx: tx.put_task(leader_task))
+        helper_eph.datastore.run_tx(lambda tx: tx.put_task(helper_task))
+
+        rng = np.random.default_rng(0x5E12)
+        meas = random_measurements(inst, n_reports, rng)
+        t0 = _time.time()
+        when = clock.now().to_batch_interval_start(leader_task.time_precision)
+        reports = make_wire_reports(
+            inst,
+            meas,
+            leader_task.task_id,
+            leader_task.hpke_keys[0].config,
+            helper_task.hpke_keys[0].config,
+            when,
+            seed=2,
+        )
+        stage_s = _time.time() - t0
+        progress["t"] = time.monotonic()
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        t0 = _time.time()
+        for r in reports:
+            status, body = http.put(
+                params.upload_uri(), r.to_bytes(), {"Content-Type": "application/dap-report"}
+            )
+            assert status == 201, body
+        upload_s = _time.time() - t0
+        progress["t"] = time.monotonic()
+
+        creator = AggregationJobCreator(
+            leader_eph.datastore,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=job_size
+            ),
+        )
+        driver = AggregationJobDriver(leader_eph.datastore, http)
+        jd = JobDriver(
+            JobDriverConfig(max_concurrent_job_workers=1),
+            driver.acquirer(),
+            driver.stepper,
+        )
+        t0 = _time.time()
+        creator.run_once()
+        while jd.run_once():
+            progress["t"] = time.monotonic()
+        aggregate_s = _time.time() - t0
+        progress["t"] = time.monotonic()
+
+        collector = Collector(
+            CollectorParameters(
+                leader_task.task_id,
+                leader_srv.url,
+                leader_task.collector_auth_token,
+                collector_kp,
+            ),
+            inst,
+            http,
+        )
+        query = Query.time_interval(
+            Interval(Time(when.seconds - 3600), Duration(3600 * 4))
+        )
+        t0 = _time.time()
+        job_id = collector.start_collection(query)
+        cdriver = CollectionJobDriver(leader_eph.datastore, http)
+        cjd = JobDriver(
+            JobDriverConfig(max_concurrent_job_workers=1),
+            cdriver.acquirer(),
+            cdriver.stepper,
+        )
+        cjd.run_once()
+        result = collector.poll_once(job_id, query)
+        collect_s = _time.time() - t0
+        assert result.report_count == n_reports, result.report_count
+        return {
+            "n_reports": n_reports,
+            "stage_s": round(stage_s, 2),
+            "upload_rps": round(n_reports / upload_s, 2),
+            "served_aggregate_rps": round(n_reports / aggregate_s, 2),
+            "collect_s": round(collect_s, 2),
+        }
+    finally:
+        leader_srv.stop()
+        helper_srv.stop()
+        leader_eph.cleanup()
+        helper_eph.cleanup()
+
+
 def main() -> None:
+    # Persistent XLA compilation cache: re-runs of the same config skip
+    # the multi-minute compile (set before jax initializes a backend).
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/jax_comp_cache")
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     ap = argparse.ArgumentParser()
     # Default is the north-star config (BASELINE.md): SumVec(len=1000,
     # bits=16) two-party prepare+accumulate. Chip-proven since the
@@ -44,6 +202,17 @@ def main() -> None:
         "(0 = the BASELINE.md config)",
     )
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument(
+        "--mode",
+        default="device",
+        choices=["device", "served"],
+        help="device = fused two-party step only; served = also drive "
+        "reports through the real HTTP serving path (HPKE + decode + "
+        "SQLite + engine) and report both numbers",
+    )
+    ap.add_argument(
+        "--reports", type=int, default=256, help="report count for --mode served"
+    )
     ap.add_argument("--host-reports", type=int, default=2, help="reports for the host baseline")
     ap.add_argument(
         "--max-seconds",
@@ -146,21 +315,42 @@ def main() -> None:
     )
 
     rng = np.random.default_rng(0xBE7C)
-    meas = random_measurements(inst, batch, rng)
-    t0 = time.time()
-    step_args, _ = make_report_batch(inst, meas, seed=1)
-    progress["t"] = time.monotonic()
-    print(f"[bench] backend={backend} shard: {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
-
     verify_key = bytes(range(16))
-    step = jax.jit(two_party_step(inst, verify_key))
 
-    # warmup/compile
-    t0 = time.time()
-    out = jax.block_until_ready(step(*step_args))
-    compile_s = time.time() - t0
-    progress["t"] = time.monotonic()
-    print(f"[bench] two_party_step compile+first: {compile_s:.1f}s", file=sys.stderr, flush=True)
+    def _is_oom(e: Exception) -> bool:
+        s = str(e)
+        return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
+
+    # stage + compile + first run, halving the batch on device OOM so
+    # long-vector configs always produce a number unattended
+    while True:
+        try:
+            meas = random_measurements(inst, batch, rng)
+            t0 = time.time()
+            step_args, _ = make_report_batch(inst, meas, seed=1)
+            progress["t"] = time.monotonic()
+            print(
+                f"[bench] backend={backend} batch={batch} shard: {time.time()-t0:.1f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            step = jax.jit(two_party_step(inst, verify_key))
+            t0 = time.time()
+            out = jax.block_until_ready(step(*step_args))
+            compile_s = time.time() - t0
+            progress["t"] = time.monotonic()
+            print(
+                f"[bench] two_party_step compile+first: {compile_s:.1f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            break
+        except RuntimeError as e:
+            if not _is_oom(e) or batch <= 1:
+                raise
+            batch //= 2
+            progress["t"] = time.monotonic()
+            print(f"[bench] device OOM; retrying batch={batch}", file=sys.stderr, flush=True)
     assert int(out[2]) == batch, f"bench reports rejected: {int(out[2])}/{batch}"
 
     t0 = time.time()
@@ -172,9 +362,26 @@ def main() -> None:
     progress["t"] = time.monotonic()
     device_rps = batch * args.iters / elapsed
 
-    # host (CPU oracle) baseline, extrapolated per report
-    host = prio3_host(inst)
-    host_meas = random_measurements(inst, args.host_reports, rng)
+    served = None
+    if args.mode == "served":
+        served = run_served(inst, args.reports, min(batch, 512), progress)
+
+    # host (CPU oracle) baseline, extrapolated per report. For long
+    # vectors the oracle is too slow to run at full length inside the
+    # watchdog window; measure at a capped length and scale LINEARLY in
+    # the vector length — conservative, since the FLP cost is
+    # superlinear (NTT + sqrt-chunked gadget), so linear scaling
+    # overstates the host and understates vs_baseline.
+    host_len_cap = 2000
+    host_inst = inst
+    host_scale = 1.0
+    if inst.length > host_len_cap and inst.kind in ("sumvec", "histogram", "fixedpoint", "countvec"):
+        import dataclasses
+
+        host_inst = dataclasses.replace(inst, length=host_len_cap)
+        host_scale = inst.length / host_len_cap
+    host = prio3_host(host_inst)
+    host_meas = random_measurements(host_inst, args.host_reports, rng)
     t0 = time.time()
     for i in range(args.host_reports):
         mi = host_meas[i]
@@ -187,7 +394,7 @@ def main() -> None:
         host.prepare_next(st0, prep)
         host.prepare_next(st1, prep)
         progress["t"] = time.monotonic()
-    host_s_per_report = (time.time() - t0) / args.host_reports
+    host_s_per_report = (time.time() - t0) * host_scale / args.host_reports
     # the host loop above includes shard(); prepare is ~2/3 of it — keep
     # the conservative (higher) host number by not discounting
     host_rps = 1.0 / host_s_per_report if host_s_per_report > 0 else float("inf")
@@ -209,6 +416,8 @@ def main() -> None:
                 "iters": args.iters,
                 "compile_s": round(compile_s, 1),
                 "host_oracle_rps": round(host_rps, 3),
+                "host_oracle_extrapolated": host_scale != 1.0,
+                **({"served": served} if served else {}),
                 "config": inst.to_dict(),
             }
         )

@@ -191,7 +191,7 @@ class AggregationJobDriver:
 
         # device: batched leader prepare-init (reference hot loop :329-402)
         out0, seed0, ver0, part0 = engine.leader_init(
-            nonce_lanes, public_parts, meas, proof, blind_lanes
+            nonce_lanes, public_parts, meas, proof, blind_lanes, ok=ok
         )
 
         # build + send the init request (reference :404-424)
@@ -308,7 +308,12 @@ class AggregationJobDriver:
             + f"/tasks/{base64.urlsafe_b64encode(task.task_id.data).decode().rstrip('=')}"
             + f"/aggregation_jobs/{base64.urlsafe_b64encode(job_id.data).decode().rstrip('=')}"
         )
-        headers = {"Content-Type": AggregationJobInitializeReq.MEDIA_TYPE}
+        from .http_handlers import XOF_MODE_HEADER
+
+        headers = {
+            "Content-Type": AggregationJobInitializeReq.MEDIA_TYPE,
+            XOF_MODE_HEADER: task.vdaf.xof_mode,
+        }
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
         status, body = retry_http_request(

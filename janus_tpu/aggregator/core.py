@@ -169,7 +169,11 @@ class TaskAggregator:
         else:
             fresh = ds.run_tx(lambda tx: tx.put_client_report(stored), "upload")
         if not fresh:
-            raise errors.ReportRejected("report replayed", task.task_id)
+            # Replay is silent success: client retries are a normal
+            # at-least-once-HTTP occurrence, not an error (DAP-07
+            # upload semantics; the reference's upload dedup drops the
+            # duplicate row and answers 201).
+            metrics.upload_replay_counter.add()
 
     # ------------------------------------------------------------------
     # helper aggregate init (reference aggregator.rs:1561)

@@ -89,7 +89,10 @@ class EngineCache:
         return out1, np.asarray(mask)[:n], np.asarray(prep_msg)[:n]
 
     # --- leader side: init only (network round trip follows) ---
-    def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0):
+    def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0, ok=None):
+        # ok is accepted for interface parity with HostEngineCache; the
+        # batched device step costs nothing extra for failed lanes
+        # (their rows are zeroed and masked downstream).
         p3 = self.p3
         n = nonce_lanes.shape[0]
         b = bucket_size(n)
@@ -122,6 +125,145 @@ class EngineCache:
         return [int(x) for x in p3.jf.to_ints(agg)]
 
 
+class _HostP3:
+    """Duck-typed `.p3` for HostEngineCache (callers use engine.p3.jf
+    for the columnar codecs)."""
+
+    def __init__(self, jf):
+        self.jf = jf
+
+
+class HostEngineCache:
+    """Per-report host engine for draft-mode (spec-framing) tasks.
+
+    Same surface as EngineCache but loops reports through the scalar
+    host Prio3 — mirroring the reference's own per-report CPU loop
+    (aggregation_job_driver.rs:329-402, aggregator.rs:1775-1826). The
+    TPU engine only implements the fast framing; conformant tasks trade
+    throughput for cross-implementation compatibility.
+    """
+
+    def __init__(self, inst: VdafInstance, verify_key: bytes):
+        from ..vdaf.engine import jf_for
+        from ..vdaf.registry import circuit_for, prio3_host
+
+        self.inst = inst
+        self.verify_key = verify_key
+        self.host = prio3_host(inst)
+        self.circ = circuit_for(inst)
+        self.jf = jf_for(self.circ)
+        self.p3 = _HostP3(self.jf)
+
+    # --- lane <-> host-int conversions ---
+    def _row_ints(self, limbs, i) -> list[int]:
+        if len(limbs) == 1:
+            return [int(x) for x in np.asarray(limbs[0])[i]]
+        lo = np.asarray(limbs[0])[i]
+        hi = np.asarray(limbs[1])[i]
+        return [int(l) | (int(h) << 64) for l, h in zip(lo, hi)]
+
+    def _ints_to_limbs(self, rows: list[list[int] | None], n: int):
+        batch = len(rows)
+        out = tuple(np.zeros((batch, n), dtype=np.uint64) for _ in range(self.jf.LIMBS))
+        for i, r in enumerate(rows):
+            if r is None:
+                continue
+            for j, v in enumerate(r):
+                out[0][i, j] = np.uint64(v & 0xFFFFFFFFFFFFFFFF)
+                if self.jf.LIMBS == 2:
+                    out[1][i, j] = np.uint64(v >> 64)
+        return out
+
+    @staticmethod
+    def _row_bytes(lanes, i) -> bytes:
+        return np.asarray(lanes, dtype="<u8")[i].tobytes()
+
+    def helper_init(self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
+        from ..vdaf.reference import HelperShare, PrepShare, VdafError
+
+        n = nonce_lanes.shape[0]
+        uses_jr = self.host.uses_joint_rand
+        out_rows: list[list[int] | None] = [None] * n
+        accept = np.zeros(n, dtype=bool)
+        prep_msg = np.zeros((n, 2), dtype=np.uint64)
+        for i in range(n):
+            if not ok_mask[i]:
+                continue
+            nonce = self._row_bytes(nonce_lanes, i)
+            share = HelperShare(
+                self._row_bytes(helper_seeds, i),
+                self._row_bytes(blinds, i) if uses_jr else None,
+            )
+            parts = (
+                [self._row_bytes(public_parts[:, 0], i), self._row_bytes(public_parts[:, 1], i)]
+                if uses_jr
+                else []
+            )
+            try:
+                state1, ps1 = self.host.prepare_init(
+                    self.verify_key, 1, nonce, parts, share
+                )
+                ps0 = PrepShare(
+                    self._row_ints(ver0, i),
+                    self._row_bytes(part0, i) if uses_jr else None,
+                )
+                msg = self.host.prepare_shares_to_prep([ps0, ps1])
+                self.host.prepare_next(state1, msg)
+            except VdafError:
+                continue
+            out_rows[i] = state1.out_share
+            accept[i] = True
+            if uses_jr:
+                prep_msg[i] = np.frombuffer(msg, dtype="<u8")
+        out1 = self._ints_to_limbs(out_rows, self.circ.output_len)
+        return out1, accept, prep_msg
+
+    def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0, ok=None):
+        from ..vdaf.reference import LeaderShare
+
+        n = nonce_lanes.shape[0]
+        uses_jr = self.host.uses_joint_rand
+        out_rows: list[list[int] | None] = [None] * n
+        ver_rows: list[list[int] | None] = [None] * n
+        seed0 = np.zeros((n, 2), dtype=np.uint64) if uses_jr else None
+        part0 = np.zeros((n, 2), dtype=np.uint64) if uses_jr else None
+        for i in range(n):
+            if ok is not None and not ok[i]:
+                continue  # don't pay scalar FLP prepare for failed lanes
+            nonce = self._row_bytes(nonce_lanes, i)
+            share = LeaderShare(
+                self._row_ints(meas, i),
+                self._row_ints(proof, i),
+                self._row_bytes(blind0, i) if uses_jr else None,
+            )
+            parts = (
+                [self._row_bytes(public_parts[:, 0], i), self._row_bytes(public_parts[:, 1], i)]
+                if uses_jr
+                else []
+            )
+            state, ps = self.host.prepare_init(self.verify_key, 0, nonce, parts, share)
+            out_rows[i] = state.out_share
+            ver_rows[i] = ps.verifier_share
+            if uses_jr:
+                seed0[i] = np.frombuffer(state.corrected_joint_rand_seed, dtype="<u8")
+                part0[i] = np.frombuffer(ps.joint_rand_part, dtype="<u8")
+        out0 = self._ints_to_limbs(out_rows, self.circ.output_len)
+        ver0 = self._ints_to_limbs(ver_rows, self.circ.verifier_len)
+        return out0, seed0, ver0, part0
+
+    def aggregate(self, out_shares, mask):
+        p = self.circ.FIELD.MODULUS
+        agg = [0] * self.circ.output_len
+        for i in range(mask.shape[0]):
+            if not mask[i]:
+                continue
+            row = self._row_ints(out_shares, i)
+            agg = [(a + b) % p for a, b in zip(agg, row)]
+        return agg
+
+
 @lru_cache(maxsize=256)
-def engine_cache(inst: VdafInstance, verify_key: bytes) -> EngineCache:
+def engine_cache(inst: VdafInstance, verify_key: bytes):
+    if inst.xof_mode != "fast":
+        return HostEngineCache(inst, verify_key)
     return EngineCache(inst, verify_key)

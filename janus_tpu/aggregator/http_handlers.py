@@ -39,6 +39,11 @@ from ..core.time_util import Clock
 from .core import Aggregator
 from .errors import AggregatorError, InvalidMessage, UnrecognizedTask
 
+# Advertises the sender's XOF framing mode on aggregation-job requests
+# so a leader/helper mode mismatch fails loudly instead of rejecting
+# every report (ADVICE: framing-version identifier).
+XOF_MODE_HEADER = "janus-xof-mode"
+
 log = logging.getLogger(__name__)
 
 
@@ -176,8 +181,21 @@ class DapHttpApp:
                         got = {k.lower(): v for k, v in headers.items()}.get(
                             "content-type", ""
                         )
-                        if got.split(";")[0].strip() != want:
-                            return 415, "text/plain", b"unexpected media type"
+                        # Exact match, no parameter stripping — the
+                        # reference's validate_content_type requires the
+                        # precise media type and answers 400 BadRequest
+                        # (http_handlers.rs validate_content_type).
+                        if got != want:
+                            from ..messages.problem_type import DapProblemType
+
+                            doc = DapProblemType.INVALID_MESSAGE.document(
+                                detail=f"unexpected media type: {got!r} (want {want!r})"
+                            )
+                            return (
+                                400,
+                                "application/problem+json",
+                                json.dumps(doc).encode(),
+                            )
                     return getattr(self, "h_" + name)(match, query, headers, body)
             return 404, "text/plain", b"not found"
         except AggregatorError as e:
@@ -238,6 +256,20 @@ class DapHttpApp:
         # helper endpoint: the provisioning peer is the leader
         ta = self.agg.task_aggregator_for(task_id, taskprov_config, headers, peer_role=Role.LEADER)
         self._check_helper_auth(ta, task_id, headers, taskprov_config)
+        # XOF framing-version check: a leader/helper xof_mode mismatch
+        # would otherwise silently reject every report (the two framings
+        # produce disjoint pseudorandom streams, SECURITY-NOTES.md).
+        # The leader advertises its mode; tolerate absence so a
+        # spec-conformant non-janus leader can pair with a draft-mode
+        # task.
+        sent_mode = {k.lower(): v for k, v in headers.items()}.get(XOF_MODE_HEADER)
+        task_mode = ta.task.vdaf.xof_mode
+        if sent_mode is not None and sent_mode != task_mode:
+            raise InvalidMessage(
+                f"XOF framing mismatch: peer uses {sent_mode!r}, task is "
+                f"{task_mode!r} — aggregators must deploy the same mode",
+                task_id,
+            )
         req = AggregationJobInitializeReq.from_bytes(body)
         resp = ta.handle_aggregate_init(self.agg.ds, self.agg.clock, job_id, req, body)
         return 200, "application/dap-aggregation-job-resp", resp.to_bytes()
@@ -322,13 +354,14 @@ class DapServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(out)))
-                # CORS: browser clients/collectors (reference
-                # http_handlers.rs:236-259 wraps these routes in
-                # trillium_api CORS preflight handlers)
-                self.send_header("Access-Control-Allow-Origin", "*")
-                if method == "OPTIONS":
-                    allow = _cors_allow(urlsplit(self.path).path)
-                    if allow is not None:
+                # CORS only on browser-reachable routes (reference
+                # http_handlers.rs:236-259 scopes CORS to hpke_config,
+                # upload, and collection_jobs; aggregator-to-aggregator
+                # endpoints get none)
+                allow = _cors_allow(urlsplit(self.path).path)
+                if allow is not None:
+                    self.send_header("Access-Control-Allow-Origin", "*")
+                    if method == "OPTIONS":
                         self.send_header("Access-Control-Allow-Methods", allow)
                         self.send_header(
                             "Access-Control-Allow-Headers",

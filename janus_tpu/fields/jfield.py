@@ -380,15 +380,21 @@ def fconst(jf, value: int, shape=()):
 
 
 def fpow_const(jf, x, e: int):
-    """x^e for a host-known exponent via square-and-multiply (unrolled)."""
+    """x^e for a host-known exponent via square-and-multiply (unrolled).
+
+    Each squaring is barriered: for inversion-sized exponents (finv,
+    e = p-2) the chain is 64/128 muls deep and XLA's fusion otherwise
+    re-inlines the whole producer chain into every consumer — compile
+    time explodes from seconds to unbounded (observed on the Lagrange
+    query path before the barriers)."""
     result = None
     base = x
     while e:
         if e & 1:
-            result = base if result is None else jf.mul(result, base)
+            result = base if result is None else anti_recompute_barrier(jf.mul(result, base))
         e >>= 1
         if e:
-            base = jf.mul(base, base)
+            base = anti_recompute_barrier(jf.mul(base, base))
     if result is None:
         return fconst(jf, 1, fshape(x))
     return result

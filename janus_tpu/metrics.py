@@ -129,6 +129,9 @@ REGISTRY = MetricsRegistry()
 upload_decrypt_failure_counter = REGISTRY.counter(
     "janus_upload_decrypt_failures", "reports which failed HPKE decryption at upload"
 )
+upload_replay_counter = REGISTRY.counter(
+    "janus_upload_replayed_reports", "Duplicate report uploads ignored"
+)
 upload_decode_failure_counter = REGISTRY.counter(
     "janus_upload_decode_failures", "reports which failed decoding at upload"
 )

@@ -125,6 +125,25 @@ def intt_batched(jf, evals):
     return _transform(jf, evals, n, inverse=True)
 
 
+def lagrange_eval_weights(jf, t_powers, m: int):
+    """L_k(t) for the m-point root-of-unity domain {α^0..α^{m-1}}:
+    the weights such that a polynomial interpolated from domain values
+    v_k evaluates at t as Σ_k v_k·L_k(t).
+
+    Closed form: L_k(t) = α^k·(t^m−1)/(m·(t−α^k)) — and since
+    (t^m−1)/(t−α^k) = Σ_i α^{k(m-1-i)} t^i, this collapses to
+    L_k(t) = (1/m)·Σ_i α^{-ki}·t^i, i.e. **the inverse NTT of t's
+    power vector** [t^0..t^{m-1}]. One batched log-depth transform, no
+    per-element field inversions (an explicit 1/(t−α^k) formulation
+    compiled pathologically on XLA CPU). Identical field elements to
+    the host oracle's intt-then-Horner (differential-tested).
+
+    t_powers: field value [..., >=m] of powers of t. Returns [..., m].
+    """
+    pw_m = fmap(lambda x: x[..., :m], t_powers)
+    return intt_batched(jf, pw_m)
+
+
 def powers(jf, x, n: int):
     """[x^0, x^1, ..., x^{n-1}] along a new trailing axis, log-depth.
 

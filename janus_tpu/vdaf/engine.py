@@ -39,7 +39,13 @@ from ..fields.jfield import (
     is_zero,
     anti_recompute_barrier,
 )
-from ..ops.ntt import intt_batched, ntt_batched, poly_eval_powers, powers
+from ..ops.ntt import (
+    intt_batched,
+    lagrange_eval_weights,
+    ntt_batched,
+    poly_eval_powers,
+    powers,
+)
 from .reference import (
     EVAL_POINT_CANDIDATES,
     Circuit,
@@ -377,7 +383,8 @@ def flp_prove_batched(bc: BatchedCircuit, inp, prove_rand, joint_rand):
 
 
 def _pick_eval_point(jf, cands, m: int):
-    """First candidate t (of EVAL_POINT_CANDIDATES) with t^m != 1."""
+    """First candidate t (of EVAL_POINT_CANDIDATES) with t^m != 1
+    (branch-free draw; bound analysis SECURITY-NOTES.md #4)."""
     tm = fpow_const(jf, cands, m)  # [batch, 4]
     ok = ~is_zero(jf.sub(tm, fconst(jf, 1, tm[0].shape)))
     idx = jnp.argmax(ok, axis=-1)  # first True (0 if none; prob ~2^-128)
@@ -406,11 +413,23 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
     gevals = ntt_batched(jf, gfold, bc.m)  # values at alpha^0..alpha^{m-1}
     outs = fmap(lambda x: x[..., 1 : bc.calls + 1], gevals)
 
-    # wire polys from proof-share seeds; evaluate everything at t
-    wp = anti_recompute_barrier(_wire_polys(bc, seeds, ci))  # [batch, arity, m]
-    pw = anti_recompute_barrier(powers(jf, t, max(bc.m, bc.gp_len)))  # [batch, >=m]
-    pw_b = fmap(lambda x: x[:, None, :], pw)
-    wire_t = poly_eval_powers(jf, wp, pw_b)  # [batch, arity]
+    # Wire polys evaluated at t WITHOUT interpolating coefficients:
+    # wire j's domain values are [seed_j, ci[*, j], 0...], so
+    # wire_j(t) = seed_j*L_0(t) + sum_i ci[i, j]*L_{i+1}(t) with L the
+    # Lagrange basis at t (= iNTT of t's powers, ops/ntt.py). Skips the
+    # [batch, arity, m] wire-poly iNTT the host oracle does
+    # (reference.flp_query:694-699); same field elements, and the peak
+    # tensor drops from [batch, arity, m] to the [batch, calls, arity]
+    # inputs — the len=100k memory win.
+    pw = anti_recompute_barrier(powers(jf, t, bc.gp_len))
+    L = anti_recompute_barrier(lagrange_eval_weights(jf, pw, bc.m))
+    L0 = fmap(lambda x: x[:, 0], L)
+    Lc = fmap(lambda x: x[:, 1 : 1 + bc.calls], L)
+    prod = jf.mul(ci, fmap(lambda x: x[:, :, None], Lc))  # [batch, calls, arity]
+    wire_t = jf.add(
+        fsum(jf, prod, axis=1),
+        jf.mul(seeds, fmap(lambda x: x[:, None], L0)),
+    )  # [batch, arity]
     proof_t = poly_eval_powers(jf, gcoeffs, pw)  # [batch]
 
     v = bc.finish(inp_share, joint_rand, outs, shares_inv)  # [batch]

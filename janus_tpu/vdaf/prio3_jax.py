@@ -116,6 +116,13 @@ class Prio3Batched:
         from .xof import INLINE_BINDER_MAX, TREE_DIGEST_SIZE
 
         if binder_len > INLINE_BINDER_MAX:
+            # Restricted to joint-rand-part: SECURITY-NOTES.md #2.
+            # Explicit raise so the boundary survives python -O.
+            if usage != USAGE_JOINT_RAND_PART:
+                raise ValueError(
+                    f"tree-digest substitution restricted to joint-rand-part "
+                    f"(SECURITY-NOTES.md #2); got usage {usage}"
+                )
             digest = tree_digest_lanes(binder_parts, binder_len, batch)
             binder_parts = [(0, digest)]
             binder_len = TREE_DIGEST_SIZE

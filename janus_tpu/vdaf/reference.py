@@ -26,6 +26,8 @@ rather than the expanded share encoding; the seed uniquely determines
 the share, so binding is preserved while keeping hashing O(1) per
 report. The reference's hot loop pays the full hash on CPU
 (aggregator/src/aggregator.rs:1633-1797 does all of this per report).
+Full analysis: SECURITY-NOTES.md #3 (seed binder), #4 (fixed
+4-candidate eval point).
 """
 
 from __future__ import annotations
@@ -44,6 +46,8 @@ from .xof import (
     USAGE_PROVE_RANDOMNESS,
     USAGE_QUERY_RANDOMNESS,
     XofShake128,
+    XofSponge128,
+    draft_dst,
     dst,
 )
 
@@ -757,15 +761,40 @@ class PrepShare:
 
 
 class Prio3:
+    """Host Prio3 for one circuit.
+
+    mode selects the XOF framing (per-task `xof_mode`):
+      - "fast": counter-mode XofCtr128 with the TPU framing
+        (SECURITY-NOTES.md #1-#5) — the intra-deployment default.
+      - "draft": sequential-sponge XofSponge128 + rejection sampling +
+        8-byte draft DSTs + single-byte aggregator ids + full-share
+        joint-rand binders, following the VDAF-07 construction the
+        reference's prio dependency implements (conformance caveat in
+        XofSponge128's docstring). Host-only: prio3_batched refuses
+        draft-mode instances.
+    """
+
     NUM_SHARES = 2
     ROUNDS = 1
 
-    def __init__(self, circuit: Circuit):
+    def __init__(self, circuit: Circuit, mode: str = "fast"):
+        assert mode in ("fast", "draft")
         self.circuit = circuit
+        self.mode = mode
+        self.xof = XofShake128 if mode == "fast" else XofSponge128
 
     # --- domain separation ---
     def _dst(self, usage: int) -> bytes:
+        if self.mode == "draft":
+            return draft_dst(self.circuit.algo_id, usage)
         return dst(self.circuit.algo_id, usage)
+
+    def _agg_id_bytes(self, agg_id: int) -> bytes:
+        # fast mode keeps ids lane-aligned (8-byte LE); draft uses the
+        # draft's single byte
+        if self.mode == "draft":
+            return bytes([agg_id])
+        return agg_id.to_bytes(8, "little")
 
     @property
     def uses_joint_rand(self) -> bool:
@@ -790,24 +819,28 @@ class Prio3:
         blinds = seeds[2:] if self.uses_joint_rand else [None, None]
 
         inp = circ.encode(measurement)
-        helper_meas = self._expand(helper_seed, USAGE_MEASUREMENT_SHARE, AGG1, circ.input_len)
+        agg1 = self._agg_id_bytes(1)
+        helper_meas = self._expand(helper_seed, USAGE_MEASUREMENT_SHARE, agg1, circ.input_len)
         leader_meas = [F.sub(x, h) for x, h in zip(inp, helper_meas)]
 
         joint_rand: list[int] = []
         parts: list[bytes] = []
         if self.uses_joint_rand:
+            # fast mode binds the helper's 16-byte seed (SECURITY-NOTES.md
+            # #3); draft mode binds the full expanded share per the spec
+            helper_binder = (
+                helper_seed if self.mode == "fast" else self._encode_vec(helper_meas)
+            )
             parts = [
                 self._joint_rand_part(0, blinds[0], nonce, self._encode_vec(leader_meas)),
-                self._joint_rand_part(1, blinds[1], nonce, helper_seed),
+                self._joint_rand_part(1, blinds[1], nonce, helper_binder),
             ]
             jr_seed = self._joint_rand_seed(parts)
-            joint_rand = prng_next_vec(F, jr_seed, self._dst(USAGE_JOINT_RANDOMNESS), b"", circ.joint_rand_len)
+            joint_rand = self._next_vec(jr_seed, USAGE_JOINT_RANDOMNESS, b"", circ.joint_rand_len)
 
-        prove_rand = prng_next_vec(
-            F, prove_seed, self._dst(USAGE_PROVE_RANDOMNESS), b"", circ.prove_rand_len
-        )
+        prove_rand = self._next_vec(prove_seed, USAGE_PROVE_RANDOMNESS, b"", circ.prove_rand_len)
         proof = flp_prove(circ, inp, prove_rand, joint_rand)
-        helper_proof = self._expand(helper_seed, USAGE_PROOF_SHARE, AGG1, circ.proof_len)
+        helper_proof = self._expand(helper_seed, USAGE_PROOF_SHARE, agg1, circ.proof_len)
         leader_proof = [F.sub(x, h) for x, h in zip(proof, helper_proof)]
 
         public_share = parts if self.uses_joint_rand else []
@@ -829,10 +862,14 @@ class Prio3:
         circ = self.circuit
         F = circ.FIELD
         if isinstance(input_share, HelperShare):
-            meas = self._expand(input_share.seed, USAGE_MEASUREMENT_SHARE, AGG1, circ.input_len)
-            proof = self._expand(input_share.seed, USAGE_PROOF_SHARE, AGG1, circ.proof_len)
+            agg1 = self._agg_id_bytes(1)
+            meas = self._expand(input_share.seed, USAGE_MEASUREMENT_SHARE, agg1, circ.input_len)
+            proof = self._expand(input_share.seed, USAGE_PROOF_SHARE, agg1, circ.proof_len)
             blind = input_share.joint_rand_blind
-            part_binder = input_share.seed
+            # seed binder is the fast-mode shortcut (SECURITY-NOTES.md #3)
+            part_binder = (
+                input_share.seed if self.mode == "fast" else self._encode_vec(meas)
+            )
         else:
             meas = input_share.measurement_share
             proof = input_share.proof_share
@@ -847,12 +884,12 @@ class Prio3:
             parts = list(public_share)
             parts[agg_id] = own_part
             corrected_seed = self._joint_rand_seed(parts)
-            joint_rand = prng_next_vec(
-                F, corrected_seed, self._dst(USAGE_JOINT_RANDOMNESS), b"", circ.joint_rand_len
+            joint_rand = self._next_vec(
+                corrected_seed, USAGE_JOINT_RANDOMNESS, b"", circ.joint_rand_len
             )
 
-        query_rand = prng_next_vec(
-            F, verify_key, self._dst(USAGE_QUERY_RANDOMNESS), nonce, circ.query_rand_len
+        query_rand = self._next_vec(
+            verify_key, USAGE_QUERY_RANDOMNESS, nonce, circ.query_rand_len
         )
         verifier_share = flp_query(circ, meas, proof, query_rand, joint_rand, self.NUM_SHARES)
         state = PrepState(circ.truncate(meas), corrected_seed)
@@ -893,16 +930,24 @@ class Prio3:
         return self.circuit.decode(agg, num_measurements)
 
     # --- internals ---
+    def _next_vec(self, seed: bytes, usage: int, binder: bytes, length: int) -> list[int]:
+        F = self.circuit.FIELD
+        if self.mode == "fast":
+            return prng_next_vec(F, seed, self._dst(usage), binder, length)
+        return XofSponge128(seed, self._dst(usage), binder).next_vec(F, length)
+
     def _expand(self, seed: bytes, usage: int, binder: bytes, length: int) -> list[int]:
-        return prng_next_vec(self.circuit.FIELD, seed, self._dst(usage), binder, length)
+        return self._next_vec(seed, usage, binder, length)
 
     def _joint_rand_part(self, agg_id: int, blind: bytes, nonce: bytes, share_binder: bytes) -> bytes:
-        return XofShake128.derive_seed(
-            blind, self._dst(USAGE_JOINT_RAND_PART), agg_id.to_bytes(8, "little") + nonce + share_binder
+        return self.xof.derive_seed(
+            blind,
+            self._dst(USAGE_JOINT_RAND_PART),
+            self._agg_id_bytes(agg_id) + nonce + share_binder,
         )
 
     def _joint_rand_seed(self, parts: list[bytes]) -> bytes:
-        return XofShake128.derive_seed(
+        return self.xof.derive_seed(
             b"\x00" * SEED_SIZE, self._dst(USAGE_JOINT_RAND_SEED), b"".join(parts)
         )
 

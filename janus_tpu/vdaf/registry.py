@@ -34,6 +34,13 @@ class VdafInstance:
     bits: int = 0
     length: int = 0
     chunk_length: int = 0  # 0 -> sqrt heuristic (core/src/task.rs:84-86)
+    # XOF framing mode: "fast" = TPU counter-mode framing (default;
+    # SECURITY-NOTES.md), "draft" = VDAF-07 sequential-sponge framing
+    # (host-only, for spec conformance / cross-implementation pairing).
+    # Part of the instance identity: both aggregators of a task must
+    # agree or every report fails verification — the aggregation-job
+    # framing check makes that mismatch fail loudly.
+    xof_mode: str = "fast"
 
     # --- constructors mirroring the reference enum variants ---
     @classmethod
@@ -110,6 +117,8 @@ class VdafInstance:
         for k in ("bits", "length", "chunk_length"):
             if getattr(self, k):
                 d[k] = getattr(self, k)
+        if self.xof_mode != "fast":
+            d["xof_mode"] = self.xof_mode
         return d
 
     @classmethod
@@ -119,11 +128,13 @@ class VdafInstance:
             bits=d.get("bits", 0),
             length=d.get("length", 0),
             chunk_length=d.get("chunk_length", 0),
+            xof_mode=d.get("xof_mode", "fast"),
         )
 
 
 @lru_cache(maxsize=None)
 def circuit_for(inst: VdafInstance) -> Circuit:
+    assert inst.xof_mode in ("fast", "draft"), inst.xof_mode
     ch = inst.chunk_length or None
     if inst.kind == "count":
         return Count()
@@ -151,7 +162,7 @@ def circuit_for(inst: VdafInstance) -> Circuit:
 @lru_cache(maxsize=None)
 def prio3_host(inst: VdafInstance) -> Prio3:
     """Host (scalar) implementation: clients, tools, oracles."""
-    return Prio3(circuit_for(inst))
+    return Prio3(circuit_for(inst), mode=inst.xof_mode)
 
 
 @lru_cache(maxsize=None)
@@ -159,8 +170,14 @@ def prio3_batched(inst: VdafInstance) -> Prio3Batched:
     """Device (batched) implementation: the aggregator hot path.
 
     Cached so repeated dispatch returns the identical instance and jit
-    caches keyed on it never recompile.
+    caches keyed on it never recompile. Fast-framing only: draft-mode
+    tasks run the host engine (aggregator.engine_cache dispatches).
     """
+    if inst.xof_mode != "fast":
+        raise ValueError(
+            "prio3_batched supports xof_mode=fast only; draft-mode tasks "
+            "run the host engine"
+        )
     return Prio3Batched(circuit_for(inst))
 
 

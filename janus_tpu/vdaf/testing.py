@@ -34,6 +34,79 @@ def random_measurements(inst: VdafInstance, batch: int, rng: np.random.Generator
     raise ValueError(inst.kind)
 
 
+def make_wire_reports(
+    inst: VdafInstance,
+    measurements,
+    task_id,
+    leader_hpke_config,
+    helper_hpke_config,
+    time,
+    seed: int = 0,
+):
+    """Device-shard a batch and assemble full DAP Report messages.
+
+    A batched client: sharding runs on device (one traced computation
+    for the whole batch), then each report is HPKE-sealed and framed
+    exactly as client.Client.prepare_report does per report
+    (reference client/src/lib.rs:212-260). Used by load generators and
+    the served-mode bench.
+    """
+    from ..core.hpke import HpkeApplicationInfo, Label, hpke_seal
+    from ..messages import (
+        InputShareAad,
+        PlaintextInputShare,
+        Report,
+        ReportId,
+        ReportMetadata,
+        Role,
+    )
+    from .registry import circuit_for
+    from .wire import Prio3Wire, encode_field_rows
+
+    p3 = prio3_batched(inst)
+    wire = Prio3Wire(circuit_for(inst))
+    args, _ = make_report_batch(inst, measurements, seed=seed)
+    nonce_lanes, public_parts, leader_meas, leader_proof, blind0, helper_seed, blind1 = args
+    n = nonce_lanes.shape[0]
+    meas_rows = encode_field_rows(p3.jf, leader_meas)
+    proof_rows = encode_field_rows(p3.jf, leader_proof)
+    seed_rows = [r.tobytes() for r in np.asarray(helper_seed, dtype="<u8")]
+    if p3.uses_joint_rand:
+        blind0_rows = [r.tobytes() for r in np.asarray(blind0, dtype="<u8")]
+        blind1_rows = [r.tobytes() for r in np.asarray(blind1, dtype="<u8")]
+        pp = np.asarray(public_parts, dtype="<u8")
+        part_rows = [(pp[i, 0].tobytes(), pp[i, 1].tobytes()) for i in range(n)]
+    reports = []
+    for i in range(n):
+        report_id = ReportId(nonce_lanes[i].astype("<u8").tobytes())
+        metadata = ReportMetadata(report_id, time)
+        if p3.uses_joint_rand:
+            public_share = wire.encode_public_share(list(part_rows[i]))
+            leader_payload = wire.encode_leader_share_raw(
+                meas_rows[i] + proof_rows[i], blind0_rows[i]
+            )
+            helper_payload = wire.encode_helper_share(seed_rows[i], blind1_rows[i])
+        else:
+            public_share = b""
+            leader_payload = meas_rows[i] + proof_rows[i]
+            helper_payload = wire.encode_helper_share(seed_rows[i], None)
+        aad = InputShareAad(task_id, metadata, public_share).to_bytes()
+        leader_ct = hpke_seal(
+            leader_hpke_config,
+            HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+            PlaintextInputShare((), leader_payload).to_bytes(),
+            aad,
+        )
+        helper_ct = hpke_seal(
+            helper_hpke_config,
+            HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
+            PlaintextInputShare((), helper_payload).to_bytes(),
+            aad,
+        )
+        reports.append(Report(metadata, public_share, leader_ct, helper_ct))
+    return reports
+
+
 def make_report_batch(inst: VdafInstance, measurements, seed: int = 0):
     """Shard a batch of measurements on device.
 

@@ -178,6 +178,10 @@ class Prio3Wire:
             out += blind
         return out
 
+    def encode_leader_share_raw(self, encoded_meas_proof: bytes, blind: bytes | None) -> bytes:
+        """Column path: meas||proof row already encoded (encode_field_rows)."""
+        return encoded_meas_proof + (blind if self.uses_jr else b"")
+
     def decode_leader_share(self, raw: bytes) -> tuple[list[int], list[int], bytes | None]:
         F = self.circ.FIELD
         n = self.circ.input_len * self.enc_size

@@ -41,6 +41,10 @@ carried as 8-byte little-endian words), so every field of every
 message is u64-lane-aligned and the batched device Keccak packs
 messages as uint64 lane arrays with no byte-straddling shifts.
 
+Security analysis of every deviation here (claim, bound, what to
+attack): SECURITY-NOTES.md #1 (counter mode), #2 (tree digest),
+#5 (oversample-and-reduce).
+
 Field-element sampling is **oversample-and-reduce** (the RFC 9380
 hash-to-field construction, not the VDAF draft's rejection sampling):
 element i consumes (LIMBS+1) 8-byte little-endian lanes — 128 random
@@ -138,6 +142,17 @@ class XofCtr128:
         assert len(seed) == SEED_SIZE
         assert len(dst_) <= DST_SIZE
         if len(binder) > INLINE_BINDER_MAX:
+            # The 16-byte digest's ~2^64 collision bound is only argued
+            # safe for the joint-rand-part usage (SECURITY-NOTES.md #2);
+            # any new long-binder usage must be analyzed, not inherited.
+            # Explicit raise, not assert: a security boundary must
+            # survive python -O.
+            usage = int.from_bytes(dst_[6:8], "big")
+            if usage != USAGE_JOINT_RAND_PART:
+                raise ValueError(
+                    f"tree-digest substitution restricted to joint-rand-part "
+                    f"(SECURITY-NOTES.md #2); got usage {usage}"
+                )
             binder = tree_digest(binder)
         self._prefix = dst_.ljust(DST_SIZE, b"\x00") + seed + binder
         assert len(self._prefix) + 8 <= RATE - 1  # always one absorb block
@@ -171,6 +186,78 @@ class XofCtr128:
 # The class named for what the stream is derived from; modules that
 # predate the counter-mode rename import this alias.
 XofShake128 = XofCtr128
+
+
+DRAFT_VERSION = 7
+
+
+def draft_dst(algo_id: int, usage: int) -> bytes:
+    """VDAF-07-style 8-byte domain-separation tag:
+    version || class || algo id (u32be) || usage (u16be)."""
+    return (
+        bytes([DRAFT_VERSION, ALGO_CLASS_VDAF])
+        + algo_id.to_bytes(4, "big")
+        + usage.to_bytes(2, "big")
+    )
+
+
+class XofSponge128:
+    """Sequential-sponge SHAKE128 XOF with rejection sampling — the
+    VDAF-07 XofShake128 construction (`xof_mode: draft`).
+
+    Framing: absorb ``byte(len(dst)) || dst || seed || binder``, squeeze
+    the output stream sequentially. Field elements are rejection-sampled
+    from ENCODED_SIZE-byte little-endian chunks (resample on >= p), per
+    the draft — none of the fast-mode deviations (SECURITY-NOTES.md
+    #1/#2/#5) apply here.
+
+    Conformance status: this follows the draft-irtf-cfrg-vdaf-07
+    construction as implemented by the reference's prio 0.15 dependency
+    (Cargo.lock:2939); byte-exactness against the published test
+    vectors is NOT verified in this build environment (no network
+    access — see tests/test_vdaf_vectors.py, which consumes the
+    official JSON vector format when vectors are provided).
+    """
+
+    SEED_SIZE = SEED_SIZE
+
+    def __init__(self, seed: bytes, dst_: bytes, binder: bytes = b""):
+        assert len(seed) == SEED_SIZE
+        self._absorbed = bytes([len(dst_)]) + dst_ + seed + binder
+        self._off = 0
+        self._squeezed = b""
+
+    def next(self, n: int) -> bytes:
+        # Sequential squeezing of one sponge == successive bytes of a
+        # single arbitrary-length SHAKE128 output. hashlib can't extend
+        # a digest incrementally, so re-digest with doubling lengths
+        # (amortized O(total), not O(total^2)).
+        end = self._off + n
+        if end > len(self._squeezed):
+            self._squeezed = hashlib.shake_128(self._absorbed).digest(
+                max(end, 2 * len(self._squeezed), 256)
+            )
+        chunk = self._squeezed[self._off : end]
+        self._off = end
+        return chunk
+
+    def next_vec(self, field, length: int) -> list[int]:
+        size = field.ENCODED_SIZE
+        p = field.MODULUS
+        out: list[int] = []
+        while len(out) < length:
+            # bulk-read for the common all-accepted case
+            want = length - len(out)
+            buf = self.next(size * want)
+            for i in range(want):
+                x = int.from_bytes(buf[i * size : (i + 1) * size], "little")
+                if x < p:
+                    out.append(x)
+        return out
+
+    @classmethod
+    def derive_seed(cls, seed: bytes, dst_: bytes, binder: bytes = b"") -> bytes:
+        return cls(seed, dst_, binder).next(SEED_SIZE)
 
 
 def prng_expand(field, seed: bytes, dst_: bytes, binder: bytes, length: int):

@@ -2,6 +2,7 @@
 (reference aggregator/src/aggregator/http_handlers.rs:236-259 CORS
 wrappers, :512-551 media-type extraction)."""
 
+import urllib.error
 import urllib.request
 
 from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
@@ -28,15 +29,45 @@ def test_options_preflight_routes():
 
 
 def test_wrong_media_type_rejected():
+    # exact-match media type, 400 problem document (reference
+    # http_handlers.rs validate_content_type answers 400 BadRequest)
     app = DapHttpApp(_NoAgg())
-    status, _, _ = app.handle(
+    status, ctype, body = app.handle(
         "PUT",
         "/tasks/x/reports",
         {},
         {"Content-Type": "application/json"},
         b"body",
     )
-    assert status == 415
+    assert status == 400
+    assert ctype == "application/problem+json"
+    # media-type parameters are NOT tolerated (exact match)
+    status, _, _ = app.handle(
+        "PUT",
+        "/tasks/x/reports",
+        {},
+        {"Content-Type": "application/dap-report; charset=utf-8"},
+        b"body",
+    )
+    assert status == 400
+
+
+def test_no_cors_headers_on_aggregator_routes():
+    # ACAO must not leak onto aggregator-to-aggregator endpoints
+    # (reference scopes CORS to hpke_config/upload/collection_jobs)
+    app = DapHttpApp(_NoAgg())
+    srv = DapServer(app).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "tasks/x/aggregation_jobs/y", method="PUT", data=b""
+        )
+        try:
+            resp = urllib.request.urlopen(req)
+        except urllib.error.HTTPError as e:
+            resp = e
+        assert resp.headers.get("Access-Control-Allow-Origin") is None
+    finally:
+        srv.stop()
 
 
 def test_cors_headers_on_server():

@@ -115,10 +115,14 @@ def test_tree_digest_matches_host():
 
 
 def test_long_binder_derive_matches_host():
-    # derive_seed with binder > INLINE_BINDER_MAX goes through the tree
-    from janus_tpu.vdaf.xof import INLINE_BINDER_MAX, XofCtr128
+    # derive_seed with binder > INLINE_BINDER_MAX goes through the tree.
+    # Only the joint-rand-part usage may take the digest substitution
+    # (SECURITY-NOTES.md #2); any other usage asserts.
+    import pytest
 
-    d = dst(0x42, USAGE_MEASUREMENT_SHARE)
+    from janus_tpu.vdaf.xof import INLINE_BINDER_MAX, USAGE_JOINT_RAND_PART, XofCtr128
+
+    d = dst(0x42, USAGE_JOINT_RAND_PART)
     seed = bytes(range(16))
     binder = bytes(range(256))  # > 112, lane-aligned
     assert len(binder) > INLINE_BINDER_MAX
@@ -127,6 +131,9 @@ def test_long_binder_derive_matches_host():
     from janus_tpu.vdaf.xof import tree_digest
 
     assert out == XofCtr128.derive_seed(seed, d, tree_digest(binder))
+
+    with pytest.raises(ValueError, match="joint-rand-part"):
+        XofCtr128.derive_seed(seed, dst(0x42, USAGE_MEASUREMENT_SHARE), binder)
 
 
 def test_reduction_sampling_semantics():

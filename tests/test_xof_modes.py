@@ -1,0 +1,180 @@
+"""Per-task XOF framing modes: fast (TPU counter-mode) vs draft
+(VDAF-07 sequential sponge + rejection sampling).
+
+The draft mode removes every fast-mode deviation (SECURITY-NOTES.md):
+sequential squeezing, 8-byte draft DSTs, single-byte aggregator ids,
+full-share joint-rand binders, rejection sampling. Host-only; the
+aggregator dispatches draft tasks to HostEngineCache.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from janus_tpu.fields.field import Field64, Field128
+from janus_tpu.vdaf.registry import VdafInstance, prio3_batched, prio3_host
+from janus_tpu.vdaf.xof import XofSponge128, draft_dst
+
+VK = bytes(range(16))
+
+
+def test_sponge_sequential_squeeze_matches_one_shot():
+    x = XofSponge128(b"\x01" * 16, draft_dst(1, 2), b"binder")
+    a = x.next(5) + x.next(11) + x.next(170)
+    y = XofSponge128(b"\x01" * 16, draft_dst(1, 2), b"binder")
+    assert a == y.next(186)
+    # and equals the raw SHAKE128 of the absorbed framing
+    absorbed = bytes([8]) + draft_dst(1, 2) + b"\x01" * 16 + b"binder"
+    assert a == hashlib.shake_128(absorbed).digest(186)
+
+
+def test_sponge_rejection_sampling_in_range():
+    for field in (Field64, Field128):
+        v = XofSponge128(b"\x02" * 16, draft_dst(3, 4)).next_vec(field, 300)
+        assert len(v) == 300
+        assert all(0 <= x < field.MODULUS for x in v)
+        # deterministic
+        v2 = XofSponge128(b"\x02" * 16, draft_dst(3, 4)).next_vec(field, 300)
+        assert v == v2
+
+
+def test_draft_dst_layout():
+    d = draft_dst(0x01020304, 0x0506)
+    assert len(d) == 8
+    assert d == bytes([7, 0]) + b"\x01\x02\x03\x04" + b"\x05\x06"
+
+
+def _round_trip(inst: VdafInstance, measurements):
+    """Full two-party host transcript; returns the aggregate."""
+    host = prio3_host(inst)
+    out_shares = [[], []]
+    for k, m in enumerate(measurements):
+        nonce = bytes([k]) * 16
+        public, (ls, hs) = host.shard(m, nonce)
+        st0, ps0 = host.prepare_init(VK, 0, nonce, public, ls)
+        st1, ps1 = host.prepare_init(VK, 1, nonce, public, hs)
+        msg = host.prepare_shares_to_prep([ps0, ps1])
+        out_shares[0].append(host.prepare_next(st0, msg))
+        out_shares[1].append(host.prepare_next(st1, msg))
+    aggs = [host.aggregate(s) for s in out_shares]
+    return host.unshard(aggs, len(measurements))
+
+
+@pytest.mark.parametrize(
+    "inst,meas,want",
+    [
+        (VdafInstance("count", xof_mode="draft"), [1, 0, 1], 2),
+        (VdafInstance("sum", bits=8, xof_mode="draft"), [3, 200, 17], 220),
+        (
+            VdafInstance("sumvec", bits=4, length=5, xof_mode="draft"),
+            [[1, 2, 3, 4, 5], [5, 4, 3, 2, 1]],
+            [6, 6, 6, 6, 6],
+        ),
+        (
+            VdafInstance("histogram", length=4, xof_mode="draft"),
+            [0, 3, 3],
+            [1, 0, 0, 2],
+        ),
+    ],
+)
+def test_draft_mode_round_trip(inst, meas, want):
+    assert _round_trip(inst, meas) == want
+
+
+def test_modes_produce_disjoint_transcripts():
+    """The same (measurement, nonce, rand) shards to different bytes per
+    mode, and a cross-mode pair rejects the report."""
+    fast = prio3_host(VdafInstance("sum", bits=8))
+    draft = prio3_host(VdafInstance("sum", bits=8, xof_mode="draft"))
+    rand = bytes(range(fast.rand_size))
+    nonce = b"\x07" * 16
+    pub_f, (ls_f, hs_f) = fast.shard(9, nonce, rand)
+    pub_d, (ls_d, hs_d) = draft.shard(9, nonce, rand)
+    assert ls_f.measurement_share != ls_d.measurement_share
+
+    # fast-sharded report, helper running draft framing: FLP rejects
+    from janus_tpu.vdaf.reference import VdafError
+
+    st0, ps0 = fast.prepare_init(VK, 0, nonce, pub_f, ls_f)
+    st1, ps1 = draft.prepare_init(VK, 1, nonce, pub_f, hs_f)
+    with pytest.raises(VdafError):
+        draft.prepare_shares_to_prep([ps0, ps1])
+
+
+def test_batched_engine_refuses_draft_mode():
+    with pytest.raises(ValueError):
+        prio3_batched(VdafInstance("count", xof_mode="draft"))
+
+
+def test_engine_cache_dispatches_host_engine():
+    from janus_tpu.aggregator.engine_cache import (
+        EngineCache,
+        HostEngineCache,
+        engine_cache,
+    )
+
+    fast = engine_cache(VdafInstance("count"), VK)
+    draft = engine_cache(VdafInstance("count", xof_mode="draft"), VK)
+    assert isinstance(fast, EngineCache)
+    assert isinstance(draft, HostEngineCache)
+
+
+def test_host_engine_matches_host_transcript():
+    """HostEngineCache's columnar surface reproduces the scalar host
+    protocol end to end (leader init -> helper init -> aggregate)."""
+    from janus_tpu.aggregator.engine_cache import HostEngineCache
+    from janus_tpu.vdaf.wire import (
+        decode_field_rows,
+        seeds_to_lanes,
+    )
+
+    inst = VdafInstance("sumvec", bits=2, length=3, xof_mode="draft")
+    host = prio3_host(inst)
+    eng = HostEngineCache(inst, VK)
+    meas = [[1, 2, 3], [3, 2, 1], [0, 1, 2]]
+    n = len(meas)
+
+    nonces, meas_rows, proof_rows, blind_rows, p0_rows, p1_rows = [], [], [], [], [], []
+    helper_seed_rows, helper_blind_rows = [], []
+    F = host.circuit.FIELD
+    for k, m in enumerate(meas):
+        nonce = bytes([k + 1]) * 16
+        public, (ls, hs) = host.shard(m, nonce)
+        nonces.append(nonce)
+        meas_rows.append(F.encode_vec(ls.measurement_share))
+        proof_rows.append(F.encode_vec(ls.proof_share))
+        blind_rows.append(ls.joint_rand_blind)
+        p0_rows.append(public[0])
+        p1_rows.append(public[1])
+        helper_seed_rows.append(hs.seed)
+        helper_blind_rows.append(hs.joint_rand_blind)
+
+    nonce_lanes, _ = seeds_to_lanes(nonces)
+    meas_l, ok_m = decode_field_rows(eng.jf, meas_rows, host.circuit.input_len)
+    proof_l, ok_p = decode_field_rows(eng.jf, proof_rows, host.circuit.proof_len)
+    assert ok_m.all() and ok_p.all()
+    blind_lanes, _ = seeds_to_lanes(blind_rows)
+    p0, _ = seeds_to_lanes(p0_rows)
+    p1, _ = seeds_to_lanes(p1_rows)
+    public_parts = np.stack([p0, p1], axis=1)
+
+    out0, seed0, ver0, part0 = eng.leader_init(
+        nonce_lanes, public_parts, meas_l, proof_l, blind_lanes
+    )
+
+    hseed_lanes, _ = seeds_to_lanes(helper_seed_rows)
+    hblind_lanes, _ = seeds_to_lanes(helper_blind_rows)
+    ok = np.ones(n, dtype=bool)
+    out1, accept, prep_msg = eng.helper_init(
+        nonce_lanes, public_parts, hseed_lanes, hblind_lanes, ver0, part0, ok
+    )
+    assert accept.all()
+    # leader's corrected seed equals the helper-computed prep message
+    assert np.array_equal(seed0, prep_msg)
+
+    agg0 = eng.aggregate(out0, accept)
+    agg1 = eng.aggregate(out1, accept)
+    total = [(a + b) % F.MODULUS for a, b in zip(agg0, agg1)]
+    want = [sum(col) for col in zip(*meas)]
+    assert total == want
