@@ -319,15 +319,27 @@ def main() -> None:
 
     def _is_oom(e: Exception) -> bool:
         s = str(e)
-        return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
+        # the axon tunnel's remote AOT compile reports HBM exhaustion as
+        # an opaque compile-helper HTTP 500 (details only on its own
+        # stderr); treat it as probably-OOM and let the halving loop
+        # bottom out at batch 1 if it is something else
+        return (
+            "RESOURCE_EXHAUSTED" in s
+            or "Out of memory" in s
+            or "OOM" in s
+            or "remote_compile: HTTP 500" in s
+        )
 
     # stage + compile + first run, halving the batch on device OOM so
     # long-vector configs always produce a number unattended
+    # stage in prove-sized sub-batches for long vectors (the prove graph
+    # peaks at [chunk, arity, n2]; prepare no longer has such a tensor)
+    shard_chunk = 8 if getattr(inst, "length", 0) * max(inst.bits, 1) > (1 << 18) else 0
     while True:
         try:
             meas = random_measurements(inst, batch, rng)
             t0 = time.time()
-            step_args, _ = make_report_batch(inst, meas, seed=1)
+            step_args, _ = make_report_batch(inst, meas, seed=1, shard_chunk=shard_chunk)
             progress["t"] = time.monotonic()
             print(
                 f"[bench] backend={backend} batch={batch} shard: {time.time()-t0:.1f}s",

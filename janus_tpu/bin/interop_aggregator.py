@@ -12,7 +12,7 @@ import tempfile
 import time
 
 from ..core.time_util import RealClock
-from ..datastore.store import Crypter, Datastore
+from ..datastore.store import Crypter, open_datastore
 from ..interop import InteropAggregator
 from ..trace import install_trace_subscriber
 
@@ -43,7 +43,7 @@ def main(argv=None) -> int:
     else:
         keys = [secrets.token_bytes(16)]  # ephemeral DB, ephemeral key
     db = args.database or os.path.join(tempfile.mkdtemp(prefix="interop_"), "ds.sqlite")
-    ds = Datastore(db, Crypter(keys), RealClock())
+    ds = open_datastore(db, Crypter(keys), RealClock())
     agg = InteropAggregator(ds)
     srv = agg.server(host="0.0.0.0", port=args.port).start()
     agg.start_job_runners()

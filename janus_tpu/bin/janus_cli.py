@@ -20,7 +20,7 @@ import yaml
 
 from ..binary_utils import parse_datastore_keys
 from ..core.time_util import RealClock
-from ..datastore.store import Crypter, Datastore
+from ..datastore.store import Crypter, open_datastore
 from ..task import Task
 from ..trace import install_trace_subscriber
 
@@ -33,7 +33,7 @@ def cmd_create_datastore_key(args) -> int:
 def _open_datastore(args) -> Datastore:
     raw = args.datastore_keys or os.environ.get("DATASTORE_KEYS", "")
     keys = parse_datastore_keys(raw)
-    return Datastore(args.database, Crypter(keys), RealClock())
+    return open_datastore(args.database, Crypter(keys), RealClock())
 
 
 def cmd_provision_tasks(args) -> int:

@@ -24,7 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .aggregator.job_driver import Stopper
 from .config import CommonConfig, load_config
 from .core.time_util import RealClock
-from .datastore.store import Crypter, Datastore
+from .datastore.store import Crypter, open_datastore
 from .metrics import REGISTRY
 from .trace import install_trace_subscriber
 
@@ -137,7 +137,7 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
             log.exception("could not pin JAX platform %r", common.jax_platform)
 
     keys = parse_datastore_keys(args.datastore_keys)
-    ds = Datastore(common.database.url, Crypter(keys), RealClock())
+    ds = open_datastore(common.database.url, Crypter(keys), RealClock())
 
     stopper = Stopper()
     if install_signals:

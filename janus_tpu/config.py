@@ -21,8 +21,11 @@ from .trace import TraceConfiguration
 
 @dataclass
 class DbConfig:
-    """reference config.rs:61 (url + connection knobs). The datastore is
-    SQLite-backed here, so `url` is a filesystem path (or ":memory:")."""
+    """reference config.rs:61 (url + connection knobs). `url` selects
+    the engine: a postgres://…/postgresql://… URL opens the Postgres
+    backend (multi-host work queue, datastore.rs:203); any other value
+    is a SQLite filesystem path (or ":memory:") for single-host
+    deployments and tests."""
 
     url: str = "janus.sqlite"
 

@@ -36,6 +36,12 @@ from .models import (
     ReportAggregationModel,
     ReportAggregationState,
 )
-from .store import Crypter, Datastore, EphemeralDatastore
+from .store import (
+    Crypter,
+    Datastore,
+    EphemeralDatastore,
+    PostgresDatastore,
+    open_datastore,
+)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

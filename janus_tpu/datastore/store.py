@@ -1,25 +1,48 @@
 """Datastore: transactional facade + typed ops + Crypter.
 
 Equivalent of reference aggregator_core/src/datastore.rs:107-4960.
-Mapping of reference semantics onto SQLite (see package docstring):
+Two engines behind one typed-op surface (the reference's horizontal
+scaling is Postgres, datastore.rs:203-305; SQLite serves single-host
+deployments and tests):
 
-  - `run_tx` retry on serialization failure (datastore.rs:216-305) ->
-    BEGIN IMMEDIATE + bounded retry on SQLITE_BUSY.
-  - `FOR UPDATE ... SKIP LOCKED` lease acquire (datastore.rs:1836-1905)
-    -> one UPDATE ... WHERE ... RETURNING statement per claim, which is
+  - SQLite: `run_tx` retry on serialization failure
+    (datastore.rs:216-305) -> BEGIN IMMEDIATE + bounded retry on
+    SQLITE_BUSY; lease acquire (`FOR UPDATE SKIP LOCKED`,
+    datastore.rs:1836-1905) -> guarded UPDATE ... RETURNING per claim,
     atomic under SQLite's writer lock.
+  - Postgres (`PostgresDatastore`, psycopg): REPEATABLE READ +
+    retry-on-serialization-failure, real `FOR UPDATE SKIP LOCKED`
+    lease claims, same schema translated BLOB->BYTEA/INTEGER->BIGINT.
+    Selected by `database.url` = postgres:// (open_datastore).
   - `Crypter` AES-128-GCM encryption at rest with AAD =
-    table||row||column and multi-key rotation (datastore.rs:4889-4960).
+    table||row||column and multi-key rotation (datastore.rs:4889-4960)
+    — engine-independent.
+
+The typed ops (Transaction) are written once in portable SQL; the
+engine differences are confined to placeholder style (adapter), the
+integrity-error types, the lease-select locking suffix, and DDL types.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import secrets
 import sqlite3
 import tempfile
 import threading
 import time as _time
+
+try:  # Postgres backend is optional (psycopg not present in all images)
+    import psycopg as _psycopg
+except ImportError:  # pragma: no cover - exercised where psycopg exists
+    _psycopg = None
+
+_INTEGRITY_ERRORS = (
+    (sqlite3.IntegrityError,)
+    if _psycopg is None
+    else (sqlite3.IntegrityError, _psycopg.errors.IntegrityError)
+)
 
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
@@ -230,14 +253,34 @@ class TxConflict(Exception):
     pass
 
 
+class _PgConnAdapter:
+    """Gives a psycopg connection the sqlite3 execute surface the typed
+    ops are written against: qmark placeholders, execute returning a
+    cursor with fetchone/fetchall/rowcount."""
+
+    def __init__(self, conn):
+        self._conn = conn
+
+    def execute(self, sql: str, params=()):
+        return self._conn.execute(sql.replace("?", "%s"), params)
+
+    def executemany(self, sql: str, seq):
+        cur = self._conn.cursor()
+        cur.executemany(sql.replace("?", "%s"), list(seq))
+        return cur
+
+
 class Transaction:
     """One open transaction; exposes every typed op. Obtained from
-    Datastore.run_tx / Datastore.tx()."""
+    Datastore.run_tx / Datastore.tx(). The ops are portable SQL; the
+    `dialect` selects the lease-select locking suffix (Postgres gets a
+    real FOR UPDATE SKIP LOCKED, datastore.rs:1853-1860)."""
 
-    def __init__(self, conn: sqlite3.Connection, crypter: Crypter, clock):
+    def __init__(self, conn, crypter: Crypter, clock, dialect: str = "sqlite"):
         self._c = conn
         self._crypter = crypter
         self._clock = clock
+        self._lease_suffix = " FOR UPDATE SKIP LOCKED" if dialect == "postgres" else ""
 
     # ---- tasks (reference datastore.rs:528-1160) ----
     def put_task(self, task: Task) -> None:
@@ -295,9 +338,11 @@ class Transaction:
         row_key = peer.endpoint.encode() + bytes([int(peer.role)])
         doc = json.dumps(peer.to_dict()).encode()
         enc = self._crypter.encrypt("taskprov_peer_aggregators", row_key, "doc", doc)
+        # upsert portable to both engines (sqlite >= 3.24 and Postgres)
         self._c.execute(
-            "INSERT OR REPLACE INTO taskprov_peer_aggregators (endpoint, role, doc)"
-            " VALUES (?,?,?)",
+            "INSERT INTO taskprov_peer_aggregators (endpoint, role, doc)"
+            " VALUES (?,?,?)"
+            " ON CONFLICT (endpoint, role) DO UPDATE SET doc = excluded.doc",
             (peer.endpoint, int(peer.role), enc),
         )
 
@@ -338,22 +383,24 @@ class Transaction:
         lis = self._crypter.encrypt(
             "client_reports", row_key, "leader_input_share", report.leader_input_share
         )
-        try:
-            self._c.execute(
-                "INSERT INTO client_reports (task_id, report_id, client_time, public_share,"
-                " leader_input_share, helper_encrypted_input_share) VALUES (?,?,?,?,?,?)",
-                (
-                    report.task_id.data,
-                    report.report_id.data,
-                    report.client_time.seconds,
-                    report.public_share,
-                    lis,
-                    report.helper_encrypted_input_share.to_bytes(),
-                ),
-            )
-            return True
-        except sqlite3.IntegrityError:
-            return False
+        # ON CONFLICT DO NOTHING instead of catch-and-continue: a caught
+        # IntegrityError would poison a Postgres transaction (everything
+        # after it fails with InFailedSqlTransaction), and the report
+        # writer keeps using the tx for the rest of its batch.
+        cur = self._c.execute(
+            "INSERT INTO client_reports (task_id, report_id, client_time, public_share,"
+            " leader_input_share, helper_encrypted_input_share) VALUES (?,?,?,?,?,?)"
+            " ON CONFLICT DO NOTHING",
+            (
+                report.task_id.data,
+                report.report_id.data,
+                report.client_time.seconds,
+                report.public_share,
+                lis,
+                report.helper_encrypted_input_share.to_bytes(),
+            ),
+        )
+        return cur.rowcount == 1
 
     def get_client_report(self, task_id: TaskId, report_id: ReportId) -> LeaderStoredReport | None:
         row = self._c.execute(
@@ -491,7 +538,7 @@ class Transaction:
         rows = self._c.execute(
             "SELECT task_id, job_id FROM aggregation_jobs"
             " WHERE state = 'in_progress' AND lease_expiry <= ?"
-            " ORDER BY lease_expiry LIMIT ?",
+            " ORDER BY lease_expiry LIMIT ?" + self._lease_suffix,
             (now, limit),
         ).fetchall()
         for task_id, job_id in rows:
@@ -623,7 +670,7 @@ class Transaction:
                     ba.checksum.data,
                 ),
             )
-        except sqlite3.IntegrityError as e:
+        except _INTEGRITY_ERRORS as e:
             # unique violation -> retryable conflict (reference accumulator.rs:173-199)
             raise TxConflict(str(e)) from e
 
@@ -826,7 +873,7 @@ class Transaction:
         rows = self._c.execute(
             "SELECT task_id, collection_job_id FROM collection_jobs"
             " WHERE state IN ('start', 'collectable') AND lease_expiry <= ?"
-            " ORDER BY lease_expiry LIMIT ?",
+            " ORDER BY lease_expiry LIMIT ?" + self._lease_suffix,
             (now, limit),
         ).fetchall()
         out = []
@@ -1099,15 +1146,23 @@ class Transaction:
 
 
 class Datastore:
-    """Connection manager + transaction runner (reference datastore.rs:107)."""
+    """Connection manager + transaction runner (reference datastore.rs:107).
+
+    SQLite engine. Engine-specific seams (overridden by
+    PostgresDatastore): `DIALECT`, `_connect`, `_begin`,
+    `_retryable_errors`, `_adapt`."""
 
     MAX_RETRIES = 16
+    DIALECT = "sqlite"
 
     def __init__(self, path: str, crypter: Crypter, clock):
         self._path = path
         self._crypter = crypter
         self._clock = clock
         self._local = threading.local()
+        self._bootstrap_schema()
+
+    def _bootstrap_schema(self) -> None:
         conn = self._connect()
         with conn:
             conn.executescript(_SCHEMA)
@@ -1128,6 +1183,26 @@ class Datastore:
             self._local.conn = conn
         return conn
 
+    def _begin(self, conn) -> None:
+        conn.execute("BEGIN IMMEDIATE")
+
+    def _adapt(self, conn):
+        """Wrap the raw connection for Transaction's execute surface."""
+        return conn
+
+    def _discard(self, conn) -> None:
+        """Drop a known-dead cached connection (engine hook)."""
+
+    def _discard_if_broken(self, conn) -> None:
+        """Drop the cached connection if the engine marks it broken."""
+
+    @property
+    def _retryable_errors(self) -> tuple:
+        return (sqlite3.OperationalError, TxConflict)
+
+    def _tx_obj(self, conn) -> Transaction:
+        return Transaction(self._adapt(conn), self._crypter, self._clock, dialect=self.DIALECT)
+
     def tx(self):
         """Single-attempt transaction as a context manager (no retry):
         commits on clean exit, rolls back on exception. For callers that
@@ -1138,9 +1213,9 @@ class Datastore:
         @contextlib.contextmanager
         def cm():
             conn = self._connect()
-            conn.execute("BEGIN IMMEDIATE")
+            self._begin(conn)
             try:
-                yield Transaction(conn, self._crypter, self._clock)
+                yield self._tx_obj(conn)
                 conn.commit()
             except BaseException:
                 conn.rollback()
@@ -1157,14 +1232,22 @@ class Datastore:
         for attempt in range(self.MAX_RETRIES):
             conn = self._connect()
             try:
-                conn.execute("BEGIN IMMEDIATE")
-                tx = Transaction(conn, self._crypter, self._clock)
+                self._begin(conn)
+                tx = self._tx_obj(conn)
                 result = fn(tx)
                 conn.commit()
                 metrics.tx_duration.observe(_time.monotonic() - start, tx=name)
                 return result
-            except (sqlite3.OperationalError, TxConflict) as e:
-                conn.rollback()
+            except self._retryable_errors as e:
+                # the connection itself may be dead (e.g. Postgres
+                # restart): rollback best-effort, let the engine decide
+                # whether to discard the cached connection
+                try:
+                    conn.rollback()
+                except Exception:
+                    self._discard(conn)
+                else:
+                    self._discard_if_broken(conn)
                 if attempt == self.MAX_RETRIES - 1:
                     raise
                 _time.sleep(0.002 * (1 << min(attempt, 6)))
@@ -1179,20 +1262,153 @@ class Datastore:
             self._local.conn = None
 
 
-class EphemeralDatastore:
-    """Per-test datastore on a temp file (the analog of the reference's
-    ephemeral postgres testcontainer, datastore/test_util.rs:26-120)."""
+def _pg_schema() -> str:
+    """The canonical DDL translated for Postgres: BLOB->BYTEA,
+    INTEGER->BIGINT (sqlite INTEGER is 64-bit; pg INTEGER is 32 and
+    timestamps/counters need 64)."""
+    ddl = _SCHEMA.replace("BLOB", "BYTEA")
+    ddl = re.sub(r"\bINTEGER\b", "BIGINT", ddl)
+    return ddl
 
-    def __init__(self, clock=None, crypter: Crypter | None = None):
-        from ..core.time_util import MockClock
 
-        self._dir = tempfile.TemporaryDirectory(prefix="janus-tpu-ds-")
-        self.clock = clock if clock is not None else MockClock()
-        self.crypter = crypter or Crypter()
-        self.datastore = Datastore(
-            os.path.join(self._dir.name, "ds.sqlite"), self.crypter, self.clock
+class PostgresDatastore(Datastore):
+    """Postgres engine: the reference's horizontal-scaling deployment
+    (datastore.rs:203-305) — REPEATABLE READ with retry on
+    serialization failure, `FOR UPDATE SKIP LOCKED` lease claims
+    (datastore.rs:1836-1905), many worker hosts against one database.
+
+    `dsn` is a postgres:// / postgresql:// URL (psycopg format). An
+    optional `schema` confines all tables to a named schema (used by
+    the ephemeral test fixture for isolation)."""
+
+    DIALECT = "postgres"
+
+    def __init__(self, dsn: str, crypter: Crypter, clock, schema: str | None = None):
+        if _psycopg is None:
+            raise RuntimeError(
+                "database.url is postgres:// but psycopg is not installed"
+            )
+        self._dsn = dsn
+        self._schema = schema
+        super().__init__(dsn, crypter, clock)
+
+    # arbitrary fixed key serializing concurrent schema bootstrap
+    _BOOTSTRAP_LOCK_KEY = 0x6A616E7573  # "janus"
+
+    def _bootstrap_schema(self) -> None:
+        conn = self._connect()
+        try:
+            # advisory lock: multiple worker hosts booting against an
+            # empty database would otherwise race the unguarded CREATEs
+            # (pg_type_typname_nsp_index duplicate-key race) and the
+            # schema_version check-then-insert
+            conn.execute(
+                "SELECT pg_advisory_xact_lock(%s)", (self._BOOTSTRAP_LOCK_KEY,)
+            )
+            if self._schema is not None:
+                conn.execute(f'CREATE SCHEMA IF NOT EXISTS "{self._schema}"')
+            for stmt in _pg_schema().split(";"):
+                if stmt.strip():
+                    conn.execute(stmt)
+            cur = conn.execute("SELECT version FROM schema_version")
+            row = cur.fetchone()
+            if row is None:
+                conn.execute(
+                    "INSERT INTO schema_version (version) VALUES (%s)", (SCHEMA_VERSION,)
+                )
+            elif row[0] != SCHEMA_VERSION:
+                raise RuntimeError(f"unsupported schema version {row[0]}")
+            conn.commit()
+        except BaseException:
+            conn.rollback()
+            raise
+
+    def _connect(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            kwargs = {}
+            if self._schema is not None:
+                kwargs["options"] = f"-c search_path={self._schema}"
+            conn = _psycopg.connect(self._dsn, autocommit=False, **kwargs)
+            conn.isolation_level = _psycopg.IsolationLevel.REPEATABLE_READ
+            self._local.conn = conn
+        return conn
+
+    def _begin(self, conn) -> None:
+        # psycopg opens the transaction implicitly at the first statement
+        # (autocommit=False) at the connection's isolation level
+        pass
+
+    def _adapt(self, conn):
+        return _PgConnAdapter(conn)
+
+    def _discard(self, conn) -> None:
+        try:
+            conn.close()
+        except Exception:
+            pass
+        if getattr(self._local, "conn", None) is conn:
+            self._local.conn = None
+
+    def _discard_if_broken(self, conn) -> None:
+        if getattr(conn, "closed", False) or getattr(conn, "broken", False):
+            self._discard(conn)
+
+    @property
+    def _retryable_errors(self) -> tuple:
+        return (
+            _psycopg.errors.SerializationFailure,
+            _psycopg.errors.DeadlockDetected,
+            _psycopg.OperationalError,
+            TxConflict,
         )
 
+    def drop_schema(self) -> None:
+        """Test teardown: drop the confined schema and everything in it."""
+        assert self._schema is not None
+        conn = self._connect()
+        conn.execute(f'DROP SCHEMA IF EXISTS "{self._schema}" CASCADE')
+        conn.commit()
+
+
+def open_datastore(url: str, crypter: Crypter, clock):
+    """database.url dispatch: postgres:// -> PostgresDatastore, anything
+    else is a SQLite path (reference DbConfig, config.rs:61)."""
+    if url.startswith(("postgres://", "postgresql://")):
+        return PostgresDatastore(url, crypter, clock)
+    return Datastore(url, crypter, clock)
+
+
+class EphemeralDatastore:
+    """Per-test datastore (the analog of the reference's ephemeral
+    postgres testcontainer, datastore/test_util.rs:26-120).
+
+    engine="sqlite" (default) uses a temp file. engine="postgres" uses
+    the server at $JANUS_TEST_DATABASE_URL with a random per-fixture
+    schema (dropped on cleanup) — the test parameterization skips it
+    when psycopg or the URL is absent."""
+
+    def __init__(self, clock=None, crypter: Crypter | None = None, engine: str = "sqlite"):
+        from ..core.time_util import MockClock
+
+        self.clock = clock if clock is not None else MockClock()
+        self.crypter = crypter or Crypter()
+        self._dir = None
+        if engine == "postgres":
+            url = os.environ.get("JANUS_TEST_DATABASE_URL")
+            if not url:
+                raise RuntimeError("JANUS_TEST_DATABASE_URL not set")
+            schema = "janus_test_" + secrets.token_hex(8)
+            self.datastore = PostgresDatastore(url, self.crypter, self.clock, schema=schema)
+        else:
+            self._dir = tempfile.TemporaryDirectory(prefix="janus-tpu-ds-")
+            self.datastore = Datastore(
+                os.path.join(self._dir.name, "ds.sqlite"), self.crypter, self.clock
+            )
+
     def cleanup(self) -> None:
+        if isinstance(self.datastore, PostgresDatastore):
+            self.datastore.drop_schema()
         self.datastore.close()
-        self._dir.cleanup()
+        if self._dir is not None:
+            self._dir.cleanup()

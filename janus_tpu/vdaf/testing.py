@@ -107,22 +107,62 @@ def make_wire_reports(
     return reports
 
 
-def make_report_batch(inst: VdafInstance, measurements, seed: int = 0):
+def make_report_batch(inst: VdafInstance, measurements, seed: int = 0, shard_chunk: int = 0):
     """Shard a batch of measurements on device.
 
     Returns (step_args, measurements) where step_args is the positional
     tuple for parallel.api.two_party_step: (nonce_lanes, public_parts,
     leader_meas, leader_proof, blind0, helper_seed, blind1).
+
+    shard_chunk > 0 shards in sub-batches of that size and concatenates
+    on host: the FLP *prove* graph peaks at [chunk, arity, n2] per
+    sub-batch, so long-vector configs (SumVec len=100k) can stage a
+    batch far larger than the prove path could hold at once. The
+    prepare step's own memory is unaffected (query needs no wire-poly
+    coefficient arrays).
     """
     p3 = prio3_batched(inst)
     rng = np.random.default_rng(seed)
     batch = len(measurements)
-    inp_np = p3.bc.encode_batch(measurements)
-    inp = p3.jf.from_ints(inp_np.astype(object))
     nonce_lanes = rng.integers(0, 1 << 63, size=(batch, 2), dtype=np.uint64)
     n_seeds = 4 if p3.uses_joint_rand else 2
     rand_lanes = rng.integers(0, 1 << 63, size=(batch, n_seeds, 2), dtype=np.uint64)
-    sh = p3.shard_jit(inp, nonce_lanes, rand_lanes)
+
+    def shard_slice(lo: int, hi: int):
+        inp_np = p3.bc.encode_batch(measurements[lo:hi])
+        inp = p3.jf.from_ints(inp_np.astype(object))
+        return p3.shard_jit(inp, nonce_lanes[lo:hi], rand_lanes[lo:hi])
+
+    if not shard_chunk or shard_chunk >= batch:
+        sh = shard_slice(0, batch)
+    else:
+        parts = []
+        for lo in range(0, batch, shard_chunk):
+            s = shard_slice(lo, min(lo + shard_chunk, batch))
+            # pull to host so device frees the sub-batch before the next
+            parts.append(
+                {
+                    k: (
+                        None
+                        if v is None
+                        else tuple(np.asarray(x) for x in v)
+                        if isinstance(v, tuple)
+                        else np.asarray(v)
+                    )
+                    for k, v in s.items()
+                }
+            )
+        sh = {}
+        for k in parts[0]:
+            if parts[0][k] is None:
+                sh[k] = None
+            elif isinstance(parts[0][k], tuple):
+                sh[k] = tuple(
+                    np.concatenate([p[k][i] for p in parts])
+                    for i in range(len(parts[0][k]))
+                )
+            else:
+                sh[k] = np.concatenate([p[k] for p in parts])
     args = (
         nonce_lanes,
         sh["public_parts"],

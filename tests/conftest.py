@@ -30,3 +30,14 @@ if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Datastore engines under test: SQLite always; Postgres when a server
+# URL and psycopg are both available (the reference's datastore tests
+# run against a real postgres testcontainer,
+# datastore/test_util.rs:26-120). Shared by every engine-parameterized
+# suite so coverage can't silently diverge between files.
+import importlib.util
+
+DATASTORE_ENGINES = ["sqlite"]
+if os.environ.get("JANUS_TEST_DATABASE_URL") and importlib.util.find_spec("psycopg"):
+    DATASTORE_ENGINES.append("postgres")

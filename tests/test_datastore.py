@@ -47,9 +47,12 @@ from janus_tpu.task import QueryTypeConfig, TaskBuilder
 from janus_tpu.vdaf.registry import VdafInstance
 
 
-@pytest.fixture()
-def eph():
-    e = EphemeralDatastore()
+from conftest import DATASTORE_ENGINES
+
+
+@pytest.fixture(params=DATASTORE_ENGINES)
+def eph(request):
+    e = EphemeralDatastore(engine=request.param)
     yield e
     e.cleanup()
 

@@ -13,6 +13,16 @@ from janus_tpu.datastore.models import (
     LeaderStoredReport,
 )
 from janus_tpu.datastore.store import EphemeralDatastore
+
+# Parameterize the invariants over both engines (Postgres skips unless
+# a server URL + psycopg are present); engine list shared via conftest.
+import pytest
+from conftest import DATASTORE_ENGINES
+
+
+@pytest.fixture(params=DATASTORE_ENGINES)
+def engine(request):
+    return request.param
 from janus_tpu.messages import (
     Duration,
     HpkeCiphertext,
@@ -52,10 +62,10 @@ def put_job(ds, task, job_id_bytes):
     return job
 
 
-def test_concurrent_lease_acquisition_never_double_assigns():
+def test_concurrent_lease_acquisition_never_double_assigns(engine):
     """N workers racing to acquire M jobs: every job is handed to exactly
     one worker (the FOR UPDATE SKIP LOCKED analog)."""
-    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
     ds = eph.datastore
     try:
         task = make_task(ds)
@@ -87,7 +97,7 @@ def test_concurrent_lease_acquisition_never_double_assigns():
         eph.cleanup()
 
 
-def test_release_requires_matching_lease_token():
+def test_release_requires_matching_lease_token(engine):
     """A stale worker (expired lease re-acquired by another) cannot
     release the new holder's lease."""
     import pytest
@@ -95,7 +105,7 @@ def test_release_requires_matching_lease_token():
     from janus_tpu.datastore.store import TxConflict
 
     clock = MockClock(Time(1_600_000_000))
-    eph = EphemeralDatastore(clock=clock)
+    eph = EphemeralDatastore(clock=clock, engine=engine)
     ds = eph.datastore
     try:
         task = make_task(ds)
@@ -118,10 +128,10 @@ def test_release_requires_matching_lease_token():
         eph.cleanup()
 
 
-def test_concurrent_report_claims_are_disjoint():
+def test_concurrent_report_claims_are_disjoint(engine):
     """Racing creators claim disjoint report sets (aggregation_started
     flip is atomic per report)."""
-    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
     ds = eph.datastore
     try:
         task = make_task(ds)
@@ -162,10 +172,10 @@ def test_concurrent_report_claims_are_disjoint():
         eph.cleanup()
 
 
-def test_accumulator_flush_is_idempotent_under_tx_retry():
+def test_accumulator_flush_is_idempotent_under_tx_retry(engine):
     """Re-flushing the same accumulator state (a retried transaction)
     yields the same batch rows, not doubled counts."""
-    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
     ds = eph.datastore
     try:
         task = make_task(ds)
