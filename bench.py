@@ -175,14 +175,20 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
         helper_eph.cleanup()
 
 
-def main() -> None:
-    # Persistent XLA compilation cache: re-runs of the same config skip
-    # the multi-minute compile (set before jax initializes a backend).
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/jax_comp_cache")
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: re-runs of the same config skip
+    the multi-minute compile. jax is preimported (sitecustomize), so
+    env vars are a no-op — must go through jax.config."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax_comp_cache")
     )
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
+def main() -> None:
     ap = argparse.ArgumentParser()
     # Default is the north-star config (BASELINE.md): SumVec(len=1000,
     # bits=16) two-party prepare+accumulate. Chip-proven since the
@@ -270,6 +276,8 @@ def main() -> None:
 
     import jax
     import numpy as np
+
+    _enable_compile_cache()
 
     if os.environ.get("JANUS_BENCH_CPU_FALLBACK") == "1":
         # sitecustomize may have pinned the axon platform; override in

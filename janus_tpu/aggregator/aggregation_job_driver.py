@@ -66,6 +66,10 @@ class AggregationJobDriverConfig:
     batch_aggregation_shard_count: int = 1
     maximum_attempts_before_failure: int = 10
     http_backoff: Backoff = Backoff()
+    # helper HTTP work is bounded by lease remaining minus this skew
+    # (reference job_driver.rs:191-196) so a hung helper can't outlive
+    # the lease and run the job concurrently with a re-acquirer
+    worker_lease_clock_skew_s: int = 60
 
 
 class AggregationJobDriver:
@@ -87,6 +91,13 @@ class AggregationJobDriver:
             )
 
         return acquire
+
+    def _lease_deadline(self, acquired) -> float:
+        from .job_driver import lease_deadline
+
+        return lease_deadline(
+            self.ds.clock, acquired.lease, self.cfg.worker_lease_clock_skew_s
+        )
 
     def stepper(self, acquired: AcquiredAggregationJob) -> None:
         if acquired.lease.attempts > self.cfg.maximum_attempts_before_failure:
@@ -229,7 +240,9 @@ class AggregationJobDriver:
                 PartialBatchSelector.from_bytes(job.partial_batch_identifier),
                 tuple(prep_inits),
             )
-            resp = self._send_init_request(task, acquired.job_id, req)
+            resp = self._send_init_request(
+                task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
+            )
             by_id = {pr.report_id: pr for pr in resp.prepare_resps}
             # process response (reference :530-726), host-side lane checks
             for k, i in enumerate(send_idx):
@@ -300,7 +313,9 @@ class AggregationJobDriver:
 
         self.ds.run_tx(write, "step_agg_job_write")
 
-    def _send_init_request(self, task: Task, job_id, req: AggregationJobInitializeReq) -> AggregationJobResp:
+    def _send_init_request(
+        self, task: Task, job_id, req: AggregationJobInitializeReq, deadline: float | None = None
+    ) -> AggregationJobResp:
         import base64
 
         url = (
@@ -309,6 +324,7 @@ class AggregationJobDriver:
             + f"/aggregation_jobs/{base64.urlsafe_b64encode(job_id.data).decode().rstrip('=')}"
         )
         from .http_handlers import XOF_MODE_HEADER
+        from .job_driver import deadline_request_timeout
 
         headers = {
             "Content-Type": AggregationJobInitializeReq.MEDIA_TYPE,
@@ -316,9 +332,13 @@ class AggregationJobDriver:
         }
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
-        status, body = retry_http_request(
-            lambda: self.http.put(url, req.to_bytes(), headers), self.cfg.http_backoff
-        )
+
+        def attempt():
+            return self.http.put(
+                url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
+            )
+
+        status, body = retry_http_request(attempt, self.cfg.http_backoff, deadline=deadline)
         if status not in (200, 201):
             raise RuntimeError(f"helper init failed: HTTP {status}: {body[:300]!r}")
         return AggregationJobResp.from_bytes(body)

@@ -43,6 +43,8 @@ log = logging.getLogger(__name__)
 class CollectionJobDriverConfig:
     maximum_attempts_before_failure: int = 10
     http_backoff: Backoff = Backoff()
+    # see AggregationJobDriverConfig.worker_lease_clock_skew_s
+    worker_lease_clock_skew_s: int = 60
 
 
 class CollectionJobDriver:
@@ -155,7 +157,9 @@ class CollectionJobDriver:
         else:
             batch_selector = BatchSelector.fixed_size(BatchId(job.batch_identifier))
         req = AggregateShareReq(batch_selector, job.aggregation_parameter, total, checksum)
-        helper_share = self._send_aggregate_share_request(task, req)
+        helper_share = self._send_aggregate_share_request(
+            task, req, deadline=self._lease_deadline(acquired)
+        )
 
         def mark_and_store(tx):
             for row in rows:
@@ -176,8 +180,19 @@ class CollectionJobDriver:
 
         self.ds.run_tx(mark_and_store, "step_collection_store")
 
-    def _send_aggregate_share_request(self, task: Task, req: AggregateShareReq) -> AggregateShare:
+    def _lease_deadline(self, acquired) -> float:
+        from .job_driver import lease_deadline
+
+        return lease_deadline(
+            self.ds.clock, acquired.lease, self.cfg.worker_lease_clock_skew_s
+        )
+
+    def _send_aggregate_share_request(
+        self, task: Task, req: AggregateShareReq, deadline: float | None = None
+    ) -> AggregateShare:
         import base64
+
+        from .job_driver import deadline_request_timeout
 
         url = (
             task.helper_aggregator_endpoint.rstrip("/")
@@ -186,9 +201,13 @@ class CollectionJobDriver:
         headers = {"Content-Type": AggregateShareReq.MEDIA_TYPE}
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
-        status, body = retry_http_request(
-            lambda: self.http.post(url, req.to_bytes(), headers), self.cfg.http_backoff
-        )
+
+        def attempt():
+            return self.http.post(
+                url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
+            )
+
+        status, body = retry_http_request(attempt, self.cfg.http_backoff, deadline=deadline)
         if status != 200:
             raise RuntimeError(f"helper aggregate share failed: HTTP {status}: {body[:300]!r}")
         return AggregateShare.from_bytes(body)

@@ -464,7 +464,9 @@ class TaskAggregator:
                 tx.delete_outstanding_batch(task.task_id, chosen.batch_id)
                 bid = chosen.batch_id.data
             else:
-                existing = tx.find_collection_job_by_query(task.task_id, req.query.to_bytes())
+                existing = tx.find_collection_job_by_query(
+                    task.task_id, req.query.to_bytes(), req.aggregation_parameter
+                )
                 if existing is not None:
                     if existing.collection_job_id != collection_job_id:
                         raise errors.BatchOverlap("query already collected under another job", task.task_id)
@@ -472,6 +474,37 @@ class TaskAggregator:
                 if tx.get_collection_job(task.task_id, collection_job_id) is not None:
                     raise errors.InvalidMessage("collection job id reuse", task.task_id)
                 bid = batch_identifier
+
+            # Leader-side collect validation (reference
+            # query_type.rs:204 CollectableQueryType collectability +
+            # aggregator.rs:2185-2485). Without it a misbehaving
+            # collector gets unbounded leader work and the privacy
+            # budget is enforced only by the helper. Deleted jobs still
+            # count: their batches were (or may have been) released, so
+            # the budget is spent.
+            if req.query.query_type == TimeInterval.CODE:
+                # overlap with DISTINCT prior batches only — re-querying
+                # the same interval (different agg param) is governed by
+                # the query-count check below, not overlap
+                for other_bid, _query, _state in tx.get_collection_job_batches_for_task(
+                    task.task_id
+                ):
+                    if other_bid == bid:
+                        continue
+                    other = Interval.from_bytes(other_bid)
+                    if (
+                        interval.start.seconds < other.end.seconds
+                        and other.start.seconds < interval.end.seconds
+                    ):
+                        raise errors.BatchOverlap(
+                            "batch interval overlaps a previously collected interval",
+                            task.task_id,
+                        )
+            queried = tx.count_collection_jobs_for_batch(task.task_id, bid)
+            if queried >= task.max_batch_query_count:
+                raise errors.BatchQueryCountExceeded(
+                    "batch has reached max_batch_query_count", task.task_id
+                )
             tx.put_collection_job(
                 CollectionJobModel(
                     task.task_id,

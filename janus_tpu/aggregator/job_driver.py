@@ -27,6 +27,22 @@ class JobDriverConfig:
     maximum_attempts_before_failure: int = 10
 
 
+def lease_deadline(clock, lease, skew_s: int) -> float:
+    """time.monotonic() bound for one job step's network work: lease
+    remaining minus clock skew (reference job_driver.rs:191-196) — a
+    stuck helper must not outlive the lease and run the job
+    concurrently with its re-acquirer."""
+    remaining = lease.expiry.seconds - clock.now().seconds - skew_s
+    return time.monotonic() + max(1.0, remaining)
+
+
+def deadline_request_timeout(deadline: float | None) -> float | None:
+    """Per-attempt socket timeout capped to the remaining deadline."""
+    if deadline is None:
+        return None
+    return max(0.1, deadline - time.monotonic())
+
+
 class Stopper:
     """Cooperative shutdown flag (reference uses trillium Stopper)."""
 
@@ -58,7 +74,9 @@ class JobDriver:
         self.stopper = stopper or Stopper()
 
     def run_once(self) -> int:
-        """One acquire+step pass; returns number of jobs stepped."""
+        """One acquire+step pass (barrier semantics — tests and one-shot
+        tools); returns number of jobs stepped. The production loop is
+        run(), which streams."""
         jobs = self.acquirer(self.cfg.max_concurrent_job_workers)
         if not jobs:
             return 0
@@ -74,12 +92,34 @@ class JobDriver:
             log.exception("job step failed (lease will expire and retry)")
 
     def run(self) -> None:
-        """Adaptive-delay discovery loop until stopped (job_driver.rs:119-186)."""
+        """Streaming discovery loop until stopped: acquire as worker
+        permits free instead of barriering on whole batches, so one
+        slow/hung job never idles the rest of the pool (reference
+        job_driver.rs:119-186 acquires under a semaphore the same way).
+        """
+        from concurrent.futures import FIRST_COMPLETED
+
         delay = self.cfg.job_discovery_interval_s
-        while not self.stopper.stopped:
-            n = self.run_once()
-            if n > 0:
-                delay = self.cfg.job_discovery_interval_s
-            else:
-                delay = min(delay * 2, self.cfg.max_job_discovery_interval_s)
-            self.stopper.wait(delay)
+        in_flight: set = set()
+        with ThreadPoolExecutor(max_workers=self.cfg.max_concurrent_job_workers) as pool:
+            while not self.stopper.stopped:
+                in_flight = {f for f in in_flight if not f.done()}
+                free = self.cfg.max_concurrent_job_workers - len(in_flight)
+                n = 0
+                if free > 0:
+                    jobs = self.acquirer(free)
+                    n = len(jobs)
+                    for j in jobs:
+                        in_flight.add(pool.submit(self._step_one, j))
+                if n > 0:
+                    delay = self.cfg.job_discovery_interval_s
+                else:
+                    delay = min(delay * 2, self.cfg.max_job_discovery_interval_s)
+                if in_flight:
+                    # wake as soon as any permit frees (or re-discover)
+                    wait(in_flight, timeout=delay, return_when=FIRST_COMPLETED)
+                else:
+                    self.stopper.wait(delay)
+            # shutdown: drain in-flight steps (job_driver.rs:124-142)
+            if in_flight:
+                wait(in_flight)

@@ -107,6 +107,37 @@ def setup_signal_handler(stopper: Stopper) -> None:
     signal.signal(signal.SIGINT, handle)
 
 
+def warmup_engines(ds) -> None:
+    """Compile the device engine steps for every provisioned task before
+    serving traffic (cold-start mitigation: a cold aggregator otherwise
+    stalls for minutes on first request per task). With the persistent
+    compilation cache, restarts reduce this to disk loads."""
+    import numpy as np
+
+    from .aggregator.engine_cache import MIN_BUCKET, engine_cache
+    from .vdaf.testing import make_report_batch, random_measurements
+
+    tasks = ds.run_tx(lambda tx: tx.get_tasks(), "warmup_list_tasks")
+    for task in tasks:
+        if task.vdaf.kind.startswith("fake") or task.vdaf.xof_mode != "fast":
+            continue  # host engines need no compile
+        try:
+            eng = engine_cache(task.vdaf, task.vdaf_verify_key)
+            rng = np.random.default_rng(0)
+            args, _ = make_report_batch(
+                task.vdaf, random_measurements(task.vdaf, MIN_BUCKET, rng), seed=0
+            )
+            nonce, parts, meas, proof, blind0, hseed, blind1 = args
+            out0, seed0, ver0, part0 = eng.leader_init(nonce, parts, meas, proof, blind0)
+            ok = np.ones(MIN_BUCKET, dtype=bool)
+            part0_l = part0 if part0 is not None else np.zeros((MIN_BUCKET, 2), dtype=np.uint64)
+            eng.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
+            eng.aggregate(out0, ok)
+            log.info("warmed engines for task %s (%s)", task.task_id, task.vdaf.kind)
+        except Exception:
+            log.exception("engine warmup failed for task %s", task.task_id)
+
+
 def janus_main(description: str, config_cls, run, argv=None, install_signals: bool = True):
     """Shared entry point (reference binary_utils.rs janus_main).
 
@@ -136,8 +167,26 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         except Exception:
             log.exception("could not pin JAX platform %r", common.jax_platform)
 
+    if common.compilation_cache_dir:
+        # persistent XLA compile cache: restart cold-start drops from
+        # minutes (first jit of each engine step) to seconds. jax is
+        # already imported by now (sitecustomize/transitive imports), so
+        # env vars are a no-op — must go through jax.config.
+        cache_dir = os.path.expanduser(common.compilation_cache_dir)
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        except Exception:
+            log.exception("could not enable the persistent compilation cache")
+
     keys = parse_datastore_keys(args.datastore_keys)
     ds = open_datastore(common.database.url, Crypter(keys), RealClock())
+
+    if common.warmup_engines_at_boot:
+        warmup_engines(ds)
 
     stopper = Stopper()
     if install_signals:

@@ -57,6 +57,14 @@ class CommonConfig:
     # aggregator server, and the leader-side aggregation job driver) and
     # pin every other process to "cpu". None = leave the environment alone.
     jax_platform: str | None = None
+    # Persistent XLA compilation cache directory. First compile of a
+    # (VDAF, step, batch-bucket) is minutes; with the cache a process
+    # restart reloads compiled executables in seconds. None disables.
+    compilation_cache_dir: str | None = "~/.cache/janus_tpu_xla"
+    # Warm the engines for every provisioned task at boot (trace+compile
+    # the helper/leader steps for the smallest batch bucket) instead of
+    # stalling the first request. Only the VDAF-hot-path binaries use it.
+    warmup_engines_at_boot: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -67,6 +75,8 @@ class CommonConfig:
                 d.get("health_check_listen_address", "0.0.0.0:9001")
             ),
             jax_platform=d.get("jax_platform"),
+            compilation_cache_dir=d.get("compilation_cache_dir", "~/.cache/janus_tpu_xla"),
+            warmup_engines_at_boot=bool(d.get("warmup_engines_at_boot", False)),
         )
 
 

@@ -13,22 +13,31 @@ class HttpClient:
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout
 
-    def request(self, method: str, url: str, body: bytes | None = None, headers: dict | None = None):
+    def request(
+        self,
+        method: str,
+        url: str,
+        body: bytes | None = None,
+        headers: dict | None = None,
+        timeout: float | None = None,
+    ):
         req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout if timeout is None else min(self.timeout, timeout)
+            ) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             return e.code, e.read()
 
-    def get(self, url: str, headers: dict | None = None):
-        return self.request("GET", url, None, headers)
+    def get(self, url: str, headers: dict | None = None, timeout: float | None = None):
+        return self.request("GET", url, None, headers, timeout)
 
-    def put(self, url: str, body: bytes, headers: dict | None = None):
-        return self.request("PUT", url, body, headers)
+    def put(self, url: str, body: bytes, headers: dict | None = None, timeout: float | None = None):
+        return self.request("PUT", url, body, headers, timeout)
 
-    def post(self, url: str, body: bytes, headers: dict | None = None):
-        return self.request("POST", url, body, headers)
+    def post(self, url: str, body: bytes, headers: dict | None = None, timeout: float | None = None):
+        return self.request("POST", url, body, headers, timeout)
 
-    def delete(self, url: str, headers: dict | None = None):
-        return self.request("DELETE", url, None, headers)
+    def delete(self, url: str, headers: dict | None = None, timeout: float | None = None):
+        return self.request("DELETE", url, None, headers, timeout)

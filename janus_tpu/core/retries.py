@@ -34,17 +34,33 @@ def is_retryable_status(status: int) -> bool:
     return status in RETRYABLE_STATUS
 
 
-def retry_http_request(do_request, backoff: Backoff = Backoff(), sleep=time.sleep):
+def retry_http_request(
+    do_request, backoff: Backoff = Backoff(), sleep=time.sleep, deadline: float | None = None
+):
     """Call do_request() until success or budget exhausted.
 
     do_request returns (status:int, body) or raises OSError-likes for
     transport failures. Returns the last (status, body); raises the
     last transport error if every attempt failed by exception.
+
+    deadline: optional time.monotonic() value after which no further
+    attempt or backoff sleep is started (the lease-bounded job step,
+    reference job_driver.rs:191-196 — a stuck helper must not outlive
+    the worker's lease and run concurrently with its re-acquirer).
+    Raises TimeoutError if the deadline passes before any conclusive
+    response.
     """
     interval = backoff.initial
     elapsed = 0.0
     last_exc = None
+    status = body = None
     while True:
+        if deadline is not None and time.monotonic() >= deadline:
+            if last_exc is not None:
+                raise last_exc
+            if status is not None:
+                return status, body
+            raise TimeoutError("request deadline (lease bound) exceeded")
         try:
             status, body = do_request()
             if not is_retryable_status(status):
@@ -52,7 +68,10 @@ def retry_http_request(do_request, backoff: Backoff = Backoff(), sleep=time.slee
             last_exc = None
         except (OSError, ConnectionError) as e:
             last_exc = e
-        if elapsed + interval > backoff.max_elapsed:
+        out_of_budget = elapsed + interval > backoff.max_elapsed or (
+            deadline is not None and time.monotonic() + interval >= deadline
+        )
+        if out_of_budget:
             if last_exc is not None:
                 raise last_exc
             return status, body

@@ -832,11 +832,36 @@ class Transaction:
             row[8],
         )
 
-    def find_collection_job_by_query(self, task_id: TaskId, query: bytes) -> CollectionJobModel | None:
-        """Idempotent collection-job creation (reference aggregator.rs:2233)."""
+    def get_collection_job_batches_for_task(self, task_id: TaskId) -> list[tuple[bytes, bytes, str]]:
+        """[(batch_identifier, query, state)] over every collection job
+        of the task — feeds the leader's time-interval overlap scan
+        (reference query_type.rs:204)."""
+        rows = self._c.execute(
+            "SELECT batch_identifier, query, state FROM collection_jobs WHERE task_id = ?",
+            (task_id.data,),
+        ).fetchall()
+        return [(r[0], r[1], r[2]) for r in rows]
+
+    def count_collection_jobs_for_batch(self, task_id: TaskId, batch_identifier: bytes) -> int:
+        """Queries consumed against a batch (leader-side
+        max_batch_query_count; deleted jobs still count — the budget is
+        spent)."""
+        return self._c.execute(
+            "SELECT COUNT(*) FROM collection_jobs WHERE task_id = ? AND batch_identifier = ?",
+            (task_id.data, batch_identifier),
+        ).fetchone()[0]
+
+    def find_collection_job_by_query(
+        self, task_id: TaskId, query: bytes, aggregation_parameter: bytes = b""
+    ) -> CollectionJobModel | None:
+        """Idempotent collection-job creation (reference
+        aggregator.rs:2233). Collection identity is (query, agg param):
+        distinct aggregation parameters over the same query are
+        distinct collections (each consuming batch query count)."""
         row = self._c.execute(
-            "SELECT collection_job_id FROM collection_jobs WHERE task_id = ? AND query = ?",
-            (task_id.data, query),
+            "SELECT collection_job_id FROM collection_jobs"
+            " WHERE task_id = ? AND query = ? AND aggregation_parameter = ?",
+            (task_id.data, query, aggregation_parameter),
         ).fetchone()
         return self.get_collection_job(task_id, CollectionJobId(row[0])) if row else None
 
@@ -1172,6 +1197,10 @@ class Datastore:
             elif row[0] != SCHEMA_VERSION:
                 # reference: supported_schema_versions! check (datastore.rs:103)
                 raise RuntimeError(f"unsupported schema version {row[0]}")
+
+    @property
+    def clock(self):
+        return self._clock
 
     def _connect(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)

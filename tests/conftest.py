@@ -26,10 +26,16 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-if "jax" in sys.modules:
-    import jax
+import jax
 
-    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms", "cpu")
+# env vars above are no-ops when sitecustomize preimported jax; the
+# config route always works
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
 # Datastore engines under test: SQLite always; Postgres when a server
 # URL and psycopg are both available (the reference's datastore tests

@@ -86,3 +86,27 @@ def test_janus_cli_help_and_bad_args():
         env=env, capture_output=True, cwd=REPO,
     )
     assert out.returncode != 0
+
+
+def test_warmup_engines_compiles_provisioned_tasks(caplog):
+    """Boot-time engine warmup (CommonConfig.warmup_engines_at_boot)
+    traces + compiles the hot steps for each provisioned task."""
+    from janus_tpu.binary_utils import warmup_engines
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Role
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    eph = EphemeralDatastore()
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.HELPER)
+        .with_(
+            collector_hpke_config=generate_hpke_config_and_private_key(config_id=3).config,
+        )
+        .build()
+    )
+    eph.datastore.run_tx(lambda tx: tx.put_task(task))
+    warmup_engines(eph.datastore)  # must not raise; compiles count engine
+    assert "warmup failed" not in caplog.text
+    eph.cleanup()

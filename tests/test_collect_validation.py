@@ -1,0 +1,143 @@
+"""Leader-side collect-query validation (reference
+aggregator_core/src/query_type.rs:204 CollectableQueryType checks and
+aggregator/src/aggregator.rs:2185-2485): time-interval batch-overlap
+rejection and max_batch_query_count enforcement at collection-job
+creation — without these, a misbehaving collector gets unbounded
+leader work and the privacy budget is enforced only by the peer."""
+
+import pytest
+
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.errors import (
+    BatchOverlap,
+    BatchQueryCountExceeded,
+    InvalidMessage,
+)
+from janus_tpu.core.auth import AuthenticationToken
+from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import (
+    BatchId,
+    CollectionJobId,
+    CollectionReq,
+    Duration,
+    FixedSizeQuery,
+    Interval,
+    Query,
+    Role,
+    Time,
+)
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+def _mk(query_type, **kw):
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    collector_kp = generate_hpke_config_and_private_key(config_id=7)
+    task = (
+        TaskBuilder(query_type, VdafInstance.count(), Role.LEADER)
+        .with_(
+            collector_hpke_config=collector_kp.config,
+            aggregator_auth_token=AuthenticationToken.random_bearer(),
+            collector_auth_token=AuthenticationToken.random_bearer(),
+            min_batch_size=1,
+            **kw,
+        )
+        .build()
+    )
+    eph.datastore.run_tx(lambda tx: tx.put_task(task))
+    agg = Aggregator(eph.datastore, clock, Config())
+    ta = agg.task_aggregator_for(task.task_id)
+    return eph, agg, ta, task
+
+
+def _ti_req(start, dur):
+    return CollectionReq(Query.time_interval(Interval(Time(start), Duration(dur))), b"")
+
+
+def test_time_interval_overlap_rejected():
+    eph, agg, ta, task = _mk(QueryTypeConfig.time_interval())
+    tp = task.time_precision.seconds
+    base = 1_600_000_000 - (1_600_000_000 % tp)
+    ta.handle_create_collection_job(
+        agg.ds, CollectionJobId(bytes(16)), _ti_req(base, 2 * tp)
+    )
+    # overlapping interval (shifted by one precision unit) -> batchOverlap
+    with pytest.raises(BatchOverlap):
+        ta.handle_create_collection_job(
+            agg.ds, CollectionJobId(bytes([1]) * 16), _ti_req(base + tp, 2 * tp)
+        )
+    # disjoint interval is fine
+    ta.handle_create_collection_job(
+        agg.ds, CollectionJobId(bytes([2]) * 16), _ti_req(base + 2 * tp, tp)
+    )
+    eph.cleanup()
+
+
+def test_time_interval_idempotent_retry_and_job_id_reuse():
+    eph, agg, ta, task = _mk(QueryTypeConfig.time_interval())
+    tp = task.time_precision.seconds
+    base = 1_600_000_000 - (1_600_000_000 % tp)
+    jid = CollectionJobId(bytes([3]) * 16)
+    ta.handle_create_collection_job(agg.ds, jid, _ti_req(base, tp))
+    # same query, same job id: idempotent
+    ta.handle_create_collection_job(agg.ds, jid, _ti_req(base, tp))
+    # same query, different job id: rejected
+    with pytest.raises(BatchOverlap):
+        ta.handle_create_collection_job(
+            agg.ds, CollectionJobId(bytes([4]) * 16), _ti_req(base, tp)
+        )
+    # different query, same job id: rejected
+    with pytest.raises(InvalidMessage):
+        ta.handle_create_collection_job(agg.ds, jid, _ti_req(base + tp, tp))
+    eph.cleanup()
+
+
+def test_time_interval_same_interval_new_agg_param_counts_not_overlaps():
+    """Re-collecting the SAME interval under a different aggregation
+    parameter is a distinct collection governed by query count, not
+    batch overlap (an interval trivially 'overlaps' itself)."""
+    eph, agg, ta, task = _mk(QueryTypeConfig.time_interval(), max_batch_query_count=2)
+    tp = task.time_precision.seconds
+    base = 1_600_000_000 - (1_600_000_000 % tp)
+    q = Query.time_interval(Interval(Time(base), Duration(tp)))
+    ta.handle_create_collection_job(
+        agg.ds, CollectionJobId(bytes([30]) * 16), CollectionReq(q, b"")
+    )
+    # same interval, different agg param: allowed (2nd of 2)
+    ta.handle_create_collection_job(
+        agg.ds, CollectionJobId(bytes([31]) * 16), CollectionReq(q, b"\x01")
+    )
+    # 3rd query of the same batch: budget exhausted
+    with pytest.raises(BatchQueryCountExceeded):
+        ta.handle_create_collection_job(
+            agg.ds, CollectionJobId(bytes([32]) * 16), CollectionReq(q, b"\x02")
+        )
+    eph.cleanup()
+
+
+def test_fixed_size_query_count_enforced_on_leader():
+    eph, agg, ta, task = _mk(
+        QueryTypeConfig.fixed_size(max_batch_size=8), max_batch_query_count=2
+    )
+    bid = BatchId(bytes([9]) * 32)
+    # distinct aggregation parameters are distinct queries over the same
+    # batch, each consuming query count
+    def by_batch_id_query():
+        return Query.fixed_size(FixedSizeQuery(FixedSizeQuery.BY_BATCH_ID, bid))
+
+    for i in range(2):
+        ta.handle_create_collection_job(
+            agg.ds,
+            CollectionJobId(bytes([10 + i]) * 16),
+            CollectionReq(by_batch_id_query(), bytes([i])),
+        )
+    with pytest.raises(BatchQueryCountExceeded):
+        ta.handle_create_collection_job(
+            agg.ds,
+            CollectionJobId(bytes([20]) * 16),
+            CollectionReq(by_batch_id_query(), bytes([2])),
+        )
+    eph.cleanup()

@@ -231,12 +231,12 @@ def test_helper_auth_and_idempotency(pair):
     captured = {}
 
     class CapturingHttp(HttpClient):
-        def put(self, url, body, headers=None):
+        def put(self, url, body, headers=None, timeout=None):
             if "aggregation_jobs" in url:
                 captured["url"] = url
                 captured["body"] = body
                 captured["headers"] = headers
-            return super().put(url, body, headers)
+            return super().put(url, body, headers, timeout=timeout)
 
     driver = AggregationJobDriver(pair["leader_ds"], CapturingHttp())
     jd = JobDriver(JobDriverConfig(), driver.acquirer(), driver.stepper)

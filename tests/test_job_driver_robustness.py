@@ -1,0 +1,102 @@
+"""Job-driver robustness: lease-bounded step deadlines and the
+streaming (non-barrier) worker pool (reference
+aggregator/src/binary_utils/job_driver.rs:119-196) — one hung helper
+must neither outlive its lease nor block other jobs."""
+
+import threading
+import time
+
+import pytest
+
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig, Stopper
+from janus_tpu.core.retries import Backoff, retry_http_request
+
+
+def test_retry_deadline_stops_retrying():
+    calls = []
+
+    def do_request():
+        calls.append(time.monotonic())
+        return 503, b"unavailable"  # retryable forever
+
+    deadline = time.monotonic() + 0.15
+    status, body = retry_http_request(
+        do_request, Backoff(initial=0.01, max_elapsed=60.0), deadline=deadline
+    )
+    # returned the last retryable response instead of burning the whole
+    # 60s backoff budget past the lease
+    assert status == 503
+    assert time.monotonic() <= deadline + 0.2
+
+
+def test_retry_deadline_raises_without_any_response():
+    def do_request():
+        raise OSError("connect refused")
+
+    with pytest.raises(OSError):
+        retry_http_request(
+            do_request,
+            Backoff(initial=0.01, max_elapsed=60.0),
+            deadline=time.monotonic() + 0.1,
+        )
+
+
+def test_retry_deadline_already_passed_raises_timeout():
+    def do_request():  # pragma: no cover - must not be called
+        raise AssertionError("request attempted past deadline")
+
+    with pytest.raises(TimeoutError):
+        retry_http_request(do_request, deadline=time.monotonic() - 1)
+
+
+def test_streaming_pool_hung_job_does_not_block_others():
+    """One job hangs; later-discovered jobs still run while it hangs
+    (the old run_once barrier would wait for the whole batch)."""
+    hang = threading.Event()
+    done: dict[str, float] = {}
+    lock = threading.Lock()
+
+    jobs = [["hung"], ["a"], ["b"], []]
+    calls = {"n": 0}
+
+    def acquirer(limit):
+        i = min(calls["n"], len(jobs) - 1)
+        calls["n"] += 1
+        batch = jobs[i][:limit]
+        jobs[i] = jobs[i][len(batch):]
+        return batch
+
+    def stepper(job):
+        if job == "hung":
+            hang.wait(timeout=10)
+        with lock:
+            done[job] = time.monotonic()
+
+    stopper = Stopper()
+    jd = JobDriver(
+        JobDriverConfig(
+            max_concurrent_job_workers=2,
+            job_discovery_interval_s=0.01,
+            max_job_discovery_interval_s=0.05,
+        ),
+        acquirer,
+        stepper,
+        stopper,
+    )
+    t = threading.Thread(target=jd.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with lock:
+                if "a" in done and "b" in done:
+                    break
+            time.sleep(0.01)
+        with lock:
+            assert "a" in done and "b" in done, done
+            assert "hung" not in done  # still hanging while others ran
+    finally:
+        hang.set()
+        stopper.stop()
+        t.join(timeout=5)
+    assert "hung" in done  # shutdown drained the in-flight step

@@ -161,11 +161,11 @@ class TaskprovHeaderHttp(HttpClient):
             headers[TASKPROV_HEADER] = self.header
         return headers
 
-    def put(self, url, body, headers=None):
-        return super().put(url, body, self._with_header(url, headers))
+    def put(self, url, body, headers=None, timeout=None):
+        return super().put(url, body, self._with_header(url, headers), timeout=timeout)
 
-    def post(self, url, body, headers=None):
-        return super().post(url, body, self._with_header(url, headers))
+    def post(self, url, body, headers=None, timeout=None):
+        return super().post(url, body, self._with_header(url, headers), timeout=timeout)
 
 
 def test_helper_side_taskprov_end_to_end():
