@@ -1,0 +1,39 @@
+# janus_tpu container image (the analog of the reference's Dockerfile:
+# one image, the binary selected at run time).
+#
+# For TPU deployments use a base image with libtpu preinstalled (e.g.
+# a Cloud-TPU PyTorch/JAX image) and run the VDAF hot-path binaries
+# (helper `aggregator`, leader `aggregation_job_driver`) on TPU hosts;
+# every other binary pins jax_platform: cpu in its YAML and can run
+# anywhere. Intra-deployment coordination is the datastore
+# (database.url: postgres://... for multi-host), exactly like the
+# reference's Postgres-only control plane (docs/DEPLOYING.md).
+FROM python:3.13-slim
+
+WORKDIR /opt/janus_tpu
+
+# Runtime deps. For CPU-only processes jax[cpu] suffices; TPU hosts
+# need jax[tpu] (libtpu) instead — build with
+#   --build-arg JAX_EXTRA=tpu
+ARG JAX_EXTRA=cpu
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" numpy cryptography pyyaml
+
+COPY pyproject.toml README.md ./
+COPY janus_tpu ./janus_tpu
+RUN pip install --no-cache-dir .
+
+# build the native Keccak/XOF helper used by the host staging path
+# (available() compiles xof.c on first call when a C compiler exists)
+RUN apt-get update && apt-get install -y --no-install-recommends gcc libc6-dev \
+    && python -c "import janus_tpu.native as n; print('native:', n.available())" \
+    && apt-get purge -y gcc libc6-dev && apt-get autoremove -y \
+    && rm -rf /var/lib/apt/lists/*
+
+# healthz/metrics listener (CommonConfig.health_check_listen_address)
+EXPOSE 8080 9001
+
+# Select the binary: aggregator | aggregation_job_creator |
+# aggregation_job_driver | collection_job_driver | janus_cli |
+# interop_client | interop_aggregator | interop_collector
+ENTRYPOINT ["python", "-m"]
+CMD ["janus_tpu.bin.aggregator", "--config-file", "/etc/janus/aggregator.yaml"]

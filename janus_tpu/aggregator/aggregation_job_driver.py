@@ -32,10 +32,13 @@ from ..datastore.models import (
 from .. import metrics
 from ..datastore.store import Datastore
 from ..messages import (
+    AggregationJobContinueReq,
     AggregationJobInitializeReq,
     AggregationJobResp,
+    AggregationJobStep,
     Duration,
     PartialBatchSelector,
+    PrepareContinue,
     PrepareError,
     PrepareInit,
     PrepareStepResult,
@@ -46,6 +49,7 @@ from ..messages.codec import DecodeError
 from ..task import Task
 from ..vdaf.registry import circuit_for
 from ..vdaf.wire import (
+    PP_CONTINUE,
     PP_FINISH,
     PP_INITIALIZE,
     Prio3Wire,
@@ -137,6 +141,14 @@ class AggregationJobDriver:
 
         wire = Prio3Wire(circuit_for(task.vdaf))
         engine = engine_cache(task.vdaf, task.vdaf_verify_key)
+
+        # multi-round jobs park accepted reports in WaitingLeader after
+        # init; a later step sends the continue request (reference
+        # :439-514 CONTINUE path)
+        waiting = [ra for ra in ras if ra.state == ReportAggregationState.WAITING_LEADER]
+        if waiting:
+            self._continue_step(acquired, task, job, waiting)
+            return
 
         pending = [ra for ra in ras if ra.state == ReportAggregationState.START]
         if not pending:
@@ -233,7 +245,9 @@ class AggregationJobDriver:
             )
             send_idx.append(i)
 
+        multi_round = task.vdaf.rounds > 1
         accept = np.zeros(n, dtype=bool)
+        continue_msgs: list[bytes | None] = [None] * n
         if prep_inits:
             req = AggregationJobInitializeReq(
                 job.aggregation_parameter,
@@ -257,6 +271,21 @@ class AggregationJobDriver:
                 if pr.result.kind not in (PrepareStepResult.CONTINUE, PrepareStepResult.FINISHED):
                     failed[i] = PrepareError.INVALID_MESSAGE
                     continue
+                if multi_round:
+                    # helper answered ping-pong CONTINUE; the leader's
+                    # next message (sent on a later step) finishes with
+                    # the combined prep message (fake: echo)
+                    try:
+                        tag, prep_msg, _share = decode_pingpong(pr.result.message)
+                    except DecodeError:
+                        failed[i] = PrepareError.INVALID_MESSAGE
+                        continue
+                    if tag != PP_CONTINUE:
+                        failed[i] = PrepareError.INVALID_MESSAGE
+                        continue
+                    continue_msgs[i] = encode_pingpong(PP_FINISH, prep_msg or b"", None)
+                    accept[i] = True
+                    continue
                 if wire.uses_jr:
                     try:
                         tag, prep_msg, _ = decode_pingpong(pr.result.message)
@@ -279,6 +308,39 @@ class AggregationJobDriver:
                 if accept[i]:
                     accept[i] = False
                     failed[i] = PrepareError.VDAF_PREP_ERROR
+
+        if multi_round:
+            # park accepted reports as WaitingLeader(out_share || msg);
+            # job stays in progress — a later driver step sends the
+            # continue request (reference stores the transition the same
+            # way, models.rs:714 WaitingLeader)
+            import dataclasses
+
+            out0_rows = encode_field_rows(jf, out0)
+            new_ras = []
+            for i, ra in enumerate(pending):
+                if accept[i]:
+                    msg = continue_msgs[i]
+                    blob = len(msg).to_bytes(4, "big") + msg + out0_rows[i]
+                    new_ras.append(
+                        dataclasses.replace(
+                            ra,
+                            state=ReportAggregationState.WAITING_LEADER,
+                            prep_blob=blob,
+                        )
+                    )
+                else:
+                    err = failed[i] or PrepareError.VDAF_PREP_ERROR
+                    metrics.aggregate_step_failure_counter.add(type=err.name.lower())
+                    new_ras.append(ra.failed(err))
+
+            def write_waiting(tx):
+                for ra in new_ras:
+                    tx.update_report_aggregation(ra)
+                tx.release_aggregation_job(acquired)
+
+            self.ds.run_tx(write_waiting, "step_agg_job_park")
+            return
 
         # masked accumulate (reference Accumulator::update :605-627)
         accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
@@ -313,35 +375,130 @@ class AggregationJobDriver:
 
         self.ds.run_tx(write, "step_agg_job_write")
 
-    def _send_init_request(
-        self, task: Task, job_id, req: AggregationJobInitializeReq, deadline: float | None = None
+    def _continue_step(self, acquired, task: Task, job, waiting) -> None:
+        """Send the ord-matched continue request for WaitingLeader rows
+        and finish the job (reference :439-514 + :530-726)."""
+        import dataclasses
+
+        field = circuit_for(task.vdaf).FIELD
+        msgs = []
+        outs = []
+        for ra in waiting:
+            mlen = int.from_bytes(ra.prep_blob[:4], "big")
+            msgs.append(ra.prep_blob[4 : 4 + mlen])
+            outs.append(ra.prep_blob[4 + mlen :])
+        req = AggregationJobContinueReq(
+            AggregationJobStep(job.step + 1),
+            tuple(
+                PrepareContinue(ra.report_id, msg) for ra, msg in zip(waiting, msgs)
+            ),
+        )
+        resp = self._send_continue_request(
+            task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
+        )
+        by_id = {pr.report_id: pr for pr in resp.prepare_resps}
+
+        accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
+        pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
+        fixed_bid = fixed_size_batch_id(pbs)
+        new_ras = []
+        for ra, out_enc in zip(waiting, outs):
+            pr = by_id.get(ra.report_id)
+            if pr is not None and pr.result.kind == PrepareStepResult.FINISHED:
+                from ..messages import Interval
+
+                bid = fixed_bid or Interval(
+                    ra.client_time.to_batch_interval_start(task.time_precision),
+                    task.time_precision,
+                ).to_bytes()
+                accumulator.update_single(
+                    bid, field.decode_vec(out_enc), ra.report_id, ra.client_time
+                )
+                new_ras.append(
+                    dataclasses.replace(
+                        ra, state=ReportAggregationState.FINISHED, prep_blob=b""
+                    )
+                )
+            else:
+                err = (
+                    pr.result.prepare_error
+                    if pr is not None and pr.result.kind == PrepareStepResult.REJECT
+                    else None
+                ) or PrepareError.VDAF_PREP_ERROR
+                metrics.aggregate_step_failure_counter.add(type=err.name.lower())
+                new_ras.append(ra.failed(err))
+
+        new_job = dataclasses.replace(
+            job, state=AggregationJobState.FINISHED, step=job.step + 1
+        )
+
+        def write(tx):
+            unmerged = accumulator.flush_to_datastore(tx)
+            for ra in new_ras:
+                if ra.report_id.data in unmerged:
+                    ra = ra.failed(PrepareError.BATCH_COLLECTED)
+                tx.update_report_aggregation(ra)
+            tx.update_aggregation_job(new_job)
+            tx.release_aggregation_job(acquired)
+
+        self.ds.run_tx(write, "step_agg_job_continue_write")
+
+    def _send_continue_request(
+        self, task: Task, job_id, req: AggregationJobContinueReq, deadline: float | None = None
     ) -> AggregationJobResp:
+        return self._send_agg_job_request(task, job_id, "POST", req, deadline=deadline)
+
+    def _send_agg_job_request(
+        self,
+        task: Task,
+        job_id,
+        method: str,
+        req,
+        extra_headers: dict | None = None,
+        deadline: float | None = None,
+    ) -> AggregationJobResp:
+        """Shared PUT(init)/POST(continue) to the helper's
+        aggregation_jobs endpoint: URL, auth, deadline-capped timeouts,
+        retries, response decode."""
         import base64
+
+        from .job_driver import deadline_request_timeout
 
         url = (
             task.helper_aggregator_endpoint.rstrip("/")
             + f"/tasks/{base64.urlsafe_b64encode(task.task_id.data).decode().rstrip('=')}"
             + f"/aggregation_jobs/{base64.urlsafe_b64encode(job_id.data).decode().rstrip('=')}"
         )
-        from .http_handlers import XOF_MODE_HEADER
-        from .job_driver import deadline_request_timeout
-
-        headers = {
-            "Content-Type": AggregationJobInitializeReq.MEDIA_TYPE,
-            XOF_MODE_HEADER: task.vdaf.xof_mode,
-        }
+        headers = {"Content-Type": req.MEDIA_TYPE, **(extra_headers or {})}
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
 
         def attempt():
-            return self.http.put(
-                url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
-            )
+            # go through put/post (not request) so test doubles that
+            # wrap those verbs see the traffic
+            fn = self.http.put if method == "PUT" else self.http.post
+            return fn(url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline))
 
         status, body = retry_http_request(attempt, self.cfg.http_backoff, deadline=deadline)
         if status not in (200, 201):
-            raise RuntimeError(f"helper init failed: HTTP {status}: {body[:300]!r}")
+            raise RuntimeError(
+                f"helper {method} aggregation job failed: HTTP {status}: {body[:300]!r}"
+            )
         return AggregationJobResp.from_bytes(body)
+
+    def _send_init_request(
+        self, task: Task, job_id, req: AggregationJobInitializeReq, deadline: float | None = None
+    ) -> AggregationJobResp:
+        from .http_handlers import XOF_MODE_HEADER
+
+        return self._send_agg_job_request(
+            task,
+            job_id,
+            "PUT",
+            req,
+            extra_headers={XOF_MODE_HEADER: task.vdaf.xof_mode},
+            deadline=deadline,
+        )
 
     # --- abandon (reference :728) ---
     def abandon_job(self, acquired: AcquiredAggregationJob) -> None:

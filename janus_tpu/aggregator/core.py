@@ -40,6 +40,7 @@ from ..messages import (
     HpkeConfigList,
     InputShareAad,
     Interval,
+    PartialBatchSelector,
     PlaintextInputShare,
     PrepareError,
     PrepareResp,
@@ -57,6 +58,7 @@ from ..datastore.models import CollectionJobModel, CollectionJobState
 from ..task import Task
 from ..vdaf.registry import circuit_for
 from ..vdaf.wire import (
+    PP_CONTINUE,
     PP_FINISH,
     PP_INITIALIZE,
     Prio3Wire,
@@ -67,6 +69,11 @@ from ..vdaf.wire import (
     seeds_to_lanes,
     split_prep_share_columns,
 )
+
+# Round-1 helper prep share carried in the two-round fake VDAF's
+# ping-pong CONTINUE (opaque bytes; the fake's round-2 check is a
+# prep-message echo — the *machinery* is what multi-round exercises).
+FAKE_ROUND1_PREP_SHARE = b"fake-round1-ps!!"
 from . import errors
 from .accumulator import (
     Accumulator,
@@ -319,17 +326,29 @@ class TaskAggregator:
         for e in prep_err:
             if e is not None:
                 metrics.aggregate_step_failure_counter.add(type=e.name.lower())
-        # build response + rows
+        # build response + rows. Multi-round VDAFs park accepted reports
+        # in WaitingHelper with (prep_msg || out_share) and answer
+        # ping-pong CONTINUE; the continue request finishes them
+        # (reference aggregation_job_continue.rs:30-300).
+        multi_round = task.vdaf.rounds > 1
+        out1_rows = encode_field_rows(self.engine.p3.jf, out1) if multi_round else None
         resps = []
         report_aggs = []
         for i, pi in enumerate(inits):
             md = pi.report_share.metadata
             if prep_err[i] is None:
-                result = PrepareStepResult.cont(
-                    encode_pingpong(PP_FINISH, prep_msg_rows[i], None)
-                )
-                state = ReportAggregationState.FINISHED
-                blob = prep_msg_rows[i]
+                if multi_round:
+                    result = PrepareStepResult.cont(
+                        encode_pingpong(PP_CONTINUE, prep_msg_rows[i], FAKE_ROUND1_PREP_SHARE)
+                    )
+                    state = ReportAggregationState.WAITING_HELPER
+                    blob = prep_msg_rows[i] + out1_rows[i]
+                else:
+                    result = PrepareStepResult.cont(
+                        encode_pingpong(PP_FINISH, prep_msg_rows[i], None)
+                    )
+                    state = ReportAggregationState.FINISHED
+                    blob = prep_msg_rows[i]
                 err = None
             else:
                 result = PrepareStepResult.reject(prep_err[i])
@@ -343,18 +362,20 @@ class TaskAggregator:
                 )
             )
 
-        # accumulate accepted out shares per batch bucket (reference :1811-1826)
+        # accumulate accepted out shares per batch bucket (reference
+        # :1811-1826); multi-round jobs accumulate at continue-finish
         accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
         fixed_bid = fixed_size_batch_id(req.partial_batch_selector)
-        accumulate_batched(
-            task,
-            self.engine,
-            accumulator,
-            out1,
-            accept,
-            [pi.report_share.metadata for pi in inits],
-            batch_identifier=fixed_bid,
-        )
+        if not multi_round:
+            accumulate_batched(
+                task,
+                self.engine,
+                accumulator,
+                out1,
+                accept,
+                [pi.report_share.metadata for pi in inits],
+                batch_identifier=fixed_bid,
+            )
 
         times = [pi.report_share.metadata.time.seconds for pi in inits]
         job = AggregationJobModel(
@@ -363,7 +384,7 @@ class TaskAggregator:
             req.aggregation_parameter,
             req.partial_batch_selector.to_bytes(),
             Interval(Time(min(times)), Duration(max(times) - min(times) + 1)) if times else Interval(Time(0), Duration(1)),
-            AggregationJobState.FINISHED,
+            AggregationJobState.IN_PROGRESS if multi_round else AggregationJobState.FINISHED,
             0,
             request_hash,
         )
@@ -406,6 +427,168 @@ class TaskAggregator:
             else:
                 result = PrepareStepResult.reject(ra.prepare_error or PrepareError.VDAF_PREP_ERROR)
             resps.append(PrepareResp(ra.report_id, result))
+        return AggregationJobResp(tuple(resps))
+
+    # ------------------------------------------------------------------
+    # helper aggregate continue (reference aggregation_job_continue.rs:30-300)
+    # ------------------------------------------------------------------
+    def handle_aggregate_continue(
+        self,
+        ds: Datastore,
+        clock: Clock,
+        job_id: AggregationJobId,
+        req,
+        request_bytes: bytes,
+    ) -> AggregationJobResp:
+        """Step a multi-round aggregation job: ord-matched prepare
+        continues against stored WaitingHelper rows, step/replay
+        validation, accumulate on finish."""
+        import dataclasses
+
+        task = self.task
+        if task.vdaf.rounds == 1:
+            # all production Prio3 VDAFs are 1-round; a continue request
+            # is always a step mismatch for them (reference parity gate)
+            raise errors.StepMismatch("no multi-round VDAFs configured", task.task_id)
+        request_hash = hashlib.sha256(request_bytes).digest()
+        step = req.step.step
+        if step == 0:
+            raise errors.InvalidMessage("aggregation job cannot continue to step 0", task.task_id)
+
+        # Everything — validation, row reads, accumulate, writes — in ONE
+        # transaction: concurrent identical continues (leader timeout +
+        # re-POST on a threaded server) must serialize so exactly one
+        # processes and the other sees the bumped step and replays;
+        # split reads would double-accumulate.
+        def process(tx):
+            job = tx.get_aggregation_job(task.task_id, job_id)
+            if job is None:
+                raise errors.UnrecognizedAggregationJob(
+                    "no such aggregation job", task.task_id
+                )
+            if step == job.step:
+                # idempotent replay (reference aggregation_job_continue.rs
+                # replay branch): same request -> same response, scoped to
+                # exactly the reports the continue addressed
+                if job.last_request_hash == request_hash:
+                    return self._rebuild_continue_resps(tx, job_id, req)
+                raise errors.StepMismatch(
+                    "continue step replay with different request", task.task_id
+                )
+            if job.state != AggregationJobState.IN_PROGRESS:
+                raise errors.StepMismatch(
+                    "aggregation job is not continuable", task.task_id
+                )
+            if step != job.step + 1:
+                raise errors.StepMismatch(
+                    f"continue to step {step}, job is at step {job.step}", task.task_id
+                )
+
+            ras = tx.get_report_aggregations_for_job(task.task_id, job_id)
+            waiting = [
+                ra for ra in ras if ra.state == ReportAggregationState.WAITING_HELPER
+            ]
+            # ord-matched: the leader's prepare steps must be exactly the
+            # waiting reports, in ord order (reference :58-84 rejects
+            # unexpected, duplicate, or out-of-order steps)
+            if [pc.report_id for pc in req.prepare_continues] != [
+                ra.report_id for ra in waiting
+            ]:
+                raise errors.InvalidMessage(
+                    "leader sent unexpected, duplicate, or out-of-order prepare steps",
+                    task.task_id,
+                )
+
+            msg_len = 16 if self.wire.uses_jr else 0
+            accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
+            pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
+            fixed_bid = fixed_size_batch_id(pbs)
+            updated = []
+            resps = []
+            for ra, pc in zip(waiting, req.prepare_continues):
+                ok = False
+                try:
+                    tag, prep_msg, _share = decode_pingpong(pc.message)
+                    ok = tag == PP_FINISH and (prep_msg or b"") == ra.prep_blob[:msg_len]
+                except DecodeError:
+                    ok = False
+                if ok:
+                    out_share = accumulator.field.decode_vec(ra.prep_blob[msg_len:])
+                    bid = fixed_bid or Interval(
+                        ra.client_time.to_batch_interval_start(task.time_precision),
+                        task.time_precision,
+                    ).to_bytes()
+                    accumulator.update_single(bid, out_share, ra.report_id, ra.client_time)
+                    updated.append(
+                        dataclasses.replace(
+                            ra, state=ReportAggregationState.FINISHED, prep_blob=b""
+                        )
+                    )
+                    resps.append(PrepareResp(ra.report_id, PrepareStepResult.finished()))
+                else:
+                    metrics.aggregate_step_failure_counter.add(type="vdaf_prep_error")
+                    updated.append(ra.failed(PrepareError.VDAF_PREP_ERROR))
+                    resps.append(
+                        PrepareResp(
+                            ra.report_id,
+                            PrepareStepResult.reject(PrepareError.VDAF_PREP_ERROR),
+                        )
+                    )
+
+            unmerged = accumulator.flush_to_datastore(tx)
+            tx.update_aggregation_job(
+                dataclasses.replace(
+                    job,
+                    state=AggregationJobState.FINISHED,
+                    step=step,
+                    last_request_hash=request_hash,
+                )
+            )
+            for ra in updated:
+                tx.update_report_aggregation(
+                    ra.failed(PrepareError.BATCH_COLLECTED)
+                    if ra.report_id.data in unmerged
+                    else ra
+                )
+            if unmerged:
+                resps = [
+                    PrepareResp(
+                        r.report_id,
+                        PrepareStepResult.reject(PrepareError.BATCH_COLLECTED),
+                    )
+                    if r.report_id.data in unmerged
+                    else r
+                    for r in resps
+                ]
+            return AggregationJobResp(tuple(resps))
+
+        return ds.run_tx(process, "aggregate_continue")
+
+    def _rebuild_continue_resps(self, tx, job_id, req) -> AggregationJobResp:
+        """Replay response scoped to exactly the reports the continue
+        request addressed, in request order (init-time failures are NOT
+        part of a continue response — reference reconstructs only the
+        addressed steps)."""
+        ras = {
+            ra.report_id: ra
+            for ra in tx.get_report_aggregations_for_job(self.task.task_id, job_id)
+        }
+        resps = []
+        for pc in req.prepare_continues:
+            ra = ras.get(pc.report_id)
+            if ra is None:
+                continue
+            if ra.state == ReportAggregationState.FINISHED:
+                resps.append(PrepareResp(ra.report_id, PrepareStepResult.finished()))
+            else:
+                resps.append(
+                    PrepareResp(
+                        ra.report_id,
+                        PrepareStepResult.reject(
+                            ra.prepare_error or PrepareError.VDAF_PREP_ERROR
+                        ),
+                    )
+                )
         return AggregationJobResp(tuple(resps))
 
     # ------------------------------------------------------------------

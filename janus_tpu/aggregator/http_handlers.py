@@ -275,15 +275,16 @@ class DapHttpApp:
         return 200, "application/dap-aggregation-job-resp", resp.to_bytes()
 
     def h_aggregate_continue(self, match, query, headers, body):
+        from ..messages import AggregationJobContinueReq
+
         task_id = TaskId(_b64dec(match.group(1), 32))
+        job_id = AggregationJobId(_b64dec(match.group(2), 16))
         taskprov_config = self._taskprov_config(task_id, headers)
         ta = self.agg.task_aggregator_for(task_id)
         self._check_helper_auth(ta, task_id, headers, taskprov_config)
-        # all supported VDAFs are 1-round: a continue request is always a
-        # step mismatch (reference aggregation_job_continue.rs:58-84)
-        from .errors import StepMismatch
-
-        raise StepMismatch("no multi-round VDAFs configured", task_id)
+        req = AggregationJobContinueReq.from_bytes(body)
+        resp = ta.handle_aggregate_continue(self.agg.ds, self.agg.clock, job_id, req, body)
+        return 200, "application/dap-aggregation-job-resp", resp.to_bytes()
 
     def h_collection_create(self, match, query, headers, body):
         task_id = TaskId(_b64dec(match.group(1), 32))

@@ -31,9 +31,14 @@ def lease_deadline(clock, lease, skew_s: int) -> float:
     """time.monotonic() bound for one job step's network work: lease
     remaining minus clock skew (reference job_driver.rs:191-196) — a
     stuck helper must not outlive the lease and run the job
-    concurrently with its re-acquirer."""
-    remaining = lease.expiry.seconds - clock.now().seconds - skew_s
-    return time.monotonic() + max(1.0, remaining)
+    concurrently with its re-acquirer.
+
+    The skew must not swallow short (test/interop) leases: when the
+    lease is shorter than twice the skew, keep half the remaining
+    lease instead."""
+    remaining = lease.expiry.seconds - clock.now().seconds
+    bound = remaining - skew_s if remaining > 2 * skew_s else remaining / 2
+    return time.monotonic() + max(1.0, bound)
 
 
 def deadline_request_timeout(deadline: float | None) -> float | None:

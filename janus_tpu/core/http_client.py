@@ -10,7 +10,11 @@ import urllib.request
 
 
 class HttpClient:
-    def __init__(self, timeout: float = 30.0):
+    # Default generous: a cold aggregator's first request per task can
+    # legitimately take minutes (XLA engine compile). The job drivers
+    # cap per-request timeouts by lease remaining (job_driver.py
+    # deadline_request_timeout), so hot paths stay bounded.
+    def __init__(self, timeout: float = 300.0):
         self.timeout = timeout
 
     def request(

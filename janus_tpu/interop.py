@@ -349,6 +349,12 @@ class InteropAggregator:
             hpke_keys=(generate_hpke_config_and_private_key(config_id=0),),
         )
         self.ds.run_tx(lambda tx: tx.put_task(task), "interop_add_task")
+        # Warm the engine now: add_task has no client timeout, whereas
+        # the job runners' short test leases (15s) cannot absorb a
+        # first multi-minute engine compile mid-protocol.
+        from .binary_utils import warmup_engines
+
+        warmup_engines(self.ds)
         return {"status": "success"}
 
     def server(self, host="127.0.0.1", port=0) -> _JsonServer:

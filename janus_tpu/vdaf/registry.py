@@ -98,6 +98,22 @@ class VdafInstance:
     def fake_fails_prep_step(cls) -> "VdafInstance":
         return cls("fake_fails_prep_step")
 
+    @classmethod
+    def fake_two_round(cls) -> "VdafInstance":
+        """Two-round fake VDAF: exercises the DAP continue machinery
+        (helper WaitingHelper state, ord-matched AggregationJobContinueReq,
+        step/replay validation — reference
+        aggregation_job_continue.rs:30-300) the same way the reference
+        tests it with dummy_vdaf. Runs the Count circuit for its shares;
+        round 2 is a prep-message echo."""
+        return cls("fake_two_round")
+
+    @property
+    def rounds(self) -> int:
+        """DAP prepare rounds (1 for all Prio3; the two-round fake
+        exercises the continue machinery)."""
+        return 2 if self.kind == "fake_two_round" else 1
+
     @property
     def fails_prep_init(self) -> bool:
         return self.kind == "fake_fails_prep_init"
@@ -148,7 +164,7 @@ def circuit_for(inst: VdafInstance) -> Circuit:
         return SumVec(length=inst.length, bits=1, chunk_length=ch)
     if inst.kind == "fixedpoint":
         return FixedPointVec(length=inst.length, bits=inst.bits, chunk_length=ch)
-    if inst.kind in ("fake", "fake_fails_prep_init", "fake_fails_prep_step"):
+    if inst.kind in ("fake", "fake_fails_prep_init", "fake_fails_prep_step", "fake_two_round"):
         return Count()
     if inst.kind == "poplar1":
         raise ValueError(

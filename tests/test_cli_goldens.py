@@ -1,0 +1,66 @@
+"""Golden CLI tests: every binary's --help output is pinned byte-exact
+(the analog of the reference's trycmd goldens, tools/tests/cli.rs and
+aggregator/tests/cli.rs). Regenerate with
+JANUS_REGEN_GOLDENS=1 python -m pytest tests/test_cli_goldens.py."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+GOLDEN_DIR = Path(__file__).parent / "goldens"
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+BINARIES = [
+    "aggregator",
+    "aggregation_job_creator",
+    "aggregation_job_driver",
+    "collection_job_driver",
+    "janus_cli",
+]
+
+
+def _run_help(binary: str) -> str:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", f"janus_tpu.bin.{binary}", "--help"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        env=env,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout + out.stderr
+
+
+@pytest.mark.parametrize("binary", BINARIES)
+def test_help_matches_golden(binary):
+    golden = GOLDEN_DIR / f"{binary}_help.txt"
+    got = _run_help(binary)
+    if os.environ.get("JANUS_REGEN_GOLDENS") == "1":
+        golden.write_text(got)
+    assert got == golden.read_text(), (
+        f"{binary} --help drifted from its golden; regenerate with "
+        "JANUS_REGEN_GOLDENS=1 if the change is intentional"
+    )
+
+
+def test_janus_cli_create_datastore_key_shape():
+    """create-datastore-key output is random; pin its shape instead."""
+    import base64
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "janus_tpu.bin.janus_cli", "create-datastore-key"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        env=env,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    key = out.stdout.strip()
+    assert len(base64.urlsafe_b64decode(key + "=" * (-len(key) % 4))) == 16

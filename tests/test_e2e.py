@@ -84,13 +84,38 @@ def provision(pair, vdaf):
     return leader_task, helper_task, collector_kp
 
 
+# Every VDAF family through the full live-pair protocol (the
+# reference's per-VDAF matrix, integration_tests/tests/janus.rs:14-60),
+# plus the draft XOF framing end-to-end (host engine on both sides).
 CASES = [
     (VdafInstance.count(), [0, 1, 1, 0, 1, 1, 1], 5),
-    (VdafInstance.histogram(length=4), [0, 1, 1, 3, 2, 1, 0], None),
+    (VdafInstance.sum(bits=8), [3, 200, 17], 220),
+    (
+        VdafInstance.sum_vec(length=4, bits=4),
+        [[1, 2, 3, 4], [5, 4, 3, 2], [0, 1, 0, 1]],
+        [6, 7, 6, 7],
+    ),
+    (VdafInstance.count_vec(length=3), [[1, 0, 1], [0, 1, 1]], [1, 1, 2]),
+    (VdafInstance.histogram(length=4), [0, 1, 1, 3, 2, 1, 0], [2, 3, 1, 1]),
+    (
+        VdafInstance.fixed_point_vec(length=2, bits=16),
+        [[100, -50], [25, 75]],
+        [125 / 32768, 25 / 32768],
+    ),
+    (VdafInstance("sum", bits=8, xof_mode="draft"), [9, 30], 39),
+]
+CASE_IDS = [
+    "count",
+    "sum",
+    "sumvec",
+    "countvec",
+    "histogram",
+    "fixedpoint",
+    "sum-draft-xof",
 ]
 
 
-@pytest.mark.parametrize("vdaf,measurements,expected", CASES, ids=["count", "histogram"])
+@pytest.mark.parametrize("vdaf,measurements,expected", CASES, ids=CASE_IDS)
 def test_full_protocol_round_trip(pair, vdaf, measurements, expected):
     leader_task, helper_task, collector_kp = provision(pair, vdaf)
     http = HttpClient()
@@ -156,13 +181,10 @@ def test_full_protocol_round_trip(pair, vdaf, measurements, expected):
 
     result = collector.poll_once(job_id, query)
     assert result.report_count == len(measurements)
-    if vdaf.kind == "count":
-        assert result.aggregate_result == expected
+    if vdaf.kind == "fixedpoint":
+        assert result.aggregate_result == pytest.approx(expected)
     else:
-        want = [0] * vdaf.length
-        for m in measurements:
-            want[m] += 1
-        assert result.aggregate_result == want
+        assert result.aggregate_result == expected
 
 
 def test_upload_rejections(pair):

@@ -1,0 +1,184 @@
+"""Multi-round prepare (continue) machinery over the live pair, driven
+by the two-round fake VDAF — the same way the reference exercises
+aggregation_job_continue.rs with dummy_vdaf: WaitingLeader/WaitingHelper
+states, ord-matched AggregationJobContinueReq, step validation, replay
+idempotency, accumulate-at-finish."""
+
+import pytest
+
+from janus_tpu.aggregator.aggregation_job_creator import (
+    AggregationJobCreator,
+    AggregationJobCreatorConfig,
+)
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.collector import Collector, CollectorParameters
+from janus_tpu.core.http_client import HttpClient
+from janus_tpu.datastore.models import ReportAggregationState
+from janus_tpu.messages import (
+    AggregationJobContinueReq,
+    AggregationJobStep,
+    Duration,
+    Interval,
+    Query,
+    Time,
+)
+from janus_tpu.vdaf.registry import VdafInstance
+
+from test_e2e import pair, provision  # noqa: F401  (fixture + helper)
+
+VDAF = VdafInstance.fake_two_round()
+
+
+def _upload(pair, leader_task, measurements):
+    http = HttpClient()
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, VDAF, http, clock=pair["clock"])
+    for m in measurements:
+        client.upload(m)
+    return http, params
+
+
+def _continue_url(pair, leader_task, job_id_bytes):
+    import base64
+
+    b64 = lambda b: base64.urlsafe_b64encode(b).decode().rstrip("=")
+    return (
+        pair["helper_srv"].url.rstrip("/")
+        + f"/tasks/{b64(leader_task.task_id.data)}/aggregation_jobs/{b64(job_id_bytes)}"
+    )
+
+
+def _states(ds, task_id, job_id):
+    ras = ds.run_tx(lambda tx: tx.get_report_aggregations_for_job(task_id, job_id))
+    return [ra.state for ra in ras]
+
+
+def test_two_round_full_protocol(pair):
+    leader_task, helper_task, collector_kp = provision(pair, VDAF)
+    measurements = [1, 0, 1, 1]
+    http, params = _upload(pair, leader_task, measurements)
+
+    creator = AggregationJobCreator(
+        pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+    )
+    assert creator.run_once() == 1
+    driver = AggregationJobDriver(pair["leader_ds"], http)
+    jd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1), driver.acquirer(), driver.stepper
+    )
+
+    # step 1: init round — both sides park in Waiting*
+    assert jd.run_once() == 1
+    job = pair["leader_ds"].run_tx(
+        lambda tx: tx.get_aggregation_jobs_for_task(leader_task.task_id)
+    )[0]
+    assert set(_states(pair["leader_ds"], leader_task.task_id, job.job_id)) == {
+        ReportAggregationState.WAITING_LEADER
+    }
+    assert set(_states(pair["helper_ds"], helper_task.task_id, job.job_id)) == {
+        ReportAggregationState.WAITING_HELPER
+    }
+
+    # step 2: continue round — both sides finish, shares accumulate
+    assert jd.run_once() == 1
+    assert set(_states(pair["leader_ds"], leader_task.task_id, job.job_id)) == {
+        ReportAggregationState.FINISHED
+    }
+    assert set(_states(pair["helper_ds"], helper_task.task_id, job.job_id)) == {
+        ReportAggregationState.FINISHED
+    }
+
+    # collect end-to-end (the fake runs the Count circuit)
+    clock = pair["clock"]
+    start = Time(clock.now().seconds).to_batch_interval_start(leader_task.time_precision)
+    query = Query.time_interval(Interval(Time(start.seconds - 3600), Duration(2 * 3600)))
+    collector = Collector(
+        CollectorParameters(
+            leader_task.task_id,
+            pair["leader_srv"].url,
+            leader_task.collector_auth_token,
+            collector_kp,
+        ),
+        VDAF,
+        http,
+    )
+    job_id = collector.start_collection(query)
+    cdriver = CollectionJobDriver(pair["leader_ds"], http)
+    cjd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1), cdriver.acquirer(), cdriver.stepper
+    )
+    assert cjd.run_once() == 1
+    result = collector.poll_once(job_id, query)
+    assert result.report_count == len(measurements)
+    assert result.aggregate_result == sum(measurements)
+
+
+def test_continue_step_and_order_validation(pair):
+    leader_task, helper_task, _ = provision(pair, VDAF)
+    http, params = _upload(pair, leader_task, [1, 1])
+    creator = AggregationJobCreator(
+        pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+    )
+    assert creator.run_once() == 1
+
+    captured = {}
+
+    class CapturingHttp(HttpClient):
+        def post(self, url, body, headers=None, timeout=None):
+            if "aggregation_jobs" in url:
+                captured["url"] = url
+                captured["body"] = body
+                captured["headers"] = headers
+            return super().post(url, body, headers, timeout=timeout)
+
+    chttp = CapturingHttp()
+    driver = AggregationJobDriver(pair["leader_ds"], chttp)
+    jd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1), driver.acquirer(), driver.stepper
+    )
+    assert jd.run_once() == 1  # init round; reports parked
+
+    job = pair["leader_ds"].run_tx(
+        lambda tx: tx.get_aggregation_jobs_for_task(leader_task.task_id)
+    )[0]
+    url = _continue_url(pair, leader_task, job.job_id.data)
+    headers = {
+        "Content-Type": AggregationJobContinueReq.MEDIA_TYPE,
+        **leader_task.aggregator_auth_token.request_headers(),
+    }
+
+    # step 0 is never a valid continue target
+    bad0 = AggregationJobContinueReq(AggregationJobStep(0), ())
+    status, body = http.post(url, bad0.to_bytes(), headers)
+    assert status == 400 and b"invalidMessage" in body
+
+    # skipping ahead is a step mismatch
+    bad2 = AggregationJobContinueReq(AggregationJobStep(2), ())
+    status, body = http.post(url, bad2.to_bytes(), headers)
+    assert status == 400 and b"stepMismatch" in body
+
+    # right step but wrong (empty) prepare set: ord-match rejection
+    bad_empty = AggregationJobContinueReq(AggregationJobStep(1), ())
+    status, body = http.post(url, bad_empty.to_bytes(), headers)
+    assert status == 400 and b"invalidMessage" in body
+
+    # drive the real continue; capture the leader's request bytes
+    assert jd.run_once() == 1
+    assert "body" in captured
+    status1, body1 = chttp.post(captured["url"], captured["body"], captured["headers"])
+    # identical replay of the continue request: idempotent 200, same resp
+    assert status1 == 200
+    status2, body2 = http.post(captured["url"], captured["body"], captured["headers"])
+    assert status2 == 200 and body2 == body1
+
+    # same step, different request: step mismatch (replay guard)
+    status, body = http.post(url, bad_empty.to_bytes(), headers)
+    assert status == 400 and b"stepMismatch" in body
