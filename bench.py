@@ -88,6 +88,17 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
         leader_eph.datastore.run_tx(lambda tx: tx.put_task(leader_task))
         helper_eph.datastore.run_tx(lambda tx: tx.put_task(helper_task))
 
+        # warm both aggregators' engines before timing (production boots
+        # with warmup_engines_at_boot; first-compile must not pollute
+        # the steady-state serving numbers)
+        from janus_tpu.binary_utils import warmup_engines
+
+        t0 = _time.time()
+        warmup_engines(leader_eph.datastore, batch=job_size)
+        warmup_engines(helper_eph.datastore, batch=job_size)
+        warmup_s = _time.time() - t0
+        progress["t"] = time.monotonic()
+
         rng = np.random.default_rng(0x5E12)
         meas = random_measurements(inst, n_reports, rng)
         t0 = _time.time()
@@ -163,6 +174,7 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
         assert result.report_count == n_reports, result.report_count
         return {
             "n_reports": n_reports,
+            "warmup_s": round(warmup_s, 2),
             "stage_s": round(stage_s, 2),
             "upload_rps": round(n_reports / upload_s, 2),
             "served_aggregate_rps": round(n_reports / aggregate_s, 2),

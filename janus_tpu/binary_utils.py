@@ -107,16 +107,20 @@ def setup_signal_handler(stopper: Stopper) -> None:
     signal.signal(signal.SIGINT, handle)
 
 
-def warmup_engines(ds) -> None:
+def warmup_engines(ds, batch: int | None = None) -> None:
     """Compile the device engine steps for every provisioned task before
     serving traffic (cold-start mitigation: a cold aggregator otherwise
     stalls for minutes on first request per task). With the persistent
-    compilation cache, restarts reduce this to disk loads."""
+    compilation cache, restarts reduce this to disk loads.
+
+    batch selects the bucket to warm (engines compile per power-of-two
+    batch bucket); default MIN_BUCKET."""
     import numpy as np
 
     from .aggregator.engine_cache import MIN_BUCKET, engine_cache
     from .vdaf.testing import make_report_batch, random_measurements
 
+    warm_batch = batch or MIN_BUCKET
     tasks = ds.run_tx(lambda tx: tx.get_tasks(), "warmup_list_tasks")
     for task in tasks:
         if task.vdaf.kind.startswith("fake") or task.vdaf.xof_mode != "fast":
@@ -125,12 +129,12 @@ def warmup_engines(ds) -> None:
             eng = engine_cache(task.vdaf, task.vdaf_verify_key)
             rng = np.random.default_rng(0)
             args, _ = make_report_batch(
-                task.vdaf, random_measurements(task.vdaf, MIN_BUCKET, rng), seed=0
+                task.vdaf, random_measurements(task.vdaf, warm_batch, rng), seed=0
             )
             nonce, parts, meas, proof, blind0, hseed, blind1 = args
             out0, seed0, ver0, part0 = eng.leader_init(nonce, parts, meas, proof, blind0)
-            ok = np.ones(MIN_BUCKET, dtype=bool)
-            part0_l = part0 if part0 is not None else np.zeros((MIN_BUCKET, 2), dtype=np.uint64)
+            ok = np.ones(warm_batch, dtype=bool)
+            part0_l = part0 if part0 is not None else np.zeros((warm_batch, 2), dtype=np.uint64)
             eng.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
             eng.aggregate(out0, ok)
             log.info("warmed engines for task %s (%s)", task.task_id, task.vdaf.kind)
