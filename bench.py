@@ -93,9 +93,15 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
         # the steady-state serving numbers)
         from janus_tpu.binary_utils import warmup_engines
 
+        # warm every batch bucket the run will actually use: full jobs
+        # of job_size and the remainder job (bucketed separately)
+        warm_sizes = {min(job_size, n_reports)}
+        if n_reports % job_size:
+            warm_sizes.add(n_reports % job_size)
         t0 = _time.time()
-        warmup_engines(leader_eph.datastore, batch=job_size)
-        warmup_engines(helper_eph.datastore, batch=job_size)
+        for ws in sorted(warm_sizes):
+            warmup_engines(leader_eph.datastore, batch=ws)
+            warmup_engines(helper_eph.datastore, batch=ws)
         warmup_s = _time.time() - t0
         progress["t"] = time.monotonic()
 

@@ -82,11 +82,18 @@ class EngineCache:
                 prep_msg = jnp.zeros((nonce_lanes.shape[0], 2), dtype=jnp.uint64)
             return out1, mask, prep_msg
 
+        from ..trace import span
+
         fn = self._jit("helper_init", step)
         args = pad_args(b, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask)
-        out1, mask, prep_msg = fn(*args)
-        out1 = tuple(np.asarray(x)[:n] for x in out1)
-        return out1, np.asarray(mask)[:n], np.asarray(prep_msg)[:n]
+        # the np.asarray conversions block on device execution — they
+        # must sit inside the span or it measures only async dispatch
+        with span("engine.helper_init", vdaf=self.inst.kind, batch=n, bucket=b):
+            out1, mask, prep_msg = fn(*args)
+            out1 = tuple(np.asarray(x)[:n] for x in out1)
+            mask = np.asarray(mask)[:n]
+            prep_msg = np.asarray(prep_msg)[:n]
+        return out1, mask, prep_msg
 
     # --- leader side: init only (network round trip follows) ---
     def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0, ok=None):
@@ -102,13 +109,17 @@ class EngineCache:
                 self.verify_key, nonce_lanes, public_parts, meas, proof, blind0
             )
 
+        from ..trace import span
+
         fn = self._jit("leader_init", step)
         args = pad_args(b, nonce_lanes, public_parts, meas, proof, blind0)
-        out0, seed0, ver0, part0 = fn(*args)
-        out0 = tuple(np.asarray(x)[:n] for x in out0)
-        seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
-        ver0 = tuple(np.asarray(x)[:n] for x in ver0)
-        part0 = np.asarray(part0)[:n] if part0 is not None else None
+        # conversions block on device execution — keep inside the span
+        with span("engine.leader_init", vdaf=self.inst.kind, batch=n, bucket=b):
+            out0, seed0, ver0, part0 = fn(*args)
+            out0 = tuple(np.asarray(x)[:n] for x in out0)
+            seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
+            ver0 = tuple(np.asarray(x)[:n] for x in ver0)
+            part0 = np.asarray(part0)[:n] if part0 is not None else None
         return out0, seed0, ver0, part0
 
     # --- masked aggregate over the batch axis ---

@@ -154,13 +154,16 @@ class DapHttpApp:
 
         from .. import metrics
 
+        from ..trace import span
+
         route = "none"
         for m, rx, name in _ROUTES:
             if m == method and rx.match(path):
                 route = name
                 break
         start = monotonic()
-        result = self._handle(method, path, query, headers, body)
+        with span(f"dap.{route}", method=method):
+            result = self._handle(method, path, query, headers, body)
         metrics.http_request_duration.observe(monotonic() - start, route=route)
         metrics.http_request_counter.add(route=route, status=str(result[0]))
         return result

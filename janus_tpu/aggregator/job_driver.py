@@ -91,8 +91,11 @@ class JobDriver:
         return len(jobs)
 
     def _step_one(self, acquired) -> None:
+        from ..trace import span
+
         try:
-            self.stepper(acquired)
+            with span("job.step", job=type(acquired).__name__):
+                self.stepper(acquired)
         except Exception:
             log.exception("job step failed (lease will expire and retry)")
 

@@ -1,19 +1,24 @@
-"""Structured logging setup.
+"""Structured logging + span tracing.
 
 Equivalent of the reference's tracing subscriber installation
 (aggregator/src/trace.rs:44-90): pretty or JSON line format, level
-from config or the JANUS_LOG env var (the RUST_LOG analog). The
-Chrome-trace/tokio-console layers map to the JAX profiler
-(jax.profiler.trace emits Perfetto files); see docs/OBSERVABILITY.md.
+from config or the JANUS_LOG env var (the RUST_LOG analog), and a
+**Chrome trace-file layer** (trace.rs:68-71): host-side spans —
+request handlers, job steps, engine calls — written as Chrome
+trace-event JSON, loadable in chrome://tracing or Perfetto alongside
+the device-side `jax.profiler.trace` output (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
 import sys
+import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 
 
@@ -24,6 +29,10 @@ class TraceConfiguration:
     use_test_writer: bool = False
     force_json_output: bool = False
     level: str = "INFO"
+    # Path for host-side span output in Chrome trace-event format
+    # (reference trace.rs:68-71 ChromeLayer); None disables. The
+    # JANUS_CHROME_TRACE env var overrides.
+    chrome_trace_file: str | None = None
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "TraceConfiguration":
@@ -32,7 +41,83 @@ class TraceConfiguration:
             use_test_writer=bool(d.get("use_test_writer", False)),
             force_json_output=bool(d.get("force_json_output", False)),
             level=str(d.get("level", "INFO")),
+            chrome_trace_file=d.get("chrome_trace_file"),
         )
+
+
+class ChromeTraceWriter:
+    """Streams complete ('X') trace events; the file is a JSON array
+    readable by chrome://tracing and Perfetto even if the tail comma
+    is left dangling on crash."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "w")
+        self._f.write("[\n")
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._closed = False
+
+    def event(self, name: str, ts_us: float, dur_us: float, args: dict) -> None:
+        doc = {
+            "name": name,
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": self._pid,
+            "tid": threading.get_ident() % 1_000_000,
+            "args": args,
+        }
+        with self._lock:
+            if self._closed:
+                return  # a daemon thread's span outlived the writer
+            try:
+                self._f.write(json.dumps(doc) + ",\n")
+                self._f.flush()
+            except ValueError:
+                self._closed = True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._f.write("{}]\n")
+                self._f.close()
+            except ValueError:
+                pass  # already closed
+
+
+_chrome_writer: ChromeTraceWriter | None = None
+
+
+def install_chrome_trace(path: str) -> None:
+    """Install the process-wide span writer. The PID is embedded in the
+    filename: several processes sharing one configured path (leader +
+    helper on a host) must not truncate/interleave each other's files."""
+    global _chrome_writer
+    root, ext = os.path.splitext(path)
+    path = f"{root}.{os.getpid()}{ext or '.json'}"
+    if _chrome_writer is not None:
+        _chrome_writer.close()
+    _chrome_writer = ChromeTraceWriter(path)
+    atexit.register(_chrome_writer.close)
+
+
+@contextmanager
+def span(name: str, **args):
+    """Record a host-side span (no-op unless a Chrome trace file is
+    installed — the `if enabled` cost is one global read)."""
+    w = _chrome_writer
+    if w is None:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter_ns()
+        w.event(name, t0 / 1000.0, (t1 - t0) / 1000.0, args)
 
 
 class JsonFormatter(logging.Formatter):
@@ -52,6 +137,9 @@ class JsonFormatter(logging.Formatter):
 def install_trace_subscriber(config: TraceConfiguration | None = None) -> None:
     """Install the root logging handler (idempotent)."""
     config = config or TraceConfiguration()
+    chrome = os.environ.get("JANUS_CHROME_TRACE", config.chrome_trace_file)
+    if chrome:
+        install_chrome_trace(chrome)
     level = os.environ.get("JANUS_LOG", config.level).upper()
     root = logging.getLogger()
     root.setLevel(level)

@@ -1,0 +1,79 @@
+"""Chrome trace-file span layer (the reference's trace.rs:68-71
+ChromeLayer analog): spans stream as Chrome trace events that load in
+chrome://tracing / Perfetto next to jax.profiler device traces."""
+
+import json
+
+from janus_tpu import trace as trace_mod
+from janus_tpu.trace import TraceConfiguration, install_chrome_trace, span
+
+
+def _trace_file(base):
+    """install_chrome_trace embeds the PID in the filename."""
+    import glob
+    import os
+
+    root, ext = os.path.splitext(str(base))
+    matches = glob.glob(f"{root}.{os.getpid()}{ext or '.json'}")
+    assert matches, f"no trace file for {base}"
+    return matches[0]
+
+
+def _read_events(path):
+    raw = open(path).read().rstrip()
+    if not raw.endswith("]"):
+        raw += "{}]"  # crash-tolerant tail
+    return [e for e in json.loads(raw) if e]
+
+
+def test_spans_stream_chrome_events(tmp_path):
+    out = tmp_path / "trace.json"
+    install_chrome_trace(str(out))
+    try:
+        with span("outer", kind="test"):
+            with span("inner", n=3):
+                pass
+    finally:
+        trace_mod._chrome_writer.close()
+        trace_mod._chrome_writer = None
+
+    events = _read_events(_trace_file(out))
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"outer", "inner"}
+    assert by_name["outer"]["ph"] == "X"
+    assert by_name["outer"]["args"] == {"kind": "test"}
+    assert by_name["inner"]["args"] == {"n": 3}
+    # inner nests inside outer on the timeline
+    o, i = by_name["outer"], by_name["inner"]
+    assert o["ts"] <= i["ts"] and i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 50
+
+
+def test_span_is_noop_without_writer():
+    assert trace_mod._chrome_writer is None
+    with span("ignored"):
+        pass  # must not raise or write anywhere
+
+
+def test_handlers_emit_spans(tmp_path):
+    """The DAP router wraps every request in a dap.<route> span."""
+    out = tmp_path / "http.json"
+    install_chrome_trace(str(out))
+    try:
+        from janus_tpu.aggregator.http_handlers import DapHttpApp
+
+        class _NoAgg:
+            pass
+
+        app = DapHttpApp(_NoAgg())
+        status, _, _ = app.handle("OPTIONS", "/hpke_config", {}, {}, b"")
+        assert status == 204
+    finally:
+        trace_mod._chrome_writer.close()
+        trace_mod._chrome_writer = None
+    events = _read_events(_trace_file(out))
+    assert any(e["name"] == "dap.none" or e["name"].startswith("dap.") for e in events)
+
+
+def test_config_plumbs_chrome_trace_file(tmp_path):
+    cfg = TraceConfiguration.from_dict({"chrome_trace_file": str(tmp_path / "t.json")})
+    assert cfg.chrome_trace_file == str(tmp_path / "t.json")
