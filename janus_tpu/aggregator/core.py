@@ -156,7 +156,10 @@ class TaskAggregator:
                 aad,
             )
             payload = PlaintextInputShare.from_bytes(plaintext).payload
-            self.wire.decode_leader_share(payload)
+            # columnar validation, not scalar decode: the full Python
+            # decode was the measured upload bottleneck (BASELINE.md
+            # served table)
+            self.wire.validate_leader_share(payload)
         except (HpkeError, DecodeError) as e:
             metrics.upload_decrypt_failure_counter.add()
             raise errors.ReportRejected(f"undecryptable/undecodable share: {e}", task.task_id)

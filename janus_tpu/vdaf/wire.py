@@ -54,6 +54,19 @@ def encode_field_rows(jf, value) -> list[bytes]:
     return [row.tobytes() for row in le]
 
 
+def lanes_in_range(lanes: np.ndarray, modulus: int, limbs: int) -> np.ndarray:
+    """Element-wise `value < modulus` over little-endian u64 lane arrays
+    shaped [..., n*limbs]. Single home for the two-limb lexicographic
+    compare so upload validation and driver staging can't diverge."""
+    if limbs == 1:
+        return lanes < np.uint64(modulus)
+    r = lanes.reshape(lanes.shape[:-1] + (-1, 2))
+    lo, hi = r[..., 0], r[..., 1]
+    p_lo = np.uint64(modulus & 0xFFFFFFFFFFFFFFFF)
+    p_hi = np.uint64(modulus >> 64)
+    return (hi < p_hi) | ((hi == p_hi) & (lo < p_lo))
+
+
 def decode_field_rows(jf, rows: list[bytes], n: int):
     """Per-row encodings -> host numpy limb tuple [batch, n] (validated).
 
@@ -70,18 +83,12 @@ def decode_field_rows(jf, rows: list[bytes], n: int):
             continue
         lanes[i] = np.frombuffer(row, dtype="<u8")
         ok[i] = True
+    ok &= lanes_in_range(lanes, jf.MODULUS, jf.LIMBS).all(axis=-1)
     if jf.LIMBS == 1:
         limbs = (lanes,)
-        in_range = lanes < np.uint64(jf.MODULUS)
-        ok &= in_range.all(axis=1)
     else:
         r = lanes.reshape(batch, n, 2)
-        lo, hi = r[:, :, 0], r[:, :, 1]
-        p_lo = np.uint64(jf.MODULUS & 0xFFFFFFFFFFFFFFFF)
-        p_hi = np.uint64(jf.MODULUS >> 64)
-        in_range = (hi < p_hi) | ((hi == p_hi) & (lo < p_lo))
-        ok &= in_range.all(axis=1)
-        limbs = (np.ascontiguousarray(lo), np.ascontiguousarray(hi))
+        limbs = (np.ascontiguousarray(r[:, :, 0]), np.ascontiguousarray(r[:, :, 1]))
     # zero out bad rows so device math stays in range
     for l in limbs:
         l[~ok] = 0
@@ -181,6 +188,22 @@ class Prio3Wire:
     def encode_leader_share_raw(self, encoded_meas_proof: bytes, blind: bytes | None) -> bytes:
         """Column path: meas||proof row already encoded (encode_field_rows)."""
         return encoded_meas_proof + (blind if self.uses_jr else b"")
+
+    def validate_leader_share(self, raw: bytes) -> None:
+        """Length + field-range validation without scalar decoding.
+
+        The upload handler only needs to know the share is well-formed
+        (the stored payload is re-staged columnar by the driver); the
+        full scalar decode of a 16k-element share costs ~100ms/report
+        in Python and was the measured upload bottleneck. numpy checks
+        the same conditions in microseconds."""
+        if len(raw) != self.leader_share_len:
+            raise DecodeError("bad leader share length")
+        n = self.circ.input_len + self.circ.proof_len
+        lanes = np.frombuffer(raw[: n * self.enc_size], dtype="<u8")
+        limbs = self.enc_size // 8
+        if not bool(lanes_in_range(lanes, self.circ.FIELD.MODULUS, limbs).all()):
+            raise DecodeError("leader share element out of field range")
 
     def decode_leader_share(self, raw: bytes) -> tuple[list[int], list[int], bytes | None]:
         F = self.circ.FIELD
