@@ -150,8 +150,10 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             ),
         )
         driver = AggregationJobDriver(leader_eph.datastore, http)
+        # two workers: job B's host->device staging transfer overlaps
+        # job A's helper round trip + datastore writes
         jd = JobDriver(
-            JobDriverConfig(max_concurrent_job_workers=1),
+            JobDriverConfig(max_concurrent_job_workers=2),
             driver.acquirer(),
             driver.stepper,
         )

@@ -117,50 +117,9 @@ class AggregationJobDriver:
             )
             raise
 
-    # --- the step (reference :102-726) ---
-    def step_aggregation_job(self, acquired: AcquiredAggregationJob) -> None:
-        # tx1: read everything (reference :144-233)
-        def read(tx):
-            task = tx.get_task(acquired.task_id)
-            job = tx.get_aggregation_job(acquired.task_id, acquired.job_id)
-            ras = tx.get_report_aggregations_for_job(acquired.task_id, acquired.job_id)
-            reports = {}
-            for ra in ras:
-                if ra.state == ReportAggregationState.START:
-                    reports[ra.report_id.data] = tx.get_client_report(
-                        acquired.task_id, ra.report_id
-                    )
-            return task, job, ras, reports
-
-        task, job, ras, reports = self.ds.run_tx(read, "step_agg_job_read")
-        if job is None or task is None:
-            raise RuntimeError("job or task vanished while leased")
-        if job.state != AggregationJobState.IN_PROGRESS:
-            self.ds.run_tx(lambda tx: tx.release_aggregation_job(acquired), "release")
-            return
-
-        wire = Prio3Wire(circuit_for(task.vdaf))
-        engine = engine_cache(task.vdaf, task.vdaf_verify_key)
-
-        # multi-round jobs park accepted reports in WaitingLeader after
-        # init; a later step sends the continue request (reference
-        # :439-514 CONTINUE path)
-        waiting = [ra for ra in ras if ra.state == ReportAggregationState.WAITING_LEADER]
-        if waiting:
-            self._continue_step(acquired, task, job, waiting)
-            return
-
-        pending = [ra for ra in ras if ra.state == ReportAggregationState.START]
-        if not pending:
-            # nothing to do; mark job finished
-            def finish_empty(tx):
-                tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
-                tx.release_aggregation_job(acquired)
-
-            self.ds.run_tx(finish_empty, "step_agg_job_finish_empty")
-            return
-
-        # columnar staging of stored leader shares
+    def _stage_pending(self, task, wire, engine, pending, reports):
+        """Columnar staging of stored leader shares -> device-ready
+        arrays + per-report failure marks."""
         n = len(pending)
         meas_rows: list[bytes | None] = [None] * n
         proof_rows: list[bytes | None] = [None] * n
@@ -211,6 +170,67 @@ class AggregationJobDriver:
         else:
             blind_lanes = None
             public_parts = None
+        return meas, proof, nonce_lanes, blind_lanes, public_parts, ok, failed
+
+    # --- the step (reference :102-726) ---
+    def step_aggregation_job(self, acquired: AcquiredAggregationJob) -> None:
+        # tx1: read everything (reference :144-233)
+        def read(tx):
+            task = tx.get_task(acquired.task_id)
+            job = tx.get_aggregation_job(acquired.task_id, acquired.job_id)
+            ras = tx.get_report_aggregations_for_job(acquired.task_id, acquired.job_id)
+            reports = {}
+            for ra in ras:
+                if ra.state == ReportAggregationState.START:
+                    reports[ra.report_id.data] = tx.get_client_report(
+                        acquired.task_id, ra.report_id
+                    )
+            return task, job, ras, reports
+
+        from ..trace import span
+
+        with span("driver.read_tx"):
+            task, job, ras, reports = self.ds.run_tx(read, "step_agg_job_read")
+        if job is None or task is None:
+            raise RuntimeError("job or task vanished while leased")
+        if job.state != AggregationJobState.IN_PROGRESS:
+            self.ds.run_tx(lambda tx: tx.release_aggregation_job(acquired), "release")
+            return
+
+        wire = Prio3Wire(circuit_for(task.vdaf))
+        engine = engine_cache(task.vdaf, task.vdaf_verify_key)
+
+        # multi-round jobs park accepted reports in WaitingLeader after
+        # init; a later step sends the continue request (reference
+        # :439-514 CONTINUE path)
+        waiting = [ra for ra in ras if ra.state == ReportAggregationState.WAITING_LEADER]
+        if waiting:
+            self._continue_step(acquired, task, job, waiting)
+            return
+
+        pending = [ra for ra in ras if ra.state == ReportAggregationState.START]
+        if not pending:
+            # nothing to do; mark job finished
+            def finish_empty(tx):
+                tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
+                tx.release_aggregation_job(acquired)
+
+            self.ds.run_tx(finish_empty, "step_agg_job_finish_empty")
+            return
+
+        # columnar staging of stored leader shares
+        n = len(pending)
+        with span("driver.stage", batch=n):
+            (
+                meas,
+                proof,
+                nonce_lanes,
+                blind_lanes,
+                public_parts,
+                ok,
+                failed,
+            ) = self._stage_pending(task, wire, engine, pending, reports)
+        jf = engine.p3.jf
 
         # device: batched leader prepare-init (reference hot loop :329-402)
         out0, seed0, ver0, part0 = engine.leader_init(
@@ -218,32 +238,33 @@ class AggregationJobDriver:
         )
 
         # build + send the init request (reference :404-424)
-        ver0_rows = encode_field_rows(jf, ver0)
-        part0_rows = (
-            [row.tobytes() for row in np.asarray(part0, dtype="<u8")]
-            if wire.uses_jr
-            else [None] * n
-        )
-        prep_inits = []
-        send_idx = []
-        for i, ra in enumerate(pending):
-            if failed[i] is not None or not ok[i]:
-                if failed[i] is None:
-                    failed[i] = PrepareError.INVALID_MESSAGE
-                continue
-            rep = reports[ra.report_id.data]
-            prep_share = wire.encode_prep_share_raw(ver0_rows[i], part0_rows[i])
-            prep_inits.append(
-                PrepareInit(
-                    ReportShare(
-                        ReportMetadata(ra.report_id, ra.client_time),
-                        rep.public_share,
-                        rep.helper_encrypted_input_share,
-                    ),
-                    encode_pingpong(PP_INITIALIZE, None, prep_share),
-                )
+        with span("driver.encode_init", batch=n):
+            ver0_rows = encode_field_rows(jf, ver0)
+            part0_rows = (
+                [row.tobytes() for row in np.asarray(part0, dtype="<u8")]
+                if wire.uses_jr
+                else [None] * n
             )
-            send_idx.append(i)
+            prep_inits = []
+            send_idx = []
+            for i, ra in enumerate(pending):
+                if failed[i] is not None or not ok[i]:
+                    if failed[i] is None:
+                        failed[i] = PrepareError.INVALID_MESSAGE
+                    continue
+                rep = reports[ra.report_id.data]
+                prep_share = wire.encode_prep_share_raw(ver0_rows[i], part0_rows[i])
+                prep_inits.append(
+                    PrepareInit(
+                        ReportShare(
+                            ReportMetadata(ra.report_id, ra.client_time),
+                            rep.public_share,
+                            rep.helper_encrypted_input_share,
+                        ),
+                        encode_pingpong(PP_INITIALIZE, None, prep_share),
+                    )
+                )
+                send_idx.append(i)
 
         multi_round = task.vdaf.rounds > 1
         accept = np.zeros(n, dtype=bool)
@@ -254,9 +275,10 @@ class AggregationJobDriver:
                 PartialBatchSelector.from_bytes(job.partial_batch_identifier),
                 tuple(prep_inits),
             )
-            resp = self._send_init_request(
-                task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
-            )
+            with span("driver.http_init", reports=len(prep_inits)):
+                resp = self._send_init_request(
+                    task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
+                )
             by_id = {pr.report_id: pr for pr in resp.prepare_resps}
             # process response (reference :530-726), host-side lane checks
             for k, i in enumerate(send_idx):
@@ -347,9 +369,10 @@ class AggregationJobDriver:
         metadatas = [ReportMetadata(ra.report_id, ra.client_time) for ra in pending]
         pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
         fixed_bid = fixed_size_batch_id(pbs)
-        accumulate_batched(
-            task, engine, accumulator, out0, accept, metadatas, batch_identifier=fixed_bid
-        )
+        with span("driver.accumulate", batch=n):
+            accumulate_batched(
+                task, engine, accumulator, out0, accept, metadatas, batch_identifier=fixed_bid
+            )
 
         # tx2: write results + release (reference :698-724)
         new_ras = []
@@ -373,7 +396,8 @@ class AggregationJobDriver:
             tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
             tx.release_aggregation_job(acquired)
 
-        self.ds.run_tx(write, "step_agg_job_write")
+        with span("driver.write_tx", batch=n):
+            self.ds.run_tx(write, "step_agg_job_write")
 
     def _continue_step(self, acquired, task: Task, job, waiting) -> None:
         """Send the ord-matched continue request for WaitingLeader rows

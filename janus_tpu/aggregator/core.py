@@ -91,7 +91,9 @@ class Config:
     """reference aggregator.rs:186-218."""
 
     max_upload_batch_size: int = 100
-    max_upload_batch_write_delay_ms: int = 250
+    # 0 = pure group commit (the reference's default write delay,
+    # aggregator.rs:186-218); >0 adds a coalescing window
+    max_upload_batch_write_delay_ms: int = 0
     batch_aggregation_shard_count: int = 1
     taskprov_enabled: bool = False
 
@@ -147,19 +149,22 @@ class TaskAggregator:
         keypair = self._hpke_keypair(report.leader_encrypted_input_share.config_id)
         if keypair is None:
             raise errors.OutdatedHpkeConfig("unknown HPKE config id", task.task_id)
+        from ..trace import span
+
         aad = InputShareAad(task.task_id, report.metadata, report.public_share).to_bytes()
         try:
-            plaintext = hpke_open(
-                keypair,
-                HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
-                report.leader_encrypted_input_share,
-                aad,
-            )
-            payload = PlaintextInputShare.from_bytes(plaintext).payload
-            # columnar validation, not scalar decode: the full Python
-            # decode was the measured upload bottleneck (BASELINE.md
-            # served table)
-            self.wire.validate_leader_share(payload)
+            with span("upload.hpke_validate"):
+                plaintext = hpke_open(
+                    keypair,
+                    HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+                    report.leader_encrypted_input_share,
+                    aad,
+                )
+                payload = PlaintextInputShare.from_bytes(plaintext).payload
+                # columnar validation, not scalar decode: the full Python
+                # decode was the measured upload bottleneck (BASELINE.md
+                # served table)
+                self.wire.validate_leader_share(payload)
         except (HpkeError, DecodeError) as e:
             metrics.upload_decrypt_failure_counter.add()
             raise errors.ReportRejected(f"undecryptable/undecodable share: {e}", task.task_id)
@@ -174,10 +179,11 @@ class TaskAggregator:
             payload,
             report.helper_encrypted_input_share,
         )
-        if writer is not None:
-            fresh = writer.write_report(stored)  # batched tx (report_writer.rs)
-        else:
-            fresh = ds.run_tx(lambda tx: tx.put_client_report(stored), "upload")
+        with span("upload.write"):
+            if writer is not None:
+                fresh = writer.write_report(stored)  # batched tx (report_writer.rs)
+            else:
+                fresh = ds.run_tx(lambda tx: tx.put_client_report(stored), "upload")
         if not fresh:
             # Replay is silent success: client retries are a normal
             # at-least-once-HTTP occurrence, not an error (DAP-07
@@ -223,6 +229,8 @@ class TaskAggregator:
         now = clock.now()
         prep_err = [None] * n  # per-report PrepareError or None
 
+        from ..trace import span
+
         # host-side staging: HPKE open + decode columns (the per-report
         # failure modes become mask lanes; reference :1633-1768)
         helper_seed_rows: list[bytes | None] = [None] * n
@@ -230,60 +238,60 @@ class TaskAggregator:
         part_rows0: list[bytes | None] = [None] * n  # public part 0
         part_rows1: list[bytes | None] = [None] * n
         leader_prep_rows: list[bytes | None] = [None] * n
-        for i, pi in enumerate(inits):
-            rs = pi.report_share
-            md = rs.metadata
-            if task.task_expiration and md.time > task.task_expiration:
-                prep_err[i] = PrepareError.TASK_EXPIRED
-                continue
-            if task.report_expired(md.time, now):
-                prep_err[i] = PrepareError.REPORT_DROPPED
-                continue
-            keypair = self._hpke_keypair(rs.encrypted_input_share.config_id)
-            if keypair is None:
-                prep_err[i] = PrepareError.HPKE_UNKNOWN_CONFIG_ID
-                continue
-            aad = InputShareAad(task.task_id, md, rs.public_share).to_bytes()
-            try:
-                plaintext = hpke_open(
-                    keypair,
-                    HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
-                    rs.encrypted_input_share,
-                    aad,
-                )
-            except HpkeError:
-                prep_err[i] = PrepareError.HPKE_DECRYPT_ERROR
-                continue
-            try:
-                payload = PlaintextInputShare.from_bytes(plaintext).payload
-                seed, blind = self.wire.decode_helper_share(payload)
-                parts = self.wire.decode_public_share(rs.public_share)
-                tag, _, prep_share = decode_pingpong(pi.message)
-                if tag != PP_INITIALIZE or prep_share is None:
-                    raise DecodeError("expected ping-pong initialize")
-            except DecodeError:
-                prep_err[i] = PrepareError.INVALID_MESSAGE
-                continue
-            helper_seed_rows[i] = seed
-            blind_rows[i] = blind
-            if self.wire.uses_jr:
-                part_rows0[i] = parts[0]
-                part_rows1[i] = parts[1]
-            leader_prep_rows[i] = prep_share
+        with span("helper.hpke_stage", batch=n):
+            for i, pi in enumerate(inits):
+                rs = pi.report_share
+                md = rs.metadata
+                if task.task_expiration and md.time > task.task_expiration:
+                    prep_err[i] = PrepareError.TASK_EXPIRED
+                    continue
+                if task.report_expired(md.time, now):
+                    prep_err[i] = PrepareError.REPORT_DROPPED
+                    continue
+                keypair = self._hpke_keypair(rs.encrypted_input_share.config_id)
+                if keypair is None:
+                    prep_err[i] = PrepareError.HPKE_UNKNOWN_CONFIG_ID
+                    continue
+                aad = InputShareAad(task.task_id, md, rs.public_share).to_bytes()
+                try:
+                    plaintext = hpke_open(
+                        keypair,
+                        HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
+                        rs.encrypted_input_share,
+                        aad,
+                    )
+                except HpkeError:
+                    prep_err[i] = PrepareError.HPKE_DECRYPT_ERROR
+                    continue
+                try:
+                    payload = PlaintextInputShare.from_bytes(plaintext).payload
+                    seed, blind = self.wire.decode_helper_share(payload)
+                    parts = self.wire.decode_public_share(rs.public_share)
+                    tag, _, prep_share = decode_pingpong(pi.message)
+                    if tag != PP_INITIALIZE or prep_share is None:
+                        raise DecodeError("expected ping-pong initialize")
+                except DecodeError:
+                    prep_err[i] = PrepareError.INVALID_MESSAGE
+                    continue
+                helper_seed_rows[i] = seed
+                blind_rows[i] = blind
+                if self.wire.uses_jr:
+                    part_rows0[i] = parts[0]
+                    part_rows1[i] = parts[1]
+                leader_prep_rows[i] = prep_share
 
-        # replay check against prior aggregations (reference replay semantics)
-        def check_replays(tx):
-            out = set()
-            for i, rid in enumerate(ids):
-                if prep_err[i] is None and tx.count_report_aggregations_for_report(
-                    task.task_id, rid
-                ):
-                    out.add(i)
-            return out
-
-        replayed = ds.run_tx(check_replays, "agg_init_replay")
-        for i in replayed:
-            prep_err[i] = PrepareError.REPORT_REPLAYED
+        # replay check against prior aggregations (reference replay
+        # semantics) — one set-valued query for the whole batch, not a
+        # per-report query loop
+        fresh_ids = [rid for i, rid in enumerate(ids) if prep_err[i] is None]
+        with span("helper.replay_tx", batch=len(fresh_ids)):
+            replayed_ids = ds.run_tx(
+                lambda tx: tx.get_aggregated_report_ids(task.task_id, fresh_ids),
+                "agg_init_replay",
+            )
+        for i, rid in enumerate(ids):
+            if prep_err[i] is None and rid.data in replayed_ids:
+                prep_err[i] = PrepareError.REPORT_REPLAYED
 
         # test-only fake VDAF failure injection (the reference's
         # dummy_vdaf prep_init_fn hook, core/src/test_util/dummy_vdaf.rs:46)
@@ -293,22 +301,23 @@ class TaskAggregator:
                     prep_err[i] = PrepareError.VDAF_PREP_ERROR
 
         # columnar staging -> device
-        nonce_lanes, ok_nonce = seeds_to_lanes([rid.data for rid in ids])
-        seed_lanes, ok_seed = seeds_to_lanes(helper_seed_rows)
-        ver0, part0_lanes, ok_prep = split_prep_share_columns(
-            self.wire, self.engine.p3.jf, leader_prep_rows
-        )
-        ver0 = tuple(np.asarray(x) for x in ver0)
-        ok = ok_nonce & ok_seed & ok_prep & np.array([e is None for e in prep_err])
-        if self.wire.uses_jr:
-            blind_lanes, ok_b = seeds_to_lanes(blind_rows)
-            p0_pub, ok_p0 = seeds_to_lanes(part_rows0)
-            p1_pub, ok_p1 = seeds_to_lanes(part_rows1)
-            ok = ok & ok_b & ok_p0 & ok_p1
-            public_parts = np.stack([p0_pub, p1_pub], axis=1)
-        else:
-            blind_lanes = None
-            public_parts = None
+        with span("helper.columnar", batch=n):
+            nonce_lanes, ok_nonce = seeds_to_lanes([rid.data for rid in ids])
+            seed_lanes, ok_seed = seeds_to_lanes(helper_seed_rows)
+            ver0, part0_lanes, ok_prep = split_prep_share_columns(
+                self.wire, self.engine.p3.jf, leader_prep_rows
+            )
+            ver0 = tuple(np.asarray(x) for x in ver0)
+            ok = ok_nonce & ok_seed & ok_prep & np.array([e is None for e in prep_err])
+            if self.wire.uses_jr:
+                blind_lanes, ok_b = seeds_to_lanes(blind_rows)
+                p0_pub, ok_p0 = seeds_to_lanes(part_rows0)
+                p1_pub, ok_p1 = seeds_to_lanes(part_rows1)
+                ok = ok & ok_b & ok_p0 & ok_p1
+                public_parts = np.stack([p0_pub, p1_pub], axis=1)
+            else:
+                blind_lanes = None
+                public_parts = None
 
         out1, accept, prep_msg_lanes = self.engine.helper_init(
             nonce_lanes, public_parts, seed_lanes, blind_lanes, ver0, part0_lanes, ok
@@ -370,15 +379,16 @@ class TaskAggregator:
         accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
         fixed_bid = fixed_size_batch_id(req.partial_batch_selector)
         if not multi_round:
-            accumulate_batched(
-                task,
-                self.engine,
-                accumulator,
-                out1,
-                accept,
-                [pi.report_share.metadata for pi in inits],
-                batch_identifier=fixed_bid,
-            )
+            with span("helper.accumulate", batch=n):
+                accumulate_batched(
+                    task,
+                    self.engine,
+                    accumulator,
+                    out1,
+                    accept,
+                    [pi.report_share.metadata for pi in inits],
+                    batch_identifier=fixed_bid,
+                )
 
         times = [pi.report_share.metadata.time.seconds for pi in inits]
         job = AggregationJobModel(
@@ -404,7 +414,8 @@ class TaskAggregator:
                 tx.put_report_aggregation(ra)
             return unmerged
 
-        unmerged = ds.run_tx(write, "aggregate_init")
+        with span("helper.write_tx", batch=n):
+            unmerged = ds.run_tx(write, "aggregate_init")
         if unmerged:
             resps = [
                 PrepareResp(
@@ -418,15 +429,30 @@ class TaskAggregator:
 
     def _replay_aggregate_init_response(self, ds: Datastore, job_id) -> AggregationJobResp:
         """Reconstruct the response from stored rows (reference
-        check_aggregation_job_idempotence, aggregator.rs:1526)."""
+        check_aggregation_job_idempotence, aggregator.rs:1526).
+
+        Only reachable while the job's last_request_hash is still the
+        init request's hash — i.e. before any continue was processed
+        (handle_aggregate_continue bumps the hash, so a re-PUT init
+        after a continue fails the hash check instead of landing here).
+        WAITING_HELPER rows therefore re-emit the same ping-pong
+        CONTINUE the original init answered; FINISHED rows still hold
+        their prep message in prep_blob."""
         ras = ds.run_tx(
             lambda tx: tx.get_report_aggregations_for_job(self.task.task_id, job_id),
             "agg_init_replay_resp",
         )
+        msg_len = 16 if self.wire.uses_jr else 0
         resps = []
         for ra in ras:
             if ra.state == ReportAggregationState.FINISHED:
                 result = PrepareStepResult.cont(encode_pingpong(PP_FINISH, ra.prep_blob, None))
+            elif ra.state == ReportAggregationState.WAITING_HELPER:
+                result = PrepareStepResult.cont(
+                    encode_pingpong(
+                        PP_CONTINUE, ra.prep_blob[:msg_len], FAKE_ROUND1_PREP_SHARE
+                    )
+                )
             else:
                 result = PrepareStepResult.reject(ra.prepare_error or PrepareError.VDAF_PREP_ERROR)
             resps.append(PrepareResp(ra.report_id, result))

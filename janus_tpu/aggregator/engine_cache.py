@@ -37,6 +37,28 @@ def _pad(arr, b: int):
     return np.pad(np.asarray(arr), widths)
 
 
+def put_args(args, block: bool = False, shardings=None):
+    """Explicitly dispatch every staged host array to the device, all
+    puts in flight at once (async), before invoking the jit — one slow
+    serialized arg upload must not gate the whole call.
+
+    block=True waits for the transfers to land before returning:
+    measured on the tunnel backend, dispatching an execute against
+    still-pending input buffers degrades the transfer ~1.5-2x versus
+    letting the puts finish first.
+
+    shardings: optional pytree (matching args) of NamedShardings so
+    multi-device placement happens in the transfer itself instead of a
+    resharding copy at dispatch."""
+    if shardings is not None:
+        out = jax.device_put(args, shardings)
+    else:
+        out = jax.device_put(args)  # maps over the arg pytree, puts async
+    if block:
+        jax.block_until_ready(out)
+    return out
+
+
 def pad_args(b: int, *args):
     out = []
     for a in args:
@@ -49,18 +71,75 @@ def pad_args(b: int, *args):
     return tuple(out)
 
 
+class DeviceRows:
+    """Out-share field value living ON DEVICE, padded to its bucket.
+
+    The serving path used to fetch out shares to numpy after init and
+    re-upload them for the masked aggregate — ~2x the out-share bytes
+    across the host<->device link per job for nothing. Callers that
+    truly need host rows (multi-round park paths) go through
+    `to_numpy()`; `EngineCache.aggregate` consumes the device value
+    directly."""
+
+    __slots__ = ("value", "n")
+
+    def __init__(self, value, n: int):
+        self.value = value  # tuple of [bucket, len] device limb arrays
+        self.n = n  # true batch size (rows beyond n are padding)
+
+    def to_numpy(self):
+        return tuple(np.asarray(x)[: self.n] for x in self.value)
+
+
 class EngineCache:
-    """Per (vdaf, verify_key) jitted steps, keyed by batch bucket."""
+    """Per (vdaf, verify_key) jitted steps, keyed by batch bucket.
+
+    Multi-device serving: when the process sees more than one JAX
+    device, every jitted step is bound to a dp (report-batch) mesh over
+    the largest power-of-two device count, so helper init and the
+    leader driver — the production traffic paths, not just bench.py —
+    shard across chips (SURVEY §2.10 P2/P4; the reference scales the
+    same work with DB replicas + rayon). Single-device behavior is
+    unchanged."""
 
     def __init__(self, inst: VdafInstance, verify_key: bytes):
         self.inst = inst
         self.verify_key = verify_key
         self.p3 = prio3_batched(inst)
         self._jits: dict[str, object] = {}
+        ndev = len(jax.devices())
+        if ndev > 1:
+            from ..parallel.api import make_mesh
 
-    def _jit(self, name: str, fn):
+            dp = 1 << (ndev.bit_length() - 1)  # largest power of two <= ndev
+            dp = min(dp, MIN_BUCKET)  # every bucket must divide by dp
+            self.mesh = make_mesh(dp, 1)
+            self.dp = dp
+        else:
+            self.mesh = None
+            self.dp = 1
+
+    def _shard(self, *batch_ndims):
+        """NamedShardings splitting the leading (report) axis over 'dp';
+        one entry per arg, each an int ndim or a tuple (field limbs) or
+        None (absent arg)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def one(nd):
+            if nd is None:
+                return None
+            if isinstance(nd, tuple):
+                return tuple(one(x) for x in nd)
+            return NamedSharding(self.mesh, P(*(("dp",) + (None,) * (nd - 1))))
+
+        return tuple(one(nd) for nd in batch_ndims)
+
+    def _jit(self, name: str, fn, in_shardings=None):
         if name not in self._jits:
-            self._jits[name] = jax.jit(fn)
+            if self.mesh is not None and in_shardings is not None:
+                self._jits[name] = jax.jit(fn, in_shardings=in_shardings)
+            else:
+                self._jits[name] = jax.jit(fn)
         return self._jits[name]
 
     # --- helper side: init + combine + decide in one traced step ---
@@ -84,16 +163,33 @@ class EngineCache:
 
         from ..trace import span
 
-        fn = self._jit("helper_init", step)
+        L = len(ver0)
+        shardings = None
+        if self.mesh is not None:
+            shardings = self._shard(
+                2,
+                None if public_parts is None else 3,
+                2,
+                None if blinds is None else 2,
+                (2,) * L,
+                2,
+                1,
+            )
+        fn = self._jit("helper_init", step, in_shardings=shardings)
         args = pad_args(b, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask)
         # the np.asarray conversions block on device execution — they
-        # must sit inside the span or it measures only async dispatch
+        # must sit inside the span or it measures only async dispatch.
+        # out1 stays ON DEVICE (DeviceRows): the aggregate step reads it
+        # there; only the small mask/prep_msg come back.
         with span("engine.helper_init", vdaf=self.inst.kind, batch=n, bucket=b):
-            out1, mask, prep_msg = fn(*args)
-            out1 = tuple(np.asarray(x)[:n] for x in out1)
-            mask = np.asarray(mask)[:n]
-            prep_msg = np.asarray(prep_msg)[:n]
-        return out1, mask, prep_msg
+            with span("engine.helper_init.put"):
+                args = put_args(args, block=True, shardings=shardings)
+            with span("engine.helper_init.dispatch"):
+                out1, mask, prep_msg = fn(*args)
+            with span("engine.helper_init.fetch"):
+                mask = np.asarray(mask)[:n]
+                prep_msg = np.asarray(prep_msg)[:n]
+        return DeviceRows(out1, n), mask, prep_msg
 
     # --- leader side: init only (network round trip follows) ---
     def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0, ok=None):
@@ -111,28 +207,52 @@ class EngineCache:
 
         from ..trace import span
 
-        fn = self._jit("leader_init", step)
+        L = len(meas)
+        shardings = None
+        if self.mesh is not None:
+            shardings = self._shard(
+                2,
+                None if public_parts is None else 3,
+                (2,) * L,
+                (2,) * L,
+                None if blind0 is None else 2,
+            )
+        fn = self._jit("leader_init", step, in_shardings=shardings)
         args = pad_args(b, nonce_lanes, public_parts, meas, proof, blind0)
-        # conversions block on device execution — keep inside the span
+        # conversions block on device execution — keep inside the span.
+        # out0 stays ON DEVICE (DeviceRows) for the later aggregate;
+        # seed0/ver0/part0 are needed host-side for the wire round trip.
         with span("engine.leader_init", vdaf=self.inst.kind, batch=n, bucket=b):
-            out0, seed0, ver0, part0 = fn(*args)
-            out0 = tuple(np.asarray(x)[:n] for x in out0)
-            seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
-            ver0 = tuple(np.asarray(x)[:n] for x in ver0)
-            part0 = np.asarray(part0)[:n] if part0 is not None else None
-        return out0, seed0, ver0, part0
+            with span("engine.leader_init.put"):
+                args = put_args(args, block=True, shardings=shardings)
+            with span("engine.leader_init.dispatch"):
+                out0, seed0, ver0, part0 = fn(*args)
+            with span("engine.leader_init.fetch_seed"):
+                seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
+            with span("engine.leader_init.fetch_ver"):
+                ver0 = tuple(np.asarray(x)[:n] for x in ver0)
+            with span("engine.leader_init.fetch_part"):
+                part0 = np.asarray(part0)[:n] if part0 is not None else None
+        return DeviceRows(out0, n), seed0, ver0, part0
 
     # --- masked aggregate over the batch axis ---
     def aggregate(self, out_shares, mask):
         p3 = self.p3
-        n = mask.shape[0]
-        b = bucket_size(n)
 
         def step(out_shares, mask):
             return p3.aggregate(out_shares, mask)
 
         fn = self._jit("aggregate", step)
-        agg = fn(*pad_args(b, out_shares, mask))
+        if isinstance(out_shares, DeviceRows):
+            # device-resident path: the out shares are already on device
+            # padded to their bucket — only the (tiny) mask moves
+            b = out_shares.value[0].shape[0]
+            mask = _pad(np.asarray(mask), b)
+            agg = fn(out_shares.value, mask)
+        else:
+            n = mask.shape[0]
+            b = bucket_size(n)
+            agg = fn(*pad_args(b, out_shares, mask))
         return [int(x) for x in p3.jf.to_ints(agg)]
 
 

@@ -2,15 +2,23 @@
 
 Equivalent of reference aggregator/src/aggregator/report_writer.rs:24-165
 (`ReportWriteBatcher`): buffer uploaded reports and flush them in a
-single transaction when `max_batch_size` accumulate or
-`max_write_delay` elapses, fanning the per-report outcome (fresh vs
-replayed) back to each waiting upload request.
+single transaction, fanning the per-report outcome (fresh vs replayed)
+back to each waiting upload request.
+
+Flush policy is GROUP COMMIT, not a fixed timer: a dedicated flusher
+thread writes whatever accumulated while the previous transaction ran.
+A lone client therefore sees ~transaction latency (the reference's
+`max_upload_batch_write_delay` default is 0, aggregator.rs:186-218),
+while concurrent bursts batch naturally — the batch size adapts to
+however many requests arrive per transaction. `max_write_delay_ms > 0`
+adds an optional coalescing wait, capped by `max_batch_size`.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from ..datastore.models import LeaderStoredReport
 from ..datastore.store import Datastore
@@ -29,37 +37,37 @@ class _Pending:
 
 
 class ReportWriteBatcher:
-    """Blocking writes with batched flushes. Request threads call
+    """Blocking writes with group-commit flushes. Request threads call
     `write_report` and park until their batch's transaction commits."""
 
     def __init__(
         self,
         ds: Datastore,
         max_batch_size: int = 100,
-        max_write_delay_ms: int = 250,
+        max_write_delay_ms: int = 0,
     ):
         self.ds = ds
         self.max_batch_size = max_batch_size
         self.max_write_delay_s = max_write_delay_ms / 1000.0
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._buffer: list[_Pending] = []
-        self._timer: threading.Timer | None = None
+        self._flusher: threading.Thread | None = None
+        self._stop = False
 
     def write_report(self, report: LeaderStoredReport, timeout_s: float = 30.0) -> bool:
-        """Queue + wait for the batch commit; returns False on replay."""
+        """Queue + wait for the group commit; returns False on replay."""
         pending = _Pending(report)
-        with self._lock:
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("report writer is closed")
             self._buffer.append(pending)
-            if len(self._buffer) >= self.max_batch_size:
-                batch = self._take_locked()
-            else:
-                batch = None
-                if self._timer is None:
-                    self._timer = threading.Timer(self.max_write_delay_s, self._flush_timer)
-                    self._timer.daemon = True
-                    self._timer.start()
-        if batch:
-            self._flush(batch)
+            if self._flusher is None:
+                self._flusher = threading.Thread(
+                    target=self._flush_loop, name="report-writer", daemon=True
+                )
+                self._flusher.start()
+            self._cv.notify()
         if not pending.event.wait(timeout_s):
             raise TimeoutError("report write batch did not flush in time")
         if pending.error is not None:
@@ -68,32 +76,53 @@ class ReportWriteBatcher:
         return pending.fresh
 
     def flush_now(self) -> None:
-        """Flush whatever is buffered (tests/shutdown)."""
-        with self._lock:
-            batch = self._take_locked()
+        """Flush whatever is buffered synchronously (tests/shutdown)."""
+        with self._cv:
+            batch, self._buffer = self._buffer, []
         if batch:
             self._flush(batch)
 
-    def _take_locked(self) -> list[_Pending]:
-        batch, self._buffer = self._buffer, []
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
-        return batch
+    def close(self) -> None:
+        """Stop the flusher thread after draining (shutdown path)."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        flusher = self._flusher
+        if flusher is not None:
+            flusher.join(timeout=5)
+        self.flush_now()
 
-    def _flush_timer(self) -> None:
-        with self._lock:
-            batch = self._take_locked()
-        if batch:
-            self._flush(batch)
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._buffer:
+                    if self._stop:
+                        return
+                    self._cv.wait()
+                if self.max_write_delay_s > 0:
+                    # optional coalescing window (off by default): wait
+                    # until the batch fills or the window closes
+                    deadline = time.monotonic() + self.max_write_delay_s
+                    while len(self._buffer) < self.max_batch_size and not self._stop:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                batch = self._buffer[: self.max_batch_size]
+                self._buffer = self._buffer[self.max_batch_size :]
+            if batch:  # a concurrent flush_now may have drained it
+                self._flush(batch)
 
     def _flush(self, batch: list[_Pending]) -> None:
         """One transaction for the whole batch (reference :96-165)."""
+        from ..trace import span
+
         try:
             def tx_fn(tx):
                 return [tx.put_client_report(p.report) for p in batch]
 
-            results = self.ds.run_tx(tx_fn, "upload_batch")
+            with span("upload.flush_tx", batch=len(batch)):
+                results = self.ds.run_tx(tx_fn, "upload_batch")
             for p, fresh in zip(batch, results):
                 p.fresh = fresh
         except BaseException as e:  # fan the failure out to every waiter

@@ -102,7 +102,7 @@ class AggregatorConfig:
     aggregator_api_listen_address: str | None = None
     aggregator_api_auth_tokens: tuple[str, ...] = ()
     max_upload_batch_size: int = 100
-    max_upload_batch_write_delay_ms: int = 250
+    max_upload_batch_write_delay_ms: int = 0
     batch_aggregation_shard_count: int = 1
     taskprov: TaskprovConfig = field(default_factory=TaskprovConfig)
     garbage_collection_interval_s: float | None = None
@@ -118,7 +118,7 @@ class AggregatorConfig:
             aggregator_api_auth_tokens=tuple(api.get("auth_tokens", ())),
             max_upload_batch_size=int(d.get("max_upload_batch_size", 100)),
             max_upload_batch_write_delay_ms=int(
-                d.get("max_upload_batch_write_delay_ms", 250)
+                d.get("max_upload_batch_write_delay_ms", 0)
             ),
             batch_aggregation_shard_count=int(
                 d.get("batch_aggregation_shard_count", 1)

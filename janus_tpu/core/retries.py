@@ -13,6 +13,18 @@ import time
 from dataclasses import dataclass
 
 
+class DeadlineExceeded(TimeoutError):
+    """The retry deadline (lease bound) tripped before a conclusive
+    response. Carries the last retryable status, if any, so callers can
+    log it — but deliberately NOT as a (status, body) return value: a
+    stale 5xx from an earlier attempt must not masquerade as the
+    conclusive outcome of the request."""
+
+    def __init__(self, msg: str, last_status: int | None = None):
+        super().__init__(msg)
+        self.last_status = last_status
+
+
 @dataclass(frozen=True)
 class Backoff:
     initial: float = 0.1
@@ -47,8 +59,9 @@ def retry_http_request(
     attempt or backoff sleep is started (the lease-bounded job step,
     reference job_driver.rs:191-196 — a stuck helper must not outlive
     the worker's lease and run concurrently with its re-acquirer).
-    Raises TimeoutError if the deadline passes before any conclusive
-    response.
+    Raises DeadlineExceeded (a TimeoutError) if the deadline passes
+    before any conclusive response — a stale retryable (status, body)
+    from an earlier attempt is never returned as if conclusive.
     """
     interval = backoff.initial
     elapsed = 0.0
@@ -58,9 +71,9 @@ def retry_http_request(
         if deadline is not None and time.monotonic() >= deadline:
             if last_exc is not None:
                 raise last_exc
-            if status is not None:
-                return status, body
-            raise TimeoutError("request deadline (lease bound) exceeded")
+            raise DeadlineExceeded(
+                "request deadline (lease bound) exceeded", last_status=status
+            )
         try:
             status, body = do_request()
             if not is_retryable_status(status):
@@ -68,13 +81,18 @@ def retry_http_request(
             last_exc = None
         except (OSError, ConnectionError) as e:
             last_exc = e
-        out_of_budget = elapsed + interval > backoff.max_elapsed or (
-            deadline is not None and time.monotonic() + interval >= deadline
-        )
-        if out_of_budget:
+        budget_spent = elapsed + interval > backoff.max_elapsed
+        deadline_near = deadline is not None and time.monotonic() + interval >= deadline
+        if budget_spent or deadline_near:
             if last_exc is not None:
                 raise last_exc
-            return status, body
+            if budget_spent:
+                # backoff budget exhausted: the last (retryable) response
+                # IS the documented conclusive outcome
+                return status, body
+            raise DeadlineExceeded(
+                "request deadline (lease bound) exceeded", last_status=status
+            )
         delay = interval * (1 + random.uniform(-backoff.jitter, backoff.jitter))
         sleep(delay)
         elapsed += delay

@@ -45,6 +45,8 @@ PP_FINISH = 2
 
 def encode_field_rows(jf, value) -> list[bytes]:
     """Device field value [batch, n] -> per-row little-endian encodings."""
+    if hasattr(value, "to_numpy"):  # engine_cache.DeviceRows
+        value = value.to_numpy()
     limbs = [np.asarray(x, dtype=np.uint64) for x in value]
     if len(limbs) == 1:
         lanes = limbs[0]

@@ -99,6 +99,24 @@ def test_batcher_flushes_on_delay_and_reports_replays(ds):
     assert batcher.write_report(report) is False  # same id -> replay
 
 
+def test_batcher_serial_latency_group_commit(ds):
+    """A lone serial client must see ~transaction latency, not a fixed
+    flush-timer delay (reference default max_upload_batch_write_delay=0,
+    aggregator.rs:186-218). p50 < 20ms on this box."""
+    import time as _time
+
+    task = put_task(ds, VdafInstance.count())
+    batcher = ReportWriteBatcher(ds, max_batch_size=100, max_write_delay_ms=0)
+    lat = []
+    for _ in range(15):
+        r = make_report(task)
+        t0 = _time.monotonic()
+        assert batcher.write_report(r) is True
+        lat.append(_time.monotonic() - t0)
+    lat.sort()
+    assert lat[len(lat) // 2] < 0.020, f"serial upload p50 {lat[len(lat)//2]*1e3:.1f}ms"
+
+
 class _BrokenDs:
     def run_tx(self, fn, name="tx"):
         raise RuntimeError("datastore down")

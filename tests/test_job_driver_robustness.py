@@ -13,6 +13,8 @@ from janus_tpu.core.retries import Backoff, retry_http_request
 
 
 def test_retry_deadline_stops_retrying():
+    from janus_tpu.core.retries import DeadlineExceeded
+
     calls = []
 
     def do_request():
@@ -20,12 +22,14 @@ def test_retry_deadline_stops_retrying():
         return 503, b"unavailable"  # retryable forever
 
     deadline = time.monotonic() + 0.15
-    status, body = retry_http_request(
-        do_request, Backoff(initial=0.01, max_elapsed=60.0), deadline=deadline
-    )
-    # returned the last retryable response instead of burning the whole
-    # 60s backoff budget past the lease
-    assert status == 503
+    # the deadline (not the backoff budget) ends the retries: that is
+    # never a conclusive response — DeadlineExceeded carries the stale
+    # status for logging only
+    with pytest.raises(DeadlineExceeded) as ei:
+        retry_http_request(
+            do_request, Backoff(initial=0.01, max_elapsed=60.0), deadline=deadline
+        )
+    assert ei.value.last_status == 503
     assert time.monotonic() <= deadline + 0.2
 
 
@@ -47,6 +51,33 @@ def test_retry_deadline_already_passed_raises_timeout():
 
     with pytest.raises(TimeoutError):
         retry_http_request(do_request, deadline=time.monotonic() - 1)
+
+
+def test_retry_deadline_during_sleep_raises_not_stale_response():
+    """A retryable response followed by a sleep that crosses the
+    deadline must surface as DeadlineExceeded (carrying the stale
+    status for logging), never as a conclusive (status, body)."""
+    from janus_tpu.core.retries import DeadlineExceeded
+
+    deadline = time.monotonic() + 0.05
+
+    def do_request():
+        return 503, b"unavailable"
+
+    def sleep(_):  # a sleep that overshoots the deadline
+        time.sleep(0.2)
+
+    with pytest.raises(DeadlineExceeded) as ei:
+        retry_http_request(
+            do_request,
+            # huge interval so out_of_budget's now+interval pre-check
+            # cannot return early; the top-of-loop deadline check after
+            # the overshooting sleep must decide
+            Backoff(initial=0.0001, multiplier=1.0, max_elapsed=60.0, jitter=0.0),
+            sleep=sleep,
+            deadline=deadline,
+        )
+    assert ei.value.last_status == 503
 
 
 def test_streaming_pool_hung_job_does_not_block_others():

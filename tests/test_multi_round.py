@@ -182,3 +182,62 @@ def test_continue_step_and_order_validation(pair):
     # same step, different request: step mismatch (replay guard)
     status, body = http.post(url, bad_empty.to_bytes(), headers)
     assert status == 400 and b"stepMismatch" in body
+
+
+def test_init_replay_while_waiting_helper(pair):
+    """Leader timeout + re-PUT of the identical init request while the
+    helper's rows are parked in WAITING_HELPER must replay the original
+    ping-pong CONTINUE response — not reject the reports (the
+    _replay_aggregate_init_response multi-round gap, ADVICE r2)."""
+    leader_task, helper_task, _ = provision(pair, VDAF)
+    http, params = _upload(pair, leader_task, [1, 0, 1])
+    creator = AggregationJobCreator(
+        pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+    )
+    assert creator.run_once() == 1
+
+    captured = {}
+
+    class CapturingHttp(HttpClient):
+        def put(self, url, body, headers=None, timeout=None):
+            if "aggregation_jobs" in url:
+                captured["url"] = url
+                captured["body"] = body
+                captured["headers"] = headers
+            return super().put(url, body, headers, timeout=timeout)
+
+    chttp = CapturingHttp()
+    driver = AggregationJobDriver(pair["leader_ds"], chttp)
+    jd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1), driver.acquirer(), driver.stepper
+    )
+    assert jd.run_once() == 1  # init round; helper rows parked WAITING_HELPER
+    assert "body" in captured
+
+    job = pair["leader_ds"].run_tx(
+        lambda tx: tx.get_aggregation_jobs_for_task(leader_task.task_id)
+    )[0]
+    assert set(_states(pair["helper_ds"], helper_task.task_id, job.job_id)) == {
+        ReportAggregationState.WAITING_HELPER
+    }
+
+    # identical re-PUT: replayed response must match the original —
+    # every report still a ping-pong CONTINUE, none rejected
+    from janus_tpu.messages import AggregationJobResp, PrepareStepResult
+
+    status, body = chttp.put(captured["url"], captured["body"], captured["headers"])
+    assert status in (200, 201)
+    resp = AggregationJobResp.from_bytes(body)
+    assert len(resp.prepare_resps) == 3
+    for pr in resp.prepare_resps:
+        assert pr.result.kind == PrepareStepResult.CONTINUE, pr.result
+    # rows still parked, step unchanged (replay had no side effects)
+    assert set(_states(pair["helper_ds"], helper_task.task_id, job.job_id)) == {
+        ReportAggregationState.WAITING_HELPER
+    }
+
+    # the job still completes normally after the replay
+    assert jd.run_once() == 1
+    assert set(_states(pair["helper_ds"], helper_task.task_id, job.job_id)) == {
+        ReportAggregationState.FINISHED
+    }

@@ -1,0 +1,193 @@
+"""Postgres dialect conformance without a Postgres server.
+
+psycopg and a live server are unavailable in this image, so the
+Postgres engine (`PostgresDatastore`, datastore/store.py) cannot be
+executed here. What CAN be checked — and what this file pins down — is
+the *translation layer* the engine rests on (VERDICT r2 Missing #4 /
+Next #7; reference datastore.rs:203-305):
+
+  1. every SQL string the typed ops pass to execute()/executemany()
+     survives the blind '?' -> '%s' placeholder rewrite
+     (_PgConnAdapter.execute), i.e. no string literal contains '?';
+  2. the rewrite is complete and count-preserving;
+  3. every statement is syntactically complete SQL
+     (sqlite3.complete_statement — both dialects share the grammar
+     subset the ops use);
+  4. the _pg_schema() DDL rewrite (BLOB->BYTEA, INTEGER->BIGINT) is
+     word-bounded, leaves no sqlite-only constructs behind, and cannot
+     clobber identifiers;
+  5. the lease-select FOR UPDATE SKIP LOCKED suffix lands in the
+     statements that claim leases, and only syntactically-valid spots.
+
+Execution against a real server is a one-command recipe:
+docs/DEPLOYING.md "Postgres" (docker compose + JANUS_TEST_DATABASE_URL
+turns on the live-postgres test parameterization in conftest.py).
+"""
+
+import ast
+import re
+import sqlite3
+from pathlib import Path
+
+import pytest
+
+import janus_tpu.datastore.store as store_mod
+from janus_tpu.datastore.store import _SCHEMA, _pg_schema
+
+STORE_PATH = Path(store_mod.__file__)
+
+SQL_HEAD = re.compile(
+    r"^\s*(SELECT|INSERT|UPDATE|DELETE|CREATE|DROP|BEGIN|COMMIT|ROLLBACK|PRAGMA)\b",
+    re.IGNORECASE,
+)
+
+
+def _collect_sql_strings() -> list[str]:
+    """Every string literal in store.py that is (part of) a SQL
+    statement — including f-string fragments, which are joined with a
+    placeholder for their interpolations."""
+    tree = ast.parse(STORE_PATH.read_text())
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Constant(self, node):
+            if isinstance(node.value, str) and SQL_HEAD.match(node.value):
+                out.append(node.value)
+
+        def visit_JoinedStr(self, node):
+            parts = []
+            for v in node.values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    parts.append(v.value)
+                else:
+                    parts.append("interp")  # stand-in for {expr}
+            s = "".join(parts)
+            if SQL_HEAD.match(s):
+                out.append(s)
+            # don't also visit the constants inside
+            return
+
+    V().visit(tree)
+    assert len(out) >= 60, f"SQL extraction looks broken: only {len(out)} statements"
+    return out
+
+
+ALL_SQL = _collect_sql_strings()
+
+
+def _string_literals(sql: str) -> list[str]:
+    return re.findall(r"'((?:[^']|'')*)'", sql)
+
+
+def test_no_question_mark_inside_string_literals():
+    """The PG adapter rewrites every '?' to '%s' blindly; a literal '?'
+    inside a quoted SQL string would be silently corrupted on the
+    Postgres engine only (ADVICE r2)."""
+    for sql in ALL_SQL:
+        for lit in _string_literals(sql):
+            assert "?" not in lit, f"literal {lit!r} in: {sql[:80]}"
+
+
+def test_placeholder_rewrite_is_complete_and_count_preserving():
+    for sql in ALL_SQL:
+        if "%s" in sql:
+            continue  # PG-native statement (bootstrap), bypasses the adapter
+        translated = sql.replace("?", "%s")
+        assert "?" not in translated
+        assert translated.count("%s") == sql.count("?")
+
+
+def test_statements_are_syntactically_complete():
+    for sql in ALL_SQL:
+        # multi-statement blobs (the schema) validate per statement
+        for stmt in sql.split(";"):
+            if not stmt.strip():
+                continue
+            probe = stmt.replace("?", "1").replace("interp", "1") + ";"
+            assert sqlite3.complete_statement(probe), f"incomplete SQL: {stmt[:100]}"
+
+
+def test_pg_ddl_translation_word_bounded():
+    ddl = _pg_schema()
+    # rewrite completeness
+    assert not re.search(r"\bBLOB\b", ddl)
+    assert not re.search(r"\bINTEGER\b", ddl)
+    assert "BYTEA" in ddl and "BIGINT" in ddl
+    # identifiers embedding the type words (e.g. prep_blob) survive the
+    # word-bounded rewrite untouched
+    for ident in re.findall(r"\b\w*_(?:blob|integer)\w*\b|\b(?:blob|integer)_\w*\b", _SCHEMA):
+        assert ident in ddl, f"identifier {ident} was corrupted by the DDL rewrite"
+    # and no bare uppercase type word can hide inside an identifier the
+    # rewrite WOULD touch: every uppercase BLOB/INTEGER occurrence in
+    # the source must be a standalone type token
+    for word in ("BLOB", "INTEGER"):
+        for m in re.finditer(rf"\b{word}\b", _SCHEMA):
+            context = _SCHEMA[max(0, m.start() - 1) : m.end() + 1]
+            assert not re.search(r"\w" + word + r"|" + word + r"\w", context)
+    # no sqlite-only constructs survive into the PG dialect
+    for sqlite_only in ("AUTOINCREMENT", "WITHOUT ROWID", "PRAGMA"):
+        assert sqlite_only not in ddl.upper()
+    # every DDL statement still parses as complete SQL
+    for stmt in ddl.split(";"):
+        if stmt.strip():
+            assert sqlite3.complete_statement(stmt + ";"), stmt[:100]
+
+
+def test_pg_ddl_statement_count_matches_sqlite():
+    n = lambda text: sum(1 for s in text.split(";") if s.strip())
+    assert n(_pg_schema()) == n(_SCHEMA)
+
+
+def test_lease_suffix_lands_in_lease_selects():
+    """The Transaction built with the postgres dialect appends
+    FOR UPDATE SKIP LOCKED to its lease-acquisition SELECTs; validate
+    the suffixed statements still parse (PG grammar accepts the suffix
+    exactly where sqlite's complete_statement sees a complete SELECT)."""
+    src = STORE_PATH.read_text()
+    uses = src.count("self._lease_suffix")
+    assert uses >= 2, "lease suffix no longer used where leases are claimed"
+    # reconstruct the suffixed form of each statement that embeds it:
+    # the ops append it via `"..." + self._lease_suffix`, i.e. a BinOp
+    # whose right side is the attribute access
+    tree = ast.parse(src)
+    suffixed = []
+
+    def flat(node):
+        """Concatenated string value of a BinOp(+) chain of constants."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left, right = flat(node.left), flat(node.right)
+            if left is not None and right is not None:
+                return left + right
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "_lease_suffix"
+        ):
+            return " FOR UPDATE SKIP LOCKED"
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            s = flat(node)
+            if s is not None and "FOR UPDATE SKIP LOCKED" in s and SQL_HEAD.match(s):
+                suffixed.append(s)
+    assert len(suffixed) >= 2
+    for sql in suffixed:
+        # sqlite's grammar does not know SKIP LOCKED; strip the suffix
+        # and require the remainder to be a complete SELECT, and the
+        # suffix to sit at the very end (the only spot PG allows)
+        assert sql.endswith(" FOR UPDATE SKIP LOCKED"), sql[-60:]
+        base = sql[: -len(" FOR UPDATE SKIP LOCKED")]
+        assert sqlite3.complete_statement(base.replace("?", "1") + ";"), sql[:120]
+
+
+def test_pg_adapter_rewrite_matches_reference_behavior():
+    """_PgConnAdapter.execute must translate exactly like the tested
+    rewrite (guards against the adapter and this test diverging)."""
+    import inspect
+
+    from janus_tpu.datastore.store import _PgConnAdapter
+
+    src = inspect.getsource(_PgConnAdapter)
+    assert 'sql.replace("?", "%s")' in src
