@@ -396,5 +396,11 @@ class HostEngineCache:
 @lru_cache(maxsize=256)
 def engine_cache(inst: VdafInstance, verify_key: bytes):
     if inst.xof_mode != "fast":
-        return HostEngineCache(inst, verify_key)
+        # draft (VDAF-07) framing: device engine for short-stream
+        # circuits (Count/Sum/small vectors, vdaf.draft_jax), host
+        # scalar loop only for long-stream draft tasks
+        try:
+            prio3_batched(inst)
+        except ValueError:
+            return HostEngineCache(inst, verify_key)
     return EngineCache(inst, verify_key)

@@ -120,13 +120,17 @@ def warmup_engines(ds, batch: int | None = None) -> None:
     from .aggregator.engine_cache import MIN_BUCKET, engine_cache
     from .vdaf.testing import make_report_batch, random_measurements
 
+    from .aggregator.engine_cache import HostEngineCache
+
     warm_batch = batch or MIN_BUCKET
     tasks = ds.run_tx(lambda tx: tx.get_tasks(), "warmup_list_tasks")
     for task in tasks:
-        if task.vdaf.kind.startswith("fake") or task.vdaf.xof_mode != "fast":
-            continue  # host engines need no compile
+        if task.vdaf.kind.startswith("fake"):
+            continue
         try:
             eng = engine_cache(task.vdaf, task.vdaf_verify_key)
+            if isinstance(eng, HostEngineCache):
+                continue  # host engines need no compile
             rng = np.random.default_rng(0)
             args, _ = make_report_batch(
                 task.vdaf, random_measurements(task.vdaf, warm_batch, rng), seed=0

@@ -650,12 +650,6 @@ class Transaction:
             )
         return out
 
-    def count_report_aggregations_for_report(self, task_id: TaskId, report_id: ReportId) -> int:
-        return self._c.execute(
-            "SELECT COUNT(*) FROM report_aggregations WHERE task_id = ? AND report_id = ?",
-            (task_id.data, report_id.data),
-        ).fetchone()[0]
-
     def get_aggregated_report_ids(self, task_id: TaskId, report_ids: list[ReportId]) -> set[bytes]:
         """Which of `report_ids` already have ANY report-aggregation row
         (helper replay check) — one set query for the whole init batch,

@@ -70,25 +70,38 @@ def b64(b: bytes) -> str:
 
 def vdaf_from_object(obj: dict) -> VdafInstance:
     """Interop VdafObject -> VdafInstance (reference
-    interop_binaries/src/lib.rs VdafObject)."""
+    interop_binaries/src/lib.rs VdafObject).
+
+    The interop API exists for CROSS-IMPLEMENTATION pairing, so tasks
+    default to the spec framing (`xof_mode: draft`, the VDAF-07
+    construction a conformant peer speaks — count/sum run it on device,
+    vdaf.draft_jax). Same-framework pairs can opt into the fast TPU
+    framing with ``"xof_mode": "fast"`` in the VdafObject."""
+    import dataclasses
+
     typ = obj["type"]
     geti = lambda k, d=0: int(obj.get(k, d))
     if typ == "Prio3Count":
-        return VdafInstance.count()
-    if typ == "Prio3CountVec":
-        return VdafInstance.count_vec(length=geti("length"), chunk_length=geti("chunk_length"))
-    if typ == "Prio3Sum":
-        return VdafInstance.sum(bits=geti("bits"))
-    if typ == "Prio3SumVec":
-        return VdafInstance.sum_vec(
+        inst = VdafInstance.count()
+    elif typ == "Prio3CountVec":
+        inst = VdafInstance.count_vec(length=geti("length"), chunk_length=geti("chunk_length"))
+    elif typ == "Prio3Sum":
+        inst = VdafInstance.sum(bits=geti("bits"))
+    elif typ == "Prio3SumVec":
+        inst = VdafInstance.sum_vec(
             length=geti("length"), bits=geti("bits"), chunk_length=geti("chunk_length")
         )
-    if typ == "Prio3Histogram":
-        return VdafInstance.histogram(length=geti("length"), chunk_length=geti("chunk_length"))
-    if typ.startswith("Prio3FixedPoint") and typ.endswith("BitBoundedL2VecSum"):
+    elif typ == "Prio3Histogram":
+        inst = VdafInstance.histogram(length=geti("length"), chunk_length=geti("chunk_length"))
+    elif typ.startswith("Prio3FixedPoint") and typ.endswith("BitBoundedL2VecSum"):
         bits = int(typ.removeprefix("Prio3FixedPoint").removesuffix("BitBoundedL2VecSum"))
-        return VdafInstance.fixed_point_vec(length=geti("length"), bits=bits)
-    raise ValueError(f"unsupported VDAF type {typ!r}")
+        inst = VdafInstance.fixed_point_vec(length=geti("length"), bits=bits)
+    else:
+        raise ValueError(f"unsupported VDAF type {typ!r}")
+    mode = str(obj.get("xof_mode", "draft"))
+    if mode not in ("fast", "draft"):
+        raise ValueError(f"unknown xof_mode {mode!r} (want 'fast' or 'draft')")
+    return dataclasses.replace(inst, xof_mode=mode)
 
 
 def measurement_from_json(vdaf: VdafInstance, measurement):

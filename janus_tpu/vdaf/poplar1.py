@@ -273,6 +273,66 @@ class Poplar1:
         return [int(x) for x in agg]
 
 
+# ---------------------------------------------------------------------------
+# DAP wire codecs (public share = correction words; input share = root
+# seed). The reference declares Poplar1 but cannot drive it through DAP
+# (nontrivial aggregation parameters unsupported, README.md:9-11);
+# these codecs + the aggregator's agg-param plumbing make it reachable
+# here.
+# ---------------------------------------------------------------------------
+
+
+def encode_public_share(bits: int, cws: list) -> bytes:
+    """Correction words: per level seed_cw(16) || ctrl byte(t_l<<1|t_r)
+    || value_cw elements (2, level field, fixed width)."""
+    idpf = Idpf(bits)
+    out = bytearray()
+    for level, (seed_cw, t_l, t_r, value_cw) in enumerate(cws):
+        F = idpf.field_at(level)
+        out += seed_cw
+        out.append((t_l << 1) | t_r)
+        for v in value_cw:
+            out += int(v).to_bytes(F.ENCODED_SIZE, "little")
+    return bytes(out)
+
+
+def decode_public_share(bits: int, raw: bytes) -> list:
+    idpf = Idpf(bits)
+    cws = []
+    off = 0
+    for level in range(bits):
+        F = idpf.field_at(level)
+        if off + SEED_SIZE + 1 + 2 * F.ENCODED_SIZE > len(raw):
+            raise ValueError("poplar1 public share truncated")
+        seed_cw = raw[off : off + SEED_SIZE]
+        off += SEED_SIZE
+        ctrl = raw[off]
+        off += 1
+        if ctrl > 3:
+            raise ValueError("poplar1 public share bad control byte")
+        value_cw = []
+        for _ in range(Idpf.VALUE_LEN):
+            v = int.from_bytes(raw[off : off + F.ENCODED_SIZE], "little")
+            if v >= F.MODULUS:
+                raise ValueError("poplar1 correction word out of range")
+            value_cw.append(v)
+            off += F.ENCODED_SIZE
+        cws.append((seed_cw, (ctrl >> 1) & 1, ctrl & 1, value_cw))
+    if off != len(raw):
+        raise ValueError("poplar1 public share trailing bytes")
+    return cws
+
+
+def encode_input_share(key: IdpfKey) -> bytes:
+    return key.root_seed
+
+
+def decode_input_share(bits: int, cws: list, raw: bytes) -> IdpfKey:
+    if len(raw) != SEED_SIZE:
+        raise ValueError("poplar1 input share must be one 16-byte root seed")
+    return IdpfKey(raw, cws)
+
+
 def heavy_hitters(poplar: Poplar1, keys0, keys1, threshold: int) -> list[int]:
     """The classic Poplar loop: walk levels keeping prefixes whose count
     reaches the threshold; returns the heavy alpha values."""

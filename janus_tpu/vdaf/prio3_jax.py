@@ -160,6 +160,16 @@ class Prio3Batched:
         """Expand helper measurement/proof share: binder = AGG1."""
         return self._expand_vec(usage, seed_lanes, [(0, AGG1)], 8, length)
 
+    def _part_binder(self, agg_id: int, meas, helper_seed):
+        """The share binder for joint-rand part derivation (as lanes):
+        the leader binds its full encoded measurement share; the helper
+        binds its 16-byte seed (the fast-mode shortcut,
+        SECURITY-NOTES.md #3). Draft mode overrides to bind the full
+        expanded share for both, per the spec."""
+        if agg_id == 0:
+            return field_value_to_enc_lanes(self.jf, meas)
+        return helper_seed
+
     def _joint_rand_part(self, agg_id: int, blind_lanes, nonce_lanes, share_binder_lanes):
         """derive_seed(blind, ..., agg_id8 + nonce + share_binder)."""
         agg = AGG0 if agg_id == 0 else AGG1
@@ -229,9 +239,12 @@ class Prio3Batched:
         if self.uses_joint_rand:
             blind0 = rand_lanes[:, 2]
             blind1 = rand_lanes[:, 3]
-            enc = field_value_to_enc_lanes(jf, leader_meas)
-            part0 = self._joint_rand_part(0, blind0, nonce_lanes, enc)
-            part1 = self._joint_rand_part(1, blind1, nonce_lanes, helper_seed)
+            part0 = self._joint_rand_part(
+                0, blind0, nonce_lanes, self._part_binder(0, leader_meas, None)
+            )
+            part1 = self._joint_rand_part(
+                1, blind1, nonce_lanes, self._part_binder(1, helper_meas, helper_seed)
+            )
             jr_seed = self._joint_rand_seed(part0, part1)
             joint_rand = self._joint_rand(jr_seed)
             public_parts = jnp.stack([part0, part1], axis=1)
@@ -278,10 +291,7 @@ class Prio3Batched:
         own_part = None
         joint_rand = ()
         if self.uses_joint_rand:
-            if agg_id == 0:
-                binder = field_value_to_enc_lanes(jf, meas)
-            else:
-                binder = helper_seed
+            binder = self._part_binder(agg_id, meas, helper_seed)
             own_part = self._joint_rand_part(agg_id, blind, nonce_lanes, binder)
             other = public_parts[:, 1 - agg_id]
             parts = (own_part, other) if agg_id == 0 else (other, own_part)

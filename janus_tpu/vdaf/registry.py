@@ -186,14 +186,21 @@ def prio3_batched(inst: VdafInstance) -> Prio3Batched:
     """Device (batched) implementation: the aggregator hot path.
 
     Cached so repeated dispatch returns the identical instance and jit
-    caches keyed on it never recompile. Fast-framing only: draft-mode
-    tasks run the host engine (aggregator.engine_cache dispatches).
-    """
+    caches keyed on it never recompile. Draft-framing (VDAF-07)
+    instances run the device draft engine when their streams are short
+    enough for the sequential sponge (Count, Sum, small vectors —
+    vdaf.draft_jax); longer draft tasks raise and fall back to the host
+    engine (aggregator.engine_cache dispatches)."""
     if inst.xof_mode != "fast":
-        raise ValueError(
-            "prio3_batched supports xof_mode=fast only; draft-mode tasks "
-            "run the host engine"
-        )
+        from .draft_jax import Prio3BatchedDraft
+
+        circ = circuit_for(inst)
+        if not Prio3BatchedDraft.supports_circuit(circ):
+            raise ValueError(
+                "draft-mode streams too long for the device sponge; this "
+                "task runs the host engine"
+            )
+        return Prio3BatchedDraft(circ)
     return Prio3Batched(circuit_for(inst))
 
 

@@ -189,10 +189,16 @@ def test_report_aggregations(eph):
     ds.run_tx(lambda tx: tx.update_report_aggregation(upd))
     got = ds.run_tx(lambda tx: tx.get_report_aggregations_for_job(task.task_id, job.job_id))
     assert got[1] == upd and got[1].prepare_error == PrepareError.VDAF_PREP_ERROR
-    n = ds.run_tx(
-        lambda tx: tx.count_report_aggregations_for_report(task.task_id, ras[0].report_id)
+    # helper replay check: one set query over the whole id list
+    from janus_tpu.messages import ReportId as _RID
+
+    unknown = _RID(bytes(16))
+    replayed = ds.run_tx(
+        lambda tx: tx.get_aggregated_report_ids(
+            task.task_id, [ras[0].report_id, unknown]
+        )
     )
-    assert n == 1
+    assert replayed == {ras[0].report_id.data}
 
 
 def test_batch_aggregations_and_conflict(eph):

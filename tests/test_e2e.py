@@ -86,7 +86,10 @@ def provision(pair, vdaf):
 
 # Every VDAF family through the full live-pair protocol (the
 # reference's per-VDAF matrix, integration_tests/tests/janus.rs:14-60),
-# plus the draft XOF framing end-to-end (host engine on both sides).
+# plus a draft (VDAF-07) XOF framing column for every family: count and
+# sum run the DEVICE draft engine (vdaf.draft_jax), the vector cases at
+# these small sizes too — a long-stream draft task would fall back to
+# the host engine (engine_cache dispatch, tested in test_xof_modes).
 CASES = [
     (VdafInstance.count(), [0, 1, 1, 0, 1, 1, 1], 5),
     (VdafInstance.sum(bits=8), [3, 200, 17], 220),
@@ -102,7 +105,20 @@ CASES = [
         [[100, -50], [25, 75]],
         [125 / 32768, 25 / 32768],
     ),
+    (VdafInstance("count", xof_mode="draft"), [1, 0, 1, 1], 3),
     (VdafInstance("sum", bits=8, xof_mode="draft"), [9, 30], 39),
+    (
+        VdafInstance("sumvec", bits=4, length=4, xof_mode="draft"),
+        [[1, 2, 3, 4], [5, 4, 3, 2]],
+        [6, 6, 6, 6],
+    ),
+    (VdafInstance("countvec", bits=1, length=3, xof_mode="draft"), [[1, 0, 1]], [1, 0, 1]),
+    (VdafInstance("histogram", length=4, xof_mode="draft"), [0, 3, 3], [1, 0, 0, 2]),
+    (
+        VdafInstance("fixedpoint", bits=16, length=2, xof_mode="draft"),
+        [[100, -50]],
+        [100 / 32768, -50 / 32768],
+    ),
 ]
 CASE_IDS = [
     "count",
@@ -111,7 +127,12 @@ CASE_IDS = [
     "countvec",
     "histogram",
     "fixedpoint",
+    "count-draft-xof",
     "sum-draft-xof",
+    "sumvec-draft-xof",
+    "countvec-draft-xof",
+    "histogram-draft-xof",
+    "fixedpoint-draft-xof",
 ]
 
 

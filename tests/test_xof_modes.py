@@ -102,12 +102,19 @@ def test_modes_produce_disjoint_transcripts():
         draft.prepare_shares_to_prep([ps0, ps1])
 
 
-def test_batched_engine_refuses_draft_mode():
+def test_batched_engine_draft_dispatch():
+    """Short-stream draft instances get the device draft engine
+    (vdaf.draft_jax); long-stream draft tasks refuse and fall back to
+    the host engine."""
+    from janus_tpu.vdaf.draft_jax import Prio3BatchedDraft
+
+    p3 = prio3_batched(VdafInstance("count", xof_mode="draft"))
+    assert isinstance(p3, Prio3BatchedDraft)
     with pytest.raises(ValueError):
-        prio3_batched(VdafInstance("count", xof_mode="draft"))
+        prio3_batched(VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"))
 
 
-def test_engine_cache_dispatches_host_engine():
+def test_engine_cache_dispatches_by_stream_length():
     from janus_tpu.aggregator.engine_cache import (
         EngineCache,
         HostEngineCache,
@@ -115,9 +122,13 @@ def test_engine_cache_dispatches_host_engine():
     )
 
     fast = engine_cache(VdafInstance("count"), VK)
-    draft = engine_cache(VdafInstance("count", xof_mode="draft"), VK)
+    draft_short = engine_cache(VdafInstance("count", xof_mode="draft"), VK)
+    draft_long = engine_cache(
+        VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"), VK
+    )
     assert isinstance(fast, EngineCache)
-    assert isinstance(draft, HostEngineCache)
+    assert isinstance(draft_short, EngineCache)  # device draft engine
+    assert isinstance(draft_long, HostEngineCache)
 
 
 def test_host_engine_matches_host_transcript():
