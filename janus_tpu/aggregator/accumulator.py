@@ -98,9 +98,19 @@ def accumulate_batched(
 class Accumulator:
     """reference accumulator.rs:32."""
 
-    def __init__(self, task: Task, shard_count: int = 1):
+    def __init__(
+        self,
+        task: Task,
+        shard_count: int = 1,
+        field=None,
+        aggregation_parameter: bytes = b"",
+    ):
+        """field/aggregation_parameter: parameterized VDAFs (Poplar1)
+        accumulate in a per-parameter field and key their batch rows by
+        the parameter; Prio3 uses the circuit field and parameter b""."""
         self.task = task
-        self.field = circuit_for(task.vdaf).FIELD
+        self.field = field if field is not None else circuit_for(task.vdaf).FIELD
+        self.agg_param = aggregation_parameter
         self.shard_count = shard_count
         # batch_identifier bytes -> [share bytes | None, count, checksum, interval | None]
         self._state: dict[bytes, list] = {}
@@ -157,19 +167,21 @@ class Accumulator:
         unmerged: set = set()
         for batch_identifier, (share, count, checksum, interval, rids) in self._state.items():
             # a COLLECTED row in ANY shard closes the batch
-            if tx.batch_has_collected_shard(self.task.task_id, batch_identifier, b""):
+            if tx.batch_has_collected_shard(
+                self.task.task_id, batch_identifier, self.agg_param
+            ):
                 unmerged.update(r.data for r in rids)
                 continue
             ord_ = secrets.randbelow(self.shard_count)
             existing = tx.get_batch_aggregation(
-                self.task.task_id, batch_identifier, b"", ord_
+                self.task.task_id, batch_identifier, self.agg_param, ord_
             )
             if existing is None:
                 tx.put_batch_aggregation(
                     BatchAggregation(
                         self.task.task_id,
                         batch_identifier,
-                        b"",
+                        self.agg_param,
                         ord_,
                         BatchAggregationState.AGGREGATING,
                         share,
@@ -182,7 +194,7 @@ class Accumulator:
             merged = BatchAggregation(
                 self.task.task_id,
                 batch_identifier,
-                b"",
+                self.agg_param,
                 ord_,
                 existing.state,
                 add_encoded_aggregate_shares(self.field, existing.aggregate_share, share),

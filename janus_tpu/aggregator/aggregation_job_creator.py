@@ -53,6 +53,11 @@ class AggregationJobCreator:
         for task in tasks:
             if task.role != Role.LEADER:
                 continue
+            if task.vdaf.has_aggregation_parameter:
+                # parameterized VDAFs (Poplar1): reports aggregate once
+                # PER collection parameter; jobs are created by the
+                # collection job driver when the parameter is known
+                continue
             created += self.create_jobs_for_task(task)
         return created
 

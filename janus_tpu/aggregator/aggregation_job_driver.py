@@ -65,6 +65,12 @@ from .engine_cache import engine_cache
 log = logging.getLogger(__name__)
 
 
+def _err_or_default(err) -> PrepareError:
+    """PrepareError.BATCH_COLLECTED has enum value 0 (falsy), so the
+    `err or DEFAULT` idiom silently rewrites it; compare against None."""
+    return err if err is not None else PrepareError.VDAF_PREP_ERROR
+
+
 @dataclass
 class AggregationJobDriverConfig:
     batch_aggregation_shard_count: int = 1
@@ -197,9 +203,6 @@ class AggregationJobDriver:
             self.ds.run_tx(lambda tx: tx.release_aggregation_job(acquired), "release")
             return
 
-        wire = Prio3Wire(circuit_for(task.vdaf))
-        engine = engine_cache(task.vdaf, task.vdaf_verify_key)
-
         # multi-round jobs park accepted reports in WaitingLeader after
         # init; a later step sends the continue request (reference
         # :439-514 CONTINUE path)
@@ -209,6 +212,12 @@ class AggregationJobDriver:
             return
 
         pending = [ra for ra in ras if ra.state == ReportAggregationState.START]
+        if task.vdaf.kind == "poplar1":
+            self._step_poplar1_init(acquired, task, job, pending, reports)
+            return
+
+        wire = Prio3Wire(circuit_for(task.vdaf))
+        engine = engine_cache(task.vdaf, task.vdaf_verify_key)
         if not pending:
             # nothing to do; mark job finished
             def finish_empty(tx):
@@ -288,7 +297,7 @@ class AggregationJobDriver:
                     failed[i] = PrepareError.INVALID_MESSAGE
                     continue
                 if pr.result.kind == PrepareStepResult.REJECT:
-                    failed[i] = pr.result.prepare_error or PrepareError.VDAF_PREP_ERROR
+                    failed[i] = _err_or_default(pr.result.prepare_error)
                     continue
                 if pr.result.kind not in (PrepareStepResult.CONTINUE, PrepareStepResult.FINISHED):
                     failed[i] = PrepareError.INVALID_MESSAGE
@@ -352,7 +361,7 @@ class AggregationJobDriver:
                         )
                     )
                 else:
-                    err = failed[i] or PrepareError.VDAF_PREP_ERROR
+                    err = _err_or_default(failed[i])
                     metrics.aggregate_step_failure_counter.add(type=err.name.lower())
                     new_ras.append(ra.failed(err))
 
@@ -380,7 +389,7 @@ class AggregationJobDriver:
             if accept[i]:
                 new_ras.append(ra.finished())
             else:
-                err = failed[i] or PrepareError.VDAF_PREP_ERROR
+                err = _err_or_default(failed[i])
                 metrics.aggregate_step_failure_counter.add(type=err.name.lower())
                 new_ras.append(ra.failed(err))
 
@@ -399,12 +408,136 @@ class AggregationJobDriver:
         with span("driver.write_tx", batch=n):
             self.ds.run_tx(write, "step_agg_job_write")
 
+    def _step_poplar1_init(self, acquired, task: Task, job, pending, reports) -> None:
+        """Poplar1 leader init (see aggregator.poplar1_ops docstring):
+        evaluate IDPF shares at the job's aggregation parameter, send
+        sketch shares, verify the helper's combined sketch, park
+        WaitingLeader for the continue round."""
+        import dataclasses
+
+        from .poplar1_ops import Poplar1Ops
+
+        pop = Poplar1Ops(task.vdaf.bits)
+        param = pop.decode_param(job.aggregation_parameter)
+        F = pop.field_for(param)
+
+        if not pending:
+            def finish_empty(tx):
+                tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
+                tx.release_aggregation_job(acquired)
+
+            self.ds.run_tx(finish_empty, "step_p1_job_finish_empty")
+            return
+
+        n = len(pending)
+        failed: list = [None] * n
+        evals: dict[int, tuple] = {}  # i -> (y0, total0)
+        for i, ra in enumerate(pending):
+            rep = reports.get(ra.report_id.data)
+            if rep is None:
+                failed[i] = PrepareError.REPORT_DROPPED
+                continue
+            try:
+                evals[i] = pop.eval_share(
+                    0, rep.public_share, rep.leader_input_share, param
+                )
+            except ValueError:
+                failed[i] = PrepareError.INVALID_MESSAGE
+
+        prep_inits = []
+        send_idx = []
+        for i, ra in enumerate(pending):
+            if failed[i] is not None:
+                continue
+            rep = reports[ra.report_id.data]
+            _, total0 = evals[i]
+            prep_inits.append(
+                PrepareInit(
+                    ReportShare(
+                        ReportMetadata(ra.report_id, ra.client_time),
+                        rep.public_share,
+                        rep.helper_encrypted_input_share,
+                    ),
+                    encode_pingpong(PP_INITIALIZE, None, pop.encode_elem(param, total0)),
+                )
+            )
+            send_idx.append(i)
+
+        parked: dict[int, bytes] = {}  # i -> WaitingLeader blob
+        if prep_inits:
+            req = AggregationJobInitializeReq(
+                job.aggregation_parameter,
+                PartialBatchSelector.from_bytes(job.partial_batch_identifier),
+                tuple(prep_inits),
+            )
+            resp = self._send_init_request(
+                task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
+            )
+            by_id = {pr.report_id: pr for pr in resp.prepare_resps}
+            for i in send_idx:
+                ra = pending[i]
+                pr = by_id.get(ra.report_id)
+                if pr is None or pr.result.kind == PrepareStepResult.REJECT:
+                    failed[i] = _err_or_default(
+                        pr.result.prepare_error if pr is not None else None
+                    )
+                    continue
+                try:
+                    tag, prep_msg, helper_share = decode_pingpong(pr.result.message)
+                    if tag != PP_CONTINUE or helper_share is None:
+                        raise DecodeError("expected ping-pong continue")
+                    total1 = pop.decode_elem(param, helper_share)
+                except (DecodeError, ValueError):
+                    failed[i] = PrepareError.INVALID_MESSAGE
+                    continue
+                y0, total0 = evals[i]
+                combined = F.add(total0, total1)
+                # the helper's claimed prep message must equal our own
+                # combination, and the sketch must verify
+                if prep_msg != pop.encode_elem(param, combined) or not pop.sketch_valid(
+                    param, combined
+                ):
+                    failed[i] = PrepareError.VDAF_PREP_ERROR
+                    continue
+                msg = encode_pingpong(PP_FINISH, pop.encode_elem(param, combined), None)
+                parked[i] = (
+                    len(msg).to_bytes(4, "big") + msg + pop.encode_vec(param, y0)
+                )
+
+        new_ras = []
+        for i, ra in enumerate(pending):
+            if i in parked:
+                new_ras.append(
+                    dataclasses.replace(
+                        ra,
+                        state=ReportAggregationState.WAITING_LEADER,
+                        prep_blob=parked[i],
+                    )
+                )
+            else:
+                err = _err_or_default(failed[i])
+                metrics.aggregate_step_failure_counter.add(type=err.name.lower())
+                new_ras.append(ra.failed(err))
+
+        def write_waiting(tx):
+            for ra in new_ras:
+                tx.update_report_aggregation(ra)
+            tx.release_aggregation_job(acquired)
+
+        self.ds.run_tx(write_waiting, "step_p1_job_park")
+
     def _continue_step(self, acquired, task: Task, job, waiting) -> None:
         """Send the ord-matched continue request for WaitingLeader rows
         and finish the job (reference :439-514 + :530-726)."""
         import dataclasses
 
-        field = circuit_for(task.vdaf).FIELD
+        if task.vdaf.kind == "poplar1":
+            from .poplar1_ops import Poplar1Ops
+
+            pop = Poplar1Ops(task.vdaf.bits)
+            field = pop.field_for(pop.decode_param(job.aggregation_parameter))
+        else:
+            field = circuit_for(task.vdaf).FIELD
         msgs = []
         outs = []
         for ra in waiting:
@@ -422,7 +555,12 @@ class AggregationJobDriver:
         )
         by_id = {pr.report_id: pr for pr in resp.prepare_resps}
 
-        accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
+        accumulator = Accumulator(
+            task,
+            self.cfg.batch_aggregation_shard_count,
+            field=field,
+            aggregation_parameter=job.aggregation_parameter,
+        )
         pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
         fixed_bid = fixed_size_batch_id(pbs)
         new_ras = []
@@ -444,11 +582,11 @@ class AggregationJobDriver:
                     )
                 )
             else:
-                err = (
+                err = _err_or_default(
                     pr.result.prepare_error
                     if pr is not None and pr.result.kind == PrepareStepResult.REJECT
                     else None
-                ) or PrepareError.VDAF_PREP_ERROR
+                )
                 metrics.aggregate_step_failure_counter.add(type=err.name.lower())
                 new_ras.append(ra.failed(err))
 

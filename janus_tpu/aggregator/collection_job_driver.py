@@ -87,14 +87,30 @@ class CollectionJobDriver:
             self.ds.run_tx(lambda tx: tx.release_collection_job(acquired), "release")
             return
 
-        field = circuit_for(task.vdaf).FIELD
+        if task.vdaf.has_aggregation_parameter:
+            # parameterized VDAFs (Poplar1): aggregation happens per
+            # collection parameter — the piece the reference punts on
+            # (README.md:9-11). Create aggregation jobs for the
+            # parameter on first step; wait for them to finish before
+            # computing the aggregate share.
+            from .poplar1_ops import Poplar1Ops
+
+            pop = Poplar1Ops(task.vdaf.bits)
+            field = pop.field_for(pop.decode_param(job.aggregation_parameter))
+            if not self._ensure_param_aggregation(task, job):
+                self.ds.run_tx(lambda tx: tx.release_collection_job(acquired), "release")
+                return
+        else:
+            field = circuit_for(task.vdaf).FIELD
         query = Query.from_bytes(job.query)
 
         # tx1: gather + mark collected (reference :160-199)
         def gather(tx):
             if query.query_type == TimeInterval.CODE:
                 rows = tx.get_batch_aggregations_intersecting_interval(
-                    task.task_id, Interval.from_bytes(job.batch_identifier)
+                    task.task_id,
+                    Interval.from_bytes(job.batch_identifier),
+                    aggregation_parameter=job.aggregation_parameter,
                 )
             else:
                 rows = tx.get_batch_aggregations_for_batch(
@@ -179,6 +195,68 @@ class CollectionJobDriver:
             tx.release_collection_job(acquired)
 
         self.ds.run_tx(mark_and_store, "step_collection_store")
+
+    def _ensure_param_aggregation(self, task: Task, job) -> bool:
+        """Create aggregation jobs for the collection's parameter over
+        reports in the batch interval; True when aggregation under this
+        parameter is complete and the aggregate share can be computed.
+
+        Max 512 reports per job (host per-report prepare; heavy-hitters
+        batches are small)."""
+        import secrets as _secrets
+
+        from ..messages import AggregationJobId, PartialBatchSelector, Time
+        from ..datastore.models import (
+            AggregationJobModel,
+            AggregationJobState,
+            ReportAggregationModel,
+            ReportAggregationState,
+        )
+
+        interval = Interval.from_bytes(job.batch_identifier)
+        param = job.aggregation_parameter
+
+        def create(tx):
+            in_interval = tx.get_client_report_ids_in_interval(task.task_id, interval)
+            done = tx.get_aggregated_report_ids_for_param(
+                task.task_id, [rid for rid, _ in in_interval], param
+            )
+            todo = [(rid, t) for rid, t in in_interval if rid.data not in done]
+            for lo in range(0, len(todo), 512):
+                chunk = todo[lo : lo + 512]
+                job_id = AggregationJobId(_secrets.token_bytes(16))
+                times = [t.seconds for _, t in chunk]
+                tx.put_aggregation_job(
+                    AggregationJobModel(
+                        task.task_id,
+                        job_id,
+                        param,
+                        PartialBatchSelector.time_interval().to_bytes(),
+                        Interval(Time(min(times)), Duration(max(times) - min(times) + 1)),
+                        AggregationJobState.IN_PROGRESS,
+                        0,
+                        None,
+                    )
+                )
+                for ord_, (rid, t) in enumerate(chunk):
+                    tx.put_report_aggregation(
+                        ReportAggregationModel(
+                            task.task_id,
+                            job_id,
+                            rid,
+                            t,
+                            ord_,
+                            ReportAggregationState.START,
+                            b"",
+                            None,
+                        )
+                    )
+            if todo:
+                return False  # fresh jobs: not ready this pass
+            # ready once no job for this param is still in progress
+            return tx.count_active_aggregation_jobs_for_param(task.task_id, param) == 0
+
+        return self.ds.run_tx(create, "ensure_param_aggregation")
 
     def _lease_deadline(self, acquired) -> float:
         from .job_driver import lease_deadline

@@ -74,6 +74,12 @@ from ..vdaf.wire import (
 # ping-pong CONTINUE (opaque bytes; the fake's round-2 check is a
 # prep-message echo — the *machinery* is what multi-round exercises).
 FAKE_ROUND1_PREP_SHARE = b"fake-round1-ps!!"
+
+
+def _err_or_default(err) -> "PrepareError":
+    """PrepareError.BATCH_COLLECTED has enum value 0 (falsy), so the
+    `err or DEFAULT` idiom silently rewrites it; compare against None."""
+    return err if err is not None else PrepareError.VDAF_PREP_ERROR
 from . import errors
 from .accumulator import (
     Accumulator,
@@ -104,9 +110,18 @@ class TaskAggregator:
     def __init__(self, task: Task, cfg: Config, global_hpke_keypairs=None):
         self.task = task
         self.cfg = cfg
-        self.circ = circuit_for(task.vdaf)
-        self.wire = Prio3Wire(self.circ)
-        self.engine = engine_cache(task.vdaf, task.vdaf_verify_key)
+        if task.vdaf.kind == "poplar1":
+            from .poplar1_ops import Poplar1Ops
+
+            self.circ = None
+            self.wire = None
+            self.engine = None
+            self.poplar = Poplar1Ops(task.vdaf.bits)
+        else:
+            self.circ = circuit_for(task.vdaf)
+            self.wire = Prio3Wire(self.circ)
+            self.engine = engine_cache(task.vdaf, task.vdaf_verify_key)
+            self.poplar = None
         self.global_hpke_keypairs = global_hpke_keypairs
 
     def _hpke_keypair(self, config_id):
@@ -139,11 +154,14 @@ class TaskAggregator:
             raise errors.ReportRejected("task expired", task.task_id)
         if task.report_expired(report.metadata.time, now):
             raise errors.ReportRejected("report expired", task.task_id)
-        try:
-            self.wire.decode_public_share(report.public_share)
-        except DecodeError as e:
-            metrics.upload_decode_failure_counter.add()
-            raise errors.InvalidMessage(f"bad public share: {e}", task.task_id)
+        # (poplar1 public-share validation happens with the input-share
+        # validation below — validate_shares decodes it once)
+        if self.poplar is None:
+            try:
+                self.wire.decode_public_share(report.public_share)
+            except DecodeError as e:
+                metrics.upload_decode_failure_counter.add()
+                raise errors.InvalidMessage(f"bad public share: {e}", task.task_id)
 
         # decrypt + decode the leader input share at upload time (:1391)
         keypair = self._hpke_keypair(report.leader_encrypted_input_share.config_id)
@@ -161,11 +179,14 @@ class TaskAggregator:
                     aad,
                 )
                 payload = PlaintextInputShare.from_bytes(plaintext).payload
-                # columnar validation, not scalar decode: the full Python
-                # decode was the measured upload bottleneck (BASELINE.md
-                # served table)
-                self.wire.validate_leader_share(payload)
-        except (HpkeError, DecodeError) as e:
+                if self.poplar is not None:
+                    self.poplar.validate_shares(report.public_share, payload)
+                else:
+                    # columnar validation, not scalar decode: the full
+                    # Python decode was the measured upload bottleneck
+                    # (BASELINE.md served table)
+                    self.wire.validate_leader_share(payload)
+        except (HpkeError, DecodeError, ValueError) as e:
             metrics.upload_decrypt_failure_counter.add()
             raise errors.ReportRejected(f"undecryptable/undecodable share: {e}", task.task_id)
 
@@ -211,13 +232,18 @@ class TaskAggregator:
         )
         if existing is not None:
             if existing.last_request_hash == request_hash:
-                return self._replay_aggregate_init_response(ds, job_id)
+                return self._replay_aggregate_init_response(ds, job_id, existing)
             raise errors.InvalidMessage("aggregation job id reuse", task.task_id)
 
         if req.partial_batch_selector.query_type != task.query_type.code:
             # reference rejects PBS/task query-type mismatch as invalidMessage
             raise errors.InvalidMessage(
                 "partial batch selector query type mismatch", task.task_id
+            )
+
+        if self.poplar is not None:
+            return self._handle_aggregate_init_poplar1(
+                ds, clock, job_id, req, request_hash
             )
 
         inits = list(req.prepare_inits)
@@ -427,7 +453,122 @@ class TaskAggregator:
             ]
         return AggregationJobResp(tuple(resps))
 
-    def _replay_aggregate_init_response(self, ds: Datastore, job_id) -> AggregationJobResp:
+    def _handle_aggregate_init_poplar1(
+        self, ds: Datastore, clock, job_id, req, request_hash
+    ) -> AggregationJobResp:
+        """Helper init for Poplar1 (see poplar1_ops module docstring for
+        the ping-pong mapping). Per-report host loop, like the
+        reference's own prepare loops."""
+        task = self.task
+        pop = self.poplar
+        try:
+            param = pop.decode_param(req.aggregation_parameter)
+        except ValueError as e:
+            raise errors.InvalidMessage(f"bad aggregation parameter: {e}", task.task_id)
+        F = pop.field_for(param)
+
+        inits = list(req.prepare_inits)
+        n = len(inits)
+        ids = [pi.report_share.metadata.report_id for pi in inits]
+        if len(set(ids)) != n:
+            raise errors.InvalidMessage("duplicate report id in init request", task.task_id)
+
+        now = clock.now()
+        # param-scoped replay check: a report aggregates once PER param
+        replayed_ids = ds.run_tx(
+            lambda tx: tx.get_aggregated_report_ids_for_param(
+                task.task_id, ids, req.aggregation_parameter
+            ),
+            "agg_init_replay_p1",
+        )
+
+        # (no accumulator here: Poplar1 is 2-round — out shares
+        # accumulate in the continue handler when the sketch finishes)
+        resps = []
+        report_aggs = []
+        for i, pi in enumerate(inits):
+            rs = pi.report_share
+            md = rs.metadata
+            err = None
+            blob = b""
+            state = ReportAggregationState.FAILED
+            result = None
+            if task.task_expiration and md.time > task.task_expiration:
+                err = PrepareError.TASK_EXPIRED
+            elif task.report_expired(md.time, now):
+                err = PrepareError.REPORT_DROPPED
+            elif md.report_id.data in replayed_ids:
+                err = PrepareError.REPORT_REPLAYED
+            else:
+                keypair = self._hpke_keypair(rs.encrypted_input_share.config_id)
+                if keypair is None:
+                    err = PrepareError.HPKE_UNKNOWN_CONFIG_ID
+                else:
+                    aad = InputShareAad(task.task_id, md, rs.public_share).to_bytes()
+                    try:
+                        plaintext = hpke_open(
+                            keypair,
+                            HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
+                            rs.encrypted_input_share,
+                            aad,
+                        )
+                    except HpkeError:
+                        err = PrepareError.HPKE_DECRYPT_ERROR
+                        plaintext = None
+                    if err is None:
+                        try:
+                            seed = PlaintextInputShare.from_bytes(plaintext).payload
+                            pop.validate_shares(rs.public_share, seed)
+                            tag, _, leader_ps = decode_pingpong(pi.message)
+                            if tag != PP_INITIALIZE or leader_ps is None:
+                                raise ValueError("expected ping-pong initialize")
+                            total0 = pop.decode_elem(param, leader_ps)
+                            y1, total1 = pop.eval_share(1, rs.public_share, seed, param)
+                            combined = F.add(total0, total1)
+                            if not pop.sketch_valid(param, combined):
+                                err = PrepareError.VDAF_PREP_ERROR
+                            else:
+                                msg = pop.encode_elem(param, combined)
+                                blob = msg + pop.encode_elem(param, total1) + pop.encode_vec(param, y1)
+                                state = ReportAggregationState.WAITING_HELPER
+                                result = PrepareStepResult.cont(
+                                    encode_pingpong(PP_CONTINUE, msg, pop.encode_elem(param, total1))
+                                )
+                        except (DecodeError, ValueError):
+                            err = PrepareError.INVALID_MESSAGE
+            if err is not None:
+                metrics.aggregate_step_failure_counter.add(type=err.name.lower())
+                result = PrepareStepResult.reject(err)
+            resps.append(PrepareResp(md.report_id, result))
+            report_aggs.append(
+                ReportAggregationModel(
+                    task.task_id, job_id, md.report_id, md.time, i, state, blob, err
+                )
+            )
+
+        times = [pi.report_share.metadata.time.seconds for pi in inits]
+        job = AggregationJobModel(
+            task.task_id,
+            job_id,
+            req.aggregation_parameter,
+            req.partial_batch_selector.to_bytes(),
+            Interval(Time(min(times)), Duration(max(times) - min(times) + 1))
+            if times
+            else Interval(Time(0), Duration(1)),
+            AggregationJobState.IN_PROGRESS,
+            0,
+            request_hash,
+        )
+
+        def write(tx):
+            tx.put_aggregation_job(job)
+            for ra in report_aggs:
+                tx.put_report_aggregation(ra)
+
+        ds.run_tx(write, "aggregate_init_p1")
+        return AggregationJobResp(tuple(resps))
+
+    def _replay_aggregate_init_response(self, ds: Datastore, job_id, job) -> AggregationJobResp:
         """Reconstruct the response from stored rows (reference
         check_aggregation_job_idempotence, aggregator.rs:1526).
 
@@ -442,7 +583,19 @@ class TaskAggregator:
             lambda tx: tx.get_report_aggregations_for_job(self.task.task_id, job_id),
             "agg_init_replay_resp",
         )
-        msg_len = 16 if self.wire.uses_jr else 0
+        if self.poplar is not None:
+            param = self.poplar.decode_param(job.aggregation_parameter)
+            es = self.poplar.enc_size(param)
+            msg_len = es
+
+            def round1_share(blob):
+                return blob[es : 2 * es]
+        else:
+            msg_len = 16 if self.wire.uses_jr else 0
+
+            def round1_share(blob):
+                return FAKE_ROUND1_PREP_SHARE
+
         resps = []
         for ra in ras:
             if ra.state == ReportAggregationState.FINISHED:
@@ -450,11 +603,11 @@ class TaskAggregator:
             elif ra.state == ReportAggregationState.WAITING_HELPER:
                 result = PrepareStepResult.cont(
                     encode_pingpong(
-                        PP_CONTINUE, ra.prep_blob[:msg_len], FAKE_ROUND1_PREP_SHARE
+                        PP_CONTINUE, ra.prep_blob[:msg_len], round1_share(ra.prep_blob)
                     )
                 )
             else:
-                result = PrepareStepResult.reject(ra.prepare_error or PrepareError.VDAF_PREP_ERROR)
+                result = PrepareStepResult.reject(_err_or_default(ra.prepare_error))
             resps.append(PrepareResp(ra.report_id, result))
         return AggregationJobResp(tuple(resps))
 
@@ -528,8 +681,22 @@ class TaskAggregator:
                     task.task_id,
                 )
 
-            msg_len = 16 if self.wire.uses_jr else 0
-            accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
+            if self.poplar is not None:
+                # blob = enc(combined) || enc(total1) || enc(y_shares)
+                param = self.poplar.decode_param(job.aggregation_parameter)
+                es = self.poplar.enc_size(param)
+                msg_len, skip_len = es, 2 * es
+                field = self.poplar.field_for(param)
+            else:
+                msg_len = 16 if self.wire.uses_jr else 0
+                skip_len = msg_len
+                field = None
+            accumulator = Accumulator(
+                task,
+                self.cfg.batch_aggregation_shard_count,
+                field=field,
+                aggregation_parameter=job.aggregation_parameter,
+            )
             pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
             fixed_bid = fixed_size_batch_id(pbs)
             updated = []
@@ -542,7 +709,7 @@ class TaskAggregator:
                 except DecodeError:
                     ok = False
                 if ok:
-                    out_share = accumulator.field.decode_vec(ra.prep_blob[msg_len:])
+                    out_share = accumulator.field.decode_vec(ra.prep_blob[skip_len:])
                     bid = fixed_bid or Interval(
                         ra.client_time.to_batch_interval_start(task.time_precision),
                         task.time_precision,
@@ -614,7 +781,7 @@ class TaskAggregator:
                     PrepareResp(
                         ra.report_id,
                         PrepareStepResult.reject(
-                            ra.prepare_error or PrepareError.VDAF_PREP_ERROR
+                            _err_or_default(ra.prepare_error)
                         ),
                     )
                 )
@@ -629,6 +796,22 @@ class TaskAggregator:
         task = self.task
         if req.query.query_type != task.query_type.code:
             raise errors.InvalidMessage("query type mismatch", task.task_id)
+        if self.poplar is not None:
+            # reject malformed parameters at creation, not as silent
+            # driver abandonment ten lease attempts later
+            try:
+                self.poplar.decode_param(req.aggregation_parameter)
+            except ValueError as e:
+                raise errors.InvalidMessage(
+                    f"bad aggregation parameter: {e}", task.task_id
+                )
+        elif req.aggregation_parameter != b"" and not task.vdaf.kind.startswith("fake"):
+            # fakes mirror the reference's dummy_vdaf, which accepts
+            # arbitrary parameters; real Prio3 parameters are empty
+            raise errors.InvalidMessage(
+                "nonempty aggregation parameter for a parameterless VDAF",
+                task.task_id,
+            )
         from ..messages import FixedSizeQuery
 
         current_batch = False
@@ -797,6 +980,15 @@ class TaskAggregator:
         else:
             batch_identifier = req.batch_selector.batch_id.data
 
+        if self.poplar is not None:
+            try:
+                p1_param = self.poplar.decode_param(req.aggregation_parameter)
+            except ValueError as e:
+                raise errors.InvalidMessage(f"bad aggregation parameter: {e}", task.task_id)
+            share_field = self.poplar.field_for(p1_param)
+        else:
+            share_field = self.circ.FIELD
+
         def compute(tx):
             existing = tx.get_aggregate_share_job(
                 task.task_id, batch_identifier, req.aggregation_parameter
@@ -810,7 +1002,9 @@ class TaskAggregator:
             # gather the helper's own shard rows
             if req.batch_selector.query_type == TimeInterval.CODE:
                 rows = tx.get_batch_aggregations_intersecting_interval(
-                    task.task_id, Interval.from_bytes(batch_identifier)
+                    task.task_id,
+                    Interval.from_bytes(batch_identifier),
+                    aggregation_parameter=req.aggregation_parameter,
                 )
             else:
                 rows = tx.get_batch_aggregations_for_batch(
@@ -820,7 +1014,7 @@ class TaskAggregator:
             total = 0
             checksum = ReportIdChecksum()
             for row in rows:
-                share = add_encoded_aggregate_shares(self.circ.FIELD, share, row.aggregate_share)
+                share = add_encoded_aggregate_shares(share_field, share, row.aggregate_share)
                 total += row.report_count
                 checksum = checksum.combined_with(row.checksum)
                 tx.mark_batch_aggregations_collected(
@@ -840,7 +1034,7 @@ class TaskAggregator:
             # released (count/checksum stay exact; only the share is noised)
             from ..dp import add_noise_to_agg_share
 
-            share = add_noise_to_agg_share(task.dp_strategy, self.circ.FIELD, share)
+            share = add_noise_to_agg_share(task.dp_strategy, share_field, share)
             job = AggregateShareJob(
                 task.task_id,
                 batch_identifier,

@@ -1,0 +1,111 @@
+"""Poplar1 protocol ops for the DAP aggregator.
+
+The reference declares `Poplar1<XofShake128, 16>` but cannot drive it
+through DAP: nontrivial aggregation parameters are unsupported
+(README.md:9-11; `VdafHasAggregationParameter`,
+aggregator_core/src/lib.rs:44). This module is the missing plumbing —
+per-(level, prefixes) parameter handling for upload validation, helper
+prepare (the sketch exchange mapped onto ping-pong), the leader
+driver, and the collection-driven aggregation-job creation.
+
+Protocol mapping onto DAP ping-pong (2 rounds, the same shape the
+continue machinery already serves for the two-round fake):
+
+  - leader init: evaluates its IDPF key share at the parameter's
+    prefixes -> y0 (count shares) + sketch share total0;
+    PrepareInit.message = PP_INITIALIZE(prep_share=enc(total0)).
+  - helper init: evaluates -> y1, total1; combined = total0 + total1
+    must reconstruct to 0 (pruned path) or 1 (one-hot path); invalid
+    reports reject NOW; valid ones park WAITING_HELPER with
+    prep_blob = enc(combined) || enc(total1) || enc(y1) and answer
+    PP_CONTINUE(prep_msg=enc(combined), prep_share=enc(total1)).
+  - leader continue: re-derives combined from its own total0 + the
+    helper's total1, verifies the sketch, parks WAITING_LEADER, then
+    sends PP_FINISH(enc(combined)); the helper's ord-matched continue
+    compares it against prep_blob[:enc_size] and accumulates y1.
+
+Host-side per-report loops (like the reference's own prepare loops) —
+heavy-hitters batches are small; the TPU path stays Prio3's.
+"""
+
+from __future__ import annotations
+
+from ..vdaf.poplar1 import (
+    Idpf,
+    IdpfKey,
+    Poplar1AggParam,
+    decode_input_share,
+    decode_public_share,
+)
+
+
+class Poplar1Ops:
+    def __init__(self, bits: int):
+        assert bits > 0, "poplar1 task missing bit length"
+        self.bits = bits
+        self.idpf = Idpf(bits)
+
+    # --- aggregation parameter ---
+    def decode_param(self, raw: bytes) -> Poplar1AggParam:
+        param = Poplar1AggParam.decode(raw)
+        if not (0 <= param.level < self.bits):
+            raise ValueError(f"poplar1 level {param.level} out of range")
+        if not param.prefixes:
+            raise ValueError("poplar1 aggregation parameter has no prefixes")
+        limit = 1 << (param.level + 1)
+        if any(not (0 <= p < limit) for p in param.prefixes):
+            raise ValueError("poplar1 prefix out of range for level")
+        if list(param.prefixes) != sorted(set(param.prefixes)):
+            raise ValueError("poplar1 prefixes must be sorted and distinct")
+        return param
+
+    def field_for(self, param: Poplar1AggParam):
+        return self.idpf.field_at(param.level)
+
+    def enc_size(self, param: Poplar1AggParam) -> int:
+        return self.field_for(param).ENCODED_SIZE
+
+    # --- share handling ---
+    def validate_shares(self, public_share: bytes, input_share_payload: bytes) -> None:
+        decode_public_share(self.bits, public_share)
+        if len(input_share_payload) != 16:
+            raise ValueError("poplar1 input share must be a 16-byte root seed")
+
+    def eval_share(
+        self, party: int, public_share: bytes, root_seed: bytes, param: Poplar1AggParam
+    ):
+        """-> (y_shares [per prefix], total [sketch share]) as field ints."""
+        F = self.field_for(param)
+        cws = decode_public_share(self.bits, public_share)
+        key = decode_input_share(self.bits, cws, root_seed)
+        vals = self.idpf.eval_prefixes(party, key, param.level, list(param.prefixes))
+        y = [v[0] for v in vals]
+        total = 0
+        for v in y:
+            total = F.add(total, v)
+        return y, total
+
+    def sketch_valid(self, param: Poplar1AggParam, combined: int) -> bool:
+        return combined in (0, 1)
+
+    # --- codecs ---
+    def encode_elem(self, param: Poplar1AggParam, x: int) -> bytes:
+        return int(x).to_bytes(self.enc_size(param), "little")
+
+    def decode_elem(self, param: Poplar1AggParam, raw: bytes) -> int:
+        F = self.field_for(param)
+        if len(raw) != F.ENCODED_SIZE:
+            raise ValueError("poplar1 element length mismatch")
+        x = int.from_bytes(raw, "little")
+        if x >= F.MODULUS:
+            raise ValueError("poplar1 element out of range")
+        return x
+
+    def encode_vec(self, param: Poplar1AggParam, xs: list[int]) -> bytes:
+        return b"".join(self.encode_elem(param, x) for x in xs)
+
+    def decode_vec(self, param: Poplar1AggParam, raw: bytes) -> list[int]:
+        es = self.enc_size(param)
+        if len(raw) != es * len(param.prefixes):
+            raise ValueError("poplar1 out-share length mismatch")
+        return [self.decode_elem(param, raw[i : i + es]) for i in range(0, len(raw), es)]

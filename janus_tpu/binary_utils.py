@@ -125,8 +125,8 @@ def warmup_engines(ds, batch: int | None = None) -> None:
     warm_batch = batch or MIN_BUCKET
     tasks = ds.run_tx(lambda tx: tx.get_tasks(), "warmup_list_tasks")
     for task in tasks:
-        if task.vdaf.kind.startswith("fake"):
-            continue
+        if task.vdaf.kind.startswith("fake") or task.vdaf.kind == "poplar1":
+            continue  # fakes and host-side Poplar1 have no device engine
         try:
             eng = engine_cache(task.vdaf, task.vdaf_verify_key)
             if isinstance(eng, HostEngineCache):

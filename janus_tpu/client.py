@@ -71,8 +71,16 @@ class Client:
     ):
         self.params = parameters
         self.vdaf = vdaf
-        self.prio3 = prio3_host(vdaf)
-        self.wire = Prio3Wire(circuit_for(vdaf))
+        if vdaf.kind == "poplar1":
+            from .vdaf.poplar1 import Poplar1
+
+            self.prio3 = None
+            self.wire = None
+            self.poplar = Poplar1(vdaf.bits)
+        else:
+            self.prio3 = prio3_host(vdaf)
+            self.wire = Prio3Wire(circuit_for(vdaf))
+            self.poplar = None
         self.leader_hpke_config = leader_hpke_config
         self.helper_hpke_config = helper_hpke_config
         self.clock = clock or RealClock()
@@ -100,24 +108,30 @@ class Client:
         time = (when or self.clock.now()).to_batch_interval_start(self.params.time_precision)
         metadata = ReportMetadata(report_id, time)
 
-        public_share_parts, (leader_share, helper_share) = self.prio3.shard(
-            measurement, report_id.data
-        )
-        public_share = self.wire.encode_public_share(public_share_parts)
-        aad = InputShareAad(self.params.task_id, metadata, public_share).to_bytes()
+        if self.poplar is not None:
+            from .vdaf.poplar1 import encode_input_share, encode_public_share
 
-        leader_payload = PlaintextInputShare(
-            (),
-            self.wire.encode_leader_share(
+            cws, (k0, k1) = self.poplar.shard(measurement)
+            public_share = encode_public_share(self.poplar.bits, cws)
+            leader_raw = encode_input_share(k0)
+            helper_raw = encode_input_share(k1)
+        else:
+            public_share_parts, (leader_share, helper_share) = self.prio3.shard(
+                measurement, report_id.data
+            )
+            public_share = self.wire.encode_public_share(public_share_parts)
+            leader_raw = self.wire.encode_leader_share(
                 leader_share.measurement_share,
                 leader_share.proof_share,
                 leader_share.joint_rand_blind,
-            ),
-        ).to_bytes()
-        helper_payload = PlaintextInputShare(
-            (),
-            self.wire.encode_helper_share(helper_share.seed, helper_share.joint_rand_blind),
-        ).to_bytes()
+            )
+            helper_raw = self.wire.encode_helper_share(
+                helper_share.seed, helper_share.joint_rand_blind
+            )
+        aad = InputShareAad(self.params.task_id, metadata, public_share).to_bytes()
+
+        leader_payload = PlaintextInputShare((), leader_raw).to_bytes()
+        helper_payload = PlaintextInputShare((), helper_raw).to_bytes()
 
         leader_ct = hpke_seal(
             self.leader_hpke_config,

@@ -67,7 +67,7 @@ class Collector:
     def __init__(self, params: CollectorParameters, vdaf: VdafInstance, http):
         self.params = params
         self.vdaf = vdaf
-        self.prio3 = prio3_host(vdaf)
+        self.prio3 = prio3_host(vdaf) if vdaf.kind != "poplar1" else None
         self.http = http
 
     def start_collection(self, query: Query, agg_param: bytes = b"") -> CollectionJobId:
@@ -123,7 +123,14 @@ class Collector:
         else:
             batch_selector = BatchSelector.fixed_size(collection.partial_batch_selector.batch_id)
         aad = AggregateShareAad(self.params.task_id, agg_param, batch_selector).to_bytes()
-        field = circuit_for(self.vdaf).FIELD
+        if self.vdaf.kind == "poplar1":
+            from .vdaf.poplar1 import Poplar1, Poplar1AggParam
+
+            poplar = Poplar1(self.vdaf.bits)
+            p1_param = Poplar1AggParam.decode(agg_param)
+            field = poplar.idpf.field_at(p1_param.level)
+        else:
+            field = circuit_for(self.vdaf).FIELD
         shares = []
         for role, ct in (
             (Role.LEADER, collection.leader_encrypted_agg_share),
@@ -136,7 +143,10 @@ class Collector:
                 aad,
             )
             shares.append(field.decode_vec(pt))
-        result = self.prio3.unshard(shares, collection.report_count)
+        if self.vdaf.kind == "poplar1":
+            result = poplar.unshard(p1_param, shares)
+        else:
+            result = self.prio3.unshard(shares, collection.report_count)
         pbs = (
             collection.partial_batch_selector
             if query.query_type != TimeInterval.CODE

@@ -650,6 +650,51 @@ class Transaction:
             )
         return out
 
+    def get_aggregated_report_ids_for_param(
+        self, task_id: TaskId, report_ids: list[ReportId], aggregation_parameter: bytes
+    ) -> set[bytes]:
+        """Param-scoped replay check (VDAFs with nontrivial aggregation
+        parameters, e.g. Poplar1): which of `report_ids` already have a
+        report-aggregation row under a job with THIS parameter. A
+        report legitimately aggregates once per parameter."""
+        out: set[bytes] = set()
+        ids = [r.data for r in report_ids]
+        for lo in range(0, len(ids), 500):
+            chunk = ids[lo : lo + 500]
+            marks = ",".join("?" * len(chunk))
+            rows = self._c.execute(
+                "SELECT DISTINCT ra.report_id FROM report_aggregations ra"
+                " JOIN aggregation_jobs aj ON aj.task_id = ra.task_id"
+                "  AND aj.job_id = ra.job_id"
+                " WHERE ra.task_id = ? AND aj.aggregation_parameter = ?"
+                f" AND ra.report_id IN ({marks})",
+                (task_id.data, aggregation_parameter, *chunk),
+            ).fetchall()
+            out.update(r[0] for r in rows)
+        return out
+
+    def get_client_report_ids_in_interval(
+        self, task_id: TaskId, interval: Interval
+    ) -> list[tuple[ReportId, Time]]:
+        """All stored client reports whose time falls in the interval
+        (collection-driven aggregation for parameterized VDAFs)."""
+        rows = self._c.execute(
+            "SELECT report_id, client_time FROM client_reports"
+            " WHERE task_id = ? AND client_time >= ? AND client_time < ?"
+            " ORDER BY client_time, report_id",
+            (task_id.data, interval.start.seconds, interval.end.seconds),
+        ).fetchall()
+        return [(ReportId(r[0]), Time(r[1])) for r in rows]
+
+    def count_active_aggregation_jobs_for_param(
+        self, task_id: TaskId, aggregation_parameter: bytes
+    ) -> int:
+        return self._c.execute(
+            "SELECT COUNT(*) FROM aggregation_jobs"
+            " WHERE task_id = ? AND aggregation_parameter = ? AND state = 'in_progress'",
+            (task_id.data, aggregation_parameter),
+        ).fetchone()[0]
+
     def get_aggregated_report_ids(self, task_id: TaskId, report_ids: list[ReportId]) -> set[bytes]:
         """Which of `report_ids` already have ANY report-aggregation row
         (helper replay check) — one set query for the whole init batch,
@@ -770,11 +815,15 @@ class Transaction:
         ]
 
     def get_batch_aggregations_intersecting_interval(
-        self, task_id: TaskId, interval: Interval
+        self, task_id: TaskId, interval: Interval, aggregation_parameter: bytes | None = None
     ) -> list[BatchAggregation]:
         """Time-interval collection: find shard rows whose batch interval
         falls inside the collection interval (reference
-        query_type.rs:204 CollectableQueryType)."""
+        query_type.rs:204 CollectableQueryType).
+
+        aggregation_parameter: restrict to rows accumulated under that
+        parameter (parameterized VDAFs aggregate the same interval once
+        per parameter); None matches every parameter."""
         rows = self._c.execute(
             "SELECT DISTINCT batch_identifier, aggregation_parameter FROM batch_aggregations"
             " WHERE task_id = ?",
@@ -782,6 +831,8 @@ class Transaction:
         ).fetchall()
         out = []
         for bid, param in rows:
+            if aggregation_parameter is not None and param != aggregation_parameter:
+                continue
             biv = Interval.from_bytes(bid)
             if biv.start >= interval.start and biv.end <= interval.end:
                 out.extend(self.get_batch_aggregations_for_batch(task_id, bid, param))

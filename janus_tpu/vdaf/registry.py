@@ -74,11 +74,14 @@ class VdafInstance:
     @classmethod
     def poplar1(cls, bits: int) -> "VdafInstance":
         """Heavy-hitters VDAF (the reference's Poplar1 variant,
-        core/src/task.rs). Declared and implemented
-        (janus_tpu.vdaf.poplar1) but, exactly like the reference,
-        unreachable through the DAP flow: nontrivial aggregation
-        parameters are unsupported (reference README.md:9-11,
-        VdafHasAggregationParameter, aggregator_core/src/lib.rs:44)."""
+        core/src/task.rs) — fully reachable through DAP here, with
+        nontrivial aggregation parameters (level, prefixes): the
+        collection flow creates param-scoped aggregation jobs and the
+        two-round sketch exchange rides the continue machinery
+        (aggregator.poplar1_ops; tests/test_poplar1_dap.py). The
+        reference declares this variant but punts on the DAP plumbing
+        (README.md:9-11, VdafHasAggregationParameter,
+        aggregator_core/src/lib.rs:44)."""
         return cls("poplar1", bits=bits)
 
     # --- test-only fakes (the reference's VdafInstance::Fake* variants,
@@ -110,9 +113,20 @@ class VdafInstance:
 
     @property
     def rounds(self) -> int:
-        """DAP prepare rounds (1 for all Prio3; the two-round fake
-        exercises the continue machinery)."""
-        return 2 if self.kind == "fake_two_round" else 1
+        """DAP prepare rounds: 1 for all Prio3; 2 for Poplar1 (sketch
+        exchange then verify) and the two-round fake."""
+        return 2 if self.kind in ("fake_two_round", "poplar1") else 1
+
+    @property
+    def has_aggregation_parameter(self) -> bool:
+        """Nontrivial aggregation parameters (Poplar1's (level,
+        prefixes)): reports aggregate once PER parameter, and
+        aggregation jobs are created by the collection flow instead of
+        the upload-batch creator. The reference marks this with
+        VdafHasAggregationParameter (aggregator_core/src/lib.rs:44) but
+        punts on the DAP plumbing (README.md:9-11); here it is
+        implemented."""
+        return self.kind == "poplar1"
 
     @property
     def fails_prep_init(self) -> bool:
@@ -168,9 +182,9 @@ def circuit_for(inst: VdafInstance) -> Circuit:
         return Count()
     if inst.kind == "poplar1":
         raise ValueError(
-            "Poplar1 requires nontrivial aggregation parameters, which the "
-            "DAP flow does not support (same practical gate as the "
-            "reference); use janus_tpu.vdaf.poplar1 directly"
+            "Poplar1 has no FLP circuit: the aggregator dispatches it to "
+            "aggregator.poplar1_ops (IDPF + sketch over per-parameter "
+            "prefixes), not the Prio3 engine"
         )
     raise ValueError(f"unknown VDAF kind {inst.kind!r}")
 

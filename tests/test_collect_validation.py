@@ -32,12 +32,12 @@ from janus_tpu.task import QueryTypeConfig, TaskBuilder
 from janus_tpu.vdaf.registry import VdafInstance
 
 
-def _mk(query_type, **kw):
+def _mk(query_type, vdaf=None, **kw):
     clock = MockClock(Time(1_600_000_000))
     eph = EphemeralDatastore(clock=clock)
     collector_kp = generate_hpke_config_and_private_key(config_id=7)
     task = (
-        TaskBuilder(query_type, VdafInstance.count(), Role.LEADER)
+        TaskBuilder(query_type, vdaf or VdafInstance.count(), Role.LEADER)
         .with_(
             collector_hpke_config=collector_kp.config,
             aggregator_auth_token=AuthenticationToken.random_bearer(),
@@ -99,7 +99,11 @@ def test_time_interval_same_interval_new_agg_param_counts_not_overlaps():
     """Re-collecting the SAME interval under a different aggregation
     parameter is a distinct collection governed by query count, not
     batch overlap (an interval trivially 'overlaps' itself)."""
-    eph, agg, ta, task = _mk(QueryTypeConfig.time_interval(), max_batch_query_count=2)
+    eph, agg, ta, task = _mk(
+        QueryTypeConfig.time_interval(),
+        max_batch_query_count=2,
+        vdaf=VdafInstance.fake(),  # accepts nonempty parameters
+    )
     tp = task.time_precision.seconds
     base = 1_600_000_000 - (1_600_000_000 % tp)
     q = Query.time_interval(Interval(Time(base), Duration(tp)))
@@ -119,8 +123,13 @@ def test_time_interval_same_interval_new_agg_param_counts_not_overlaps():
 
 
 def test_fixed_size_query_count_enforced_on_leader():
+    # the fake VDAF accepts arbitrary aggregation parameters (the
+    # reference's dummy_vdaf, used for exactly these query-count
+    # tests); real Prio3 rejects nonempty parameters at creation
     eph, agg, ta, task = _mk(
-        QueryTypeConfig.fixed_size(max_batch_size=8), max_batch_query_count=2
+        QueryTypeConfig.fixed_size(max_batch_size=8),
+        max_batch_query_count=2,
+        vdaf=VdafInstance.fake(),
     )
     bid = BatchId(bytes([9]) * 32)
     # distinct aggregation parameters are distinct queries over the same

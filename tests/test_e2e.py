@@ -58,7 +58,7 @@ def pair():
     helper_eph.cleanup()
 
 
-def provision(pair, vdaf):
+def provision(pair, vdaf, max_batch_query_count: int = 1):
     collector_kp = generate_hpke_config_and_private_key(config_id=200)
     agg_token = AuthenticationToken.random_bearer()
     col_token = AuthenticationToken.random_bearer()
@@ -71,6 +71,7 @@ def provision(pair, vdaf):
             aggregator_auth_token=agg_token,
             collector_auth_token=col_token,
             min_batch_size=1,
+            max_batch_query_count=max_batch_query_count,
         )
         .build()
     )

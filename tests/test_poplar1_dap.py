@@ -1,0 +1,208 @@
+"""Poplar1 end-to-end through the live DAP pair — heavy hitters with
+NONTRIVIAL aggregation parameters, the piece the reference declares
+but punts on (README.md:9-11; VERDICT r2 Next #6).
+
+Flow per level: the collector starts a collection with
+agg_param=(level, prefixes); the collection driver creates
+param-scoped aggregation jobs; the aggregation driver runs the
+two-round sketch exchange (init -> WaitingHelper/WaitingLeader ->
+continue) over live HTTP; the collection driver then computes the
+aggregate share for that parameter and the collector unshards
+per-prefix counts."""
+
+import pytest
+
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.collector import Collector, CollectorParameters
+from janus_tpu.core.http_client import HttpClient
+from janus_tpu.datastore.models import ReportAggregationState
+from janus_tpu.messages import Duration, Interval, Query, Time
+from janus_tpu.vdaf.poplar1 import Poplar1AggParam
+from janus_tpu.vdaf.registry import VdafInstance
+
+from test_e2e import pair, provision  # noqa: F401  (fixture + helper)
+
+BITS = 4
+VDAF = VdafInstance.poplar1(bits=BITS)
+
+
+def _drive(pair, http, rounds=8):
+    """Run collection + aggregation drivers until quiescent."""
+    adriver = AggregationJobDriver(pair["leader_ds"], http)
+    ajd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1), adriver.acquirer(), adriver.stepper
+    )
+    cdriver = CollectionJobDriver(pair["leader_ds"], http)
+    cjd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1), cdriver.acquirer(), cdriver.stepper
+    )
+    for _ in range(rounds):
+        worked = cjd.run_once() + ajd.run_once()
+        if not worked:
+            break
+
+
+def test_poplar1_heavy_hitters_via_dap(pair):
+    leader_task, helper_task, collector_kp = provision(
+        pair, VDAF, max_batch_query_count=BITS + 1
+    )
+    http = HttpClient()
+    clock = pair["clock"]
+
+    # measurements: 0b1010 is heavy (3 uploads), 0b0110 appears twice,
+    # 0b0001 once
+    measurements = [0b1010, 0b1010, 0b1010, 0b0110, 0b0110, 0b0001]
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, VDAF, http, clock=clock)
+    for m in measurements:
+        client.upload(m)
+
+    start = clock.now().to_batch_interval_start(leader_task.time_precision)
+    query = Query.time_interval(Interval(Time(start.seconds - 3600), Duration(2 * 3600)))
+    collector = Collector(
+        CollectorParameters(
+            leader_task.task_id,
+            pair["leader_srv"].url,
+            leader_task.collector_auth_token,
+            collector_kp,
+        ),
+        VDAF,
+        http,
+    )
+
+    threshold = 2
+    prefixes = [0, 1]
+    heavy = None
+    for level in range(BITS):
+        agg_param = Poplar1AggParam(level, tuple(sorted(prefixes))).encode()
+        job_id = collector.start_collection(query, agg_param=agg_param)
+        _drive(pair, http)
+        result = collector.poll_once(job_id, query, agg_param=agg_param)
+        assert result.report_count == len(measurements)
+        counts = result.aggregate_result
+        # exact per-prefix expectation (reports whose path left the
+        # queried set — pruned at an earlier level — count nowhere)
+        expected = [
+            sum(1 for m in measurements if (m >> (BITS - 1 - level)) == p)
+            for p in sorted(prefixes)
+        ]
+        assert counts == expected, (level, sorted(prefixes), counts, expected)
+        survivors = [
+            p for p, c in zip(sorted(prefixes), counts) if c >= threshold
+        ]
+        if level == BITS - 1:
+            heavy = survivors
+            break
+        prefixes = [p << 1 for p in survivors] + [(p << 1) | 1 for p in survivors]
+
+    assert heavy == [0b0110, 0b1010], heavy
+
+    # both sides drove the real two-round machinery: every report
+    # aggregation row under every parameter is FINISHED on the helper
+    jobs = pair["helper_ds"].run_tx(
+        lambda tx: tx.get_aggregation_jobs_for_task(helper_task.task_id)
+    )
+    assert len(jobs) == BITS  # one per level
+    for job in jobs:
+        states = {
+            ra.state
+            for ra in pair["helper_ds"].run_tx(
+                lambda tx: tx.get_report_aggregations_for_job(
+                    helper_task.task_id, job.job_id
+                )
+            )
+        }
+        assert states == {ReportAggregationState.FINISHED}, (job.job_id, states)
+
+
+def test_poplar1_invalid_report_rejected(pair):
+    """A malformed (multi-path) IDPF key must fail the sketch and be
+    rejected by both aggregators, not silently counted."""
+    import dataclasses
+
+    from janus_tpu.vdaf.poplar1 import (
+        Poplar1,
+        encode_input_share,
+        encode_public_share,
+    )
+
+    leader_task, helper_task, collector_kp = provision(
+        pair, VDAF, max_batch_query_count=BITS + 1
+    )
+    http = HttpClient()
+    clock = pair["clock"]
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, VDAF, http, clock=clock)
+
+    # one honest report
+    client.upload(0b1100)
+
+    # one corrupt report: swap in a mismatched helper key share (from a
+    # DIFFERENT sharding), so the two parties' evaluations do not form
+    # a one-hot path and the sketch cannot verify
+    poplar = Poplar1(BITS)
+    cws_a, (k0_a, _) = poplar.shard(0b1100)
+    _, (_, k1_b) = poplar.shard(0b0011)
+    orig = Client.prepare_report
+
+    def corrupt(self, measurement, when=None):
+        report = orig(self, measurement, when=when)
+        from janus_tpu.core.hpke import HpkeApplicationInfo, Label, hpke_seal
+        from janus_tpu.messages import InputShareAad, PlaintextInputShare, Role
+
+        public = encode_public_share(BITS, cws_a)
+        aad = InputShareAad(self.params.task_id, report.metadata, public).to_bytes()
+        leader_ct = hpke_seal(
+            self.leader_hpke_config,
+            HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+            PlaintextInputShare((), encode_input_share(k0_a)).to_bytes(),
+            aad,
+        )
+        helper_ct = hpke_seal(
+            self.helper_hpke_config,
+            HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
+            PlaintextInputShare((), encode_input_share(k1_b)).to_bytes(),
+            aad,
+        )
+        return dataclasses.replace(
+            report,
+            public_share=public,
+            leader_encrypted_input_share=leader_ct,
+            helper_encrypted_input_share=helper_ct,
+        )
+
+    client.prepare_report = corrupt.__get__(client)
+    client.upload(0)  # measurement ignored by the corrupt shard
+
+    start = clock.now().to_batch_interval_start(leader_task.time_precision)
+    query = Query.time_interval(Interval(Time(start.seconds - 3600), Duration(2 * 3600)))
+    collector = Collector(
+        CollectorParameters(
+            leader_task.task_id,
+            pair["leader_srv"].url,
+            leader_task.collector_auth_token,
+            collector_kp,
+        ),
+        VDAF,
+        http,
+    )
+    agg_param = Poplar1AggParam(0, (0, 1)).encode()
+    job_id = collector.start_collection(query, agg_param=agg_param)
+    _drive(pair, http)
+    result = collector.poll_once(job_id, query, agg_param=agg_param)
+    # only the honest report survives; 0b1100 has prefix 1 at level 0
+    assert result.report_count == 1
+    assert result.aggregate_result == [0, 1]
