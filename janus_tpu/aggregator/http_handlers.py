@@ -154,7 +154,7 @@ class DapHttpApp:
 
         from .. import metrics
 
-        from ..trace import span
+        from ..trace import adopt_traceparent, reset_traceparent, span
 
         route = "none"
         for m, rx, name in _ROUTES:
@@ -162,8 +162,17 @@ class DapHttpApp:
                 route = name
                 break
         start = monotonic()
-        with span(f"dap.{route}", method=method):
-            result = self._handle(method, path, query, headers, body)
+        # adopt the caller's trace (leader -> helper propagation): one
+        # trace then stitches upload -> init -> continue across both
+        # aggregators (reference trace.rs:44-90 OTLP layer analog)
+        tp_token = adopt_traceparent(
+            {k.lower(): v for k, v in headers.items()}.get("traceparent")
+        )
+        try:
+            with span(f"dap.{route}", method=method):
+                result = self._handle(method, path, query, headers, body)
+        finally:
+            reset_traceparent(tp_token)
         metrics.http_request_duration.observe(monotonic() - start, route=route)
         metrics.http_request_counter.add(route=route, status=str(result[0]))
         return result

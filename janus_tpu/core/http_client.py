@@ -25,7 +25,14 @@ class HttpClient:
         headers: dict | None = None,
         timeout: float | None = None,
     ):
-        req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
+        headers = dict(headers or {})
+        if not any(k.lower() == "traceparent" for k in headers):
+            from ..trace import current_traceparent
+
+            tp = current_traceparent()
+            if tp is not None:
+                headers["traceparent"] = tp
+        req = urllib.request.Request(url, data=body, method=method, headers=headers)
         try:
             with urllib.request.urlopen(
                 req, timeout=self.timeout if timeout is None else min(self.timeout, timeout)

@@ -104,20 +104,80 @@ def install_chrome_trace(path: str) -> None:
     atexit.register(_chrome_writer.close)
 
 
+# ---------------------------------------------------------------------------
+# W3C traceparent propagation (the OTLP-shaped analog of the reference's
+# OpenTelemetry layer, trace.rs:44-90): every span carries
+# (trace_id, span_id, parent_span_id); the HTTP client attaches the
+# current context as a `traceparent` header and the DAP server adopts an
+# incoming one, so one trace stitches upload -> init -> continue across
+# leader and helper processes.
+# ---------------------------------------------------------------------------
+
+import contextvars
+
+
+# (trace_id_hex32, span_id_hex16) of the active span, per task/thread
+_trace_ctx: contextvars.ContextVar[tuple[str, str] | None] = contextvars.ContextVar(
+    "janus_trace_ctx", default=None
+)
+
+
+def current_traceparent() -> str | None:
+    """W3C traceparent header for the active span, or None."""
+    ctx = _trace_ctx.get()
+    if ctx is None:
+        return None
+    return f"00-{ctx[0]}-{ctx[1]}-01"
+
+
+def adopt_traceparent(header: str | None):
+    """Enter the trace context of an incoming request (or clear it if
+    the header is absent/malformed — the handler's span then starts a
+    fresh trace as a true root, with no phantom parent). Returns a
+    token for contextvars reset."""
+    if header:
+        parts = header.split("-")
+        if len(parts) == 4 and len(parts[1]) == 32 and len(parts[2]) == 16:
+            return _trace_ctx.set((parts[1], parts[2]))
+    return _trace_ctx.set(None)
+
+
+def reset_traceparent(token) -> None:
+    _trace_ctx.reset(token)
+
+
 @contextmanager
 def span(name: str, **args):
-    """Record a host-side span (no-op unless a Chrome trace file is
-    installed — the `if enabled` cost is one global read)."""
+    """Record a host-side span (event emission is a no-op unless a
+    Chrome trace file is installed; the trace-context bookkeeping for
+    traceparent propagation always runs — contextvar ops plus a PRNG
+    draw; ids need uniqueness, not unpredictability, so this is
+    random.getrandbits, not a urandom syscall)."""
+    import random as _random
+
+    parent = _trace_ctx.get()
+    trace_id = parent[0] if parent else f"{_random.getrandbits(128):032x}"
+    span_id = f"{_random.getrandbits(64):016x}"
+    token = _trace_ctx.set((trace_id, span_id))
     w = _chrome_writer
-    if w is None:
-        yield
-        return
     t0 = time.perf_counter_ns()
     try:
         yield
     finally:
         t1 = time.perf_counter_ns()
-        w.event(name, t0 / 1000.0, (t1 - t0) / 1000.0, args)
+        _trace_ctx.reset(token)
+        if w is not None:
+            w.event(
+                name,
+                t0 / 1000.0,
+                (t1 - t0) / 1000.0,
+                {
+                    **args,
+                    "trace_id": trace_id,
+                    "span_id": span_id,
+                    **({"parent_span_id": parent[1]} if parent else {}),
+                },
+            )
 
 
 class JsonFormatter(logging.Formatter):

@@ -41,11 +41,106 @@ def test_spans_stream_chrome_events(tmp_path):
     by_name = {e["name"]: e for e in events}
     assert set(by_name) == {"outer", "inner"}
     assert by_name["outer"]["ph"] == "X"
-    assert by_name["outer"]["args"] == {"kind": "test"}
-    assert by_name["inner"]["args"] == {"n": 3}
-    # inner nests inside outer on the timeline
+    assert by_name["outer"]["args"]["kind"] == "test"
+    assert by_name["inner"]["args"]["n"] == 3
+    # inner nests inside outer on the timeline AND in the trace tree
     o, i = by_name["outer"], by_name["inner"]
     assert o["ts"] <= i["ts"] and i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 50
+    assert i["args"]["trace_id"] == o["args"]["trace_id"]
+    assert i["args"]["parent_span_id"] == o["args"]["span_id"]
+
+
+def test_traceparent_stitches_leader_and_helper(tmp_path):
+    """One trace follows a job step across the leader driver and the
+    helper's HTTP handler via the traceparent header (reference
+    trace.rs:44-90 OTLP propagation analog): the helper's
+    dap.aggregate_init span carries the SAME trace id as the leader's
+    job.step span, parented under driver.http_init."""
+    import dataclasses
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    out = tmp_path / "stitch.json"
+    install_chrome_trace(str(out))
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    leader_srv = DapServer(DapHttpApp(Aggregator(leader_eph.datastore, clock, Config()))).start()
+    helper_srv = DapServer(DapHttpApp(Aggregator(helper_eph.datastore, clock, Config()))).start()
+    try:
+        vdaf = VdafInstance.fake()
+        collector_kp = generate_hpke_config_and_private_key(config_id=200)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+        )
+        leader_eph.datastore.run_tx(lambda tx: tx.put_task(leader_task))
+        helper_eph.datastore.run_tx(lambda tx: tx.put_task(helper_task))
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        client.upload(1)
+        creator = AggregationJobCreator(
+            leader_eph.datastore, AggregationJobCreatorConfig(min_aggregation_job_size=1)
+        )
+        assert creator.run_once() == 1
+        driver = AggregationJobDriver(leader_eph.datastore, http)
+        jd = JobDriver(
+            JobDriverConfig(max_concurrent_job_workers=1),
+            driver.acquirer(),
+            driver.stepper,
+        )
+        assert jd.run_once() == 1
+    finally:
+        leader_srv.stop()
+        helper_srv.stop()
+        trace_mod._chrome_writer.close()
+        trace_mod._chrome_writer = None
+        leader_eph.cleanup()
+        helper_eph.cleanup()
+
+    events = _read_events(_trace_file(out))
+    job_steps = [e for e in events if e["name"] == "job.step"]
+    http_inits = [e for e in events if e["name"] == "driver.http_init"]
+    helper_inits = [e for e in events if e["name"] == "dap.aggregate_init"]
+    assert job_steps and http_inits and helper_inits
+    trace_id = job_steps[0]["args"]["trace_id"]
+    assert http_inits[0]["args"]["trace_id"] == trace_id
+    assert helper_inits[0]["args"]["trace_id"] == trace_id
+    # the helper's handler span is parented under the leader's HTTP span
+    assert helper_inits[0]["args"]["parent_span_id"] == http_inits[0]["args"]["span_id"]
 
 
 def test_span_is_noop_without_writer():
