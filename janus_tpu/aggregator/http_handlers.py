@@ -166,7 +166,7 @@ class DapHttpApp:
         # trace then stitches upload -> init -> continue across both
         # aggregators (reference trace.rs:44-90 OTLP layer analog)
         tp_token = adopt_traceparent(
-            {k.lower(): v for k, v in headers.items()}.get("traceparent")
+            next((v for k, v in headers.items() if k.lower() == "traceparent"), None)
         )
         try:
             with span(f"dap.{route}", method=method):

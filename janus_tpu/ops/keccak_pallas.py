@@ -15,9 +15,10 @@ Layout: callers hold the state as 25 u64 arrays of identical shape S
 half, with prod(S) flattened and zero-padded to R*128 columns — tiled
 over a grid on R. Zero columns permute to garbage and are sliced away.
 
-Enabled on TPU backends by default (JANUS_PALLAS=0 disables, =1 forces
-— the interpreter makes it work on CPU for differential tests);
-everything else falls back to the scan path. The flag and backend are
+Enabled on single-device TPU processes by default (JANUS_PALLAS=0
+disables; =1 forces interpret mode on CPU for differential tests;
+multi-device TPU is always off — see _mode — and JANUS_PALLAS=1 does
+NOT override that); everything else falls back to the scan path. The flag and backend are
 read once at the first XOF call and cached (jitted graphs embed the
 dispatch decision, so mid-process toggles could not take effect
 anyway); tests that need a different mode patch `_mode` directly.
@@ -62,9 +63,11 @@ def _rot64(a, r: int):
     return ((lo << s) | (hi >> t), (hi << s) | (lo >> t))
 
 
-def _kernel(x_ref, o_ref):
-    x = x_ref[:]  # [50, TR, 128] u32
-    a = [(x[2 * i], x[2 * i + 1]) for i in range(25)]
+def permute_pairs(a):
+    """All 24 Keccak-f[1600] rounds on a 25-list of (lo32, hi32) pairs.
+
+    Shared between the plain-permutation kernel below and the fused
+    expansion kernel (janus_tpu.ops.expand_pallas)."""
     for rnd in range(24):
         # theta
         c = [
@@ -96,17 +99,29 @@ def _kernel(x_ref, o_ref):
             a[0][0] ^ np.uint32(rc & 0xFFFFFFFF),
             a[0][1] ^ np.uint32(rc >> 32),
         )
+    return a
+
+
+def _kernel(x_ref, o_ref):
+    x = x_ref[:]  # [50, TR, 128] u32
+    a = permute_pairs([(x[2 * i], x[2 * i + 1]) for i in range(25)])
     o_ref[:] = jnp.stack([h for pair in a for h in pair], axis=0)
 
 
 @lru_cache(maxsize=1)
 def _mode() -> str:
-    """'tpu' (real kernel), 'interpret' (forced on non-TPU), or 'off'."""
+    """'tpu' (real kernel), 'interpret' (forced on non-TPU), or 'off'.
+
+    Multi-device TPU processes run with kernels off: engine_cache binds
+    jitted steps to a dp mesh there, and pallas_call has no SPMD
+    partitioning rule — sharding it needs shard_map plumbing around
+    every call site (future work; single-chip is where the benchmarks
+    run today)."""
     flag = os.environ.get("JANUS_PALLAS")
     if flag == "0":
         return "off"
     if jax.default_backend() == "tpu":
-        return "tpu"
+        return "tpu" if len(jax.devices()) == 1 else "off"
     return "interpret" if flag == "1" else "off"
 
 

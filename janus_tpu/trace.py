@@ -116,10 +116,18 @@ def install_chrome_trace(path: str) -> None:
 import contextvars
 
 
-# (trace_id_hex32, span_id_hex16) of the active span, per task/thread
-_trace_ctx: contextvars.ContextVar[tuple[str, str] | None] = contextvars.ContextVar(
-    "janus_trace_ctx", default=None
+# (trace_id, span_id) of the active span, per task/thread: ints for
+# locally-generated ids (hex-formatted lazily by _hex), hex strings
+# when adopted from an incoming traceparent header
+_trace_ctx: contextvars.ContextVar[tuple[int | str, int | str] | None] = (
+    contextvars.ContextVar("janus_trace_ctx", default=None)
 )
+
+
+def _hex(v, width: int) -> str:
+    # ids live in the contextvar as ints (locally generated, formatted
+    # lazily) or as hex strings (adopted from an incoming header)
+    return v if isinstance(v, str) else f"{v:0{width}x}"
 
 
 def current_traceparent() -> str | None:
@@ -127,17 +135,29 @@ def current_traceparent() -> str | None:
     ctx = _trace_ctx.get()
     if ctx is None:
         return None
-    return f"00-{ctx[0]}-{ctx[1]}-01"
+    return f"00-{_hex(ctx[0], 32)}-{_hex(ctx[1], 16)}-01"
+
+
+_HEX_DIGITS = frozenset("0123456789abcdef")
 
 
 def adopt_traceparent(header: str | None):
     """Enter the trace context of an incoming request (or clear it if
     the header is absent/malformed — the handler's span then starts a
     fresh trace as a true root, with no phantom parent). Returns a
-    token for contextvars reset."""
+    token for contextvars reset. Per W3C trace-context, ids must be
+    lowercase hex and non-zero; anything else is treated as absent."""
     if header:
         parts = header.split("-")
-        if len(parts) == 4 and len(parts[1]) == 32 and len(parts[2]) == 16:
+        if (
+            len(parts) == 4
+            and len(parts[1]) == 32
+            and len(parts[2]) == 16
+            and set(parts[1]) <= _HEX_DIGITS
+            and set(parts[2]) <= _HEX_DIGITS
+            and set(parts[1]) != {"0"}
+            and set(parts[2]) != {"0"}
+        ):
             return _trace_ctx.set((parts[1], parts[2]))
     return _trace_ctx.set(None)
 
@@ -151,13 +171,15 @@ def span(name: str, **args):
     """Record a host-side span (event emission is a no-op unless a
     Chrome trace file is installed; the trace-context bookkeeping for
     traceparent propagation always runs — contextvar ops plus a PRNG
-    draw; ids need uniqueness, not unpredictability, so this is
-    random.getrandbits, not a urandom syscall)."""
+    draw, with hex formatting deferred to emission/header time so the
+    untraced hot path stays near-free; ids need uniqueness, not
+    unpredictability, so this is random.getrandbits, not a urandom
+    syscall)."""
     import random as _random
 
     parent = _trace_ctx.get()
-    trace_id = parent[0] if parent else f"{_random.getrandbits(128):032x}"
-    span_id = f"{_random.getrandbits(64):016x}"
+    trace_id = parent[0] if parent else _random.getrandbits(128)
+    span_id = _random.getrandbits(64)
     token = _trace_ctx.set((trace_id, span_id))
     w = _chrome_writer
     t0 = time.perf_counter_ns()
@@ -173,9 +195,9 @@ def span(name: str, **args):
                 (t1 - t0) / 1000.0,
                 {
                     **args,
-                    "trace_id": trace_id,
-                    "span_id": span_id,
-                    **({"parent_span_id": parent[1]} if parent else {}),
+                    "trace_id": _hex(trace_id, 32),
+                    "span_id": _hex(span_id, 16),
+                    **({"parent_span_id": _hex(parent[1], 16)} if parent else {}),
                 },
             )
 

@@ -363,8 +363,17 @@ def expand_field_vec(jf, prefix_parts, prefix_len_bytes: int, batch: int, length
 
     prefix_parts lay out dst16 || seed || binder' (counter-mode framing,
     janus_tpu.vdaf.xof); the binder must already be inline-size.
+
+    Long Field128 expansions dispatch to the fused Pallas kernel
+    (janus_tpu.ops.expand_pallas): permutation + mod-p sampling in
+    VMEM, so the raw stream (24 bytes/element) never reaches HBM.
     """
-    out = ctr_stream_lanes(
-        prefix_parts, prefix_len_bytes, batch, sample_count_blocks(jf, length)
-    )
+    from ..ops import expand_pallas
+
+    assert prefix_len_bytes % 8 == 0  # lane-aligned framing (xof.py)
+    blocks = sample_count_blocks(jf, length)
+    if expand_pallas.enabled(jf, blocks):
+        prefix = _assemble_segments(prefix_parts, prefix_len_bytes // 8, batch)
+        return expand_pallas.expand_f128(prefix, blocks, length)
+    out = ctr_stream_lanes(prefix_parts, prefix_len_bytes, batch, blocks)
     return sample_field_vec(jf, out, length)

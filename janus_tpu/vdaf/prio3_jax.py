@@ -33,8 +33,7 @@ from .engine import (
 )
 from .keccak_jax import (
     ctr_stream_lanes,
-    sample_count_blocks,
-    sample_field_vec,
+    expand_field_vec,
     tree_digest_lanes,
 )
 from .reference import AGG1, Circuit
@@ -142,10 +141,7 @@ class Prio3Batched:
         parts, prefix_len = self._prefix_parts(
             usage, seed_lanes, binder_parts, binder_len, batch
         )
-        out = ctr_stream_lanes(
-            parts, prefix_len, batch, sample_count_blocks(self.jf, length)
-        )
-        return sample_field_vec(self.jf, out, length)
+        return expand_field_vec(self.jf, parts, prefix_len, batch, length)
 
     def _derive_seed(self, usage: int, seed_lanes, binder_parts, binder_len: int):
         """[batch, 2] output seed lanes."""
@@ -203,10 +199,9 @@ class Prio3Batched:
             (DST_LANES + SEED_LANES, nonce_lanes),
         ]
         prefix_len = DST_SIZE + SEED_SIZE + SEED_SIZE
-        out = ctr_stream_lanes(
-            parts, prefix_len, batch, sample_count_blocks(self.jf, self.circ.query_rand_len)
+        return expand_field_vec(
+            self.jf, parts, prefix_len, batch, self.circ.query_rand_len
         )
-        return sample_field_vec(self.jf, out, self.circ.query_rand_len)
 
     @property
     def uses_joint_rand(self) -> bool:
