@@ -238,6 +238,14 @@ def main() -> None:
     )
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument(
+        "--xof-mode",
+        default="fast",
+        choices=["fast", "draft"],
+        help="fast = the TPU counter-mode framing (BASELINE.md); draft "
+        "= the VDAF-07 spec framing (sequential sponge + rejection "
+        "sampling, device engine via vdaf.draft_jax)",
+    )
+    ap.add_argument(
         "--mode",
         default="device",
         choices=["device", "served"],
@@ -345,6 +353,10 @@ def main() -> None:
         "histogram": VdafInstance.histogram(length=L or 10000),
         "fixedpoint": VdafInstance.fixed_point_vec(length=L or 1000, bits=16),
     }[args.config]
+    if args.xof_mode != "fast":
+        import dataclasses
+
+        inst = dataclasses.replace(inst, xof_mode=args.xof_mode)
     batch = args.batch or (
         {"count": 8192, "sum": 4096, "sumvec": 1024, "histogram": 512, "fixedpoint": 512}[args.config]
         if on_accel

@@ -396,9 +396,10 @@ class HostEngineCache:
 @lru_cache(maxsize=256)
 def engine_cache(inst: VdafInstance, verify_key: bytes):
     if inst.xof_mode != "fast":
-        # draft (VDAF-07) framing: device engine for short-stream
-        # circuits (Count/Sum/small vectors, vdaf.draft_jax), host
-        # scalar loop only for long-stream draft tasks
+        # draft (VDAF-07) framing: device engine for every circuit
+        # whose sponge streams fit the latency cap (vdaf.draft_jax;
+        # covers SumVec up to ~len=25k since the window-select
+        # rejection sampler), host scalar loop only beyond that
         try:
             prio3_batched(inst)
         except ValueError:

@@ -111,19 +111,32 @@ def _sponge_stream(segments, msg_len_bytes: int, batch: int, out_blocks: int):
     return out.reshape(batch, -1)
 
 
+# Max rejected candidates absorbed per expansion before the output
+# tail degrades to zero (and the report FLP-rejects, explicitly).
+# P(> 8 rejects) even for Field64 at 10M candidates is ~(10M * 2^-32)^9
+# / 9! ~ 2^-80; Field128's per-candidate reject prob is 2^-68.
+_REJECT_WINDOW = 8
+
+
 def _candidate_count(jf, length: int) -> int:
-    """Candidates sampled per vector: cushion makes exhaustion
-    cryptographically unreachable (Field64 reject prob ~2^-32/candidate,
-    Field128 ~2^-68)."""
-    return length + max(4, length // 8)
+    """Candidates sampled per vector: the window plus a little slack so
+    every shifted slice below stays in range."""
+    return length + 2 * _REJECT_WINDOW
 
 
 def _reject_sample(jf, stream_lanes, length: int):
     """Order-exact draft rejection sampling from contiguous
     ENCODED_SIZE-byte candidates. Returns a field value [batch, length];
-    if (improbably) fewer than `length` candidates are accepted, the
-    missing tail is zero — downstream FLP verification rejects such a
-    report, so exhaustion can never yield silent acceptance."""
+    if (improbably) more than _REJECT_WINDOW candidates are rejected,
+    the missing tail is zero — downstream FLP verification rejects such
+    a report, so exhaustion can never yield silent acceptance.
+
+    Compaction without gathers, O(window * length) instead of the dense
+    O(length^2) rank-select: element e is filled by candidate e+j
+    (j <= window) exactly when candidate e+j is accepted and exactly j
+    rejects precede it — rank(e+j) = (e+j) - rejects_before(e+j) = e.
+    Elementwise masks over shifted slices; works at any vector length
+    (the dense select capped device draft mode at short streams)."""
     C = _candidate_count(jf, length)
     L = jf.LIMBS
     cand = tuple(stream_lanes[:, i : C * L : L] for i in range(L))  # [batch, C] limbs
@@ -133,13 +146,16 @@ def _reject_sample(jf, stream_lanes, length: int):
         p_lo = U64(jf.MODULUS & 0xFFFFFFFFFFFFFFFF)
         p_hi = U64(jf.MODULUS >> 64)
         accept = (cand[1] < p_hi) | ((cand[1] == p_hi) & (cand[0] < p_lo))
-    rank = jnp.cumsum(accept.astype(jnp.int32), axis=1) - accept.astype(jnp.int32)
-    sel = (rank[:, None, :] == jnp.arange(length, dtype=jnp.int32)[None, :, None]) & accept[
-        :, None, :
-    ]  # [batch, length, C]
-    out = tuple(
-        jnp.sum(jnp.where(sel, c[:, None, :], U64(0)), axis=-1, dtype=U64) for c in cand
-    )
+    # rejects strictly before each candidate (exclusive prefix sum)
+    rej = (~accept).astype(jnp.int32)
+    rejects_before = jnp.cumsum(rej, axis=1) - rej
+    out = tuple(jnp.zeros((stream_lanes.shape[0], length), dtype=U64) for _ in range(L))
+    for j in range(_REJECT_WINDOW + 1):
+        sel = accept[:, j : j + length] & (rejects_before[:, j : j + length] == j)
+        out = tuple(
+            o | jnp.where(sel, c[:, j : j + length], U64(0))
+            for o, c in zip(out, cand)
+        )
     return out
 
 
@@ -158,9 +174,16 @@ class Prio3BatchedDraft(Prio3Batched):
     they stay on the host oracle.
     """
 
-    # max sponge output blocks per expansion; the absorb+squeeze chain
-    # is sequential, so this bounds device latency (~24 rounds/block)
-    MAX_STREAM_BLOCKS = 64
+    # max sponge blocks per expansion (absorb or squeeze side). The
+    # chain is sequential per report (~24 rounds/block of pure latency)
+    # but fully batched across reports, and the scan-based sponge keeps
+    # the traced graph O(1) in stream length — so the cap is about
+    # bounding worst-case step latency, not feasibility. 4096 blocks
+    # (~672 KB of stream) covers SumVec len=1000 bits=16 (~1.5k blocks
+    # each way) with room; the truly huge configs (len=100k: ~150k
+    # absorb blocks for the spec's full-share joint-rand binder) stay
+    # on the host oracle.
+    MAX_STREAM_BLOCKS = 4096
 
     @classmethod
     def supports_circuit(cls, circ) -> bool:
@@ -171,7 +194,9 @@ class Prio3BatchedDraft(Prio3Batched):
             circ.input_len, circ.proof_len, circ.prove_rand_len, circ.query_rand_len,
             circ.joint_rand_len,
         )
-        blocks = math.ceil((longest + max(4, longest // 8)) * jf_limbs / RATE_LANES)
+        blocks = math.ceil(
+            (longest + 2 * _REJECT_WINDOW) * jf_limbs / RATE_LANES
+        )
         # absorb side: the longest binder is the encoded measurement
         # share (joint-rand part)
         absorb_blocks = (PREFIX_BYTES + 1 + SEED_SIZE + circ.input_len * circ.FIELD.ENCODED_SIZE) // RATE + 1

@@ -8,8 +8,10 @@ import numpy as np
 import pytest
 
 from janus_tpu.vdaf.draft_jax import (
+    _REJECT_WINDOW,
     Prio3BatchedDraft,
     _assemble_bytes,
+    _candidate_count,
     _reject_sample,
     _sponge_stream,
     _stream_blocks_for,
@@ -90,6 +92,46 @@ class TestRejectionSampling:
                 hi = np.asarray(got[1])[i][:length]
                 have = [int(a) | (int(b) << 64) for a, b in zip(lo, hi)]
             assert have == want
+
+    def test_crafted_rejects_compact_in_order(self):
+        """Real rejects are ~2^-32 events, so craft a candidate stream
+        with rejects at known positions and check the window select
+        reproduces the draft's skip-and-continue semantics exactly."""
+        import jax.numpy as jnp
+
+        from janus_tpu.fields.jfield import JF64
+
+        length = 40
+        C = _candidate_count(JF64, length)
+        p = JF64.MODULUS
+        rng = np.random.default_rng(9)
+        cand = rng.integers(0, p, size=(3, C), dtype=np.uint64)
+        # report 0: no rejects; report 1: scattered rejects (within the
+        # window); report 2: window+1 rejects -> zero tail, never
+        # garbage
+        cand[1, [0, 7, 7 + 1, 25]] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        for k in range(_REJECT_WINDOW + 1):
+            cand[2, 2 * k] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        # lanes layout: candidates are contiguous 8-byte chunks
+        pad_lanes = -(-C // 21) * 21
+        stream = np.zeros((3, pad_lanes), dtype=np.uint64)
+        stream[:, :C] = cand
+        got = np.asarray(_reject_sample(JF64, jnp.asarray(stream), length)[0])
+
+        for r in range(3):
+            accepted = [int(c) for c in cand[r] if int(c) < p]
+            rejects = sum(1 for c in cand[r] if int(c) >= p)
+            want = accepted[:length]
+            if rejects > _REJECT_WINDOW:
+                # elements whose filling candidate sits beyond the
+                # window degrade to zero (explicit FLP-reject path)
+                have = [int(x) for x in got[r]]
+                assert have != want  # tail degraded...
+                assert all(
+                    h == w or h == 0 for h, w in zip(have, want)
+                )  # ...to zero, never to a wrong value
+            else:
+                assert [int(x) for x in got[r]] == want
 
 
 def _lane(v):
