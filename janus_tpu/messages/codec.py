@@ -132,6 +132,37 @@ class Decoder:
         return out
 
 
+# Ping-pong message framing (prio topology::ping_pong): u8 tag, then
+# 1 (initialize/finish) or 2 (continue) opaque-u32 fields. DAP embeds
+# these messages inline (self-delimiting, no outer length prefix) in
+# PrepareInit/PrepareContinue/PrepareStepResult. Single home for the
+# tag->field-count mapping; vdaf.wire imports these constants.
+PP_INITIALIZE = 0
+PP_CONTINUE = 1
+PP_FINISH = 2
+
+
+def decode_pingpong_frame(dec: Decoder) -> bytes:
+    """Consume one self-delimiting ping-pong message, return its raw bytes."""
+    start = dec._pos
+    tag = dec.u8()
+    if tag == PP_INITIALIZE or tag == PP_FINISH:
+        dec.opaque_u32()
+    elif tag == PP_CONTINUE:
+        dec.opaque_u32()
+        dec.opaque_u32()
+    else:
+        raise DecodeError(f"bad ping-pong message tag {tag}")
+    return dec._buf[start : dec._pos]
+
+
+def check_pingpong_frame(raw: bytes) -> None:
+    """Raise DecodeError unless raw is exactly one ping-pong message."""
+    dec = Decoder(raw)
+    decode_pingpong_frame(dec)
+    dec.finish()
+
+
 class Codec:
     """Mixin: encode to / decode from bytes via Encoder/Decoder methods."""
 

@@ -14,7 +14,14 @@ import hashlib
 import secrets
 from dataclasses import dataclass, field
 
-from .codec import Codec, DecodeError, Decoder, Encoder
+from .codec import (
+    Codec,
+    DecodeError,
+    Decoder,
+    Encoder,
+    check_pingpong_frame,
+    decode_pingpong_frame,
+)
 
 
 def _fixed(name, size, *, doc=""):
@@ -135,6 +142,12 @@ class Interval(Codec):
 
     start: Time
     duration: Duration
+
+    def __post_init__(self):
+        # Match the reference's Interval::new overflow check
+        # (messages/src/lib.rs:210): end must fit in u64.
+        if self.start.seconds + self.duration.seconds > 0xFFFFFFFFFFFFFFFF:
+            raise DecodeError("interval end overflows u64")
 
     def encode(self, enc: Encoder) -> None:
         self.start.encode(enc)
@@ -285,13 +298,15 @@ class HpkeConfig(Codec):
 
     @classmethod
     def decode(cls, dec: Decoder):
-        return cls(
-            HpkeConfigId.decode(dec),
-            HpkeKemId(dec.u16()),
-            HpkeKdfId(dec.u16()),
-            HpkeAeadId(dec.u16()),
-            dec.opaque_u16(),
-        )
+        cid = HpkeConfigId.decode(dec)
+        algs = []
+        for reg in (HpkeKemId, HpkeKdfId, HpkeAeadId):
+            v = dec.u16()
+            try:
+                algs.append(reg(v))
+            except ValueError:
+                raise DecodeError(f"unsupported {reg.__name__} {v:#x}")
+        return cls(cid, *algs, dec.opaque_u16())
 
 
 @dataclass(frozen=True)
@@ -628,18 +643,25 @@ class ReportShare(Codec):
 
 @dataclass(frozen=True)
 class PrepareInit(Codec):
-    """reference messages/src/lib.rs:2139."""
+    """reference messages/src/lib.rs:2139.
+
+    `message` is one self-delimiting ping-pong message, embedded inline
+    (no outer length prefix) per DAP-07.
+    """
 
     report_share: ReportShare
     message: bytes  # ping-pong initialize message (leader prep share)
 
+    def __post_init__(self):
+        check_pingpong_frame(self.message)
+
     def encode(self, enc: Encoder) -> None:
         self.report_share.encode(enc)
-        enc.opaque_u32(self.message)
+        enc.write(self.message)
 
     @classmethod
     def decode(cls, dec: Decoder):
-        return cls(ReportShare.decode(dec), dec.opaque_u32())
+        return cls(ReportShare.decode(dec), decode_pingpong_frame(dec))
 
 
 class PrepareError(enum.IntEnum):
@@ -679,6 +701,10 @@ class PrepareStepResult(Codec):
     message: bytes | None = None
     prepare_error: PrepareError | None = None
 
+    def __post_init__(self):
+        if self.kind == self.CONTINUE:
+            check_pingpong_frame(self.message)
+
     @classmethod
     def cont(cls, message: bytes) -> "PrepareStepResult":
         return cls(cls.CONTINUE, message=message)
@@ -694,7 +720,7 @@ class PrepareStepResult(Codec):
     def encode(self, enc: Encoder) -> None:
         enc.u8(self.kind)
         if self.kind == self.CONTINUE:
-            enc.opaque_u32(self.message)
+            enc.write(self.message)
         elif self.kind == self.REJECT:
             self.prepare_error.encode(enc)
 
@@ -702,7 +728,7 @@ class PrepareStepResult(Codec):
     def decode(cls, dec: Decoder):
         kind = dec.u8()
         if kind == cls.CONTINUE:
-            return cls(kind, message=dec.opaque_u32())
+            return cls(kind, message=decode_pingpong_frame(dec))
         if kind == cls.FINISHED:
             return cls(kind)
         if kind == cls.REJECT:
@@ -733,13 +759,16 @@ class PrepareContinue(Codec):
     report_id: ReportId
     message: bytes
 
+    def __post_init__(self):
+        check_pingpong_frame(self.message)
+
     def encode(self, enc: Encoder) -> None:
         self.report_id.encode(enc)
-        enc.opaque_u32(self.message)
+        enc.write(self.message)
 
     @classmethod
     def decode(cls, dec: Decoder):
-        return cls(ReportId.decode(dec), dec.opaque_u32())
+        return cls(ReportId.decode(dec), decode_pingpong_frame(dec))
 
 
 @dataclass(frozen=True)

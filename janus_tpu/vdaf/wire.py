@@ -27,15 +27,18 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..messages.codec import DecodeError, Decoder, Encoder
+from ..messages.codec import (
+    PP_CONTINUE,
+    PP_FINISH,
+    PP_INITIALIZE,
+    DecodeError,
+    Decoder,
+    Encoder,
+)
 from .prio3_jax import Prio3Batched
 from .reference import Circuit
 
 SEED_SIZE = 16
-
-PP_INITIALIZE = 0
-PP_CONTINUE = 1
-PP_FINISH = 2
 
 
 # ---------------------------------------------------------------------------

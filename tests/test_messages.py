@@ -90,16 +90,21 @@ def test_queries_and_selectors():
 
 
 def test_aggregation_job_messages():
+    from janus_tpu.vdaf.wire import PP_CONTINUE, PP_INITIALIZE, encode_pingpong
+
     meta = m.ReportMetadata(m.ReportId.random(), m.Time(1700000000))
     ct = m.HpkeCiphertext(m.HpkeConfigId(1), b"ek", b"pl")
     share = m.ReportShare(meta, b"pub", ct)
-    init = m.PrepareInit(share, b"ping-pong-msg")
+    init = m.PrepareInit(share, encode_pingpong(PP_INITIALIZE, None, b"prep-share"))
     req = m.AggregationJobInitializeReq(b"", m.PartialBatchSelector.time_interval(), (init, init))
     rt(req)
 
     resp = m.AggregationJobResp(
         (
-            m.PrepareResp(meta.report_id, m.PrepareStepResult.cont(b"msg")),
+            m.PrepareResp(
+                meta.report_id,
+                m.PrepareStepResult.cont(encode_pingpong(PP_CONTINUE, b"msg", b"share")),
+            ),
             m.PrepareResp(meta.report_id, m.PrepareStepResult.finished()),
             m.PrepareResp(
                 meta.report_id,
@@ -111,7 +116,7 @@ def test_aggregation_job_messages():
 
     cont = m.AggregationJobContinueReq(
         m.AggregationJobStep(1),
-        (m.PrepareContinue(meta.report_id, b"m"),),
+        (m.PrepareContinue(meta.report_id, encode_pingpong(PP_INITIALIZE, None, b"m")),),
     )
     rt(cont)
     assert m.AggregationJobStep(0).increment() == m.AggregationJobStep(1)

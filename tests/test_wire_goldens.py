@@ -1,0 +1,628 @@
+"""Byte-exact DAP wire-conformance goldens.
+
+Every hex string below is transcribed from the reference's own test
+vectors (reference messages/src/lib.rs:2905-5019 `roundtrip_encoding`
+corpus, and messages/src/taskprov.rs:470-833). These are protocol
+test vectors, not code: they pin our encodings byte-equal to what the
+reference (and hence any interoperating DAP-07 implementation) puts on
+the wire. VERDICT r3 item #3.
+
+Each case asserts encode(value) == bytes.fromhex(golden) AND
+decode(golden) == value (full roundtrip, like the reference's
+`roundtrip_encoding` helper).
+"""
+
+import pytest
+
+from janus_tpu import messages as m
+from janus_tpu.messages import taskprov as tp
+from janus_tpu.messages.codec import DecodeError
+from janus_tpu.vdaf.wire import PP_CONTINUE, PP_FINISH, PP_INITIALIZE, encode_pingpong
+
+
+def golden(value, hex_encoding, cls=None):
+    raw = value.to_bytes()
+    assert raw == bytes.fromhex(hex_encoding), (
+        f"encoding differs for {value!r}:\n got {raw.hex()}\nwant {hex_encoding.lower()}"
+    )
+    back = (cls or type(value)).from_bytes(raw)
+    assert back == value, f"decode roundtrip differs for {value!r}"
+
+
+# --- primitives (lib.rs roundtrip_duration/_time/_interval) ---------------
+
+
+def test_duration():
+    golden(m.Duration(0), "0000000000000000")
+    golden(m.Duration(12345), "0000000000003039")
+    golden(m.Duration(2**64 - 1), "FFFFFFFFFFFFFFFF")
+
+
+def test_time():
+    golden(m.Time(0), "0000000000000000")
+    golden(m.Time(12345), "0000000000003039")
+    golden(m.Time(2**64 - 1), "FFFFFFFFFFFFFFFF")
+
+
+def test_interval():
+    golden(m.Interval(m.Time(0), m.Duration(2**64 - 1)), "0000000000000000" "FFFFFFFFFFFFFFFF")
+    golden(m.Interval(m.Time(54321), m.Duration(12345)), "000000000000D431" "0000000000003039")
+    golden(m.Interval(m.Time(2**64 - 1), m.Duration(0)), "FFFFFFFFFFFFFFFF" "0000000000000000")
+    # end overflowing u64 must be rejected on decode (lib.rs Interval::new)
+    with pytest.raises(DecodeError):
+        m.Interval.from_bytes(bytes.fromhex("0000000000000001" "FFFFFFFFFFFFFFFF"))
+
+
+def test_batch_id():
+    golden(m.BatchId(bytes(32)), "00" * 32)
+    golden(
+        m.BatchId(bytes(range(32))),
+        "000102030405060708090A0B0C0D0E0F101112131415161718191A1B1C1D1E1F",
+    )
+    golden(m.BatchId(b"\xff" * 32), "FF" * 32)
+
+
+def test_task_id():
+    golden(m.TaskId(bytes(32)), "00" * 32)
+    golden(
+        m.TaskId(bytes(range(32))),
+        "000102030405060708090A0B0C0D0E0F101112131415161718191A1B1C1D1E1F",
+    )
+    golden(m.TaskId(b"\xff" * 32), "FF" * 32)
+
+
+def test_report_id():
+    golden(m.ReportId(bytes(range(1, 17))), "0102030405060708090a0b0c0d0e0f10")
+    golden(m.ReportId(bytes(range(16, 0, -1))), "100f0e0d0c0b0a090807060504030201")
+
+
+def test_role():
+    golden(m.Role.COLLECTOR, "00")
+    golden(m.Role.CLIENT, "01")
+    golden(m.Role.LEADER, "02")
+    golden(m.Role.HELPER, "03")
+
+
+def test_hpke_config_id():
+    golden(m.HpkeConfigId(0), "00")
+    golden(m.HpkeConfigId(10), "0A")
+    golden(m.HpkeConfigId(255), "FF")
+
+
+def test_hpke_algorithm_ids():
+    assert m.HpkeKemId.P256_HKDF_SHA256.to_bytes(2, "big") == bytes.fromhex("0010")
+    assert m.HpkeKemId.X25519_HKDF_SHA256.to_bytes(2, "big") == bytes.fromhex("0020")
+    assert m.HpkeKdfId.HKDF_SHA256.to_bytes(2, "big") == bytes.fromhex("0001")
+    assert m.HpkeKdfId.HKDF_SHA384.to_bytes(2, "big") == bytes.fromhex("0002")
+    assert m.HpkeKdfId.HKDF_SHA512.to_bytes(2, "big") == bytes.fromhex("0003")
+    assert m.HpkeAeadId.AES_128_GCM.to_bytes(2, "big") == bytes.fromhex("0001")
+    assert m.HpkeAeadId.AES_256_GCM.to_bytes(2, "big") == bytes.fromhex("0002")
+    assert m.HpkeAeadId.CHACHA20POLY1305.to_bytes(2, "big") == bytes.fromhex("0003")
+
+
+def test_extension():
+    golden(m.Extension(m.ExtensionType.TBD, b""), "0000" "0000")
+    golden(m.Extension(m.ExtensionType.TBD, b"0123"), "0000" "0004" "30313233")
+
+
+def test_hpke_ciphertext():
+    golden(
+        m.HpkeCiphertext(m.HpkeConfigId(10), b"0123", b"4567"),
+        "0A" "0004" "30313233" "00000004" "34353637",
+    )
+    golden(
+        m.HpkeCiphertext(m.HpkeConfigId(12), b"01234", b"567"),
+        "0C" "0005" "3031323334" "00000003" "353637",
+    )
+
+
+def test_hpke_config():
+    golden(
+        m.HpkeConfig(
+            m.HpkeConfigId(12),
+            m.HpkeKemId.P256_HKDF_SHA256,
+            m.HpkeKdfId.HKDF_SHA512,
+            m.HpkeAeadId.AES_256_GCM,
+            b"",
+        ),
+        "0C" "0010" "0003" "0002" "0000",
+    )
+    golden(
+        m.HpkeConfig(
+            m.HpkeConfigId(23),
+            m.HpkeKemId.X25519_HKDF_SHA256,
+            m.HpkeKdfId.HKDF_SHA256,
+            m.HpkeAeadId.CHACHA20POLY1305,
+            b"0123456789abcdef",
+        ),
+        "17" "0020" "0001" "0003" "0010" "30313233343536373839616263646566",
+    )
+
+
+def test_decode_unknown_hpke_algorithms():
+    # lib.rs decode_unknown_hpke_algorithms: unknown kem/kdf/aead ids reject
+    for hexstr in (
+        "0C" "9999" "0003" "0002" "0000",
+        "0C" "0010" "9999" "0002" "0000",
+        "0C" "0010" "0003" "9999" "0000",
+    ):
+        with pytest.raises(DecodeError):
+            m.HpkeConfig.from_bytes(bytes.fromhex(hexstr))
+
+
+# --- report structs -------------------------------------------------------
+
+
+def test_report_metadata():
+    golden(
+        m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(12345)),
+        "0102030405060708090A0B0C0D0E0F10" "0000000000003039",
+    )
+    golden(
+        m.ReportMetadata(m.ReportId(bytes(range(16, 0, -1))), m.Time(54321)),
+        "100F0E0D0C0B0A090807060504030201" "000000000000D431",
+    )
+
+
+def test_plaintext_input_share():
+    golden(
+        m.PlaintextInputShare((), b"0123"),
+        "0000" "00000004" "30313233",
+    )
+    golden(
+        m.PlaintextInputShare((m.Extension(m.ExtensionType.TBD, b"0123"),), b"4567"),
+        "0008" "0000" "0004" "30313233" "00000004" "34353637",
+    )
+
+
+LEADER_CT = m.HpkeCiphertext(m.HpkeConfigId(42), b"012345", b"543210")
+HELPER_CT = m.HpkeCiphertext(m.HpkeConfigId(13), b"abce", b"abfd")
+LEADER_CT_HEX = "2A" "0006" "303132333435" "00000006" "353433323130"
+HELPER_CT_HEX = "0D" "0004" "61626365" "00000004" "61626664"
+
+
+def test_report():
+    golden(
+        m.Report(
+            m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(12345)),
+            b"",
+            LEADER_CT,
+            HELPER_CT,
+        ),
+        "0102030405060708090A0B0C0D0E0F10" "0000000000003039"
+        "00000000" + LEADER_CT_HEX + HELPER_CT_HEX,
+    )
+    golden(
+        m.Report(
+            m.ReportMetadata(m.ReportId(bytes(range(16, 0, -1))), m.Time(54321)),
+            b"3210",
+            LEADER_CT,
+            HELPER_CT,
+        ),
+        "100F0E0D0C0B0A090807060504030201" "000000000000D431"
+        "00000004" "33323130" + LEADER_CT_HEX + HELPER_CT_HEX,
+    )
+
+
+# --- queries and selectors ------------------------------------------------
+
+
+def test_fixed_size_query():
+    golden(
+        m.FixedSizeQuery(m.FixedSizeQuery.BY_BATCH_ID, m.BatchId(b"\x0a" * 32)),
+        "00" + "0A" * 32,
+    )
+    golden(m.FixedSizeQuery(m.FixedSizeQuery.CURRENT_BATCH), "01")
+
+
+def test_query():
+    golden(
+        m.Query.time_interval(m.Interval(m.Time(54321), m.Duration(12345))),
+        "01" "000000000000D431" "0000000000003039",
+    )
+    golden(
+        m.Query.time_interval(m.Interval(m.Time(48913), m.Duration(44721))),
+        "01" "000000000000BF11" "000000000000AEB1",
+    )
+    golden(
+        m.Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.BY_BATCH_ID, m.BatchId(b"\x0a" * 32))),
+        "02" "00" + "0A" * 32,
+    )
+    golden(m.Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.CURRENT_BATCH)), "02" "01")
+
+
+def test_collection_req():
+    golden(
+        m.CollectionReq(m.Query.time_interval(m.Interval(m.Time(54321), m.Duration(12345))), b""),
+        "01" "000000000000D431" "0000000000003039" "00000000",
+    )
+    golden(
+        m.CollectionReq(
+            m.Query.time_interval(m.Interval(m.Time(48913), m.Duration(44721))), b"012345"
+        ),
+        "01" "000000000000BF11" "000000000000AEB1" "00000006" "303132333435",
+    )
+    golden(
+        m.CollectionReq(
+            m.Query.fixed_size(
+                m.FixedSizeQuery(m.FixedSizeQuery.BY_BATCH_ID, m.BatchId(b"\x0a" * 32))
+            ),
+            b"",
+        ),
+        "02" "00" + "0A" * 32 + "00000000",
+    )
+    golden(
+        m.CollectionReq(m.Query.fixed_size(m.FixedSizeQuery(m.FixedSizeQuery.CURRENT_BATCH)), b"012345"),
+        "02" "01" "00000006" "303132333435",
+    )
+
+
+def test_partial_batch_selector():
+    golden(m.PartialBatchSelector.time_interval(), "01")
+    golden(m.PartialBatchSelector.fixed_size(m.BatchId(b"\x03" * 32)), "02" + "03" * 32)
+    golden(m.PartialBatchSelector.fixed_size(m.BatchId(b"\x04" * 32)), "02" + "04" * 32)
+
+
+def test_batch_selector():
+    golden(
+        m.BatchSelector.time_interval(m.Interval(m.Time(54321), m.Duration(12345))),
+        "01" "000000000000D431" "0000000000003039",
+    )
+    golden(
+        m.BatchSelector.time_interval(m.Interval(m.Time(50821), m.Duration(84354))),
+        "01" "000000000000C685" "0000000000014982",
+    )
+    golden(m.BatchSelector.fixed_size(m.BatchId(b"\x0c" * 32)), "02" + "0C" * 32)
+    golden(m.BatchSelector.fixed_size(m.BatchId(b"\x07" * 32)), "02" + "07" * 32)
+
+
+SMALL_LEADER_CT = m.HpkeCiphertext(m.HpkeConfigId(10), b"0123", b"4567")
+SMALL_HELPER_CT = m.HpkeCiphertext(m.HpkeConfigId(12), b"01234", b"567")
+SMALL_LEADER_CT_HEX = "0A" "0004" "30313233" "00000004" "34353637"
+SMALL_HELPER_CT_HEX = "0C" "0005" "3031323334" "00000003" "353637"
+
+
+def test_collection():
+    interval = m.Interval(m.Time(54321), m.Duration(12345))
+    interval_hex = "000000000000D431" "0000000000003039"
+    golden(
+        m.Collection(m.PartialBatchSelector.time_interval(), 0, interval, SMALL_LEADER_CT, SMALL_HELPER_CT),
+        "01" "0000000000000000" + interval_hex + SMALL_LEADER_CT_HEX + SMALL_HELPER_CT_HEX,
+    )
+    golden(
+        m.Collection(m.PartialBatchSelector.time_interval(), 23, interval, SMALL_LEADER_CT, SMALL_HELPER_CT),
+        "01" "0000000000000017" + interval_hex + SMALL_LEADER_CT_HEX + SMALL_HELPER_CT_HEX,
+    )
+    golden(
+        m.Collection(
+            m.PartialBatchSelector.fixed_size(m.BatchId(b"\x03" * 32)),
+            0,
+            interval,
+            SMALL_LEADER_CT,
+            SMALL_HELPER_CT,
+        ),
+        "02" + "03" * 32 + "0000000000000000" + interval_hex + SMALL_LEADER_CT_HEX + SMALL_HELPER_CT_HEX,
+    )
+    golden(
+        m.Collection(
+            m.PartialBatchSelector.fixed_size(m.BatchId(b"\x04" * 32)),
+            23,
+            interval,
+            SMALL_LEADER_CT,
+            SMALL_HELPER_CT,
+        ),
+        "02" + "04" * 32 + "0000000000000017" + interval_hex + SMALL_LEADER_CT_HEX + SMALL_HELPER_CT_HEX,
+    )
+
+
+# --- aggregation sub-protocol ---------------------------------------------
+
+RS1 = m.ReportShare(
+    m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(54321)), b"", LEADER_CT
+)
+RS1_HEX = (
+    "0102030405060708090A0B0C0D0E0F10" "000000000000D431" "00000000" + LEADER_CT_HEX
+)
+RS2 = m.ReportShare(
+    m.ReportMetadata(m.ReportId(bytes(range(16, 0, -1))), m.Time(73542)), b"0123", HELPER_CT
+)
+RS2_HEX = (
+    "100F0E0D0C0B0A090807060504030201" "0000000000011F46" "00000004" "30313233" + HELPER_CT_HEX
+)
+
+PP_INIT_MSG = encode_pingpong(PP_INITIALIZE, None, b"012345")
+PP_INIT_MSG_HEX = "00" "00000006" "303132333435"
+PP_FINISH_MSG = encode_pingpong(PP_FINISH, b"", None)
+PP_FINISH_MSG_HEX = "02" "00000000"
+
+
+def test_report_share():
+    golden(RS1, RS1_HEX)
+    golden(RS2, RS2_HEX)
+
+
+def test_prepare_init():
+    golden(m.PrepareInit(RS1, PP_INIT_MSG), RS1_HEX + PP_INIT_MSG_HEX)
+    golden(m.PrepareInit(RS2, PP_FINISH_MSG), RS2_HEX + PP_FINISH_MSG_HEX)
+
+
+def test_prepare_resp():
+    golden(
+        m.PrepareResp(
+            m.ReportId(bytes(range(1, 17))),
+            m.PrepareStepResult.cont(encode_pingpong(PP_CONTINUE, b"012345", b"6789")),
+        ),
+        "0102030405060708090A0B0C0D0E0F10" "00"
+        "01" "00000006" "303132333435" "00000004" "36373839",
+    )
+    golden(
+        m.PrepareResp(m.ReportId(bytes(range(16, 0, -1))), m.PrepareStepResult.finished()),
+        "100F0E0D0C0B0A090807060504030201" "01",
+    )
+    golden(
+        m.PrepareResp(
+            m.ReportId(b"\xff" * 16), m.PrepareStepResult.reject(m.PrepareError.VDAF_PREP_ERROR)
+        ),
+        "FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF" "02" "05",
+    )
+
+
+def test_prepare_error():
+    for err, hexstr in [
+        (m.PrepareError.BATCH_COLLECTED, "00"),
+        (m.PrepareError.REPORT_REPLAYED, "01"),
+        (m.PrepareError.REPORT_DROPPED, "02"),
+        (m.PrepareError.HPKE_UNKNOWN_CONFIG_ID, "03"),
+        (m.PrepareError.HPKE_DECRYPT_ERROR, "04"),
+        (m.PrepareError.VDAF_PREP_ERROR, "05"),
+    ]:
+        assert err.to_bytes() == bytes.fromhex(hexstr)
+
+
+def test_aggregation_job_initialize_req():
+    prep_inits = (m.PrepareInit(RS1, PP_INIT_MSG), m.PrepareInit(RS2, PP_FINISH_MSG))
+    body = "0000006E" + RS1_HEX + PP_INIT_MSG_HEX + RS2_HEX + PP_FINISH_MSG_HEX
+    golden(
+        m.AggregationJobInitializeReq(b"012345", m.PartialBatchSelector.time_interval(), prep_inits),
+        "00000006" "303132333435" "01" + body,
+    )
+    golden(
+        m.AggregationJobInitializeReq(
+            b"012345", m.PartialBatchSelector.fixed_size(m.BatchId(b"\x02" * 32)), prep_inits
+        ),
+        "00000006" "303132333435" "02" + "02" * 32 + body,
+    )
+
+
+def test_aggregation_job_continue_req():
+    golden(
+        m.AggregationJobContinueReq(
+            m.AggregationJobStep(42405),
+            (
+                m.PrepareContinue(m.ReportId(bytes(range(1, 17))), PP_INIT_MSG),
+                m.PrepareContinue(m.ReportId(bytes(range(16, 0, -1))), PP_INIT_MSG),
+            ),
+        ),
+        "A5A5" "00000036"
+        "0102030405060708090A0B0C0D0E0F10" + PP_INIT_MSG_HEX
+        + "100F0E0D0C0B0A090807060504030201" + PP_INIT_MSG_HEX,
+    )
+
+
+def test_aggregation_job_resp():
+    golden(
+        m.AggregationJobResp(
+            (
+                m.PrepareResp(
+                    m.ReportId(bytes(range(1, 17))),
+                    m.PrepareStepResult.cont(encode_pingpong(PP_CONTINUE, b"01234", b"56789")),
+                ),
+                m.PrepareResp(m.ReportId(bytes(range(16, 0, -1))), m.PrepareStepResult.finished()),
+            )
+        ),
+        "00000035"
+        "0102030405060708090A0B0C0D0E0F10" "00"
+        "01" "00000005" "3031323334" "00000005" "3536373839"
+        "100F0E0D0C0B0A090807060504030201" "01",
+    )
+
+
+def test_aggregate_share_req():
+    golden(
+        m.AggregateShareReq(
+            m.BatchSelector.time_interval(m.Interval(m.Time(54321), m.Duration(12345))),
+            b"",
+            439,
+            m.ReportIdChecksum(bytes(32)),
+        ),
+        "01" "000000000000D431" "0000000000003039" "00000000" "00000000000001B7" + "00" * 32,
+    )
+    golden(
+        m.AggregateShareReq(
+            m.BatchSelector.time_interval(m.Interval(m.Time(50821), m.Duration(84354))),
+            b"012345",
+            8725,
+            m.ReportIdChecksum(b"\xff" * 32),
+        ),
+        "01" "000000000000C685" "0000000000014982" "00000006" "303132333435"
+        "0000000000002215" + "FF" * 32,
+    )
+    golden(
+        m.AggregateShareReq(
+            m.BatchSelector.fixed_size(m.BatchId(b"\x0c" * 32)),
+            b"",
+            439,
+            m.ReportIdChecksum(bytes(32)),
+        ),
+        "02" + "0C" * 32 + "00000000" "00000000000001B7" + "00" * 32,
+    )
+    golden(
+        m.AggregateShareReq(
+            m.BatchSelector.fixed_size(m.BatchId(b"\x07" * 32)),
+            b"012345",
+            8725,
+            m.ReportIdChecksum(b"\xff" * 32),
+        ),
+        "02" + "07" * 32 + "00000006" "303132333435" "0000000000002215" + "FF" * 32,
+    )
+
+
+def test_aggregate_share():
+    golden(m.AggregateShare(SMALL_LEADER_CT), SMALL_LEADER_CT_HEX)
+    golden(m.AggregateShare(SMALL_HELPER_CT), SMALL_HELPER_CT_HEX)
+
+
+def test_input_share_aad():
+    golden(
+        m.InputShareAad(
+            m.TaskId(b"\x0c" * 32),
+            m.ReportMetadata(m.ReportId(bytes(range(1, 17))), m.Time(54321)),
+            b"0123",
+        ),
+        "0C" * 32 + "0102030405060708090A0B0C0D0E0F10" "000000000000D431" "00000004" "30313233",
+    )
+
+
+def test_aggregate_share_aad():
+    golden(
+        m.AggregateShareAad(
+            m.TaskId(b"\x0c" * 32),
+            bytes([0, 1, 2, 3]),
+            m.BatchSelector.time_interval(m.Interval(m.Time(54321), m.Duration(12345))),
+        ),
+        "0C" * 32 + "00000004" "00010203" "01" "000000000000D431" "0000000000003039",
+    )
+    golden(
+        m.AggregateShareAad(
+            m.TaskId(bytes(32)),
+            bytes([3, 2, 1, 0]),
+            m.BatchSelector.fixed_size(m.BatchId(b"\x07" * 32)),
+        ),
+        "00" * 32 + "00000004" "03020100" "02" + "07" * 32,
+    )
+
+
+# --- taskprov (messages/src/taskprov.rs vectors) --------------------------
+
+
+def test_dp_config():
+    golden(tp.DpConfig(tp.DpMechanism.RESERVED), "00")
+    golden(tp.DpConfig(tp.DpMechanism.NONE), "01")
+
+
+def test_vdaf_type():
+    golden(tp.VdafType.prio3_count(), "00000000")
+    golden(tp.VdafType.prio3_sum(0), "00000001" "00")
+    golden(tp.VdafType.prio3_sum(0x80), "00000001" "80")
+    golden(tp.VdafType.prio3_sum(0xFF), "00000001" "FF")
+    golden(
+        tp.VdafType.prio3_histogram([0x00ABCDEF, 0x40404040, 0xDEADBEEF]),
+        "00000002" "000018" "0000000000ABCDEF" "0000000040404040" "00000000DEADBEEF",
+    )
+    golden(
+        tp.VdafType.prio3_histogram([0, 2**64 - 1]),
+        "00000002" "000010" "0000000000000000" "FFFFFFFFFFFFFFFF",
+    )
+    golden(tp.VdafType.poplar1(0), "00001000" "0000")
+    golden(tp.VdafType.poplar1(0xABAB), "00001000" "ABAB")
+    golden(tp.VdafType.poplar1(0xFFFF), "00001000" "FFFF")
+
+
+def test_vdaf_config():
+    golden(
+        tp.VdafConfig(tp.DpConfig(tp.DpMechanism.NONE), tp.VdafType.prio3_count()),
+        "01" "00000000",
+    )
+    golden(
+        tp.VdafConfig(tp.DpConfig(tp.DpMechanism.NONE), tp.VdafType.prio3_sum(0x42)),
+        "01" "00000001" "42",
+    )
+    golden(
+        tp.VdafConfig(
+            tp.DpConfig(tp.DpMechanism.NONE), tp.VdafType.prio3_histogram([0xAAAAAAAA])
+        ),
+        "01" "00000002" "000008" "00000000AAAAAAAA",
+    )
+    # empty histogram buckets reject on decode
+    with pytest.raises((DecodeError, ValueError)):
+        tp.VdafConfig.from_bytes(bytes.fromhex("01" "00000002" "000000"))
+
+
+def test_query_config():
+    golden(
+        tp.QueryConfig(m.Duration(0x3C), 0x40, 0x24, tp.TaskprovQueryType.TIME_INTERVAL),
+        "01" "000000000000003C" "0040" "00000024",
+    )
+    golden(
+        tp.QueryConfig(m.Duration(0), 0, 0, tp.TaskprovQueryType.FIXED_SIZE, 0),
+        "02" "0000000000000000" "0000" "00000000" "00000000",
+    )
+    golden(
+        tp.QueryConfig(m.Duration(0x3C), 0x40, 0x24, tp.TaskprovQueryType.FIXED_SIZE, 0xFAFA),
+        "02" "000000000000003C" "0040" "00000024" "0000FAFA",
+    )
+    golden(
+        tp.QueryConfig(
+            m.Duration(2**64 - 1), 0xFFFF, 0xFFFFFFFF, tp.TaskprovQueryType.FIXED_SIZE, 0xFFFFFFFF
+        ),
+        "02" "FFFFFFFFFFFFFFFF" "FFFF" "FFFFFFFF" "FFFFFFFF",
+    )
+
+
+def test_task_config():
+    golden(
+        tp.TaskConfig(
+            b"foobar",
+            ("https://example.com/", "https://another.example.com/"),
+            tp.QueryConfig(m.Duration(0xAAAA), 0xBBBB, 0xCCCC, tp.TaskprovQueryType.FIXED_SIZE, 0xDDDD),
+            m.Time(0xEEEE),
+            tp.VdafConfig(tp.DpConfig(tp.DpMechanism.NONE), tp.VdafType.prio3_count()),
+        ),
+        "06" "666F6F626172"
+        "0034"
+        "0014" "68747470733A2F2F6578616D706C652E636F6D2F"
+        "001C" "68747470733A2F2F616E6F746865722E6578616D706C652E636F6D2F"
+        "02" "000000000000AAAA" "BBBB" "0000CCCC" "0000DDDD"
+        "000000000000EEEE"
+        "01" "00000000",
+    )
+    golden(
+        tp.TaskConfig(
+            b"f",
+            ("https://example.com",),
+            tp.QueryConfig(m.Duration(0xAAAA), 0xBBBB, 0xCCCC, tp.TaskprovQueryType.TIME_INTERVAL),
+            m.Time(0xEEEE),
+            tp.VdafConfig(
+                tp.DpConfig(tp.DpMechanism.NONE), tp.VdafType.prio3_histogram([0xFFFF])
+            ),
+        ),
+        "01" "66"
+        "0015"
+        "0013" "68747470733A2F2F6578616D706C652E636F6D"
+        "01" "000000000000AAAA" "BBBB" "0000CCCC"
+        "000000000000EEEE"
+        "01" "00000002" "000008" "000000000000FFFF",
+    )
+    # empty task_info / empty aggregator_endpoints reject on decode
+    tail = (
+        "01" "000000000000AAAA" "BBBB" "0000CCCC"
+        "000000000000EEEE"
+        "01" "00000002" "000008" "000000000000FFFF"
+    )
+    with pytest.raises((DecodeError, ValueError)):
+        tp.TaskConfig.from_bytes(bytes.fromhex("00" + "0003" "0001" "68" + tail))
+    with pytest.raises((DecodeError, ValueError)):
+        tp.TaskConfig.from_bytes(bytes.fromhex("01" "66" + "0000" + tail))
+
+
+# --- ping-pong framing itself (prio topology::ping_pong) ------------------
+
+
+def test_pingpong_framing():
+    assert encode_pingpong(PP_INITIALIZE, None, b"012345") == bytes.fromhex(
+        "00" "00000006" "303132333435"
+    )
+    assert encode_pingpong(PP_CONTINUE, b"012345", b"6789") == bytes.fromhex(
+        "01" "00000006" "303132333435" "00000004" "36373839"
+    )
+    assert encode_pingpong(PP_FINISH, b"", None) == bytes.fromhex("02" "00000000")
