@@ -166,13 +166,15 @@ def _reduce_f128_words(w, zero):
 def _expand_kernel(p_lanes: int, tile_blocks: int = _TILE_BLOCKS):
     """Kernel factory: prefix occupies lanes [0, p_lanes), counter at
     lane p_lanes, SHAKE padding at p_lanes+1 and lane 20 (the
-    ctr_stream_lanes single-block framing, keccak_jax.py)."""
+    ctr_stream_lanes single-block framing, keccak_jax.py). off_ref is a
+    [1] SMEM scalar: the stream-block counter offset (0 for whole-share
+    expansion; step*blocks_per_step for the streamed query path)."""
 
-    def kern(pref_ref, o_ref):
+    def kern(off_ref, pref_ref, o_ref):
         shape = (_TILE_REPORTS, tile_blocks)
         zero = jnp.zeros(shape, U32)
         lane_i = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-        ctr_lo = (lane_i + pl_program_id(1) * tile_blocks).astype(U32)
+        ctr_lo = (lane_i + pl_program_id(1) * tile_blocks + off_ref[0]).astype(U32)
         a = []
         for lane in range(25):
             if lane < p_lanes:
@@ -220,6 +222,10 @@ def _call(p_lanes: int, b8: int, nb: int, tile_blocks: int, interpret: bool):
     grid = (b8 // _TILE_REPORTS, nb)
     # index maps derived from grid indices only (monomorphic i32 — see
     # keccak_pallas._call for the Mosaic constraint this dodges)
+    # explicit monomorphic index map (literal 0s lower to i64 constants,
+    # which this Mosaic build refuses to mix in func.return — see
+    # keccak_pallas._call)
+    off_spec = pl.BlockSpec((1,), lambda b, j: (j * 0,), memory_space=pltpu.SMEM)
     in_spec = pl.BlockSpec(
         (_TILE_REPORTS, 128), lambda b, j: (b, j * 0), memory_space=pltpu.VMEM
     )
@@ -234,20 +240,22 @@ def _call(p_lanes: int, b8: int, nb: int, tile_blocks: int, interpret: bool):
         _expand_kernel(p_lanes, tile_blocks),
         out_shape=jax.ShapeDtypeStruct((b8, nb, 28, tile_blocks), jnp.uint32),
         grid=grid,
-        in_specs=[in_spec],
+        in_specs=[off_spec, in_spec],
         out_specs=out_spec,
         interpret=interpret,
     )
 
 
-def expand_f128(prefix_lanes, out_blocks: int, length: int):
+def expand_f128(prefix_lanes, out_blocks: int, length: int, block_offset=0):
     """Expand per-report counter-mode prefixes straight to Field128
     limb arrays, fused on device.
 
     prefix_lanes: [batch, p] u64 (dst||seed||binder', lane-aligned);
     returns a (lo, hi) limb tuple of shape [batch, length] — the same
     value keccak_jax.sample_field_vec produces from the unfused stream
-    (differential-tested in tests/test_expand_pallas.py).
+    (differential-tested in tests/test_expand_pallas.py). block_offset
+    (python int or traced scalar) starts the stream counter at that
+    block.
     """
     prefix_lanes = jnp.asarray(prefix_lanes, U64)
     batch, p = prefix_lanes.shape
@@ -259,7 +267,8 @@ def expand_f128(prefix_lanes, out_blocks: int, length: int):
     hi32 = (prefix_lanes >> np.uint64(32)).astype(U32)
     inter = jnp.stack([lo32, hi32], axis=-1).reshape(batch, 2 * p)
     inter = jnp.pad(inter, ((0, b8 - batch), (0, 128 - 2 * p)))
-    out = _call(p, b8, nb, _TILE_BLOCKS, _mode() != "tpu")(inter)
+    off = jnp.asarray(block_offset, jnp.int32).reshape(1)
+    out = _call(p, b8, nb, _TILE_BLOCKS, _mode() != "tpu")(off, inter)
     # out[b, nbi, t*4+k, lane] = word k of element t of block
     # nbi*128+lane; element index is block*7 + t
     o = out.reshape(b8, nb, 7, 4, _TILE_BLOCKS)

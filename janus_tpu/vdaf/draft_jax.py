@@ -20,12 +20,17 @@ handled head-on here, because short streams make them affordable:
   (one shift pair per segment, O(#segments) ops).
 - **Rejection sampling without gathers.** The draft samples field
   elements by rejecting candidates >= p, a data-dependent compaction.
-  For short vectors the select is a dense [batch, length, candidates]
-  masked sum (rank = exclusive prefix sum of the accept mask), which
-  is elementwise + one reduction — no gathers. The candidate cushion
-  makes exhaustion cryptographically unreachable (P < 2^-128; an
-  exhausted lane would surface as FLP rejection of that report, never
-  silent acceptance).
+  The select is O(window x length) over shifted slices (element e is
+  filled by candidate e+j iff exactly j rejects precede it), which is
+  elementwise + one prefix sum — no gathers, any vector length. The
+  candidate cushion makes window exhaustion cryptographically
+  unreachable (P < 2^-80; an exhausted lane would surface as FLP
+  rejection of that report, never silent acceptance).
+
+At north-star lengths the FLP query runs streamed over the materialized
+share (engine.flp_query_streamed via the sliced source), so the
+O(input_len) wire intermediates never exist; the sponge chain itself is
+the remaining sequential cost.
 
 Differentially tested byte-for-byte against the host draft oracle
 (`reference.Prio3(mode="draft")`) in tests/test_draft_jax.py.
@@ -168,22 +173,34 @@ class Prio3BatchedDraft(Prio3Batched):
     """Device Prio3 with the VDAF-07 draft XOF framing.
 
     Shares the entire FLP/field pipeline with the fast engine; only the
-    XOF plumbing (framing, sampling, binder choices) differs. Gated to
-    short-stream circuits by `supports_circuit` — long expansions keep
-    the sequential-squeeze latency the fast framing exists to kill, so
-    they stay on the host oracle.
+    XOF plumbing (framing, sampling, binder choices) differs.
+    `supports_circuit` bounds the sponge stream length; within it every
+    deployed config (including the north-star SumVec len=100k) runs on
+    device.
     """
+
+    # Draft framing: sponge streams have no random-access counter and
+    # the joint-rand binder is the full expanded share — so the helper
+    # share materializes once and the streamed query slices it
+    # (prio3_jax.prepare_init_helper's sliced branch). The query
+    # streaming itself applies unchanged (the FLP math is
+    # framing-independent; differential-tested in test_draft_jax.py).
+    _can_stream = True
+    _stream_expand_offsets = False
 
     # max sponge blocks per expansion (absorb or squeeze side). The
     # chain is sequential per report (~24 rounds/block of pure latency)
     # but fully batched across reports, and the scan-based sponge keeps
-    # the traced graph O(1) in stream length — so the cap is about
-    # bounding worst-case step latency, not feasibility. 4096 blocks
-    # (~672 KB of stream) covers SumVec len=1000 bits=16 (~1.5k blocks
-    # each way) with room; the truly huge configs (len=100k: ~150k
-    # absorb blocks for the spec's full-share joint-rand binder) stay
-    # on the host oracle.
-    MAX_STREAM_BLOCKS = 4096
+    # the traced graph O(1) in stream length — so the cap bounds
+    # worst-case step latency and stream memory (21 lanes x 8 B/block
+    # per report), not feasibility. 160k blocks covers the north-star
+    # SumVec len=100k bits=16 (~152k squeeze blocks for the share, and
+    # the same order absorbing the full-share joint-rand binder):
+    # spec-conformant tasks at north-star lengths now run on device
+    # instead of the ~1 r/s host scalar loop (VERDICT r3 item 4) —
+    # slowly (the sponge chain is inherently sequential per report;
+    # batching amortizes it across reports) but orders faster than host.
+    MAX_STREAM_BLOCKS = 160_000
 
     @classmethod
     def supports_circuit(cls, circ) -> bool:

@@ -454,6 +454,191 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
     )
 
 
+# ---------------------------------------------------------------------------
+# Streamed FLP query + truncate (large-input circuits)
+# ---------------------------------------------------------------------------
+
+# Stream the query once the expanded share would dominate HBM: below
+# this the whole-share path is faster (no scan sequentialization).
+STREAM_MIN_INPUT_LEN = 1 << 17
+_STREAM_TARGET_STEPS = 16
+
+
+class StreamPlan:
+    """Group geometry for the streamed query: the input is processed in
+    `n_steps` scan steps of `gcalls` gadget calls (= `group` input
+    elements) each. `group` is aligned to both the XOF block quantum
+    (7 Field128 elements per 168-byte counter block) and `bits` (so
+    SumVec truncate tiles never straddle a group)."""
+
+    __slots__ = ("gcalls", "n_steps", "group", "bits")
+
+    def __init__(self, gcalls: int, n_steps: int, group: int, bits: int):
+        self.gcalls = gcalls
+        self.n_steps = n_steps
+        self.group = group
+        self.bits = bits
+
+
+def stream_plan(bc: BatchedCircuit, min_input_len: int | None = None) -> StreamPlan | None:
+    """A StreamPlan for circuits worth streaming, else None.
+
+    SumVec and Histogram only: their query consumes the expanded share
+    as per-call folds, so it streams. (FixedPointVec's two-pass entry
+    values could stream too but its deployed lengths don't need it;
+    Count/Sum inputs are tiny.)
+    """
+    import math
+
+    circ = bc.circ
+    if type(circ) not in (SumVec, Histogram):
+        return None
+    if bc.jf.LIMBS != 2:
+        return None  # block alignment below assumes 7 F128 elements/block
+    if circ.input_len < (STREAM_MIN_INPUT_LEN if min_input_len is None else min_input_len):
+        return None
+    ch = circ.chunk_length
+    bits = getattr(circ, "bits", 1)
+    align = math.lcm(7, bits)
+    a = align // math.gcd(align, ch)  # smallest gcalls with align | gcalls*ch
+    gcalls = a * max(1, round(bc.calls / a / _STREAM_TARGET_STEPS))
+    n_steps = -(-bc.calls // gcalls)
+    return StreamPlan(gcalls, n_steps, gcalls * ch, bits)
+
+
+def sliced_meas_source(bc: BatchedCircuit, plan: StreamPlan, meas):
+    """meas_source over a device-resident [batch, input_len] share
+    (leader side): pad to the group grid once, dynamic-slice per step."""
+    total = plan.n_steps * plan.group
+    n = bc.circ.input_len
+    if total > n:
+        meas = fmap(lambda v: jnp.pad(v, ((0, 0), (0, total - n))), meas)
+
+    def src(step):
+        return fmap(
+            lambda v: jax.lax.dynamic_slice_in_dim(v, step * plan.group, plan.group, axis=1),
+            meas,
+        )
+
+    return src
+
+
+def flp_query_streamed(
+    bc: BatchedCircuit, plan: StreamPlan, meas_source, proof_share, query_rand, joint_rand, num_shares: int
+):
+    """Streamed twin of flp_query_batched, fused with truncate.
+
+    meas_source(step) -> input-share elements [batch, group] for
+    element range [step*group, (step+1)*group) (values beyond input_len
+    are masked here). Returns (verifier, out_share) — field-element
+    identical to (flp_query_batched(...), bc.truncate(meas)) (the fold
+    order differs but field addition is exact mod p), with peak memory
+    O(group) instead of O(input_len): the expanded share never fully
+    materializes. This is what lifts the SumVec len=100k single-chip
+    batch cap (BASELINE.md roofline: the limiter was HBM capacity).
+    Replaces the reference's per-report query loop
+    (aggregation_job_driver.rs:329-402) at north-star lengths.
+    """
+    jf = bc.jf
+    circ = bc.circ
+    F = circ.FIELD
+    shares_inv = F.inv(num_shares)
+    n = circ.input_len
+    G = plan.group
+    ch = circ.chunk_length
+    gcalls = plan.gcalls
+    batch = query_rand[0].shape[0]
+    is_sumvec = isinstance(circ, SumVec)
+
+    # --- proof-share side (small; identical to flp_query_batched) ---
+    seeds = fmap(lambda x: x[..., : bc.arity], proof_share)
+    gcoeffs = fmap(lambda x: x[..., bc.arity : bc.arity + bc.gp_len], proof_share)
+    assert query_rand[0].shape[-1] == EVAL_POINT_CANDIDATES
+    t = anti_recompute_barrier(_pick_eval_point(jf, query_rand, bc.m))
+    folds = -(-bc.gp_len // bc.m)
+    padded = fmap(lambda x: jnp.pad(x, ((0, 0), (0, folds * bc.m - bc.gp_len))), gcoeffs)
+    gfold = fsum(jf, fmap(lambda x: x.reshape(x.shape[0], folds, bc.m), padded), axis=1)
+    gevals = ntt_batched(jf, gfold, bc.m)
+    outs = fmap(lambda x: x[..., 1 : bc.calls + 1], gevals)
+    pw = anti_recompute_barrier(powers(jf, t, bc.gp_len))
+    L = anti_recompute_barrier(lagrange_eval_weights(jf, pw, bc.m))
+    L0 = fmap(lambda x: x[:, 0], L)
+    # call weights, zero-padded so tail calls beyond `calls` contribute 0
+    Lc = fmap(lambda x: x[:, 1 : 1 + bc.calls], L)
+    padc = plan.n_steps * gcalls - bc.calls
+    if padc:
+        Lc = fmap(lambda x: jnp.pad(x, ((0, 0), (0, padc))), Lc)
+
+    # --- streamed input-share folds ---
+    r = fmap(lambda x: x[:, 0], joint_rand)
+    rt = anti_recompute_barrier(powers(jf, r, G))  # [batch, G]: r^0..r^{G-1}
+    rstep = fpow_const(jf, r, G)  # r^G
+    s_const = fconst(jf, shares_inv)
+    two_pows = _two_power_consts(jf, plan.bits) if is_sumvec else None
+
+    from ..fields.jfield import fzeros
+
+    def body(carry, step):
+        base, W0, W1, S = carry  # base = r^{step*G + 1}
+        x = meas_source(step)  # [batch, G]
+        mask = (step * G + jnp.arange(G)) < n  # [G]
+        x = fmap(lambda v: jnp.where(mask[None, :], v, jnp.zeros_like(v)), x)
+        # gadget wire pair (a, b) per element k: (r^{k+1} x_k, x_k - 1/shares)
+        a = jf.mul(jf.mul(fmap(lambda v: v[:, None], base), rt), x)
+        b = fmap(
+            lambda v, z: jnp.where(mask[None, :], v, z),
+            jf.sub(x, s_const),
+            fzeros(jf, (batch, G)),
+        )
+        a_r = fmap(lambda v: v.reshape(batch, gcalls, ch), a)
+        b_r = fmap(lambda v: v.reshape(batch, gcalls, ch), b)
+        Lg = fmap(
+            lambda v: jax.lax.dynamic_slice_in_dim(v, step * gcalls, gcalls, axis=1), Lc
+        )
+        Lg3 = fmap(lambda v: v[:, :, None], Lg)
+        W0 = jf.add(W0, fsum(jf, jf.mul(a_r, Lg3), axis=1))
+        W1 = jf.add(W1, fsum(jf, jf.mul(b_r, Lg3), axis=1))
+        S = jf.add(S, fsum(jf, x, axis=-1))
+        if is_sumvec:  # bits-major fold: out[e] = sum_b 2^b x_{e*bits+b}
+            v = fmap(
+                lambda w: jnp.swapaxes(w.reshape(batch, G // plan.bits, plan.bits), 1, 2), x
+            )
+            part = fsum(jf, jf.mul(v, fmap(lambda w: w[:, None], two_pows)), axis=1)
+        else:  # histogram truncate is the identity
+            part = x
+        base = jf.mul(base, rstep)
+        return (base, W0, W1, S), part
+
+    init = (r, fzeros(jf, (batch, ch)), fzeros(jf, (batch, ch)), fzeros(jf, (batch,)))
+    carry, parts = jax.lax.scan(body, init, jnp.arange(plan.n_steps))
+    _, W0, W1, S = carry
+    out_share = fmap(
+        lambda v: jnp.moveaxis(v, 0, 1).reshape(batch, -1)[:, : circ.output_len], parts
+    )
+
+    # wire_t interleaves (a, b) per chunk position: index 2c from W0[c]
+    wire_t = fmap(lambda p, q: jnp.stack([p, q], axis=-1).reshape(batch, -1), W0, W1)
+    wire_t = jf.add(wire_t, jf.mul(seeds, fmap(lambda x: x[:, None], L0)))
+    proof_t = poly_eval_powers(jf, gcoeffs, pw)
+
+    # circuit output v = bc.finish(...) without the full input tensor
+    if is_sumvec:
+        v = fsum(jf, outs, axis=-1)
+    else:
+        bit_check = fsum(jf, outs, axis=-1)
+        sum_check = jf.sub(S, s_const)
+        jr1 = fmap(lambda x: x[:, 1], joint_rand)
+        v = jf.add(bit_check, jf.mul(jr1, sum_check))
+
+    verifier = fmap(
+        lambda a, b, c: jnp.concatenate([a[:, None], b, c[:, None]], axis=-1),
+        v,
+        wire_t,
+        proof_t,
+    )
+    return verifier, out_share
+
+
 def flp_decide_batched(bc: BatchedCircuit, verifier):
     """Boolean accept mask [batch] over combined verifier messages."""
     jf = bc.jf

@@ -233,7 +233,7 @@ def _single_block_keccak(lane_cols):
     return keccak_f1600(state)
 
 
-def ctr_stream_lanes(prefix_parts, prefix_len_bytes: int, batch: int, out_blocks: int):
+def ctr_stream_lanes(prefix_parts, prefix_len_bytes: int, batch: int, out_blocks: int, ctr_offset=0):
     """Counter-mode SHAKE128 stream: [batch, out_blocks, 21] u64 lanes.
 
     prefix_parts: (lane_offset, content) segments of the prefix
@@ -241,6 +241,10 @@ def ctr_stream_lanes(prefix_parts, prefix_len_bytes: int, batch: int, out_blocks
     block is the independent single-block message prefix || le64(i), so
     the whole stream is ONE batched permutation — this is the load-bearing
     TPU restructuring over sequential sponge squeezing.
+
+    ctr_offset (python int or traced scalar) starts the counter at block
+    `ctr_offset` instead of 0 — the streamed-expansion path (engine.py
+    flp_query_streamed) generates the stream a slice at a time.
     """
     assert prefix_len_bytes % 8 == 0
     p = prefix_len_bytes // 8
@@ -252,7 +256,7 @@ def ctr_stream_lanes(prefix_parts, prefix_len_bytes: int, batch: int, out_blocks
         if lane < p:
             cols.append(jnp.broadcast_to(prefix[:, lane : lane + 1], shape))
         elif lane == p:
-            ctr = jnp.arange(out_blocks, dtype=U64)[None, :]
+            ctr = jnp.arange(out_blocks, dtype=U64)[None, :] + jnp.asarray(ctr_offset, U64)
             cols.append(jnp.broadcast_to(ctr, shape))
         else:
             v = np.uint64(0)
@@ -358,7 +362,7 @@ def sample_field_vec(jf, stream_lanes, length: int):
     return _f128_reduce256(lanes[0], lanes[1], lanes[2], zero)
 
 
-def expand_field_vec(jf, prefix_parts, prefix_len_bytes: int, batch: int, length: int):
+def expand_field_vec(jf, prefix_parts, prefix_len_bytes: int, batch: int, length: int, block_offset=0):
     """XOF-expand per-report prefixes straight to field vectors on device.
 
     prefix_parts lay out dst16 || seed || binder' (counter-mode framing,
@@ -367,6 +371,10 @@ def expand_field_vec(jf, prefix_parts, prefix_len_bytes: int, batch: int, length
     Long Field128 expansions dispatch to the fused Pallas kernel
     (janus_tpu.ops.expand_pallas): permutation + mod-p sampling in
     VMEM, so the raw stream (24 bytes/element) never reaches HBM.
+
+    block_offset (python int or traced scalar) starts the counter at
+    that stream block; the caller is responsible for block-aligning the
+    element range (Field128: 7 elements per block).
     """
     from ..ops import expand_pallas
 
@@ -374,6 +382,6 @@ def expand_field_vec(jf, prefix_parts, prefix_len_bytes: int, batch: int, length
     blocks = sample_count_blocks(jf, length)
     if expand_pallas.enabled(jf, blocks):
         prefix = _assemble_segments(prefix_parts, prefix_len_bytes // 8, batch)
-        return expand_pallas.expand_f128(prefix, blocks, length)
-    out = ctr_stream_lanes(prefix_parts, prefix_len_bytes, batch, blocks)
+        return expand_pallas.expand_f128(prefix, blocks, length, block_offset=block_offset)
+    out = ctr_stream_lanes(prefix_parts, prefix_len_bytes, batch, blocks, ctr_offset=block_offset)
     return sample_field_vec(jf, out, length)

@@ -30,6 +30,9 @@ from .engine import (
     flp_decide_batched,
     flp_prove_batched,
     flp_query_batched,
+    flp_query_streamed,
+    sliced_meas_source,
+    stream_plan,
 )
 from .keccak_jax import (
     ctr_stream_lanes,
@@ -84,6 +87,15 @@ class Prio3Batched:
     """
 
     NUM_SHARES = 2
+    # Streamed-query controls. _can_stream: the FLP query runs via
+    # engine.flp_query_streamed at large input_len (the query math is
+    # XOF-framing independent, so the draft engine streams too).
+    # _stream_expand_offsets: the helper share expansion supports
+    # random-access counter offsets, so the share never materializes
+    # (true only for this class's counter-mode framing; the draft's
+    # sequential sponge materializes the share once and slices it).
+    _can_stream = True
+    _stream_expand_offsets = True
 
     def __init__(self, circuit: Circuit):
         self.circ = circuit
@@ -155,6 +167,31 @@ class Prio3Batched:
     def _expand_share(self, seed_lanes, usage: int, length: int):
         """Expand helper measurement/proof share: binder = AGG1."""
         return self._expand_vec(usage, seed_lanes, [(0, AGG1)], 8, length)
+
+    def _expand_share_source(self, seed_lanes, usage: int, plan):
+        """meas_source for flp_query_streamed: expands the helper share a
+        group at a time via the counter-mode block offset (the expanded
+        share never fully materializes). plan.group is block-aligned
+        (7 Field128 elements per counter block, engine.stream_plan)."""
+        from .keccak_jax import _assemble_segments, expand_field_vec
+
+        batch = seed_lanes.shape[0]
+        parts, prefix_len = self._prefix_parts(usage, seed_lanes, [(0, AGG1)], 8, batch)
+        # assemble the (loop-invariant) prefix once, outside the scan
+        prefix = _assemble_segments(parts, prefix_len // 8, batch)
+        blocks_per_step = plan.group // 7
+
+        def src(step):
+            return expand_field_vec(
+                self.jf,
+                [(0, prefix)],
+                prefix_len,
+                batch,
+                plan.group,
+                block_offset=step * blocks_per_step,
+            )
+
+        return src
 
     def _part_binder(self, agg_id: int, meas, helper_seed):
         """The share binder for joint-rand part derivation (as lanes):
@@ -273,6 +310,26 @@ class Prio3Batched:
 
     def prepare_init_helper(self, verify_key: bytes, nonce_lanes, public_parts, helper_seed, blind1):
         circ = self.circ
+        plan = stream_plan(self.bc) if self._can_stream else None
+        if plan is not None:
+            proof = self._expand_share(helper_seed, USAGE_PROOF_SHARE, circ.proof_len)
+            if self._stream_expand_offsets:
+                # fully streamed: the expanded measurement share never
+                # materializes (the fast-mode joint-rand binder is the
+                # seed, so nothing else needs the whole share)
+                src = self._expand_share_source(helper_seed, USAGE_MEASUREMENT_SHARE, plan)
+                meas = None
+            else:
+                # draft framing: the sponge expansion is sequential (no
+                # random access) and the joint-rand binder needs the
+                # whole share — materialize once, stream the query over
+                # slices (kills the O(input_len) wire intermediates)
+                meas = self._expand_share(helper_seed, USAGE_MEASUREMENT_SHARE, circ.input_len)
+                src = sliced_meas_source(self.bc, plan, meas)
+            return self._prepare_init_streamed(
+                verify_key, 1, nonce_lanes, public_parts, src, proof, blind1, helper_seed,
+                plan, meas=meas,
+            )
         meas = self._expand_share(helper_seed, USAGE_MEASUREMENT_SHARE, circ.input_len)
         proof = self._expand_share(helper_seed, USAGE_PROOF_SHARE, circ.proof_len)
         return self._prepare_init(
@@ -282,6 +339,15 @@ class Prio3Batched:
     def _prepare_init(self, verify_key, agg_id, nonce_lanes, public_parts, meas, proof, blind, helper_seed):
         circ = self.circ
         jf = self.jf
+        plan = stream_plan(self.bc) if self._can_stream and agg_id == 0 else None
+        if plan is not None:
+            # leader streamed: meas exists (staged input), but the query's
+            # O(input_len) wire intermediates are replaced by group folds
+            src = sliced_meas_source(self.bc, plan, meas)
+            return self._prepare_init_streamed(
+                verify_key, agg_id, nonce_lanes, public_parts, src, proof, blind, helper_seed,
+                plan, meas=meas,
+            )
         corrected_seed = None
         own_part = None
         joint_rand = ()
@@ -297,6 +363,31 @@ class Prio3Batched:
             self.bc, meas, proof, query_rand, joint_rand, self.NUM_SHARES
         )
         out_share = self.bc.truncate(meas)
+        return out_share, corrected_seed, verifier, own_part
+
+    def _prepare_init_streamed(
+        self, verify_key, agg_id, nonce_lanes, public_parts, meas_source, proof, blind,
+        helper_seed, plan, meas=None,
+    ):
+        """Streamed prepare-init: query + truncate via flp_query_streamed.
+
+        Field-element identical to _prepare_init (differential-tested in
+        tests/test_stream_query.py); the joint-rand derivation is
+        unchanged (leader binder = staged meas, helper binder = seed)."""
+        corrected_seed = None
+        own_part = None
+        joint_rand = ()
+        if self.uses_joint_rand:
+            binder = self._part_binder(agg_id, meas, helper_seed)
+            own_part = self._joint_rand_part(agg_id, blind, nonce_lanes, binder)
+            other = public_parts[:, 1 - agg_id]
+            parts = (own_part, other) if agg_id == 0 else (other, own_part)
+            corrected_seed = self._joint_rand_seed(*parts)
+            joint_rand = self._joint_rand(corrected_seed)
+        query_rand = self._query_rand(verify_key, nonce_lanes)
+        verifier, out_share = flp_query_streamed(
+            self.bc, plan, meas_source, proof, query_rand, joint_rand, self.NUM_SHARES
+        )
         return out_share, corrected_seed, verifier, own_part
 
     def prep_shares_to_prep(self, verifier0, verifier1, part0=None, part1=None):

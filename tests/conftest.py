@@ -47,3 +47,26 @@ import importlib.util
 DATASTORE_ENGINES = ["sqlite"]
 if os.environ.get("JANUS_TEST_DATABASE_URL") and importlib.util.find_spec("psycopg"):
     DATASTORE_ENGINES.append("postgres")
+
+# XLA:CPU's in-process compiler state degrades after many hundreds of
+# compilations in one interpreter (observed: deterministic segfault in
+# backend_compile_and_load roughly two-thirds into `pytest tests/`,
+# independent of which test runs there; every file passes in
+# isolation). Clearing jax's tracing/executable caches between test
+# modules bounds that growth — subsequent modules retrace, which the
+# persistent on-disk cache keeps cheap.
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    yield
+    jax.clear_caches()
+    # lru-cached engine wrappers hold compiled callables; drop them with
+    # the caches they reference
+    try:
+        from janus_tpu.aggregator.engine_cache import engine_cache
+
+        engine_cache.cache_clear()
+    except Exception:
+        pass

@@ -232,3 +232,53 @@ def test_two_party_prepare_differential(kind):
             assert lanes_to_bytes_row(prep_msg, i) == msg
         assert value_to_ints(out0, i) == o0
         assert value_to_ints(out1, i) == o1
+
+
+def test_draft_streamed_query_matches_unstreamed(monkeypatch):
+    """Draft engine at streaming sizes: the sliced-source streamed query
+    must be element-identical to the whole-share path (VERDICT r3
+    item 4 — spec-conformant tasks at north-star lengths no longer fall
+    back to the host loop; the geometry here is small, the activation
+    threshold is monkeypatched down)."""
+    import numpy as np
+
+    from janus_tpu.vdaf import engine
+    from janus_tpu.vdaf.draft_jax import Prio3BatchedDraft
+    from janus_tpu.vdaf.reference import SumVec
+
+    circ = SumVec(40, 16, chunk_length=5)
+    p3 = Prio3BatchedDraft(circ)
+    assert p3._can_stream and not p3._stream_expand_offsets
+    rng = np.random.default_rng(77)
+    batch = 2
+    vk = bytes(range(16))
+    nonce = rng.integers(0, 1 << 63, size=(batch, 2), dtype=np.uint64)
+    seeds = rng.integers(0, 1 << 63, size=(batch, 2), dtype=np.uint64)
+    blind = rng.integers(0, 1 << 63, size=(batch, 2), dtype=np.uint64)
+    parts = np.stack(
+        [rng.integers(0, 1 << 63, size=(batch, 2), dtype=np.uint64) for _ in range(2)],
+        axis=1,
+    )
+
+    monkeypatch.setattr(engine, "STREAM_MIN_INPUT_LEN", 1)
+    out_s = p3.prepare_init_helper(vk, nonce, parts, seeds, blind)
+    monkeypatch.setattr(engine, "STREAM_MIN_INPUT_LEN", 1 << 60)
+    out_u = p3.prepare_init_helper(vk, nonce, parts, seeds, blind)
+    for s, u in zip(out_s, out_u):
+        if s is None:
+            assert u is None
+            continue
+        if isinstance(s, tuple):
+            for a, b in zip(s, u):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        else:
+            np.testing.assert_array_equal(np.asarray(s), np.asarray(u))
+
+
+def test_draft_supports_north_star_length():
+    """The device draft engine now covers SumVec len=100k (the cap that
+    used to force the host fallback at ~len 25k)."""
+    from janus_tpu.vdaf.draft_jax import Prio3BatchedDraft
+    from janus_tpu.vdaf.reference import SumVec
+
+    assert Prio3BatchedDraft.supports_circuit(SumVec(100_000, 16))

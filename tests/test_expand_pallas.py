@@ -90,3 +90,56 @@ def test_enabled_gating():
         assert not ep.enabled(JF128, 10_000)
     # Field64 never dispatches (block straddling), regardless of mode
     assert not ep.enabled(JF64, 10_000)
+
+
+def test_framing_and_offset_with_mock_permutation(monkeypatch):
+    """Always-on smoke test of the kernel's framing/reshape/offset logic
+    (ADVICE r3): the 24-round permutation is swapped for a cheap
+    bijective mock — rot64(lane[(i+3)%25], 32) ^ C — applied identically
+    in the u32-pair kernel and the u64 unfused path, so the prefix
+    interleave, counter placement (incl. the new block_offset), SHAKE
+    padding lanes, in-kernel mod-p reduction, and output transpose are
+    all exercised in interpret mode without the 24-round cost."""
+    C = 0xA5A5A5A5_5A5A5A5A
+
+    def mock_pairs(a):
+        out = []
+        for i in range(25):
+            lo, hi = a[(i + 3) % 25]
+            # rot64 by 32 == swap halves; xor C on the swapped value
+            out.append((hi ^ np.uint32(C & 0xFFFFFFFF), lo ^ np.uint32(C >> 32)))
+        return out
+
+    def mock_f1600(state):
+        return tuple(
+            ((state[(i + 3) % 25] << jnp.uint64(32)) | (state[(i + 3) % 25] >> jnp.uint64(32)))
+            ^ jnp.uint64(C)
+            for i in range(25)
+        )
+
+    monkeypatch.setattr(ep, "permute_pairs", mock_pairs)
+    monkeypatch.setattr(kj, "keccak_f1600", mock_f1600)
+    monkeypatch.setattr(kp, "_mode", lambda: "interpret")
+    ep._call.cache_clear()
+    try:
+        rng = np.random.default_rng(11)
+        batch, p = 3, 6
+        prefix = rng.integers(0, 1 << 63, size=(batch, p), dtype=np.uint64)
+        jf = kj and __import__("janus_tpu.fields.jfield", fromlist=["JF128"]).JF128
+        length, blocks = 7 * 130, 130  # >1 tile along the block axis
+        fused = ep.expand_f128(prefix, blocks, length)
+        unfused_stream = kj.ctr_stream_lanes([(0, jnp.asarray(prefix))], p * 8, batch, blocks)
+        unfused = kj.sample_field_vec(jf, unfused_stream, length)
+        for a, b in zip(fused, unfused):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # block_offset consistency: expanding [off, off+k) blocks equals
+        # the same slice of the offset-0 expansion
+        off_blocks, k_blocks = 2, 128
+        fused_off = ep.expand_f128(prefix, k_blocks, 7 * k_blocks, block_offset=off_blocks)
+        for a, b in zip(fused_off, fused):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)[:, 7 * off_blocks : 7 * (off_blocks + k_blocks)]
+            )
+    finally:
+        ep._call.cache_clear()

@@ -110,3 +110,39 @@ def test_helper_http_serving_runs_sharded(pair, monkeypatch):
         )
     )
     assert sum(r.report_count for r in rows) == len(measurements)
+
+
+def test_long_vector_task_gets_sp_mesh():
+    """Tasks past SP_MIN_INPUT_LEN shard the vector axis too: the mesh
+    is (dp, sp=2) and leader_init runs with meas sharded over both axes
+    (VERDICT r3 item 7 — the serving path, not just the dryrun)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+
+    long_vdaf = VdafInstance.sum_vec(length=16384, bits=8)  # input_len 131072
+    eng = engine_cache(long_vdaf, b"\x02" * 16)
+    assert eng.sp == 2
+    assert eng.mesh.shape["sp"] == 2
+
+    # run a leader init through the sharded step (content is random —
+    # this checks sharding/execution, not protocol validity)
+    rng = np.random.default_rng(8)
+    n = 4
+    circ = eng.p3.circ
+    nonce = rng.integers(0, 1 << 63, size=(n, 2), dtype=np.uint64)
+    parts = rng.integers(0, 1 << 63, size=(n, 2, 2), dtype=np.uint64)
+    meas = tuple(
+        rng.integers(0, 1 << 62, size=(n, circ.input_len), dtype=np.uint64) for _ in range(2)
+    )
+    proof = tuple(
+        rng.integers(0, 1 << 62, size=(n, circ.proof_len), dtype=np.uint64) for _ in range(2)
+    )
+    blind0 = rng.integers(0, 1 << 63, size=(n, 2), dtype=np.uint64)
+    out0, seed0, ver0, part0 = eng.leader_init(nonce, parts, meas, proof, blind0)
+    assert isinstance(out0, DeviceRows)
+    # the out-share rows live sharded over the (dp, sp) mesh
+    shard_mesh = out0.value[0].sharding.mesh
+    assert dict(shard_mesh.shape) == dict(eng.mesh.shape)
+    assert ver0[0].shape == (n, circ.verifier_len)

@@ -1,0 +1,129 @@
+"""Differential tests: streamed FLP query == whole-share query.
+
+flp_query_streamed (engine.py) must be field-element identical to
+flp_query_batched + truncate for both the helper (expanded-by-group)
+and leader (sliced) measurement sources, at sizes small enough for CPU.
+The production threshold (STREAM_MIN_INPUT_LEN) is monkeypatched down
+so the streamed path activates on toy circuits.
+"""
+
+import numpy as np
+import pytest
+
+from janus_tpu.vdaf import engine
+from janus_tpu.vdaf.prio3_jax import Prio3Batched, bytes_to_lane_batch
+from janus_tpu.vdaf.reference import Histogram, SumVec
+from janus_tpu.vdaf.registry import VdafInstance, prio3_batched
+
+
+def _mk(circ):
+    return Prio3Batched(circ)
+
+
+def _rand_lanes(rng, batch, n):
+    return rng.integers(0, 1 << 63, size=(batch, n), dtype=np.uint64)
+
+
+CIRCUITS = [
+    SumVec(40, 16, chunk_length=5),  # input_len 640; align lcm(7,16)/gcd(.,5)=112 calls... exercises call padding
+    SumVec(56, 8, chunk_length=7),  # chunk divisible by 7
+    Histogram(200, chunk_length=9),
+]
+
+
+@pytest.mark.parametrize("circ", CIRCUITS, ids=["sumvec-ch5", "sumvec-ch7", "histogram"])
+def test_streamed_equals_batched(circ, monkeypatch):
+    monkeypatch.setattr(engine, "STREAM_MIN_INPUT_LEN", 1)
+    p3 = _mk(circ)
+    bc = p3.bc
+    plan = engine.stream_plan(bc)
+    assert plan is not None
+    assert plan.group % 7 == 0  # XOF block alignment
+    rng = np.random.default_rng(42)
+    batch = 3
+    verify_key = bytes(range(16))
+    nonce = _rand_lanes(rng, batch, 2)
+    helper_seed = _rand_lanes(rng, batch, 2)
+    blind = _rand_lanes(rng, batch, 2) if p3.uses_joint_rand else None
+    public_parts = (
+        np.stack([_rand_lanes(rng, batch, 2), _rand_lanes(rng, batch, 2)], axis=1)
+        if p3.uses_joint_rand
+        else None
+    )
+
+    # helper: streamed (threshold=1) vs whole-share (threshold huge)
+    out_s, seed_s, ver_s, part_s = p3.prepare_init_helper(
+        verify_key, nonce, public_parts, helper_seed, blind
+    )
+    monkeypatch.setattr(engine, "STREAM_MIN_INPUT_LEN", 1 << 60)
+    out_u, seed_u, ver_u, part_u = p3.prepare_init_helper(
+        verify_key, nonce, public_parts, helper_seed, blind
+    )
+    for a, b in zip(out_s, out_u):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(ver_s, ver_u):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    if p3.uses_joint_rand:
+        np.testing.assert_array_equal(np.asarray(seed_s), np.asarray(seed_u))
+        np.testing.assert_array_equal(np.asarray(part_s), np.asarray(part_u))
+
+    # leader: meas/proof staged as device arrays
+    jf = p3.jf
+    meas = tuple(
+        rng.integers(0, 1 << 62, size=(batch, circ.input_len), dtype=np.uint64)
+        for _ in range(jf.LIMBS)
+    )
+    proof = tuple(
+        rng.integers(0, 1 << 62, size=(batch, circ.proof_len), dtype=np.uint64)
+        for _ in range(jf.LIMBS)
+    )
+    blind0 = _rand_lanes(rng, batch, 2) if p3.uses_joint_rand else None
+    monkeypatch.setattr(engine, "STREAM_MIN_INPUT_LEN", 1)
+    lo_s = p3.prepare_init_leader(verify_key, nonce, public_parts, meas, proof, blind0)
+    monkeypatch.setattr(engine, "STREAM_MIN_INPUT_LEN", 1 << 60)
+    lo_u = p3.prepare_init_leader(verify_key, nonce, public_parts, meas, proof, blind0)
+    for s, u in zip(lo_s, lo_u):
+        if s is None:
+            assert u is None
+            continue
+        if isinstance(s, tuple):
+            for a, b in zip(s, u):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        else:
+            np.testing.assert_array_equal(np.asarray(s), np.asarray(u))
+
+
+def test_full_two_party_step_streamed(monkeypatch):
+    """End-to-end: shard on the unstreamed path, prepare on the streamed
+    path, decide + aggregate — all reports accepted, sum correct."""
+    import jax
+
+    monkeypatch.setattr(engine, "STREAM_MIN_INPUT_LEN", 1)
+    inst = VdafInstance.sum_vec(length=21, bits=4)
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+    from janus_tpu.parallel.api import two_party_step
+
+    rng = np.random.default_rng(7)
+    meas = random_measurements(inst, 4, rng)
+    step_args, _ = make_report_batch(inst, meas, seed=3)
+    step = jax.jit(two_party_step(inst, bytes(range(16))))
+    agg0, agg1, count = step(*step_args)
+    assert int(count) == 4
+    p3 = prio3_batched(inst)
+    total = p3.merge_agg_shares(agg0, agg1)
+    vals = p3.jf.to_ints(total)
+    expected = np.asarray(meas).sum(axis=0)
+    np.testing.assert_array_equal(np.asarray([int(v) for v in vals]), expected)
+
+
+def test_stream_plan_gating():
+    """Plan geometry: alignment and activation threshold."""
+    bc_small = engine.batched_circuit(SumVec(10, 4))
+    assert engine.stream_plan(bc_small) is None  # below threshold
+    big = SumVec(100000, 16)
+    bc = engine.batched_circuit(big)
+    plan = engine.stream_plan(bc)
+    assert plan is not None
+    assert plan.group % 7 == 0 and plan.group % 16 == 0
+    assert plan.n_steps * plan.gcalls >= bc.calls
+    assert plan.gcalls * (plan.n_steps - 1) < bc.calls  # no empty tail step
