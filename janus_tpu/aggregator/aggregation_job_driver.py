@@ -417,7 +417,7 @@ class AggregationJobDriver:
 
         from .poplar1_ops import Poplar1Ops
 
-        pop = Poplar1Ops(task.vdaf.bits)
+        pop = Poplar1Ops(task.vdaf.bits, task.vdaf_verify_key)
         param = pop.decode_param(job.aggregation_parameter)
         F = pop.field_for(param)
 
@@ -431,15 +431,15 @@ class AggregationJobDriver:
 
         n = len(pending)
         failed: list = [None] * n
-        evals: dict[int, tuple] = {}  # i -> (y0, total0)
+        evals: dict[int, tuple] = {}  # i -> (prep state, y0, [A0, B0])
         for i, ra in enumerate(pending):
             rep = reports.get(ra.report_id.data)
             if rep is None:
                 failed[i] = PrepareError.REPORT_DROPPED
                 continue
             try:
-                evals[i] = pop.eval_share(
-                    0, rep.public_share, rep.leader_input_share, param
+                evals[i] = pop.round1(
+                    0, rep.public_share, rep.leader_input_share, param, ra.report_id.data
                 )
             except ValueError:
                 failed[i] = PrepareError.INVALID_MESSAGE
@@ -450,7 +450,7 @@ class AggregationJobDriver:
             if failed[i] is not None:
                 continue
             rep = reports[ra.report_id.data]
-            _, total0 = evals[i]
+            _, _, msg1_0 = evals[i]
             prep_inits.append(
                 PrepareInit(
                     ReportShare(
@@ -458,7 +458,7 @@ class AggregationJobDriver:
                         rep.public_share,
                         rep.helper_encrypted_input_share,
                     ),
-                    encode_pingpong(PP_INITIALIZE, None, pop.encode_elem(param, total0)),
+                    encode_pingpong(PP_INITIALIZE, None, pop.encode_vec(param, msg1_0)),
                 )
             )
             send_idx.append(i)
@@ -486,20 +486,24 @@ class AggregationJobDriver:
                     tag, prep_msg, helper_share = decode_pingpong(pr.result.message)
                     if tag != PP_CONTINUE or helper_share is None:
                         raise DecodeError("expected ping-pong continue")
-                    total1 = pop.decode_elem(param, helper_share)
+                    es = pop.enc_size(param)
+                    # helper share = enc(A1)||enc(B1)||enc(sigma1)
+                    msg1_1 = pop.decode_fixed_vec(param, helper_share[: 2 * es], 2)
+                    sigma1 = pop.decode_elem(param, helper_share[2 * es :])
                 except (DecodeError, ValueError):
                     failed[i] = PrepareError.INVALID_MESSAGE
                     continue
-                y0, total0 = evals[i]
-                combined = F.add(total0, total1)
-                # the helper's claimed prep message must equal our own
-                # combination, and the sketch must verify
-                if prep_msg != pop.encode_elem(param, combined) or not pop.sketch_valid(
-                    param, combined
-                ):
+                st0, y0, msg1_0 = evals[i]
+                sigma0, combined = pop.round2(st0, msg1_0, msg1_1)
+                # the helper's claimed round-1 prep message must equal our
+                # own combination, and the quadratic sketch must verify
+                # (sigma0 + sigma1 == 0 <=> y one-hot or all-zero)
+                if prep_msg != pop.encode_vec(param, combined) or F.add(
+                    sigma0, sigma1
+                ) != 0:
                     failed[i] = PrepareError.VDAF_PREP_ERROR
                     continue
-                msg = encode_pingpong(PP_FINISH, pop.encode_elem(param, combined), None)
+                msg = encode_pingpong(PP_FINISH, pop.encode_elem(param, sigma0), None)
                 parked[i] = (
                     len(msg).to_bytes(4, "big") + msg + pop.encode_vec(param, y0)
                 )

@@ -102,6 +102,9 @@ class Config:
     max_upload_batch_write_delay_ms: int = 0
     batch_aggregation_shard_count: int = 1
     taskprov_enabled: bool = False
+    # Retry-After (seconds) on 202 collection-job polls; the collector
+    # honors it (reference collector/src/lib.rs:466)
+    collection_retry_after_s: int = 1
 
 
 class TaskAggregator:
@@ -116,7 +119,7 @@ class TaskAggregator:
             self.circ = None
             self.wire = None
             self.engine = None
-            self.poplar = Poplar1Ops(task.vdaf.bits)
+            self.poplar = Poplar1Ops(task.vdaf.bits, task.vdaf_verify_key)
         else:
             self.circ = circuit_for(task.vdaf)
             self.wire = Prio3Wire(self.circ)
@@ -180,7 +183,7 @@ class TaskAggregator:
                 )
                 payload = PlaintextInputShare.from_bytes(plaintext).payload
                 if self.poplar is not None:
-                    self.poplar.validate_shares(report.public_share, payload)
+                    self.poplar.validate_shares(report.public_share, payload, party=0)
                 else:
                     # columnar validation, not scalar decode: the full
                     # Python decode was the measured upload bottleneck
@@ -517,23 +520,24 @@ class TaskAggregator:
                         plaintext = None
                     if err is None:
                         try:
-                            seed = PlaintextInputShare.from_bytes(plaintext).payload
-                            pop.validate_shares(rs.public_share, seed)
+                            payload = PlaintextInputShare.from_bytes(plaintext).payload
                             tag, _, leader_ps = decode_pingpong(pi.message)
                             if tag != PP_INITIALIZE or leader_ps is None:
                                 raise ValueError("expected ping-pong initialize")
-                            total0 = pop.decode_elem(param, leader_ps)
-                            y1, total1 = pop.eval_share(1, rs.public_share, seed, param)
-                            combined = F.add(total0, total1)
-                            if not pop.sketch_valid(param, combined):
-                                err = PrepareError.VDAF_PREP_ERROR
-                            else:
-                                msg = pop.encode_elem(param, combined)
-                                blob = msg + pop.encode_elem(param, total1) + pop.encode_vec(param, y1)
-                                state = ReportAggregationState.WAITING_HELPER
-                                result = PrepareStepResult.cont(
-                                    encode_pingpong(PP_CONTINUE, msg, pop.encode_elem(param, total1))
-                                )
+                            msg1_0 = pop.decode_fixed_vec(param, leader_ps, 2)
+                            st1, y1, msg1_1 = pop.round1(
+                                1, rs.public_share, payload, param, md.report_id.data
+                            )
+                            sigma1, combined = pop.round2(st1, msg1_0, msg1_1)
+                            # sketch verdict needs the leader's sigma0:
+                            # park; validity resolves at continue time
+                            msg = pop.encode_vec(param, combined)
+                            share = pop.encode_vec(param, msg1_1) + pop.encode_elem(param, sigma1)
+                            blob = msg + share + pop.encode_vec(param, y1)
+                            state = ReportAggregationState.WAITING_HELPER
+                            result = PrepareStepResult.cont(
+                                encode_pingpong(PP_CONTINUE, msg, share)
+                            )
                         except (DecodeError, ValueError):
                             err = PrepareError.INVALID_MESSAGE
             if err is not None:
@@ -584,12 +588,13 @@ class TaskAggregator:
             "agg_init_replay_resp",
         )
         if self.poplar is not None:
+            # blob = enc(A)||enc(B) || enc(A1)||enc(B1)||enc(sigma1) || y1
             param = self.poplar.decode_param(job.aggregation_parameter)
             es = self.poplar.enc_size(param)
-            msg_len = es
+            msg_len = 2 * es
 
             def round1_share(blob):
-                return blob[es : 2 * es]
+                return blob[2 * es : 5 * es]
         else:
             msg_len = 16 if self.wire.uses_jr else 0
 
@@ -667,25 +672,40 @@ class TaskAggregator:
                 )
 
             ras = tx.get_report_aggregations_for_job(task.task_id, job_id)
-            waiting = [
+            all_waiting = [
                 ra for ra in ras if ra.state == ReportAggregationState.WAITING_HELPER
             ]
-            # ord-matched: the leader's prepare steps must be exactly the
-            # waiting reports, in ord order (reference :58-84 rejects
-            # unexpected, duplicate, or out-of-order steps)
-            if [pc.report_id for pc in req.prepare_continues] != [
-                ra.report_id for ra in waiting
-            ]:
-                raise errors.InvalidMessage(
-                    "leader sent unexpected, duplicate, or out-of-order prepare steps",
-                    task.task_id,
-                )
+            # ord-matched subsequence (reference :58-84): the leader's
+            # prepare steps must appear in the helper's ord order; a
+            # waiting report the leader omitted (failed on its side) is
+            # marked ReportDropped; unexpected/duplicate/out-of-order
+            # steps reject the request
+            waiting = []
+            dropped = []
+            it = iter(all_waiting)
+            for pc in req.prepare_continues:
+                for ra in it:
+                    if ra.report_id == pc.report_id:
+                        waiting.append(ra)
+                        break
+                    dropped.append(ra)
+                else:
+                    raise errors.InvalidMessage(
+                        "leader sent unexpected, duplicate, or out-of-order prepare steps",
+                        task.task_id,
+                    )
+            dropped.extend(it)  # trailing omissions
 
+            pop_sigma1_at = None
             if self.poplar is not None:
-                # blob = enc(combined) || enc(total1) || enc(y_shares)
+                # blob = enc(A)||enc(B) || enc(A1)||enc(B1)||enc(sigma1) || y1
                 param = self.poplar.decode_param(job.aggregation_parameter)
                 es = self.poplar.enc_size(param)
-                msg_len, skip_len = es, 2 * es
+                msg_len, skip_len = es, 5 * es  # FINISH msg = enc(sigma0)
+
+                def pop_sigma1_at(blob):
+                    return blob[4 * es : 5 * es]
+
                 field = self.poplar.field_for(param)
             else:
                 msg_len = 16 if self.wire.uses_jr else 0
@@ -705,8 +725,17 @@ class TaskAggregator:
                 ok = False
                 try:
                     tag, prep_msg, _share = decode_pingpong(pc.message)
-                    ok = tag == PP_FINISH and (prep_msg or b"") == ra.prep_blob[:msg_len]
-                except DecodeError:
+                    if tag != PP_FINISH:
+                        ok = False
+                    elif pop_sigma1_at is not None:
+                        # quadratic sketch: FINISH carries the leader's
+                        # sigma0; accept iff sigma0 + sigma1 == 0
+                        sigma0 = self.poplar.decode_elem(param, prep_msg or b"")
+                        sigma1 = self.poplar.decode_elem(param, pop_sigma1_at(ra.prep_blob))
+                        ok = field.add(sigma0, sigma1) == 0
+                    else:
+                        ok = (prep_msg or b"") == ra.prep_blob[:msg_len]
+                except (DecodeError, ValueError):
                     ok = False
                 if ok:
                     out_share = accumulator.field.decode_vec(ra.prep_blob[skip_len:])
@@ -740,6 +769,10 @@ class TaskAggregator:
                     last_request_hash=request_hash,
                 )
             )
+            for ra in dropped:
+                # waiting rows the leader omitted (failed on its side):
+                # reference marks them ReportDropped (:72-81)
+                tx.update_report_aggregation(ra.failed(PrepareError.REPORT_DROPPED))
             for ra in updated:
                 tx.update_report_aggregation(
                     ra.failed(PrepareError.BATCH_COLLECTED)

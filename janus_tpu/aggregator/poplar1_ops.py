@@ -5,24 +5,28 @@ through DAP: nontrivial aggregation parameters are unsupported
 (README.md:9-11; `VdafHasAggregationParameter`,
 aggregator_core/src/lib.rs:44). This module is the missing plumbing —
 per-(level, prefixes) parameter handling for upload validation, helper
-prepare (the sketch exchange mapped onto ping-pong), the leader
+prepare (the quadratic sketch mapped onto ping-pong), the leader
 driver, and the collection-driven aggregation-job creation.
 
 Protocol mapping onto DAP ping-pong (2 rounds, the same shape the
-continue machinery already serves for the two-round fake):
+continue machinery already serves for the two-round fake). es = the
+level field's encoded size; sketch algebra in vdaf.poplar1:
 
-  - leader init: evaluates its IDPF key share at the parameter's
-    prefixes -> y0 (count shares) + sketch share total0;
-    PrepareInit.message = PP_INITIALIZE(prep_share=enc(total0)).
-  - helper init: evaluates -> y1, total1; combined = total0 + total1
-    must reconstruct to 0 (pruned path) or 1 (one-hot path); invalid
-    reports reject NOW; valid ones park WAITING_HELPER with
-    prep_blob = enc(combined) || enc(total1) || enc(y1) and answer
-    PP_CONTINUE(prep_msg=enc(combined), prep_share=enc(total1)).
-  - leader continue: re-derives combined from its own total0 + the
-    helper's total1, verifies the sketch, parks WAITING_LEADER, then
-    sends PP_FINISH(enc(combined)); the helper's ord-matched continue
-    compares it against prep_blob[:enc_size] and accumulates y1.
+  - leader init: IDPF-evaluates its key at the parameter's prefixes
+    and computes its round-1 sketch share [A0, B0];
+    PrepareInit.message = PP_INITIALIZE(prep_share = enc(A0)||enc(B0)).
+  - helper init: evaluates -> y1 + [A1, B1]; combines A = A0+A1,
+    B = B0+B1 and computes its round-2 share sigma1. Parks
+    WAITING_HELPER with prep_blob =
+    enc(A)||enc(B) || enc(A1)||enc(B1)||enc(sigma1) || enc(y1) and
+    answers PP_CONTINUE(prep_msg = enc(A)||enc(B),
+    prep_share = enc(A1)||enc(B1)||enc(sigma1)).
+  - leader continue: recomputes (A, B) from its own [A0, B0] + the
+    helper's [A1, B1], verifies them against the helper's claimed
+    prep_msg, computes sigma0, checks sigma0 + sigma1 == 0, parks
+    WAITING_LEADER, then sends PP_FINISH(enc(sigma0)); the helper's
+    ord-matched continue recomputes sigma from its stored sigma1 and
+    accumulates y1 iff sigma == 0 (symmetric verification).
 
 Host-side per-report loops (like the reference's own prepare loops) —
 heavy-hitters batches are small; the TPU path stays Prio3's.
@@ -32,18 +36,21 @@ from __future__ import annotations
 
 from ..vdaf.poplar1 import (
     Idpf,
-    IdpfKey,
+    Poplar1,
     Poplar1AggParam,
     decode_input_share,
     decode_public_share,
 )
+from ..vdaf.poplar1 import SEED_SIZE
 
 
 class Poplar1Ops:
-    def __init__(self, bits: int):
+    def __init__(self, bits: int, verify_key: bytes = b"\x00" * SEED_SIZE):
         assert bits > 0, "poplar1 task missing bit length"
         self.bits = bits
         self.idpf = Idpf(bits)
+        self.poplar = Poplar1(bits)
+        self.verify_key = verify_key
 
     # --- aggregation parameter ---
     def decode_param(self, raw: bytes) -> Poplar1AggParam:
@@ -66,27 +73,27 @@ class Poplar1Ops:
         return self.field_for(param).ENCODED_SIZE
 
     # --- share handling ---
-    def validate_shares(self, public_share: bytes, input_share_payload: bytes) -> None:
-        decode_public_share(self.bits, public_share)
-        if len(input_share_payload) != 16:
-            raise ValueError("poplar1 input share must be a 16-byte root seed")
-
-    def eval_share(
-        self, party: int, public_share: bytes, root_seed: bytes, param: Poplar1AggParam
-    ):
-        """-> (y_shares [per prefix], total [sketch share]) as field ints."""
-        F = self.field_for(param)
+    def validate_shares(self, public_share: bytes, input_share_payload: bytes, party: int) -> None:
         cws = decode_public_share(self.bits, public_share)
-        key = decode_input_share(self.bits, cws, root_seed)
-        vals = self.idpf.eval_prefixes(party, key, param.level, list(param.prefixes))
-        y = [v[0] for v in vals]
-        total = 0
-        for v in y:
-            total = F.add(total, v)
-        return y, total
+        decode_input_share(self.bits, cws, input_share_payload, party)
 
-    def sketch_valid(self, param: Poplar1AggParam, combined: int) -> bool:
-        return combined in (0, 1)
+    def _key(self, party: int, public_share: bytes, payload: bytes):
+        cws = decode_public_share(self.bits, public_share)
+        return decode_input_share(self.bits, cws, payload, party)
+
+    def round1(self, party: int, public_share: bytes, payload: bytes, param, nonce: bytes):
+        """-> (prep state, y_shares, [A_share, B_share])."""
+        key = self._key(party, public_share, payload)
+        state, msg1 = self.poplar.prepare_init(party, key, param, self.verify_key, nonce)
+        return state, state.y_shares, msg1
+
+    def round2(self, state, msg1_leader, msg1_helper):
+        """-> (sigma_share, combined [A, B])."""
+        F = state.field
+        state, msg2 = self.poplar.prepare_next(state, [msg1_leader, msg1_helper])
+        A = F.add(msg1_leader[0], msg1_helper[0])
+        B = F.add(msg1_leader[1], msg1_helper[1])
+        return msg2[0], [A, B]
 
     # --- codecs ---
     def encode_elem(self, param: Poplar1AggParam, x: int) -> bytes:
@@ -105,7 +112,10 @@ class Poplar1Ops:
         return b"".join(self.encode_elem(param, x) for x in xs)
 
     def decode_vec(self, param: Poplar1AggParam, raw: bytes) -> list[int]:
+        return self.decode_fixed_vec(param, raw, len(param.prefixes))
+
+    def decode_fixed_vec(self, param: Poplar1AggParam, raw: bytes, n: int) -> list[int]:
         es = self.enc_size(param)
-        if len(raw) != es * len(param.prefixes):
-            raise ValueError("poplar1 out-share length mismatch")
+        if len(raw) != es * n:
+            raise ValueError("poplar1 vector length mismatch")
         return [self.decode_elem(param, raw[i : i + es]) for i in range(0, len(raw), es)]

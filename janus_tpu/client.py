@@ -113,8 +113,8 @@ class Client:
 
             cws, (k0, k1) = self.poplar.shard(measurement)
             public_share = encode_public_share(self.poplar.bits, cws)
-            leader_raw = encode_input_share(k0)
-            helper_raw = encode_input_share(k1)
+            leader_raw = encode_input_share(k0, 0, self.poplar.bits)
+            helper_raw = encode_input_share(k1, 1, self.poplar.bits)
         else:
             public_share_parts, (leader_share, helper_share) = self.prio3.shard(
                 measurement, report_id.data

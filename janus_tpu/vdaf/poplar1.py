@@ -19,13 +19,18 @@ Design (draft-irtf-cfrg-vdaf Poplar1, re-derived):
   prefix of alpha else 0). Each tree level's value is a vector
   (1, alpha_extra) in a level field: inner levels use Field64,
   the leaf level Field128 (the draft's field split).
-- **Sketch**: one exchange of masked sums verifying
-  sum_p y_p == 1 over the queried prefixes — a linear sketch that
-  rejects malformed multi-path keys against covert clients. (The
-  draft's full quadratic sketch with client-supplied correlated
-  randomness also bounds each y_p to {0,1} against fully malicious
-  clients; that strengthening is noted as future work and does not
-  change any interface here.)
+- **Sketch**: the draft's full quadratic sketch with client-supplied
+  correlated randomness. Per level the client provides additive shares
+  of random (a, b) and of c = a^2 + b (leader explicit, helper derived
+  from a seed). With verify-randomness r_p per queried prefix (derived
+  by the aggregators from the shared verify key + report nonce, so the
+  client cannot predict it), the aggregators reveal the masked sums
+  A = a + SUM r_p y_p and B = b + SUM r_p^2 y_p, then exchange shares
+  of sigma = A^2 - B - 2*A*a + c  (= Z^2 - W for Z = SUM r_p y_p,
+  W = SUM r_p^2 y_p) and accept iff sigma == 0. This holds exactly when
+  y is all-zero (pruned path) or one-hot with value 1; a forged vector
+  like (2, -1, 0, ...) — which passes a bare sum check — makes
+  sigma = 2(r_0 - r_1)^2 != 0 w.h.p. (tested in test_poplar1.py).
 - **Aggregation parameter**: (level, list of prefixes). The collector
   walks levels, keeping heavy prefixes — the classic Poplar
   heavy-hitters loop (tested in test_poplar1.py).
@@ -48,6 +53,8 @@ ALGO_ID = 0x00001000  # matches the reference's declared codepoint
 
 USAGE_CONVERT = 5
 USAGE_EXTEND = 6
+USAGE_VERIFY_RAND = 7
+USAGE_CORR_RAND = 8
 
 
 def _xof_vec(field, seed: bytes, usage: int, binder: bytes, length: int):
@@ -73,11 +80,38 @@ def _convert(field, seed: bytes, length: int) -> tuple[bytes, list[int]]:
 
 @dataclass
 class IdpfKey:
-    """One party's IDPF key: root seed + per-level correction words."""
+    """One party's IDPF key: root seed + per-level correction words +
+    the sketch's correlated randomness (leader: explicit per-level
+    (a, b, c) shares; helper: a 16-byte seed they derive from)."""
 
     root_seed: bytes
     # per level: (seed_cw, bit_cw_L, bit_cw_R, value_cw)
     correction_words: list
+    # leader (party 0): list of per-level (a_share, b_share, c_share);
+    # helper (party 1): 16-byte corr seed. None only for legacy tests.
+    corr: object = None
+
+
+def corr_from_seed(bits: int, corr_seed: bytes, level: int):
+    """The helper's per-level (a, b, c) share, derived from its seed."""
+    F = Field128 if level == bits - 1 else Field64
+    vec = _xof_vec(F, corr_seed, USAGE_CORR_RAND, level.to_bytes(2, "big"), 3)
+    return tuple(vec)
+
+
+def verify_rand(bits: int, verify_key: bytes, nonce: bytes, param: "Poplar1AggParam"):
+    """Per-prefix sketch randomness r_p, shared by both aggregators and
+    unpredictable to the client: XOF(verify_key, nonce || level ||
+    H(prefixes))."""
+    import hashlib
+
+    F = Field128 if param.level == bits - 1 else Field64
+    binder = (
+        nonce
+        + param.level.to_bytes(2, "big")
+        + hashlib.sha256(b"".join(p.to_bytes(16, "big") for p in param.prefixes)).digest()[:8]
+    )
+    return _xof_vec(F, verify_key, USAGE_VERIFY_RAND, binder, len(param.prefixes))
 
 
 class Idpf:
@@ -212,15 +246,18 @@ class _PrepState:
     field: object
     y_shares: list  # per-prefix count share
     party: int
-    verify_share: list  # sketch verification share (round 1 message)
+    a_share: int  # correlated-randomness shares for this level
+    c_share: int
+    sigma_share: int | None = None  # set after prepare_next
 
 
 class Poplar1:
-    """Host Poplar1: shard / prepare (sketch) / aggregate / unshard.
+    """Host Poplar1: shard / prepare (quadratic sketch, 2 exchange
+    rounds) / aggregate / unshard.
 
-    Two aggregators (leader=0, helper=1); one prepare round of sketch
-    verification per the simplified sketch: the aggregators exchange
-    masked sums proving sum(y) == 1 without revealing which prefix.
+    Two aggregators (leader=0, helper=1). Round 1 reveals the masked
+    sums (A, B); round 2 reveals sigma = Z^2 - W (module docstring),
+    which is 0 iff the y vector is all-zero or one-hot with value 1.
     """
 
     NUM_SHARES = 2
@@ -231,30 +268,73 @@ class Poplar1:
 
     # --- client ---
     def shard(self, measurement: int):
-        """measurement: the alpha bit string as an int < 2^bits."""
+        """measurement: the alpha bit string as an int < 2^bits.
+
+        Key 0 (leader) carries explicit per-level (a, b, c) correlated-
+        randomness shares; key 1 (helper) derives its shares from a
+        seed — constant wire size for the helper, like the draft."""
         cws, k0, k1 = self.idpf.gen(measurement)
+        corr_seed = secrets.token_bytes(SEED_SIZE)
+        leader_corr = []
+        for level in range(self.bits):
+            F = self.idpf.field_at(level)
+            a = int.from_bytes(secrets.token_bytes(16), "big") % F.MODULUS
+            b = int.from_bytes(secrets.token_bytes(16), "big") % F.MODULUS
+            c = F.add(F.mul(a, a), b)  # c = a^2 + b
+            a1, b1, c1 = corr_from_seed(self.bits, corr_seed, level)
+            leader_corr.append((F.sub(a, a1), F.sub(b, b1), F.sub(c, c1)))
+        k0.corr = leader_corr
+        k1.corr = corr_seed
         return cws, (k0, k1)
 
+    def _corr_at(self, party: int, key: IdpfKey, level: int):
+        if party == 0:
+            return key.corr[level]
+        return corr_from_seed(self.bits, key.corr, level)
+
     # --- aggregator ---
-    def prepare_init(self, party: int, key: IdpfKey, agg_param: Poplar1AggParam):
+    def prepare_init(
+        self, party: int, key: IdpfKey, agg_param: Poplar1AggParam,
+        verify_key: bytes = b"\x00" * SEED_SIZE, nonce: bytes = b"",
+    ):
+        """-> (state, round-1 message [A_share, B_share])."""
         F = self.idpf.field_at(agg_param.level)
         vals = self.idpf.eval_prefixes(party, key, agg_param.level, list(agg_param.prefixes))
         y = [v[0] for v in vals]
-        # sketch round 1: share of sum(y) (should reconstruct to 1)
-        total = 0
-        for v in y:
-            total = F.add(total, v)
-        return _PrepState(F, y, party, [total]), [total]
+        r = verify_rand(self.bits, verify_key, nonce, agg_param)
+        z = 0  # share of Z = SUM r_p y_p
+        w = 0  # share of W = SUM r_p^2 y_p
+        for rp, yp in zip(r, y):
+            z = F.add(z, F.mul(rp, yp))
+            w = F.add(w, F.mul(F.mul(rp, rp), yp))
+        a_sh, b_sh, c_sh = self._corr_at(party, key, agg_param.level)
+        state = _PrepState(F, y, party, a_sh, c_sh)
+        return state, [F.add(z, a_sh), F.add(w, b_sh)]
 
-    def prepare_finish(self, state: _PrepState, msgs: list[list[int]]):
+    def prepare_next(self, state: _PrepState, round1_msgs: list[list[int]]):
+        """Combine round-1 messages -> (state, round-2 msg [sigma_share])."""
         F = state.field
-        total = 0
-        for m in msgs:
-            total = F.add(total, m[0])
-        # 1 = client's path intersects the queried prefixes; 0 = the
-        # client was pruned out at an earlier level (legitimate)
-        if total not in (0, 1):
-            raise VdafError("poplar1 sketch failed: not a one-hot path")
+        A = 0
+        B = 0
+        for m in round1_msgs:
+            A = F.add(A, m[0])
+            B = F.add(B, m[1])
+        sigma = F.sub(F.mul(2 % F.MODULUS, F.mul(A, state.a_share)), state.c_share)
+        sigma = F.neg(sigma)  # -2*A*a_share + c_share
+        if state.party == 0:
+            sigma = F.add(sigma, F.sub(F.mul(A, A), B))
+        state.sigma_share = sigma
+        return state, [sigma]
+
+    def prepare_finish(self, state: _PrepState, round2_msgs: list[list[int]]):
+        F = state.field
+        sigma = 0
+        for m in round2_msgs:
+            sigma = F.add(sigma, m[0])
+        # sigma = Z^2 - W: zero iff y is all-zero (pruned path) or
+        # one-hot with value 1
+        if sigma != 0:
+            raise VdafError("poplar1 sketch failed: y is not one-hot")
         return state.y_shares
 
     # --- aggregation ---
@@ -323,28 +403,66 @@ def decode_public_share(bits: int, raw: bytes) -> list:
     return cws
 
 
-def encode_input_share(key: IdpfKey) -> bytes:
-    return key.root_seed
+def _leader_corr_size(bits: int) -> int:
+    idpf = Idpf(bits)
+    return sum(3 * idpf.field_at(level).ENCODED_SIZE for level in range(bits))
 
 
-def decode_input_share(bits: int, cws: list, raw: bytes) -> IdpfKey:
-    if len(raw) != SEED_SIZE:
-        raise ValueError("poplar1 input share must be one 16-byte root seed")
-    return IdpfKey(raw, cws)
+def encode_input_share(key: IdpfKey, party: int, bits: int) -> bytes:
+    """Party 0: root_seed || per-level explicit (a, b, c) shares;
+    party 1: root_seed || corr_seed."""
+    if party == 1:
+        return key.root_seed + key.corr
+    idpf = Idpf(bits)
+    out = bytearray(key.root_seed)
+    for level, (a, b, c) in enumerate(key.corr):
+        es = idpf.field_at(level).ENCODED_SIZE
+        for v in (a, b, c):
+            out += int(v).to_bytes(es, "little")
+    return bytes(out)
 
 
-def heavy_hitters(poplar: Poplar1, keys0, keys1, threshold: int) -> list[int]:
+def decode_input_share(bits: int, cws: list, raw: bytes, party: int) -> IdpfKey:
+    if party == 1:
+        if len(raw) != 2 * SEED_SIZE:
+            raise ValueError("poplar1 helper input share must be root seed + corr seed")
+        return IdpfKey(raw[:SEED_SIZE], cws, corr=raw[SEED_SIZE:])
+    if len(raw) != SEED_SIZE + _leader_corr_size(bits):
+        raise ValueError("poplar1 leader input share length mismatch")
+    idpf = Idpf(bits)
+    corr = []
+    off = SEED_SIZE
+    for level in range(bits):
+        F = idpf.field_at(level)
+        es = F.ENCODED_SIZE
+        vals = []
+        for _ in range(3):
+            v = int.from_bytes(raw[off : off + es], "little")
+            if v >= F.MODULUS:
+                raise ValueError("poplar1 correlated randomness out of range")
+            vals.append(v)
+            off += es
+        corr.append(tuple(vals))
+    return IdpfKey(raw[:SEED_SIZE], cws, corr=corr)
+
+
+def heavy_hitters(
+    poplar: Poplar1, keys0, keys1, threshold: int, verify_key: bytes = b"\x00" * SEED_SIZE
+) -> list[int]:
     """The classic Poplar loop: walk levels keeping prefixes whose count
     reaches the threshold; returns the heavy alpha values."""
     prefixes = [0, 1]
     for level in range(poplar.bits):
         agg_param = Poplar1AggParam(level, tuple(prefixes))
         out0, out1 = [], []
-        for k0, k1 in zip(keys0, keys1):
-            st0, m0 = poplar.prepare_init(0, k0, agg_param)
-            st1, m1 = poplar.prepare_init(1, k1, agg_param)
-            out0.append(poplar.prepare_finish(st0, [m0, m1]))
-            out1.append(poplar.prepare_finish(st1, [m0, m1]))
+        for i, (k0, k1) in enumerate(zip(keys0, keys1)):
+            nonce = i.to_bytes(16, "big")
+            st0, m0 = poplar.prepare_init(0, k0, agg_param, verify_key, nonce)
+            st1, m1 = poplar.prepare_init(1, k1, agg_param, verify_key, nonce)
+            st0, s0 = poplar.prepare_next(st0, [m0, m1])
+            st1, s1 = poplar.prepare_next(st1, [m0, m1])
+            out0.append(poplar.prepare_finish(st0, [s0, s1]))
+            out1.append(poplar.prepare_finish(st1, [s0, s1]))
         counts = poplar.unshard(
             agg_param,
             [poplar.aggregate(agg_param, out0), poplar.aggregate(agg_param, out1)],

@@ -165,10 +165,24 @@ def test_continue_step_and_order_validation(pair):
     status, body = http.post(url, bad2.to_bytes(), headers)
     assert status == 400 and b"stepMismatch" in body
 
-    # right step but wrong (empty) prepare set: ord-match rejection
-    bad_empty = AggregationJobContinueReq(AggregationJobStep(1), ())
-    status, body = http.post(url, bad_empty.to_bytes(), headers)
+    # right step but an unknown report id: ord-match rejection (the
+    # reference accepts leader-OMITTED rows as ReportDropped but rejects
+    # steps addressing reports it is not waiting on,
+    # aggregation_job_continue.rs:58-84)
+    from janus_tpu.messages import PrepareContinue, ReportId
+    from janus_tpu.vdaf.wire import PP_FINISH, encode_pingpong
+
+    bad_unknown = AggregationJobContinueReq(
+        AggregationJobStep(1),
+        (
+            PrepareContinue(
+                ReportId(b"\xee" * 16), encode_pingpong(PP_FINISH, b"", None)
+            ),
+        ),
+    )
+    status, body = http.post(url, bad_unknown.to_bytes(), headers)
     assert status == 400 and b"invalidMessage" in body
+    bad_empty = AggregationJobContinueReq(AggregationJobStep(1), ())
 
     # drive the real continue; capture the leader's request bytes
     assert jd.run_once() == 1

@@ -168,13 +168,13 @@ def test_poplar1_invalid_report_rejected(pair):
         leader_ct = hpke_seal(
             self.leader_hpke_config,
             HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
-            PlaintextInputShare((), encode_input_share(k0_a)).to_bytes(),
+            PlaintextInputShare((), encode_input_share(k0_a, 0, BITS)).to_bytes(),
             aad,
         )
         helper_ct = hpke_seal(
             self.helper_hpke_config,
             HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
-            PlaintextInputShare((), encode_input_share(k1_b)).to_bytes(),
+            PlaintextInputShare((), encode_input_share(k1_b, 1, BITS)).to_bytes(),
             aad,
         )
         return dataclasses.replace(
