@@ -39,6 +39,9 @@ class AggregationJobCreatorConfig:
 
     min_aggregation_job_size: int = 1
     max_aggregation_job_size: int = 1024
+    # worker threads for the per-task sweep (the reference runs a tokio
+    # task per DAP task, aggregation_job_creator.rs:210); 1 = serial
+    max_concurrent_tasks: int = 8
 
 
 class AggregationJobCreator:
@@ -47,19 +50,30 @@ class AggregationJobCreator:
         self.cfg = cfg or AggregationJobCreatorConfig()
 
     def run_once(self) -> int:
-        """Sweep all leader tasks once; returns number of jobs created."""
+        """Sweep all leader tasks once; returns number of jobs created.
+
+        Tasks sweep concurrently in a thread pool (the reference spawns
+        one worker per task, aggregation_job_creator.rs:210); each
+        task's claim/write transactions are independent, so cross-task
+        serialization would bound many-task deployments by the slowest
+        task."""
         tasks = self.ds.run_tx(lambda tx: tx.get_tasks(), "creator_tasks")
-        created = 0
-        for task in tasks:
-            if task.role != Role.LEADER:
-                continue
-            if task.vdaf.has_aggregation_parameter:
-                # parameterized VDAFs (Poplar1): reports aggregate once
-                # PER collection parameter; jobs are created by the
-                # collection job driver when the parameter is known
-                continue
-            created += self.create_jobs_for_task(task)
-        return created
+        eligible = [
+            t
+            for t in tasks
+            if t.role == Role.LEADER
+            # parameterized VDAFs (Poplar1): reports aggregate once PER
+            # collection parameter; jobs are created by the collection
+            # job driver when the parameter is known
+            and not t.vdaf.has_aggregation_parameter
+        ]
+        if len(eligible) <= 1 or self.cfg.max_concurrent_tasks <= 1:
+            return sum(self.create_jobs_for_task(t) for t in eligible)
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = min(self.cfg.max_concurrent_tasks, len(eligible))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return sum(pool.map(self.create_jobs_for_task, eligible))
 
     def create_jobs_for_task(self, task: Task) -> int:
         if task.query_type.code == TimeInterval.CODE:

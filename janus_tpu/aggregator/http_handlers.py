@@ -147,7 +147,8 @@ class DapHttpApp:
             self.agg.check_aggregator_auth(ta.task, headers)
 
     def handle(self, method: str, path: str, query: dict, headers, body: bytes):
-        """-> (status, content_type, body_bytes). Wraps _handle with the
+        """-> (status, content_type, body_bytes, extra_headers). Wraps
+        _handle (whose handlers may return 3- or 4-tuples) with the
         per-route request counter/latency histogram (the analog of the
         reference's per-status metrics, http_handlers.rs:266)."""
         from time import monotonic
@@ -175,6 +176,8 @@ class DapHttpApp:
             reset_traceparent(tp_token)
         metrics.http_request_duration.observe(monotonic() - start, route=route)
         metrics.http_request_counter.add(route=route, status=str(result[0]))
+        if len(result) == 3:
+            result = result + ({},)
         return result
 
     def _handle(self, method: str, path: str, query: dict, headers, body: bytes):
@@ -314,7 +317,10 @@ class DapHttpApp:
         self.agg.check_collector_auth(ta.task, headers)
         ready, collection = ta.handle_get_collection_job(self.agg.ds, cj_id)
         if not ready:
-            return 202, "text/plain", b""
+            # advise the poll cadence (reference collector honors this,
+            # collector/src/lib.rs:466; leader-side emission analog of
+            # aggregator_api's job-poll hint)
+            return 202, "text/plain", b"", {"Retry-After": str(self.agg.cfg.collection_retry_after_s)}
         return 200, "application/dap-collection", collection.to_bytes()
 
     def h_collection_delete(self, match, query, headers, body):
@@ -356,17 +362,19 @@ class DapServer:
                 query = dict(parse_qsl(parts.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                status, ctype, out = outer.app.handle(
+                status, ctype, out, extra = outer.app.handle(
                     method, parts.path, query, dict(self.headers.items()), body
                 )
-                self._reply(status, ctype, out, method)
+                self._reply(status, ctype, out, method, extra)
 
-            def _reply(self, status, ctype, out, method="GET"):
+            def _reply(self, status, ctype, out, method="GET", extra=None):
                 from urllib.parse import urlsplit
 
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(out)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 # CORS only on browser-reachable routes (reference
                 # http_handlers.rs:236-259 scopes CORS to hpke_config,
                 # upload, and collection_jobs; aggregator-to-aggregator

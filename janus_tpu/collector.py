@@ -58,7 +58,12 @@ class CollectionResult:
 
 
 class CollectionJobNotReady(Exception):
-    pass
+    """202 poll response; retry_after_s carries the leader's Retry-After
+    hint when present (reference collector/src/lib.rs:466)."""
+
+    def __init__(self, retry_after_s: float | None = None):
+        super().__init__("collection job not ready")
+        self.retry_after_s = retry_after_s
 
 
 class Collector:
@@ -92,7 +97,15 @@ class Collector:
             lambda: self.http.post(self.params.collection_job_uri(job_id), b"", headers)
         )
         if status == 202:
-            raise CollectionJobNotReady()
+            ra = None
+            hdrs = getattr(self.http, "last_response_headers", {})
+            raw = next((v for k, v in hdrs.items() if k.lower() == "retry-after"), None)
+            if raw is not None:
+                try:
+                    ra = max(0.0, float(raw))  # delta-seconds form only
+                except ValueError:
+                    ra = None
+            raise CollectionJobNotReady(retry_after_s=ra)
         if status != 200:
             raise RuntimeError(f"collection poll failed: HTTP {status}: {body[:300]!r}")
         collection = Collection.from_bytes(body)
@@ -101,15 +114,27 @@ class Collector:
     def poll_until_complete(
         self, job_id: CollectionJobId, query: Query, agg_param: bytes = b"", timeout_s: float = 60.0, poll_interval_s: float = 0.2
     ) -> CollectionResult:
-        """reference :561."""
+        """reference :561 — honors the leader's Retry-After on 202
+        (collector/src/lib.rs:466), falling back to poll_interval_s."""
         deadline = _time.monotonic() + timeout_s
         while True:
             try:
                 return self.poll_once(job_id, query, agg_param)
-            except CollectionJobNotReady:
-                if _time.monotonic() > deadline:
+            except CollectionJobNotReady as e:
+                # a 0 (or absent) hint keeps the local floor — never
+                # busy-loop POSTs against the leader
+                wait = (
+                    poll_interval_s
+                    if not e.retry_after_s  # None or 0
+                    else e.retry_after_s
+                )
+                # cap to the remaining budget so a hint >= budget still
+                # gets one final poll at the deadline instead of an
+                # immediate TimeoutError
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
                     raise TimeoutError("collection job did not complete in time")
-                _time.sleep(poll_interval_s)
+                _time.sleep(min(wait, remaining))
 
     def collect(self, query: Query, agg_param: bytes = b"", timeout_s: float = 60.0) -> CollectionResult:
         """start + poll to completion (reference :619)."""

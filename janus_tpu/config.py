@@ -65,6 +65,10 @@ class CommonConfig:
     # the helper/leader steps for the smallest batch bucket) instead of
     # stalling the first request. Only the VDAF-hot-path binaries use it.
     warmup_engines_at_boot: bool = False
+    # With warmup_buckets set (e.g. [32, 256, 1024]), warmup runs in a
+    # background thread per ascending bucket — serving starts
+    # immediately and big job buckets compile ahead of their first job.
+    warmup_buckets: tuple[int, ...] = ()
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -77,6 +81,7 @@ class CommonConfig:
             jax_platform=d.get("jax_platform"),
             compilation_cache_dir=d.get("compilation_cache_dir", "~/.cache/janus_tpu_xla"),
             warmup_engines_at_boot=bool(d.get("warmup_engines_at_boot", False)),
+            warmup_buckets=tuple(int(b) for b in d.get("warmup_buckets", ())),
         )
 
 
@@ -106,6 +111,7 @@ class AggregatorConfig:
     batch_aggregation_shard_count: int = 1
     taskprov: TaskprovConfig = field(default_factory=TaskprovConfig)
     garbage_collection_interval_s: float | None = None
+    collection_retry_after_s: int = 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "AggregatorConfig":
@@ -125,6 +131,7 @@ class AggregatorConfig:
             ),
             taskprov=TaskprovConfig.from_dict(d.get("taskprov_config")),
             garbage_collection_interval_s=gc.get("gc_frequency_s"),
+            collection_retry_after_s=int(d.get("collection_retry_after_secs", 1)),
         )
 
     def protocol_config(self) -> AggregatorProtocolConfig:
@@ -133,6 +140,7 @@ class AggregatorConfig:
             max_upload_batch_write_delay_ms=self.max_upload_batch_write_delay_ms,
             batch_aggregation_shard_count=self.batch_aggregation_shard_count,
             taskprov_enabled=self.taskprov.enabled,
+            collection_retry_after_s=self.collection_retry_after_s,
         )
 
 
@@ -144,6 +152,7 @@ class JobCreatorConfig:
     aggregation_job_creation_interval_s: float = 1.0
     min_aggregation_job_size: int = 10
     max_aggregation_job_size: int = 100
+    max_concurrent_tasks: int = 8
 
     @classmethod
     def from_dict(cls, d: dict) -> "JobCreatorConfig":
@@ -157,12 +166,14 @@ class JobCreatorConfig:
             ),
             min_aggregation_job_size=int(d.get("min_aggregation_job_size", 10)),
             max_aggregation_job_size=int(d.get("max_aggregation_job_size", 100)),
+            max_concurrent_tasks=int(d.get("max_concurrent_tasks", 8)),
         )
 
     def creator_config(self) -> AggregationJobCreatorConfig:
         return AggregationJobCreatorConfig(
             min_aggregation_job_size=self.min_aggregation_job_size,
             max_aggregation_job_size=self.max_aggregation_job_size,
+            max_concurrent_tasks=self.max_concurrent_tasks,
         )
 
 

@@ -1,14 +1,17 @@
 """HPKE (RFC 9180) single-shot seal/open with DAP application labels.
 
-Equivalent of reference core/src/hpke.rs:27-120: base-mode
-DHKEM(X25519, HKDF-SHA256) + HKDF-SHA256 + AES-128-GCM, with the
+Equivalent of reference core/src/hpke.rs:27-120: base mode with the
 DAP-07 application-info labels ("dap-07 input share",
 "dap-07 aggregate share") and sender/recipient roles bound into the
 key schedule info.
 
-KEM/AEAD primitives come from the `cryptography` package (the
-reference's equivalent dependency is the hpke-dispatch crate); the
-HKDF labeling is implemented here to match RFC 9180 exactly.
+Suite matrix (reference core/src/hpke.rs:214-215,456 round_trip_check):
+KEMs DHKEM(X25519, HKDF-SHA256) + DHKEM(P-256, HKDF-SHA256); KDFs
+HKDF-SHA256/384/512; AEADs AES-128-GCM / AES-256-GCM /
+ChaCha20Poly1305 — any combination. KEM/AEAD primitives come from the
+`cryptography` package (the reference's equivalent dependency is the
+hpke-dispatch crate); the HKDF labeling is implemented here to match
+RFC 9180 exactly.
 """
 
 from __future__ import annotations
@@ -16,68 +19,138 @@ from __future__ import annotations
 import enum
 import hashlib
 import hmac
-import secrets
 from dataclasses import dataclass
 
+from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.hazmat.primitives.asymmetric.x25519 import (
     X25519PrivateKey,
     X25519PublicKey,
 )
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM, ChaCha20Poly1305
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
 
 from ..messages import HpkeAeadId, HpkeCiphertext, HpkeConfig, HpkeConfigId, HpkeKdfId, HpkeKemId, Role
 
-# suite constants: DHKEM(X25519, HKDF-SHA256)=0x0020, HKDF-SHA256=0x0001, AES-128-GCM=0x0001
-KEM_ID = 0x0020
-KDF_ID = 0x0001
-AEAD_ID = 0x0001
-NK = 16  # AES-128 key
-NN = 12  # GCM nonce
-NH = 32  # SHA-256
-NSECRET = 32
+NN = 12  # nonce size, all three AEADs
 
-_SUITE_ID = b"HPKE" + KEM_ID.to_bytes(2, "big") + KDF_ID.to_bytes(2, "big") + AEAD_ID.to_bytes(2, "big")
-_KEM_SUITE_ID = b"KEM" + KEM_ID.to_bytes(2, "big")
+_KDF_HASH = {
+    HpkeKdfId.HKDF_SHA256: hashlib.sha256,
+    HpkeKdfId.HKDF_SHA384: hashlib.sha384,
+    HpkeKdfId.HKDF_SHA512: hashlib.sha512,
+}
+
+_AEAD = {  # id -> (constructor, Nk)
+    HpkeAeadId.AES_128_GCM: (AESGCM, 16),
+    HpkeAeadId.AES_256_GCM: (AESGCM, 32),
+    HpkeAeadId.CHACHA20POLY1305: (ChaCha20Poly1305, 32),
+}
 
 
 class HpkeError(Exception):
     pass
 
 
-def _hmac_sha256(key: bytes, msg: bytes) -> bytes:
-    return hmac.new(key, msg, hashlib.sha256).digest()
+def _labeled_extract(suite_id: bytes, hashfn, salt: bytes, label: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, b"HPKE-v1" + suite_id + label + ikm, hashfn).digest()
 
 
-def _labeled_extract(suite_id: bytes, salt: bytes, label: bytes, ikm: bytes) -> bytes:
-    return _hmac_sha256(salt, b"HPKE-v1" + suite_id + label + ikm)
-
-
-def _labeled_expand(suite_id: bytes, prk: bytes, label: bytes, info: bytes, length: int) -> bytes:
+def _labeled_expand(suite_id: bytes, hashfn, prk: bytes, label: bytes, info: bytes, length: int) -> bytes:
     labeled_info = length.to_bytes(2, "big") + b"HPKE-v1" + suite_id + label + info
     out = b""
     t = b""
     i = 1
     while len(out) < length:
-        t = _hmac_sha256(prk, t + labeled_info + bytes([i]))
+        t = hmac.new(prk, t + labeled_info + bytes([i]), hashfn).digest()
         out += t
         i += 1
     return out[:length]
 
 
-def _extract_and_expand(dh: bytes, kem_context: bytes) -> bytes:
-    eae_prk = _labeled_extract(_KEM_SUITE_ID, b"", b"eae_prk", dh)
-    return _labeled_expand(_KEM_SUITE_ID, eae_prk, b"shared_secret", kem_context, NSECRET)
+# ---------------------------------------------------------------------------
+# KEMs (both use HKDF-SHA256 internally per their RFC 9180 definitions)
+# ---------------------------------------------------------------------------
 
 
-def _key_schedule(shared_secret: bytes, info: bytes) -> tuple[bytes, bytes]:
-    """Base mode key schedule -> (key, base_nonce)."""
-    psk_id_hash = _labeled_extract(_SUITE_ID, b"", b"psk_id_hash", b"")
-    info_hash = _labeled_extract(_SUITE_ID, b"", b"info_hash", info)
+class _X25519Kem:
+    ID = HpkeKemId.X25519_HKDF_SHA256
+    NSECRET = 32
+
+    @staticmethod
+    def generate() -> tuple[bytes, bytes]:
+        sk = X25519PrivateKey.generate()
+        return sk.public_key().public_bytes_raw(), sk.private_bytes_raw()
+
+    @staticmethod
+    def encap(pk_bytes: bytes) -> tuple[bytes, bytes]:
+        pk_r = X25519PublicKey.from_public_bytes(pk_bytes)
+        sk_e = X25519PrivateKey.generate()
+        return sk_e.exchange(pk_r), sk_e.public_key().public_bytes_raw()
+
+    @staticmethod
+    def decap(sk_bytes: bytes, enc: bytes) -> bytes:
+        sk_r = X25519PrivateKey.from_private_bytes(sk_bytes)
+        return sk_r.exchange(X25519PublicKey.from_public_bytes(enc))
+
+
+class _P256Kem:
+    ID = HpkeKemId.P256_HKDF_SHA256
+    NSECRET = 32
+    _CURVE = ec.SECP256R1()
+
+    @classmethod
+    def generate(cls) -> tuple[bytes, bytes]:
+        sk = ec.generate_private_key(cls._CURVE)
+        pk = sk.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
+        return pk, sk.private_numbers().private_value.to_bytes(32, "big")
+
+    @classmethod
+    def _load_pk(cls, pk_bytes: bytes):
+        return ec.EllipticCurvePublicKey.from_encoded_point(cls._CURVE, pk_bytes)
+
+    @classmethod
+    def encap(cls, pk_bytes: bytes) -> tuple[bytes, bytes]:
+        pk_r = cls._load_pk(pk_bytes)
+        sk_e = ec.generate_private_key(cls._CURVE)
+        enc = sk_e.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
+        return sk_e.exchange(ec.ECDH(), pk_r), enc
+
+    @classmethod
+    def decap(cls, sk_bytes: bytes, enc: bytes) -> bytes:
+        sk_r = ec.derive_private_key(int.from_bytes(sk_bytes, "big"), cls._CURVE)
+        return sk_r.exchange(ec.ECDH(), cls._load_pk(enc))
+
+
+_KEMS = {k.ID: k for k in (_X25519Kem, _P256Kem)}
+
+
+def _extract_and_expand(kem, dh: bytes, kem_context: bytes) -> bytes:
+    kem_suite_id = b"KEM" + int(kem.ID).to_bytes(2, "big")
+    eae_prk = _labeled_extract(kem_suite_id, hashlib.sha256, b"", b"eae_prk", dh)
+    return _labeled_expand(
+        kem_suite_id, hashlib.sha256, eae_prk, b"shared_secret", kem_context, kem.NSECRET
+    )
+
+
+def _key_schedule(config: HpkeConfig, shared_secret: bytes, info: bytes):
+    """Base mode key schedule -> (aead instance, base_nonce)."""
+    suite_id = (
+        b"HPKE"
+        + int(config.kem_id).to_bytes(2, "big")
+        + int(config.kdf_id).to_bytes(2, "big")
+        + int(config.aead_id).to_bytes(2, "big")
+    )
+    hashfn = _KDF_HASH[config.kdf_id]
+    ctor, nk = _AEAD[config.aead_id]
+    psk_id_hash = _labeled_extract(suite_id, hashfn, b"", b"psk_id_hash", b"")
+    info_hash = _labeled_extract(suite_id, hashfn, b"", b"info_hash", info)
     key_schedule_context = b"\x00" + psk_id_hash + info_hash
-    secret = _labeled_extract(_SUITE_ID, shared_secret, b"secret", b"")
-    key = _labeled_expand(_SUITE_ID, secret, b"key", key_schedule_context, NK)
-    base_nonce = _labeled_expand(_SUITE_ID, secret, b"base_nonce", key_schedule_context, NN)
-    return key, base_nonce
+    secret = _labeled_extract(suite_id, hashfn, shared_secret, b"secret", b"")
+    key = _labeled_expand(suite_id, hashfn, secret, b"key", key_schedule_context, nk)
+    base_nonce = _labeled_expand(suite_id, hashfn, secret, b"base_nonce", key_schedule_context, NN)
+    return ctor(key), base_nonce
 
 
 class Label(enum.Enum):
@@ -102,33 +175,32 @@ class HpkeApplicationInfo:
 @dataclass(frozen=True)
 class HpkeKeypair:
     config: HpkeConfig
-    private_key: bytes  # raw X25519 scalar
+    private_key: bytes  # raw X25519 scalar / P-256 big-endian scalar
 
     def config_id(self) -> HpkeConfigId:
         return self.config.id
 
 
-def generate_hpke_config_and_private_key(config_id: int = 0) -> HpkeKeypair:
+def generate_hpke_config_and_private_key(
+    config_id: int = 0,
+    kem_id: HpkeKemId = HpkeKemId.X25519_HKDF_SHA256,
+    kdf_id: HpkeKdfId = HpkeKdfId.HKDF_SHA256,
+    aead_id: HpkeAeadId = HpkeAeadId.AES_128_GCM,
+) -> HpkeKeypair:
     """reference core/src/hpke.rs generate_hpke_config_and_private_key."""
-    sk = X25519PrivateKey.generate()
-    pk_bytes = sk.public_key().public_bytes_raw()
-    config = HpkeConfig(
-        HpkeConfigId(config_id),
-        HpkeKemId.X25519_HKDF_SHA256,
-        HpkeKdfId.HKDF_SHA256,
-        HpkeAeadId.AES_128_GCM,
-        pk_bytes,
-    )
-    return HpkeKeypair(config, sk.private_bytes_raw())
+    kem = _kem_for(kem_id)
+    pk_bytes, sk_bytes = kem.generate()
+    if kdf_id not in _KDF_HASH or aead_id not in _AEAD:
+        raise HpkeError(f"unsupported HPKE ciphersuite {kem_id}/{kdf_id}/{aead_id}")
+    config = HpkeConfig(HpkeConfigId(config_id), kem_id, kdf_id, aead_id, pk_bytes)
+    return HpkeKeypair(config, sk_bytes)
 
 
-def _check_config(config: HpkeConfig) -> None:
-    if (
-        config.kem_id != HpkeKemId.X25519_HKDF_SHA256
-        or config.kdf_id != HpkeKdfId.HKDF_SHA256
-        or config.aead_id != HpkeAeadId.AES_128_GCM
-    ):
-        raise HpkeError(f"unsupported HPKE ciphersuite {config}")
+def _kem_for(kem_id) -> type:
+    try:
+        return _KEMS[kem_id]
+    except KeyError:
+        raise HpkeError(f"unsupported HPKE KEM {kem_id}")
 
 
 def hpke_seal(
@@ -138,14 +210,11 @@ def hpke_seal(
     aad: bytes,
 ) -> HpkeCiphertext:
     """Single-shot base-mode seal to `config`'s public key."""
-    _check_config(config)
-    pk_r = X25519PublicKey.from_public_bytes(config.public_key)
-    sk_e = X25519PrivateKey.generate()
-    enc = sk_e.public_key().public_bytes_raw()
-    dh = sk_e.exchange(pk_r)
-    shared_secret = _extract_and_expand(dh, enc + config.public_key)
-    key, base_nonce = _key_schedule(shared_secret, application_info.bytes())
-    ct = AESGCM(key).encrypt(base_nonce, plaintext, aad)
+    kem = _kem_for(config.kem_id)
+    dh, enc = kem.encap(config.public_key)
+    shared_secret = _extract_and_expand(kem, dh, enc + config.public_key)
+    aead, base_nonce = _key_schedule(config, shared_secret, application_info.bytes())
+    ct = aead.encrypt(base_nonce, plaintext, aad)
     return HpkeCiphertext(config.id, enc, ct)
 
 
@@ -156,18 +225,19 @@ def hpke_open(
     aad: bytes,
 ) -> bytes:
     """Single-shot base-mode open with the recipient private key."""
-    _check_config(keypair.config)
+    kem = _kem_for(keypair.config.kem_id)
     if ciphertext.config_id != keypair.config.id:
         raise HpkeError(
             f"config id mismatch: {ciphertext.config_id} != {keypair.config.id}"
         )
-    sk_r = X25519PrivateKey.from_private_bytes(keypair.private_key)
-    pk_e = X25519PublicKey.from_public_bytes(ciphertext.encapsulated_key)
-    dh = sk_r.exchange(pk_e)
-    kem_context = ciphertext.encapsulated_key + keypair.config.public_key
-    shared_secret = _extract_and_expand(dh, kem_context)
-    key, base_nonce = _key_schedule(shared_secret, application_info.bytes())
     try:
-        return AESGCM(key).decrypt(base_nonce, ciphertext.payload, aad)
+        dh = kem.decap(keypair.private_key, ciphertext.encapsulated_key)
+    except Exception as e:  # malformed point / key
+        raise HpkeError(f"KEM decap failed: {e}") from e
+    kem_context = ciphertext.encapsulated_key + keypair.config.public_key
+    shared_secret = _extract_and_expand(kem, dh, kem_context)
+    aead, base_nonce = _key_schedule(keypair.config, shared_secret, application_info.bytes())
+    try:
+        return aead.decrypt(base_nonce, ciphertext.payload, aad)
     except Exception as e:  # InvalidTag
         raise HpkeError(f"decryption failed: {e}") from e

@@ -5,6 +5,7 @@ send_request_to_helper); this wraps urllib for the same purpose.
 
 from __future__ import annotations
 
+import threading
 import urllib.error
 import urllib.request
 
@@ -16,6 +17,17 @@ class HttpClient:
     # deadline_request_timeout), so hot paths stay bounded.
     def __init__(self, timeout: float = 300.0):
         self.timeout = timeout
+        self._local = threading.local()
+
+    @property
+    def last_response_headers(self) -> dict:
+        """Response headers of this thread's most recent request
+        (clients are shared across driver worker threads)."""
+        return getattr(self._local, "headers", {})
+
+    @last_response_headers.setter
+    def last_response_headers(self, value: dict) -> None:
+        self._local.headers = value
 
     def request(
         self,
@@ -37,8 +49,10 @@ class HttpClient:
             with urllib.request.urlopen(
                 req, timeout=self.timeout if timeout is None else min(self.timeout, timeout)
             ) as resp:
+                self.last_response_headers = dict(resp.headers.items())
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
+            self.last_response_headers = dict(e.headers.items())
             return e.code, e.read()
 
     def get(self, url: str, headers: dict | None = None, timeout: float | None = None):

@@ -174,7 +174,7 @@ class _JsonServer:
 
                 parts = urlsplit(self.path)
                 query = dict(parse_qsl(parts.query))
-                status, ctype, out = dap_app.handle(
+                status, ctype, out, _hdrs = dap_app.handle(
                     method, parts.path, query, self.headers, body
                 )
                 self.send_response(status)

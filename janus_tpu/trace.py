@@ -33,6 +33,11 @@ class TraceConfiguration:
     # (reference trace.rs:68-71 ChromeLayer); None disables. The
     # JANUS_CHROME_TRACE env var overrides.
     chrome_trace_file: str | None = None
+    # OTLP/HTTP collector base endpoint (spans POST to /v1/traces,
+    # metrics to /v1/metrics, JSON encoding) — the reference's
+    # OpenTelemetry OTLP exporters (trace.rs:44-90, metrics.rs:53-80).
+    # None disables; the JANUS_OTLP_ENDPOINT env var overrides.
+    otlp_endpoint: str | None = None
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "TraceConfiguration":
@@ -42,6 +47,7 @@ class TraceConfiguration:
             force_json_output=bool(d.get("force_json_output", False)),
             level=str(d.get("level", "INFO")),
             chrome_trace_file=d.get("chrome_trace_file"),
+            otlp_endpoint=d.get("otlp_endpoint"),
         )
 
 
@@ -88,7 +94,185 @@ class ChromeTraceWriter:
                 pass  # already closed
 
 
+class OtlpExporter:
+    """Dependency-free OTLP/HTTP exporter, JSON encoding (the OTLP/HTTP
+    spec's JSON mapping of the protobufs): finished spans batch to
+    {endpoint}/v1/traces, metrics-registry snapshots to /v1/metrics.
+    The reference ships the same capability via the opentelemetry-otlp
+    crate (aggregator/src/trace.rs:44-90, metrics.rs:53-80)."""
+
+    def __init__(self, endpoint: str, service_name: str = "janus_tpu", flush_interval_s: float = 5.0):
+        self.endpoint = endpoint.rstrip("/")
+        self._resource = {
+            "attributes": [
+                {"key": "service.name", "value": {"stringValue": service_name}},
+                {"key": "process.pid", "value": {"intValue": str(os.getpid())}},
+            ]
+        }
+        self._spans: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, args=(flush_interval_s,), daemon=True
+        )
+        self._thread.start()
+        atexit.register(self.shutdown)
+
+    # --- span intake (called from span()'s exit path) ---
+    def record_span(self, name, start_unix_ns, end_unix_ns, trace_id, span_id, parent_span_id, attrs):
+        doc = {
+            "traceId": _hex(trace_id, 32),
+            "spanId": _hex(span_id, 16),
+            "name": name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_unix_ns),
+            "endTimeUnixNano": str(end_unix_ns),
+            "attributes": [
+                {"key": k, "value": self._any_value(v)} for k, v in attrs.items()
+            ],
+        }
+        if parent_span_id is not None:
+            doc["parentSpanId"] = _hex(parent_span_id, 16)
+        with self._lock:
+            self._spans.append(doc)
+
+    @staticmethod
+    def _any_value(v):
+        if isinstance(v, bool):
+            return {"boolValue": v}
+        if isinstance(v, int):
+            return {"intValue": str(v)}
+        if isinstance(v, float):
+            return {"doubleValue": v}
+        return {"stringValue": str(v)}
+
+    # --- export ---
+    def _post(self, path: str, doc: dict) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.endpoint + path,
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+        except Exception:
+            logging.getLogger(__name__).debug("OTLP export to %s failed", path, exc_info=True)
+
+    def flush(self) -> None:
+        with self._lock:
+            spans, self._spans = self._spans, []
+        if spans:
+            self._post(
+                "/v1/traces",
+                {
+                    "resourceSpans": [
+                        {
+                            "resource": self._resource,
+                            "scopeSpans": [
+                                {"scope": {"name": "janus_tpu"}, "spans": spans}
+                            ],
+                        }
+                    ]
+                },
+            )
+        metrics_doc = self._metrics_snapshot()
+        if metrics_doc is not None:
+            self._post("/v1/metrics", metrics_doc)
+
+    def _metrics_snapshot(self) -> dict | None:
+        from . import metrics as m
+
+        now = str(time.time_ns())
+
+        def attrs(labels):
+            return [{"key": k, "value": {"stringValue": v}} for k, v in labels]
+
+        out = []
+        for metric in m.REGISTRY._metrics.values():
+            if isinstance(metric, m.Counter):
+                with metric._lock:
+                    items = sorted(metric._values.items())
+                points = [
+                    {"attributes": attrs(k), "timeUnixNano": now, "asDouble": v}
+                    for k, v in items
+                ]
+                if points:
+                    out.append(
+                        {
+                            "name": metric.name,
+                            "sum": {
+                                "dataPoints": points,
+                                "aggregationTemporality": 2,  # CUMULATIVE
+                                "isMonotonic": True,
+                            },
+                        }
+                    )
+            elif isinstance(metric, m.Histogram):
+                points = []
+                with metric._lock:
+                    for key in sorted(metric._counts):
+                        # OTLP bucket_counts are PER-BUCKET (unlike
+                        # Prometheus's cumulative buckets); the last
+                        # entry is the +Inf overflow
+                        per_bucket = list(metric._counts[key])
+                        overflow = metric._totals[key] - sum(per_bucket)
+                        counts = [str(c) for c in per_bucket] + [str(overflow)]
+                        points.append(
+                            {
+                                "attributes": attrs(key),
+                                "timeUnixNano": now,
+                                "count": str(metric._totals[key]),
+                                "sum": metric._sums[key],
+                                "bucketCounts": counts,
+                                "explicitBounds": list(metric.buckets),
+                            }
+                        )
+                if points:
+                    out.append(
+                        {
+                            "name": metric.name,
+                            "histogram": {"dataPoints": points, "aggregationTemporality": 2},
+                        }
+                    )
+        if not out:
+            return None
+        return {
+            "resourceMetrics": [
+                {
+                    "resource": self._resource,
+                    "scopeMetrics": [{"scope": {"name": "janus_tpu"}, "metrics": out}],
+                }
+            ]
+        }
+
+    def _loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.flush()
+            except Exception:
+                # the flusher must outlive any single bad export
+                logging.getLogger(__name__).debug("OTLP flush failed", exc_info=True)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.flush()
+
+
 _chrome_writer: ChromeTraceWriter | None = None
+_otlp_exporter: OtlpExporter | None = None
+
+
+def install_otlp_export(endpoint: str, flush_interval_s: float = 5.0) -> OtlpExporter:
+    """Install the process-wide OTLP exporter (spans + metrics)."""
+    global _otlp_exporter
+    if _otlp_exporter is not None:
+        _otlp_exporter.shutdown()
+    _otlp_exporter = OtlpExporter(endpoint, flush_interval_s=flush_interval_s)
+    return _otlp_exporter
 
 
 def install_chrome_trace(path: str) -> None:
@@ -151,10 +335,15 @@ def adopt_traceparent(header: str | None):
         parts = header.split("-")
         if (
             len(parts) == 4
+            and len(parts[0]) == 2
             and len(parts[1]) == 32
             and len(parts[2]) == 16
+            and len(parts[3]) == 2
+            and set(parts[0]) <= _HEX_DIGITS
             and set(parts[1]) <= _HEX_DIGITS
             and set(parts[2]) <= _HEX_DIGITS
+            and set(parts[3]) <= _HEX_DIGITS
+            and parts[0] != "ff"  # W3C: version 0xff is invalid
             and set(parts[1]) != {"0"}
             and set(parts[2]) != {"0"}
         ):
@@ -182,7 +371,9 @@ def span(name: str, **args):
     span_id = _random.getrandbits(64)
     token = _trace_ctx.set((trace_id, span_id))
     w = _chrome_writer
+    ox = _otlp_exporter
     t0 = time.perf_counter_ns()
+    e0 = time.time_ns() if ox is not None else 0
     try:
         yield
     finally:
@@ -199,6 +390,11 @@ def span(name: str, **args):
                     "span_id": _hex(span_id, 16),
                     **({"parent_span_id": _hex(parent[1], 16)} if parent else {}),
                 },
+            )
+        if ox is not None:
+            ox.record_span(
+                name, e0, e0 + (t1 - t0), trace_id, span_id,
+                parent[1] if parent else None, args,
             )
 
 
@@ -222,6 +418,9 @@ def install_trace_subscriber(config: TraceConfiguration | None = None) -> None:
     chrome = os.environ.get("JANUS_CHROME_TRACE", config.chrome_trace_file)
     if chrome:
         install_chrome_trace(chrome)
+    otlp = os.environ.get("JANUS_OTLP_ENDPOINT", config.otlp_endpoint)
+    if otlp:
+        install_otlp_export(otlp)
     level = os.environ.get("JANUS_LOG", config.level).upper()
     root = logging.getLogger()
     root.setLevel(level)

@@ -94,3 +94,64 @@ def test_fixed_size_packing_fills_and_spills():
         assert all(v <= 5 for v in per_batch.values())
     finally:
         eph.cleanup()
+
+
+def test_creator_sweeps_tasks_concurrently():
+    """N tasks sweep in parallel workers with no cross-task
+    serialization (reference runs a worker per task,
+    aggregation_job_creator.rs:210): every task gets its job, and at
+    least two sweeps are observed in flight simultaneously."""
+    import threading
+
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ds = eph.datastore
+    tasks = []
+    for i in range(4):
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+            .with_(
+                collector_hpke_config=generate_hpke_config_and_private_key(config_id=i).config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        ds.run_tx(lambda tx, t=task: tx.put_task(t))
+        put_reports(ds, task, 3)
+        tasks.append(task)
+
+    creator = AggregationJobCreator(
+        ds, AggregationJobCreatorConfig(min_aggregation_job_size=1, max_concurrent_tasks=4)
+    )
+    in_flight = {"now": 0, "peak": 0}
+    lock = threading.Lock()
+    gate = threading.Barrier(2, timeout=10.0)
+    orig = creator.create_jobs_for_task
+
+    def instrumented(task):
+        with lock:
+            in_flight["now"] += 1
+            in_flight["peak"] = max(in_flight["peak"], in_flight["now"])
+        try:
+            gate.wait()  # blocks until a second sweep is concurrent
+        except threading.BrokenBarrierError:
+            pass  # >2 workers racing past an already-broken barrier is fine
+        try:
+            return orig(task)
+        finally:
+            with lock:
+                in_flight["now"] -= 1
+        
+    creator.create_jobs_for_task = instrumented
+    created = creator.run_once()
+    assert created == 4
+    assert in_flight["peak"] >= 2
+    for task in tasks:
+        jobs = ds.run_tx(lambda tx, t=task: tx.get_aggregation_jobs_for_task(t.task_id))
+        assert len(jobs) == 1
+    eph.cleanup()

@@ -110,3 +110,34 @@ def test_warmup_engines_compiles_provisioned_tasks(caplog):
     warmup_engines(eph.datastore)  # must not raise; compiles count engine
     assert "warmup failed" not in caplog.text
     eph.cleanup()
+
+
+def test_warmup_background_buckets(caplog):
+    """warmup_buckets runs ahead-of-time bucket compilation in a daemon
+    thread (serving is not blocked) and warms every configured bucket."""
+    from janus_tpu.binary_utils import warmup_engines_background
+    from janus_tpu.config import CommonConfig
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Role
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    cfg = CommonConfig.from_dict({"warmup_buckets": [32, 64]})
+    assert cfg.warmup_buckets == (32, 64)
+
+    eph = EphemeralDatastore()
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.HELPER)
+        .with_(
+            collector_hpke_config=generate_hpke_config_and_private_key(config_id=4).config,
+        )
+        .build()
+    )
+    eph.datastore.run_tx(lambda tx: tx.put_task(task))
+    t = warmup_engines_background(eph.datastore, cfg.warmup_buckets)
+    assert t.daemon
+    t.join(timeout=300)
+    assert not t.is_alive()
+    assert "warmup failed" not in caplog.text
+    eph.cleanup()

@@ -150,3 +150,45 @@ def test_fixed_size_query_count_enforced_on_leader():
             CollectionReq(by_batch_id_query(), bytes([2])),
         )
     eph.cleanup()
+
+
+def test_retry_after_emitted_and_honored():
+    """The leader answers 202 polls with Retry-After and the collector
+    honors it (reference collector/src/lib.rs:466)."""
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.collector import (
+        CollectionJobNotReady,
+        Collector,
+        CollectorParameters,
+    )
+    from janus_tpu.core.http_client import HttpClient
+
+    eph, agg, ta, task = _mk(QueryTypeConfig.time_interval())
+    agg.cfg.collection_retry_after_s = 3
+    srv = DapServer(DapHttpApp(agg)).start()
+    try:
+        collector_kp = generate_hpke_config_and_private_key(config_id=7)
+        http = HttpClient()
+        collector = Collector(
+            CollectorParameters(
+                task.task_id, srv.url, task.collector_auth_token, collector_kp
+            ),
+            task.vdaf,
+            http,
+        )
+        q = Query.time_interval(Interval(Time(1_599_998_400), Duration(7200)))
+        job_id = collector.start_collection(q)
+        with pytest.raises(CollectionJobNotReady) as ei:
+            collector.poll_once(job_id, q)
+        assert ei.value.retry_after_s == 3.0
+        # poll_until_complete sleeps per the hint: with a deadline
+        # shorter than the hinted wait it gives up without sleeping 3s
+        import time as _t
+
+        t0 = _t.monotonic()
+        with pytest.raises(TimeoutError):
+            collector.poll_until_complete(job_id, q, timeout_s=1.0)
+        assert _t.monotonic() - t0 < 2.5
+    finally:
+        srv.stop()
+        eph.cleanup()

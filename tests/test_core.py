@@ -66,13 +66,76 @@ def test_hpke_rfc9180_vector_a1():
     dh = X25519PrivateKey.from_private_bytes(sk_e).exchange(
         X25519PublicKey.from_public_bytes(pk_r)
     )
-    shared_secret = _extract_and_expand(dh, enc + pk_r)
+    from janus_tpu.core.hpke import _X25519Kem
+
+    shared_secret = _extract_and_expand(_X25519Kem, dh, enc + pk_r)
     assert shared_secret == bytes.fromhex(
         "fe0e18c9f024ce43799ae393c7e8fe8fce9d218875e8227b0187c04e7d2ea1fc"
     )
-    key, base_nonce = _key_schedule(shared_secret, bytes.fromhex("4f6465206f6e2061204772656369616e2055726e"))
-    assert key == bytes.fromhex("4531685d41d65f03dc48f6b8302c05b0")
+    from janus_tpu.core.hpke import HpkeKeypair as _KP
+    from janus_tpu.messages import HpkeAeadId, HpkeConfig, HpkeKdfId, HpkeKemId
+
+    cfg = HpkeConfig(
+        HpkeConfigId(0),
+        HpkeKemId.X25519_HKDF_SHA256,
+        HpkeKdfId.HKDF_SHA256,
+        HpkeAeadId.AES_128_GCM,
+        pk_r,
+    )
+    aead, base_nonce = _key_schedule(
+        cfg, shared_secret, bytes.fromhex("4f6465206f6e2061204772656369616e2055726e")
+    )
     assert base_nonce == bytes.fromhex("56d890e5accaaf011cff4b7d")
+    # RFC 9180 A.1.1.1 first seal: pt/aad/ct from the published vector
+    ct = aead.encrypt(
+        base_nonce,
+        bytes.fromhex("4265617574792069732074727574682c20747275746820626561757479"),
+        bytes.fromhex("436f756e742d30"),
+    )
+    assert ct == bytes.fromhex(
+        "f938558b5d72f1a23810b4be2ab4f84331acc02fc97babc53a52ae8218a355a9"
+        "6d8770ac83d07bea87e13c512a"
+    )
+
+
+def test_hpke_suite_matrix_round_trips():
+    """Every KEM x KDF x AEAD combination the reference supports
+    (core/src/hpke.rs:456 round_trip_check) seals and opens."""
+    from janus_tpu.messages import HpkeAeadId, HpkeKdfId, HpkeKemId
+
+    info = HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+    for kem in (HpkeKemId.X25519_HKDF_SHA256, HpkeKemId.P256_HKDF_SHA256):
+        for kdf in (HpkeKdfId.HKDF_SHA256, HpkeKdfId.HKDF_SHA384, HpkeKdfId.HKDF_SHA512):
+            for aead in (
+                HpkeAeadId.AES_128_GCM,
+                HpkeAeadId.AES_256_GCM,
+                HpkeAeadId.CHACHA20POLY1305,
+            ):
+                kp = generate_hpke_config_and_private_key(
+                    config_id=3, kem_id=kem, kdf_id=kdf, aead_id=aead
+                )
+                assert kp.config.kem_id == kem
+                ct = hpke_seal(kp.config, info, b"measurement", b"aad")
+                assert hpke_open(kp, info, ct, b"aad") == b"measurement"
+                with pytest.raises(HpkeError):
+                    hpke_open(kp, info, ct, b"bad aad")
+
+
+def test_hpke_p256_cross_suite_failure():
+    """A P-256 recipient cannot open an X25519-sealed ciphertext and
+    malformed encapsulated points are rejected, not crashed on."""
+    from janus_tpu.messages import HpkeKemId
+
+    info = HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+    p256 = generate_hpke_config_and_private_key(config_id=5, kem_id=HpkeKemId.P256_HKDF_SHA256)
+    x = generate_hpke_config_and_private_key(config_id=5)
+    ct = hpke_seal(x.config, info, b"pt", b"aad")
+    with pytest.raises(HpkeError):
+        hpke_open(p256, info, ct, b"aad")  # 32-byte enc is not a P-256 point
+    ct2 = hpke_seal(p256.config, info, b"pt", b"aad")
+    bad = HpkeCiphertext(ct2.config_id, b"\x04" + b"\x00" * 64, ct2.payload)
+    with pytest.raises(HpkeError):
+        hpke_open(p256, info, bad, b"aad")
 
 
 def test_clocks():

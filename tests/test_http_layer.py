@@ -17,14 +17,14 @@ class _NoAgg:
 
 def test_options_preflight_routes():
     app = DapHttpApp(_NoAgg())
-    status, _, _ = app.handle("OPTIONS", "/hpke_config", {}, {}, b"")
+    status, _, _, _ = app.handle("OPTIONS", "/hpke_config", {}, {}, b"")
     assert status == 204
-    status, _, _ = app.handle("OPTIONS", "/tasks/x/reports", {}, {}, b"")
+    status, _, _, _ = app.handle("OPTIONS", "/tasks/x/reports", {}, {}, b"")
     assert status == 204
-    status, _, _ = app.handle("OPTIONS", "/tasks/x/collection_jobs/y", {}, {}, b"")
+    status, _, _, _ = app.handle("OPTIONS", "/tasks/x/collection_jobs/y", {}, {}, b"")
     assert status == 204
     # non-CORS route: aggregation jobs are aggregator-to-aggregator
-    status, _, _ = app.handle("OPTIONS", "/tasks/x/aggregation_jobs/y", {}, {}, b"")
+    status, _, _, _ = app.handle("OPTIONS", "/tasks/x/aggregation_jobs/y", {}, {}, b"")
     assert status == 404
 
 
@@ -32,7 +32,7 @@ def test_wrong_media_type_rejected():
     # exact-match media type, 400 problem document (reference
     # http_handlers.rs validate_content_type answers 400 BadRequest)
     app = DapHttpApp(_NoAgg())
-    status, ctype, body = app.handle(
+    status, ctype, body, _ = app.handle(
         "PUT",
         "/tasks/x/reports",
         {},
@@ -42,7 +42,7 @@ def test_wrong_media_type_rejected():
     assert status == 400
     assert ctype == "application/problem+json"
     # media-type parameters are NOT tolerated (exact match)
-    status, _, _ = app.handle(
+    status, _, _, _ = app.handle(
         "PUT",
         "/tasks/x/reports",
         {},

@@ -308,17 +308,17 @@ def test_taskprov_rejections():
 
         # unknown peer endpoint -> invalidTask (opt-out)
         cfg_bad_peer = sample_task_config("https://other.example/", "https://helper.example/")
-        status, _, body = init_req(cfg_bad_peer, good_auth)
+        status, _, body, _h = init_req(cfg_bad_peer, good_auth)
         assert status == 400 and b"invalidTask" in body
 
         # bad auth -> unauthorizedRequest
         cfg = sample_task_config("https://leader.example/", "https://helper.example/")
-        status, _, body = init_req(cfg, {"Authorization": "Bearer nope"})
+        status, _, body, _h = init_req(cfg, {"Authorization": "Bearer nope"})
         assert status == 400 and b"unauthorizedRequest" in body
 
         # expired task -> invalidTask
         cfg_expired = dataclasses.replace(cfg, task_expiration=Time(1))
-        status, _, body = init_req(cfg_expired, good_auth)
+        status, _, body, _h = init_req(cfg_expired, good_auth)
         assert status == 400 and b"invalidTask" in body
 
         # task id not matching the config digest -> invalidMessage
@@ -328,7 +328,7 @@ def test_taskprov_rejections():
             TASKPROV_HEADER: b64(cfg.to_bytes()).decode().rstrip("="),
             **good_auth,
         }
-        status, _, body = app.handle(
+        status, _, body, _h = app.handle(
             "PUT",
             f"/tasks/{b64(bytes(32)).decode().rstrip('=')}/aggregation_jobs/{b64(bytes(16)).decode().rstrip('=')}",
             {},

@@ -160,7 +160,7 @@ def test_handlers_emit_spans(tmp_path):
             pass
 
         app = DapHttpApp(_NoAgg())
-        status, _, _ = app.handle("OPTIONS", "/hpke_config", {}, {}, b"")
+        status, _, _, _ = app.handle("OPTIONS", "/hpke_config", {}, {}, b"")
         assert status == 204
     finally:
         trace_mod._chrome_writer.close()
@@ -172,3 +172,103 @@ def test_handlers_emit_spans(tmp_path):
 def test_config_plumbs_chrome_trace_file(tmp_path):
     cfg = TraceConfiguration.from_dict({"chrome_trace_file": str(tmp_path / "t.json")})
     assert cfg.chrome_trace_file == str(tmp_path / "t.json")
+
+
+def test_adopt_traceparent_validation():
+    """W3C trace-context field validation (ADVICE r3): version must be
+    2 hex digits != 'ff', flags 2 hex digits; bad ids/zero ids reject."""
+    from janus_tpu.trace import adopt_traceparent, current_traceparent, reset_traceparent
+
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    good = f"00-{tid}-{sid}-01"
+    bad = [
+        f"zz-{tid}-{sid}-01",  # non-hex version
+        f"ff-{tid}-{sid}-01",  # version 0xff invalid
+        f"0-{tid}-{sid}-01",  # short version
+        f"00-{tid}-{sid}-zzzz",  # bad flags
+        f"00-{tid}-{sid}-0",  # short flags
+        f"00-{'0' * 32}-{sid}-01",  # zero trace id
+        f"00-{tid}-{'0' * 16}-01",  # zero span id
+        f"00-{tid[:-1]}-{sid}-01",  # short trace id
+    ]
+    tok = adopt_traceparent(good)
+    assert current_traceparent() == good
+    reset_traceparent(tok)
+    for h in bad:
+        tok = adopt_traceparent(h)
+        assert current_traceparent() is None, h
+        reset_traceparent(tok)
+
+
+def test_otlp_export_spans_and_metrics():
+    """Spans and metrics export as OTLP/HTTP JSON to a collector (the
+    reference's opentelemetry-otlp layers, trace.rs:44-90 /
+    metrics.rs:53-80): a local sink receives /v1/traces with the span
+    tree ids and /v1/metrics with counter + histogram points."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from janus_tpu import metrics as m
+    from janus_tpu import trace as tr
+
+    received = {}
+    done = threading.Event()
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            received[self.path] = _json.loads(body)
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            if "/v1/traces" in received and "/v1/metrics" in received:
+                done.set()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Sink)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        exporter = tr.install_otlp_export(
+            f"http://127.0.0.1:{srv.server_port}", flush_interval_s=3600
+        )
+        with tr.span("otlp.outer", kind="test"):
+            with tr.span("otlp.inner", n=3):
+                pass
+        m.http_request_counter.add(route="otlp_test", status="200")
+        m.http_request_duration.observe(0.02, route="otlp_test")
+        exporter.flush()
+        assert done.wait(5.0)
+
+        spans = received["/v1/traces"]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        by_name = {s["name"]: s for s in spans if s["name"].startswith("otlp.")}
+        outer, inner = by_name["otlp.outer"], by_name["otlp.inner"]
+        assert inner["traceId"] == outer["traceId"]
+        assert inner["parentSpanId"] == outer["spanId"]
+        assert int(inner["endTimeUnixNano"]) >= int(inner["startTimeUnixNano"])
+        assert {"key": "kind", "value": {"stringValue": "test"}} in outer["attributes"]
+
+        metrics = received["/v1/metrics"]["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+        by_metric = {mm["name"]: mm for mm in metrics}
+        cnt = by_metric["janus_http_requests"]["sum"]
+        assert cnt["isMonotonic"] and cnt["aggregationTemporality"] == 2
+        assert any(
+            {"key": "route", "value": {"stringValue": "otlp_test"}} in p["attributes"]
+            for p in cnt["dataPoints"]
+        )
+        hist = by_metric["janus_http_request_duration_seconds"]["histogram"]
+        pt = next(
+            p
+            for p in hist["dataPoints"]
+            if {"key": "route", "value": {"stringValue": "otlp_test"}} in p["attributes"]
+        )
+        assert len(pt["bucketCounts"]) == len(pt["explicitBounds"]) + 1
+        # OTLP buckets are per-bucket (non-cumulative): they sum to count
+        assert sum(int(c) for c in pt["bucketCounts"]) == int(pt["count"])
+        assert int(pt["count"]) >= 1
+    finally:
+        tr._otlp_exporter = None
+        srv.shutdown()
