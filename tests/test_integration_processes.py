@@ -1,0 +1,214 @@
+"""Full deployment integration: the real binaries as real processes.
+
+The reference runs containerized leader+helper pairs over a network
+(integration_tests/tests/janus.rs:14-60, interop_binaries/src/
+testcontainer.rs). This is that harness at process scope: both DAP
+deployments run as actual `python -m janus_tpu.bin.*` processes over
+localhost with SQLite —
+
+  leader side: aggregator + aggregation_job_creator +
+               aggregation_job_driver + collection_job_driver
+  helper side: aggregator
+
+— tasks provisioned through janus_cli, reports uploaded through the
+real Client, results collected through the real Collector, and every
+process SIGTERM-drained at the end. Unlike tests/test_e2e.py (the
+in-process loopback pair), nothing here shares an interpreter: datastore
+Crypter keys, YAML configs, compile caches and HTTP all cross real
+process boundaries.
+"""
+
+import base64
+import os
+import secrets
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LEADER_DAP = 21310
+HELPER_DAP = 21311
+HEALTH_BASE = 21320
+
+
+def _wait_healthz(port: int, deadline_s: float = 90.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=2) as r:
+                assert r.status == 200
+                return
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+def _spawn(name: str, cfg_path, key: str, log_path):
+    env = dict(os.environ, PYTHONPATH=REPO, DATASTORE_KEYS=key, JAX_PLATFORMS="cpu")
+    logf = open(log_path, "wb")
+    return subprocess.Popen(
+        [sys.executable, "-m", f"janus_tpu.bin.{name}", "--config-file", str(cfg_path)],
+        env=env,
+        stdout=logf,
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+    )
+
+
+def test_deployed_process_pair_end_to_end(tmp_path):
+    from janus_tpu.bin import janus_cli
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    key = base64.urlsafe_b64encode(secrets.token_bytes(16)).decode().rstrip("=")
+    leader_db = str(tmp_path / "leader.sqlite")
+    helper_db = str(tmp_path / "helper.sqlite")
+    leader_url = f"http://127.0.0.1:{LEADER_DAP}/"
+    helper_url = f"http://127.0.0.1:{HELPER_DAP}/"
+
+    # --- provision tasks via the real CLI, one DB per deployment ---
+    import dataclasses
+
+    vdaf = VdafInstance.count()
+    collector_kp = generate_hpke_config_and_private_key(config_id=200)
+    leader_task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+        .with_(
+            leader_aggregator_endpoint=leader_url,
+            helper_aggregator_endpoint=helper_url,
+            collector_hpke_config=collector_kp.config,
+            aggregator_auth_token=AuthenticationToken.random_bearer(),
+            collector_auth_token=AuthenticationToken.random_bearer(),
+            min_batch_size=1,
+        )
+        .build()
+    )
+    helper_task = dataclasses.replace(
+        leader_task,
+        role=Role.HELPER,
+        hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+    )
+    for db, task in ((leader_db, leader_task), (helper_db, helper_task)):
+        tasks_file = tmp_path / f"tasks_{task.role.name.lower()}.yaml"
+        tasks_file.write_text(yaml.safe_dump([task.to_dict()]))
+        assert (
+            janus_cli.main(
+                ["provision-tasks", str(tasks_file), "--database", db, "--datastore-keys", key]
+            )
+            == 0
+        )
+
+    # --- per-binary YAML configs ---
+    def cfg(name: str, db: str, idx: int, extra: str = "") -> str:
+        path = tmp_path / f"{name}_{idx}.yaml"
+        path.write_text(
+            f"database: {{url: {db}}}\n"
+            f"health_check_listen_address: \"127.0.0.1:{HEALTH_BASE + idx}\"\n"
+            "jax_platform: cpu\n"
+            f"compilation_cache_dir: {tmp_path}/xla_cache\n" + extra
+        )
+        return str(path)
+
+    procs: dict[str, subprocess.Popen] = {}
+    try:
+        procs["helper"] = _spawn(
+            "aggregator",
+            cfg("aggregator", helper_db, 0, f'listen_address: "127.0.0.1:{HELPER_DAP}"\n'),
+            key,
+            tmp_path / "helper.log",
+        )
+        procs["leader"] = _spawn(
+            "aggregator",
+            cfg("aggregator", leader_db, 1, f'listen_address: "127.0.0.1:{LEADER_DAP}"\n'),
+            key,
+            tmp_path / "leader.log",
+        )
+        procs["creator"] = _spawn(
+            "aggregation_job_creator",
+            cfg(
+                "creator",
+                leader_db,
+                2,
+                "aggregation_job_creation_interval_secs: 0.5\nmin_aggregation_job_size: 1\n",
+            ),
+            key,
+            tmp_path / "creator.log",
+        )
+        procs["agg_driver"] = _spawn(
+            "aggregation_job_driver",
+            cfg("agg_driver", leader_db, 3, "worker_lease_duration_secs: 60\n"),
+            key,
+            tmp_path / "agg_driver.log",
+        )
+        procs["col_driver"] = _spawn(
+            "collection_job_driver",
+            cfg("col_driver", leader_db, 4, "worker_lease_duration_secs: 60\n"),
+            key,
+            tmp_path / "col_driver.log",
+        )
+        for idx in range(5):
+            _wait_healthz(HEALTH_BASE + idx)
+
+        # --- drive the protocol through the real client/collector ---
+        clock = RealClock()
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_url, helper_url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        measurements = [1, 0, 1, 1, 1]
+        for m in measurements:
+            client.upload(m)
+
+        collector = Collector(
+            CollectorParameters(
+                leader_task.task_id, leader_url, leader_task.collector_auth_token, collector_kp
+            ),
+            vdaf,
+            http,
+        )
+        tp = leader_task.time_precision
+        start = clock.now().to_batch_interval_start(tp)
+        query = Query.time_interval(
+            Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+        )
+        # creator + drivers poll on their own cadence; collection becomes
+        # ready once the pipeline has run end to end across 5 processes
+        result = collector.collect(query, timeout_s=240.0)
+        assert result.report_count == len(measurements)
+        assert result.aggregate_result == sum(measurements)
+
+        # --- SIGTERM-drain everything cleanly ---
+        for proc in procs.values():
+            proc.send_signal(signal.SIGTERM)
+        for name, proc in procs.items():
+            rc = proc.wait(timeout=60)
+            assert rc == 0, f"{name} exited {rc}; see {tmp_path}/{name}.log"
+            log = (tmp_path / f"{name}.log").read_bytes()
+            assert b"shut down" in log, f"{name} did not drain: {log[-1500:]}"
+    finally:
+        for name, proc in procs.items():
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                sys.stderr.write(
+                    f"--- {name} tail ---\n"
+                    + (tmp_path / f"{name}.log").read_text()[-800:]
+                    + "\n"
+                )
+            except OSError:
+                pass
