@@ -142,13 +142,16 @@ def test_collect_cli_end_to_end(capsys):
 
         rc = collect.main(
             [
-                "--task-id", leader_task.to_dict()["task_id"],
+                "--task-id=" + leader_task.to_dict()["task_id"],
                 "--leader", leader_srv.url,
-                "--authorization-bearer-token", leader_task.collector_auth_token.token,
-                "--hpke-config",
-                base64.urlsafe_b64encode(collector_kp.config.to_bytes()).decode(),
-                "--hpke-private-key",
-                base64.urlsafe_b64encode(collector_kp.private_key).decode(),
+                "--authorization-bearer-token="
+                + leader_task.collector_auth_token.token,
+                # =-form: a random key's base64url may start with '-',
+                # which space-form argparse reads as an option (1/64 flake)
+                "--hpke-config="
+                + base64.urlsafe_b64encode(collector_kp.config.to_bytes()).decode(),
+                "--hpke-private-key="
+                + base64.urlsafe_b64encode(collector_kp.private_key).decode(),
                 "--vdaf", "count",
                 "--batch-interval-start", str(start.seconds - 3600),
                 "--batch-interval-duration", str(3 * 3600),

@@ -103,15 +103,18 @@ def test_modes_produce_disjoint_transcripts():
 
 
 def test_batched_engine_draft_dispatch():
-    """Short-stream draft instances get the device draft engine
-    (vdaf.draft_jax); long-stream draft tasks refuse and fall back to
-    the host engine."""
+    """Draft instances within the sponge-stream cap (which since r4
+    includes the north-star SumVec len=100k) get the device draft
+    engine (vdaf.draft_jax); only truly enormous streams refuse and
+    fall back to the host engine."""
     from janus_tpu.vdaf.draft_jax import Prio3BatchedDraft
 
     p3 = prio3_batched(VdafInstance("count", xof_mode="draft"))
     assert isinstance(p3, Prio3BatchedDraft)
+    ns = prio3_batched(VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"))
+    assert isinstance(ns, Prio3BatchedDraft)
     with pytest.raises(ValueError):
-        prio3_batched(VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"))
+        prio3_batched(VdafInstance("sumvec", bits=16, length=1_000_000, xof_mode="draft"))
 
 
 def test_engine_cache_dispatches_by_stream_length():
@@ -123,12 +126,16 @@ def test_engine_cache_dispatches_by_stream_length():
 
     fast = engine_cache(VdafInstance("count"), VK)
     draft_short = engine_cache(VdafInstance("count", xof_mode="draft"), VK)
-    draft_long = engine_cache(
+    draft_ns = engine_cache(
         VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"), VK
+    )
+    draft_huge = engine_cache(
+        VdafInstance("sumvec", bits=16, length=1_000_000, xof_mode="draft"), VK
     )
     assert isinstance(fast, EngineCache)
     assert isinstance(draft_short, EngineCache)  # device draft engine
-    assert isinstance(draft_long, HostEngineCache)
+    assert isinstance(draft_ns, EngineCache)  # r4: north-star length on device
+    assert isinstance(draft_huge, HostEngineCache)
 
 
 def test_host_engine_matches_host_transcript():
