@@ -541,9 +541,10 @@ class HostEngineCache:
 def engine_cache(inst: VdafInstance, verify_key: bytes):
     if inst.xof_mode != "fast":
         # draft (VDAF-07) framing: device engine for every circuit
-        # whose sponge streams fit the cap (vdaf.draft_jax
-        # MAX_STREAM_BLOCKS — includes the north-star SumVec len=100k);
-        # host scalar loop only beyond that
+        # whose sponge streams fit the measured latency knee
+        # (vdaf.draft_jax MAX_STREAM_BLOCKS, ~32k blocks = 8x the r3
+        # range); beyond it the sequential sponge is slower on device
+        # than the scalar host loop, which handles those
         try:
             prio3_batched(inst)
         except ValueError:

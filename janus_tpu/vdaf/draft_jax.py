@@ -174,9 +174,11 @@ class Prio3BatchedDraft(Prio3Batched):
 
     Shares the entire FLP/field pipeline with the fast engine; only the
     XOF plumbing (framing, sampling, binder choices) differs.
-    `supports_circuit` bounds the sponge stream length; within it every
-    deployed config (including the north-star SumVec len=100k) runs on
-    device.
+    `supports_circuit` bounds the sponge stream length at the measured
+    latency knee (MAX_STREAM_BLOCKS below): ~8x the round-3 device
+    range, but NOT the north-star SumVec len=100k — past the knee the
+    sequential sponge is slower on device than the scalar host loop,
+    which keeps those tasks.
     """
 
     # Draft framing: sponge streams have no random-access counter and
@@ -191,16 +193,18 @@ class Prio3BatchedDraft(Prio3Batched):
     # max sponge blocks per expansion (absorb or squeeze side). The
     # chain is sequential per report (~24 rounds/block of pure latency)
     # but fully batched across reports, and the scan-based sponge keeps
-    # the traced graph O(1) in stream length — so the cap bounds
-    # worst-case step latency and stream memory (21 lanes x 8 B/block
-    # per report), not feasibility. 160k blocks covers the north-star
-    # SumVec len=100k bits=16 (~152k squeeze blocks for the share, and
-    # the same order absorbing the full-share joint-rand binder):
-    # spec-conformant tasks at north-star lengths now run on device
-    # instead of the ~1 r/s host scalar loop (VERDICT r3 item 4) —
-    # slowly (the sponge chain is inherently sequential per report;
-    # batching amortizes it across reports) but orders faster than host.
-    MAX_STREAM_BLOCKS = 160_000
+    # the traced graph O(1) in stream length. The cap is set at the
+    # MEASURED latency knee (chip, 2026-07-31): a 32,768-block squeeze
+    # runs ~1.9 s steady, but a 152k-block one (SumVec len=100k) blows
+    # up superlinearly to ~209 s — the draft's sequential sponge
+    # construction fundamentally fights the hardware at that scale, and
+    # the device step would be SLOWER than the scalar host loop. 32,768
+    # blocks is 8x the round-3 range (the streamed query removed the
+    # memory wall; latency is now the only limit); truly huge
+    # spec-conformant tasks stay on the host fallback, and the fast
+    # framing — counter mode, one batched permutation for the whole
+    # stream — remains the reason north-star lengths fly (BASELINE.md).
+    MAX_STREAM_BLOCKS = 32_768
 
     @classmethod
     def supports_circuit(cls, circ) -> bool:

@@ -103,18 +103,19 @@ def test_modes_produce_disjoint_transcripts():
 
 
 def test_batched_engine_draft_dispatch():
-    """Draft instances within the sponge-stream cap (which since r4
-    includes the north-star SumVec len=100k) get the device draft
-    engine (vdaf.draft_jax); only truly enormous streams refuse and
-    fall back to the host engine."""
+    """Draft instances within the sponge-stream cap (raised 8x in r4 —
+    the streamed query removed the memory wall; the cap now sits at the
+    measured sequential-sponge latency knee, draft_jax.MAX_STREAM_BLOCKS)
+    get the device draft engine; beyond it the device would be slower
+    than the scalar host loop, so those fall back."""
     from janus_tpu.vdaf.draft_jax import Prio3BatchedDraft
 
     p3 = prio3_batched(VdafInstance("count", xof_mode="draft"))
     assert isinstance(p3, Prio3BatchedDraft)
-    ns = prio3_batched(VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"))
-    assert isinstance(ns, Prio3BatchedDraft)
+    mid = prio3_batched(VdafInstance("sumvec", bits=16, length=14_000, xof_mode="draft"))
+    assert isinstance(mid, Prio3BatchedDraft)
     with pytest.raises(ValueError):
-        prio3_batched(VdafInstance("sumvec", bits=16, length=1_000_000, xof_mode="draft"))
+        prio3_batched(VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"))
 
 
 def test_engine_cache_dispatches_by_stream_length():
@@ -126,16 +127,16 @@ def test_engine_cache_dispatches_by_stream_length():
 
     fast = engine_cache(VdafInstance("count"), VK)
     draft_short = engine_cache(VdafInstance("count", xof_mode="draft"), VK)
-    draft_ns = engine_cache(
-        VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"), VK
+    draft_mid = engine_cache(
+        VdafInstance("sumvec", bits=16, length=14_000, xof_mode="draft"), VK
     )
     draft_huge = engine_cache(
-        VdafInstance("sumvec", bits=16, length=1_000_000, xof_mode="draft"), VK
+        VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"), VK
     )
     assert isinstance(fast, EngineCache)
     assert isinstance(draft_short, EngineCache)  # device draft engine
-    assert isinstance(draft_ns, EngineCache)  # r4: north-star length on device
-    assert isinstance(draft_huge, HostEngineCache)
+    assert isinstance(draft_mid, EngineCache)  # r4: 8x the r3 device range
+    assert isinstance(draft_huge, HostEngineCache)  # past the latency knee
 
 
 def test_host_engine_matches_host_transcript():
