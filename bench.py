@@ -358,7 +358,7 @@ def main() -> None:
 
         inst = dataclasses.replace(inst, xof_mode=args.xof_mode)
     batch = args.batch or (
-        {"count": 8192, "sum": 4096, "sumvec": 2048, "histogram": 1024, "fixedpoint": 1024}[args.config]
+        {"count": 8192, "sum": 16384, "sumvec": 2048, "histogram": 1024, "fixedpoint": 1024}[args.config]
         if on_accel
         else {"count": 256, "sum": 128, "sumvec": 16, "histogram": 16, "fixedpoint": 16}[args.config]
     )
