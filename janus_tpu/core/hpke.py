@@ -189,9 +189,8 @@ def generate_hpke_config_and_private_key(
 ) -> HpkeKeypair:
     """reference core/src/hpke.rs generate_hpke_config_and_private_key."""
     kem = _kem_for(kem_id)
+    _check_ciphersuite(kem_id, kdf_id, aead_id)
     pk_bytes, sk_bytes = kem.generate()
-    if kdf_id not in _KDF_HASH or aead_id not in _AEAD:
-        raise HpkeError(f"unsupported HPKE ciphersuite {kem_id}/{kdf_id}/{aead_id}")
     config = HpkeConfig(HpkeConfigId(config_id), kem_id, kdf_id, aead_id, pk_bytes)
     return HpkeKeypair(config, sk_bytes)
 
@@ -203,6 +202,11 @@ def _kem_for(kem_id) -> type:
         raise HpkeError(f"unsupported HPKE KEM {kem_id}")
 
 
+def _check_ciphersuite(kem_id, kdf_id, aead_id) -> None:
+    if kdf_id not in _KDF_HASH or aead_id not in _AEAD:
+        raise HpkeError(f"unsupported HPKE ciphersuite {kem_id}/{kdf_id}/{aead_id}")
+
+
 def hpke_seal(
     config: HpkeConfig,
     application_info: HpkeApplicationInfo,
@@ -211,6 +215,7 @@ def hpke_seal(
 ) -> HpkeCiphertext:
     """Single-shot base-mode seal to `config`'s public key."""
     kem = _kem_for(config.kem_id)
+    _check_ciphersuite(config.kem_id, config.kdf_id, config.aead_id)
     dh, enc = kem.encap(config.public_key)
     shared_secret = _extract_and_expand(kem, dh, enc + config.public_key)
     aead, base_nonce = _key_schedule(config, shared_secret, application_info.bytes())
@@ -226,6 +231,7 @@ def hpke_open(
 ) -> bytes:
     """Single-shot base-mode open with the recipient private key."""
     kem = _kem_for(keypair.config.kem_id)
+    _check_ciphersuite(keypair.config.kem_id, keypair.config.kdf_id, keypair.config.aead_id)
     if ciphertext.config_id != keypair.config.id:
         raise HpkeError(
             f"config id mismatch: {ciphertext.config_id} != {keypair.config.id}"

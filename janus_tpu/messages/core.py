@@ -703,6 +703,8 @@ class PrepareStepResult(Codec):
 
     def __post_init__(self):
         if self.kind == self.CONTINUE:
+            if self.message is None:
+                raise DecodeError("continue PrepareStepResult requires a message")
             check_pingpong_frame(self.message)
 
     @classmethod

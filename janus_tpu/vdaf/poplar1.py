@@ -109,7 +109,7 @@ def verify_rand(bits: int, verify_key: bytes, nonce: bytes, param: "Poplar1AggPa
     binder = (
         nonce
         + param.level.to_bytes(2, "big")
-        + hashlib.sha256(b"".join(p.to_bytes(16, "big") for p in param.prefixes)).digest()[:8]
+        + hashlib.sha256(b"".join(p.to_bytes(16, "big") for p in param.prefixes)).digest()
     )
     return _xof_vec(F, verify_key, USAGE_VERIFY_RAND, binder, len(param.prefixes))
 

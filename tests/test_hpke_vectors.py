@@ -1,0 +1,132 @@
+"""HPKE conformance against the reference's own RFC 9180 test vectors.
+
+``tests/vectors/hpke-rfc9180.json`` is a verbatim copy of the
+reference's ``core/src/test-vectors.json`` (public RFC 9180 Appendix A
+data the reference checks its hpke-dispatch backend against,
+``core/src/hpke.rs`` mod tests).  This proves our decap → LabeledExtract/
+Expand → key-schedule → AEAD pipeline byte-for-byte against published
+vectors rather than only round-tripping against itself.
+
+Of the 24 base-mode vectors, 12 fall inside our suite matrix
+(X25519/P-256 KEMs × HKDF-SHA256/512 × AES-128/256-GCM,
+ChaCha20Poly1305); X448 and P-521 KEMs are outside DAP's registry and
+are asserted to be *rejected*, not silently skipped.
+"""
+
+import json
+import os
+
+import pytest
+
+from janus_tpu.core import hpke as H
+from janus_tpu.messages import (
+    HpkeAeadId,
+    HpkeConfig,
+    HpkeConfigId,
+    HpkeKdfId,
+    HpkeKemId,
+)
+
+VECTOR_PATH = os.path.join(os.path.dirname(__file__), "vectors", "hpke-rfc9180.json")
+
+with open(VECTOR_PATH) as f:
+    _ALL = json.load(f)
+
+_SUPPORTED_KEMS = {int(k) for k in H._KEMS}
+_SUPPORTED_KDFS = {int(k) for k in H._KDF_HASH}
+_SUPPORTED_AEADS = {int(k) for k in H._AEAD}
+
+
+def _supported(v) -> bool:
+    return (
+        v["mode"] == 0
+        and v["kem_id"] in _SUPPORTED_KEMS
+        and v["kdf_id"] in _SUPPORTED_KDFS
+        and v["aead_id"] in _SUPPORTED_AEADS
+    )
+
+
+SUPPORTED = [v for v in _ALL if _supported(v)]
+UNSUPPORTED = [v for v in _ALL if not _supported(v)]
+
+
+def _ids(vs):
+    return [f"kem{v['kem_id']}-kdf{v['kdf_id']}-aead{v['aead_id']}" for v in vs]
+
+
+def _config_for(v) -> HpkeConfig:
+    return HpkeConfig(
+        HpkeConfigId(0),
+        HpkeKemId(v["kem_id"]),
+        HpkeKdfId(v["kdf_id"]),
+        HpkeAeadId(v["aead_id"]),
+        bytes.fromhex(v["pkRm"]),
+    )
+
+
+def test_coverage_is_what_we_claim():
+    # 24 vectors; exactly half are inside DAP's suite registry.
+    assert len(_ALL) == 24
+    assert len(SUPPORTED) == 12
+    kems = {v["kem_id"] for v in SUPPORTED}
+    assert kems == {int(HpkeKemId.X25519_HKDF_SHA256), int(HpkeKemId.P256_HKDF_SHA256)}
+
+
+@pytest.mark.parametrize("v", SUPPORTED, ids=_ids(SUPPORTED))
+def test_recipient_pipeline_matches_vector(v):
+    """decap(skRm, enc) → shared secret → key schedule → open each ct."""
+    config = _config_for(v)
+    kem = H._kem_for(config.kem_id)
+    enc = bytes.fromhex(v["enc"])
+    sk = bytes.fromhex(v["skRm"])
+
+    dh = kem.decap(sk, enc)
+    shared_secret = H._extract_and_expand(kem, dh, enc + config.public_key)
+    aead, base_nonce = H._key_schedule(config, shared_secret, bytes.fromhex(v["info"]))
+
+    assert base_nonce == bytes.fromhex(v["base_nonce"])
+
+    for seq, e in enumerate(v["encryptions"]):
+        nonce = bytes.fromhex(e["nonce"])
+        # RFC 9180 ComputeNonce: base_nonce XOR I2OSP(seq, Nn)
+        expect_nonce = bytes(
+            b ^ s for b, s in zip(base_nonce, seq.to_bytes(H.NN, "big"))
+        )
+        assert nonce == expect_nonce
+        pt = aead.decrypt(nonce, bytes.fromhex(e["ct"]), bytes.fromhex(e["aad"]))
+        assert pt == bytes.fromhex(e["pt"])
+
+
+@pytest.mark.parametrize("v", SUPPORTED, ids=_ids(SUPPORTED))
+def test_seal_roundtrips_through_vector_key(v):
+    """Our sender path seals to pkRm; the vector's skRm opens it.
+
+    (Seal is randomized — encap draws a fresh ephemeral key — so the
+    vector can't pin sender bytes; interoperating with the vector's
+    recipient key *is* the sender-side conformance statement.)
+    """
+    config = _config_for(v)
+    # Use the raw-info internals so the vector's info bytes are honored.
+    kem = H._kem_for(config.kem_id)
+    dh, enc = kem.encap(config.public_key)
+    shared_secret = H._extract_and_expand(kem, dh, enc + config.public_key)
+    aead, base_nonce = H._key_schedule(config, shared_secret, bytes.fromhex(v["info"]))
+    ct = aead.encrypt(base_nonce, b"round trip", b"aad")
+
+    sk = bytes.fromhex(v["skRm"])
+    dh_r = kem.decap(sk, enc)
+    ss_r = H._extract_and_expand(kem, dh_r, enc + config.public_key)
+    aead_r, base_nonce_r = H._key_schedule(config, ss_r, bytes.fromhex(v["info"]))
+    assert base_nonce_r == base_nonce
+    assert aead_r.decrypt(base_nonce, ct, b"aad") == b"round trip"
+
+
+@pytest.mark.parametrize(
+    "v",
+    [v for v in UNSUPPORTED if v["kem_id"] not in _SUPPORTED_KEMS],
+    ids=_ids([v for v in UNSUPPORTED if v["kem_id"] not in _SUPPORTED_KEMS]),
+)
+def test_unsupported_kems_rejected(v):
+    """X448 / P-521 are outside DAP's registry: explicit HpkeError."""
+    with pytest.raises((H.HpkeError, ValueError)):
+        H._kem_for(HpkeKemId(v["kem_id"]))
