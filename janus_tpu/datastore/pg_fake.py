@@ -1,0 +1,255 @@
+"""Recorded-conversation fake of the psycopg driver surface.
+
+This image has no Postgres server and no psycopg, so the
+`PostgresDatastore` engine — the horizontal-scaling deployment story
+(reference aggregator_core/src/datastore.rs:203-305) — would otherwise
+never execute. This driver stands in for psycopg at the exact seam
+`PostgresDatastore` uses (`connect`, `IsolationLevel`, `errors.*`,
+`OperationalError`), so the PG adapter's Python logic — `%s` parameter
+binding, implicit-BEGIN transaction management, REPEATABLE-READ retry
+loop, broken-connection discard, advisory-lock bootstrap, FOR UPDATE
+SKIP LOCKED lease claims — runs for real, in-image, against a shared
+SQLite file that plays the server.
+
+Two layers of fidelity:
+
+- **Conversation**: every statement is recorded exactly as it would hit
+  the PG wire (after the adapter's `?`→`%s` rewrite), plus
+  connect/commit/rollback/close events. Tests assert the exact SQL +
+  parameter streams for the lease and retry paths
+  (tests/test_pg_conversation.py), the analog of the reference proving
+  those paths against its ephemeral postgres container
+  (datastore/test_util.rs:26-120).
+- **Execution**: statements are translated back (`%s`→`?`, PG-only
+  statements mapped to no-ops) and executed on SQLite, so typed ops see
+  real rows and the full datastore suite runs against the PG engine
+  (conftest DATASTORE_ENGINES includes "pgfake" unconditionally).
+
+What this cannot prove: genuine PG server semantics (MVCC snapshot
+behavior, serialization-failure timing, type coercion details). For
+that, `docker-compose.pg.yaml` + JANUS_TEST_DATABASE_URL runs the same
+suite against a real server (conftest adds the "postgres" engine
+automatically when psycopg and the URL are present).
+
+Error taxonomy mirrors psycopg's: SerializationFailure and
+DeadlockDetected subclass OperationalError, which subclasses Error.
+SQLite "database is locked" surfaces as OperationalError — the same
+retryable class a PG worker sees on a dropped connection.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sqlite3
+import tempfile
+import threading
+
+
+class Error(Exception):
+    pass
+
+
+class OperationalError(Error):
+    pass
+
+
+class IntegrityError(Error):
+    pass
+
+
+class SerializationFailure(OperationalError):
+    pass
+
+
+class DeadlockDetected(OperationalError):
+    pass
+
+
+class InFailedSqlTransaction(Error):
+    pass
+
+
+class _Errors:
+    """The `psycopg.errors` namespace subset the datastore touches."""
+
+    SerializationFailure = SerializationFailure
+    DeadlockDetected = DeadlockDetected
+    IntegrityError = IntegrityError
+    InFailedSqlTransaction = InFailedSqlTransaction
+
+
+class _IsolationLevel:
+    READ_COMMITTED = 1
+    REPEATABLE_READ = 2
+    SERIALIZABLE = 3
+
+
+_ADVISORY_LOCK_RE = re.compile(r"^\s*SELECT\s+pg_advisory_xact_lock", re.I)
+_CREATE_SCHEMA_RE = re.compile(r"^\s*CREATE\s+SCHEMA\b", re.I)
+_DROP_SCHEMA_RE = re.compile(r"^\s*DROP\s+SCHEMA\b", re.I)
+# PG row-locking clause SQLite has no parse for; recorded verbatim,
+# stripped for execution (SQLite's database-level write lock is the
+# stand-in — the real SKIP LOCKED semantics need the real-PG suite)
+_FOR_UPDATE_RE = re.compile(r"\s+FOR\s+UPDATE(\s+SKIP\s+LOCKED)?\s*$", re.I)
+
+
+def _to_sqlite(sql: str) -> str:
+    return _FOR_UPDATE_RE.sub("", sql).replace("%s", "?")
+
+
+class FakeConnection:
+    """psycopg-Connection surface: execute/cursor/commit/rollback/close,
+    `closed`/`broken` flags, assignable `isolation_level`. Transactions
+    are implicit (BEGIN at first statement), matching psycopg
+    autocommit=False."""
+
+    def __init__(self, driver: "FakePostgresDriver"):
+        self._driver = driver
+        self._sq = sqlite3.connect(
+            driver._db_path, timeout=5.0, isolation_level=None, check_same_thread=False
+        )
+        self._sq.execute("PRAGMA foreign_keys=ON")
+        self._in_tx = False
+        self.closed = False
+        self.broken = False
+        self.isolation_level = None
+
+    # -- transaction management (implicit BEGIN, like psycopg) --
+    def _ensure_tx(self):
+        if not self._in_tx:
+            self._sq.execute("BEGIN")
+            self._in_tx = True
+
+    def execute(self, sql: str, params=()):
+        self._driver._record("execute", sql, tuple(params))
+        if self.broken or self.closed:
+            raise OperationalError("connection is broken")
+        self._driver._maybe_inject(self, sql, params)
+        if _ADVISORY_LOCK_RE.match(sql):
+            self._ensure_tx()
+            return self._sq.execute("SELECT 1")
+        if _CREATE_SCHEMA_RE.match(sql) or _DROP_SCHEMA_RE.match(sql):
+            self._ensure_tx()
+            return self._sq.execute("SELECT 1")
+        self._ensure_tx()
+        try:
+            return self._sq.execute(_to_sqlite(sql), params)
+        except sqlite3.IntegrityError:
+            raise  # _INTEGRITY_ERRORS catches the sqlite3 class
+        except sqlite3.OperationalError as e:
+            raise OperationalError(str(e)) from e
+
+    def cursor(self):
+        conn = self
+
+        class _Cur:
+            def executemany(self, sql, seq):
+                seq = [tuple(p) for p in seq]
+                conn._driver._record("executemany", sql, tuple(seq))
+                if conn.broken or conn.closed:
+                    raise OperationalError("connection is broken")
+                conn._driver._maybe_inject(conn, sql, seq)
+                conn._ensure_tx()
+                try:
+                    self._c = conn._sq.executemany(_to_sqlite(sql), seq)
+                except sqlite3.IntegrityError:
+                    raise
+                except sqlite3.OperationalError as e:
+                    raise OperationalError(str(e)) from e
+                return self._c
+
+            def __getattr__(self, name):
+                return getattr(self._c, name)
+
+        return _Cur()
+
+    def commit(self):
+        self._driver._record("commit")
+        if self.broken or self.closed:
+            raise OperationalError("connection is broken")
+        if self._in_tx:
+            self._sq.execute("COMMIT")
+            self._in_tx = False
+
+    def rollback(self):
+        self._driver._record("rollback")
+        if self.broken or self.closed:
+            raise OperationalError("connection is broken")
+        if self._in_tx:
+            self._sq.execute("ROLLBACK")
+            self._in_tx = False
+
+    def close(self):
+        self._driver._record("close")
+        self.closed = True
+        try:
+            self._sq.close()
+        except Exception:
+            pass
+
+
+class FakePostgresDriver:
+    """Module-shaped driver object: pass as `PostgresDatastore(driver=...)`."""
+
+    errors = _Errors
+    OperationalError = OperationalError
+    Error = Error
+    IsolationLevel = _IsolationLevel
+
+    def __init__(self, db_path: str | None = None):
+        if db_path is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="janus-pgfake-")
+            db_path = os.path.join(self._tmp.name, "pgfake.sqlite")
+        else:
+            self._tmp = None
+        self._db_path = db_path
+        self._lock = threading.Lock()
+        self.log: list[tuple] = []
+        self.connections: list[FakeConnection] = []
+        # (predicate(sql, params) -> bool, exception, once) injection
+        # rules, checked before execution — tests script failures here
+        self._injections: list[list] = []
+
+    # -- psycopg module surface --
+    def connect(self, dsn: str, autocommit: bool = False, **kwargs):
+        self._record("connect", dsn, tuple(sorted(kwargs)))
+        assert autocommit is False, "datastore always runs transactional"
+        conn = FakeConnection(self)
+        self.connections.append(conn)
+        return conn
+
+    # -- recording / scripting --
+    def _record(self, kind: str, *detail):
+        with self._lock:
+            self.log.append((kind, *detail))
+
+    def _maybe_inject(self, conn, sql, params):
+        with self._lock:
+            for rule in self._injections:
+                pred, exc, once = rule
+                if pred(sql, params):
+                    if once:
+                        self._injections.remove(rule)
+                    raise exc
+
+    def inject_once(self, predicate, exc: Exception):
+        """Raise `exc` on the first statement matching predicate(sql, params)."""
+        self._injections.append([predicate, exc, True])
+
+    def statements(self, kind: str = "execute") -> list[tuple]:
+        return [e for e in self.log if e[0] == kind]
+
+    def clear_log(self):
+        with self._lock:
+            self.log.clear()
+
+    def cleanup(self):
+        for c in self.connections:
+            if not c.closed:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        if self._tmp is not None:
+            self._tmp.cleanup()

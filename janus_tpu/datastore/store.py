@@ -1378,12 +1378,26 @@ class PostgresDatastore(Datastore):
 
     `dsn` is a postgres:// / postgresql:// URL (psycopg format). An
     optional `schema` confines all tables to a named schema (used by
-    the ephemeral test fixture for isolation)."""
+    the ephemeral test fixture for isolation). `driver` injects a
+    psycopg-shaped module object at the exact seam this class touches
+    (connect/IsolationLevel/errors/OperationalError) — production uses
+    the real psycopg; in-image tests use
+    janus_tpu.datastore.pg_fake.FakePostgresDriver so the adapter's
+    SQL and retry/lease state machine have executable coverage without
+    a server."""
 
     DIALECT = "postgres"
 
-    def __init__(self, dsn: str, crypter: Crypter, clock, schema: str | None = None):
-        if _psycopg is None:
+    def __init__(
+        self,
+        dsn: str,
+        crypter: Crypter,
+        clock,
+        schema: str | None = None,
+        driver=None,
+    ):
+        self._driver = driver if driver is not None else _psycopg
+        if self._driver is None:
             raise RuntimeError(
                 "database.url is postgres:// but psycopg is not installed"
             )
@@ -1428,8 +1442,8 @@ class PostgresDatastore(Datastore):
             kwargs = {}
             if self._schema is not None:
                 kwargs["options"] = f"-c search_path={self._schema}"
-            conn = _psycopg.connect(self._dsn, autocommit=False, **kwargs)
-            conn.isolation_level = _psycopg.IsolationLevel.REPEATABLE_READ
+            conn = self._driver.connect(self._dsn, autocommit=False, **kwargs)
+            conn.isolation_level = self._driver.IsolationLevel.REPEATABLE_READ
             self._local.conn = conn
         return conn
 
@@ -1456,9 +1470,9 @@ class PostgresDatastore(Datastore):
     @property
     def _retryable_errors(self) -> tuple:
         return (
-            _psycopg.errors.SerializationFailure,
-            _psycopg.errors.DeadlockDetected,
-            _psycopg.OperationalError,
+            self._driver.errors.SerializationFailure,
+            self._driver.errors.DeadlockDetected,
+            self._driver.OperationalError,
             TxConflict,
         )
 
@@ -1493,12 +1507,26 @@ class EphemeralDatastore:
         self.clock = clock if clock is not None else MockClock()
         self.crypter = crypter or Crypter()
         self._dir = None
+        self._pg_driver = None
         if engine == "postgres":
             url = os.environ.get("JANUS_TEST_DATABASE_URL")
             if not url:
                 raise RuntimeError("JANUS_TEST_DATABASE_URL not set")
             schema = "janus_test_" + secrets.token_hex(8)
             self.datastore = PostgresDatastore(url, self.crypter, self.clock, schema=schema)
+        elif engine == "pgfake":
+            # PostgresDatastore through the recorded-conversation fake
+            # driver (pg_fake.py): PG adapter code paths, SQLite rows
+            from .pg_fake import FakePostgresDriver
+
+            self._pg_driver = FakePostgresDriver()
+            self.datastore = PostgresDatastore(
+                "postgresql://pgfake/janus",
+                self.crypter,
+                self.clock,
+                schema="janus_pgfake",
+                driver=self._pg_driver,
+            )
         else:
             self._dir = tempfile.TemporaryDirectory(prefix="janus-tpu-ds-")
             self.datastore = Datastore(
@@ -1509,5 +1537,7 @@ class EphemeralDatastore:
         if isinstance(self.datastore, PostgresDatastore):
             self.datastore.drop_schema()
         self.datastore.close()
+        if self._pg_driver is not None:
+            self._pg_driver.cleanup()
         if self._dir is not None:
             self._dir.cleanup()

@@ -44,7 +44,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 # suite so coverage can't silently diverge between files.
 import importlib.util
 
-DATASTORE_ENGINES = ["sqlite"]
+DATASTORE_ENGINES = ["sqlite", "pgfake"]
 if os.environ.get("JANUS_TEST_DATABASE_URL") and importlib.util.find_spec("psycopg"):
     DATASTORE_ENGINES.append("postgres")
 
