@@ -132,9 +132,17 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
         from concurrent.futures import ThreadPoolExecutor
 
         def _upload(r):
-            status, body = http.put(
-                params.upload_uri(), r.to_bytes(), {"Content-Type": "application/dap-report"}
-            )
+            for attempt in (0, 1):
+                try:
+                    status, body = http.put(
+                        params.upload_uri(),
+                        r.to_bytes(),
+                        {"Content-Type": "application/dap-report"},
+                    )
+                    break
+                except (ConnectionError, OSError):
+                    if attempt:
+                        raise
             assert status == 201, body
 
         t0 = _time.time()
@@ -204,6 +212,101 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
         helper_eph.cleanup()
 
 
+def run_poplar1(args, backend, progress, watchdog) -> None:
+    """Poplar1 two-party prepare throughput: batched device IDPF eval +
+    quadratic sketch (vdaf.poplar1_jax) at the declared parity config
+    (Poplar1<XofShake128,16>, reference aggregator.rs:1096), leaf level,
+    256 queried prefixes. Host baseline: the per-report host walk
+    (vdaf.poplar1.Poplar1.prepare_init), extrapolated."""
+    import secrets
+    import time as _time
+
+    import numpy as np
+
+    from janus_tpu.vdaf.poplar1 import Poplar1, Poplar1AggParam
+    from janus_tpu.vdaf.poplar1_jax import prepare_init_batched
+
+    bits = 16
+    level = bits - 1
+    n_prefixes = 256
+    batch = args.batch or (512 if backend != "cpu" else 32)
+    verify_key = bytes(range(16))
+    poplar = Poplar1(bits)
+    rng = np.random.default_rng(0xB0B)
+
+    t0 = _time.time()
+    alphas = [int(rng.integers(0, 1 << bits)) for _ in range(batch)]
+    keys0, keys1 = [], []
+    for a in alphas:
+        _, (k0, k1) = poplar.shard(a)
+        keys0.append(k0)
+        keys1.append(k1)
+    prefixes = tuple(sorted(rng.choice(1 << bits, size=n_prefixes, replace=False).tolist()))
+    param = Poplar1AggParam(level, prefixes)
+    nonces = [secrets.token_bytes(16) for _ in alphas]
+    print(f"[bench] poplar1 shard(batch={batch}): {_time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+    progress["t"] = time.monotonic()
+
+    def both_parties():
+        # two-party prepare: both aggregators' round-1 (device), sketch
+        # combine on host ints (tiny). Return value forces the fetch.
+        y0, A0, B0, a0, c0 = prepare_init_batched(bits, 0, keys0, param, verify_key, nonces)
+        y1, A1, B1, a1, c1 = prepare_init_batched(bits, 1, keys1, param, verify_key, nonces)
+        F = poplar.idpf.field_at(level)
+        ok = 0
+        for i in range(batch):
+            A = F.add(A0[i], A1[i])
+            B = F.add(B0[i], B1[i])
+            s0 = F.neg(F.sub(F.mul(2 % F.MODULUS, F.mul(A, a0[i])), c0[i]))
+            s0 = F.add(s0, F.sub(F.mul(A, A), B))
+            s1 = F.neg(F.sub(F.mul(2 % F.MODULUS, F.mul(A, a1[i])), c1[i]))
+            ok += int(F.add(s0, s1) == 0)
+        assert ok == batch, f"sketch failed: {ok}/{batch}"
+        return ok
+
+    t0 = _time.time()
+    both_parties()
+    compile_s = _time.time() - t0
+    progress["t"] = time.monotonic()
+    t0 = _time.time()
+    iters = max(2, args.iters)
+    for _ in range(iters):
+        both_parties()
+        progress["t"] = time.monotonic()
+    device_rps = batch * iters / (_time.time() - t0)
+
+    # host baseline: the scalar walk on a few reports
+    hr = min(args.host_reports, batch)
+    t0 = _time.time()
+    for i in range(hr):
+        poplar.prepare_init(0, keys0[i], param, verify_key, nonces[i])
+        poplar.prepare_init(1, keys1[i], param, verify_key, nonces[i])
+        progress["t"] = time.monotonic()
+    host_rps = hr / (_time.time() - t0)
+
+    progress["done"] = True
+    if watchdog is not None:
+        watchdog.cancel()
+    print(
+        json.dumps(
+            {
+                "metric": "poplar1_two_party_prepare",
+                "value": round(device_rps, 2),
+                "unit": "reports_per_sec_per_chip",
+                "vs_baseline": round(device_rps / host_rps, 2),
+                "backend": backend,
+                "batch": batch,
+                "bits": bits,
+                "level": level,
+                "prefixes": n_prefixes,
+                "iters": iters,
+                "compile_s": round(compile_s, 1),
+                "host_walk_rps": round(host_rps, 3),
+            }
+        )
+    )
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache: re-runs of the same config skip
     the multi-minute compile. jax is preimported (sitecustomize), so
@@ -226,7 +329,7 @@ def main() -> None:
     ap.add_argument(
         "--config",
         default="sumvec",
-        choices=["count", "sum", "sumvec", "histogram", "fixedpoint"],
+        choices=["count", "sum", "sumvec", "histogram", "fixedpoint", "poplar1"],
     )
     ap.add_argument("--batch", type=int, default=0, help="0 = auto per backend")
     ap.add_argument(
@@ -260,7 +363,8 @@ def main() -> None:
     ap.add_argument(
         "--max-seconds",
         type=float,
-        default=420.0,
+        default=900.0,  # must exceed the worst remote-compile stretch
+        # (len=100k mm-query graph: ~450-600 s through the tunnel)
         help="watchdog: if the accelerator path stalls past this (wedged "
         "tunnel grant), re-exec pinned to CPU so a real measurement is "
         "still produced",
@@ -341,6 +445,10 @@ def main() -> None:
     from janus_tpu.parallel.api import two_party_step
     from janus_tpu.vdaf.registry import VdafInstance, prio3_host
     from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    if args.config == "poplar1":
+        run_poplar1(args, backend, progress, watchdog)
+        return
 
     # BASELINE.md measurement configs
     if args.length and args.config in ("count", "sum"):

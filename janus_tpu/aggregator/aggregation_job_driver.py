@@ -432,17 +432,23 @@ class AggregationJobDriver:
         n = len(pending)
         failed: list = [None] * n
         evals: dict[int, tuple] = {}  # i -> (prep state, y0, [A0, B0])
+        items = []
+        item_idx = []
         for i, ra in enumerate(pending):
             rep = reports.get(ra.report_id.data)
             if rep is None:
                 failed[i] = PrepareError.REPORT_DROPPED
                 continue
-            try:
-                evals[i] = pop.round1(
-                    0, rep.public_share, rep.leader_input_share, param, ra.report_id.data
-                )
-            except ValueError:
+            items.append(
+                (rep.public_share, rep.leader_input_share, ra.report_id.data)
+            )
+            item_idx.append(i)
+        # one batched device IDPF walk + sketch for the whole job
+        for i, res in zip(item_idx, pop.round1_batch(0, items, param)):
+            if isinstance(res, ValueError):
                 failed[i] = PrepareError.INVALID_MESSAGE
+            else:
+                evals[i] = res
 
         prep_inits = []
         send_idx = []

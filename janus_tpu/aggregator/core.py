@@ -487,15 +487,16 @@ class TaskAggregator:
 
         # (no accumulator here: Poplar1 is 2-round — out shares
         # accumulate in the continue handler when the sketch finishes)
-        resps = []
-        report_aggs = []
+        # Pass 1: per-report checks + HPKE + decode; eligible reports
+        # collect into one batched device IDPF walk (round1_batch).
+        errs: list = [None] * n
+        msg1_0s: list = [None] * n
+        items = []
+        item_idx = []
         for i, pi in enumerate(inits):
             rs = pi.report_share
             md = rs.metadata
             err = None
-            blob = b""
-            state = ReportAggregationState.FAILED
-            result = None
             if task.task_expiration and md.time > task.task_expiration:
                 err = PrepareError.TASK_EXPIRED
             elif task.report_expired(md.time, now):
@@ -524,22 +525,42 @@ class TaskAggregator:
                             tag, _, leader_ps = decode_pingpong(pi.message)
                             if tag != PP_INITIALIZE or leader_ps is None:
                                 raise ValueError("expected ping-pong initialize")
-                            msg1_0 = pop.decode_fixed_vec(param, leader_ps, 2)
-                            st1, y1, msg1_1 = pop.round1(
-                                1, rs.public_share, payload, param, md.report_id.data
-                            )
-                            sigma1, combined = pop.round2(st1, msg1_0, msg1_1)
-                            # sketch verdict needs the leader's sigma0:
-                            # park; validity resolves at continue time
-                            msg = pop.encode_vec(param, combined)
-                            share = pop.encode_vec(param, msg1_1) + pop.encode_elem(param, sigma1)
-                            blob = msg + share + pop.encode_vec(param, y1)
-                            state = ReportAggregationState.WAITING_HELPER
-                            result = PrepareStepResult.cont(
-                                encode_pingpong(PP_CONTINUE, msg, share)
-                            )
+                            msg1_0s[i] = pop.decode_fixed_vec(param, leader_ps, 2)
+                            items.append((rs.public_share, payload, md.report_id.data))
+                            item_idx.append(i)
                         except (DecodeError, ValueError):
                             err = PrepareError.INVALID_MESSAGE
+            errs[i] = err
+
+        round1 = {}
+        for i, res in zip(item_idx, pop.round1_batch(1, items, param)):
+            if isinstance(res, ValueError):
+                errs[i] = PrepareError.INVALID_MESSAGE
+            else:
+                round1[i] = res
+
+        # Pass 2: combine + park, same per-report results as before
+        resps = []
+        report_aggs = []
+        for i, pi in enumerate(inits):
+            rs = pi.report_share
+            md = rs.metadata
+            err = errs[i]
+            blob = b""
+            state = ReportAggregationState.FAILED
+            result = None
+            if err is None and i in round1:
+                st1, y1, msg1_1 = round1[i]
+                sigma1, combined = pop.round2(st1, msg1_0s[i], msg1_1)
+                # sketch verdict needs the leader's sigma0:
+                # park; validity resolves at continue time
+                msg = pop.encode_vec(param, combined)
+                share = pop.encode_vec(param, msg1_1) + pop.encode_elem(param, sigma1)
+                blob = msg + share + pop.encode_vec(param, y1)
+                state = ReportAggregationState.WAITING_HELPER
+                result = PrepareStepResult.cont(encode_pingpong(PP_CONTINUE, msg, share))
+            elif err is None:
+                err = PrepareError.INVALID_MESSAGE
             if err is not None:
                 metrics.aggregate_step_failure_counter.add(type=err.name.lower())
                 result = PrepareStepResult.reject(err)

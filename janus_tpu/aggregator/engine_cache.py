@@ -9,6 +9,8 @@ per-report loop — XLA sees static shapes, reports ride the batch axis.
 
 from __future__ import annotations
 
+import os
+import threading
 from functools import lru_cache
 
 import jax
@@ -80,16 +82,22 @@ class DeviceRows:
     across the host<->device link per job for nothing. Callers that
     truly need host rows (multi-round park paths) go through
     `to_numpy()`; `EngineCache.aggregate` consumes the device value
-    directly."""
+    directly.
 
-    __slots__ = ("value", "n")
+    `offset` supports coalesced dispatches: several jobs' rows share
+    one device buffer, each job holding a [offset, offset+n) view."""
 
-    def __init__(self, value, n: int):
+    __slots__ = ("value", "n", "offset")
+
+    def __init__(self, value, n: int, offset: int = 0):
         self.value = value  # tuple of [bucket, len] device limb arrays
         self.n = n  # true batch size (rows beyond n are padding)
+        self.offset = offset
 
     def to_numpy(self):
-        return tuple(np.asarray(x)[: self.n] for x in self.value)
+        return tuple(
+            np.asarray(x)[self.offset : self.offset + self.n] for x in self.value
+        )
 
 
 class DeviceRowsChunks:
@@ -110,6 +118,141 @@ class DeviceRowsChunks:
     def to_numpy(self):
         parts = [c.to_numpy() for c in self.chunks]
         return tuple(np.concatenate([p[i] for p in parts]) for i in range(len(parts[0])))
+
+
+class _Coalescer:
+    """Round-based dispatch coalescing across concurrent callers.
+
+    The driver steps jobs concurrently but each job used to dispatch
+    its own device call: a 10k-report Count job got 86,813 r/s from a
+    chip that does 287,619 at batch 32768 (BASELINE.md matrix,
+    VERDICT r4 weak #7) — the dispatch floor cannot amortize. Here
+    concurrent calls to the same engine step merge into one padded
+    device call: an arrival with no dispatch in flight goes out
+    immediately (zero added latency when unloaded); arrivals during an
+    in-flight dispatch queue and ride the next round together. The
+    reference's analog is rayon parallelism inside one job
+    (aggregation_job_driver.rs:329) — it has no cross-job batching at
+    all.
+
+    Lease/abandon semantics are untouched: coalescing sits strictly
+    below the job layer (one device call serving several jobs' rows;
+    each job still writes and releases its own lease).
+    """
+
+    __slots__ = ("_run", "_max_rows", "_lock", "_queue", "_active", "rounds")
+
+    def __init__(self, run, max_rows: int):
+        import collections
+
+        self._run = run  # ([args...], [n...]) -> [per-call results]
+        self._max_rows = max_rows
+        self._lock = threading.Lock()
+        self._queue: list[list] = []  # entries: [args, n, Event, result, error]
+        self._active = False
+        # calls per dispatched round, recent window only (stats/tests;
+        # unbounded growth would be a slow RSS leak on long-lived
+        # aggregators)
+        self.rounds = collections.deque(maxlen=1024)
+
+    def submit(self, args, n: int):
+        ent = [args, n, threading.Event(), None, None]
+        with self._lock:
+            self._queue.append(ent)
+            dispatcher = not self._active
+            if dispatcher:
+                self._active = True
+        if dispatcher:
+            self._dispatch_until_done(ent)
+        else:
+            while not ent[2].is_set():
+                # the previous dispatcher may have exited with entries
+                # still queued (its own round finished first): adopt the
+                # role instead of waiting forever
+                with self._lock:
+                    adopt = not self._active and not ent[2].is_set()
+                    if adopt:
+                        self._active = True
+                if adopt:
+                    self._dispatch_until_done(ent)
+                    break
+                ent[2].wait(0.05)
+        if ent[4] is not None:
+            raise ent[4]
+        return ent[3]
+
+    def _dispatch_until_done(self, own):
+        """Dispatch rounds until our own entry completes AND the queue
+        is drained or another thread can adopt the role."""
+        try:
+            while True:
+                with self._lock:
+                    batch: list[list] = []
+                    rows = 0
+                    while self._queue and (
+                        not batch or rows + self._queue[0][1] <= self._max_rows
+                    ):
+                        e = self._queue.pop(0)
+                        batch.append(e)
+                        rows += e[1]
+                    if not batch:
+                        return
+                self.rounds.append(len(batch))
+                try:
+                    results = self._run([e[0] for e in batch], [e[1] for e in batch])
+                    for e, r in zip(batch, results):
+                        e[3] = r
+                except BaseException as ex:  # noqa: BLE001 - even
+                    # KeyboardInterrupt/SystemExit must release the
+                    # co-batched waiters (their entries were already
+                    # popped; nobody else will ever set their events)
+                    for e in batch:
+                        e[4] = ex
+                    if not isinstance(ex, Exception):
+                        for e in batch:
+                            e[2].set()
+                        raise
+                for e in batch:
+                    e[2].set()
+                if own[2].is_set():
+                    # our caller has work to do with its result; leave
+                    # remaining entries for a waiter to adopt (50 ms poll)
+                    return
+        finally:
+            with self._lock:
+                self._active = False
+
+
+def _concat_args(args_list):
+    """Concatenate per-call arg tuples along the batch axis. None args
+    must be None in every call (same engine => same schedule)."""
+    out = []
+    for parts in zip(*args_list):
+        if parts[0] is None:
+            assert all(p is None for p in parts)
+            out.append(None)
+        elif isinstance(parts[0], tuple):  # field limbs
+            out.append(
+                tuple(
+                    np.concatenate([np.asarray(p[k]) for p in parts])
+                    for k in range(len(parts[0]))
+                )
+            )
+        else:
+            assert all(p is not None for p in parts)
+            out.append(np.concatenate([np.asarray(p) for p in parts]))
+    return tuple(out)
+
+
+def _split_rows(value, offsets):
+    """Slice a host array / field tuple / None back into per-call rows."""
+    if value is None:
+        return [None] * (len(offsets) - 1)
+    if isinstance(value, tuple):
+        return [
+            tuple(x[s:e] for x in value) for s, e in zip(offsets, offsets[1:])
+        ]
+    return [value[s:e] for s, e in zip(offsets, offsets[1:])]
 
 
 class EngineCache:
@@ -160,6 +303,18 @@ class EngineCache:
             self.mesh = None
             self.dp = 1
             self.sp = 1
+        # cross-job dispatch coalescing (VERDICT r4 item 3): calls at or
+        # below COALESCE_MAX_JOB rows ride shared device dispatches;
+        # bigger jobs fill a dispatch on their own and go direct.
+        self._coalesce = os.environ.get("JANUS_COALESCE", "1") != "0"
+        self._co_leader = _Coalescer(self._run_leader_round, self.COALESCE_ROUND_ROWS)
+        self._co_helper = _Coalescer(self._run_helper_round, self.COALESCE_ROUND_ROWS)
+
+    # Per-call row cap for joining a shared round, and the cap on one
+    # coalesced round (keeps the padded bucket within the measured
+    # single-dispatch sweet spot, BASELINE.md matrix).
+    COALESCE_MAX_JOB = 4096
+    COALESCE_ROUND_ROWS = 32768
 
     def _shard(self, *batch_ndims):
         """NamedShardings splitting the leading (report) axis over 'dp';
@@ -190,7 +345,34 @@ class EngineCache:
     # --- helper side: init + combine + decide in one traced step ---
     def helper_init(self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
         """Returns (out1 field value, accept mask, prep_msg lanes) sliced
-        to the true batch size."""
+        to the true batch size. Small batches coalesce with concurrent
+        callers into one device dispatch (_Coalescer)."""
+        n = nonce_lanes.shape[0]
+        if self._coalesce and n <= self.COALESCE_MAX_JOB:
+            return self._co_helper.submit(
+                (nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask),
+                n,
+            )
+        return self._helper_init_inner(
+            nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask
+        )
+
+    def _run_helper_round(self, args_list, ns):
+        offsets = list(np.cumsum([0] + ns))
+        if len(args_list) == 1:
+            out1, mask, prep_msg = self._helper_init_inner(*args_list[0])
+            return [(out1, mask, prep_msg)]
+        merged = _concat_args(args_list)
+        out1, mask, prep_msg = self._helper_init_inner(*merged, coalesced=len(ns))
+        return [
+            (DeviceRows(out1.value, e - s, offset=s), mask[s:e], prep_msg[s:e])
+            for s, e in zip(offsets, offsets[1:])
+        ]
+
+    def _helper_init_inner(
+        self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask,
+        coalesced: int = 0,
+    ):
         p3 = self.p3
         n = nonce_lanes.shape[0]
         b = bucket_size(n)
@@ -226,7 +408,13 @@ class EngineCache:
         # must sit inside the span or it measures only async dispatch.
         # out1 stays ON DEVICE (DeviceRows): the aggregate step reads it
         # there; only the small mask/prep_msg come back.
-        with span("engine.helper_init", vdaf=self.inst.kind, batch=n, bucket=b):
+        with span(
+            "engine.helper_init",
+            vdaf=self.inst.kind,
+            batch=n,
+            bucket=b,
+            coalesced=coalesced,
+        ):
             with span("engine.helper_init.put"):
                 args = put_args(args, block=True, shardings=shardings)
             with span("engine.helper_init.dispatch"):
@@ -248,9 +436,45 @@ class EngineCache:
         # ok is accepted for interface parity with HostEngineCache; the
         # batched device step costs nothing extra for failed lanes
         # (their rows are zeroed and masked downstream).
+        n = nonce_lanes.shape[0]
+        if self._coalesce and n <= self.COALESCE_MAX_JOB:
+            return self._co_leader.submit(
+                (nonce_lanes, public_parts, meas, proof, blind0), n
+            )
+        return self._leader_init_inner(nonce_lanes, public_parts, meas, proof, blind0)
+
+    def _run_leader_round(self, args_list, ns):
+        offsets = list(np.cumsum([0] + ns))
+        if len(args_list) == 1:
+            return [self._leader_init_inner(*args_list[0])]
+        merged = _concat_args(args_list)
+        # one padded dispatch for the whole round (no intra-call
+        # pipelining: round-to-round overlap already covers H2D)
+        out0, seed0, ver0, part0 = self._leader_init_inner(
+            *merged, coalesced=len(ns), allow_pipeline=False
+        )
+        outs = [
+            DeviceRows(out0.value, e - s, offset=s)
+            for s, e in zip(offsets, offsets[1:])
+        ]
+        seeds = _split_rows(seed0, offsets)
+        vers = _split_rows(ver0, offsets)
+        parts = _split_rows(part0, offsets)
+        return list(zip(outs, seeds, vers, parts))
+
+    def _leader_init_inner(
+        self,
+        nonce_lanes,
+        public_parts,
+        meas,
+        proof,
+        blind0,
+        coalesced: int = 0,
+        allow_pipeline: bool = True,
+    ):
         p3 = self.p3
         n = nonce_lanes.shape[0]
-        if self.mesh is None and n >= 2 * self.PIPELINE_CHUNK:
+        if allow_pipeline and self.mesh is None and n >= 2 * self.PIPELINE_CHUNK:
             return self._leader_init_pipelined(
                 nonce_lanes, public_parts, meas, proof, blind0
             )
@@ -279,7 +503,13 @@ class EngineCache:
         # conversions block on device execution — keep inside the span.
         # out0 stays ON DEVICE (DeviceRows) for the later aggregate;
         # seed0/ver0/part0 are needed host-side for the wire round trip.
-        with span("engine.leader_init", vdaf=self.inst.kind, batch=n, bucket=b):
+        with span(
+            "engine.leader_init",
+            vdaf=self.inst.kind,
+            batch=n,
+            bucket=b,
+            coalesced=coalesced,
+        ):
             with span("engine.leader_init.put"):
                 args = put_args(args, block=True, shardings=shardings)
             with span("engine.leader_init.dispatch"):
@@ -389,10 +619,35 @@ class EngineCache:
         fn = self._jit("aggregate", step)
         if isinstance(out_shares, DeviceRows):
             # device-resident path: the out shares are already on device
-            # padded to their bucket — only the (tiny) mask moves
-            b = out_shares.value[0].shape[0]
-            mask = _pad(np.asarray(mask), b)
-            agg = fn(out_shares.value, mask)
+            # padded to their bucket — only the (tiny) mask moves.
+            n = out_shares.n
+            value = out_shares.value
+            b = value[0].shape[0]
+            vb = bucket_size(n)
+            s = out_shares.offset
+            if (s or vb < b) and s + vb <= b:
+                # coalesced view: one jitted dynamic-slice + masked
+                # reduce over the job's own bucket — reducing the whole
+                # merged buffer once per co-batched job would multiply
+                # the aggregate work by the round size. (Views whose
+                # bucket would run past the buffer keep the full-width
+                # mask path below: dynamic_slice clamps out-of-bounds
+                # starts, which would silently shift rows.)
+                def step_view(value, start, mask, _vb=vb):
+                    v = tuple(
+                        jax.lax.dynamic_slice_in_dim(x, start, _vb, axis=0)
+                        for x in value
+                    )
+                    return p3.aggregate(v, mask)
+
+                fnv = self._jit(f"aggregate_view_{vb}", step_view)
+                mask_vb = np.zeros(vb, dtype=bool)
+                mask_vb[:n] = np.asarray(mask, dtype=bool)
+                agg = fnv(value, np.int32(s), mask_vb)
+            else:
+                full = np.zeros(b, dtype=bool)
+                full[s : s + n] = np.asarray(mask, dtype=bool)
+                agg = fn(value, full)
         else:
             n = mask.shape[0]
             b = bucket_size(n)

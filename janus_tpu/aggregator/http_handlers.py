@@ -362,9 +362,22 @@ class DapServer:
                 query = dict(parse_qsl(parts.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                status, ctype, out, extra = outer.app.handle(
-                    method, parts.path, query, dict(self.headers.items()), body
-                )
+                try:
+                    status, ctype, out, extra = outer.app.handle(
+                        method, parts.path, query, dict(self.headers.items()), body
+                    )
+                except Exception:
+                    # a handler bug must answer 500, not kill the
+                    # keep-alive connection mid-request (the client sees
+                    # an opaque ECONNRESET otherwise — found by the
+                    # shell-capacity bench at 16-way upload)
+                    log.exception("unhandled error serving %s %s", method, parts.path)
+                    status, ctype, out, extra = (
+                        500,
+                        "application/problem+json",
+                        b'{"type":"about:blank","status":500}',
+                        None,
+                    )
                 self._reply(status, ctype, out, method, extra)
 
             def _reply(self, status, ctype, out, method="GET", extra=None):
@@ -411,7 +424,15 @@ class DapServer:
                 log.debug("http: " + fmt, *args)
 
         self.app = app
-        self.server = ThreadingHTTPServer((host, port), Handler)
+
+        # deep listen backlog: bursts of short-lived connections (load
+        # generators, proxies that do not keep alive) otherwise overflow
+        # the default 5-entry accept queue into client-visible resets.
+        # Subclassed so the setting stays scoped to DAP listeners.
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        self.server = _Server((host, port), Handler)
         self._thread: threading.Thread | None = None
 
     @property

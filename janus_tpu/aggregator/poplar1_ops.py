@@ -87,6 +87,58 @@ class Poplar1Ops:
         state, msg1 = self.poplar.prepare_init(party, key, param, self.verify_key, nonce)
         return state, state.y_shares, msg1
 
+    # below this many (report, prefix) evaluations the host walk beats
+    # the device dispatch overhead
+    DEVICE_MIN_EVALS = 8
+
+    def round1_batch(self, party: int, items, param):
+        """Batched round1 over [(public_share, payload, nonce)].
+
+        Returns a list of (state, y_shares, msg1) | ValueError per
+        item. Decode failures stay per-report; eligible reports
+        evaluate on device in one [reports x prefixes] batched IDPF
+        walk + sketch (vdaf.poplar1_jax — VERDICT r4 item 4; the host
+        per-report walk remains as the oracle and small-batch path).
+        """
+        import os
+
+        results: list = [None] * len(items)
+        keys = []
+        idx = []
+        nonces = []
+        for i, (ps, payload, nonce) in enumerate(items):
+            try:
+                keys.append(self._key(party, ps, payload))
+                idx.append(i)
+                nonces.append(nonce)
+            except ValueError as e:
+                results[i] = e
+        if not keys:
+            return results
+        use_device = (
+            os.environ.get("JANUS_POPLAR1_DEVICE", "1") != "0"
+            and self.bits <= 64
+            and len(keys) * len(param.prefixes) >= self.DEVICE_MIN_EVALS
+        )
+        if use_device:
+            from ..vdaf.poplar1 import _PrepState
+            from ..vdaf.poplar1_jax import prepare_init_batched
+
+            F = self.field_for(param)
+            y, A, B, a_sh, c_sh = prepare_init_batched(
+                self.bits, party, keys, param, self.verify_key, nonces
+            )
+            for k, i in enumerate(idx):
+                state = _PrepState(F, y[k], party, a_sh[k], c_sh[k])
+                results[i] = (state, y[k], [A[k], B[k]])
+        else:
+            for k, i in enumerate(idx):
+                state, msg1 = self.poplar.prepare_init(
+                    party, keys[k], param, self.verify_key, nonces[k]
+                )
+                results[i] = (state, state.y_shares, msg1)
+        return results
+
     def round2(self, state, msg1_leader, msg1_helper):
         """-> (sigma_share, combined [A, B])."""
         F = state.field

@@ -250,7 +250,11 @@ def _f128_reduce256(r0, r1, r2, r3):
     b = _f128_fold(a, 2)[:3]
     # fold 3: H = (b2) < 2^12 -> result < 2^128 + 2^82 (3 limbs, top in {0,1})
     c = _f128_fold([b[0], b[1], b[2]], 1)[:3]
-    lo, hi, top = c
+    return _f128_finalize(*c)
+
+
+def _f128_finalize(lo, hi, top):
+    """Canonicalize a (lo, hi, top) value < 2^128 + eps with top in {0,1}."""
     # if top bit set: value - p = value - 2^128 + 7*2^66 - 1
     seven66_lo = _u64((7 * 2**66) & 0xFFFFFFFFFFFFFFFF)
     seven66_hi = _u64((7 * 2**66) >> 64)
@@ -350,6 +354,31 @@ class JF128:
 # ---------------------------------------------------------------------------
 # Generic helpers over limb tuples (field-agnostic)
 # ---------------------------------------------------------------------------
+
+
+def fmul_pow2(jf, v, k: int):
+    """v * 2^k mod p for a static 0 <= k < 64: pure shifts + sparse-
+    moduli folds — ~5x cheaper than a generic jf.mul by the same
+    constant (the truncate paths multiply by 2^bit, bit < bits <= 64)."""
+    assert 0 <= k < 64, k
+    if k == 0:
+        return v
+    nk = np.uint64(k)
+    ink = np.uint64(64 - k)
+    if jf.LIMBS == 1:
+        (lo,) = v
+        return (_f64_reduce_wide(lo << nk, lo >> ink),)
+    lo, hi = v
+    top = hi >> ink  # < 2^k
+    nlo = lo << nk
+    nhi = (hi << nk) | (lo >> ink)
+    if k <= 32:
+        # fold top*2^128 once: result < 2^128 + 7*2^(66+k) < 2^129
+        c = _f128_fold([nlo, nhi, top], 1)[:3]
+        return _f128_finalize(*c)
+    # k up to 63: 7*top*2^66 can reach 2^133 — run the full 256-bit
+    # reduction on [nlo, nhi, top, 0]
+    return _f128_reduce256(nlo, nhi, top, jnp.zeros_like(top))
 
 
 def fmap(fn, *vals):

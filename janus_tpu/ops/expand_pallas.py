@@ -163,7 +163,7 @@ def _reduce_f128_words(w, zero):
     )
 
 
-def _expand_kernel(p_lanes: int, tile_blocks: int = _TILE_BLOCKS):
+def _expand_kernel(p_lanes: int, tile_blocks: int = _TILE_BLOCKS, rounds: int = 24):
     """Kernel factory: prefix occupies lanes [0, p_lanes), counter at
     lane p_lanes, SHAKE padding at p_lanes+1 and lane 20 (the
     ctr_stream_lanes single-block framing, keccak_jax.py). off_ref is a
@@ -191,7 +191,7 @@ def _expand_kernel(p_lanes: int, tile_blocks: int = _TILE_BLOCKS):
                 if lane == 20:  # RATE_LANES - 1: 0x80 in the last byte
                     hi = jnp.full(shape, np.uint32(0x80000000))
                 a.append((lo, hi))
-        a = permute_pairs(a)
+        a = permute_pairs(a, rounds)
         for t in range(7):
             w = (
                 a[3 * t][0],
@@ -215,7 +215,7 @@ def pl_program_id(axis: int):
 
 
 @lru_cache(maxsize=None)
-def _call(p_lanes: int, b8: int, nb: int, tile_blocks: int, interpret: bool):
+def _call(p_lanes: int, b8: int, nb: int, tile_blocks: int, interpret: bool, rounds: int = 24):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -237,7 +237,7 @@ def _call(p_lanes: int, b8: int, nb: int, tile_blocks: int, interpret: bool):
         memory_space=pltpu.VMEM,
     )
     return pl.pallas_call(
-        _expand_kernel(p_lanes, tile_blocks),
+        _expand_kernel(p_lanes, tile_blocks, rounds),
         out_shape=jax.ShapeDtypeStruct((b8, nb, 28, tile_blocks), jnp.uint32),
         grid=grid,
         in_specs=[off_spec, in_spec],
@@ -246,7 +246,7 @@ def _call(p_lanes: int, b8: int, nb: int, tile_blocks: int, interpret: bool):
     )
 
 
-def expand_f128(prefix_lanes, out_blocks: int, length: int, block_offset=0):
+def expand_f128(prefix_lanes, out_blocks: int, length: int, block_offset=0, rounds: int = 24):
     """Expand per-report counter-mode prefixes straight to Field128
     limb arrays, fused on device.
 
@@ -268,7 +268,7 @@ def expand_f128(prefix_lanes, out_blocks: int, length: int, block_offset=0):
     inter = jnp.stack([lo32, hi32], axis=-1).reshape(batch, 2 * p)
     inter = jnp.pad(inter, ((0, b8 - batch), (0, 128 - 2 * p)))
     off = jnp.asarray(block_offset, jnp.int32).reshape(1)
-    out = _call(p, b8, nb, _TILE_BLOCKS, _mode() != "tpu")(off, inter)
+    out = _call(p, b8, nb, _TILE_BLOCKS, _mode() != "tpu", rounds)(off, inter)
     # out[b, nbi, t*4+k, lane] = word k of element t of block
     # nbi*128+lane; element index is block*7 + t
     o = out.reshape(b8, nb, 7, 4, _TILE_BLOCKS)

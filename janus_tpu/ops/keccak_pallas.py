@@ -63,12 +63,16 @@ def _rot64(a, r: int):
     return ((lo << s) | (hi >> t), (hi << s) | (lo >> t))
 
 
-def permute_pairs(a):
-    """All 24 Keccak-f[1600] rounds on a 25-list of (lo32, hi32) pairs.
+def permute_pairs(a, rounds: int = 24):
+    """Keccak-f[1600] rounds on a 25-list of (lo32, hi32) pairs.
 
     Shared between the plain-permutation kernel below and the fused
-    expansion kernel (janus_tpu.ops.expand_pallas)."""
-    for rnd in range(24):
+    expansion kernel (janus_tpu.ops.expand_pallas). `rounds < 24` is a
+    test-only reduction (same round function, first `rounds` round
+    constants) so the full kernel framing runs in interpret mode in
+    default CI without the 24-round unrolled-body compile cost; both
+    sides of every differential use the same count."""
+    for rnd in range(rounds):
         # theta
         c = [
             _xor2(_xor2(_xor2(a[i], a[i + 5]), _xor2(a[i + 10], a[i + 15])), a[i + 20])
@@ -102,10 +106,13 @@ def permute_pairs(a):
     return a
 
 
-def _kernel(x_ref, o_ref):
-    x = x_ref[:]  # [50, TR, 128] u32
-    a = permute_pairs([(x[2 * i], x[2 * i + 1]) for i in range(25)])
-    o_ref[:] = jnp.stack([h for pair in a for h in pair], axis=0)
+def _kernel_for(rounds: int):
+    def _kernel(x_ref, o_ref):
+        x = x_ref[:]  # [50, TR, 128] u32
+        a = permute_pairs([(x[2 * i], x[2 * i + 1]) for i in range(25)], rounds)
+        o_ref[:] = jnp.stack([h for pair in a for h in pair], axis=0)
+
+    return _kernel
 
 
 @lru_cache(maxsize=1)
@@ -138,7 +145,7 @@ def enabled(n_columns: int | None = None) -> bool:
 
 
 @lru_cache(maxsize=None)
-def _call(rows: int, interpret: bool):
+def _call(rows: int, interpret: bool, rounds: int = 24):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -150,7 +157,7 @@ def _call(rows: int, interpret: bool):
         (50, _TILE_ROWS, 128), lambda i: (i * 0, i, i * 0), memory_space=pltpu.VMEM
     )
     return pl.pallas_call(
-        _kernel,
+        _kernel_for(rounds),
         out_shape=jax.ShapeDtypeStruct((50, rows, 128), jnp.uint32),
         grid=grid,
         in_specs=[spec],
@@ -159,7 +166,7 @@ def _call(rows: int, interpret: bool):
     )
 
 
-def keccak_f1600_pallas(state):
+def keccak_f1600_pallas(state, rounds: int = 24):
     """Permute 25 u64 arrays of identical shape; returns the same tuple
     structure. Caller guarantees enabled() is True."""
     shape = state[0].shape
@@ -174,10 +181,84 @@ def keccak_f1600_pallas(state):
     stacked = jnp.stack(halves, axis=0)  # [50, n]
     if cols != n:
         stacked = jnp.pad(stacked, ((0, 0), (0, cols - n)))
-    out = _call(rows, _mode() != "tpu")(stacked.reshape(50, rows, 128))
+    out = _call(rows, _mode() != "tpu", rounds)(stacked.reshape(50, rows, 128))
     out = out.reshape(50, cols)[:, :n]
     res = []
     for i in range(25):
+        lo = out[2 * i].astype(jnp.uint64)
+        hi = out[2 * i + 1].astype(jnp.uint64)
+        res.append((lo | (hi << np.uint64(32))).reshape(shape))
+    return tuple(res)
+
+
+# ---------------------------------------------------------------------------
+# Single-block variant: rate lanes in, first `out_lanes` lanes out.
+# ---------------------------------------------------------------------------
+
+
+def _kernel_single(out_lanes: int, rounds: int):
+    def _kernel(x_ref, o_ref):
+        x = x_ref[:]  # [42, TR, 128] u32 — 21 rate lanes as lo/hi pairs
+        zeros = jnp.zeros_like(x[0])
+        a = [(x[2 * i], x[2 * i + 1]) for i in range(21)] + [(zeros, zeros)] * 4
+        a = permute_pairs(a, rounds)
+        o_ref[:] = jnp.stack(
+            [h for i in range(out_lanes) for h in a[i]], axis=0
+        )
+
+    return _kernel
+
+
+@lru_cache(maxsize=None)
+def _call_single(rows: int, interpret: bool, out_lanes: int, rounds: int = 24):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (rows // _TILE_ROWS,)
+    in_spec = pl.BlockSpec(
+        (42, _TILE_ROWS, 128), lambda i: (i * 0, i, i * 0), memory_space=pltpu.VMEM
+    )
+    out_spec = pl.BlockSpec(
+        (2 * out_lanes, _TILE_ROWS, 128),
+        lambda i: (i * 0, i, i * 0),
+        memory_space=pltpu.VMEM,
+    )
+    return pl.pallas_call(
+        _kernel_single(out_lanes, rounds),
+        out_shape=jax.ShapeDtypeStruct((2 * out_lanes, rows, 128), jnp.uint32),
+        grid=grid,
+        in_specs=[in_spec],
+        out_specs=out_spec,
+        interpret=interpret,
+    )
+
+
+def keccak_single_block_pallas(lane_cols, out_lanes: int, rounds: int = 24):
+    """Permute single-block messages given as 21 rate-lane u64 arrays of
+    identical shape; return the first `out_lanes` output lanes (same
+    tuple-of-arrays structure). vs keccak_f1600_pallas this moves 42
+    u32 rows in and 2*out_lanes out instead of 50/50 — the tree-digest
+    levels (out_lanes=2) were paying ~3x their necessary HBM traffic
+    through the general kernel, the dominant cost of the leader
+    joint-rand binder at SumVec len=100k (profiled r5)."""
+    shape = lane_cols[0].shape
+    n = int(np.prod(shape)) if shape else 1
+    cols_pad = -(-n // (_TILE_ROWS * 128)) * (_TILE_ROWS * 128)
+    rows = cols_pad // 128
+    halves = []
+    for x in lane_cols:
+        flat = jnp.ravel(x)
+        halves.append(flat.astype(jnp.uint32))
+        halves.append((flat >> np.uint64(32)).astype(jnp.uint32))
+    stacked = jnp.stack(halves, axis=0)  # [42, n]
+    if cols_pad != n:
+        stacked = jnp.pad(stacked, ((0, 0), (0, cols_pad - n)))
+    out = _call_single(rows, _mode() != "tpu", out_lanes, rounds)(
+        stacked.reshape(42, rows, 128)
+    )
+    out = out.reshape(2 * out_lanes, cols_pad)[:, :n]
+    res = []
+    for i in range(out_lanes):
         lo = out[2 * i].astype(jnp.uint64)
         hi = out[2 * i + 1].astype(jnp.uint64)
         res.append((lo | (hi << np.uint64(32))).reshape(shape))

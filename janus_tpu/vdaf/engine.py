@@ -25,6 +25,8 @@ batches").
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,11 +36,18 @@ from ..fields.jfield import (
     JF128,
     fconst,
     fmap,
+    fmul_pow2,
     fpow_const,
     fsum,
+    fwhere,
     is_zero,
     anti_recompute_barrier,
 )
+
+# FLP query via MXU limb contraction (ops/limbmm.py) for the chunked
+# circuits. Read once at import (participates in tracing, like
+# JANUS_NO_BARRIERS): JANUS_QUERY_MM=0 falls back to the VPU fold path.
+_QUERY_MM = os.environ.get("JANUS_QUERY_MM", "1") != "0"
 from ..ops.ntt import (
     intt_batched,
     lagrange_eval_weights,
@@ -159,10 +168,9 @@ class BSum(BatchedCircuit):
 
     def truncate(self, inp):
         jf = self.jf
-        two_pows = _two_power_consts(jf, self.circ.bits)
         return fmap(
             lambda x: x[:, None],
-            fsum(jf, self.jf.mul(inp, two_pows), axis=-1),
+            _pow2_weighted_sum(jf, inp, self.circ.bits, axis=-1),
         )
 
 
@@ -225,8 +233,7 @@ class BSumVec(_BChunked):
         v = fmap(
             lambda x: jnp.swapaxes(x.reshape(x.shape[0], length, bits), 1, 2), inp
         )
-        two_pows = fmap(lambda w: w[:, None], _two_power_consts(jf, bits))
-        return fsum(jf, jf.mul(v, two_pows), axis=1)
+        return _pow2_weighted_sum(jf, v, bits)
 
 
 class BHistogram(_BChunked):
@@ -356,6 +363,19 @@ def _two_power_consts(jf, bits: int):
     )
 
 
+def _pow2_weighted_sum(jf, v, bits: int, axis: int = 1):
+    """sum_b 2^b * v[:, b, ...] over a bits-major axis via shift-based
+    const-muls (fmul_pow2) — replaces the generic jf.mul by
+    _two_power_consts in the truncate paths (~5x fewer VPU ops; exact
+    same field elements)."""
+    acc = fmap(lambda x: jnp.take(x, 0, axis=axis), v)
+    for b in range(1, bits):
+        acc = jf.add(
+            acc, fmul_pow2(jf, fmap(lambda x: jnp.take(x, b, axis=axis), v), b)
+        )
+    return acc
+
+
 def batched_circuit(circ: Circuit) -> BatchedCircuit:
     return _ADAPTERS[type(circ)](circ)
 
@@ -404,8 +424,95 @@ def _pick_eval_point(jf, cands, m: int):
     return fmap(lambda x: jnp.take_along_axis(x, idx[:, None], axis=-1)[:, 0], cands)
 
 
+def _query_proof_side(bc: BatchedCircuit, proof_share, query_rand):
+    """Shared proof-share setup of every query variant: split
+    seeds/gadget coefficients, pick the eval point t, evaluate the
+    gadget polynomial at the call points, and compute t's Lagrange
+    weights. Returns (seeds, gcoeffs, t, outs, pw, L0, Lc) — a single
+    copy keeps the MM/fold/streamed paths bit-identical by
+    construction."""
+    jf = bc.jf
+    seeds = fmap(lambda x: x[..., : bc.arity], proof_share)
+    gcoeffs = fmap(lambda x: x[..., bc.arity : bc.arity + bc.gp_len], proof_share)
+    assert query_rand[0].shape[-1] == EVAL_POINT_CANDIDATES
+    t = anti_recompute_barrier(_pick_eval_point(jf, query_rand, bc.m))
+    # gadget outputs at call points alpha^{k+1}: fold mod x^m - 1, NTT_m
+    folds = -(-bc.gp_len // bc.m)
+    padded = fmap(lambda x: jnp.pad(x, ((0, 0), (0, folds * bc.m - bc.gp_len))), gcoeffs)
+    gfold = fsum(jf, fmap(lambda x: x.reshape(x.shape[0], folds, bc.m), padded), axis=1)
+    gevals = ntt_batched(jf, gfold, bc.m)  # values at alpha^0..alpha^{m-1}
+    outs = fmap(lambda x: x[..., 1 : bc.calls + 1], gevals)
+    pw = anti_recompute_barrier(powers(jf, t, bc.gp_len))
+    L = anti_recompute_barrier(lagrange_eval_weights(jf, pw, bc.m))
+    L0 = fmap(lambda x: x[:, 0], L)
+    Lc = fmap(lambda x: x[:, 1 : 1 + bc.calls], L)
+    return seeds, gcoeffs, t, outs, pw, L0, Lc
+
+
+def _chunked_wire_weights(bc: BatchedCircuit, Lc, r):
+    """Per-call weight rows for the MXU wire fold of the
+    ParallelSum(Mul, chunk) schedule.
+
+    wire_t[2i]   = r^{i+1} * sum_call (L_call * r^{call*ch}) * X[call, i]
+    wire_t[2i+1] =           sum_call  L_call               * X[call, i]
+                   - shares_inv * (sum of L over calls whose position i
+                                   is a real input element)
+
+    Returns (w [batch, 2, n_calls], rc1 [batch, ch]) where n_calls is
+    Lc's call axis (>= bc.calls when the streamed plan pads) and rc1 is
+    r^1..r^ch. The decomposition r^{k+1} = r^{call*ch} * r^{i+1}
+    replaces the O(input_len) power ladder of the fold path with
+    O(calls + ch) muls.
+    """
+    jf = bc.jf
+    ch = bc.circ.chunk_length
+    n_calls = Lc[0].shape[-1]
+    rc = anti_recompute_barrier(powers(jf, r, ch + 1))  # [batch, ch+1]
+    rc1 = fmap(lambda x: x[:, 1:], rc)  # r^1..r^ch
+    rch = fmap(lambda x: x[:, ch], rc)  # r^ch
+    rpow_ch = anti_recompute_barrier(powers(jf, rch, n_calls))  # r^{call*ch}
+    u0 = jf.mul(Lc, rpow_ch)
+    w = fmap(lambda a, b: jnp.stack([a, b], axis=1), u0, Lc)
+    return w, rc1
+
+
+def _chunked_b_correction(bc: BatchedCircuit, Lc, shares_inv):
+    """shares_inv * SL_i (see _chunked_wire_weights): SL for positions
+    covered by every call, minus the last call's weight at padded
+    positions (input_len is not a multiple of chunk)."""
+    jf = bc.jf
+    ch = bc.circ.chunk_length
+    SL = fsum(jf, Lc, axis=-1)  # [batch]
+    rem = bc.circ.input_len - (bc.calls - 1) * ch
+    SLvec = fmap(lambda x: jnp.broadcast_to(x[:, None], (x.shape[0], ch)), SL)
+    if rem < ch:
+        L_last = fmap(lambda x: x[:, bc.calls - 1], Lc)
+        SLpad = jf.sub(SL, L_last)
+        mask = jnp.arange(ch) < rem
+        SLvec = fwhere(
+            mask[None, :],
+            SLvec,
+            fmap(lambda x: jnp.broadcast_to(x[:, None], (x.shape[0], ch)), SLpad),
+        )
+    return jf.mul(SLvec, fconst(jf, shares_inv))
+
+
+def _chunked_X(bc: BatchedCircuit, inp_share):
+    """[batch, input_len] share -> zero-padded [batch, calls, ch]."""
+    ch = bc.circ.chunk_length
+    pad = bc.calls * ch - bc.circ.input_len
+    x = inp_share
+    if pad:
+        x = fmap(lambda v: jnp.pad(v, ((0, 0), (0, pad))), x)
+    return fmap(lambda v: v.reshape(v.shape[0], bc.calls, ch), x)
+
+
 def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, joint_rand, num_shares: int):
     """verifier share [batch, verifier_len] matching reference.flp_query."""
+    if _QUERY_MM and type(bc.circ) in (SumVec, Histogram):
+        return _flp_query_batched_mm(
+            bc, inp_share, proof_share, query_rand, joint_rand, num_shares
+        )
     jf = bc.jf
     F = bc.circ.FIELD
     shares_inv = F.inv(num_shares)
@@ -413,18 +520,7 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
     # evaluation-at-t path; barrier so XLA shares it instead of
     # recomputing the (r-powers x input) products per consumer
     ci = anti_recompute_barrier(bc.calls_inputs(inp_share, joint_rand, shares_inv))
-    seeds = fmap(lambda x: x[..., : bc.arity], proof_share)
-    gcoeffs = fmap(lambda x: x[..., bc.arity : bc.arity + bc.gp_len], proof_share)
-
-    assert query_rand[0].shape[-1] == EVAL_POINT_CANDIDATES
-    t = anti_recompute_barrier(_pick_eval_point(jf, query_rand, bc.m))
-
-    # gadget outputs at call points alpha^{k+1}: fold mod x^m - 1, NTT_m
-    folds = -(-bc.gp_len // bc.m)
-    padded = fmap(lambda x: jnp.pad(x, ((0, 0), (0, folds * bc.m - bc.gp_len))), gcoeffs)
-    gfold = fsum(jf, fmap(lambda x: x.reshape(x.shape[0], folds, bc.m), padded), axis=1)
-    gevals = ntt_batched(jf, gfold, bc.m)  # values at alpha^0..alpha^{m-1}
-    outs = fmap(lambda x: x[..., 1 : bc.calls + 1], gevals)
+    seeds, gcoeffs, t, outs, pw, L0, Lc = _query_proof_side(bc, proof_share, query_rand)
 
     # Wire polys evaluated at t WITHOUT interpolating coefficients:
     # wire j's domain values are [seed_j, ci[*, j], 0...], so
@@ -434,10 +530,6 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
     # (reference.flp_query:694-699); same field elements, and the peak
     # tensor drops from [batch, arity, m] to the [batch, calls, arity]
     # inputs — the len=100k memory win.
-    pw = anti_recompute_barrier(powers(jf, t, bc.gp_len))
-    L = anti_recompute_barrier(lagrange_eval_weights(jf, pw, bc.m))
-    L0 = fmap(lambda x: x[:, 0], L)
-    Lc = fmap(lambda x: x[:, 1 : 1 + bc.calls], L)
     prod = jf.mul(ci, fmap(lambda x: x[:, :, None], Lc))  # [batch, calls, arity]
     wire_t = jf.add(
         fsum(jf, prod, axis=1),
@@ -454,6 +546,50 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
     )
 
 
+def _flp_query_batched_mm(
+    bc: BatchedCircuit, inp_share, proof_share, query_rand, joint_rand, num_shares: int
+):
+    """MXU twin of flp_query_batched for the ParallelSum(Mul, chunk)
+    circuits (SumVec/Histogram): field-element identical (differential
+    tested vs the fold path and the host oracle), but the O(input_len)
+    wire fold runs as one limb-decomposed int8 matmul
+    (ops/limbmm.fold_contract) instead of u64-emulated VPU multiplies.
+    This is the round-5 answer to the instruction-mix headroom
+    (BASELINE.md roofline): the contraction over gadget calls is where
+    ~all the query's multiplies live, and the MXU does it at ~40x the
+    VPU's integer rate. Replaces the reference's per-report CPU query
+    (aggregation_job_driver.rs:329-402) at every chunked length.
+    """
+    from ..ops.limbmm import fold_contract
+
+    jf = bc.jf
+    F = bc.circ.FIELD
+    shares_inv = F.inv(num_shares)
+    batch = inp_share[0].shape[0]
+
+    seeds, gcoeffs, t, outs, pw, L0, Lc = _query_proof_side(bc, proof_share, query_rand)
+
+    r = fmap(lambda x: x[:, 0], joint_rand)
+    w, rc1 = _chunked_wire_weights(bc, Lc, r)
+    X = _chunked_X(bc, inp_share)
+    Fw = fold_contract(jf, w, X)  # [batch, 2, ch]
+    A = jf.mul(fmap(lambda x: x[:, 0], Fw), rc1)
+    B = jf.sub(
+        fmap(lambda x: x[:, 1], Fw), _chunked_b_correction(bc, Lc, shares_inv)
+    )
+    wire_t = fmap(lambda a, b: jnp.stack([a, b], axis=-1).reshape(batch, -1), A, B)
+    wire_t = jf.add(wire_t, jf.mul(seeds, fmap(lambda x: x[:, None], L0)))
+    proof_t = poly_eval_powers(jf, gcoeffs, pw)
+
+    v = bc.finish(inp_share, joint_rand, outs, shares_inv)
+    return fmap(
+        lambda a, b, c: jnp.concatenate([a[:, None], b, c[:, None]], axis=-1),
+        v,
+        wire_t,
+        proof_t,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Streamed FLP query + truncate (large-input circuits)
 # ---------------------------------------------------------------------------
@@ -461,7 +597,11 @@ def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, jo
 # Stream the query once the expanded share would dominate HBM: below
 # this the whole-share path is faster (no scan sequentialization).
 STREAM_MIN_INPUT_LEN = 1 << 17
-_STREAM_TARGET_STEPS = 16
+# Fewer, larger scan steps since the MM query shrank the per-step
+# working set: 8 steps halves the sequential scan overhead that was
+# ~40% of helper_init at len=100k (r5 profile) at ~2x the transient
+# per-step memory (still O(group)).
+_STREAM_TARGET_STEPS = 8
 
 
 class StreamPlan:
@@ -550,68 +690,98 @@ def flp_query_streamed(
     batch = query_rand[0].shape[0]
     is_sumvec = isinstance(circ, SumVec)
 
-    # --- proof-share side (small; identical to flp_query_batched) ---
-    seeds = fmap(lambda x: x[..., : bc.arity], proof_share)
-    gcoeffs = fmap(lambda x: x[..., bc.arity : bc.arity + bc.gp_len], proof_share)
-    assert query_rand[0].shape[-1] == EVAL_POINT_CANDIDATES
-    t = anti_recompute_barrier(_pick_eval_point(jf, query_rand, bc.m))
-    folds = -(-bc.gp_len // bc.m)
-    padded = fmap(lambda x: jnp.pad(x, ((0, 0), (0, folds * bc.m - bc.gp_len))), gcoeffs)
-    gfold = fsum(jf, fmap(lambda x: x.reshape(x.shape[0], folds, bc.m), padded), axis=1)
-    gevals = ntt_batched(jf, gfold, bc.m)
-    outs = fmap(lambda x: x[..., 1 : bc.calls + 1], gevals)
-    pw = anti_recompute_barrier(powers(jf, t, bc.gp_len))
-    L = anti_recompute_barrier(lagrange_eval_weights(jf, pw, bc.m))
-    L0 = fmap(lambda x: x[:, 0], L)
-    # call weights, zero-padded so tail calls beyond `calls` contribute 0
-    Lc = fmap(lambda x: x[:, 1 : 1 + bc.calls], L)
+    # --- proof-share side (small; shared with flp_query_batched) ---
+    seeds, gcoeffs, t, outs, pw, L0, Lc = _query_proof_side(bc, proof_share, query_rand)
+    # call weights zero-padded so tail calls beyond `calls` contribute 0
     padc = plan.n_steps * gcalls - bc.calls
     if padc:
         Lc = fmap(lambda x: jnp.pad(x, ((0, 0), (0, padc))), Lc)
 
     # --- streamed input-share folds ---
     r = fmap(lambda x: x[:, 0], joint_rand)
-    rt = anti_recompute_barrier(powers(jf, r, G))  # [batch, G]: r^0..r^{G-1}
-    rstep = fpow_const(jf, r, G)  # r^G
     s_const = fconst(jf, shares_inv)
-    two_pows = _two_power_consts(jf, plan.bits) if is_sumvec else None
 
     from ..fields.jfield import fzeros
 
-    def body(carry, step):
-        base, W0, W1, S = carry  # base = r^{step*G + 1}
-        x = meas_source(step)  # [batch, G]
-        mask = (step * G + jnp.arange(G)) < n  # [G]
-        x = fmap(lambda v: jnp.where(mask[None, :], v, jnp.zeros_like(v)), x)
-        # gadget wire pair (a, b) per element k: (r^{k+1} x_k, x_k - 1/shares)
-        a = jf.mul(jf.mul(fmap(lambda v: v[:, None], base), rt), x)
-        b = fmap(
-            lambda v, z: jnp.where(mask[None, :], v, z),
-            jf.sub(x, s_const),
-            fzeros(jf, (batch, G)),
-        )
-        a_r = fmap(lambda v: v.reshape(batch, gcalls, ch), a)
-        b_r = fmap(lambda v: v.reshape(batch, gcalls, ch), b)
-        Lg = fmap(
-            lambda v: jax.lax.dynamic_slice_in_dim(v, step * gcalls, gcalls, axis=1), Lc
-        )
-        Lg3 = fmap(lambda v: v[:, :, None], Lg)
-        W0 = jf.add(W0, fsum(jf, jf.mul(a_r, Lg3), axis=1))
-        W1 = jf.add(W1, fsum(jf, jf.mul(b_r, Lg3), axis=1))
-        S = jf.add(S, fsum(jf, x, axis=-1))
-        if is_sumvec:  # bits-major fold: out[e] = sum_b 2^b x_{e*bits+b}
-            v = fmap(
-                lambda w: jnp.swapaxes(w.reshape(batch, G // plan.bits, plan.bits), 1, 2), x
-            )
-            part = fsum(jf, jf.mul(v, fmap(lambda w: w[:, None], two_pows)), axis=1)
-        else:  # histogram truncate is the identity
-            part = x
-        base = jf.mul(base, rstep)
-        return (base, W0, W1, S), part
+    if _QUERY_MM:
+        # MXU form (see _flp_query_batched_mm): each step's fold is one
+        # limb matmul over its gcalls; r-powers and the shares_inv
+        # correction are applied once after the scan.
+        from ..ops.limbmm import fold_contract
 
-    init = (r, fzeros(jf, (batch, ch)), fzeros(jf, (batch, ch)), fzeros(jf, (batch,)))
-    carry, parts = jax.lax.scan(body, init, jnp.arange(plan.n_steps))
-    _, W0, W1, S = carry
+        w_full, rc1 = _chunked_wire_weights(bc, Lc, r)  # Lc is step-padded
+
+        def body(carry, step):
+            F0, F1, S = carry
+            x = meas_source(step)  # [batch, G]
+            mask = (step * G + jnp.arange(G)) < n  # [G]
+            x = fmap(lambda v: jnp.where(mask[None, :], v, jnp.zeros_like(v)), x)
+            Xg = fmap(lambda v: v.reshape(batch, gcalls, ch), x)
+            wg = fmap(
+                lambda v: jax.lax.dynamic_slice_in_dim(v, step * gcalls, gcalls, axis=2),
+                w_full,
+            )
+            Fg = fold_contract(jf, wg, Xg)  # [batch, 2, ch]
+            F0 = jf.add(F0, fmap(lambda v: v[:, 0], Fg))
+            F1 = jf.add(F1, fmap(lambda v: v[:, 1], Fg))
+            S = jf.add(S, fsum(jf, x, axis=-1))
+            if is_sumvec:  # bits-major fold: out[e] = sum_b 2^b x_{e*bits+b}
+                v = fmap(
+                    lambda w: jnp.swapaxes(
+                        w.reshape(batch, G // plan.bits, plan.bits), 1, 2
+                    ),
+                    x,
+                )
+                part = _pow2_weighted_sum(jf, v, plan.bits)
+            else:  # histogram truncate is the identity
+                part = x
+            return (F0, F1, S), part
+
+        init = (fzeros(jf, (batch, ch)), fzeros(jf, (batch, ch)), fzeros(jf, (batch,)))
+        carry, parts = jax.lax.scan(body, init, jnp.arange(plan.n_steps))
+        F0, F1, S = carry
+        W0 = jf.mul(F0, rc1)
+        W1 = jf.sub(F1, _chunked_b_correction(bc, Lc, shares_inv))
+    else:
+        rt = anti_recompute_barrier(powers(jf, r, G))  # [batch, G]: r^0..r^{G-1}
+        rstep = fpow_const(jf, r, G)  # r^G
+        two_pows = _two_power_consts(jf, plan.bits) if is_sumvec else None
+
+        def body(carry, step):
+            base, W0, W1, S = carry  # base = r^{step*G + 1}
+            x = meas_source(step)  # [batch, G]
+            mask = (step * G + jnp.arange(G)) < n  # [G]
+            x = fmap(lambda v: jnp.where(mask[None, :], v, jnp.zeros_like(v)), x)
+            # gadget wire pair (a, b) per element k: (r^{k+1} x_k, x_k - 1/shares)
+            a = jf.mul(jf.mul(fmap(lambda v: v[:, None], base), rt), x)
+            b = fmap(
+                lambda v, z: jnp.where(mask[None, :], v, z),
+                jf.sub(x, s_const),
+                fzeros(jf, (batch, G)),
+            )
+            a_r = fmap(lambda v: v.reshape(batch, gcalls, ch), a)
+            b_r = fmap(lambda v: v.reshape(batch, gcalls, ch), b)
+            Lg = fmap(
+                lambda v: jax.lax.dynamic_slice_in_dim(v, step * gcalls, gcalls, axis=1), Lc
+            )
+            Lg3 = fmap(lambda v: v[:, :, None], Lg)
+            W0 = jf.add(W0, fsum(jf, jf.mul(a_r, Lg3), axis=1))
+            W1 = jf.add(W1, fsum(jf, jf.mul(b_r, Lg3), axis=1))
+            S = jf.add(S, fsum(jf, x, axis=-1))
+            if is_sumvec:  # bits-major fold: out[e] = sum_b 2^b x_{e*bits+b}
+                v = fmap(
+                    lambda w: jnp.swapaxes(w.reshape(batch, G // plan.bits, plan.bits), 1, 2), x
+                )
+                part = fsum(jf, jf.mul(v, fmap(lambda w: w[:, None], two_pows)), axis=1)
+            else:  # histogram truncate is the identity
+                part = x
+            base = jf.mul(base, rstep)
+            return (base, W0, W1, S), part
+
+        init = (r, fzeros(jf, (batch, ch)), fzeros(jf, (batch, ch)), fzeros(jf, (batch,)))
+        carry, parts = jax.lax.scan(body, init, jnp.arange(plan.n_steps))
+        _, W0, W1, S = carry
+
     out_share = fmap(
         lambda v: jnp.moveaxis(v, 0, 1).reshape(batch, -1)[:, : circ.output_len], parts
     )

@@ -83,7 +83,15 @@ def _keccak_round(a, rc):
     return tuple(a)
 
 
-def keccak_f1600(state):
+# Round count for every permutation in this module (the kernel and the
+# scan path). 24 always in production; tests monkeypatch it to run the
+# full kernel plumbing at a reduced count in interpret mode
+# (tests/test_keccak_pallas.py) — patching here covers every dispatch
+# site, including the single-block kernel below.
+KECCAK_ROUNDS = 24
+
+
+def keccak_f1600(state, rounds: int | None = None):
     """One permutation. state: 25 u64 arrays (lane (x,y) at index x + 5*y).
 
     On TPU this dispatches to the Pallas kernel (janus_tpu.ops.
@@ -92,19 +100,22 @@ def keccak_f1600(state):
     lax.scan so the round body is traced and compiled once — an
     unrolled permutation inflates the XLA graph by ~2k ops per call
     site, which multiplies out to minutes of compile time across the
-    expansion pipeline.
+    expansion pipeline. `rounds < 24` exists for the reduced-round CI
+    differentials only (tests/test_keccak_pallas.py).
     """
     from ..ops import keccak_pallas
 
+    if rounds is None:
+        rounds = KECCAK_ROUNDS
     state = tuple(jnp.asarray(x, dtype=U64) for x in state)
     n = int(np.prod(state[0].shape)) if state[0].shape else 1
     if keccak_pallas.enabled(n):
-        return keccak_pallas.keccak_f1600_pallas(state)
+        return keccak_pallas.keccak_f1600_pallas(state, rounds)
 
     def body(a, rc):
         return _keccak_round(a, rc), None
 
-    out, _ = jax.lax.scan(body, state, jnp.asarray(_RC))
+    out, _ = jax.lax.scan(body, state, jnp.asarray(_RC[:rounds]))
     return out
 
 
@@ -138,8 +149,7 @@ def shake128_squeeze_lanes(msg_lanes, out_blocks: int):
         for blk in range(n_blocks):
             state = _absorb_block(state, msg_lanes[:, blk])
     else:
-        xs = jnp.moveaxis(msg_lanes, 1, 0)  # [n_blocks, batch, 21]
-        state, _ = jax.lax.scan(lambda st, blk: (_absorb_block(st, blk), None), state, xs)
+        state = _absorb_scan(state, msg_lanes)
     if out_blocks <= _UNROLL_BLOCKS:
         outs = []
         for blk in range(out_blocks):
@@ -147,12 +157,70 @@ def shake128_squeeze_lanes(msg_lanes, out_blocks: int):
                 state = keccak_f1600(state)
             outs.append(jnp.stack(state[:RATE_LANES], axis=-1))
         return jnp.stack(outs, axis=1)
+    return _squeeze_scan(state, out_blocks)
 
+
+# Sponge chains past this many blocks run as NESTED scans (an outer
+# scan of _SCAN_CHUNK-length inner scans): a single flat lax.scan goes
+# wildly superlinear past ~32k trip counts on the TPU runtime
+# (measured: 1.9 s at 32k blocks vs 209 s at 152k — BASELINE.md "Draft
+# mode"), which round 4 mistook for an inherent cost and capped the
+# draft device gate on. The chunking is value-neutral: the same
+# sequential permutation chain, same output blocks.
+_SCAN_CHUNK = 4096
+
+
+def _absorb_scan(state, msg_lanes):
+    n_blocks = msg_lanes.shape[1]
+
+    def absorb(st, blk):
+        return _absorb_block(st, blk), None
+
+    n_full = (n_blocks // _SCAN_CHUNK) if n_blocks > 2 * _SCAN_CHUNK else 0
+    if n_full:
+        head = jnp.moveaxis(
+            msg_lanes[:, : n_full * _SCAN_CHUNK].reshape(
+                msg_lanes.shape[0], n_full, _SCAN_CHUNK, RATE_LANES
+            ),
+            0,
+            2,
+        )  # [n_full, chunk, batch, 21]
+
+        def outer(st, chunk_blocks):
+            st2, _ = jax.lax.scan(absorb, st, chunk_blocks)
+            return st2, None
+
+        state, _ = jax.lax.scan(outer, state, head)
+        msg_lanes = msg_lanes[:, n_full * _SCAN_CHUNK :]
+    if msg_lanes.shape[1]:
+        xs = jnp.moveaxis(msg_lanes, 1, 0)
+        state, _ = jax.lax.scan(absorb, state, xs)
+    return state
+
+
+def _squeeze_scan(state, out_blocks: int):
     def squeeze(st, _):
         ys = jnp.stack(st[:RATE_LANES], axis=-1)
         return keccak_f1600(st), ys
 
-    _, ys = jax.lax.scan(squeeze, state, None, length=out_blocks)
+    if out_blocks <= 2 * _SCAN_CHUNK:
+        _, ys = jax.lax.scan(squeeze, state, None, length=out_blocks)
+        return jnp.moveaxis(ys, 0, 1)
+    # full chunks via the nested scan + a flat remainder scan (mirrors
+    # _absorb_scan; rounding the squeeze up would waste up to a whole
+    # chunk of permutations over the batch)
+    n_full = out_blocks // _SCAN_CHUNK
+    rem = out_blocks - n_full * _SCAN_CHUNK
+
+    def outer(st, _):
+        st2, ys = jax.lax.scan(squeeze, st, None, length=_SCAN_CHUNK)
+        return st2, ys
+
+    state, yss = jax.lax.scan(outer, state, None, length=n_full)
+    ys = yss.reshape(n_full * _SCAN_CHUNK, yss.shape[2], RATE_LANES)
+    if rem:
+        state, tail = jax.lax.scan(squeeze, state, None, length=rem)
+        ys = jnp.concatenate([ys, tail], axis=0)
     return jnp.moveaxis(ys, 0, 1)
 
 
@@ -222,12 +290,24 @@ PAD_START = np.uint64(0x1F)
 PAD_END = np.uint64(0x80) << np.uint64(56)
 
 
-def _single_block_keccak(lane_cols):
+def _single_block_keccak(lane_cols, out_lanes: int = 25):
     """Permute single-block messages given as a list of 21 lane arrays.
 
     lane_cols: 21 arrays of identical shape [...] (the rate lanes of the
-    already-padded message). Returns the full 25-lane output state.
+    already-padded message). Returns (at least) the first `out_lanes`
+    output lanes; callers that only need a digest or a rate block pass
+    a smaller out_lanes so the Pallas path can skip moving the rest
+    (keccak_single_block_pallas: 42 u32 rows in, 2*out_lanes out,
+    instead of the general kernel's 50/50).
     """
+    from ..ops import keccak_pallas
+
+    shape = lane_cols[0].shape
+    n = int(np.prod(shape)) if shape else 1
+    if out_lanes < 25 and keccak_pallas.enabled(n):
+        return keccak_pallas.keccak_single_block_pallas(
+            lane_cols, out_lanes, rounds=KECCAK_ROUNDS
+        )
     zeros = jnp.zeros_like(lane_cols[0])
     state = tuple(lane_cols) + (zeros,) * 4
     return keccak_f1600(state)
@@ -265,7 +345,7 @@ def ctr_stream_lanes(prefix_parts, prefix_len_bytes: int, batch: int, out_blocks
             if lane == RATE_LANES - 1:
                 v |= PAD_END
             cols.append(jnp.broadcast_to(jnp.asarray(v), shape))
-    state = _single_block_keccak(cols)
+    state = _single_block_keccak(cols, out_lanes=RATE_LANES)
     return jnp.stack(state[:RATE_LANES], axis=-1)  # [batch, out_blocks, 21]
 
 
@@ -273,6 +353,34 @@ TREE_MAGIC_LANE = np.frombuffer(b"JanusTr1", dtype="<u8")[0]
 TREE_CHUNK_LANES = 14  # 112 bytes
 TREE_ARITY = 7
 TREE_DIGEST_LANES = 2
+
+
+def _tree_level_planar(planes, level: int, total_lanes_bytes: int):
+    """Hash one tree level from plane-major input: planes
+    [batch, 14, n] -> digests [batch, n, 2]. Node k's payload lane j is
+    planes[:, j, k] — a contiguous row slice."""
+    batch, _, n = planes.shape
+    shape = (batch, n)
+    idx = jnp.broadcast_to(jnp.arange(n, dtype=U64)[None, :], shape)
+    consts = {
+        0: np.uint64(TREE_MAGIC_LANE),
+        1: np.uint64(level),
+        3: np.uint64(total_lanes_bytes),
+        18: PAD_START,
+        20: PAD_END,
+    }
+    cols = []
+    for lane in range(RATE_LANES):
+        if lane == 2:
+            cols.append(idx)
+        elif 4 <= lane < 4 + TREE_CHUNK_LANES:
+            cols.append(planes[:, lane - 4, :])
+        else:
+            cols.append(
+                jnp.broadcast_to(jnp.asarray(consts.get(lane, np.uint64(0))), shape)
+            )
+    state = _single_block_keccak(cols, out_lanes=TREE_DIGEST_LANES)
+    return jnp.stack(state[:TREE_DIGEST_LANES], axis=-1)
 
 
 def _tree_level(chunks, level: int, total_lanes_bytes: int):
@@ -297,7 +405,7 @@ def _tree_level(chunks, level: int, total_lanes_bytes: int):
             cols.append(
                 jnp.broadcast_to(jnp.asarray(consts.get(lane, np.uint64(0))), shape)
             )
-    state = _single_block_keccak(cols)
+    state = _single_block_keccak(cols, out_lanes=TREE_DIGEST_LANES)
     return jnp.stack(state[:TREE_DIGEST_LANES], axis=-1)  # [batch, n, 2]
 
 
@@ -305,17 +413,20 @@ def tree_digest_lanes(data_parts, data_len_bytes: int, batch: int):
     """Arity-7 Merkle digest of lane-aligned data: [batch, 2] u64.
 
     Byte-identical to janus_tpu.vdaf.xof.tree_digest. Each level is one
-    batched permutation over all of that level's nodes.
+    batched permutation over all of that level's nodes. Level 0 uses
+    the PLANAR leaf mapping (lane j of leaf k = data lane j*n+k, see
+    tree_digest): each leaf lane column is one contiguous slice of the
+    binder instead of a stride-14 gather over all of it.
     """
     assert data_len_bytes % 8 == 0
     lanes_n = data_len_bytes // 8
     data = _assemble_segments(data_parts, lanes_n, batch)  # [batch, L]
-    n = -(-lanes_n // TREE_CHUNK_LANES)
+    n = max(1, -(-lanes_n // TREE_CHUNK_LANES))
     pad = n * TREE_CHUNK_LANES - lanes_n
     if pad:
         data = jnp.pad(data, ((0, 0), (0, pad)))
-    chunks = data.reshape(batch, n, TREE_CHUNK_LANES)
-    digs = _tree_level(chunks, 0, data_len_bytes)  # [batch, n, 2]
+    planes = data.reshape(batch, TREE_CHUNK_LANES, n)
+    digs = _tree_level_planar(planes, 0, data_len_bytes)  # [batch, n, 2]
     level = 0
     while n > 1:
         level += 1

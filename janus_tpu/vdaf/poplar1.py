@@ -55,6 +55,11 @@ USAGE_CONVERT = 5
 USAGE_EXTEND = 6
 USAGE_VERIFY_RAND = 7
 USAGE_CORR_RAND = 8
+# Domain separation for the convert VALUE vector lives in the usage id
+# (not a binder): every XOF prefix stays lane-aligned, which is what
+# lets the batched device walk (poplar1_jax) share the single-block
+# counter-mode Keccak machinery.
+USAGE_CONVERT_VALUE = 9
 
 
 def _xof_vec(field, seed: bytes, usage: int, binder: bytes, length: int):
@@ -75,7 +80,7 @@ def _extend(seed: bytes) -> tuple[bytes, int, bytes, int]:
 def _convert(field, seed: bytes, length: int) -> tuple[bytes, list[int]]:
     """Seed -> (next seed, value vector) in the level's field."""
     nxt = XofShake128.derive_seed(seed, dst(ALGO_ID, USAGE_CONVERT), b"")
-    return nxt, _xof_vec(field, seed, USAGE_CONVERT, b"next", length)
+    return nxt, _xof_vec(field, seed, USAGE_CONVERT_VALUE, b"", length)
 
 
 @dataclass

@@ -108,7 +108,17 @@ def _le64(i: int) -> bytes:
 
 
 def tree_digest(data: bytes) -> bytes:
-    """16-byte Merkle digest of lane-aligned data (see module docstring)."""
+    """16-byte Merkle digest of lane-aligned data (see module docstring).
+
+    Leaf k's payload is PLANAR: u64 lane j*n+k for j in 0..13 (n = leaf
+    count), a fixed bijection of the data rather than contiguous
+    112-byte chunks. Rationale: on device every leaf lane column is
+    then one contiguous slice — the contiguous-chunk layout forced a
+    stride-14 gather over the whole binder (~30% of the digest wall
+    time at the 25.6 MB len=100k leader binder, measured r5). Same
+    collision resistance: the node encoding is unchanged and the
+    leaf<->data mapping is a bijection.
+    """
     assert len(data) % 8 == 0
     total = _le64(len(data))
 
@@ -117,10 +127,13 @@ def tree_digest(data: bytes) -> bytes:
         msg = TREE_MAGIC + _le64(level) + _le64(index) + total + payload
         return hashlib.shake_128(msg).digest(TREE_DIGEST_SIZE)
 
-    digs = [
-        node(0, k, data[off : off + TREE_CHUNK].ljust(TREE_CHUNK, b"\x00"))
-        for k, off in enumerate(range(0, len(data), TREE_CHUNK))
-    ]
+    import numpy as _np
+
+    lanes = _np.frombuffer(data, dtype="<u8")
+    n = max(1, -(-lanes.size // (TREE_CHUNK // 8)))
+    planes = _np.zeros((TREE_CHUNK // 8, n), dtype=_np.uint64)
+    planes.reshape(-1)[: lanes.size] = lanes
+    digs = [node(0, k, planes[:, k].tobytes()) for k in range(n)]
     level = 0
     while len(digs) > 1:
         level += 1

@@ -1,0 +1,152 @@
+"""Exactness fuzzing for the MXU limb-contraction and pow2 const-mul.
+
+fold_contract must be bit-identical to the sequential field math for
+arbitrary reduced inputs — it replaces the FLP query's hot loop, where
+any deviation flips verifier equality and rejects honest reports.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from janus_tpu.fields.jfield import JF64, JF128, fmul_pow2, fsum
+from janus_tpu.ops.limbmm import fold_contract
+
+
+def _rand_field(jf, rng, shape):
+    ints = rng.integers(0, np.iinfo(np.uint64).max, size=shape, dtype=np.uint64)
+    vals = ints.astype(object)
+    if jf.LIMBS == 2:
+        hi = rng.integers(0, np.uint64(1) << np.uint64(63), size=shape, dtype=np.uint64)
+        vals = vals + (hi.astype(object) << 64)
+    vals = vals % jf.MODULUS
+    return jf.from_ints(vals), vals
+
+
+@pytest.mark.parametrize("jf", [JF64, JF128], ids=["f64", "f128"])
+@pytest.mark.parametrize("dtype", ["int8", "f32"])
+def test_fold_contract_exact(jf, dtype, monkeypatch):
+    monkeypatch.setenv("JANUS_LIMBMM_DTYPE", dtype)
+    rng = np.random.default_rng(42 + jf.LIMBS)
+    b, W, calls, C = 3, 2, 37, 11
+    w, w_ints = _rand_field(jf, rng, (b, W, calls))
+    X, x_ints = _rand_field(jf, rng, (b, calls, C))
+    got = jf.to_ints(fold_contract(jf, w, X))
+    p = jf.MODULUS
+    for bi in range(b):
+        for wi in range(W):
+            for c in range(C):
+                expect = (
+                    sum(int(w_ints[bi, wi, k]) * int(x_ints[bi, k, c]) for k in range(calls))
+                    % p
+                )
+                assert int(got[bi, wi, c]) == expect, (bi, wi, c)
+
+
+@pytest.mark.parametrize("jf", [JF64, JF128], ids=["f64", "f128"])
+def test_fold_contract_matches_sequential_field_ops(jf):
+    """Same value as mul+fsum on device (the path it replaces)."""
+    rng = np.random.default_rng(7)
+    b, W, calls, C = 2, 3, 50, 8
+    w, _ = _rand_field(jf, rng, (b, W, calls))
+    X, _ = _rand_field(jf, rng, (b, calls, C))
+    got = fold_contract(jf, w, X)
+    import jax.numpy as jnp
+
+    from janus_tpu.fields.jfield import fmap
+
+    prod = jf.mul(
+        fmap(lambda a: a[:, :, :, None], w), fmap(lambda a: a[:, None, :, :], X)
+    )
+    want = fsum(jf, prod, axis=2)
+    for g, e in zip(got, want):
+        assert (np.asarray(g) == np.asarray(e)).all()
+
+
+@pytest.mark.parametrize("jf", [JF64, JF128], ids=["f64", "f128"])
+def test_fold_contract_segmented(jf, monkeypatch):
+    """f32 path segments the contraction at 1024 calls; force a tiny
+    segment to exercise multi-segment accumulation."""
+    import janus_tpu.ops.limbmm as mm
+
+    monkeypatch.setitem(mm._SEG, "int8", 16)
+    rng = np.random.default_rng(11)
+    b, W, calls, C = 2, 1, 45, 5
+    w, w_ints = _rand_field(jf, rng, (b, W, calls))
+    X, x_ints = _rand_field(jf, rng, (b, calls, C))
+    got = jf.to_ints(fold_contract(jf, w, X))
+    p = jf.MODULUS
+    expect = (
+        sum(int(w_ints[0, 0, k]) * int(x_ints[0, k, 2]) for k in range(calls)) % p
+    )
+    assert int(got[0, 0, 2]) == expect
+
+
+@pytest.mark.parametrize("jf", [JF64, JF128], ids=["f64", "f128"])
+@pytest.mark.parametrize("k", [0, 1, 7, 15, 16, 31, 32, 33, 47, 63])
+def test_fmul_pow2(jf, k):
+    rng = np.random.default_rng(100 + k)
+    v, ints = _rand_field(jf, rng, (64,))
+    got = jf.to_ints(fmul_pow2(jf, v, k))
+    for i in range(64):
+        assert int(got[i]) == (int(ints[i]) << k) % jf.MODULUS
+
+
+@pytest.mark.parametrize("kind", ["sumvec", "histogram"])
+def test_query_mm_matches_fold_path(kind, monkeypatch):
+    """The MXU query and the VPU fold query are the same field elements
+    (both batched and streamed): flip engine._QUERY_MM at call time."""
+    import jax.numpy as jnp
+
+    import janus_tpu.vdaf.engine as eng
+    from janus_tpu.vdaf.engine import batched_circuit, flp_query_batched
+    from janus_tpu.vdaf.reference import Histogram, SumVec
+
+    circ = SumVec(length=9, bits=4) if kind == "sumvec" else Histogram(24)
+    bc = batched_circuit(circ)
+    jf = bc.jf
+    rng = np.random.default_rng(5)
+    b = 4
+    inp, _ = _rand_field(jf, rng, (b, circ.input_len))
+    proof, _ = _rand_field(jf, rng, (b, circ.proof_len))
+    qr, _ = _rand_field(jf, rng, (b, circ.query_rand_len))
+    jr, _ = _rand_field(jf, rng, (b, circ.joint_rand_len))
+
+    monkeypatch.setattr(eng, "_QUERY_MM", True)
+    got = flp_query_batched(bc, inp, proof, qr, jr, 2)
+    monkeypatch.setattr(eng, "_QUERY_MM", False)
+    want = flp_query_batched(bc, inp, proof, qr, jr, 2)
+    for g, e in zip(got, want):
+        assert (np.asarray(g) == np.asarray(e)).all()
+
+
+def test_streamed_query_mm_matches_fold_path(monkeypatch):
+    import janus_tpu.vdaf.engine as eng
+    from janus_tpu.vdaf.engine import (
+        batched_circuit,
+        flp_query_streamed,
+        sliced_meas_source,
+        stream_plan,
+    )
+    from janus_tpu.vdaf.reference import SumVec
+
+    circ = SumVec(length=64, bits=4)  # small but multi-step under a low cap
+    bc = batched_circuit(circ)
+    jf = bc.jf
+    plan = stream_plan(bc, min_input_len=1)
+    assert plan is not None and plan.n_steps > 1
+    rng = np.random.default_rng(17)
+    b = 3
+    meas, _ = _rand_field(jf, rng, (b, circ.input_len))
+    proof, _ = _rand_field(jf, rng, (b, circ.proof_len))
+    qr, _ = _rand_field(jf, rng, (b, circ.query_rand_len))
+    jr, _ = _rand_field(jf, rng, (b, circ.joint_rand_len))
+
+    out = {}
+    for flag in (True, False):
+        monkeypatch.setattr(eng, "_QUERY_MM", flag)
+        src = sliced_meas_source(bc, plan, meas)
+        out[flag] = flp_query_streamed(bc, plan, src, proof, qr, jr, 2)
+    for g, e in zip(out[True][0] + out[True][1], out[False][0] + out[False][1]):
+        assert (np.asarray(g) == np.asarray(e)).all()
