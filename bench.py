@@ -308,16 +308,11 @@ def run_poplar1(args, backend, progress, watchdog) -> None:
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compilation cache: re-runs of the same config skip
-    the multi-minute compile. jax is preimported (sitecustomize), so
-    env vars are a no-op — must go through jax.config."""
-    import jax
+    """Persistent XLA compilation cache (shared helper in binary_utils):
+    re-runs of the same config skip the multi-minute compile."""
+    from janus_tpu.binary_utils import enable_compile_cache
 
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax_comp_cache")
-    )
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    enable_compile_cache()
 
 
 def main() -> None:
@@ -447,6 +442,11 @@ def main() -> None:
     from janus_tpu.vdaf.testing import make_report_batch, random_measurements
 
     if args.config == "poplar1":
+        if args.mode != "device" or args.length or args.xof_mode != "fast":
+            ap.error(
+                "--config poplar1 supports only --mode device with the "
+                "fixed Poplar1<16> config (no --length/--xof-mode)"
+            )
         run_poplar1(args, backend, progress, watchdog)
         return
 
@@ -595,14 +595,19 @@ def main() -> None:
     # BENCH_r{N}.json witnesses it (VERDICT r3 item #2)
     north_star = None
     if args.config == "sumvec" and not args.length and args.mode == "device" and on_accel and args.xof_mode == "fast":
-        # (fast mode only: draft's device gate deliberately excludes
-        # len=100k — the sequential sponge is slower than host there)
+        # (fast mode only: draft-mode len=100k runs on device since r5
+        # but at ~1.3-5 r/s with ~50 s steps — measured separately,
+        # scripts/measure_draft_sponge.py --full-prepare; BASELINE.md
+        # "Draft mode")
         import dataclasses
 
         ns_inst = dataclasses.replace(inst, length=100_000)
         for attempt in range(3):  # the tunnel flakes transiently
             try:
-                ns_rps, ns_batch, ns_compile = measure_device(ns_inst, 32, max(2, args.iters // 2), reexec_on_oom=False)
+                # batch 64 is the measured r5 optimum (100.8 r/s; 32
+                # gives 83.3 — the dispatch floor is ~2x better
+                # amortized at 64 and HBM still fits)
+                ns_rps, ns_batch, ns_compile = measure_device(ns_inst, 64, max(2, args.iters // 2), reexec_on_oom=False)
                 north_star = {
                     "metric": "prio3_sumvec_len100k_two_party_prepare_accumulate",
                     "value": round(ns_rps, 2),

@@ -140,7 +140,7 @@ class _Coalescer:
     each job still writes and releases its own lease).
     """
 
-    __slots__ = ("_run", "_max_rows", "_lock", "_queue", "_active", "rounds")
+    __slots__ = ("_run", "_max_rows", "_lock", "_cv", "_queue", "_active", "rounds")
 
     def __init__(self, run, max_rows: int):
         import collections
@@ -148,6 +148,8 @@ class _Coalescer:
         self._run = run  # ([args...], [n...]) -> [per-call results]
         self._max_rows = max_rows
         self._lock = threading.Lock()
+        # signaled when the dispatcher role frees up with work queued
+        self._cv = threading.Condition(self._lock)
         self._queue: list[list] = []  # entries: [args, n, Event, result, error]
         self._active = False
         # calls per dispatched round, recent window only (stats/tests;
@@ -166,24 +168,27 @@ class _Coalescer:
             self._dispatch_until_done(ent)
         else:
             while not ent[2].is_set():
-                # the previous dispatcher may have exited with entries
-                # still queued (its own round finished first): adopt the
-                # role instead of waiting forever
+                # the previous dispatcher may exit with entries still
+                # queued (its own round finished first): a waiter is
+                # notified via the condition and adopts the role (the
+                # short timeout is only a lost-wakeup backstop)
                 with self._lock:
-                    adopt = not self._active and not ent[2].is_set()
+                    adopt = not self._active and not ent[2].is_set() and bool(self._queue)
                     if adopt:
                         self._active = True
+                    elif not ent[2].is_set():
+                        self._cv.wait(0.05)
+                        continue
                 if adopt:
                     self._dispatch_until_done(ent)
                     break
-                ent[2].wait(0.05)
         if ent[4] is not None:
             raise ent[4]
         return ent[3]
 
     def _dispatch_until_done(self, own):
         """Dispatch rounds until our own entry completes AND the queue
-        is drained or another thread can adopt the role."""
+        is drained or another thread adopts the role."""
         try:
             while True:
                 with self._lock:
@@ -211,16 +216,24 @@ class _Coalescer:
                     if not isinstance(ex, Exception):
                         for e in batch:
                             e[2].set()
+                        with self._lock:
+                            self._cv.notify_all()
                         raise
                 for e in batch:
                     e[2].set()
+                # wake cv-parked waiters so completed entries return
+                # immediately instead of on the 50 ms backstop
+                with self._lock:
+                    self._cv.notify_all()
                 if own[2].is_set():
-                    # our caller has work to do with its result; leave
-                    # remaining entries for a waiter to adopt (50 ms poll)
+                    # our caller has work to do with its result; hand
+                    # the role to a waiter (notified in finally)
                     return
         finally:
             with self._lock:
                 self._active = False
+                if self._queue:
+                    self._cv.notify()
 
 
 def _concat_args(args_list):
@@ -305,16 +318,26 @@ class EngineCache:
             self.sp = 1
         # cross-job dispatch coalescing (VERDICT r4 item 3): calls at or
         # below COALESCE_MAX_JOB rows ride shared device dispatches;
-        # bigger jobs fill a dispatch on their own and go direct.
+        # bigger jobs fill a dispatch on their own and go direct. The
+        # per-round row cap scales inversely with the instance's
+        # per-row size: a global 32768 tuned on Count would merge
+        # concurrent SumVec jobs past the measured single-dispatch HBM
+        # limit (len=1000 OOMs at batch 4096, BASELINE.md matrix) and
+        # fail every co-batched job at once.
         self._coalesce = os.environ.get("JANUS_COALESCE", "1") != "0"
-        self._co_leader = _Coalescer(self._run_leader_round, self.COALESCE_ROUND_ROWS)
-        self._co_helper = _Coalescer(self._run_helper_round, self.COALESCE_ROUND_ROWS)
+        in_len = max(1, getattr(self.p3.circ, "input_len", 1))
+        round_rows = max(
+            MIN_BUCKET, min(self.COALESCE_ROUND_ROWS, self.COALESCE_ROUND_ELEMS // in_len)
+        )
+        self._co_leader = _Coalescer(self._run_leader_round, round_rows)
+        self._co_helper = _Coalescer(self._run_helper_round, round_rows)
 
-    # Per-call row cap for joining a shared round, and the cap on one
-    # coalesced round (keeps the padded bucket within the measured
-    # single-dispatch sweet spot, BASELINE.md matrix).
+    # Per-call row cap for joining a shared round; absolute round row
+    # cap; and the rows x input_len budget one coalesced round may
+    # stage (2^25 elements = half the len=1000 OOM point at 4096 rows).
     COALESCE_MAX_JOB = 4096
     COALESCE_ROUND_ROWS = 32768
+    COALESCE_ROUND_ELEMS = 1 << 25
 
     def _shard(self, *batch_ndims):
         """NamedShardings splitting the leading (report) axis over 'dp';

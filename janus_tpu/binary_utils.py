@@ -107,6 +107,22 @@ def setup_signal_handler(stopper: Stopper) -> None:
     signal.signal(signal.SIGINT, handle)
 
 
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Turn on the persistent XLA compilation cache via jax.config (env
+    vars are a no-op once jax is preimported — sitecustomize does).
+    One shared helper for bench.py, the measurement scripts, the
+    dryrun entry, and the CLI precompile; the serving binaries
+    configure theirs from CommonConfig.compilation_cache_dir."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.expanduser(cache_dir or "~/.cache/jax_comp_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
 def warmup_engines_background(ds, buckets=None) -> "threading.Thread":
     """Ahead-of-time bucket compilation OFF the boot path (VERDICT r3
     weak #8: a fresh deployment's first job on a new batch bucket still

@@ -193,18 +193,22 @@ class Prio3BatchedDraft(Prio3Batched):
     # max sponge blocks per expansion (absorb or squeeze side). The
     # chain is sequential per report (~24 rounds/block of pure latency)
     # but fully batched across reports, and the scan-based sponge keeps
-    # the traced graph O(1) in stream length. The cap is set at the
-    # MEASURED latency knee (chip, 2026-07-31): a 32,768-block squeeze
-    # runs ~1.9 s steady, but a 152k-block one (SumVec len=100k) blows
-    # up superlinearly to ~209 s — the draft's sequential sponge
-    # construction fundamentally fights the hardware at that scale, and
-    # the device step would be SLOWER than the scalar host loop. 32,768
-    # blocks is 8x the round-3 range (the streamed query removed the
-    # memory wall; latency is now the only limit); truly huge
-    # spec-conformant tasks stay on the host fallback, and the fast
-    # framing — counter mode, one batched permutation for the whole
-    # stream — remains the reason north-star lengths fly (BASELINE.md).
-    MAX_STREAM_BLOCKS = 32_768
+    # the traced graph O(1) in stream length. History: round 4 capped
+    # this at 32,768 on a measured "superlinear knee" (1.9 s @ 32k vs
+    # 209 s @ 152k blocks); round 5 showed that knee was a FLAT-scan
+    # runtime pathology, not inherent — with nested scans
+    # (keccak_jax._SCAN_CHUNK) the chain is linear: 91 us/block at
+    # 152,382 blocks (13.9 s/chain @ batch 8, 8.9 s @ batch 256 —
+    # near-flat in batch, so amortization works). The cap now covers
+    # the north-star SumVec len=100k (152,382 blocks) with margin.
+    # Honest bound (measured 2026-08-01): a FULL draft len=100k
+    # prepare is ~5-6 sequential chains, 49.5 s/step at batch 64
+    # (1.29 r/s ~= the 1.3 r/s host loop; device wins from batch >=128
+    # and tops out ~2.5-5 r/s at the HBM-bound batch ~256) — the
+    # draft's sequential sponge remains why spec-framing cannot reach
+    # the fast framing's 100 r/s at this length on any single
+    # accelerator (BASELINE.md "Draft mode").
+    MAX_STREAM_BLOCKS = 160_000
 
     @classmethod
     def supports_circuit(cls, circ) -> bool:

@@ -40,11 +40,9 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.expanduser("~/.cache/jax_comp_cache")
-    )
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    from janus_tpu.binary_utils import enable_compile_cache
+
+    enable_compile_cache()
 
     backend = jax.default_backend()
     print(f"[profile] backend={backend}", flush=True)

@@ -275,14 +275,16 @@ def test_draft_streamed_query_matches_unstreamed(monkeypatch):
             np.testing.assert_array_equal(np.asarray(s), np.asarray(u))
 
 
-def test_draft_device_range_and_latency_knee():
-    """The device draft engine covers 8x the r3 range (the streamed
-    query removed the memory wall) but stops at the measured
-    sequential-sponge latency knee: past ~32k blocks a single squeeze
-    costs minutes on chip and the host loop wins (draft_jax
-    MAX_STREAM_BLOCKS docstring, measured 2026-07-31)."""
+def test_draft_device_range_covers_north_star():
+    """The device draft engine covers the north-star length: round 5
+    showed the r4 'superlinear knee' was a flat-scan pathology (nested
+    scans are linear, 91 us/block at 152k blocks), so the cap now
+    admits SumVec len=100k (152,382 blocks) with margin; truly huge
+    streams still fall back to the host loop (draft_jax
+    MAX_STREAM_BLOCKS docstring, measured 2026-08-01)."""
     from janus_tpu.vdaf.draft_jax import Prio3BatchedDraft
     from janus_tpu.vdaf.reference import SumVec
 
     assert Prio3BatchedDraft.supports_circuit(SumVec(14_000, 16))
-    assert not Prio3BatchedDraft.supports_circuit(SumVec(100_000, 16))
+    assert Prio3BatchedDraft.supports_circuit(SumVec(100_000, 16))
+    assert not Prio3BatchedDraft.supports_circuit(SumVec(120_000, 16))

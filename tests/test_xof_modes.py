@@ -103,19 +103,19 @@ def test_modes_produce_disjoint_transcripts():
 
 
 def test_batched_engine_draft_dispatch():
-    """Draft instances within the sponge-stream cap (raised 8x in r4 —
-    the streamed query removed the memory wall; the cap now sits at the
-    measured sequential-sponge latency knee, draft_jax.MAX_STREAM_BLOCKS)
-    get the device draft engine; beyond it the device would be slower
-    than the scalar host loop, so those fall back."""
+    """Draft instances within the sponge-stream cap (raised again in
+    r5: nested scans made long chains linear, so the cap now covers
+    the north-star len=100k — draft_jax.MAX_STREAM_BLOCKS) get the
+    device draft engine; truly huge streams still fall back to the
+    scalar host loop."""
     from janus_tpu.vdaf.draft_jax import Prio3BatchedDraft
 
     p3 = prio3_batched(VdafInstance("count", xof_mode="draft"))
     assert isinstance(p3, Prio3BatchedDraft)
-    mid = prio3_batched(VdafInstance("sumvec", bits=16, length=14_000, xof_mode="draft"))
+    mid = prio3_batched(VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"))
     assert isinstance(mid, Prio3BatchedDraft)
     with pytest.raises(ValueError):
-        prio3_batched(VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"))
+        prio3_batched(VdafInstance("sumvec", bits=16, length=120_000, xof_mode="draft"))
 
 
 def test_engine_cache_dispatches_by_stream_length():
@@ -128,15 +128,15 @@ def test_engine_cache_dispatches_by_stream_length():
     fast = engine_cache(VdafInstance("count"), VK)
     draft_short = engine_cache(VdafInstance("count", xof_mode="draft"), VK)
     draft_mid = engine_cache(
-        VdafInstance("sumvec", bits=16, length=14_000, xof_mode="draft"), VK
+        VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"), VK
     )
     draft_huge = engine_cache(
-        VdafInstance("sumvec", bits=16, length=100_000, xof_mode="draft"), VK
+        VdafInstance("sumvec", bits=16, length=120_000, xof_mode="draft"), VK
     )
     assert isinstance(fast, EngineCache)
     assert isinstance(draft_short, EngineCache)  # device draft engine
-    assert isinstance(draft_mid, EngineCache)  # r4: 8x the r3 device range
-    assert isinstance(draft_huge, HostEngineCache)  # past the latency knee
+    assert isinstance(draft_mid, EngineCache)  # r5: covers the north star
+    assert isinstance(draft_huge, HostEngineCache)  # past the stream cap
 
 
 def test_host_engine_matches_host_transcript():
