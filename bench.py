@@ -358,8 +358,10 @@ def main() -> None:
     ap.add_argument(
         "--max-seconds",
         type=float,
-        default=900.0,  # must exceed the worst remote-compile stretch
-        # (len=100k mm-query graph: ~450-600 s through the tunnel)
+        default=1500.0,  # must exceed the worst remote-compile stretch
+        # (len=100k mm-query step at batch 64: observed past 900 s
+        # through the tunnel's remote AOT compiler; the watchdog's job
+        # is wedged-grant detection, and 25 min still catches those)
         help="watchdog: if the accelerator path stalls past this (wedged "
         "tunnel grant), re-exec pinned to CPU so a real measurement is "
         "still produced",

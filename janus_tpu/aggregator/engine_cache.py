@@ -819,10 +819,10 @@ class HostEngineCache:
 def engine_cache(inst: VdafInstance, verify_key: bytes):
     if inst.xof_mode != "fast":
         # draft (VDAF-07) framing: device engine for every circuit
-        # whose sponge streams fit the measured latency knee
-        # (vdaf.draft_jax MAX_STREAM_BLOCKS, ~32k blocks = 8x the r3
-        # range); beyond it the sequential sponge is slower on device
-        # than the scalar host loop, which handles those
+        # whose sponge streams fit vdaf.draft_jax MAX_STREAM_BLOCKS
+        # (160k since r5 — covers the north-star len=100k; the r4
+        # "latency knee" was a flat-scan pathology, BASELINE.md "Draft
+        # mode"); truly huge streams keep the scalar host loop
         try:
             prio3_batched(inst)
         except ValueError:

@@ -75,14 +75,10 @@ def _precompile(args, ds) -> None:
     compilation_cache_dir (default ~/.cache/janus_tpu_xla)."""
     import time
 
-    import jax
-
-    from ..binary_utils import warmup_engines
+    from ..binary_utils import enable_compile_cache, warmup_engines
 
     cache_dir = os.path.expanduser(args.compilation_cache_dir)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    enable_compile_cache(cache_dir)
     buckets = [int(b) for b in str(args.precompile).split(",") if b]
     for b in sorted(buckets):
         t0 = time.time()

@@ -174,11 +174,11 @@ class Prio3BatchedDraft(Prio3Batched):
 
     Shares the entire FLP/field pipeline with the fast engine; only the
     XOF plumbing (framing, sampling, binder choices) differs.
-    `supports_circuit` bounds the sponge stream length at the measured
-    latency knee (MAX_STREAM_BLOCKS below): ~8x the round-3 device
-    range, but NOT the north-star SumVec len=100k — past the knee the
-    sequential sponge is slower on device than the scalar host loop,
-    which keeps those tasks.
+    `supports_circuit` bounds the sponge stream length
+    (MAX_STREAM_BLOCKS below): since r5 the cap covers the north-star
+    SumVec len=100k — nested scans made long chains linear — with the
+    device winning from batch >=128-equivalent amortization; truly
+    huge streams still fall back to the scalar host loop.
     """
 
     # Draft framing: sponge streams have no random-access counter and
