@@ -1,33 +1,114 @@
-"""Jitted device-step cache with batch-size bucketing.
+"""Jitted device-step cache with HBM-aware batch-size bucketing.
 
 One compiled executable serves many request sizes: batches are padded
 up to the next power-of-two bucket (padding lanes carry mask=False and
 are sliced off), so each (task VDAF, step kind) compiles O(log max
 batch) times total. This is the TPU answer to the reference's
 per-report loop — XLA sees static shapes, reports ride the batch axis.
+
+Bucketing is no longer blind (ISSUE r6): at construction each
+EngineCache asks the HBM feasibility model (vdaf.feasibility) for the
+largest bucket the device budget supports given the circuit geometry
+and the streamed-query tile, and batches beyond that cap are chunked
+into serial cap-sized dispatches instead of padded into one doomed
+one. When the model is still optimistic and the device raises
+RESOURCE_EXHAUSTED anyway, the engine halves its cap and retries; at
+the bucket floor it falls back to the scalar HostEngineCache —
+permanently for a definite RESOURCE_EXHAUSTED, with a timed device
+re-probe when only the ambiguous tunnel-500 marker was seen — so a
+serving aggregation job degrades to host speed instead of dying
+(previously only bench.py survived an OOM).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+import time
 from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..vdaf.engine import STREAM_MIN_INPUT_LEN
+from ..vdaf.engine import STREAM_MIN_INPUT_LEN, stream_plan
+from ..vdaf.feasibility import device_memory_budget, feasible_bucket
 from ..vdaf.registry import VdafInstance, prio3_batched
+
+log = logging.getLogger(__name__)
 
 MIN_BUCKET = 32
 
 
-def bucket_size(n: int) -> int:
+def bucket_size(n: int, cap: int | None = None) -> int:
+    """Power-of-two jit bucket for n rows, floored at MIN_BUCKET.
+
+    `cap` (the engine's HBM feasibility bound) clamps the result; a
+    capped bucket may be smaller than n, in which case the caller is
+    responsible for chunking the batch into cap-sized dispatches
+    (EngineCache does)."""
     b = MIN_BUCKET
     while b < n:
         b *= 2
+    if cap is not None and cap < b:
+        b = cap
     return b
+
+
+# Substrings identifying a device memory exhaustion across the ways it
+# surfaces (XlaRuntimeError RESOURCE_EXHAUSTED, allocator messages).
+_OOM_DEFINITE_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "OOM",
+    "Allocation failure",
+)
+# Errors that MAY be an HBM overflow but can equally be a transient
+# infra failure: the axon tunnel answers remote_compile with an opaque
+# 500 both when the program doesn't fit AND when the tunnel server
+# itself hiccups. These still get the halve-and-retry ladder, but a
+# host fallback reached through them is timed (re-probed), never
+# permanent — see EngineCache._host.
+_OOM_AMBIGUOUS_MARKERS = ("remote_compile: HTTP 500",)
+
+
+def is_oom_error(e: BaseException) -> bool:
+    s = str(e)
+    return any(m in s for m in _OOM_DEFINITE_MARKERS + _OOM_AMBIGUOUS_MARKERS)
+
+
+def _is_definite_oom(e: BaseException) -> bool:
+    s = str(e)
+    return any(m in s for m in _OOM_DEFINITE_MARKERS)
+
+
+def _annotate_dispatch_bucket(e: BaseException, b: int, fixed: bool = False) -> None:
+    """Record the bucket of the DISPATCH that raised. OOM recovery must
+    halve from the failed dispatch size — a coalesced round dispatches
+    many submitters' rows at once, and halving from one submitter's own
+    (much smaller) n would collapse the cap far below what actually
+    overflowed. `fixed` marks dispatches whose bucket cannot follow a
+    halved cap (aggregates over an already-resident device buffer), so
+    the handler knows retrying cannot make progress. Best-effort:
+    extension exception types without a __dict__ simply keep the
+    caller-n fallback."""
+    try:
+        if not hasattr(e, "_janus_dispatch_bucket"):
+            e._janus_dispatch_bucket = b
+            e._janus_fixed_bucket = fixed
+    except Exception:
+        pass
+
+
+def _cut_rows(a, s: int, e: int):
+    """Row-slice an arg that may be None, bytes, a field limb tuple, or
+    a plain array (the per-call arg vocabulary of pad_args)."""
+    if a is None or isinstance(a, (bytes, int)):
+        return a
+    if isinstance(a, tuple):
+        return tuple(x[s:e] for x in a)
+    return a[s:e]
 
 
 def _pad(arr, b: int):
@@ -316,6 +397,45 @@ class EngineCache:
             self.mesh = None
             self.dp = 1
             self.sp = 1
+        # HBM feasibility bound (ISSUE r6): the largest power-of-two
+        # bucket the device budget supports for this circuit, from the
+        # bytes model in vdaf.feasibility (staged share + proofs +
+        # outputs + the streamed-query tile working set). None =
+        # unknown budget (CPU backend, tunnel without memory_stats) =
+        # uncapped, preserving legacy behavior there. JANUS_BUCKET_CAP
+        # overrides for tests/tuning ("0" = explicitly uncapped).
+        circ = self.p3.circ
+        plan = stream_plan(self.p3.bc)
+        self.tile_elems = plan.group if plan is not None else None
+        env_cap = os.environ.get("JANUS_BUCKET_CAP")
+        if env_cap is not None:
+            cap = int(env_cap)
+            # buckets are powers of two (bucket_size) and mesh shards
+            # need dp | bucket — round a stray override down so e.g.
+            # "20" can't produce a 20-row axis dp can't partition
+            self.bucket_cap = (1 << (cap.bit_length() - 1)) if cap > 0 else None
+        else:
+            self.bucket_cap = feasible_bucket(
+                circ,
+                device_memory_budget(),
+                tile_elems=self.tile_elems,
+                draft=inst.xof_mode != "fast",
+            )
+        if self.bucket_cap is not None:
+            # mesh dispatches shard the report axis over dp devices;
+            # every bucket (hence the cap) must stay divisible by dp
+            self.bucket_cap = max(self.bucket_cap, self.dp)
+        # runtime OOM recovery state: halve-the-bucket retries mutate
+        # bucket_cap under the lock; at the floor the engine installs a
+        # HostEngineCache and serves from it — permanently for a
+        # definite RESOURCE_EXHAUSTED, with a timed device re-probe
+        # (_host) when only the ambiguous tunnel-500 marker was seen.
+        self._oom_lock = threading.Lock()
+        self._host_fallback: "HostEngineCache | None" = None
+        self._host_fallback_until: float | None = None
+        self._initial_bucket_cap = self.bucket_cap
+        # serializes multi-device program dispatch (see _jit)
+        self._mesh_dispatch_lock = threading.Lock()
         # cross-job dispatch coalescing (VERDICT r4 item 3): calls at or
         # below COALESCE_MAX_JOB rows ride shared device dispatches;
         # bigger jobs fill a dispatch on their own and go direct. The
@@ -323,12 +443,16 @@ class EngineCache:
         # per-row size: a global 32768 tuned on Count would merge
         # concurrent SumVec jobs past the measured single-dispatch HBM
         # limit (len=1000 OOMs at batch 4096, BASELINE.md matrix) and
-        # fail every co-batched job at once.
+        # fail every co-batched job at once — and never past the HBM
+        # feasibility cap.
         self._coalesce = os.environ.get("JANUS_COALESCE", "1") != "0"
         in_len = max(1, getattr(self.p3.circ, "input_len", 1))
         round_rows = max(
             MIN_BUCKET, min(self.COALESCE_ROUND_ROWS, self.COALESCE_ROUND_ELEMS // in_len)
         )
+        if self.bucket_cap is not None:
+            round_rows = min(round_rows, self.bucket_cap)
+        self._initial_round_rows = round_rows
         self._co_leader = _Coalescer(self._run_leader_round, round_rows)
         self._co_helper = _Coalescer(self._run_helper_round, round_rows)
 
@@ -360,25 +484,184 @@ class EngineCache:
     def _jit(self, name: str, fn, in_shardings=None):
         if name not in self._jits:
             if self.mesh is not None and in_shardings is not None:
-                self._jits[name] = jax.jit(fn, in_shardings=in_shardings)
+                jitted = jax.jit(fn, in_shardings=in_shardings)
             else:
-                self._jits[name] = jax.jit(fn)
+                jitted = jax.jit(fn)
+            if self.mesh is not None:
+                # Single-controller multi-device programs deadlock when
+                # two threads interleave their per-device enqueues (each
+                # device then waits on the other program's collective).
+                # Serialize the DISPATCH only — execution stays async —
+                # so concurrent jobs keep coalescing/pipelining safely.
+                lock = self._mesh_dispatch_lock
+
+                def locked(*a, _jitted=jitted, **k):
+                    with lock:
+                        return _jitted(*a, **k)
+
+                self._jits[name] = locked
+            else:
+                self._jits[name] = jitted
         return self._jits[name]
+
+    # --- OOM recovery (shared by every public step) ---
+    def _handle_engine_error(self, e: BaseException, n: int) -> None:
+        """Called from an except block. Re-raises non-OOM errors;
+        otherwise halves the bucket cap (so the caller's retry chunks
+        smaller) and, at the bucket floor, installs the permanent
+        HostEngineCache fallback. Never lets the OOM escape — the
+        aggregation job driver sees a slow success, not a dead job."""
+        if not is_oom_error(e):
+            raise
+        with self._oom_lock:
+            if self._host_fallback is not None:
+                return
+            # A coalesced round hands the SAME exception object to every
+            # co-batched submitter's retry loop; only the first may act,
+            # or one transient OOM would halve once per submitter and
+            # walk the cap straight to the host-fallback floor.
+            if getattr(e, "_janus_oom_handled", False):
+                return
+            try:
+                e._janus_oom_handled = True
+            except Exception:
+                pass
+            floor = max(1, self.dp)
+            observed = getattr(e, "_janus_dispatch_bucket", None)
+            if observed is None:
+                observed = bucket_size(n, self.bucket_cap)
+            # halving only helps dispatches whose bucket tracks the cap.
+            # An aggregate over an ALREADY-RESIDENT device buffer re-runs
+            # at the buffer's fixed bucket no matter the cap, so a
+            # persistent OOM there would loop forever at new_cap ==
+            # bucket_cap — treat "no progress possible" as the floor.
+            stuck = (
+                getattr(e, "_janus_fixed_bucket", False)
+                and self.bucket_cap is not None
+                and observed // 2 >= self.bucket_cap
+            )
+            if observed <= floor or stuck:
+                definite = _is_definite_oom(e)
+                log.warning(
+                    "device OOM at bucket floor %d for %s; falling back to "
+                    "the host engine %s: %s",
+                    floor,
+                    self.inst.kind,
+                    "permanently" if definite
+                    else f"for {self.HOST_FALLBACK_RETRY_SECS:.0f}s (ambiguous tunnel error)",
+                    e,
+                )
+                from ..metrics import engine_host_fallback_counter
+
+                engine_host_fallback_counter.add()
+                self._host_fallback = HostEngineCache(self.inst, self.verify_key)
+                # A genuine HBM overflow at bucket 1 can never fit, so
+                # the fallback is final. The tunnel's opaque 500 could
+                # equally be a restart/outage — re-probe the device
+                # path after a cool-down instead of pinning a recovered
+                # tunnel to the ~100x slower host loop forever.
+                self._host_fallback_until = (
+                    None if definite else time.monotonic() + self.HOST_FALLBACK_RETRY_SECS
+                )
+                return
+            new_cap = observed // 2
+            self.bucket_cap = new_cap if self.bucket_cap is None else min(self.bucket_cap, new_cap)
+            self._co_leader._max_rows = min(self._co_leader._max_rows, self.bucket_cap)
+            self._co_helper._max_rows = min(self._co_helper._max_rows, self.bucket_cap)
+            log.warning(
+                "device OOM at bucket %d for %s; retrying with bucket cap %d: %s",
+                observed, self.inst.kind, self.bucket_cap, e,
+            )
+            from ..metrics import engine_oom_retry_counter
+
+            engine_oom_retry_counter.add()
+
+    # Cool-down before a host fallback reached through an AMBIGUOUS
+    # error (tunnel 500) re-probes the device path.
+    HOST_FALLBACK_RETRY_SECS = 60.0
+
+    def _host(self) -> "HostEngineCache | None":
+        """Active host fallback, honoring the ambiguous-OOM expiry: a
+        definite RESOURCE_EXHAUSTED at the bucket floor pins the host
+        engine for the process lifetime (until=None); a tunnel-500
+        fallback expires after HOST_FALLBACK_RETRY_SECS, restoring the
+        initial feasibility caps so a recovered tunnel serves at full
+        device speed again (a still-broken one just re-walks the
+        halving ladder once per cool-down)."""
+        host = self._host_fallback
+        if host is None:
+            return None
+        until = self._host_fallback_until
+        if until is None or time.monotonic() < until:
+            return host
+        with self._oom_lock:
+            if self._host_fallback is host and self._host_fallback_until == until:
+                log.warning(
+                    "re-probing device engine for %s after ambiguous-OOM host fallback",
+                    self.inst.kind,
+                )
+                self._host_fallback = None
+                self._host_fallback_until = None
+                self.bucket_cap = self._initial_bucket_cap
+                self._co_leader._max_rows = self._initial_round_rows
+                self._co_helper._max_rows = self._initial_round_rows
+            return self._host_fallback
 
     # --- helper side: init + combine + decide in one traced step ---
     def helper_init(self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
         """Returns (out1 field value, accept mask, prep_msg lanes) sliced
         to the true batch size. Small batches coalesce with concurrent
-        callers into one device dispatch (_Coalescer)."""
+        callers into one device dispatch (_Coalescer). Device OOM is
+        absorbed: halved-bucket retry, then host fallback."""
+        args = (nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask)
+        while True:
+            host = self._host()
+            if host is not None:
+                return host.helper_init(*args)
+            try:
+                return self._helper_init_entry(*args)
+            except Exception as e:  # noqa: BLE001 - OOM filter inside
+                self._handle_engine_error(e, nonce_lanes.shape[0])
+
+    def _helper_init_entry(self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
         n = nonce_lanes.shape[0]
-        if self._coalesce and n <= self.COALESCE_MAX_JOB:
+        cap = self.bucket_cap
+        if self._coalesce and n <= self.COALESCE_MAX_JOB and (cap is None or n <= cap):
             return self._co_helper.submit(
                 (nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask),
                 n,
             )
+        if cap is not None and n > cap:
+            return self._helper_init_chunked(
+                nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask, cap
+            )
         return self._helper_init_inner(
             nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask
         )
+
+    def _helper_init_chunked(
+        self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask, cap: int
+    ):
+        """Serial cap-sized dispatches for a batch past the HBM bound —
+        each chunk's working set fits the budget; out shares stay
+        device-resident as DeviceRowsChunks."""
+        n = nonce_lanes.shape[0]
+        outs, masks, preps = [], [], []
+        for s in range(0, n, cap):
+            e = min(s + cap, n)
+            out1, mask, prep = self._helper_init_inner(
+                _cut_rows(nonce_lanes, s, e),
+                _cut_rows(public_parts, s, e),
+                _cut_rows(helper_seeds, s, e),
+                _cut_rows(blinds, s, e),
+                _cut_rows(ver0, s, e),
+                _cut_rows(part0, s, e),
+                _cut_rows(ok_mask, s, e),
+            )
+            outs.append(out1)
+            masks.append(mask)
+            preps.append(prep)
+        return DeviceRowsChunks(outs), np.concatenate(masks), np.concatenate(preps)
 
     def _run_helper_round(self, args_list, ns):
         offsets = list(np.cumsum([0] + ns))
@@ -387,6 +670,16 @@ class EngineCache:
             return [(out1, mask, prep_msg)]
         merged = _concat_args(args_list)
         out1, mask, prep_msg = self._helper_init_inner(*merged, coalesced=len(ns))
+        if isinstance(out1, DeviceRowsChunks):
+            # the bucket cap halved between round admission and dispatch
+            # (concurrent OOM recovery) and the merged round chunked:
+            # split on host rows — plain limb tuples are valid out-share
+            # currency (HostEngineCache returns them)
+            rows = out1.to_numpy()
+            return [
+                (tuple(x[s:e] for x in rows), mask[s:e], prep_msg[s:e])
+                for s, e in zip(offsets, offsets[1:])
+            ]
         return [
             (DeviceRows(out1.value, e - s, offset=s), mask[s:e], prep_msg[s:e])
             for s, e in zip(offsets, offsets[1:])
@@ -398,7 +691,14 @@ class EngineCache:
     ):
         p3 = self.p3
         n = nonce_lanes.shape[0]
-        b = bucket_size(n)
+        cap = self.bucket_cap  # read once — concurrent OOM recovery may
+        # halve it between the entry/coalescer gate and here; a stale
+        # smaller cap with n > cap must chunk, never pad negative
+        if cap is not None and n > cap:
+            return self._helper_init_chunked(
+                nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask, cap
+            )
+        b = bucket_size(n, cap)
 
         def step(nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
             out1, seed1, ver1, part1 = p3.prepare_init_helper(
@@ -431,20 +731,24 @@ class EngineCache:
         # must sit inside the span or it measures only async dispatch.
         # out1 stays ON DEVICE (DeviceRows): the aggregate step reads it
         # there; only the small mask/prep_msg come back.
-        with span(
-            "engine.helper_init",
-            vdaf=self.inst.kind,
-            batch=n,
-            bucket=b,
-            coalesced=coalesced,
-        ):
-            with span("engine.helper_init.put"):
-                args = put_args(args, block=True, shardings=shardings)
-            with span("engine.helper_init.dispatch"):
-                out1, mask, prep_msg = fn(*args)
-            with span("engine.helper_init.fetch"):
-                mask = np.asarray(mask)[:n]
-                prep_msg = np.asarray(prep_msg)[:n]
+        try:
+            with span(
+                "engine.helper_init",
+                vdaf=self.inst.kind,
+                batch=n,
+                bucket=b,
+                coalesced=coalesced,
+            ):
+                with span("engine.helper_init.put"):
+                    args = put_args(args, block=True, shardings=shardings)
+                with span("engine.helper_init.dispatch"):
+                    out1, mask, prep_msg = fn(*args)
+                with span("engine.helper_init.fetch"):
+                    mask = np.asarray(mask)[:n]
+                    prep_msg = np.asarray(prep_msg)[:n]
+        except Exception as e:
+            _annotate_dispatch_bucket(e, b)
+            raise
         return DeviceRows(out1, n), mask, prep_msg
 
     # Pipelined leader init: jobs past 2x this size split into chunks
@@ -459,8 +763,19 @@ class EngineCache:
         # ok is accepted for interface parity with HostEngineCache; the
         # batched device step costs nothing extra for failed lanes
         # (their rows are zeroed and masked downstream).
+        while True:
+            host = self._host()
+            if host is not None:
+                return host.leader_init(nonce_lanes, public_parts, meas, proof, blind0, ok)
+            try:
+                return self._leader_init_entry(nonce_lanes, public_parts, meas, proof, blind0)
+            except Exception as e:  # noqa: BLE001 - OOM filter inside
+                self._handle_engine_error(e, nonce_lanes.shape[0])
+
+    def _leader_init_entry(self, nonce_lanes, public_parts, meas, proof, blind0):
         n = nonce_lanes.shape[0]
-        if self._coalesce and n <= self.COALESCE_MAX_JOB:
+        cap = self.bucket_cap
+        if self._coalesce and n <= self.COALESCE_MAX_JOB and (cap is None or n <= cap):
             return self._co_leader.submit(
                 (nonce_lanes, public_parts, meas, proof, blind0), n
             )
@@ -476,10 +791,18 @@ class EngineCache:
         out0, seed0, ver0, part0 = self._leader_init_inner(
             *merged, coalesced=len(ns), allow_pipeline=False
         )
-        outs = [
-            DeviceRows(out0.value, e - s, offset=s)
-            for s, e in zip(offsets, offsets[1:])
-        ]
+        if isinstance(out0, DeviceRowsChunks):
+            # cap halved mid-round (concurrent OOM recovery): split on
+            # host rows instead of device-buffer views
+            rows = out0.to_numpy()
+            outs = [
+                tuple(x[s:e] for x in rows) for s, e in zip(offsets, offsets[1:])
+            ]
+        else:
+            outs = [
+                DeviceRows(out0.value, e - s, offset=s)
+                for s, e in zip(offsets, offsets[1:])
+            ]
         seeds = _split_rows(seed0, offsets)
         vers = _split_rows(ver0, offsets)
         parts = _split_rows(part0, offsets)
@@ -497,11 +820,19 @@ class EngineCache:
     ):
         p3 = self.p3
         n = nonce_lanes.shape[0]
+        cap = self.bucket_cap
+        if cap is not None and n > cap:
+            # past the HBM bound: serial cap-sized dispatches (staging
+            # everything up front, as the pipelined path does, would
+            # resident-stage exactly the bytes the cap exists to avoid)
+            return self._leader_init_chunked(
+                nonce_lanes, public_parts, meas, proof, blind0, cap
+            )
         if allow_pipeline and self.mesh is None and n >= 2 * self.PIPELINE_CHUNK:
             return self._leader_init_pipelined(
                 nonce_lanes, public_parts, meas, proof, blind0
             )
-        b = bucket_size(n)
+        b = bucket_size(n, cap)
 
         def step(nonce_lanes, public_parts, meas, proof, blind0):
             return p3.prepare_init_leader(
@@ -526,24 +857,56 @@ class EngineCache:
         # conversions block on device execution — keep inside the span.
         # out0 stays ON DEVICE (DeviceRows) for the later aggregate;
         # seed0/ver0/part0 are needed host-side for the wire round trip.
-        with span(
-            "engine.leader_init",
-            vdaf=self.inst.kind,
-            batch=n,
-            bucket=b,
-            coalesced=coalesced,
-        ):
-            with span("engine.leader_init.put"):
-                args = put_args(args, block=True, shardings=shardings)
-            with span("engine.leader_init.dispatch"):
-                out0, seed0, ver0, part0 = fn(*args)
-            with span("engine.leader_init.fetch_seed"):
-                seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
-            with span("engine.leader_init.fetch_ver"):
-                ver0 = tuple(np.asarray(x)[:n] for x in ver0)
-            with span("engine.leader_init.fetch_part"):
-                part0 = np.asarray(part0)[:n] if part0 is not None else None
+        try:
+            with span(
+                "engine.leader_init",
+                vdaf=self.inst.kind,
+                batch=n,
+                bucket=b,
+                coalesced=coalesced,
+            ):
+                with span("engine.leader_init.put"):
+                    args = put_args(args, block=True, shardings=shardings)
+                with span("engine.leader_init.dispatch"):
+                    out0, seed0, ver0, part0 = fn(*args)
+                with span("engine.leader_init.fetch_seed"):
+                    seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
+                with span("engine.leader_init.fetch_ver"):
+                    ver0 = tuple(np.asarray(x)[:n] for x in ver0)
+                with span("engine.leader_init.fetch_part"):
+                    part0 = np.asarray(part0)[:n] if part0 is not None else None
+        except Exception as e:
+            _annotate_dispatch_bucket(e, b)
+            raise
         return DeviceRows(out0, n), seed0, ver0, part0
+
+    def _leader_init_chunked(self, nonce_lanes, public_parts, meas, proof, blind0, cap: int):
+        """Serial cap-sized leader inits for a batch past the HBM bound.
+        Unlike _leader_init_pipelined, chunk k+1's transfer is NOT
+        staged while chunk k computes — bounding resident bytes is the
+        whole point. Outputs merge exactly like the pipelined path."""
+        n = nonce_lanes.shape[0]
+        outs, seeds, vers, parts = [], [], [], []
+        for s in range(0, n, cap):
+            e = min(s + cap, n)
+            out0, seed0, ver0, part0 = self._leader_init_inner(
+                _cut_rows(nonce_lanes, s, e),
+                _cut_rows(public_parts, s, e),
+                _cut_rows(meas, s, e),
+                _cut_rows(proof, s, e),
+                _cut_rows(blind0, s, e),
+                allow_pipeline=False,
+            )
+            outs.append(out0)
+            seeds.append(seed0)
+            vers.append(ver0)
+            parts.append(part0)
+        seed = np.concatenate(seeds) if seeds[0] is not None else None
+        ver = tuple(
+            np.concatenate([v[i] for v in vers]) for i in range(len(vers[0]))
+        )
+        part = np.concatenate(parts) if parts[0] is not None else None
+        return DeviceRowsChunks(outs), seed, ver, part
 
     def _leader_init_pipelined(self, nonce_lanes, public_parts, meas, proof, blind0):
         """Chunked leader init: every chunk's device transfer is issued
@@ -566,61 +929,92 @@ class EngineCache:
 
         fn = self._jit("leader_init", step)
 
-        def cut(a, s, e):
-            if a is None:
-                return None
-            if isinstance(a, tuple):
-                return tuple(x[s:e] for x in a)
-            return a[s:e]
-
         spans_ = [(s, min(s + C, n)) for s in range(0, n, C)]
-        with span("engine.leader_init", vdaf=self.inst.kind, batch=n, pipelined=len(spans_)):
-            staged = []
-            with span("engine.leader_init.put_all_async"):
-                for s, e in spans_:
-                    args = pad_args(
-                        bucket_size(e - s),
-                        cut(nonce_lanes, s, e),
-                        cut(public_parts, s, e),
-                        cut(meas, s, e),
-                        cut(proof, s, e),
-                        cut(blind0, s, e),
+        try:
+            with span("engine.leader_init", vdaf=self.inst.kind, batch=n, pipelined=len(spans_)):
+                staged = []
+                with span("engine.leader_init.put_all_async"):
+                    for s, e in spans_:
+                        args = pad_args(
+                            bucket_size(e - s),
+                            _cut_rows(nonce_lanes, s, e),
+                            _cut_rows(public_parts, s, e),
+                            _cut_rows(meas, s, e),
+                            _cut_rows(proof, s, e),
+                            _cut_rows(blind0, s, e),
+                        )
+                        staged.append(put_args(args, block=False))
+                outs = []
+                for k, ((s, e), args) in enumerate(zip(spans_, staged)):
+                    with span("engine.leader_init.chunk", k=k, rows=e - s):
+                        jax.block_until_ready(args)  # this chunk's H2D only
+                        outs.append(fn(*args))
+                with span("engine.leader_init.fetch"):
+                    out_chunks = [
+                        DeviceRows(o[0], e - s) for (s, e), o in zip(spans_, outs)
+                    ]
+                    seed0 = (
+                        np.concatenate(
+                            [np.asarray(o[1])[: e - s] for (s, e), o in zip(spans_, outs)]
+                        )
+                        if outs[0][1] is not None
+                        else None
                     )
-                    staged.append(put_args(args, block=False))
-            outs = []
-            for k, ((s, e), args) in enumerate(zip(spans_, staged)):
-                with span("engine.leader_init.chunk", k=k, rows=e - s):
-                    jax.block_until_ready(args)  # this chunk's H2D only
-                    outs.append(fn(*args))
-            with span("engine.leader_init.fetch"):
-                out_chunks = [
-                    DeviceRows(o[0], e - s) for (s, e), o in zip(spans_, outs)
-                ]
-                seed0 = (
-                    np.concatenate(
-                        [np.asarray(o[1])[: e - s] for (s, e), o in zip(spans_, outs)]
+                    L = len(outs[0][2])
+                    ver0 = tuple(
+                        np.concatenate(
+                            [np.asarray(o[2][i])[: e - s] for (s, e), o in zip(spans_, outs)]
+                        )
+                        for i in range(L)
                     )
-                    if outs[0][1] is not None
-                    else None
-                )
-                L = len(outs[0][2])
-                ver0 = tuple(
-                    np.concatenate(
-                        [np.asarray(o[2][i])[: e - s] for (s, e), o in zip(spans_, outs)]
+                    part0 = (
+                        np.concatenate(
+                            [np.asarray(o[3])[: e - s] for (s, e), o in zip(spans_, outs)]
+                        )
+                        if outs[0][3] is not None
+                        else None
                     )
-                    for i in range(L)
-                )
-                part0 = (
-                    np.concatenate(
-                        [np.asarray(o[3])[: e - s] for (s, e), o in zip(spans_, outs)]
-                    )
-                    if outs[0][3] is not None
-                    else None
-                )
+        except Exception as exc:
+            _annotate_dispatch_bucket(exc, bucket_size(min(n, C)))
+            raise
         return DeviceRowsChunks(out_chunks), seed0, ver0, part0
 
     # --- masked aggregate over the batch axis ---
     def aggregate(self, out_shares, mask):
+        """Masked aggregate with the same OOM recovery as the init
+        steps. After a host fallback, rows produced by the host engine
+        (plain limb tuples) aggregate on host; device-resident rows
+        from before the fallback are fetched and aggregated on host."""
+        while True:
+            host = self._host()
+            if host is not None:
+                if isinstance(out_shares, (DeviceRows, DeviceRowsChunks)):
+                    return host.aggregate(out_shares.to_numpy(), np.asarray(mask))
+                return host.aggregate(out_shares, mask)
+            try:
+                return self._aggregate_inner(out_shares, mask)
+            except Exception as e:  # noqa: BLE001 - OOM filter inside
+                if (
+                    is_oom_error(e)
+                    and getattr(e, "_janus_fixed_bucket", False)
+                    and isinstance(out_shares, (DeviceRows, DeviceRowsChunks))
+                ):
+                    # A resident buffer re-dispatches at its own fixed
+                    # bucket no matter the cap, so halving can't help —
+                    # fetch and reduce THIS buffer on host instead of
+                    # abandoning the device path engine-wide for an OOM
+                    # specific to one oversized buffer.
+                    log.warning(
+                        "device OOM aggregating a fixed-bucket resident "
+                        "buffer for %s; reducing it on host: %s",
+                        self.inst.kind, e,
+                    )
+                    host = HostEngineCache(self.inst, self.verify_key)
+                    return host.aggregate(out_shares.to_numpy(), np.asarray(mask))
+                n = getattr(out_shares, "n", None) or np.asarray(mask).shape[0]
+                self._handle_engine_error(e, n)
+
+    def _aggregate_inner(self, out_shares, mask):
         p3 = self.p3
 
         if isinstance(out_shares, DeviceRowsChunks):
@@ -629,7 +1023,7 @@ class EngineCache:
             total = None
             off = 0
             for chunk in out_shares.chunks:
-                part = self.aggregate(chunk, np.asarray(mask)[off : off + chunk.n])
+                part = self._aggregate_inner(chunk, np.asarray(mask)[off : off + chunk.n])
                 off += chunk.n
                 total = part if total is None else [
                     (a + b) % p for a, b in zip(total, part)
@@ -666,16 +1060,42 @@ class EngineCache:
                 fnv = self._jit(f"aggregate_view_{vb}", step_view)
                 mask_vb = np.zeros(vb, dtype=bool)
                 mask_vb[:n] = np.asarray(mask, dtype=bool)
-                agg = fnv(value, np.int32(s), mask_vb)
+                dispatch_b, dispatch_fixed = vb, True
+                dispatch = lambda: fnv(value, np.int32(s), mask_vb)  # noqa: E731
             else:
                 full = np.zeros(b, dtype=bool)
                 full[s : s + n] = np.asarray(mask, dtype=bool)
-                agg = fn(value, full)
+                dispatch_b, dispatch_fixed = b, True
+                dispatch = lambda: fn(value, full)  # noqa: E731
         else:
             n = mask.shape[0]
-            b = bucket_size(n)
-            agg = fn(*pad_args(b, out_shares, mask))
-        return [int(x) for x in p3.jf.to_ints(agg)]
+            cap = self.bucket_cap
+            if cap is not None and n > cap:
+                # host-staged rows past the HBM cap: cap-sized partial
+                # reduces merged mod p on host
+                p = p3.jf.MODULUS
+                total = None
+                for s in range(0, n, cap):
+                    e = min(s + cap, n)
+                    part = self._aggregate_inner(
+                        _cut_rows(out_shares, s, e), np.asarray(mask)[s:e]
+                    )
+                    total = part if total is None else [
+                        (a + b) % p for a, b in zip(total, part)
+                    ]
+                return total
+            b = bucket_size(n, cap)
+            dispatch_b, dispatch_fixed = b, False
+            dispatch = lambda: fn(*pad_args(b, out_shares, mask))  # noqa: E731
+        try:
+            # PJRT raises allocation failures synchronously from the
+            # dispatch; other device errors realize async at the fetch.
+            # Both need the bucket annotation, so both live in this try.
+            agg = dispatch()
+            return [int(x) for x in p3.jf.to_ints(agg)]
+        except Exception as e:
+            _annotate_dispatch_bucket(e, dispatch_b, fixed=dispatch_fixed)
+            raise
 
 
 class _HostP3:

@@ -8,10 +8,10 @@ key schedule info.
 Suite matrix (reference core/src/hpke.rs:214-215,456 round_trip_check):
 KEMs DHKEM(X25519, HKDF-SHA256) + DHKEM(P-256, HKDF-SHA256); KDFs
 HKDF-SHA256/384/512; AEADs AES-128-GCM / AES-256-GCM /
-ChaCha20Poly1305 — any combination. KEM/AEAD primitives come from the
-`cryptography` package (the reference's equivalent dependency is the
-hpke-dispatch crate); the HKDF labeling is implemented here to match
-RFC 9180 exactly.
+ChaCha20Poly1305 — any combination. KEM/AEAD primitives come from
+`core.hpke_backend` (the `cryptography` package when installed, else
+the system libcrypto via ctypes — this image ships no crypto wheels);
+the HKDF labeling is implemented here to match RFC 9180 exactly.
 """
 
 from __future__ import annotations
@@ -21,17 +21,14 @@ import hashlib
 import hmac
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
+from .hpke_backend import (
+    AESGCM,
+    ChaCha20Poly1305,
+    p256_exchange,
+    p256_generate,
+    x25519_exchange,
+    x25519_generate,
 )
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM, ChaCha20Poly1305
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
-
 from ..messages import HpkeAeadId, HpkeCiphertext, HpkeConfig, HpkeConfigId, HpkeKdfId, HpkeKemId, Role
 
 NN = 12  # nonce size, all three AEADs
@@ -80,47 +77,34 @@ class _X25519Kem:
 
     @staticmethod
     def generate() -> tuple[bytes, bytes]:
-        sk = X25519PrivateKey.generate()
-        return sk.public_key().public_bytes_raw(), sk.private_bytes_raw()
+        return x25519_generate()
 
     @staticmethod
     def encap(pk_bytes: bytes) -> tuple[bytes, bytes]:
-        pk_r = X25519PublicKey.from_public_bytes(pk_bytes)
-        sk_e = X25519PrivateKey.generate()
-        return sk_e.exchange(pk_r), sk_e.public_key().public_bytes_raw()
+        pk_e, sk_e = x25519_generate()
+        return x25519_exchange(sk_e, pk_bytes), pk_e
 
     @staticmethod
     def decap(sk_bytes: bytes, enc: bytes) -> bytes:
-        sk_r = X25519PrivateKey.from_private_bytes(sk_bytes)
-        return sk_r.exchange(X25519PublicKey.from_public_bytes(enc))
+        return x25519_exchange(sk_bytes, enc)
 
 
 class _P256Kem:
     ID = HpkeKemId.P256_HKDF_SHA256
     NSECRET = 32
-    _CURVE = ec.SECP256R1()
 
-    @classmethod
-    def generate(cls) -> tuple[bytes, bytes]:
-        sk = ec.generate_private_key(cls._CURVE)
-        pk = sk.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
-        return pk, sk.private_numbers().private_value.to_bytes(32, "big")
+    @staticmethod
+    def generate() -> tuple[bytes, bytes]:
+        return p256_generate()
 
-    @classmethod
-    def _load_pk(cls, pk_bytes: bytes):
-        return ec.EllipticCurvePublicKey.from_encoded_point(cls._CURVE, pk_bytes)
+    @staticmethod
+    def encap(pk_bytes: bytes) -> tuple[bytes, bytes]:
+        enc, sk_e = p256_generate()
+        return p256_exchange(sk_e, pk_bytes), enc
 
-    @classmethod
-    def encap(cls, pk_bytes: bytes) -> tuple[bytes, bytes]:
-        pk_r = cls._load_pk(pk_bytes)
-        sk_e = ec.generate_private_key(cls._CURVE)
-        enc = sk_e.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
-        return sk_e.exchange(ec.ECDH(), pk_r), enc
-
-    @classmethod
-    def decap(cls, sk_bytes: bytes, enc: bytes) -> bytes:
-        sk_r = ec.derive_private_key(int.from_bytes(sk_bytes, "big"), cls._CURVE)
-        return sk_r.exchange(ec.ECDH(), cls._load_pk(enc))
+    @staticmethod
+    def decap(sk_bytes: bytes, enc: bytes) -> bytes:
+        return p256_exchange(sk_bytes, enc)
 
 
 _KEMS = {k.ID: k for k in (_X25519Kem, _P256Kem)}

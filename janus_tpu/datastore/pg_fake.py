@@ -98,6 +98,29 @@ def _to_sqlite(sql: str) -> str:
     return _FOR_UPDATE_RE.sub("", sql).replace("%s", "?")
 
 
+# UPDATE ... RETURNING needs SQLite >= 3.35; on older system libs the
+# fake emulates it (see FakeConnection._execute_update_returning) so
+# the recorded PG wire form never changes.
+_SQLITE_RETURNING = sqlite3.sqlite_version_info >= (3, 35)
+_UPDATE_RETURNING_RE = re.compile(
+    r"^\s*(UPDATE\s+(\w+)\s+SET\s+.+?)\s+RETURNING\s+(.+?)\s*$", re.I | re.S
+)
+
+
+def _depth0_where(s: str) -> int:
+    """Index of the outermost ' WHERE ' (paren depth 0), or -1."""
+    depth = 0
+    u = s.upper()
+    for i, c in enumerate(s):
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif depth == 0 and u.startswith(" WHERE ", i):
+            return i
+    return -1
+
+
 class FakeConnection:
     """psycopg-Connection surface: execute/cursor/commit/rollback/close,
     `closed`/`broken` flags, assignable `isolation_level`. Transactions
@@ -134,11 +157,43 @@ class FakeConnection:
             return self._sq.execute("SELECT 1")
         self._ensure_tx()
         try:
+            if not _SQLITE_RETURNING:
+                m = _UPDATE_RETURNING_RE.match(sql)
+                if m:
+                    return self._execute_update_returning(m, tuple(params))
             return self._sq.execute(_to_sqlite(sql), params)
         except sqlite3.IntegrityError:
             raise  # _INTEGRITY_ERRORS catches the sqlite3 class
         except sqlite3.OperationalError as e:
             raise OperationalError(str(e)) from e
+
+    def _execute_update_returning(self, m: "re.Match", params: tuple):
+        """UPDATE ... RETURNING on a pre-3.35 sqlite: pin the matching
+        rowids first, update only those, then select the RETURNING
+        columns back by rowid. Equivalent inside the surrounding
+        transaction (single writer); the conversation log above already
+        recorded the genuine PG wire form."""
+        head, table, cols = m.group(1), m.group(2), m.group(3)
+        wi = _depth0_where(head)
+        set_part, where = (head[:wi], head[wi + 7 :]) if wi >= 0 else (head, None)
+        n_set = set_part.count("%s")
+        if where is None:
+            sel = f"SELECT rowid FROM {table}"  # noqa: S608 - fake, test-only
+            rowids = [r[0] for r in self._sq.execute(sel).fetchall()]
+        else:
+            sel = f"SELECT rowid FROM {table} WHERE {_to_sqlite(where)}"
+            rowids = [r[0] for r in self._sq.execute(sel, params[n_set:]).fetchall()]
+        if not rowids:
+            return self._sq.execute(f"SELECT {_to_sqlite(cols)} FROM {table} WHERE 0")
+        ph = ",".join("?" * len(rowids))
+        self._sq.execute(
+            f"{_to_sqlite(set_part)} WHERE rowid IN ({ph})",
+            params[:n_set] + tuple(rowids),
+        )
+        return self._sq.execute(
+            f"SELECT {_to_sqlite(cols)} FROM {table} WHERE rowid IN ({ph})",
+            tuple(rowids),
+        )
 
     def cursor(self):
         conn = self
@@ -160,6 +215,14 @@ class FakeConnection:
                 return self._c
 
             def __getattr__(self, name):
+                # Guard: before executemany() runs there is no `_c`, and
+                # a bare `getattr(self._c, ...)` would re-enter this
+                # __getattr__ for `_c` itself — infinite recursion
+                # surfacing as RecursionError (round-5 advisory).
+                if name == "_c":
+                    raise AttributeError(
+                        "cursor has no result yet: call executemany() first"
+                    )
                 return getattr(self._c, name)
 
         return _Cur()

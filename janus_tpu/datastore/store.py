@@ -44,7 +44,7 @@ _INTEGRITY_ERRORS = (
     else (sqlite3.IntegrityError, _psycopg.errors.IntegrityError)
 )
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from ..core.hpke_backend import AESGCM
 
 from ..messages import (
     AggregationJobId,
@@ -287,6 +287,29 @@ class Transaction:
         self._crypter = crypter
         self._clock = clock
         self._lease_suffix = " FOR UPDATE SKIP LOCKED" if dialect == "postgres" else ""
+        # UPDATE ... RETURNING needs SQLite >= 3.35 (2021); older system
+        # libs (this image ships 3.34) take the two-statement fallback.
+        # Safe: every op already runs inside one serialized transaction
+        # on one connection, so SELECT-then-UPDATE cannot interleave.
+        # Postgres always keeps the RETURNING wire form (pg_fake
+        # emulates it on old sqlite so the recorded conversation is
+        # byte-identical to what production postgres receives).
+        self._returning = dialect == "postgres" or sqlite3.sqlite_version_info >= (3, 35)
+
+    def _update_returning_one(
+        self, update_sql: str, params, returning: str, select_sql: str, select_params
+    ):
+        """Single-row guarded `UPDATE ... RETURNING <returning>`, with
+        the pre-3.35-sqlite two-statement form: UPDATE, then re-read via
+        select_sql only when a row was changed. Exact inside the
+        serialized transaction (see _returning above). New
+        UPDATE...RETURNING call sites should use this instead of
+        hand-rolling the fallback pair."""
+        if self._returning:
+            return self._c.execute(update_sql + " RETURNING " + returning, params).fetchone()
+        if not self._c.execute(update_sql, params).rowcount:
+            return None
+        return self._c.execute(select_sql, select_params).fetchone()
 
     # ---- tasks (reference datastore.rs:528-1160) ----
     def put_task(self, task: Task) -> None:
@@ -440,15 +463,28 @@ class Transaction:
     ) -> list[tuple[ReportId, Time]]:
         """Claims up to `limit` unaggregated reports (marks them started),
         like datastore.rs:1331 get_unaggregated_client_report_ids_for_task."""
-        rows = self._c.execute(
-            "UPDATE client_reports SET aggregation_started = 1"
-            " WHERE (task_id, report_id) IN ("
-            "   SELECT task_id, report_id FROM client_reports"
-            "   WHERE task_id = ? AND aggregation_started = 0"
-            "   ORDER BY client_time LIMIT ?)"
-            " RETURNING report_id, client_time",
-            (task_id.data, limit),
-        ).fetchall()
+        if self._returning:
+            rows = self._c.execute(
+                "UPDATE client_reports SET aggregation_started = 1"
+                " WHERE (task_id, report_id) IN ("
+                "   SELECT task_id, report_id FROM client_reports"
+                "   WHERE task_id = ? AND aggregation_started = 0"
+                "   ORDER BY client_time LIMIT ?)"
+                " RETURNING report_id, client_time",
+                (task_id.data, limit),
+            ).fetchall()
+        else:
+            rows = self._c.execute(
+                "SELECT report_id, client_time FROM client_reports"
+                " WHERE task_id = ? AND aggregation_started = 0"
+                " ORDER BY client_time LIMIT ?",
+                (task_id.data, limit),
+            ).fetchall()
+            self._c.executemany(
+                "UPDATE client_reports SET aggregation_started = 1"
+                " WHERE task_id = ? AND report_id = ?",
+                [(task_id.data, r[0]) for r in rows],
+            )
         return [(ReportId(r[0]), Time(r[1])) for r in rows]
 
     def mark_reports_unaggregated(self, task_id: TaskId, report_ids: list[ReportId]) -> None:
@@ -549,13 +585,16 @@ class Transaction:
         ).fetchall()
         for task_id, job_id in rows:
             token = secrets.token_bytes(16)
-            cur = self._c.execute(
+            cur = self._update_returning_one(
                 "UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = ?,"
                 " lease_attempts = lease_attempts + 1"
-                " WHERE task_id = ? AND job_id = ? AND state = 'in_progress' AND lease_expiry <= ?"
-                " RETURNING lease_attempts",
+                " WHERE task_id = ? AND job_id = ? AND state = 'in_progress' AND lease_expiry <= ?",
                 (now + lease_duration.seconds, token, task_id, job_id, now),
-            ).fetchone()
+                "lease_attempts",
+                "SELECT lease_attempts FROM aggregation_jobs"
+                " WHERE task_id = ? AND job_id = ?",
+                (task_id, job_id),
+            )
             if cur is not None:
                 out.append(
                     AcquiredAggregationJob(
@@ -974,14 +1013,17 @@ class Transaction:
         out = []
         for task_id, cj_id in rows:
             token = secrets.token_bytes(16)
-            cur = self._c.execute(
+            cur = self._update_returning_one(
                 "UPDATE collection_jobs SET lease_expiry = ?, lease_token = ?,"
                 " lease_attempts = lease_attempts + 1"
                 " WHERE task_id = ? AND collection_job_id = ? AND state IN ('start', 'collectable')"
-                " AND lease_expiry <= ?"
-                " RETURNING lease_attempts",
+                " AND lease_expiry <= ?",
                 (now + lease_duration.seconds, token, task_id, cj_id, now),
-            ).fetchone()
+                "lease_attempts",
+                "SELECT lease_attempts FROM collection_jobs"
+                " WHERE task_id = ? AND collection_job_id = ?",
+                (task_id, cj_id),
+            )
             if cur is not None:
                 out.append(
                     AcquiredCollectionJob(
@@ -1144,11 +1186,13 @@ class Transaction:
 
     def add_to_outstanding_batch(self, task_id: TaskId, batch_id: BatchId, n: int) -> int:
         """Record n more reports assigned to the batch; returns new size."""
-        row = self._c.execute(
-            "UPDATE outstanding_batches SET size = size + ? WHERE task_id = ? AND batch_id = ?"
-            " RETURNING size",
+        row = self._update_returning_one(
+            "UPDATE outstanding_batches SET size = size + ? WHERE task_id = ? AND batch_id = ?",
             (n, task_id.data, batch_id.data),
-        ).fetchone()
+            "size",
+            "SELECT size FROM outstanding_batches WHERE task_id = ? AND batch_id = ?",
+            (task_id.data, batch_id.data),
+        )
         if row is None:
             raise TxConflict("outstanding batch vanished")
         return row[0]

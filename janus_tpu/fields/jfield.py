@@ -386,6 +386,57 @@ def fmap(fn, *vals):
     return tuple(fn(*limbs) for limbs in zip(*vals))
 
 
+# --- tile-shaped structural ops (the streamed/tiled query's vocabulary:
+# every consumer used to open-code fmap(lambda v: jax.lax.dynamic_slice...)
+# per site; one copy here keeps the tile geometry in one place) ---
+
+
+def fslice_dyn(v, start, size: int, axis: int = 1):
+    """Dynamic slice of a field value along `axis`: `start` may be a
+    traced scalar (scan step), `size` is static (the tile width).
+
+    The start index is forced to int32: under jax_enable_x64 a scan
+    step is s64, and the XLA SPMD partitioner rewrites sharded
+    dynamic-slice offsets in s32 — the mixed compare fails its HLO
+    verifier (seen on the len=100k (dp, sp) mesh dryrun)."""
+    start = jnp.asarray(start, dtype=jnp.int32)
+    return tuple(jax.lax.dynamic_slice_in_dim(x, start, size, axis=axis) for x in v)
+
+
+def ftile(v, step, tile: int, axis: int = 1):
+    """Tile `step` (0-based, traced ok) of width `tile` along `axis`."""
+    return fslice_dyn(v, step * tile, tile, axis=axis)
+
+
+def fput_tile(dst, src, step, axis: int = 1):
+    """Write `src` as tile `step` along `axis` — the inverse of ftile;
+    tile width is src's (static) extent along `axis`. Same int32 index
+    rule as fslice_dyn: scan-stacked ys would carry an s64
+    dynamic_update_slice index under x64, which the SPMD partitioner
+    cannot rewrite — accumulating tiles into a carried buffer with an
+    s32 offset keeps the sharded scan compilable."""
+    start = (jnp.asarray(step) * src[0].shape[axis]).astype(jnp.int32)
+    return tuple(
+        jax.lax.dynamic_update_slice_in_dim(x, u, start, axis=axis)
+        for x, u in zip(dst, src)
+    )
+
+
+def fpad_axis(v, pad: int, axis: int = 1):
+    """Zero-pad a field value at the end of `axis` (no-op for pad=0) —
+    aligns a vector onto a tile grid before a scan consumes it."""
+    if pad == 0:
+        return v
+    widths = [(0, 0)] * v[0].ndim
+    widths[axis] = (0, pad)
+    return tuple(jnp.pad(x, widths) for x in v)
+
+
+def freshape(v, shape):
+    """Reshape every limb to `shape` (use -1 for the inferred axis)."""
+    return tuple(x.reshape(shape) for x in v)
+
+
 def fzeros(jf, shape):
     return tuple(jnp.zeros(shape, dtype=U64) for _ in range(jf.LIMBS))
 

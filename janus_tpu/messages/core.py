@@ -688,6 +688,16 @@ class PrepareError(enum.IntEnum):
         except ValueError as e:
             raise DecodeError(str(e))
 
+    def to_bytes(self) -> bytes:  # shadow int.to_bytes for codec symmetry
+        return bytes([self.value])
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PrepareError":
+        dec = Decoder(raw)
+        out = cls.decode(dec)
+        dec.finish()
+        return out
+
 
 @dataclass(frozen=True)
 class PrepareStepResult(Codec):

@@ -142,6 +142,14 @@ aggregate_step_failure_counter = REGISTRY.counter(
 job_cancel_counter = REGISTRY.counter(
     "janus_job_cancellations", "jobs abandoned after repeated failures"
 )
+engine_oom_retry_counter = REGISTRY.counter(
+    "janus_engine_oom_retries",
+    "device OOMs absorbed by halving the engine's batch bucket cap",
+)
+engine_host_fallback_counter = REGISTRY.counter(
+    "janus_engine_host_fallbacks",
+    "engines that hit the bucket floor on device OOM and fell back to the host engine",
+)
 http_request_counter = REGISTRY.counter(
     "janus_http_requests", "DAP HTTP requests by route and status"
 )

@@ -210,8 +210,14 @@ class Prio3BatchedDraft(Prio3Batched):
     # accelerator (BASELINE.md "Draft mode").
     MAX_STREAM_BLOCKS = 160_000
 
+    # Smallest batch at which the device draft engine beats the scalar
+    # host loop (measured r5: host parity at 64, device wins from ~128;
+    # 8 keeps smaller accelerators eligible while rejecting configs
+    # whose materialized share cannot amortize at all).
+    MIN_DEVICE_ROWS = 8
+
     @classmethod
-    def supports_circuit(cls, circ) -> bool:
+    def supports_circuit(cls, circ, budget_bytes=None) -> bool:
         import math
 
         jf_limbs = circ.FIELD.ENCODED_SIZE // 8
@@ -225,7 +231,29 @@ class Prio3BatchedDraft(Prio3Batched):
         # absorb side: the longest binder is the encoded measurement
         # share (joint-rand part)
         absorb_blocks = (PREFIX_BYTES + 1 + SEED_SIZE + circ.input_len * circ.FIELD.ENCODED_SIZE) // RATE + 1
-        return max(blocks, absorb_blocks) <= cls.MAX_STREAM_BLOCKS
+        if max(blocks, absorb_blocks) > cls.MAX_STREAM_BLOCKS:
+            return False
+        # HBM feasibility bound (ISSUE r6): the draft sponge has no
+        # random-access counter, so the helper share MATERIALIZES at
+        # O(input_len) per row regardless of query tiling — a stream
+        # length under MAX_STREAM_BLOCKS can still be un-runnable on a
+        # small-HBM part. Gate on the model: if fewer than
+        # MIN_DEVICE_ROWS rows fit the budget, the scalar host loop is
+        # both safer and (below the amortization knee) faster. Unknown
+        # budget (CPU backend, tunnel without memory_stats) keeps the
+        # legacy blocks-only behavior.
+        from . import engine
+        from .feasibility import device_memory_budget, feasible_rows
+
+        if budget_bytes is None:
+            budget_bytes = device_memory_budget()
+        tile = (
+            min(engine.STREAM_TILE_ELEMS, circ.input_len)
+            if circ.input_len >= engine.STREAM_MIN_INPUT_LEN
+            else None
+        )
+        rows = feasible_rows(circ, budget_bytes, tile_elems=tile, draft=True)
+        return rows is None or rows >= cls.MIN_DEVICE_ROWS
 
     # --- draft XOF plumbing ---
     def _draft_dst(self, usage: int) -> bytes:

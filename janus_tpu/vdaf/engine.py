@@ -37,8 +37,11 @@ from ..fields.jfield import (
     fconst,
     fmap,
     fmul_pow2,
+    fpad_axis,
     fpow_const,
+    freshape,
     fsum,
+    ftile,
     fwhere,
     is_zero,
     anti_recompute_barrier,
@@ -602,6 +605,19 @@ STREAM_MIN_INPUT_LEN = 1 << 17
 # ~40% of helper_init at len=100k (r5 profile) at ~2x the transient
 # per-step memory (still O(group)).
 _STREAM_TARGET_STEPS = 8
+# Hard cap on the per-step tile, in input-share ELEMENTS. The r5 plan
+# sized the group as input_len/_STREAM_TARGET_STEPS — memory
+# PROPORTIONAL, which is why len=100k (input_len 1.6M) could not reach
+# the batch>=256 amortization knee inside the 15.75 GB v5e budget
+# (ISSUE r6). With the cap the tile is FIXED at north-star lengths, so
+# the scan's working set scales with batch x TILE no matter how long
+# the measurement vector grows; extra length only adds scan steps
+# (the nested-scan sponge already made long chains linear, r5).
+# Floor: the tile must stay a multiple of the lcm(7, bits) x chunk
+# alignment quantum (XOF block + truncate-grid alignment, stream_plan),
+# so a chunk length coprime with the alignment floors the tile at
+# a*ch elements even when that exceeds this clamp.
+STREAM_TILE_ELEMS = int(os.environ.get("JANUS_STREAM_TILE", str(1 << 16)))
 
 
 class StreamPlan:
@@ -620,13 +636,22 @@ class StreamPlan:
         self.bits = bits
 
 
-def stream_plan(bc: BatchedCircuit, min_input_len: int | None = None) -> StreamPlan | None:
+def stream_plan(
+    bc: BatchedCircuit,
+    min_input_len: int | None = None,
+    tile_elems: int | None = None,
+) -> StreamPlan | None:
     """A StreamPlan for circuits worth streaming, else None.
 
     SumVec and Histogram only: their query consumes the expanded share
     as per-call folds, so it streams. (FixedPointVec's two-pass entry
     values could stream too but its deployed lengths don't need it;
     Count/Sum inputs are tiny.)
+
+    The group (tile) size is min(input_len/_STREAM_TARGET_STEPS,
+    tile_elems), alignment-rounded: short streams keep the measured
+    8-step optimum, long streams clamp to the fixed tile so peak memory
+    is length-independent (STREAM_TILE_ELEMS rationale above).
     """
     import math
 
@@ -641,7 +666,9 @@ def stream_plan(bc: BatchedCircuit, min_input_len: int | None = None) -> StreamP
     bits = getattr(circ, "bits", 1)
     align = math.lcm(7, bits)
     a = align // math.gcd(align, ch)  # smallest gcalls with align | gcalls*ch
-    gcalls = a * max(1, round(bc.calls / a / _STREAM_TARGET_STEPS))
+    tile = STREAM_TILE_ELEMS if tile_elems is None else tile_elems
+    desired_calls = min(bc.calls / _STREAM_TARGET_STEPS, max(1.0, tile / ch))
+    gcalls = a * max(1, round(desired_calls / a))
     n_steps = -(-bc.calls // gcalls)
     return StreamPlan(gcalls, n_steps, gcalls * ch, bits)
 
@@ -651,14 +678,10 @@ def sliced_meas_source(bc: BatchedCircuit, plan: StreamPlan, meas):
     (leader side): pad to the group grid once, dynamic-slice per step."""
     total = plan.n_steps * plan.group
     n = bc.circ.input_len
-    if total > n:
-        meas = fmap(lambda v: jnp.pad(v, ((0, 0), (0, total - n))), meas)
+    meas = fpad_axis(meas, total - n) if total > n else meas
 
     def src(step):
-        return fmap(
-            lambda v: jax.lax.dynamic_slice_in_dim(v, step * plan.group, plan.group, axis=1),
-            meas,
-        )
+        return ftile(meas, step, plan.group, axis=1)
 
     return src
 
@@ -695,13 +718,20 @@ def flp_query_streamed(
     # call weights zero-padded so tail calls beyond `calls` contribute 0
     padc = plan.n_steps * gcalls - bc.calls
     if padc:
-        Lc = fmap(lambda x: jnp.pad(x, ((0, 0), (0, padc))), Lc)
+        Lc = fpad_axis(Lc, padc)
 
     # --- streamed input-share folds ---
     r = fmap(lambda x: x[:, 0], joint_rand)
     s_const = fconst(jf, shares_inv)
 
-    from ..fields.jfield import fzeros
+    from ..fields.jfield import fput_tile, fzeros
+
+    # truncate-output width of one step's tile: the scan accumulates
+    # each step's contribution into a carried [batch, n_steps * gp]
+    # buffer (fput_tile) instead of scan-stacked ys — the ys path emits
+    # an s64-indexed dynamic_update_slice under x64 that the SPMD
+    # partitioner rejects on a (dp, sp) mesh (fput_tile rationale).
+    gp = G // plan.bits if is_sumvec else G
 
     if _QUERY_MM:
         # MXU form (see _flp_query_batched_mm): each step's fold is one
@@ -712,15 +742,12 @@ def flp_query_streamed(
         w_full, rc1 = _chunked_wire_weights(bc, Lc, r)  # Lc is step-padded
 
         def body(carry, step):
-            F0, F1, S = carry
+            F0, F1, S, P = carry
             x = meas_source(step)  # [batch, G]
             mask = (step * G + jnp.arange(G)) < n  # [G]
             x = fmap(lambda v: jnp.where(mask[None, :], v, jnp.zeros_like(v)), x)
-            Xg = fmap(lambda v: v.reshape(batch, gcalls, ch), x)
-            wg = fmap(
-                lambda v: jax.lax.dynamic_slice_in_dim(v, step * gcalls, gcalls, axis=2),
-                w_full,
-            )
+            Xg = freshape(x, (batch, gcalls, ch))
+            wg = ftile(w_full, step, gcalls, axis=2)
             Fg = fold_contract(jf, wg, Xg)  # [batch, 2, ch]
             F0 = jf.add(F0, fmap(lambda v: v[:, 0], Fg))
             F1 = jf.add(F1, fmap(lambda v: v[:, 1], Fg))
@@ -735,11 +762,19 @@ def flp_query_streamed(
                 part = _pow2_weighted_sum(jf, v, plan.bits)
             else:  # histogram truncate is the identity
                 part = x
-            return (F0, F1, S), part
+            P = fput_tile(P, part, step)
+            return (F0, F1, S, P), None
 
-        init = (fzeros(jf, (batch, ch)), fzeros(jf, (batch, ch)), fzeros(jf, (batch,)))
-        carry, parts = jax.lax.scan(body, init, jnp.arange(plan.n_steps))
-        F0, F1, S = carry
+        init = (
+            fzeros(jf, (batch, ch)),
+            fzeros(jf, (batch, ch)),
+            fzeros(jf, (batch,)),
+            fzeros(jf, (batch, plan.n_steps * gp)),
+        )
+        carry, _ = jax.lax.scan(
+            body, init, jnp.arange(plan.n_steps, dtype=jnp.int32)
+        )
+        F0, F1, S, parts = carry
         W0 = jf.mul(F0, rc1)
         W1 = jf.sub(F1, _chunked_b_correction(bc, Lc, shares_inv))
     else:
@@ -748,7 +783,7 @@ def flp_query_streamed(
         two_pows = _two_power_consts(jf, plan.bits) if is_sumvec else None
 
         def body(carry, step):
-            base, W0, W1, S = carry  # base = r^{step*G + 1}
+            base, W0, W1, S, P = carry  # base = r^{step*G + 1}
             x = meas_source(step)  # [batch, G]
             mask = (step * G + jnp.arange(G)) < n  # [G]
             x = fmap(lambda v: jnp.where(mask[None, :], v, jnp.zeros_like(v)), x)
@@ -759,11 +794,9 @@ def flp_query_streamed(
                 jf.sub(x, s_const),
                 fzeros(jf, (batch, G)),
             )
-            a_r = fmap(lambda v: v.reshape(batch, gcalls, ch), a)
-            b_r = fmap(lambda v: v.reshape(batch, gcalls, ch), b)
-            Lg = fmap(
-                lambda v: jax.lax.dynamic_slice_in_dim(v, step * gcalls, gcalls, axis=1), Lc
-            )
+            a_r = freshape(a, (batch, gcalls, ch))
+            b_r = freshape(b, (batch, gcalls, ch))
+            Lg = ftile(Lc, step, gcalls, axis=1)
             Lg3 = fmap(lambda v: v[:, :, None], Lg)
             W0 = jf.add(W0, fsum(jf, jf.mul(a_r, Lg3), axis=1))
             W1 = jf.add(W1, fsum(jf, jf.mul(b_r, Lg3), axis=1))
@@ -776,15 +809,22 @@ def flp_query_streamed(
             else:  # histogram truncate is the identity
                 part = x
             base = jf.mul(base, rstep)
-            return (base, W0, W1, S), part
+            P = fput_tile(P, part, step)
+            return (base, W0, W1, S, P), None
 
-        init = (r, fzeros(jf, (batch, ch)), fzeros(jf, (batch, ch)), fzeros(jf, (batch,)))
-        carry, parts = jax.lax.scan(body, init, jnp.arange(plan.n_steps))
-        _, W0, W1, S = carry
+        init = (
+            r,
+            fzeros(jf, (batch, ch)),
+            fzeros(jf, (batch, ch)),
+            fzeros(jf, (batch,)),
+            fzeros(jf, (batch, plan.n_steps * gp)),
+        )
+        carry, _ = jax.lax.scan(
+            body, init, jnp.arange(plan.n_steps, dtype=jnp.int32)
+        )
+        _, W0, W1, S, parts = carry
 
-    out_share = fmap(
-        lambda v: jnp.moveaxis(v, 0, 1).reshape(batch, -1)[:, : circ.output_len], parts
-    )
+    out_share = fmap(lambda v: v[:, : circ.output_len], parts)
 
     # wire_t interleaves (a, b) per chunk position: index 2c from W0[c]
     wire_t = fmap(lambda p, q: jnp.stack([p, q], axis=-1).reshape(batch, -1), W0, W1)

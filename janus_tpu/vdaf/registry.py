@@ -211,8 +211,9 @@ def prio3_batched(inst: VdafInstance) -> Prio3Batched:
         circ = circuit_for(inst)
         if not Prio3BatchedDraft.supports_circuit(circ):
             raise ValueError(
-                "draft-mode streams too long for the device sponge; this "
-                "task runs the host engine"
+                "draft-mode streams too long for the device sponge or too "
+                "large for the device memory budget (vdaf.feasibility); "
+                "this task runs the host engine"
             )
         return Prio3BatchedDraft(circ)
     return Prio3Batched(circuit_for(inst))

@@ -143,6 +143,7 @@ def test_warmup_background_buckets(caplog):
     eph.cleanup()
 
 
+@pytest.mark.slow  # 93s; warmup coverage stays fast via test_warmup_engines/test_warmup_background_buckets (ISSUE 1)
 def test_provision_precompile_then_warm_first_job(tmp_path):
     """janus_cli provision-tasks --precompile AOT-compiles the task's
     engine steps into the persistent compilation cache; a FRESH process

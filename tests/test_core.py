@@ -58,14 +58,9 @@ def test_hpke_rfc9180_vector_a1():
     enc = bytes.fromhex("37fda3567bdbd628e88668c3c8d7e97d1d1253b6d4ea6d44c150f741f1bf4431")
     pk_r = bytes.fromhex("3948cfe0ad1ddb695d780e59077195da6c56506b027329794ab02bca80815c4d")
     sk_e = bytes.fromhex("52c4a758a802cd8b936eceea314432798d5baf2d7e9235dc084ab1b9cfa2f736")
-    from cryptography.hazmat.primitives.asymmetric.x25519 import (
-        X25519PrivateKey,
-        X25519PublicKey,
-    )
+    from janus_tpu.core.hpke_backend import x25519_exchange
 
-    dh = X25519PrivateKey.from_private_bytes(sk_e).exchange(
-        X25519PublicKey.from_public_bytes(pk_r)
-    )
+    dh = x25519_exchange(sk_e, pk_r)
     from janus_tpu.core.hpke import _X25519Kem
 
     shared_secret = _extract_and_expand(_X25519Kem, dh, enc + pk_r)

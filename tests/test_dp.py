@@ -50,6 +50,7 @@ def test_add_noise_perturbs_within_tails():
         assert abs(delta) < 8 * 10  # 10 sigma
 
 
+@pytest.mark.slow  # 64s fixedpoint live pair; DP noise properties stay fast in the moment/tail tests above (ISSUE 1)
 def test_dp_end_to_end_fixed_point():
     from janus_tpu.aggregator import Aggregator, Config
     from janus_tpu.aggregator.aggregation_job_creator import (

@@ -137,7 +137,17 @@ CASE_IDS = [
 ]
 
 
-@pytest.mark.parametrize("vdaf,measurements,expected", CASES, ids=CASE_IDS)
+# tier-1 CPU budget (ROADMAP): one live-pair round trip per XOF mode
+# stays in the fast suite; the rest of the per-VDAF matrix compiles
+# 40-80s apiece on CPU and runs nightly/on-chip (ISSUE 1 CI triage).
+_FAST_E2E = {"count", "count-draft-xof"}
+CASES = [
+    pytest.param(*case, marks=() if cid in _FAST_E2E else pytest.mark.slow, id=cid)
+    for case, cid in zip(CASES, CASE_IDS)
+]
+
+
+@pytest.mark.parametrize("vdaf,measurements,expected", CASES)
 def test_full_protocol_round_trip(pair, vdaf, measurements, expected):
     leader_task, helper_task, collector_kp = provision(pair, vdaf)
     http = HttpClient()
@@ -308,6 +318,7 @@ def test_helper_auth_and_idempotency(pair):
     assert s3 == 400 and b"unauthorizedRequest" in b3
 
 
+@pytest.mark.slow  # 36s live-pair round trip; fixed-size packing is covered fast in test_batch_creator (ISSUE 1 CI triage)
 def test_fixed_size_current_batch_round_trip(pair):
     """Fixed-size task: packing to max_batch_size, current-batch
     collection consuming batches fullest-first (reference

@@ -71,7 +71,15 @@ CIRCUITS = [
 ]
 
 
-@pytest.mark.parametrize("circ", CIRCUITS, ids=lambda c: type(c).__name__)
+@pytest.mark.parametrize(
+    "circ",
+    CIRCUITS[:3]
+    # 26s: the SumVec differential drives the same streamed-query code;
+    # histogram tiled-vs-untiled bit-identity runs fast in
+    # test_tiled_prepare (ISSUE 1 CI triage)
+    + [pytest.param(CIRCUITS[3], marks=pytest.mark.slow)],
+    ids=lambda c: type(c).__name__,
+)
 def test_flp_prove_query_decide_differential(circ):
     batch = 6
     bc = batched_circuit(circ)

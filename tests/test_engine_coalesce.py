@@ -76,7 +76,16 @@ def test_coalescer_error_propagates_per_round():
     assert calls["n"] == 1
 
 
-@pytest.mark.parametrize("kind", ["count", "sumvec"])
+@pytest.mark.parametrize(
+    "kind",
+    [
+        "count",
+        # 39s: the count variant keeps the concurrency invariant in
+        # tier-1; sumvec window masking is covered fast by
+        # test_coalesced_view_never_leaks_neighbor_rows (ISSUE 1 CI triage)
+        pytest.param("sumvec", marks=pytest.mark.slow),
+    ],
+)
 def test_concurrent_jobs_match_serial(kind):
     """8 small 'jobs' through one engine concurrently == serial, and at
     least one dispatch was shared."""
@@ -143,3 +152,37 @@ def test_concurrent_jobs_match_serial(kind):
         want = np.asarray(m).sum(axis=0)
         want = np.atleast_1d(want)
         assert agg[: len(want)] == [int(x) for x in want]
+
+
+@pytest.mark.parametrize("offset", [0, 8, 40])
+def test_coalesced_view_never_leaks_neighbor_rows(offset):
+    """Window invariant (round-5 advisory): a job's masked aggregate
+    over its [offset, offset+n) view of a shared round buffer must
+    exclude the NEIGHBOR jobs' rows even though those rows sit inside
+    the [offset, offset+bucket_size(n)) dynamic-slice window and carry
+    nonzero out-shares. Covers both the jitted view path
+    (offset+bucket <= buffer) and the full-width-mask path (view would
+    run past the buffer)."""
+    from janus_tpu.aggregator.engine_cache import DeviceRows, bucket_size
+
+    inst = VdafInstance.sum_vec(length=3, bits=2)
+    engine = EngineCache(inst, VK)
+    jf = engine.p3.jf
+    b, n, out_len = 64, 4, 3
+    rng = np.random.default_rng(11)
+    # every row of the shared buffer nonzero — neighbor rows included
+    rows = rng.integers(1, 1000, size=(b, out_len)).astype(object)
+    value = jf.from_ints(rows)
+    dr = DeviceRows(value, n, offset=offset)
+    vb = bucket_size(n)
+    in_view_path = (offset or vb < b) and offset + vb <= b
+    if offset == 40:
+        assert not in_view_path  # 40 + 32 > 64: full-width mask path
+    # partial mask inside the job too: row offset+1 rejected
+    mask = np.array([True, False, True, True])
+    agg = engine.aggregate(dr, mask)
+    want = [
+        int(sum(int(rows[offset + i][j]) for i in range(n) if mask[i]) % jf.MODULUS)
+        for j in range(out_len)
+    ]
+    assert agg == want

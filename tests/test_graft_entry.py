@@ -6,6 +6,8 @@ import importlib.util
 import sys
 from pathlib import Path
 
+import pytest
+
 _ENTRY_PATH = Path(__file__).resolve().parents[1] / "__graft_entry__.py"
 
 
@@ -27,6 +29,7 @@ def test_entry_compiles_and_runs():
     assert int(out[2]) == batch  # every well-formed report accepted
 
 
+@pytest.mark.slow  # 441s: three sharded len<=100k compiles; the multichip witness runs nightly/on-chip (ISSUE 1 CI triage)
 def test_dryrun_multichip_8():
     # conftest forces an 8-device virtual CPU topology
     mod = _load_entry_module()

@@ -62,6 +62,7 @@ def _spawn(name: str, cfg_path, key: str, log_path):
     )
 
 
+@pytest.mark.slow  # 25s subprocess pair; the loopback live-pair e2e keeps the protocol path in tier-1 (ISSUE 1)
 def test_deployed_process_pair_end_to_end(tmp_path):
     from janus_tpu.bin import janus_cli
     from janus_tpu.client import Client, ClientParameters
@@ -107,7 +108,8 @@ def test_deployed_process_pair_end_to_end(tmp_path):
         tasks_file.write_text(yaml.safe_dump([task.to_dict()]))
         assert (
             janus_cli.main(
-                ["provision-tasks", str(tasks_file), "--database", db, "--datastore-keys", key]
+                # =-form: a random key may start with "-" (flag-lookalike)
+                ["provision-tasks", str(tasks_file), "--database", db, f"--datastore-keys={key}"]
             )
             == 0
         )

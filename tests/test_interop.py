@@ -60,10 +60,13 @@ def stack():
 
 VDAF_CASES = [
     ({"type": "Prio3Count"}, ["1", "0", "1", "1"], "3"),
-    (
+    # sumvec compiles ~95s on CPU — nightly/on-chip (ISSUE 1 CI triage);
+    # count keeps the interop-API wire path in the fast suite
+    pytest.param(
         {"type": "Prio3SumVec", "bits": "8", "length": "3"},
         [["1", "2", "3"], ["10", "20", "30"]],
         ["11", "22", "33"],
+        marks=pytest.mark.slow,
     ),
 ]
 

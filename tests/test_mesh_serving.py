@@ -35,6 +35,7 @@ def test_engine_cache_builds_dp_mesh():
     assert eng.dp == min(8, len(jax.devices()))
 
 
+@pytest.mark.slow  # 33s sharded live pair; mesh construction stays fast in test_engine_cache_builds_dp_mesh (ISSUE 1)
 def test_helper_http_serving_runs_sharded(pair, monkeypatch):
     """Drive reports through the live leader+helper HTTP pair and
     assert the helper's device step output was sharded over the dp
@@ -112,6 +113,22 @@ def test_helper_http_serving_runs_sharded(pair, monkeypatch):
     assert sum(r.report_count for r in rows) == len(measurements)
 
 
+def test_long_vector_task_selects_sp_axis():
+    """Mesh-shape selection alone (no compile): tasks past
+    SP_MIN_INPUT_LEN get an (dp, sp=2) mesh — the fast half of
+    test_long_vector_task_gets_sp_mesh below."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+
+    long_vdaf = VdafInstance.sum_vec(length=16384, bits=8)  # input_len 131072
+    eng = engine_cache(long_vdaf, b"\x03" * 16)
+    assert eng.sp == 2
+    assert eng.mesh.shape["sp"] == 2
+
+
+@pytest.mark.slow  # 66s long-vector compile; mesh-shape selection is asserted fast above (ISSUE 1)
 def test_long_vector_task_gets_sp_mesh():
     """Tasks past SP_MIN_INPUT_LEN shard the vector axis too: the mesh
     is (dp, sp=2) and leader_init runs with meas sharded over both axes

@@ -121,14 +121,16 @@ def test_cli_provision_and_list_tasks(tmp_path, capsys):
     db = str(tmp_path / "ds.sqlite")
     key = base64.urlsafe_b64encode(secrets.token_bytes(16)).decode().rstrip("=")
 
+    # --opt=value form: a random base64url key starts with "-" ~1/64 of
+    # the time and the separate-arg form then parses it as a flag
     rc = janus_cli.main(
-        ["provision-tasks", str(tasks_file), "--database", db, "--datastore-keys", key]
+        ["provision-tasks", str(tasks_file), "--database", db, f"--datastore-keys={key}"]
     )
     assert rc == 0
     out = json.loads(capsys.readouterr().out)
     assert out[0]["task_id"] == task.to_dict()["task_id"]
 
-    rc = janus_cli.main(["list-tasks", "--database", db, "--datastore-keys", key])
+    rc = janus_cli.main(["list-tasks", "--database", db, f"--datastore-keys={key}"])
     assert rc == 0
     listing = capsys.readouterr().out
     assert task.to_dict()["task_id"] in listing

@@ -45,6 +45,7 @@ def _drive(pair, http, rounds=8):
             break
 
 
+@pytest.mark.slow  # 29s live-pair heavy-hitters e2e; DAP wiring stays in tier-1 via test_poplar1_invalid_report_rejected, the Poplar1 math via test_poplar1_jax (ISSUE 1 CI triage)
 def test_poplar1_heavy_hitters_via_dap(pair):
     leader_task, helper_task, collector_kp = provision(
         pair, VDAF, max_batch_query_count=BITS + 1

@@ -25,9 +25,12 @@ CASES = [
     (ref.Sum(bits=8), [0, 255, 7, 200, 33]),
     (ref.SumVec(length=4, bits=4), [[0, 1, 2, 3], [15, 15, 15, 15], [5, 0, 9, 2], [1, 1, 1, 1], [0, 0, 0, 0]]),
     (ref.Histogram(length=7), [0, 6, 3, 3, 1]),
-    (
+    # 29s compile on CPU; fixedpoint device/host parity runs nightly —
+    # the four core families keep the differential in tier-1 (ISSUE 1)
+    pytest.param(
         ref.FixedPointVec(length=3, bits=16),
         [[8192, -8192, 0], [100, -100, 12000], [0, 0, 0], [-16384, 1, 1], [4096, 4096, 4096]],
+        marks=pytest.mark.slow,
     ),
 ]
 
@@ -134,6 +137,7 @@ def test_device_vs_host_full_protocol(circ, meas):
         assert got == want_hist
 
 
+@pytest.mark.slow  # 38s incl teardown; reject masking is covered fast by test_failures + the coalesce window tests (ISSUE 1)
 def test_invalid_reports_masked_not_fatal():
     """Tampered shares must yield False lanes, valid lanes unaffected."""
     circ = ref.Sum(bits=4)

@@ -25,8 +25,17 @@ def _rand_lanes(rng, batch, n):
 
 
 CIRCUITS = [
-    SumVec(40, 16, chunk_length=5),  # input_len 640; align lcm(7,16)/gcd(.,5)=112 calls... exercises call padding
-    SumVec(56, 8, chunk_length=7),  # chunk divisible by 7
+    # the sumvec variants compile 23-42s apiece on CPU; the tiled-prepare
+    # suite keeps a fast streamed-sumvec equivalence check in tier-1, so
+    # these run nightly/on-chip (ISSUE 1 CI triage)
+    pytest.param(
+        SumVec(40, 16, chunk_length=5),  # input_len 640; align lcm(7,16)/gcd(.,5)=112 calls... exercises call padding
+        marks=pytest.mark.slow,
+    ),
+    pytest.param(
+        SumVec(56, 8, chunk_length=7),  # chunk divisible by 7
+        marks=pytest.mark.slow,
+    ),
     Histogram(200, chunk_length=9),
 ]
 
@@ -93,6 +102,7 @@ def test_streamed_equals_batched(circ, monkeypatch):
             np.testing.assert_array_equal(np.asarray(s), np.asarray(u))
 
 
+@pytest.mark.slow  # 27s; test_tiled_prepare keeps a two-party streamed step in tier-1 (ISSUE 1 CI triage)
 def test_full_two_party_step_streamed(monkeypatch):
     """End-to-end: shard on the unstreamed path, prepare on the streamed
     path, decide + aggregate — all reports accepted, sum correct."""

@@ -89,6 +89,38 @@ def test_collect_cli_arg_validation():
         collect.main(base + ["--vdaf", "fixedpoint16vec"])  # missing --length
 
 
+def test_bench_dry_run_smoke():
+    """CI smoke of `bench.py --dry-run` (no accelerator): the HBM
+    feasibility report must be well-formed and the EngineCache
+    OOM-retry / host-fallback machinery must survive an injected
+    RESOURCE_EXHAUSTED — so the serving failure path added in r6 is
+    exercised on every CPU test run, not just on chip."""
+    import json
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # don't inherit conftest's 8-virtual-device XLA_FLAGS: the smoke
+    # models the single-accelerator serving shape (bucket floor = 1)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--dry-run", "--config", "count"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "dry_run"
+    fz = rec["feasibility"]
+    assert fz["row_bytes"] > 0 and fz["budget_bytes"] > 0
+    smoke = rec["oom_fallback_smoke"]
+    assert smoke["halved_retry_ok"] is True
+    assert smoke["host_fallback_ok"] is True
+
+
 def test_collect_cli_end_to_end(capsys):
     clock = MockClock(Time(1_600_000_000))
     leader_eph = EphemeralDatastore(clock=clock)
