@@ -155,10 +155,63 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
                 break
             raise AssertionError(f"upload failed: {status} {body!r}")
 
+        # ingest phase (docs/INGEST.md): serial baseline first — one
+        # report in flight, so the decrypt pool cannot overlap work —
+        # then the 16-way burst the staged pipeline was built for; the
+        # ratio is the pipelining win on this host. Shed accounting
+        # rides along (0 unless admission buckets are configured).
+        from janus_tpu import metrics as _metrics
+
+        shed0 = _metrics.upload_shed_counter.total()
+        n_serial = max(2, min(32, n_reports // 4))
+        t0 = _time.time()
+        for r in reports[:n_serial]:
+            _upload(r)
+        serial_s = _time.time() - t0
+        serial_rps = n_serial / serial_s if serial_s > 0 else float("inf")
+        progress["t"] = time.monotonic()
         t0 = _time.time()
         with ThreadPoolExecutor(max_workers=16) as pool:
-            list(pool.map(_upload, reports))
+            list(pool.map(_upload, reports[n_serial:]))
         upload_s = _time.time() - t0
+        ingest_rps = (n_reports - n_serial) / upload_s if upload_s > 0 else float("inf")
+        shed_total = _metrics.upload_shed_counter.total() - shed0
+        progress["t"] = time.monotonic()
+
+        # server-side ingest capacity, isolated from the loopback
+        # client's own Python cost (which shares the GIL with the
+        # server above): the OLD upload architecture — one thread, one
+        # transaction per report — vs the staged pipeline fed directly,
+        # on fresh stores so every commit is a real insert
+        from janus_tpu.aggregator.core import TaskAggregator
+        from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+        from janus_tpu.ingest import IngestPipeline
+
+        sample = reports[: min(96, n_reports)]
+        eph_a = EphemeralDatastore(clock=clock)
+        eph_b = EphemeralDatastore(clock=clock)
+        try:
+            eph_a.datastore.run_tx(lambda tx: tx.put_task(leader_task))
+            eph_b.datastore.run_tx(lambda tx: tx.put_task(leader_task))
+            ta = TaskAggregator(leader_task, Config())
+            t0 = _time.time()
+            for r in sample:
+                ta.handle_upload(eph_a.datastore, clock, r, None)
+            serial_path_s = _time.time() - t0
+            progress["t"] = time.monotonic()
+            writer = ReportWriteBatcher(eph_b.datastore, 100, 0)
+            pipe = IngestPipeline(writer, queue_depth=len(sample))
+            try:
+                t0 = _time.time()
+                tickets = [pipe.submit(ta, clock, r.to_bytes()) for r in sample]
+                assert all(t.result(timeout_s=60) for t in tickets)
+                pipeline_s = _time.time() - t0
+            finally:
+                pipe.close()
+                writer.close()
+        finally:
+            eph_a.cleanup()
+            eph_b.cleanup()
         progress["t"] = time.monotonic()
 
         creator = AggregationJobCreator(
@@ -211,7 +264,16 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             "n_reports": n_reports,
             "warmup_s": round(warmup_s, 2),
             "stage_s": round(stage_s, 2),
-            "upload_rps": round(n_reports / upload_s, 2),
+            "upload_serial_rps": round(serial_rps, 2),
+            "ingest_rps": round(ingest_rps, 2),
+            "upload_rps": round(ingest_rps, 2),  # legacy name
+            "ingest_vs_serial": round(ingest_rps / serial_rps, 2),
+            "upload_shed_total": shed_total,
+            # old architecture (one thread, one tx per report) vs the
+            # staged pipeline, pure server-side
+            "single_thread_upload_rps": round(len(sample) / serial_path_s, 2),
+            "ingest_pipeline_rps": round(len(sample) / pipeline_s, 2),
+            "ingest_pipeline_speedup": round(serial_path_s / pipeline_s, 2),
             "served_aggregate_rps": round(n_reports / aggregate_s, 2),
             "collect_s": round(collect_s, 2),
         }
@@ -403,6 +465,91 @@ def _oom_fallback_smoke() -> dict:
     }
 
 
+def _ingest_shed_smoke() -> dict:
+    """Drive a burst of real uploads through the admission-controlled
+    ingest pipeline over loopback HTTP with a deliberately tiny token
+    bucket: the first `burst` uploads must commit (exactly once), the
+    rest must shed `429 + Retry-After`, and `janus_upload_shed_total`
+    must account for every rejection. CPU-only, no accelerator — CI's
+    --dry-run smoke covers the serving shed path on every test run."""
+    from janus_tpu import metrics as _m
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    # burst of 3 then a ~glacial refill: uploads 4..8 shed deterministically
+    cfg = Config(
+        upload_bucket_rate=0.001,
+        upload_bucket_burst=3,
+        ingest_decrypt_workers=2,
+        ingest_queue_depth=8,
+    )
+    agg = Aggregator(eph.datastore, clock, cfg)
+    srv = DapServer(DapHttpApp(agg), max_handler_threads=4).start()
+    try:
+        vdaf = VdafInstance.count()
+        leader_kp = generate_hpke_config_and_private_key(config_id=0)
+        helper_kp = generate_hpke_config_and_private_key(config_id=1)
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=srv.url,
+                helper_aggregator_endpoint=srv.url,
+                hpke_keys=(leader_kp,),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        eph.datastore.run_tx(lambda tx: tx.put_task(task))
+        params = ClientParameters(task.task_id, srv.url, srv.url, task.time_precision)
+        client = Client(params, vdaf, leader_kp.config, helper_kp.config, clock=clock)
+        http = HttpClient()
+        shed0 = _m.upload_shed_counter.total()
+        results = []
+        for _ in range(8):
+            report = client.prepare_report(1)
+            status, _body = http.put(
+                params.upload_uri(),
+                report.to_bytes(),
+                {"Content-Type": "application/dap-report"},
+            )
+            retry_after = next(
+                (
+                    v
+                    for k, v in http.last_response_headers.items()
+                    if k.lower() == "retry-after"
+                ),
+                None,
+            )
+            results.append((status, retry_after))
+        accepted = sum(1 for s, _ in results if s == 201)
+        shed = [r for r in results if r[0] == 429]
+        stored, _ = eph.datastore.run_tx(
+            lambda tx: tx.count_client_reports_for_task(task.task_id)
+        )
+        return {
+            "accepted": accepted,
+            "shed": len(shed),
+            "shed_counter_delta": _m.upload_shed_counter.total() - shed0,
+            "retry_after_present": bool(shed)
+            and all(ra is not None and float(ra) >= 1 for _, ra in shed),
+            "stored_reports": int(stored),
+            "committed_exactly_once": int(stored) == accepted,
+        }
+    finally:
+        srv.stop()
+        eph.cleanup()
+
+
 # Planning default when the backend reports no memory budget (the axon
 # tunnel; CPU): the v5e HBM size the BASELINE.md measurements ran on.
 V5E_HBM_BYTES = int(15.75 * (1 << 30))
@@ -432,8 +579,10 @@ def _feasibility_record(inst):
 def run_dry(args, ap) -> None:
     """--dry-run: no accelerator required. Prints the HBM feasibility
     model's view of the config (modeled bytes/row, largest safe bucket,
-    stream-plan tile geometry) and smoke-tests the EngineCache
-    bucketing/OOM-fallback path on a toy circuit, as one JSON line."""
+    stream-plan tile geometry), smoke-tests the EngineCache
+    bucketing/OOM-fallback path on a toy circuit, and smoke-tests the
+    admission-controlled ingest pipeline's 429-shed path over loopback
+    HTTP, as one JSON line."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     inst = _make_inst(args, ap)
     desc, budget, plan = _feasibility_record(inst)
@@ -455,6 +604,7 @@ def run_dry(args, ap) -> None:
                 "device_budget_bytes": budget,
                 "modeled_budget_bytes": budget if budget is not None else V5E_HBM_BYTES,
                 "oom_fallback_smoke": _oom_fallback_smoke(),
+                "ingest_smoke": _ingest_shed_smoke(),
             }
         )
     )
@@ -509,6 +659,18 @@ def main() -> None:
         "on CPU, then exit",
     )
     ap.add_argument(
+        "--bringup-deadline-seconds",
+        type=float,
+        default=600.0,
+        help="global wall-clock budget for accelerator bring-up, measured "
+        "from the FIRST process (it survives the stall/OOM re-execs via "
+        "JANUS_BENCH_T0): once passed, stall recovery stops resting/"
+        "retrying the accelerator and re-execs pinned to CPU so the run "
+        "always emits a parseable BENCH json (the r5 driver artifact "
+        "was rc=124/parsed:null because init rests consumed the whole "
+        "window). 0 disables.",
+    )
+    ap.add_argument(
         "--max-seconds",
         type=float,
         default=1500.0,  # must exceed the worst remote-compile stretch
@@ -526,6 +688,16 @@ def main() -> None:
             ap.error("--dry-run models Prio3 prepare; poplar1 has no FLP circuit")
         run_dry(args, ap)
         return
+
+    # bring-up clock: starts in the first process and survives every
+    # re-exec (stall retries, OOM halving) via the environment
+    bringup_t0 = float(os.environ.setdefault("JANUS_BENCH_T0", str(time.time())))
+
+    def _bringup_deadline_passed() -> bool:
+        return (
+            args.bringup_deadline_seconds > 0
+            and time.time() - bringup_t0 > args.bringup_deadline_seconds
+        )
 
     # Watchdog against a wedged axon tunnel. The tunnel's chip grant can
     # take minutes to release after the previous holder exits, and a
@@ -549,7 +721,7 @@ def main() -> None:
                 rearm.start()
                 return
             attempt = int(os.environ.get("JANUS_BENCH_ATTEMPT", "0"))
-            if attempt < 3:
+            if attempt < 3 and not _bringup_deadline_passed():
                 print(
                     f"[bench] stalled (attempt {attempt}); resting 150s then retrying axon",
                     file=sys.stderr,
@@ -560,6 +732,12 @@ def main() -> None:
                     return  # the run came back to life during the rest
                 os.environ["JANUS_BENCH_ATTEMPT"] = str(attempt + 1)
             else:
+                if _bringup_deadline_passed():
+                    print(
+                        "[bench] bring-up deadline passed while stalled; no more rests",
+                        file=sys.stderr,
+                        flush=True,
+                    )
                 print("[bench] accelerator unusable; re-exec on CPU backend", file=sys.stderr, flush=True)
                 os.environ["JANUS_BENCH_CPU_FALLBACK"] = "1"
                 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -590,11 +768,23 @@ def main() -> None:
         backend = jax.default_backend()
         jax.devices()
     except RuntimeError as e:
-        if attempt >= 4:
-            raise
-        print(f"backend init failed ({e}); retrying in 90s", file=sys.stderr, flush=True)
-        time.sleep(90)
-        os.environ["JANUS_BENCH_ATTEMPT"] = str(attempt + 1)
+        if os.environ.get("JANUS_BENCH_CPU_FALLBACK") == "1":
+            raise  # even the CPU backend failed; nothing left to try
+        if attempt >= 4 or _bringup_deadline_passed():
+            # out of bring-up budget: pin CPU and re-exec so the run
+            # still emits a parseable BENCH json instead of rc=124
+            print(
+                f"backend init failed ({e}); bring-up budget exhausted, "
+                "falling back to the CPU backend",
+                file=sys.stderr,
+                flush=True,
+            )
+            os.environ["JANUS_BENCH_CPU_FALLBACK"] = "1"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        else:
+            print(f"backend init failed ({e}); retrying in 90s", file=sys.stderr, flush=True)
+            time.sleep(90)
+            os.environ["JANUS_BENCH_ATTEMPT"] = str(attempt + 1)
         os.execv(sys.executable, [sys.executable] + sys.argv)
     on_accel = backend not in ("cpu",)
 

@@ -647,9 +647,13 @@ class AggregationJobDriver:
 
         def attempt():
             # go through put/post (not request) so test doubles that
-            # wrap those verbs see the traffic
+            # wrap those verbs see the traffic; the trailing headers
+            # element lets a shedding helper's Retry-After pace retries
             fn = self.http.put if method == "PUT" else self.http.post
-            return fn(url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline))
+            status, body = fn(
+                url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
+            )
+            return status, body, getattr(self.http, "last_response_headers", {})
 
         status, body = retry_http_request(attempt, self.cfg.http_backoff, deadline=deadline)
         if status not in (200, 201):

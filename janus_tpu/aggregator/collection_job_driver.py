@@ -281,9 +281,12 @@ class CollectionJobDriver:
             headers.update(task.aggregator_auth_token.request_headers())
 
         def attempt():
-            return self.http.post(
+            # trailing headers element: a shedding helper's Retry-After
+            # paces the retry loop (core/retries.py)
+            status, body = self.http.post(
                 url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
             )
+            return status, body, getattr(self.http, "last_response_headers", {})
 
         status, body = retry_http_request(attempt, self.cfg.http_backoff, deadline=deadline)
         if status != 200:

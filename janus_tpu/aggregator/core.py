@@ -105,6 +105,33 @@ class Config:
     # Retry-After (seconds) on 202 collection-job polls; the collector
     # honors it (reference collector/src/lib.rs:466)
     collection_retry_after_s: int = 1
+    # --- ingest pipeline + admission control (docs/INGEST.md) ---
+    # HPKE-decrypt pool size; 0 = one per host core
+    ingest_decrypt_workers: int = 0
+    ingest_decode_workers: int = 1
+    # Bound on uploads in flight through the pipeline (admission's
+    # queue-depth signal and the hard queue-full backstop). Every
+    # in-flight upload also parks one handler thread on its ticket, so
+    # this must stay BELOW max_handler_threads for queue-pressure
+    # shedding to ever fire (and to leave handler slots for the other
+    # routes); a bound above it is unreachable dead config.
+    ingest_queue_depth: int = 24
+    # token buckets per route class; rate 0 = unlimited
+    upload_bucket_rate: float = 0.0
+    upload_bucket_burst: int = 0
+    aggregate_bucket_rate: float = 0.0
+    aggregate_bucket_burst: int = 0
+    # shed order under queue pressure (first sheds first): client
+    # uploads before the aggregator-to-aggregator steps that finish
+    # work the system already paid for
+    shed_priority: tuple = ("upload", "aggregate")
+    # pipeline occupancy fraction at which shed_priority[0] sheds
+    queue_high_watermark: float = 0.75
+    # Retry-After for queue-pressure sheds (rate sheds advertise the
+    # bucket's actual refill time)
+    upload_shed_retry_after_s: float = 1.0
+    # cap on concurrent HTTP handler threads in DapServer
+    max_handler_threads: int = 32
 
 
 class TaskAggregator:
@@ -145,12 +172,14 @@ class TaskAggregator:
     # ------------------------------------------------------------------
     # upload (reference aggregator.rs:1325)
     # ------------------------------------------------------------------
-    def handle_upload(self, ds: Datastore, clock: Clock, report: Report, writer=None) -> None:
-        """`writer`: a ReportWriteBatcher; falls back to a direct
-        single-report transaction when absent (tests, tools)."""
+    def upload_prepare(self, clock: Clock, report: Report):
+        """Cheap per-report checks ahead of the decrypt stage (the
+        ingest pipeline's decode stage runs this): clock skew / expiry
+        (reference :1344-1385), public-share well-formedness, HPKE
+        keypair lookup. Returns the keypair for upload_decrypt_validate.
+        """
         task = self.task
         now = clock.now()
-        # clock skew / expiry checks (reference :1344-1385)
         if report.metadata.time > now.add(task.tolerable_clock_skew):
             raise errors.ReportTooEarly("report from the future", task.task_id)
         if task.task_expiration and report.metadata.time > task.task_expiration:
@@ -166,12 +195,19 @@ class TaskAggregator:
                 metrics.upload_decode_failure_counter.add()
                 raise errors.InvalidMessage(f"bad public share: {e}", task.task_id)
 
-        # decrypt + decode the leader input share at upload time (:1391)
         keypair = self._hpke_keypair(report.leader_encrypted_input_share.config_id)
         if keypair is None:
             raise errors.OutdatedHpkeConfig("unknown HPKE config id", task.task_id)
+        return keypair
+
+    def upload_decrypt_validate(self, report: Report, keypair):
+        """CPU-heavy upload stage (the ingest pipeline's decrypt pool
+        runs this off the handler thread): decrypt + decode the leader
+        input share at upload time (reference :1391) and validate it
+        columnarly. Returns the LeaderStoredReport to commit."""
         from ..trace import span
 
+        task = self.task
         aad = InputShareAad(task.task_id, report.metadata, report.public_share).to_bytes()
         try:
             with span("upload.hpke_validate"):
@@ -195,7 +231,7 @@ class TaskAggregator:
 
         from ..datastore.models import LeaderStoredReport
 
-        stored = LeaderStoredReport(
+        return LeaderStoredReport(
             task.task_id,
             report.metadata.report_id,
             report.metadata.time,
@@ -203,6 +239,17 @@ class TaskAggregator:
             payload,
             report.helper_encrypted_input_share,
         )
+
+    def handle_upload(self, ds: Datastore, clock: Clock, report: Report, writer=None) -> None:
+        """Single-threaded upload path (tests, tools; the serving HTTP
+        layer goes through janus_tpu.ingest.IngestPipeline, which runs
+        the same two stages on its own workers). `writer`: a
+        ReportWriteBatcher; falls back to a direct single-report
+        transaction when absent."""
+        from ..trace import span
+
+        keypair = self.upload_prepare(clock, report)
+        stored = self.upload_decrypt_validate(report, keypair)
         with span("upload.write"):
             if writer is not None:
                 fresh = writer.write_report(stored)  # batched tx (report_writer.rs)

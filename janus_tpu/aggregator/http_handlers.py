@@ -20,17 +20,18 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import math
 import re
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 
+from ..ingest import AdmissionConfig, AdmissionController, IngestPipeline, ShedError
 from ..messages import (
     AggregateShareReq,
     AggregationJobId,
     AggregationJobInitializeReq,
     CollectionJobId,
     CollectionReq,
-    Report,
     Role,
     TaskId,
 )
@@ -64,6 +65,19 @@ _ROUTES = [
     ("DELETE", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "collection_delete"),
     ("POST", re.compile(r"^/tasks/([^/]+)/aggregate_shares$"), "aggregate_share"),
 ]
+
+# Admission route classes (docs/INGEST.md shed policy): client uploads
+# shed first; the aggregator-to-aggregator steps — which finish work
+# the system already paid to admit — shed only near saturation.
+# hpke_config (cheap, cacheable) and the collector-facing
+# collection_jobs routes (which have their own 202 Retry-After flow)
+# are never shed.
+_ROUTE_CLASS = {
+    "upload": "upload",
+    "aggregate_init": "aggregate",
+    "aggregate_continue": "aggregate",
+    "aggregate_share": "aggregate",
+}
 
 # Request body media types per route (reference http_handlers.rs:512-551
 # extracts and enforces the DAP media type on every body-carrying route).
@@ -108,10 +122,54 @@ def _cors_allow(path: str) -> str | None:
 
 
 class DapHttpApp:
-    """Routing + handler glue around an Aggregator."""
+    """Routing + handler glue around an Aggregator.
 
-    def __init__(self, aggregator: Aggregator):
+    Uploads flow through an admission-controlled ingest pipeline
+    (janus_tpu.ingest): shed requests answer `429 + Retry-After`
+    before any crypto work; admitted ones decode/decrypt/commit on the
+    pipeline's bounded worker stages while the handler thread parks on
+    the ticket. Built lazily from the aggregator's Config on the first
+    admitted route, so test doubles that never reach a real handler
+    need no config."""
+
+    def __init__(self, aggregator: Aggregator, ingest: IngestPipeline | None = None):
         self.agg = aggregator
+        self._ingest = ingest
+        self._admission: AdmissionController | None = None
+        self._ingest_lock = threading.Lock()
+
+    def _ensure_ingest(self) -> tuple[IngestPipeline, AdmissionController]:
+        with self._ingest_lock:
+            if self._ingest is None:
+                cfg = self.agg.cfg
+                self._ingest = IngestPipeline(
+                    self.agg.report_writer,
+                    decrypt_workers=cfg.ingest_decrypt_workers,
+                    decode_workers=cfg.ingest_decode_workers,
+                    queue_depth=cfg.ingest_queue_depth,
+                )
+            if self._admission is None:
+                cfg = self.agg.cfg
+                self._admission = AdmissionController(
+                    AdmissionConfig(
+                        upload_bucket_rate=cfg.upload_bucket_rate,
+                        upload_bucket_burst=cfg.upload_bucket_burst,
+                        aggregate_bucket_rate=cfg.aggregate_bucket_rate,
+                        aggregate_bucket_burst=cfg.aggregate_bucket_burst,
+                        shed_priority=tuple(cfg.shed_priority),
+                        queue_high_watermark=cfg.queue_high_watermark,
+                        shed_retry_after_s=cfg.upload_shed_retry_after_s,
+                    ),
+                    depth_fn=self._ingest.depth,
+                )
+            return self._ingest, self._admission
+
+    def close(self) -> None:
+        """Drain the ingest pipeline's worker threads (shutdown)."""
+        with self._ingest_lock:
+            ingest = self._ingest
+        if ingest is not None:
+            ingest.close()
 
     def _taskprov_config(self, task_id: TaskId, headers):
         """Decode + verify the dap-taskprov header (reference
@@ -211,8 +269,30 @@ class DapHttpApp:
                                 "application/problem+json",
                                 json.dumps(doc).encode(),
                             )
+                    route_class = _ROUTE_CLASS.get(name)
+                    if route_class is not None:
+                        # shed BEFORE any decode/crypto/datastore work:
+                        # the whole point of admission control is that a
+                        # refused request costs ~nothing
+                        _, admission = self._ensure_ingest()
+                        admission.admit(route_class)
                     return getattr(self, "h_" + name)(match, query, headers, body)
             return 404, "text/plain", b"not found"
+        except ShedError as e:
+            from .. import metrics
+
+            metrics.upload_shed_counter.add(route=e.route_class, reason=e.reason)
+            doc = {
+                "type": "about:blank",
+                "status": 429,
+                "detail": str(e),
+            }
+            return (
+                429,
+                "application/problem+json",
+                json.dumps(doc).encode(),
+                {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))},
+            )
         except AggregatorError as e:
             doc = e.problem_document()
             if doc is None:
@@ -260,8 +340,20 @@ class DapHttpApp:
     def h_upload(self, match, query, headers, body):
         task_id = TaskId(_b64dec(match.group(1), 32))
         ta = self.agg.task_aggregator_for(task_id)
-        report = Report.from_bytes(body)
-        ta.handle_upload(self.agg.ds, self.agg.clock, report, self.agg.report_writer)
+        # staged ingest: decode and HPKE-decrypt run on the pipeline's
+        # bounded worker stages, the write lands in the
+        # ReportWriteBatcher group commit; this thread parks on the
+        # ticket so the response still means "durably written". Stage
+        # errors (DecodeError, ReportRejected, ...) re-raise here and
+        # map to problem documents exactly as the inline path did.
+        ingest, _ = self._ensure_ingest()
+        ticket = ingest.submit(ta, self.agg.clock, body)
+        fresh = ticket.result()
+        if not fresh:
+            # replay is silent success (DAP-07 upload semantics)
+            from .. import metrics
+
+            metrics.upload_replay_counter.add()
         return 201, "text/plain", b""
 
     def h_aggregate_init(self, match, query, headers, body):
@@ -344,13 +436,33 @@ class DapHttpApp:
 
 
 class DapServer:
-    """Threaded HTTP server hosting a DapHttpApp (+ /healthz)."""
+    """Bounded-concurrency HTTP server hosting a DapHttpApp (+ /healthz).
 
-    def __init__(self, app: DapHttpApp, host: str = "127.0.0.1", port: int = 0):
+    Requests are served by a fixed pool of `max_handler_threads`
+    workers (BoundedThreadingHTTPServer) instead of a thread per
+    connection: a burst above capacity waits in the accept backlog or
+    is shed by the admission controller with 429, and handler thread
+    count stays ≤ the bound no matter the connection count."""
+
+    def __init__(
+        self,
+        app: DapHttpApp,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_handler_threads: int | None = None,
+    ):
         outer = self
+        if max_handler_threads is None:
+            try:
+                max_handler_threads = int(app.agg.cfg.max_handler_threads)
+            except Exception:  # test doubles without a real Aggregator
+                max_handler_threads = 32
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # an idle keep-alive connection must not pin a pool worker
+            # forever: time out the blocking request read
+            timeout = 60
 
             def _dispatch(self, method):
                 from urllib.parse import parse_qsl, urlsplit
@@ -386,6 +498,12 @@ class DapServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(out)))
+                if getattr(self.server, "saturated", False):
+                    # pool full: finish this response, then recycle the
+                    # connection so parked keep-alive clients can't pin
+                    # every worker and starve new connections
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
                 for k, v in (extra or {}).items():
                     self.send_header(k, v)
                 # CORS only on browser-reachable routes (reference
@@ -425,14 +543,11 @@ class DapServer:
 
         self.app = app
 
-        # deep listen backlog: bursts of short-lived connections (load
-        # generators, proxies that do not keep alive) otherwise overflow
-        # the default 5-entry accept queue into client-visible resets.
-        # Subclassed so the setting stays scoped to DAP listeners.
-        class _Server(ThreadingHTTPServer):
-            request_queue_size = 128
+        from ..binary_utils import BoundedThreadingHTTPServer
 
-        self.server = _Server((host, port), Handler)
+        self.server = BoundedThreadingHTTPServer(
+            (host, port), Handler, max_handler_threads=max_handler_threads
+        )
         self._thread: threading.Thread | None = None
 
     @property
@@ -450,3 +565,5 @@ class DapServer:
         self.server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if isinstance(self.app, DapHttpApp):  # not for routing test doubles
+            self.app.close()

@@ -27,13 +27,17 @@ log = logging.getLogger(__name__)
 
 
 class _Pending:
-    __slots__ = ("report", "event", "fresh", "error")
+    __slots__ = ("report", "event", "fresh", "error", "on_done")
 
-    def __init__(self, report: LeaderStoredReport):
+    def __init__(self, report: LeaderStoredReport, on_done=None):
         self.report = report
         self.event = threading.Event()
         self.fresh: bool | None = None
         self.error: BaseException | None = None
+        # optional callback, run on the flusher thread after the
+        # outcome is recorded (the ingest pipeline resolves its upload
+        # tickets here instead of parking a thread per report)
+        self.on_done = on_done
 
 
 class ReportWriteBatcher:
@@ -57,7 +61,20 @@ class ReportWriteBatcher:
 
     def write_report(self, report: LeaderStoredReport, timeout_s: float = 30.0) -> bool:
         """Queue + wait for the group commit; returns False on replay."""
-        pending = _Pending(report)
+        pending = self.submit_report(report)
+        if not pending.event.wait(timeout_s):
+            raise TimeoutError("report write batch did not flush in time")
+        if pending.error is not None:
+            raise pending.error
+        assert pending.fresh is not None
+        return pending.fresh
+
+    def submit_report(self, report: LeaderStoredReport, on_done=None) -> _Pending:
+        """Queue without waiting. The returned _Pending's event fires —
+        and `on_done(pending)` runs on the flusher thread — once its
+        batch's transaction commits (pending.fresh) or fails
+        (pending.error)."""
+        pending = _Pending(report, on_done)
         with self._cv:
             if self._stop:
                 raise RuntimeError("report writer is closed")
@@ -68,12 +85,7 @@ class ReportWriteBatcher:
                 )
                 self._flusher.start()
             self._cv.notify()
-        if not pending.event.wait(timeout_s):
-            raise TimeoutError("report write batch did not flush in time")
-        if pending.error is not None:
-            raise pending.error
-        assert pending.fresh is not None
-        return pending.fresh
+        return pending
 
     def flush_now(self) -> None:
         """Flush whatever is buffered synchronously (tests/shutdown)."""
@@ -131,3 +143,10 @@ class ReportWriteBatcher:
         finally:
             for p in batch:
                 p.event.set()
+                if p.on_done is not None:
+                    try:
+                        p.on_done(p)
+                    except Exception:
+                        # a bad callback must not take down the flusher
+                        # or the rest of the batch's notifications
+                        log.exception("report write on_done callback failed")

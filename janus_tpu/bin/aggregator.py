@@ -24,8 +24,18 @@ def run(cfg: AggregatorConfig, ds, stopper):
     clock = RealClock()
     aggregator = Aggregator(ds, clock, cfg.protocol_config())
     host, port = _split_hostport(cfg.listen_address)
-    server = DapServer(DapHttpApp(aggregator), host=host, port=port).start()
-    log.info("DAP server listening on %s", server.url)
+    server = DapServer(
+        DapHttpApp(aggregator),
+        host=host,
+        port=port,
+        max_handler_threads=cfg.max_handler_threads,
+    ).start()
+    log.info(
+        "DAP server listening on %s (handler threads <= %d, ingest queue depth %d)",
+        server.url,
+        cfg.max_handler_threads,
+        cfg.ingest_queue_depth,
+    )
 
     api_server = None
     if cfg.aggregator_api_listen_address:
@@ -55,9 +65,12 @@ def run(cfg: AggregatorConfig, ds, stopper):
         while not stopper.stopped:
             stopper.wait(1.0)
     finally:
-        server.stop()
+        server.stop()  # also drains the ingest pipeline (DapHttpApp.close)
         if api_server is not None:
             api_server.stop()
+        # flush any uploads still buffered in the group-commit writer so
+        # a graceful shutdown never drops admitted reports
+        aggregator.report_writer.close()
     log.info("aggregator shut down")
 
 

@@ -52,6 +52,63 @@ def _split_hostport(addr: str) -> tuple[str, int]:
     return host or "0.0.0.0", int(port)
 
 
+class BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a fixed handler pool instead of a
+    thread per connection (docs/INGEST.md "Bounded serving"): accepted
+    connections are served by at most `max_handler_threads` workers;
+    excess connections wait in the accept backlog / pool queue rather
+    than growing threads without limit. Both the DAP listener and the
+    health/metrics listener use it."""
+
+    # deep listen backlog: bursts of short-lived connections (load
+    # generators, proxies that do not keep alive) otherwise overflow
+    # the default 5-entry accept queue into client-visible resets
+    request_queue_size = 128
+
+    def __init__(self, addr, handler_cls, max_handler_threads: int = 32):
+        from concurrent.futures import ThreadPoolExecutor
+
+        super().__init__(addr, handler_cls)
+        self._max_handler_threads = max(1, max_handler_threads)
+        self._active_connections = 0
+        self._active_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._max_handler_threads, thread_name_prefix="dap-handler"
+        )
+
+    @property
+    def saturated(self) -> bool:
+        """Every pool worker is occupied by a connection. Handlers use
+        this to drop HTTP keep-alive (`Connection: close` after the
+        in-flight response): a persistent connection pins its worker
+        for the connection's lifetime, so at saturation idle-but-open
+        clients would otherwise starve every later connection without
+        even a 429 reaching them."""
+        return self._active_connections >= self._max_handler_threads
+
+    def process_request(self, request, client_address):
+        try:
+            self._pool.submit(self._process_in_pool, request, client_address)
+        except RuntimeError:  # pool already shut down (server closing)
+            self.shutdown_request(request)
+
+    def _process_in_pool(self, request, client_address):
+        with self._active_lock:
+            self._active_connections += 1
+        try:
+            self.finish_request(request, client_address)
+        except Exception:
+            self.handle_error(request, client_address)
+        finally:
+            with self._active_lock:
+                self._active_connections -= 1
+            self.shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        self._pool.shutdown(wait=False)
+
+
 class HealthServer:
     """GET /healthz -> 200; GET /metrics -> Prometheus text
     (reference serves /healthz from binary_utils.rs and metrics via the
@@ -79,7 +136,9 @@ class HealthServer:
             def log_message(self, fmt, *args):
                 pass
 
-        self._srv = ThreadingHTTPServer((host, port), Handler)
+        # small fixed pool: scrapes and probes are cheap, and the
+        # listener must never be a thread-growth vector either
+        self._srv = BoundedThreadingHTTPServer((host, port), Handler, max_handler_threads=4)
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
 
     @property

@@ -93,6 +93,7 @@ class Client:
         for role in (Role.LEADER, Role.HELPER):
             status, body = retry_http_request(
                 lambda role=role: http.get(parameters.hpke_config_uri(role))
+                + (getattr(http, "last_response_headers", {}),)
             )
             if status != 200:
                 raise RuntimeError(f"hpke_config fetch failed: HTTP {status}")
@@ -148,15 +149,19 @@ class Client:
         return Report(metadata, public_share, leader_ct, helper_ct)
 
     def upload(self, measurement, when=None) -> None:
-        """PUT the report to the leader with retries (reference :270)."""
+        """PUT the report to the leader with retries (reference :270).
+        The 3-tuple return hands response headers to the retry loop so
+        a shedding leader's `429 + Retry-After` paces this client."""
         report = self.prepare_report(measurement, when=when)
-        status, body = retry_http_request(
-            lambda: self.http.put(
+
+        def attempt():
+            status, body = self.http.put(
                 self.params.upload_uri(),
                 report.to_bytes(),
                 {"Content-Type": Report.MEDIA_TYPE},
-            ),
-            Backoff(),
-        )
+            )
+            return status, body, getattr(self.http, "last_response_headers", {})
+
+        status, body = retry_http_request(attempt, Backoff())
         if status not in (200, 201):
             raise RuntimeError(f"upload failed: HTTP {status}: {body[:200]!r}")

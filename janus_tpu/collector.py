@@ -85,6 +85,7 @@ class Collector:
             lambda: self.http.put(
                 self.params.collection_job_uri(job_id), req.to_bytes(), headers
             )
+            + (getattr(self.http, "last_response_headers", {}),)
         )
         if status not in (200, 201):
             raise RuntimeError(f"collection create failed: HTTP {status}: {body[:300]!r}")
@@ -95,6 +96,7 @@ class Collector:
         headers = dict(self.params.auth_token.request_headers())
         status, body = retry_http_request(
             lambda: self.http.post(self.params.collection_job_uri(job_id), b"", headers)
+            + (getattr(self.http, "last_response_headers", {}),)
         )
         if status == 202:
             ra = None

@@ -112,11 +112,27 @@ class AggregatorConfig:
     taskprov: TaskprovConfig = field(default_factory=TaskprovConfig)
     garbage_collection_interval_s: float | None = None
     collection_retry_after_s: int = 1
+    # --- ingest pipeline + admission control (YAML `ingest:` section;
+    # docs/INGEST.md tuning table) ---
+    ingest_decrypt_workers: int = 0  # 0 = one per host core
+    ingest_decode_workers: int = 1
+    # must stay below max_handler_threads (each in-flight upload parks
+    # a handler thread, so a larger bound can never fill)
+    ingest_queue_depth: int = 24
+    upload_bucket_rate: float = 0.0  # 0 = unlimited
+    upload_bucket_burst: int = 0
+    aggregate_bucket_rate: float = 0.0
+    aggregate_bucket_burst: int = 0
+    shed_priority: tuple = ("upload", "aggregate")
+    queue_high_watermark: float = 0.75
+    upload_shed_retry_after_s: float = 1.0
+    max_handler_threads: int = 32
 
     @classmethod
     def from_dict(cls, d: dict) -> "AggregatorConfig":
         gc = d.get("garbage_collection", {}) or {}
         api = d.get("aggregator_api", {}) or {}
+        ingest = d.get("ingest", {}) or {}
         return cls(
             common=CommonConfig.from_dict(d),
             listen_address=str(d.get("listen_address", "0.0.0.0:8080")),
@@ -132,6 +148,17 @@ class AggregatorConfig:
             taskprov=TaskprovConfig.from_dict(d.get("taskprov_config")),
             garbage_collection_interval_s=gc.get("gc_frequency_s"),
             collection_retry_after_s=int(d.get("collection_retry_after_secs", 1)),
+            ingest_decrypt_workers=int(ingest.get("decrypt_workers", 0)),
+            ingest_decode_workers=int(ingest.get("decode_workers", 1)),
+            ingest_queue_depth=int(ingest.get("queue_depth", 24)),
+            upload_bucket_rate=float(ingest.get("upload_bucket_rate", 0.0)),
+            upload_bucket_burst=int(ingest.get("upload_bucket_burst", 0)),
+            aggregate_bucket_rate=float(ingest.get("aggregate_bucket_rate", 0.0)),
+            aggregate_bucket_burst=int(ingest.get("aggregate_bucket_burst", 0)),
+            shed_priority=tuple(ingest.get("shed_priority", ("upload", "aggregate"))),
+            queue_high_watermark=float(ingest.get("queue_high_watermark", 0.75)),
+            upload_shed_retry_after_s=float(ingest.get("shed_retry_after_secs", 1.0)),
+            max_handler_threads=int(ingest.get("max_handler_threads", 32)),
         )
 
     def protocol_config(self) -> AggregatorProtocolConfig:
@@ -141,6 +168,17 @@ class AggregatorConfig:
             batch_aggregation_shard_count=self.batch_aggregation_shard_count,
             taskprov_enabled=self.taskprov.enabled,
             collection_retry_after_s=self.collection_retry_after_s,
+            ingest_decrypt_workers=self.ingest_decrypt_workers,
+            ingest_decode_workers=self.ingest_decode_workers,
+            ingest_queue_depth=self.ingest_queue_depth,
+            upload_bucket_rate=self.upload_bucket_rate,
+            upload_bucket_burst=self.upload_bucket_burst,
+            aggregate_bucket_rate=self.aggregate_bucket_rate,
+            aggregate_bucket_burst=self.aggregate_bucket_burst,
+            shed_priority=self.shed_priority,
+            queue_high_watermark=self.queue_high_watermark,
+            upload_shed_retry_after_s=self.upload_shed_retry_after_s,
+            max_handler_threads=self.max_handler_threads,
         )
 
 

@@ -97,7 +97,18 @@ except ImportError:
     BACKEND = "libcrypto"
 
     _name = ctypes.util.find_library("crypto")
-    _lib = ctypes.CDLL(_name or "libcrypto.so")
+    # PyDLL, not CDLL: these EVP/EC calls are microsecond-scale and
+    # never call back into Python, but a CDLL handle releases and
+    # reacquires the GIL around EVERY call — and one hpke_open makes
+    # dozens of them. Under a threaded server (the ingest decrypt
+    # pool + handler pool) that per-call release triggers the new-GIL
+    # convoy effect: each reacquire can wait a full switch interval
+    # behind the other runnable threads. Measured on a 2-core host:
+    # 8-thread hpke_open ran 7x SLOWER than single-threaded through
+    # CDLL; through PyDLL threaded matches serial. The bulk work the
+    # decrypt pool actually parallelizes (numpy share validation)
+    # releases the GIL on its own.
+    _lib = ctypes.PyDLL(_name or "libcrypto.so")
 
     _vp = ctypes.c_void_p
     _int = ctypes.c_int

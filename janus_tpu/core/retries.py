@@ -46,14 +46,48 @@ def is_retryable_status(status: int) -> bool:
     return status in RETRYABLE_STATUS
 
 
+def parse_retry_after(value) -> float | None:
+    """Seconds to wait per an HTTP Retry-After header value (delta
+    seconds or HTTP-date), or None if absent/unparseable."""
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+
+        dt = parsedate_to_datetime(str(value))
+        return max(0.0, dt.timestamp() - time.time())
+    except Exception:
+        return None
+
+
+def _retry_after_from(headers) -> float | None:
+    if not headers:
+        return None
+    lowered = {str(k).lower(): v for k, v in headers.items()}
+    return parse_retry_after(lowered.get("retry-after"))
+
+
 def retry_http_request(
     do_request, backoff: Backoff = Backoff(), sleep=time.sleep, deadline: float | None = None
 ):
     """Call do_request() until success or budget exhausted.
 
-    do_request returns (status:int, body) or raises OSError-likes for
-    transport failures. Returns the last (status, body); raises the
-    last transport error if every attempt failed by exception.
+    do_request returns (status:int, body) — or (status, body, headers)
+    to let a server-sent Retry-After steer the backoff — or raises
+    OSError-likes for transport failures. Returns the last
+    (status, body); raises the last transport error if every attempt
+    failed by exception.
+
+    On a retryable status carrying a Retry-After header (the admission
+    controller's 429s, a peer's 503), the next sleep honors the
+    server's delay instead of the exponential interval, clamped to
+    `backoff.max_interval` — a well-behaved client backs off when told
+    to, but a hostile/huge value cannot park a lease-bounded worker —
+    and still bounded by the deadline below.
 
     deadline: optional time.monotonic() value after which no further
     attempt or backoff sleep is started (the lease-bounded job step,
@@ -74,15 +108,32 @@ def retry_http_request(
             raise DeadlineExceeded(
                 "request deadline (lease bound) exceeded", last_status=status
             )
+        retry_after = None
         try:
-            status, body = do_request()
+            result = do_request()
+            status, body = result[0], result[1]
             if not is_retryable_status(status):
                 return status, body
+            if len(result) > 2:
+                retry_after = _retry_after_from(result[2])
             last_exc = None
         except (OSError, ConnectionError) as e:
             last_exc = e
-        budget_spent = elapsed + interval > backoff.max_elapsed
-        deadline_near = deadline is not None and time.monotonic() + interval >= deadline
+        if retry_after is not None:
+            # honor the server's schedule (clamped); no jitter — the
+            # server already paced us, and the admission bucket's
+            # refill estimate is the actual earliest useful retry.
+            # Floor at the backoff's initial interval: a hostile/buggy
+            # "Retry-After: 0" (or an HTTP-date in the past) must not
+            # collapse this loop into a zero-sleep spin that never
+            # spends the max_elapsed budget.
+            next_delay = min(max(retry_after, backoff.initial), backoff.max_interval)
+        else:
+            next_delay = interval
+        budget_spent = elapsed + next_delay > backoff.max_elapsed
+        deadline_near = (
+            deadline is not None and time.monotonic() + next_delay >= deadline
+        )
         if budget_spent or deadline_near:
             if last_exc is not None:
                 raise last_exc
@@ -93,7 +144,8 @@ def retry_http_request(
             raise DeadlineExceeded(
                 "request deadline (lease bound) exceeded", last_status=status
             )
-        delay = interval * (1 + random.uniform(-backoff.jitter, backoff.jitter))
-        sleep(delay)
-        elapsed += delay
+        if retry_after is None:
+            next_delay = interval * (1 + random.uniform(-backoff.jitter, backoff.jitter))
+        sleep(next_delay)
+        elapsed += next_delay
         interval = min(interval * backoff.multiplier, backoff.max_interval)

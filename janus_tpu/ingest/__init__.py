@@ -1,0 +1,20 @@
+"""Admission-controlled, bounded upload ingest (docs/INGEST.md).
+
+The serving front door for client report uploads: an
+AdmissionController (token buckets + queue-depth watermarks, shedding
+with 429 + Retry-After in configured priority order) in front of an
+IngestPipeline (decode → parallel HPKE-decrypt pool → validation →
+group commit through the ReportWriteBatcher)."""
+
+from .admission import AdmissionConfig, AdmissionController, ShedError, TokenBucket
+from .pipeline import IngestPipeline, UploadTicket, default_decrypt_workers
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "IngestPipeline",
+    "ShedError",
+    "TokenBucket",
+    "UploadTicket",
+    "default_decrypt_workers",
+]

@@ -1,0 +1,138 @@
+"""Admission control for the DAP front door.
+
+The upload route is the scale-out dimension of a DAP deployment (the
+original Prio paper frames client report submission as the dimension
+that grows with the user base), and the serving cost of a report is
+paid server-side (TAPAS: two-server aggregation lives or dies on
+per-report server cost under asymmetric load). An aggregator above
+capacity must answer a cheap, honest `429 + Retry-After` — not grow
+threads without bound and thrash the GIL on HPKE opens.
+
+Two admission signals, evaluated per request before any crypto work:
+
+* **Token buckets** per route class (`upload`, `aggregate`): a
+  configured sustained rate plus burst. Rate 0 disables the bucket
+  (unlimited).
+* **Queue-depth watermarks** derived from the ingest pipeline's
+  bounded stage queues: when pipeline occupancy crosses a class's
+  watermark, that class sheds. Watermarks are spaced by the configured
+  shed priority order — the first class (client uploads by default)
+  sheds at `queue_high_watermark`, later classes (the
+  aggregator-to-aggregator steps that finish work already admitted)
+  shed only as the queue approaches full.
+
+Shedding raises `ShedError`, which the HTTP layer maps to a 429
+problem document with a `Retry-After` header and counts in
+`janus_upload_shed_total`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+class ShedError(Exception):
+    """Request refused by admission control (HTTP 429)."""
+
+    def __init__(self, route_class: str, reason: str, retry_after_s: float):
+        super().__init__(
+            f"{route_class} shed ({reason}); retry after {retry_after_s:.1f}s"
+        )
+        self.route_class = route_class
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class TokenBucket:
+    """Classic token bucket: `burst` capacity, `rate` tokens/sec refill.
+
+    `try_acquire` returns 0.0 when a token was taken, else the seconds
+    until one refills (the Retry-After hint)."""
+
+    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+        if rate <= 0:
+            raise ValueError("token bucket rate must be positive")
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._tokens = self.burst
+        self._t = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self) -> float:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            return (1.0 - self._tokens) / self.rate
+
+
+@dataclass
+class AdmissionConfig:
+    """Knobs (mirrored by the aggregator Config / YAML; docs/INGEST.md)."""
+
+    # requests/sec sustained + burst per route class; rate 0 = unlimited
+    upload_bucket_rate: float = 0.0
+    upload_bucket_burst: int = 0
+    aggregate_bucket_rate: float = 0.0
+    aggregate_bucket_burst: int = 0
+    # first entry sheds first as pipeline occupancy rises
+    shed_priority: tuple[str, ...] = ("upload", "aggregate")
+    # occupancy fraction at which the first priority class sheds
+    queue_high_watermark: float = 0.75
+    # Retry-After for queue-pressure sheds (bucket sheds compute the
+    # exact refill time instead)
+    shed_retry_after_s: float = 1.0
+
+
+class AdmissionController:
+    """Evaluates both admission signals for one route class.
+
+    `depth_fn() -> (in_flight, bound)` reports the ingest pipeline's
+    occupancy; the controller derives per-class watermarks from the
+    configured shed priority."""
+
+    def __init__(self, cfg: AdmissionConfig, depth_fn=None):
+        self.cfg = cfg
+        self._depth_fn = depth_fn
+        self._buckets: dict[str, TokenBucket] = {}
+        if cfg.upload_bucket_rate > 0:
+            self._buckets["upload"] = TokenBucket(
+                cfg.upload_bucket_rate, cfg.upload_bucket_burst or cfg.upload_bucket_rate
+            )
+        if cfg.aggregate_bucket_rate > 0:
+            self._buckets["aggregate"] = TokenBucket(
+                cfg.aggregate_bucket_rate,
+                cfg.aggregate_bucket_burst or cfg.aggregate_bucket_rate,
+            )
+        # watermarks spaced across [high_watermark, 1.0) in shed order:
+        # with the default priority and high=0.75, uploads shed at 75%
+        # occupancy and aggregate steps at 87.5%
+        n = max(1, len(cfg.shed_priority))
+        hw = min(max(cfg.queue_high_watermark, 0.0), 1.0)
+        self._watermarks = {
+            cls: hw + (1.0 - hw) * i / n for i, cls in enumerate(cfg.shed_priority)
+        }
+
+    def watermark(self, route_class: str) -> float | None:
+        return self._watermarks.get(route_class)
+
+    def admit(self, route_class: str) -> None:
+        """Raise ShedError if this request must be refused."""
+        wm = self._watermarks.get(route_class)
+        if wm is not None and self._depth_fn is not None:
+            depth, bound = self._depth_fn()
+            if bound > 0 and depth >= wm * bound:
+                raise ShedError(route_class, "queue", self.cfg.shed_retry_after_s)
+        bucket = self._buckets.get(route_class)
+        if bucket is not None:
+            wait = bucket.try_acquire()
+            if wait > 0:
+                # never advertise a zero-second retry: a refill window
+                # shorter than the clock tick still needs a 1s nudge
+                raise ShedError(route_class, "rate", max(wait, 1.0))

@@ -1,0 +1,279 @@
+"""Staged, bounded upload ingest pipeline.
+
+Replaces the per-handler-thread upload path (decode + HPKE open +
+validate + write, all on the request thread) with fixed-size stages
+connected by bounded queues:
+
+    handler thread ──submit──▶ [decode q] ─▶ decode worker(s)
+        (parse Report, cheap time/keypair checks)
+                              ─▶ [decrypt q] ─▶ decrypt pool (≈ host cores)
+        (HPKE open + columnar share validation — the CPU-heavy stage.
+         What actually runs in parallel is the numpy share validation,
+         which releases the GIL; the HPKE open itself holds the GIL on
+         the ctypes-libcrypto fallback — deliberately, see the PyDLL
+         note in core/hpke_backend.py — and releases it only with the
+         `cryptography` wheel installed)
+                              ─▶ ReportWriteBatcher group commit
+        (one datastore transaction per accumulated batch; the batch's
+         flush resolves every ticket it carried)
+
+The handler thread parks on an `UploadTicket` until its report's batch
+commits, so HTTP semantics are unchanged (201 after durable write,
+replays still 201). What changes is capacity behavior: in-flight
+uploads are bounded by `queue_depth`; when the bound is hit `submit`
+raises ShedError (429 + Retry-After at the HTTP layer) instead of
+growing threads; and decryption throughput scales with the worker pool
+rather than with the (unbounded) number of connections.
+
+Stage occupancy is exported as `janus_ingest_queue_depth{stage=…}` /
+`janus_ingest_inflight` gauges, per-report stage latency as
+`janus_ingest_stage_duration_seconds{stage=…}`, and each stage runs in
+an `ingest.decode` / `ingest.decrypt` span parented under the
+originating request's `dap.upload` span (trace context rides the
+ticket across threads).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+
+from .. import metrics, trace
+from ..messages import Report
+from .admission import ShedError
+
+log = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+def default_decrypt_workers() -> int:
+    """One per host core, floor 2 (the decrypt stage is the CPU-heavy
+    one; cores beyond the queue bound buy nothing)."""
+    return max(2, os.cpu_count() or 2)
+
+
+class UploadTicket:
+    """One admitted upload's journey through the pipeline."""
+
+    __slots__ = (
+        "ta",
+        "clock",
+        "body",
+        "report",
+        "keypair",
+        "trace_ctx",
+        "event",
+        "fresh",
+        "error",
+        "t_submit",
+    )
+
+    def __init__(self, ta, clock, body: bytes):
+        self.ta = ta
+        self.clock = clock
+        self.body = body
+        self.report = None
+        self.keypair = None
+        self.trace_ctx = trace.current_context()
+        self.event = threading.Event()
+        self.fresh: bool | None = None
+        self.error: BaseException | None = None
+        self.t_submit = time.monotonic()
+
+    def result(self, timeout_s: float = 30.0) -> bool:
+        """Block until committed; returns False on replay, raises the
+        stage error otherwise (the handler maps it to a problem doc)."""
+        if not self.event.wait(timeout_s):
+            raise TimeoutError("upload did not commit in time")
+        if self.error is not None:
+            raise self.error
+        assert self.fresh is not None
+        return self.fresh
+
+
+class IngestPipeline:
+    """Bounded staged ingest; see module docstring.
+
+    `writer` is the aggregator's ReportWriteBatcher (group commit).
+    Threads start lazily on first submit and are daemons; `close()`
+    drains them for orderly shutdown."""
+
+    def __init__(
+        self,
+        writer,
+        decrypt_workers: int = 0,
+        decode_workers: int = 1,
+        # default matches aggregator Config.ingest_queue_depth; must
+        # stay below the HTTP handler-pool bound to be reachable
+        queue_depth: int = 24,
+    ):
+        self.writer = writer
+        self.decrypt_workers = decrypt_workers or default_decrypt_workers()
+        self.decode_workers = max(1, decode_workers)
+        self.queue_depth = max(1, queue_depth)
+        # queues sized to the in-flight bound so intra-pipeline puts
+        # never block; the bound itself is enforced on _inflight
+        self._decode_q: queue.Queue = queue.Queue(maxsize=self.queue_depth)
+        self._decrypt_q: queue.Queue = queue.Queue(maxsize=self.queue_depth)
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._started = False
+        self._stop = False
+
+    # ------------------------------------------------------------------
+    # occupancy (the admission controller's queue-depth signal)
+    # ------------------------------------------------------------------
+    def depth(self) -> tuple[int, int]:
+        """(uploads in flight, configured bound)."""
+        return self._inflight, self.queue_depth
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+    def submit(self, ta, clock, body: bytes) -> UploadTicket:
+        """Admit one raw upload body. Raises ShedError when the
+        in-flight bound is hit (the queue-full backstop behind the
+        admission controller's watermark)."""
+        ticket = UploadTicket(ta, clock, body)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("ingest pipeline is closed")
+            if self._inflight >= self.queue_depth:
+                raise ShedError("upload", "queue_full", 1.0)
+            self._inflight += 1
+            metrics.ingest_inflight.set(self._inflight)
+            if not self._started:
+                self._start_locked()
+            # enqueue under the lock (never blocks: queue capacity ==
+            # the in-flight bound) so close() — which flips _stop under
+            # this lock before inserting its stop sentinels — can't
+            # interleave here and strand a ticket behind a sentinel
+            self._decode_q.put(ticket)
+        metrics.ingest_queue_depth.set(self._decode_q.qsize(), stage="decode")
+        return ticket
+
+    def _start_locked(self) -> None:
+        for i in range(self.decode_workers):
+            t = threading.Thread(
+                target=self._decode_loop, name=f"ingest-decode-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        for i in range(self.decrypt_workers):
+            t = threading.Thread(
+                target=self._decrypt_loop, name=f"ingest-decrypt-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        self._started = True
+
+    # ------------------------------------------------------------------
+    # stages
+    # ------------------------------------------------------------------
+    def _resolve(self, ticket: UploadTicket, fresh=None, error=None) -> None:
+        ticket.fresh = fresh
+        ticket.error = error
+        with self._lock:
+            self._inflight -= 1
+            metrics.ingest_inflight.set(self._inflight)
+        ticket.event.set()
+
+    def _decode_loop(self) -> None:
+        while True:
+            ticket = self._decode_q.get()
+            if ticket is _STOP:
+                return
+            metrics.ingest_queue_depth.set(self._decode_q.qsize(), stage="decode")
+            t0 = time.monotonic()
+            try:
+                with trace.use_context(ticket.trace_ctx), trace.span(
+                    "ingest.decode"
+                ):
+                    ticket.report = Report.from_bytes(ticket.body)
+                    ticket.body = b""  # decoded; free the raw copy
+                    ticket.keypair = ticket.ta.upload_prepare(
+                        ticket.clock, ticket.report
+                    )
+            except BaseException as e:
+                self._resolve(ticket, error=e)
+                continue
+            finally:
+                metrics.ingest_stage_duration.observe(
+                    time.monotonic() - t0, stage="decode"
+                )
+            self._decrypt_q.put(ticket)
+            metrics.ingest_queue_depth.set(self._decrypt_q.qsize(), stage="decrypt")
+
+    def _decrypt_loop(self) -> None:
+        while True:
+            ticket = self._decrypt_q.get()
+            if ticket is _STOP:
+                return
+            metrics.ingest_queue_depth.set(self._decrypt_q.qsize(), stage="decrypt")
+            t0 = time.monotonic()
+            try:
+                with trace.use_context(ticket.trace_ctx), trace.span(
+                    "ingest.decrypt"
+                ):
+                    stored = ticket.ta.upload_decrypt_validate(
+                        ticket.report, ticket.keypair
+                    )
+            except BaseException as e:
+                self._resolve(ticket, error=e)
+                continue
+            finally:
+                metrics.ingest_stage_duration.observe(
+                    time.monotonic() - t0, stage="decrypt"
+                )
+            t_commit = time.monotonic()
+
+            def on_done(pending, ticket=ticket, t_commit=t_commit):
+                # flusher thread: the group commit carrying this report
+                # finished (fresh/replay) or failed
+                metrics.ingest_stage_duration.observe(
+                    time.monotonic() - t_commit, stage="commit"
+                )
+                if pending.error is not None:
+                    self._resolve(ticket, error=pending.error)
+                else:
+                    self._resolve(ticket, fresh=pending.fresh)
+
+            try:
+                self.writer.submit_report(stored, on_done=on_done)
+            except BaseException as e:
+                self._resolve(ticket, error=e)
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            started = self._started
+        if not started:
+            return
+        for _ in range(self.decode_workers):
+            self._decode_q.put(_STOP)
+        for _ in range(self.decrypt_workers):
+            self._decrypt_q.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=5)
+        # fail any ticket a worker handed forward after its peers took
+        # the stop sentinels (decode can enqueue behind a decrypt
+        # sentinel): nothing will consume it, and its handler thread
+        # must get an immediate error, not a 30s result() timeout
+        for q in (self._decode_q, self._decrypt_q):
+            while True:
+                try:
+                    t = q.get_nowait()
+                except queue.Empty:
+                    break
+                if t is not _STOP:
+                    self._resolve(
+                        t, error=RuntimeError("ingest pipeline is closed")
+                    )

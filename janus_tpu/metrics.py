@@ -37,8 +37,46 @@ class Counter:
     def get(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0)
 
+    def total(self) -> float:
+        """Sum across all label sets (shed accounting in bench/tests)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        for labels, v in items:
+            lines.append(f"{self.name}{_fmt_labels(labels)} {v}")
+        return "\n".join(lines)
+
+
+class Gauge:
+    """Instantaneous value (queue depths, in-flight counts)."""
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple[tuple[str, str], ...], float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def set(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = v
+
+    def add(self, n: float = 1, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += n
+
+    def get(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
             items = sorted(self._values.items())
         if not items:
@@ -108,6 +146,15 @@ class MetricsRegistry:
             assert isinstance(m, Counter)
             return m
 
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name, help_)
+                self._metrics[name] = m
+            assert isinstance(m, Gauge)
+            return m
+
     def histogram(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
         with self._lock:
             m = self._metrics.get(name)
@@ -158,4 +205,19 @@ http_request_duration = REGISTRY.histogram(
 )
 tx_duration = REGISTRY.histogram(
     "janus_database_transaction_duration_seconds", "datastore transaction latency"
+)
+# --- ingest pipeline (janus_tpu.ingest; docs/INGEST.md) ---
+upload_shed_counter = REGISTRY.counter(
+    "janus_upload_shed_total",
+    "requests rejected 429 by the admission controller, by route and reason",
+)
+ingest_queue_depth = REGISTRY.gauge(
+    "janus_ingest_queue_depth", "ingest pipeline stage queue depths, by stage"
+)
+ingest_inflight = REGISTRY.gauge(
+    "janus_ingest_inflight", "uploads admitted and not yet committed/failed"
+)
+ingest_stage_duration = REGISTRY.histogram(
+    "janus_ingest_stage_duration_seconds",
+    "per-report ingest stage latency (decode, decrypt, commit), by stage",
 )

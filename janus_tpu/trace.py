@@ -211,6 +211,15 @@ class OtlpExporter:
                             },
                         }
                     )
+            elif isinstance(metric, m.Gauge):
+                with metric._lock:
+                    items = sorted(metric._values.items())
+                points = [
+                    {"attributes": attrs(k), "timeUnixNano": now, "asDouble": v}
+                    for k, v in items
+                ]
+                if points:
+                    out.append({"name": metric.name, "gauge": {"dataPoints": points}})
             elif isinstance(metric, m.Histogram):
                 points = []
                 with metric._lock:
@@ -353,6 +362,24 @@ def adopt_traceparent(header: str | None):
 
 def reset_traceparent(token) -> None:
     _trace_ctx.reset(token)
+
+
+def current_context():
+    """Opaque trace context of the calling thread (for handing work to
+    another thread — e.g. the ingest pipeline's stage workers — so their
+    spans parent under the originating request's span)."""
+    return _trace_ctx.get()
+
+
+@contextmanager
+def use_context(ctx):
+    """Run the body under a trace context captured with
+    current_context() on a different thread."""
+    token = _trace_ctx.set(ctx)
+    try:
+        yield
+    finally:
+        _trace_ctx.reset(token)
 
 
 @contextmanager

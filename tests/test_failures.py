@@ -129,6 +129,79 @@ def test_batcher_fans_out_errors(ds):
         batcher.write_report(make_report(task))
 
 
+class _FlakyDs:
+    """Fails the first `fail_n` transactions, then delegates."""
+
+    def __init__(self, ds, fail_n=1):
+        self._ds = ds
+        self._fail_n = fail_n
+        self._lock = threading.Lock()
+
+    def run_tx(self, fn, name="tx"):
+        with self._lock:
+            if self._fail_n > 0:
+                self._fail_n -= 1
+                raise RuntimeError("datastore down")
+        return self._ds.run_tx(fn, name)
+
+
+def test_batcher_flush_error_reaches_every_waiter_then_recovers(ds):
+    """One flusher-transaction failure must fan out to EVERY _Pending
+    in the batch — an error, not a hang and not a false "fresh" — and
+    the next flush (healthy datastore again) must commit normally."""
+    task = put_task(ds, VdafInstance.count())
+    flaky = _FlakyDs(ds, fail_n=1)
+    batcher = ReportWriteBatcher(flaky, max_batch_size=3, max_write_delay_ms=60_000)
+    outcomes = [None, None, None]
+
+    def write(i):
+        try:
+            outcomes[i] = batcher.write_report(make_report(task), timeout_s=10)
+        except BaseException as e:
+            outcomes[i] = e
+
+    threads = [threading.Thread(target=write, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert all(
+        isinstance(o, RuntimeError) and "datastore down" in str(o) for o in outcomes
+    ), outcomes
+    # nothing landed from the failed transaction
+    total, _ = ds.run_tx(lambda tx: tx.count_client_reports_for_task(task.task_id))
+    assert total == 0
+    # and the batcher recovers: the next full batch commits (3 writers
+    # again so the 60s coalescing window is not what we're timing)
+    outcomes[:] = [None, None, None]
+    threads = [threading.Thread(target=write, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert outcomes == [True, True, True], outcomes
+    total, _ = ds.run_tx(lambda tx: tx.count_client_reports_for_task(task.task_id))
+    assert total == 3
+
+
+def test_batcher_submit_report_callback_resolution(ds):
+    """The non-blocking submit path (the ingest pipeline's commit
+    stage): on_done runs after the group commit with the outcome."""
+    task = put_task(ds, VdafInstance.count())
+    batcher = ReportWriteBatcher(ds, max_batch_size=100, max_write_delay_ms=60_000)
+    done = []
+    report = make_report(task)
+    p1 = batcher.submit_report(report, on_done=lambda p: done.append(("a", p.fresh, p.error)))
+    p2 = batcher.submit_report(make_report(task), on_done=lambda p: done.append(("b", p.fresh, p.error)))
+    batcher.flush_now()
+    assert p1.event.is_set() and p2.event.is_set()
+    assert done == [("a", True, None), ("b", True, None)]
+    # a replayed id resolves through the callback as fresh=False
+    p3 = batcher.submit_report(report, on_done=lambda p: done.append(("c", p.fresh, p.error)))
+    batcher.flush_now()
+    assert p3.fresh is False and done[-1] == ("c", False, None)
+
+
 # --- fake VDAF failure injection, end to end ---
 
 

@@ -91,10 +91,13 @@ def test_collect_cli_arg_validation():
 
 def test_bench_dry_run_smoke():
     """CI smoke of `bench.py --dry-run` (no accelerator): the HBM
-    feasibility report must be well-formed and the EngineCache
-    OOM-retry / host-fallback machinery must survive an injected
-    RESOURCE_EXHAUSTED — so the serving failure path added in r6 is
-    exercised on every CPU test run, not just on chip."""
+    feasibility report must be well-formed, the EngineCache OOM-retry /
+    host-fallback machinery must survive an injected
+    RESOURCE_EXHAUSTED, and the admission-controlled ingest pipeline
+    must shed a real over-capacity upload burst with 429 + Retry-After
+    while committing admitted reports exactly once — so both serving
+    failure paths are exercised on every CPU test run, not just on
+    chip."""
     import json
     import os
     import pathlib
@@ -119,6 +122,12 @@ def test_bench_dry_run_smoke():
     smoke = rec["oom_fallback_smoke"]
     assert smoke["halved_retry_ok"] is True
     assert smoke["host_fallback_ok"] is True
+    ingest = rec["ingest_smoke"]
+    assert ingest["accepted"] == 3  # the configured bucket burst
+    assert ingest["shed"] == 5  # everything above it: 429
+    assert ingest["shed_counter_delta"] == ingest["shed"]  # all accounted
+    assert ingest["retry_after_present"] is True
+    assert ingest["committed_exactly_once"] is True
 
 
 def test_collect_cli_end_to_end(capsys):
