@@ -23,6 +23,20 @@ from ..vdaf.registry import circuit_for
 from ..datastore.models import BatchAggregation, BatchAggregationState
 
 
+def count_reports_aggregated(task_id: TaskId, n: int) -> None:
+    """Increment the per-task aggregated-reports counter (the
+    accumulate-time throughput signal; health_sampler.py exports the
+    sampled gauges). Callers must invoke this OUTSIDE any run_tx
+    closure — a retried transaction would double the count."""
+    if n <= 0:
+        return
+    from .. import metrics
+
+    metrics.task_reports_aggregated_total.add(
+        n, task_id=metrics.task_id_label(task_id.data)
+    )
+
+
 def add_encoded_aggregate_shares(field, a: bytes | None, b: bytes | None) -> bytes | None:
     """Element-wise mod-p sum of two encoded field vectors."""
     if a is None:
@@ -104,16 +118,28 @@ class Accumulator:
         shard_count: int = 1,
         field=None,
         aggregation_parameter: bytes = b"",
+        count_metrics: bool = True,
     ):
         """field/aggregation_parameter: parameterized VDAFs (Poplar1)
         accumulate in a per-parameter field and key their batch rows by
-        the parameter; Prio3 uses the circuit field and parameter b""."""
+        the parameter; Prio3 uses the circuit field and parameter b"".
+
+        count_metrics: update() increments the per-task aggregated-
+        reports counter. Pass False when the Accumulator lives INSIDE a
+        run_tx closure (the helper continue path) — a retried
+        transaction re-creates it and would double the count; such
+        callers count after commit via count_reports_aggregated."""
         self.task = task
         self.field = field if field is not None else circuit_for(task.vdaf).FIELD
         self.agg_param = aggregation_parameter
         self.shard_count = shard_count
+        self._count_metrics = count_metrics
         # batch_identifier bytes -> [share bytes | None, count, checksum, interval | None]
         self._state: dict[bytes, list] = {}
+
+    def total_report_count(self) -> int:
+        """Reports merged into this accumulator so far."""
+        return sum(ent[1] for ent in self._state.values())
 
     def update(
         self,
@@ -125,6 +151,13 @@ class Accumulator:
         report_ids: list | None = None,
     ) -> None:
         """Merge one already-reduced contribution (device output)."""
+        if self._count_metrics:
+            # counted at accumulate time, not sampled. The batched
+            # paths and the leader driver build their Accumulator (and
+            # call update) OUTSIDE the writing transaction, so run_tx
+            # retries can't double this; in-transaction accumulators
+            # pass count_metrics=False and count after commit.
+            count_reports_aggregated(self.task.task_id, report_count)
         ent = self._state.get(batch_identifier)
         if ent is None:
             self._state[batch_identifier] = [

@@ -714,7 +714,11 @@ class TaskAggregator:
         # transaction: concurrent identical continues (leader timeout +
         # re-POST on a threaded server) must serialize so exactly one
         # processes and the other sees the bumped step and replays;
-        # split reads would double-accumulate.
+        # split reads would double-accumulate. `counted` carries the
+        # merged-report count out of the LAST (committing) attempt for
+        # the post-commit metrics increment.
+        counted: dict = {}
+
         def process(tx):
             job = tx.get_aggregation_job(task.task_id, job_id)
             if job is None:
@@ -779,11 +783,16 @@ class TaskAggregator:
                 msg_len = 16 if self.wire.uses_jr else 0
                 skip_len = msg_len
                 field = None
+            # count_metrics=False: this accumulator lives inside the
+            # run_tx closure — a serialization retry re-creates it and
+            # would double the per-task counter; counted after commit
+            # below via the `counted` cell
             accumulator = Accumulator(
                 task,
                 self.cfg.batch_aggregation_shard_count,
                 field=field,
                 aggregation_parameter=job.aggregation_parameter,
+                count_metrics=False,
             )
             pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
             fixed_bid = fixed_size_batch_id(pbs)
@@ -829,6 +838,7 @@ class TaskAggregator:
                     )
 
             unmerged = accumulator.flush_to_datastore(tx)
+            counted["n"] = accumulator.total_report_count() - len(unmerged)
             tx.update_aggregation_job(
                 dataclasses.replace(
                     job,
@@ -859,7 +869,11 @@ class TaskAggregator:
                 ]
             return AggregationJobResp(tuple(resps))
 
-        return ds.run_tx(process, "aggregate_continue")
+        resp = ds.run_tx(process, "aggregate_continue")
+        from .accumulator import count_reports_aggregated
+
+        count_reports_aggregated(task.task_id, counted.get("n", 0))
+        return resp
 
     def _rebuild_continue_resps(self, tx, job_id, req) -> AggregationJobResp:
         """Replay response scoped to exactly the reports the continue

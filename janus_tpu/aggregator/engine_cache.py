@@ -26,7 +26,7 @@ import logging
 import os
 import threading
 import time
-from functools import lru_cache
+from collections import OrderedDict, deque
 
 import jax
 import jax.numpy as jnp
@@ -455,6 +455,54 @@ class EngineCache:
         self._initial_round_rows = round_rows
         self._co_leader = _Coalescer(self._run_leader_round, round_rows)
         self._co_helper = _Coalescer(self._run_helper_round, round_rows)
+        # observability (docs/OBSERVABILITY.md "Engine metrics"): first
+        # dispatch per (op, bucket) is the compile; OOM events feed the
+        # /statusz engine-cache section
+        self._dispatched_buckets: set[tuple[str, int]] = set()
+        self._dispatch_track_lock = threading.Lock()
+        self.oom_history: deque = deque(maxlen=16)
+        self._publish_state()
+
+    def _backend_state(self) -> str:
+        if self._host_fallback is None:
+            return "device"
+        return "host_fallback" if self._host_fallback_until is None else "timed_fallback"
+
+    def _publish_state(self) -> None:
+        """Refresh the janus_engine_backend / janus_engine_bucket_cap
+        gauges for this engine's VDAF kind (callers hold _oom_lock when
+        mutating fallback state; the gauges take their own locks).
+        All four states are managed — including "host", which only
+        _build_engine sets to 1 — so exactly one state is 1 per kind
+        and a draft-mode host engine followed by a fast-mode device
+        engine of the same kind can't leave both at 1. Same-kind
+        engines (different params) share the label and last-writer
+        wins; the gauge is per VDAF kind, not per task."""
+        from ..metrics import engine_backend_state, engine_bucket_cap
+
+        state = self._backend_state()
+        for s in ("device", "host_fallback", "timed_fallback", "host"):
+            engine_backend_state.set(1.0 if s == state else 0.0, vdaf=self.inst.kind, state=s)
+        engine_bucket_cap.set(float(self.bucket_cap or 0), vdaf=self.inst.kind)
+
+    def _record_dispatch(self, op: str, n: int, b: int, elapsed_s: float) -> None:
+        """Per-dispatch accounting: throughput counters, padding-waste
+        gauge, and the first-call-per-(op, bucket) compile histogram —
+        jax.jit compiles synchronously on the first call of a shape
+        bucket, so that call's wall time IS the cold-start cost
+        OBSERVABILITY.md used to describe only in prose."""
+        from .. import metrics
+
+        metrics.engine_dispatches_total.add(op=op)
+        metrics.engine_rows_total.add(n, op=op)
+        if b > 0:
+            metrics.engine_batch_fill_ratio.set(n / b, op=op)
+        with self._dispatch_track_lock:
+            first = (op, b) not in self._dispatched_buckets
+            if first:
+                self._dispatched_buckets.add((op, b))
+        if first:
+            metrics.engine_compile_seconds.observe(elapsed_s, op=op, bucket=str(b))
 
     # Per-call row cap for joining a shared round; absolute round row
     # cap; and the rows x input_len budget one coalesced round may
@@ -563,6 +611,15 @@ class EngineCache:
                 self._host_fallback_until = (
                     None if definite else time.monotonic() + self.HOST_FALLBACK_RETRY_SECS
                 )
+                self.oom_history.append(
+                    {
+                        "at": time.time(),
+                        "bucket": observed,
+                        "action": "host_fallback" if definite else "timed_fallback",
+                        "error": str(e)[:200],
+                    }
+                )
+                self._publish_state()
                 return
             new_cap = observed // 2
             self.bucket_cap = new_cap if self.bucket_cap is None else min(self.bucket_cap, new_cap)
@@ -575,6 +632,15 @@ class EngineCache:
             from ..metrics import engine_oom_retry_counter
 
             engine_oom_retry_counter.add()
+            self.oom_history.append(
+                {
+                    "at": time.time(),
+                    "bucket": observed,
+                    "action": f"halved_to_{self.bucket_cap}",
+                    "error": str(e)[:200],
+                }
+            )
+            self._publish_state()
 
     # Cool-down before a host fallback reached through an AMBIGUOUS
     # error (tunnel 500) re-probes the device path.
@@ -605,6 +671,7 @@ class EngineCache:
                 self.bucket_cap = self._initial_bucket_cap
                 self._co_leader._max_rows = self._initial_round_rows
                 self._co_helper._max_rows = self._initial_round_rows
+                self._publish_state()
             return self._host_fallback
 
     # --- helper side: init + combine + decide in one traced step ---
@@ -668,6 +735,10 @@ class EngineCache:
         if len(args_list) == 1:
             out1, mask, prep_msg = self._helper_init_inner(*args_list[0])
             return [(out1, mask, prep_msg)]
+        from .. import metrics
+
+        metrics.engine_coalesced_rounds_total.add()
+        metrics.engine_coalesced_rows_total.add(int(sum(ns)))
         merged = _concat_args(args_list)
         out1, mask, prep_msg = self._helper_init_inner(*merged, coalesced=len(ns))
         if isinstance(out1, DeviceRowsChunks):
@@ -739,11 +810,13 @@ class EngineCache:
                 bucket=b,
                 coalesced=coalesced,
             ):
-                with span("engine.helper_init.put"):
+                with span("engine.helper_init.put", vdaf=self.inst.kind):
                     args = put_args(args, block=True, shardings=shardings)
-                with span("engine.helper_init.dispatch"):
+                t_disp = time.monotonic()
+                with span("engine.helper_init.dispatch", vdaf=self.inst.kind):
                     out1, mask, prep_msg = fn(*args)
-                with span("engine.helper_init.fetch"):
+                self._record_dispatch("helper_init", n, b, time.monotonic() - t_disp)
+                with span("engine.helper_init.fetch", vdaf=self.inst.kind):
                     mask = np.asarray(mask)[:n]
                     prep_msg = np.asarray(prep_msg)[:n]
         except Exception as e:
@@ -785,6 +858,10 @@ class EngineCache:
         offsets = list(np.cumsum([0] + ns))
         if len(args_list) == 1:
             return [self._leader_init_inner(*args_list[0])]
+        from .. import metrics
+
+        metrics.engine_coalesced_rounds_total.add()
+        metrics.engine_coalesced_rows_total.add(int(sum(ns)))
         merged = _concat_args(args_list)
         # one padded dispatch for the whole round (no intra-call
         # pipelining: round-to-round overlap already covers H2D)
@@ -865,15 +942,17 @@ class EngineCache:
                 bucket=b,
                 coalesced=coalesced,
             ):
-                with span("engine.leader_init.put"):
+                with span("engine.leader_init.put", vdaf=self.inst.kind):
                     args = put_args(args, block=True, shardings=shardings)
-                with span("engine.leader_init.dispatch"):
+                t_disp = time.monotonic()
+                with span("engine.leader_init.dispatch", vdaf=self.inst.kind):
                     out0, seed0, ver0, part0 = fn(*args)
-                with span("engine.leader_init.fetch_seed"):
+                self._record_dispatch("leader_init", n, b, time.monotonic() - t_disp)
+                with span("engine.leader_init.fetch_seed", vdaf=self.inst.kind):
                     seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
-                with span("engine.leader_init.fetch_ver"):
+                with span("engine.leader_init.fetch_ver", vdaf=self.inst.kind):
                     ver0 = tuple(np.asarray(x)[:n] for x in ver0)
-                with span("engine.leader_init.fetch_part"):
+                with span("engine.leader_init.fetch_part", vdaf=self.inst.kind):
                     part0 = np.asarray(part0)[:n] if part0 is not None else None
         except Exception as e:
             _annotate_dispatch_bucket(e, b)
@@ -933,7 +1012,7 @@ class EngineCache:
         try:
             with span("engine.leader_init", vdaf=self.inst.kind, batch=n, pipelined=len(spans_)):
                 staged = []
-                with span("engine.leader_init.put_all_async"):
+                with span("engine.leader_init.put_all_async", vdaf=self.inst.kind):
                     for s, e in spans_:
                         args = pad_args(
                             bucket_size(e - s),
@@ -946,10 +1025,15 @@ class EngineCache:
                         staged.append(put_args(args, block=False))
                 outs = []
                 for k, ((s, e), args) in enumerate(zip(spans_, staged)):
-                    with span("engine.leader_init.chunk", k=k, rows=e - s):
+                    with span("engine.leader_init.chunk", k=k, rows=e - s, vdaf=self.inst.kind):
                         jax.block_until_ready(args)  # this chunk's H2D only
+                        t_disp = time.monotonic()
                         outs.append(fn(*args))
-                with span("engine.leader_init.fetch"):
+                        self._record_dispatch(
+                            "leader_init", e - s, bucket_size(e - s),
+                            time.monotonic() - t_disp,
+                        )
+                with span("engine.leader_init.fetch", vdaf=self.inst.kind):
                     out_chunks = [
                         DeviceRows(o[0], e - s) for (s, e), o in zip(spans_, outs)
                     ]
@@ -1087,12 +1171,25 @@ class EngineCache:
             b = bucket_size(n, cap)
             dispatch_b, dispatch_fixed = b, False
             dispatch = lambda: fn(*pad_args(b, out_shares, mask))  # noqa: E731
+        from ..trace import span
+
         try:
             # PJRT raises allocation failures synchronously from the
             # dispatch; other device errors realize async at the fetch.
             # Both need the bucket annotation, so both live in this try.
-            agg = dispatch()
-            return [int(x) for x in p3.jf.to_ints(agg)]
+            # to_ints forces the fetch, so the span bounds true device
+            # wall time, not async dispatch.
+            t_disp = time.monotonic()
+            with span(
+                "engine.aggregate.dispatch",
+                vdaf=self.inst.kind,
+                batch=n,
+                bucket=dispatch_b,
+            ):
+                agg = dispatch()
+                result = [int(x) for x in p3.jf.to_ints(agg)]
+            self._record_dispatch("aggregate", n, dispatch_b, time.monotonic() - t_disp)
+            return result
         except Exception as e:
             _annotate_dispatch_bucket(e, dispatch_b, fixed=dispatch_fixed)
             raise
@@ -1235,8 +1332,7 @@ class HostEngineCache:
         return agg
 
 
-@lru_cache(maxsize=256)
-def engine_cache(inst: VdafInstance, verify_key: bytes):
+def _build_engine(inst: VdafInstance, verify_key: bytes):
     if inst.xof_mode != "fast":
         # draft (VDAF-07) framing: device engine for every circuit
         # whose sponge streams fit vdaf.draft_jax MAX_STREAM_BLOCKS
@@ -1246,5 +1342,102 @@ def engine_cache(inst: VdafInstance, verify_key: bytes):
         try:
             prio3_batched(inst)
         except ValueError:
+            from ..metrics import engine_backend_state
+
+            for s in ("device", "host_fallback", "timed_fallback", "host"):
+                engine_backend_state.set(
+                    1.0 if s == "host" else 0.0, vdaf=inst.kind, state=s
+                )
             return HostEngineCache(inst, verify_key)
     return EngineCache(inst, verify_key)
+
+
+# LRU over live engines. Formerly a bare functools.lru_cache; the
+# hand-rolled variant exists so hit/miss/entry counts export as
+# metrics and /statusz can walk the live engines (bucket caps, backend
+# state, OOM history) — lru_cache hides its table.
+_ENGINE_CACHE_MAX = 256
+_engine_cache_lock = threading.Lock()
+_engine_cache: "OrderedDict[tuple, object]" = OrderedDict()
+
+
+def engine_cache(inst: VdafInstance, verify_key: bytes):
+    from .. import metrics
+
+    key = (inst, verify_key)
+    with _engine_cache_lock:
+        eng = _engine_cache.get(key)
+        if eng is not None:
+            _engine_cache.move_to_end(key)
+            metrics.engine_cache_hits.add()
+            return eng
+    metrics.engine_cache_misses.add()
+    # build outside the lock: construction touches jax (mesh setup) and
+    # must not serialize against lookups; a concurrent double-build
+    # resolves first-insert-wins below
+    eng = _build_engine(inst, verify_key)
+    with _engine_cache_lock:
+        cur = _engine_cache.get(key)
+        if cur is not None:
+            return cur
+        _engine_cache[key] = eng
+        while len(_engine_cache) > _ENGINE_CACHE_MAX:
+            _engine_cache.popitem(last=False)
+        metrics.engine_cache_entries.set(float(len(_engine_cache)))
+    return eng
+
+
+def _engine_cache_clear() -> None:
+    from .. import metrics
+
+    with _engine_cache_lock:
+        _engine_cache.clear()
+    metrics.engine_cache_entries.set(0.0)
+
+
+# lru_cache-compatible surface (tests/conftest.py calls cache_clear
+# between modules to drop compiled callables)
+engine_cache.cache_clear = _engine_cache_clear
+
+
+def engine_cache_status() -> dict:
+    """Live engine-cache snapshot for /statusz: per-engine bucket cap,
+    backend state, geometry, and recent OOM history."""
+    with _engine_cache_lock:
+        engines = list(_engine_cache.values())
+    out = []
+    for eng in engines:
+        if isinstance(eng, HostEngineCache):
+            out.append(
+                {
+                    "vdaf": eng.inst.kind,
+                    "xof_mode": eng.inst.xof_mode,
+                    "backend": "host",
+                }
+            )
+            continue
+        ent = {
+            "vdaf": eng.inst.kind,
+            "xof_mode": eng.inst.xof_mode,
+            "backend": eng._backend_state(),
+            "bucket_cap": eng.bucket_cap,
+            "initial_bucket_cap": eng._initial_bucket_cap,
+            "dp": eng.dp,
+            "sp": eng.sp,
+            "tile_elems": eng.tile_elems,
+            "coalesce_round_rows": eng._co_leader._max_rows,
+            "oom_history": list(eng.oom_history),
+        }
+        try:
+            from ..vdaf.engine import describe_engine_geometry
+
+            ent["geometry"] = describe_engine_geometry(eng.p3.bc)
+        except Exception:
+            pass
+        out.append(ent)
+    return {"entries": len(engines), "max_entries": _ENGINE_CACHE_MAX, "engines": out}
+
+
+from ..statusz import register_status_provider as _register_status_provider
+
+_register_status_provider("engine_cache", engine_cache_status)

@@ -1,0 +1,145 @@
+"""Periodic datastore health sampler: the serving-side SLO gauges.
+
+A production DAP deployment operates against aggregation lag — how far
+behind the oldest unaggregated report is, how deep the job backlog
+runs, how long leases stay outstanding (Prio-class systems alert on
+exactly these; the reference surfaces them via its aggregator-api task
+metrics and OTel instruments). This sampler runs cheap read-only
+datastore queries on a period (CommonConfig.health_sampler_interval_s)
+and exports:
+
+  janus_jobs{type,state}                          job backlog (gauge)
+  janus_job_lease_age_seconds                     max outstanding lease age
+  janus_oldest_unaggregated_report_age_seconds{task_id}
+  janus_batches_pending_collection                collection jobs pending
+
+plus a /statusz section with the latest snapshot. The companion
+counter janus_task_reports_aggregated_total is NOT sampled — the
+accumulator increments it at accumulate time (accumulator.py).
+
+Lease age caveat: the schema stores only lease_expiry, not the acquire
+time, so age is measured from when THIS sampler first observed the
+lease — a lower bound on the true age (exact once the lease has been
+visible for one sampling period).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..metrics import task_id_label as _b64_task_id
+
+log = logging.getLogger(__name__)
+
+
+class HealthSampler:
+    """Thread-per-process sampler over one datastore. `run_once()` is
+    the unit of work (tests and the bench smoke call it directly);
+    `start()` spawns the periodic daemon thread."""
+
+    def __init__(self, ds, interval_s: float = 15.0):
+        self.ds = ds
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # (type, task_id, job_id) -> clock seconds at first observation
+        self._lease_first_seen: dict[tuple, int] = {}
+        # task_id labels we exported last pass (stale ones reset to 0)
+        self._lag_tasks: set[str] = set()
+        self.last_snapshot: dict = {}
+        from ..statusz import register_status_provider
+
+        register_status_provider("job_health", lambda: self.last_snapshot)
+
+    # ------------------------------------------------------------------
+    def run_once(self) -> dict:
+        from .. import metrics
+        from ..datastore.models import AggregationJobState, CollectionJobState
+
+        now = self.ds.clock.now().seconds
+
+        jobs = self.ds.run_tx(lambda tx: tx.count_jobs_by_state(), "health_jobs_by_state")
+        # zero-fill the known states so a drained backlog decays to 0
+        # instead of freezing at its last nonzero sample
+        for state in AggregationJobState:
+            jobs.setdefault(("aggregation", state.value), 0)
+        for state in CollectionJobState:
+            jobs.setdefault(("collection", state.value), 0)
+        for (typ, state), count in sorted(jobs.items()):
+            metrics.jobs_gauge.set(float(count), type=typ, state=state)
+
+        leases = self.ds.run_tx(
+            lambda tx: tx.get_held_lease_expiries(), "health_held_leases"
+        )
+        live_keys = set()
+        max_age = 0
+        for typ, task_id, job_id, _expiry in leases:
+            key = (typ, bytes(task_id), bytes(job_id))
+            live_keys.add(key)
+            first = self._lease_first_seen.setdefault(key, now)
+            max_age = max(max_age, now - first)
+        # drop released/expired leases so a re-acquired job starts fresh
+        for key in list(self._lease_first_seen):
+            if key not in live_keys:
+                del self._lease_first_seen[key]
+        metrics.job_lease_age_seconds.set(float(max_age))
+
+        oldest = self.ds.run_tx(
+            lambda tx: tx.min_unaggregated_report_time_by_task(),
+            "health_oldest_unaggregated",
+        )
+        seen_tasks = set()
+        lag_by_task = {}
+        for task_id, min_time in oldest:
+            label = _b64_task_id(bytes(task_id))
+            seen_tasks.add(label)
+            age = float(max(0, now - min_time))
+            lag_by_task[label] = age
+            metrics.oldest_unaggregated_report_age_seconds.set(age, task_id=label)
+        for label in self._lag_tasks - seen_tasks:
+            metrics.oldest_unaggregated_report_age_seconds.set(0.0, task_id=label)
+        self._lag_tasks = seen_tasks
+
+        pending = self.ds.run_tx(
+            lambda tx: tx.count_batches_pending_collection(), "health_batches_pending"
+        )
+        metrics.batches_pending_collection.set(float(pending))
+
+        self.last_snapshot = {
+            "sampled_at_clock_seconds": now,
+            "jobs": {f"{typ}/{state}": n for (typ, state), n in sorted(jobs.items())},
+            "outstanding_leases": len(leases),
+            "max_lease_age_seconds": max_age,
+            "oldest_unaggregated_report_age_seconds": lag_by_task,
+            "batches_pending_collection": pending,
+            "interval_s": self.interval_s,
+        }
+        return self.last_snapshot
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        # first pass immediately: a scrape right after boot (exactly
+        # when ops check a restarted aggregator) must not see an empty
+        # job_health section for a whole interval
+        while True:
+            try:
+                self.run_once()
+            except Exception:
+                # sampling must never take the process down, and a
+                # transiently unreachable database just skips a sample
+                log.exception("health sampling pass failed")
+            if self._stop.wait(self.interval_s):
+                return
+
+    def start(self) -> "HealthSampler":
+        self._thread = threading.Thread(
+            target=self._loop, name="health-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -148,6 +148,24 @@ class DapHttpApp:
                     decode_workers=cfg.ingest_decode_workers,
                     queue_depth=cfg.ingest_queue_depth,
                 )
+                # /statusz occupancy section (binary_utils health
+                # listener): in-flight uploads vs the admission bound
+                from ..statusz import register_status_provider
+
+                pipe = self._ingest
+
+                def _ingest_status(pipe=pipe, cfg=cfg):
+                    inflight, bound = pipe.depth()
+                    return {
+                        "inflight": inflight,
+                        "queue_depth_bound": bound,
+                        "occupancy": round(inflight / bound, 3) if bound else 0.0,
+                        "decrypt_workers": pipe.decrypt_workers,
+                        "decode_workers": pipe.decode_workers,
+                        "queue_high_watermark": cfg.queue_high_watermark,
+                    }
+
+                register_status_provider("ingest", _ingest_status)
             if self._admission is None:
                 cfg = self.agg.cfg
                 self._admission = AdmissionController(

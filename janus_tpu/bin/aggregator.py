@@ -12,6 +12,7 @@ import threading
 
 from ..aggregator import Aggregator
 from ..aggregator.garbage_collector import GarbageCollector
+from ..aggregator.health_sampler import HealthSampler
 from ..aggregator.http_handlers import DapHttpApp, DapServer
 from ..binary_utils import _split_hostport, janus_main
 from ..config import AggregatorConfig
@@ -46,6 +47,10 @@ def run(cfg: AggregatorConfig, ds, stopper):
         api_server = AggregatorApiServer(api, host=api_host, port=api_port).start()
         log.info("aggregator API listening on %s", api_server.url)
 
+    sampler = None
+    if cfg.common.health_sampler_interval_s > 0:
+        sampler = HealthSampler(ds, cfg.common.health_sampler_interval_s).start()
+
     gc_thread = None
     if cfg.garbage_collection_interval_s:
         gc = GarbageCollector(ds, clock)
@@ -66,6 +71,8 @@ def run(cfg: AggregatorConfig, ds, stopper):
             stopper.wait(1.0)
     finally:
         server.stop()  # also drains the ingest pipeline (DapHttpApp.close)
+        if sampler is not None:
+            sampler.stop()
         if api_server is not None:
             api_server.stop()
         # flush any uploads still buffered in the group-commit writer so

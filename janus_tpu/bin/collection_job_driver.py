@@ -22,6 +22,8 @@ log = logging.getLogger(__name__)
 
 
 def run(cfg: JobDriverBinaryConfig, ds, stopper):
+    from ..aggregator.health_sampler import HealthSampler
+
     driver = CollectionJobDriver(
         ds,
         HttpClient(),
@@ -35,7 +37,14 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         driver.stepper,
         stopper,
     )
-    jd.run()
+    sampler = None
+    if cfg.common.health_sampler_interval_s > 0:
+        sampler = HealthSampler(ds, cfg.common.health_sampler_interval_s).start()
+    try:
+        jd.run()
+    finally:
+        if sampler is not None:
+            sampler.stop()
     log.info("collection job driver shut down")
 
 

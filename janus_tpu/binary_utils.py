@@ -26,9 +26,14 @@ from .config import CommonConfig, load_config
 from .core.time_util import RealClock
 from .datastore.store import Crypter, open_datastore
 from .metrics import REGISTRY
+from .statusz import register_status_provider, render_statusz_html, status_snapshot
 from .trace import install_trace_subscriber
 
 log = logging.getLogger(__name__)
+
+# Prometheus text exposition content type (version 0.0.4); the charset
+# matters — label values may carry escaped non-ASCII task ids/errors.
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def parse_datastore_keys(raw: str) -> list[bytes]:
@@ -109,29 +114,160 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
         self._pool.shutdown(wait=False)
 
 
+# ---------------------------------------------------------------------------
+# On-demand profiler capture (POST /debug/profile?seconds=N): one
+# window runs jax.profiler.trace (device timeline, loadable in
+# Perfetto/TensorBoard) plus a temporary host Chrome-trace writer, and
+# answers with the artifact paths. Guarded: concurrent captures 409,
+# the window is clamped.
+# ---------------------------------------------------------------------------
+
+PROFILE_MIN_SECONDS = 0.1
+PROFILE_MAX_SECONDS = 60.0
+_profile_lock = threading.Lock()
+
+
+class ProfileBusy(RuntimeError):
+    """A capture window is already open."""
+
+
+def capture_profile(seconds: float, out_dir: str | None = None) -> dict:
+    """Open a capture window of `seconds` (clamped to
+    [PROFILE_MIN_SECONDS, PROFILE_MAX_SECONDS]); raises ProfileBusy if
+    one is already open. Returns the artifact paths: the host
+    Chrome-trace JSON always; the jax.profiler trace dir when the
+    profiler starts (absent on backends without one)."""
+    import tempfile
+    import time as _time
+
+    from .trace import scoped_chrome_trace
+
+    if not _profile_lock.acquire(blocking=False):
+        raise ProfileBusy("a profile capture is already in progress")
+    try:
+        seconds = min(max(float(seconds), PROFILE_MIN_SECONDS), PROFILE_MAX_SECONDS)
+        out_dir = out_dir or tempfile.mkdtemp(prefix="janus-profile-")
+        os.makedirs(out_dir, exist_ok=True)
+        host_trace = os.path.join(out_dir, "host-trace.json")
+        device_dir = os.path.join(out_dir, "device")
+        device_started = False
+        device_error = None
+        try:
+            import jax
+
+            jax.profiler.start_trace(device_dir)
+            device_started = True
+        except Exception as e:  # no profiler on this backend — host-only
+            device_error = f"{type(e).__name__}: {e}"
+        try:
+            with scoped_chrome_trace(host_trace):
+                _time.sleep(seconds)
+        finally:
+            if device_started:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    device_started = False
+                    device_error = f"{type(e).__name__}: {e}"
+        out = {"seconds": seconds, "host_chrome_trace": host_trace}
+        if device_started:
+            out["device_trace_dir"] = device_dir
+        if device_error is not None:
+            out["device_profiler_error"] = device_error
+        return out
+    finally:
+        _profile_lock.release()
+
+
 class HealthServer:
-    """GET /healthz -> 200; GET /metrics -> Prometheus text
+    """The per-process introspection listener:
+
+      GET  /healthz                  -> 200 (liveness)
+      GET  /metrics                  -> Prometheus text exposition
+      GET  /statusz                  -> JSON status snapshot (HTML with
+                                        ?format=html or Accept: text/html)
+      GET  /debug/vars               -> JSON dump of the metrics registry
+      POST /debug/profile?seconds=N  -> on-demand profiler capture
+
     (reference serves /healthz from binary_utils.rs and metrics via the
-    OTel Prometheus exporter, metrics.rs:53-80)."""
+    OTel Prometheus exporter, metrics.rs:53-80; statusz/debug follow
+    the usual *z-page convention)."""
 
     def __init__(self, addr: str):
         host, port = _split_hostport(addr)
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                if self.path == "/healthz":
-                    body, ctype = b"", "text/plain"
-                elif self.path == "/metrics":
-                    body, ctype = REGISTRY.render().encode(), "text/plain; version=0.0.4"
-                else:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                self.send_response(200)
+            def _send(self, status: int, ctype: str, body: bytes) -> None:
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                import json as _json
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                query = dict(parse_qsl(parts.query))
+                if parts.path == "/healthz":
+                    self._send(200, "text/plain", b"")
+                elif parts.path == "/metrics":
+                    self._send(200, METRICS_CONTENT_TYPE, REGISTRY.render().encode())
+                elif parts.path == "/statusz":
+                    snap = status_snapshot()
+                    wants_html = query.get("format") == "html" or "text/html" in (
+                        self.headers.get("Accept") or ""
+                    )
+                    if wants_html:
+                        self._send(
+                            200,
+                            "text/html; charset=utf-8",
+                            render_statusz_html(snap).encode(),
+                        )
+                    else:
+                        self._send(
+                            200,
+                            "application/json",
+                            _json.dumps(snap, indent=2, default=str).encode(),
+                        )
+                elif parts.path == "/debug/vars":
+                    self._send(
+                        200, "application/json", _json.dumps(REGISTRY.snapshot()).encode()
+                    )
+                else:
+                    self._send(404, "text/plain", b"not found")
+
+            def do_POST(self):  # noqa: N802
+                import json as _json
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                if parts.path != "/debug/profile":
+                    self._send(404, "text/plain", b"not found")
+                    return
+                query = dict(parse_qsl(parts.query))
+                try:
+                    seconds = float(query.get("seconds", "2"))
+                except ValueError:
+                    self._send(400, "text/plain", b"seconds must be a number")
+                    return
+                try:
+                    result = capture_profile(seconds)
+                except ProfileBusy as e:
+                    self._send(
+                        409,
+                        "application/json",
+                        _json.dumps({"error": str(e)}).encode(),
+                    )
+                    return
+                except Exception:
+                    log.exception("profile capture failed")
+                    self._send(500, "text/plain", b"profile capture failed")
+                    return
+                self._send(200, "application/json", _json.dumps(result).encode())
 
             def log_message(self, fmt, *args):
                 pass
@@ -286,6 +422,52 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
 
     keys = parse_datastore_keys(args.datastore_keys)
     ds = open_datastore(common.database.url, Crypter(keys), RealClock())
+    if "JANUS_SLOW_TX_WARN_S" not in os.environ:
+        # the env var is the operator override; only the YAML value is
+        # applied when it's absent (else it would be silently dead in
+        # every binary — the class default already read it)
+        ds.slow_tx_warn_s = common.database.slow_tx_warn_secs
+
+    # /statusz base sections: build/process info and the provisioned
+    # tasks (subsystems — engine cache, ingest, health sampler — add
+    # their own sections as they come up)
+    def _process_status():
+        from . import __version__
+
+        info = {
+            "version": __version__,
+            "role": description,
+            "pid": os.getpid(),
+            "config_file": args.config_file,
+            "database_url": common.database.url,
+            "jax_platform": common.jax_platform or os.environ.get("JAX_PLATFORMS"),
+            "health_sampler_interval_s": common.health_sampler_interval_s,
+        }
+        try:
+            import jax
+
+            info["jax_version"] = jax.__version__
+        except Exception:
+            pass
+        return info
+
+    def _tasks_status():
+        from .metrics import task_id_label
+
+        tasks = ds.run_tx(lambda tx: tx.get_tasks(), "statusz_tasks")
+        return [
+            {
+                "task_id": task_id_label(t.task_id.data),
+                "role": t.role.name,
+                "vdaf": t.vdaf.kind,
+                "xof_mode": t.vdaf.xof_mode,
+                "query_type": t.query_type.code,
+            }
+            for t in tasks
+        ]
+
+    register_status_provider("process", _process_status)
+    register_status_provider("tasks", _tasks_status)
 
     if common.warmup_engines_at_boot:
         if common.warmup_buckets:

@@ -28,10 +28,16 @@ class DbConfig:
     deployments and tests."""
 
     url: str = "janus.sqlite"
+    # WARN-log threshold for one datastore transaction (run_tx wall
+    # time, retries included); <= 0 disables the warning.
+    slow_tx_warn_secs: float = 1.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "DbConfig":
-        return cls(url=str(d.get("url", "janus.sqlite")))
+        return cls(
+            url=str(d.get("url", "janus.sqlite")),
+            slow_tx_warn_secs=float(d.get("slow_tx_warn_secs", 1.0)),
+        )
 
 
 @dataclass
@@ -69,6 +75,10 @@ class CommonConfig:
     # background thread per ascending bucket — serving starts
     # immediately and big job buckets compile ahead of their first job.
     warmup_buckets: tuple[int, ...] = ()
+    # Period of the job/task health sampler (aggregator/health_sampler.py:
+    # janus_jobs backlog gauges, lease age, aggregation lag). 0 disables.
+    # Wired by the aggregator server and both job driver binaries.
+    health_sampler_interval_s: float = 15.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -82,6 +92,7 @@ class CommonConfig:
             compilation_cache_dir=d.get("compilation_cache_dir", "~/.cache/janus_tpu_xla"),
             warmup_engines_at_boot=bool(d.get("warmup_engines_at_boot", False)),
             warmup_buckets=tuple(int(b) for b in d.get("warmup_buckets", ())),
+            health_sampler_interval_s=float(d.get("health_sampler_interval_secs", 15.0)),
         )
 
 

@@ -25,6 +25,7 @@ integrity-error types, the lease-select locking suffix, and DDL types.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import secrets
@@ -32,6 +33,8 @@ import sqlite3
 import tempfile
 import threading
 import time as _time
+
+_log = logging.getLogger(__name__)
 
 try:  # Postgres backend is optional (psycopg not present in all images)
     import psycopg as _psycopg
@@ -1251,6 +1254,62 @@ class Transaction:
     def delete_global_hpke_keypair(self, config_id: int) -> None:
         self._c.execute("DELETE FROM global_hpke_keys WHERE config_id = ?", (config_id,))
 
+    # ---- health/introspection reads (aggregator/health_sampler.py;
+    # cheap aggregate queries only — the sampler runs them on a period
+    # against the serving database) ----
+    def count_jobs_by_state(self) -> dict[tuple[str, str], int]:
+        """{(job type, state): count} over aggregation + collection jobs
+        (the janus_jobs{type,state} backlog gauges)."""
+        out: dict[tuple[str, str], int] = {}
+        for typ, table in (
+            ("aggregation", "aggregation_jobs"),
+            ("collection", "collection_jobs"),
+        ):
+            for state, n in self._c.execute(
+                f"SELECT state, COUNT(*) FROM {table} GROUP BY state"
+            ).fetchall():
+                out[(typ, str(state))] = int(n)
+        return out
+
+    def get_held_lease_expiries(self) -> list[tuple[str, bytes, bytes, int]]:
+        """[(job type, task_id, job_id, lease_expiry)] for every lease
+        currently outstanding (token set, not yet expired). The sampler
+        tracks first-observation time per lease to export
+        janus_job_lease_age_seconds."""
+        now = self._clock.now().seconds
+        out: list[tuple[str, bytes, bytes, int]] = []
+        for typ, table, id_col in (
+            ("aggregation", "aggregation_jobs", "job_id"),
+            ("collection", "collection_jobs", "collection_job_id"),
+        ):
+            rows = self._c.execute(
+                f"SELECT task_id, {id_col}, lease_expiry FROM {table}"
+                " WHERE lease_token IS NOT NULL AND lease_expiry > ?",
+                (now,),
+            ).fetchall()
+            out.extend((typ, r[0], r[1], int(r[2])) for r in rows)
+        return out
+
+    def min_unaggregated_report_time_by_task(self) -> list[tuple[bytes, int]]:
+        """[(task_id, oldest unaggregated client_time)] — the
+        aggregation-lag signal (oldest report no aggregation job has
+        claimed yet); uses the client_reports_unaggregated partial
+        index."""
+        rows = self._c.execute(
+            "SELECT task_id, MIN(client_time) FROM client_reports"
+            " WHERE aggregation_started = 0 GROUP BY task_id"
+        ).fetchall()
+        return [(r[0], int(r[1])) for r in rows]
+
+    def count_batches_pending_collection(self) -> int:
+        """Collection jobs still awaiting an aggregate result."""
+        return int(
+            self._c.execute(
+                "SELECT COUNT(*) FROM collection_jobs"
+                " WHERE state IN ('start', 'collectable')"
+            ).fetchone()[0]
+        )
+
     # ---- GC (reference datastore.rs:4162-4315) ----
     def delete_expired_aggregation_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
         rows = self._c.execute(
@@ -1293,6 +1352,11 @@ class Datastore:
 
     MAX_RETRIES = 16
     DIALECT = "sqlite"
+    # WARN when one run_tx (including retries) exceeds this many
+    # seconds. Configurable: database.slow_tx_warn_secs in the YAML
+    # (binary_utils applies it) or the JANUS_SLOW_TX_WARN_S env var;
+    # <= 0 disables.
+    slow_tx_warn_s = float(os.environ.get("JANUS_SLOW_TX_WARN_S", "1.0"))
 
     def __init__(self, path: str, crypter: Crypter, clock):
         self._path = path
@@ -1379,7 +1443,14 @@ class Datastore:
                 tx = self._tx_obj(conn)
                 result = fn(tx)
                 conn.commit()
-                metrics.tx_duration.observe(_time.monotonic() - start, tx=name)
+                elapsed = _time.monotonic() - start
+                metrics.tx_duration.observe(elapsed, tx=name)
+                if 0 < self.slow_tx_warn_s < elapsed:
+                    _log.warning(
+                        "slow datastore transaction %s: %.3fs over %d attempt(s)"
+                        " (threshold %.2fs)",
+                        name, elapsed, attempt + 1, self.slow_tx_warn_s,
+                    )
                 return result
             except self._retryable_errors as e:
                 # the connection itself may be dead (e.g. Postgres

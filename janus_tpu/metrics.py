@@ -15,10 +15,17 @@ from bisect import bisect_left
 from collections import defaultdict
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text exposition label-value escaping: backslash,
+    double-quote and newline must be escaped or a single hostile value
+    (a task id, an error string) corrupts the whole /metrics scrape."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label_value(str(v))}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -35,7 +42,11 @@ class Counter:
             self._values[key] += n
 
     def get(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0)
+        # the lock, not the GIL, is the documented guarantee: a reader
+        # must never observe a torn/partial update even if the value
+        # type grows beyond a float
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0)
 
     def total(self) -> float:
         """Sum across all label sets (shed accounting in bench/tests)."""
@@ -73,7 +84,13 @@ class Gauge:
             self._values[key] += n
 
     def get(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0)
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0)
+
+    def total(self) -> float:
+        """Sum across all label sets (mirrors Counter.total)."""
+        with self._lock:
+            return sum(self._values.values())
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
@@ -132,6 +149,20 @@ class Histogram:
         return "\n".join(lines)
 
 
+def _labels_dict(key: tuple[tuple[str, str], ...]) -> dict:
+    return {k: str(v) for k, v in key}
+
+
+def task_id_label(task_id_bytes: bytes) -> str:
+    """Canonical task-id label value (unpadded urlsafe base64, the DAP
+    URL form). One definition — the per-task series (reports
+    aggregated, aggregation lag) must agree on the encoding or one
+    task's metrics silently split across two label values."""
+    import base64
+
+    return base64.urlsafe_b64encode(task_id_bytes).rstrip(b"=").decode()
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -164,10 +195,44 @@ class MetricsRegistry:
             assert isinstance(m, Histogram)
             return m
 
-    def render(self) -> str:
+    def metrics_list(self) -> list:
+        """Stable copy of the registered metric objects, taken under the
+        registry lock (exporters iterating `_metrics` directly race a
+        concurrent counter()/histogram() registration)."""
         with self._lock:
-            metrics = list(self._metrics.values())
-        return "\n".join(m.render() for m in metrics) + "\n"
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        return "\n".join(m.render() for m in self.metrics_list()) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-shaped dump of every metric (the /debug/vars payload and
+        the bench rider's metric snapshot)."""
+        out: dict = {}
+        for m in self.metrics_list():
+            if isinstance(m, Histogram):
+                with m._lock:
+                    samples = [
+                        {
+                            "labels": _labels_dict(key),
+                            "sum": m._sums[key],
+                            "count": m._totals[key],
+                            "buckets": dict(
+                                zip((f"{b:g}" for b in m.buckets), m._counts[key])
+                            ),
+                        }
+                        for key in sorted(m._counts)
+                    ]
+                out[m.name] = {"type": "histogram", "help": m.help, "samples": samples}
+            else:
+                kind = "counter" if isinstance(m, Counter) else "gauge"
+                with m._lock:
+                    samples = [
+                        {"labels": _labels_dict(key), "value": v}
+                        for key, v in sorted(m._values.items())
+                    ]
+                out[m.name] = {"type": kind, "help": m.help, "samples": samples}
+        return out
 
 
 REGISTRY = MetricsRegistry()
@@ -221,3 +286,125 @@ ingest_stage_duration = REGISTRY.histogram(
     "janus_ingest_stage_duration_seconds",
     "per-report ingest stage latency (decode, decrypt, commit), by stage",
 )
+
+# --- device path: engine/dispatch metrics (docs/OBSERVABILITY.md
+# "Engine metrics"; ISSUE 3). The *_seconds histograms are fed by the
+# span->metric bridge (trace.register_span_metric, registrations at the
+# bottom of this module) so the Chrome-trace spans and the Prometheus
+# series measure the same boundaries by construction. ---
+engine_dispatch_seconds = REGISTRY.histogram(
+    "janus_engine_dispatch_seconds",
+    "device engine step wall time split into put/dispatch/fetch, by op and VDAF",
+)
+# first compiles run seconds-to-minutes (remote AOT through the tunnel):
+# the default DB/HTTP buckets top out at 30s and would flatten them
+COMPILE_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0)
+engine_compile_seconds = REGISTRY.histogram(
+    "janus_engine_compile_seconds",
+    "first-call (trace+compile) latency per (op, batch bucket)",
+    buckets=COMPILE_BUCKETS,
+)
+engine_dispatches_total = REGISTRY.counter(
+    "janus_engine_dispatches_total", "device engine dispatches, by op"
+)
+engine_rows_total = REGISTRY.counter(
+    "janus_engine_rows_total", "report rows through the device engine, by op"
+)
+engine_bucket_cap = REGISTRY.gauge(
+    "janus_engine_bucket_cap",
+    "current HBM-feasibility batch bucket cap per VDAF kind (0 = uncapped)",
+)
+engine_batch_fill_ratio = REGISTRY.gauge(
+    "janus_engine_batch_fill_ratio",
+    "rows / padded bucket of the most recent dispatch, by op (padding waste)",
+)
+engine_cache_entries = REGISTRY.gauge(
+    "janus_engine_cache_entries", "live compiled-engine cache entries"
+)
+engine_cache_hits = REGISTRY.counter(
+    "janus_engine_cache_hits_total", "engine cache lookups served from cache"
+)
+engine_cache_misses = REGISTRY.counter(
+    "janus_engine_cache_misses_total", "engine cache lookups that built a new engine"
+)
+engine_coalesced_rounds_total = REGISTRY.counter(
+    "janus_engine_coalesced_rounds_total",
+    "device dispatch rounds that merged more than one concurrent caller",
+)
+engine_coalesced_rows_total = REGISTRY.counter(
+    "janus_engine_coalesced_rows_total",
+    "report rows carried by coalesced (multi-caller) dispatch rounds",
+)
+engine_backend_state = REGISTRY.gauge(
+    "janus_engine_backend",
+    "1 for the active engine backend per VDAF kind "
+    '(state="device|host_fallback|timed_fallback|host"), 0 otherwise',
+)
+
+# --- job/task health (aggregator/health_sampler.py; sampled except the
+# accumulate-time counter) ---
+jobs_gauge = REGISTRY.gauge(
+    "janus_jobs", "datastore job backlog, by job type and state (sampled)"
+)
+job_lease_age_seconds = REGISTRY.gauge(
+    "janus_job_lease_age_seconds",
+    "max age of any outstanding job lease since the sampler first observed it",
+)
+oldest_unaggregated_report_age_seconds = REGISTRY.gauge(
+    "janus_oldest_unaggregated_report_age_seconds",
+    "age of the oldest report not yet claimed by an aggregation job, per task "
+    "(the aggregation-lag SLO signal)",
+)
+task_reports_aggregated_total = REGISTRY.counter(
+    "janus_task_reports_aggregated_total",
+    "reports merged into batch aggregations, per task (counted at accumulate time)",
+)
+batches_pending_collection = REGISTRY.gauge(
+    "janus_batches_pending_collection",
+    "collection jobs awaiting an aggregate result (sampled)",
+)
+
+
+def _register_span_bridges() -> None:
+    """Bind the engine span names to janus_engine_dispatch_seconds via
+    the span->metric bridge (trace.register_span_metric): a span exit
+    IS the histogram observation, so the trace timeline and the metric
+    cannot drift apart. The vdaf label rides the span args."""
+    from .trace import register_span_metric
+
+    for op in ("helper_init", "leader_init"):
+        for span_name, phase in (
+            (f"engine.{op}.put", "put"),
+            (f"engine.{op}.dispatch", "dispatch"),
+            (f"engine.{op}.fetch", "fetch"),
+        ):
+            register_span_metric(
+                span_name,
+                engine_dispatch_seconds,
+                labels={"op": op, "phase": phase},
+                arg_labels=("vdaf",),
+            )
+    # leader init's split fetches and the pipelined path's stages all
+    # roll up into the same three phases
+    for span_name, phase in (
+        ("engine.leader_init.fetch_seed", "fetch"),
+        ("engine.leader_init.fetch_ver", "fetch"),
+        ("engine.leader_init.fetch_part", "fetch"),
+        ("engine.leader_init.put_all_async", "put"),
+        ("engine.leader_init.chunk", "dispatch"),
+    ):
+        register_span_metric(
+            span_name,
+            engine_dispatch_seconds,
+            labels={"op": "leader_init", "phase": phase},
+            arg_labels=("vdaf",),
+        )
+    register_span_metric(
+        "engine.aggregate.dispatch",
+        engine_dispatch_seconds,
+        labels={"op": "aggregate", "phase": "dispatch"},
+        arg_labels=("vdaf",),
+    )
+
+
+_register_span_bridges()

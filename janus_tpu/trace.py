@@ -15,11 +15,17 @@ import atexit
 import json
 import logging
 import os
+import random
 import sys
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
+
+# span-id generation: uniqueness, not unpredictability (no urandom
+# syscall); a module-level instance so the span() hot path pays neither
+# an import nor the global-PRNG lock contention pattern
+_span_rng = random.Random()
 
 
 @dataclass
@@ -192,7 +198,10 @@ class OtlpExporter:
             return [{"key": k, "value": {"stringValue": v}} for k, v in labels]
 
         out = []
-        for metric in m.REGISTRY._metrics.values():
+        # metrics_list() copies under the registry lock: iterating
+        # _metrics directly races a concurrent counter()/histogram()
+        # registration ("dictionary changed size during iteration")
+        for metric in m.REGISTRY.metrics_list():
             if isinstance(metric, m.Counter):
                 with metric._lock:
                     items = sorted(metric._values.items())
@@ -282,6 +291,23 @@ def install_otlp_export(endpoint: str, flush_interval_s: float = 5.0) -> OtlpExp
         _otlp_exporter.shutdown()
     _otlp_exporter = OtlpExporter(endpoint, flush_interval_s=flush_interval_s)
     return _otlp_exporter
+
+
+@contextmanager
+def scoped_chrome_trace(path: str):
+    """Temporarily route host spans to a fresh Chrome trace file (the
+    /debug/profile capture window), restoring any configured writer on
+    exit. Unlike install_chrome_trace the path is used verbatim — the
+    caller owns the artifact name."""
+    global _chrome_writer
+    prev = _chrome_writer
+    w = ChromeTraceWriter(path)
+    _chrome_writer = w
+    try:
+        yield path
+    finally:
+        _chrome_writer = prev
+        w.close()
 
 
 def install_chrome_trace(path: str) -> None:
@@ -382,6 +408,39 @@ def use_context(ctx):
         _trace_ctx.reset(token)
 
 
+# ---------------------------------------------------------------------------
+# span -> metric bridge: a span NAME registered here records its
+# duration into a histogram on exit, so the trace timeline and the
+# Prometheus series measure the same boundaries by construction
+# (registrations live next to the histogram definitions, metrics.py).
+# Unregistered spans pay one dict lookup on exit.
+# ---------------------------------------------------------------------------
+
+_span_metrics: dict[str, tuple] = {}
+
+
+def register_span_metric(
+    span_name: str, histogram, labels: dict | None = None, arg_labels: tuple = ()
+) -> None:
+    """Record every exit of span `span_name` into `histogram`:
+    `labels` attach verbatim; each name in `arg_labels` is copied from
+    the span's kwargs when present (e.g. vdaf=...)."""
+    _span_metrics[span_name] = (histogram, dict(labels or {}), tuple(arg_labels))
+
+
+def _bridge_span(name: str, dur_s: float, args: dict) -> None:
+    reg = _span_metrics.get(name)
+    if reg is None:
+        return
+    hist, static, arg_labels = reg
+    labels = dict(static)
+    for k in arg_labels:
+        v = args.get(k)
+        if v is not None:
+            labels[k] = str(v)
+    hist.observe(dur_s, **labels)
+
+
 @contextmanager
 def span(name: str, **args):
     """Record a host-side span (event emission is a no-op unless a
@@ -390,12 +449,11 @@ def span(name: str, **args):
     draw, with hex formatting deferred to emission/header time so the
     untraced hot path stays near-free; ids need uniqueness, not
     unpredictability, so this is random.getrandbits, not a urandom
-    syscall)."""
-    import random as _random
-
+    syscall). Span names registered with register_span_metric also
+    record their duration into the bound histogram on exit."""
     parent = _trace_ctx.get()
-    trace_id = parent[0] if parent else _random.getrandbits(128)
-    span_id = _random.getrandbits(64)
+    trace_id = parent[0] if parent else _span_rng.getrandbits(128)
+    span_id = _span_rng.getrandbits(64)
     token = _trace_ctx.set((trace_id, span_id))
     w = _chrome_writer
     ox = _otlp_exporter
@@ -406,6 +464,8 @@ def span(name: str, **args):
     finally:
         t1 = time.perf_counter_ns()
         _trace_ctx.reset(token)
+        if _span_metrics:
+            _bridge_span(name, (t1 - t0) / 1e9, args)
         if w is not None:
             w.event(
                 name,

@@ -673,6 +673,32 @@ def stream_plan(
     return StreamPlan(gcalls, n_steps, gcalls * ch, bits)
 
 
+def describe_engine_geometry(bc: BatchedCircuit) -> dict:
+    """Static geometry of a batched circuit for introspection
+    (/statusz engine-cache section, bench riders): the tensor shapes
+    that drive the HBM feasibility bound and the streamed-query plan,
+    in one JSON-shaped dict."""
+    circ = bc.circ
+    plan = stream_plan(bc)
+    return {
+        "circuit": type(circ).__name__,
+        "input_len": getattr(circ, "input_len", None),
+        "output_len": getattr(circ, "output_len", None),
+        "verifier_len": getattr(circ, "verifier_len", None),
+        "gadget_calls": getattr(bc, "calls", None),
+        "field_limbs": bc.jf.LIMBS,
+        "stream_plan": (
+            {
+                "tile_elems": plan.group,
+                "gcalls": plan.gcalls,
+                "n_steps": plan.n_steps,
+            }
+            if plan is not None
+            else None
+        ),
+    }
+
+
 def sliced_meas_source(bc: BatchedCircuit, plan: StreamPlan, meas):
     """meas_source over a device-resident [batch, input_len] share
     (leader side): pad to the group grid once, dynamic-slice per step."""

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Deploy smoke check: scrape a running aggregator's health listener
+and validate the output with the same exposition parser the tests use.
+
+    python scripts/scrape_check.py --url http://127.0.0.1:9001 [--statusz]
+
+Exit status 0 when /metrics parses clean (and, with --statusz, the
+/statusz snapshot is well-formed JSON with the expected sections);
+non-zero with the errors on stderr otherwise. Exercised in tier-1 via
+bench.py --dry-run's observability smoke (tests/test_tools.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from janus_tpu.exposition import (  # noqa: E402
+    lint_metric_names,
+    parse_exposition,
+    validate_exposition,
+)
+
+
+def _fetch(url: str, timeout: float) -> tuple[str, str]:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8"), resp.headers.get("Content-Type", "")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--url",
+        required=True,
+        help="health listener base URL, e.g. http://127.0.0.1:9001",
+    )
+    ap.add_argument(
+        "--statusz", action="store_true", help="also validate the /statusz snapshot"
+    )
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    base = args.url.rstrip("/")
+
+    errors: list[str] = []
+    try:
+        text, ctype = _fetch(base + "/metrics", args.timeout)
+    except Exception as e:
+        print(f"scrape_check: GET /metrics failed: {e}", file=sys.stderr)
+        return 2
+    if not ctype.startswith("text/plain") or "version=0.0.4" not in ctype:
+        errors.append(f"/metrics Content-Type not exposition format: {ctype!r}")
+    errors.extend(validate_exposition(text))
+    families, _ = parse_exposition(text)
+    errors.extend(lint_metric_names({f.name: f.type for f in families.values()}))
+    if not families:
+        errors.append("/metrics exposed no metric families")
+
+    if args.statusz:
+        try:
+            body, _ = _fetch(base + "/statusz", args.timeout)
+            snap = json.loads(body)
+        except Exception as e:
+            errors.append(f"/statusz not valid JSON: {e}")
+        else:
+            if not isinstance(snap, dict) or not snap:
+                errors.append("/statusz snapshot is empty")
+
+    for err in errors:
+        print(f"scrape_check: {err}", file=sys.stderr)
+    if errors:
+        return 1
+    print(f"scrape_check: OK ({len(families)} metric families)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

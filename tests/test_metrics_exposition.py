@@ -1,0 +1,424 @@
+"""Prometheus exposition correctness + the observability endpoints.
+
+The scrape is an interface: a single malformed label value or a
+non-monotone bucket silently corrupts every downstream dashboard, so
+every registered metric must render output the shared parser
+(janus_tpu.exposition — also used by scripts/scrape_check.py and the
+bench dry-run smoke) accepts, and the naming conventions are linted so
+new metrics can't drift. Plus: /statusz, /debug/vars, the
+/debug/profile concurrency guard, the span->metric bridge, and the
+job-health sampler.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from janus_tpu import metrics as m
+from janus_tpu.exposition import (
+    lint_metric_names,
+    parse_exposition,
+    registry_names_by_type,
+    validate_exposition,
+)
+
+
+# ---------------------------------------------------------------------------
+# exposition format
+# ---------------------------------------------------------------------------
+
+
+def test_label_escaping_roundtrip():
+    """A label value carrying backslash, double quote, and newline must
+    render escaped and parse back to the original value."""
+    hostile = 'task"id\nwith\\everything'
+    c = m.Counter("janus_escape_test_total", "escaping probe")
+    c.add(3, task=hostile)
+    text = c.render()
+    # the raw text must not contain an unescaped newline inside a label
+    sample_lines = [l for l in text.splitlines() if not l.startswith("#")]
+    assert len(sample_lines) == 1, sample_lines
+    families, errors = parse_exposition(
+        f"# HELP {c.name} x\n# TYPE {c.name} counter\n" + sample_lines[0]
+    )
+    assert not errors, errors
+    ((name, labels, value),) = families[c.name].samples
+    assert labels["task"] == hostile
+    assert value == 3.0
+
+
+def test_unescaped_scrape_would_be_rejected():
+    """The parser the deploy check uses actually catches the corruption
+    escaping prevents (guards against a silently lax parser)."""
+    bad = '# TYPE janus_x_total counter\njanus_x_total{a="broken\nvalue"} 1\n'
+    families, errors = parse_exposition(bad)
+    assert errors  # unescaped newline splits the sample line
+
+
+def test_full_registry_scrape_valid_and_linted():
+    """Every registered metric — after populating representative
+    samples including a hostile label — renders a scrape the shared
+    parser validates, and every name passes the convention lint."""
+    m.aggregate_step_failure_counter.add(type='weird"type\nname\\x')
+    m.http_request_duration.observe(0.012, route="upload")
+    m.http_request_duration.observe(31.0, route="upload")  # +Inf overflow
+    m.engine_dispatch_seconds.observe(0.004, op="helper_init", phase="put", vdaf="count")
+    m.engine_compile_seconds.observe(42.0, op="helper_init", bucket="32")
+    m.jobs_gauge.set(2, type="aggregation", state="in_progress")
+    m.engine_backend_state.set(1.0, vdaf="count", state="device")
+    text = m.REGISTRY.render()
+    assert validate_exposition(text) == []
+    assert lint_metric_names(registry_names_by_type(m.REGISTRY)) == []
+
+
+def test_histogram_bucket_monotonicity_and_sums():
+    h = m.Histogram("janus_mono_test_seconds", "probe", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v, op="x")
+    families, errors = parse_exposition(
+        "# HELP janus_mono_test_seconds p\n# TYPE janus_mono_test_seconds histogram\n"
+        + "\n".join(l for l in h.render().splitlines() if not l.startswith("#"))
+    )
+    assert not errors
+    samples = families["janus_mono_test_seconds"].samples
+    buckets = [
+        (labels["le"], v) for name, labels, v in samples if name.endswith("_bucket")
+    ]
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts)  # cumulative
+    count = next(v for name, _, v in samples if name.endswith("_count"))
+    assert count == 5
+    inf_bucket = next(v for le, v in buckets if le == "+Inf")
+    assert inf_bucket == count
+    total = next(v for name, _, v in samples if name.endswith("_sum"))
+    assert total == pytest.approx(56.05)
+
+
+def test_naming_lint_flags_violations():
+    errs = lint_metric_names(
+        {
+            "not_janus_thing": "gauge",
+            "janus_new_counter": "counter",  # missing _total, not grandfathered
+            "janus_upload_decrypt_failures": "counter",  # grandfathered
+            "janus_some_duration": "histogram",  # missing _seconds
+        }
+    )
+    assert any("not_janus_thing" in e for e in errs)
+    assert any("janus_new_counter" in e for e in errs)
+    assert any("janus_some_duration" in e for e in errs)
+    assert not any("janus_upload_decrypt_failures" in e for e in errs)
+
+
+def test_counter_gauge_locked_reads_and_totals():
+    g = m.Gauge("janus_gauge_probe", "probe")
+    g.set(2.0, k="a")
+    g.add(3.0, k="b")
+    assert g.get(k="a") == 2.0
+    assert g.total() == 5.0
+    c = m.Counter("janus_counter_probe_total", "probe")
+    c.add(4, k="a")
+    assert c.get(k="a") == 4.0
+    assert c.total() == 4.0
+
+
+# ---------------------------------------------------------------------------
+# span -> metric bridge
+# ---------------------------------------------------------------------------
+
+
+def test_span_metric_bridge_records_duration_with_labels():
+    from janus_tpu.trace import register_span_metric, span
+
+    h = m.Histogram("janus_bridge_probe_seconds", "probe")
+    register_span_metric(
+        "bridge.probe", h, labels={"op": "x", "phase": "put"}, arg_labels=("vdaf",)
+    )
+    with span("bridge.probe", vdaf="count"):
+        time.sleep(0.01)
+    key = (("op", "x"), ("phase", "put"), ("vdaf", "count"))
+    assert h._totals[key] == 1
+    assert h._sums[key] >= 0.01
+    # a span without the optional arg label still records
+    with span("bridge.probe"):
+        pass
+    key2 = (("op", "x"), ("phase", "put"))
+    assert h._totals[key2] == 1
+
+
+def test_engine_spans_are_registered_with_dispatch_histogram():
+    """The bridge registrations in metrics.py cover the engine span
+    names engine_cache.py emits — drift here silently zeroes the
+    dispatch histogram."""
+    from janus_tpu.trace import _span_metrics
+
+    for op in ("helper_init", "leader_init"):
+        for phase in ("put", "dispatch", "fetch"):
+            assert f"engine.{op}.{phase}" in _span_metrics
+    assert "engine.aggregate.dispatch" in _span_metrics
+    for name in (
+        "engine.leader_init.fetch_seed",
+        "engine.leader_init.fetch_ver",
+        "engine.leader_init.fetch_part",
+        "engine.leader_init.put_all_async",
+        "engine.leader_init.chunk",
+    ):
+        assert name in _span_metrics
+        assert _span_metrics[name][0] is m.engine_dispatch_seconds
+
+
+# ---------------------------------------------------------------------------
+# health listener endpoints
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def health_server():
+    from janus_tpu.binary_utils import HealthServer
+
+    srv = HealthServer("127.0.0.1:0").start()
+    try:
+        yield f"http://127.0.0.1:{srv.port}"
+    finally:
+        srv.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+def test_metrics_endpoint_content_type_and_validity(health_server):
+    status, ctype, body = _get(health_server + "/metrics")
+    assert status == 200
+    assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+    assert validate_exposition(body.decode()) == []
+
+
+def test_statusz_json_and_html(health_server):
+    from janus_tpu.statusz import register_status_provider, unregister_status_provider
+
+    register_status_provider("probe_section", lambda: {"answer": 42})
+    try:
+        status, ctype, body = _get(health_server + "/statusz")
+        assert status == 200 and ctype.startswith("application/json")
+        snap = json.loads(body)
+        assert snap["probe_section"] == {"answer": 42}
+        status, ctype, body = _get(health_server + "/statusz?format=html")
+        assert status == 200 and ctype.startswith("text/html")
+        assert b"probe_section" in body
+    finally:
+        unregister_status_provider("probe_section")
+
+
+def test_statusz_survives_broken_provider(health_server):
+    from janus_tpu.statusz import register_status_provider, unregister_status_provider
+
+    register_status_provider("broken", lambda: 1 / 0)
+    try:
+        status, _, body = _get(health_server + "/statusz")
+        assert status == 200
+        snap = json.loads(body)
+        assert "error" in snap["broken"]
+    finally:
+        unregister_status_provider("broken")
+
+
+def test_debug_vars_dumps_registry(health_server):
+    m.upload_shed_counter.add(route="upload", reason="probe")
+    status, ctype, body = _get(health_server + "/debug/vars")
+    assert status == 200 and ctype.startswith("application/json")
+    vars_ = json.loads(body)
+    fam = vars_["janus_upload_shed_total"]
+    assert fam["type"] == "counter"
+    assert any(
+        s["labels"] == {"route": "upload", "reason": "probe"} for s in fam["samples"]
+    )
+
+
+def test_profile_capture_concurrent_second_409s(health_server):
+    """POST /debug/profile: a capture while the guard is held answers
+    409; with the guard free it answers 200 with a loadable host
+    Chrome trace. Deterministic — the guard lock is held directly
+    instead of racing two HTTP requests on a loaded host (the bench
+    dry-run smoke exercises the truly concurrent pair)."""
+    import janus_tpu.binary_utils as _bu
+
+    def post(seconds):
+        req = urllib.request.Request(
+            health_server + f"/debug/profile?seconds={seconds}", method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    assert _bu._profile_lock.acquire(blocking=False)
+    try:
+        status, body = post(1)
+        assert status == 409, (status, body)
+    finally:
+        _bu._profile_lock.release()
+
+    status, body = post(1)
+    assert status == 200, (status, body)
+    artifacts = json.loads(body)
+    raw = open(artifacts["host_chrome_trace"]).read().rstrip()
+    events = json.loads(raw if raw.endswith("]") else raw + "{}]")
+    assert isinstance(events, list)
+
+
+def test_profile_window_clamped():
+    from janus_tpu.binary_utils import PROFILE_MAX_SECONDS, capture_profile
+
+    out = capture_profile(0.0)  # below the floor
+    assert out["seconds"] >= 0.1
+    assert PROFILE_MAX_SECONDS <= 60.0
+
+
+def test_scrape_check_tool_against_live_listener(health_server, tmp_path):
+    """scripts/scrape_check.py (the deploy smoke) passes against a live
+    listener and fails against garbage."""
+    import pathlib
+    import runpy
+    import sys
+
+    script = pathlib.Path(__file__).resolve().parent.parent / "scripts" / "scrape_check.py"
+    sys.path.insert(0, str(script.parent.parent))
+    try:
+        mod = runpy.run_path(str(script), run_name="scrape_check")
+        assert mod["main"](["--url", health_server, "--statusz"]) == 0
+        assert mod["main"](["--url", health_server + "/nope"]) != 0
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# job/task health sampler
+# ---------------------------------------------------------------------------
+
+
+def _provision_backlog(ds, clock):
+    from janus_tpu.datastore.models import (
+        AggregationJobModel,
+        AggregationJobState,
+        LeaderStoredReport,
+    )
+    from janus_tpu.messages import (
+        AggregationJobId,
+        Duration,
+        HpkeCiphertext,
+        HpkeConfigId,
+        Interval,
+        ReportId,
+        Role,
+        Time,
+    )
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+        .with_(min_batch_size=1)
+        .build()
+    )
+    now = clock.now().seconds
+
+    def provision(tx):
+        tx.put_task(task)
+        tx.put_aggregation_job(
+            AggregationJobModel(
+                task.task_id,
+                AggregationJobId(b"\x07" * 16),
+                b"",
+                b"",
+                Interval(Time(now - 60), Duration(60)),
+                AggregationJobState.IN_PROGRESS,
+                0,
+                None,
+            )
+        )
+        tx.put_client_report(
+            LeaderStoredReport(
+                task.task_id,
+                ReportId(b"\x08" * 16),
+                Time(now - 500),
+                b"",
+                b"share",
+                HpkeCiphertext(HpkeConfigId(0), b"enc", b"payload"),
+            )
+        )
+
+    ds.run_tx(provision)
+    return task
+
+
+def test_health_sampler_exports_backlog_lag_and_lease_age():
+    from janus_tpu.aggregator.health_sampler import HealthSampler, _b64_task_id
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration
+
+    eph = EphemeralDatastore()
+    try:
+        ds = eph.datastore
+        task = _provision_backlog(ds, eph.clock)
+        sampler = HealthSampler(ds, interval_s=0.1)
+        snap = sampler.run_once()
+        assert snap["jobs"]["aggregation/in_progress"] == 1
+        assert snap["jobs"]["collection/start"] == 0  # zero-filled
+        assert m.jobs_gauge.get(type="aggregation", state="in_progress") == 1.0
+        label = _b64_task_id(task.task_id.data)
+        assert snap["oldest_unaggregated_report_age_seconds"][label] == 500.0
+        assert (
+            m.oldest_unaggregated_report_age_seconds.get(task_id=label) == 500.0
+        )
+
+        # lease age: acquire a lease, then advance the clock — age is
+        # measured from first observation
+        acquired = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        assert len(acquired) == 1
+        sampler.run_once()
+        assert m.job_lease_age_seconds.get() == 0.0
+        eph.clock.advance(Duration(30))
+        snap = sampler.run_once()
+        assert snap["max_lease_age_seconds"] == 30
+        assert m.job_lease_age_seconds.get() == 30.0
+
+        # releasing the lease drops the age back to zero
+        ds.run_tx(lambda tx: tx.release_aggregation_job(acquired[0]))
+        snap = sampler.run_once()
+        assert snap["max_lease_age_seconds"] == 0
+
+        # the report getting claimed clears the per-task lag gauge
+        ds.run_tx(
+            lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 10)
+        )
+        snap = sampler.run_once()
+        assert label not in snap["oldest_unaggregated_report_age_seconds"]
+        assert m.oldest_unaggregated_report_age_seconds.get(task_id=label) == 0.0
+    finally:
+        eph.cleanup()
+
+
+def test_accumulator_counts_reports_at_accumulate_time():
+    from janus_tpu.aggregator.accumulator import Accumulator
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import ReportId, Time
+
+    eph = EphemeralDatastore()
+    try:
+        task = _provision_backlog(eph.datastore, eph.clock)
+        label = m.task_id_label(task.task_id.data)
+        before = m.task_reports_aggregated_total.get(task_id=label)
+        acc = Accumulator(task)
+        acc.update_single(b"batch", [1], ReportId(b"\x09" * 16), Time(0))
+        acc.update_single(b"batch", [1], ReportId(b"\x0a" * 16), Time(0))
+        assert m.task_reports_aggregated_total.get(task_id=label) - before == 2
+    finally:
+        eph.cleanup()

@@ -128,6 +128,27 @@ def test_bench_dry_run_smoke():
     assert ingest["shed_counter_delta"] == ingest["shed"]  # all accounted
     assert ingest["retry_after_present"] is True
     assert ingest["committed_exactly_once"] is True
+    # observability (ISSUE 3): the span hot path is measured, not
+    # assumed, and the full metrics/statusz/profile surface works over
+    # HTTP against a live health listener
+    overhead = rec["tracing_overhead"]
+    assert overhead["disabled_rps"] > 0 and overhead["spans_per_iter"] == 4
+    # measured, not assumed; a generous bound — on a loaded 2-core
+    # host scheduling noise swings the ratio, and the record's job is
+    # to carry the real numbers, not to gate on them
+    assert 0 < overhead["disabled_vs_baseline"] < 2.0
+    assert overhead["chrome_rps"] > 0 and overhead["otlp_rps"] > 0
+    obs = rec["observability_smoke"]
+    assert obs["scrape_valid"] is True, obs.get("scrape_errors")
+    assert obs["engine_dispatch_samples"] > 0  # non-zero dispatch histogram
+    assert obs["jobs_in_progress"] == 1.0  # non-zero janus_jobs sample
+    assert obs["hostile_label_roundtrip"] is True  # '"' and '\n' in a label
+    assert obs["statusz_tasks"] == 1
+    assert obs["statusz_engine_cache_entries"] >= 1
+    assert obs["statusz_job_health_present"] is True
+    assert obs["profile_status_codes"] == [200, 409]  # concurrent capture 409s
+    assert obs["profile_host_trace_loadable"] is True
+    assert obs["scrape_check_rc"] == 0, obs.get("scrape_check_err")
 
 
 def test_collect_cli_end_to_end(capsys):
