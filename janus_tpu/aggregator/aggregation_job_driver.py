@@ -23,7 +23,14 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..core.retries import Backoff, retry_http_request
+from ..core.circuit_breaker import (
+    CircuitBreakerConfig,
+    CircuitOpenError,
+    OutboundCircuitBreakers,
+    default_breakers,
+    peer_label,
+)
+from ..core.retries import Backoff, RequestAborted, retry_http_request
 from ..datastore.models import (
     AcquiredAggregationJob,
     AggregationJobState,
@@ -80,15 +87,36 @@ class AggregationJobDriverConfig:
     # (reference job_driver.rs:191-196) so a hung helper can't outlive
     # the lease and run the job concurrently with a re-acquirer
     worker_lease_clock_skew_s: int = 60
+    # leader->helper outbound circuit breaker (core/circuit_breaker.py;
+    # YAML outbound_circuit_breaker: section)
+    circuit_breaker: CircuitBreakerConfig | None = None
+    # floor for the breaker-open step-back reacquire delay so a job
+    # whose cooldown is nearly over doesn't spin acquire/step-back
+    min_step_back_delay_s: int = 1
 
 
 class AggregationJobDriver:
     """reference aggregation_job_driver.rs:49."""
 
-    def __init__(self, ds: Datastore, http, cfg: AggregationJobDriverConfig | None = None):
+    def __init__(
+        self,
+        ds: Datastore,
+        http,
+        cfg: AggregationJobDriverConfig | None = None,
+        breakers: OutboundCircuitBreakers | None = None,
+        stopper=None,
+    ):
         self.ds = ds
         self.http = http
         self.cfg = cfg or AggregationJobDriverConfig()
+        # per-peer circuit breaker shared process-wide by default (the
+        # collection driver sees the same helper health)
+        self.breakers = (
+            breakers if breakers is not None else default_breakers(self.cfg.circuit_breaker)
+        )
+        # shutdown Stopper: in-flight helper retries abort on SIGTERM so
+        # the step can step back instead of spending the whole lease
+        self.stopper = stopper
 
     # --- JobDriver callbacks (reference :840-894) ---
     def acquirer(self, lease_duration_s: int = 600):
@@ -115,6 +143,18 @@ class AggregationJobDriver:
             return
         try:
             self.step_aggregation_job(acquired)
+        except CircuitOpenError as e:
+            # the helper's circuit is open: not this job's fault — step
+            # back (release the lease with the cooldown as backoff,
+            # refund the attempt) instead of failing the step
+            self.step_back(
+                acquired,
+                "circuit_open",
+                max(e.retry_in_s, self.cfg.min_step_back_delay_s),
+            )
+        except RequestAborted:
+            # shutdown drain: hand the lease back immediately
+            self.step_back(acquired, "shutdown_drain", 0.0)
         except Exception:
             log.exception(
                 "aggregation job %s step failed (attempt %d)",
@@ -122,6 +162,31 @@ class AggregationJobDriver:
                 acquired.lease.attempts,
             )
             raise
+
+    def step_back(
+        self, acquired: AcquiredAggregationJob, reason: str, delay_s: float
+    ) -> None:
+        """Release the lease early (reacquirable after delay_s, attempt
+        refunded) — a breaker-open helper or a draining process must
+        neither burn lease TTLs nor march the job toward abandonment."""
+        from ..datastore.store import TxConflict
+
+        delay = max(0, int(delay_s))
+        log.warning(
+            "stepping back aggregation job %s (%s): lease released, reacquirable in %ds",
+            acquired.job_id, reason, delay,
+        )
+        metrics.job_step_back_total.add(reason=reason)
+        try:
+            self.ds.run_tx(
+                lambda tx: tx.step_back_aggregation_job(
+                    acquired, reacquire_delay_s=delay, count_attempt=False
+                ),
+                "step_back_agg_job",
+            )
+        except TxConflict:
+            # lease already lost (expired / re-acquired): nothing to return
+            log.info("step-back of %s found the lease already gone", acquired.job_id)
 
     def _stage_pending(self, task, wire, engine, pending, reports):
         """Columnar staging of stored leader shares -> device-ready
@@ -644,18 +709,40 @@ class AggregationJobDriver:
         headers = {"Content-Type": req.MEDIA_TYPE, **(extra_headers or {})}
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
+        peer = peer_label(task.helper_aggregator_endpoint)
 
         def attempt():
+            # circuit gate per ATTEMPT: a breaker opened by a concurrent
+            # step aborts this retry loop too (CircuitOpenError is not a
+            # transport error, so retry_http_request lets it propagate)
+            self.breakers.check(peer)
             # go through put/post (not request) so test doubles that
             # wrap those verbs see the traffic; the trailing headers
             # element lets a shedding helper's Retry-After pace retries
             fn = self.http.put if method == "PUT" else self.http.post
-            status, body = fn(
-                url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
-            )
+            try:
+                status, body = fn(
+                    url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
+                )
+            except BaseException:
+                # transport failure (or anything else before a response):
+                # the breaker must learn of it AND free a half-open probe
+                self.breakers.record_failure(peer)
+                raise
+            # 5xx = the peer is failing; anything conclusive (2xx/4xx,
+            # incl. problem documents) or shedding (429) = alive
+            if 500 <= status < 600:
+                self.breakers.record_failure(peer)
+            else:
+                self.breakers.record_success(peer)
             return status, body, getattr(self.http, "last_response_headers", {})
 
-        status, body = retry_http_request(attempt, self.cfg.http_backoff, deadline=deadline)
+        status, body = retry_http_request(
+            attempt,
+            self.cfg.http_backoff,
+            deadline=deadline,
+            should_abort=(lambda: self.stopper.stopped) if self.stopper is not None else None,
+        )
         if status not in (200, 201):
             raise RuntimeError(
                 f"helper {method} aggregation job failed: HTTP {status}: {body[:300]!r}"

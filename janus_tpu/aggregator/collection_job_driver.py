@@ -13,7 +13,14 @@ import dataclasses
 import logging
 from dataclasses import dataclass
 
-from ..core.retries import Backoff, retry_http_request
+from ..core.circuit_breaker import (
+    CircuitBreakerConfig,
+    CircuitOpenError,
+    OutboundCircuitBreakers,
+    default_breakers,
+    peer_label,
+)
+from ..core.retries import Backoff, RequestAborted, retry_http_request
 from ..datastore.models import (
     AcquiredCollectionJob,
     AggregateShareJob,
@@ -45,15 +52,29 @@ class CollectionJobDriverConfig:
     http_backoff: Backoff = Backoff()
     # see AggregationJobDriverConfig.worker_lease_clock_skew_s
     worker_lease_clock_skew_s: int = 60
+    # see AggregationJobDriverConfig.circuit_breaker / min_step_back_delay_s
+    circuit_breaker: CircuitBreakerConfig | None = None
+    min_step_back_delay_s: int = 1
 
 
 class CollectionJobDriver:
     """reference collection_job_driver.rs:40."""
 
-    def __init__(self, ds: Datastore, http, cfg: CollectionJobDriverConfig | None = None):
+    def __init__(
+        self,
+        ds: Datastore,
+        http,
+        cfg: CollectionJobDriverConfig | None = None,
+        breakers: OutboundCircuitBreakers | None = None,
+        stopper=None,
+    ):
         self.ds = ds
         self.http = http
         self.cfg = cfg or CollectionJobDriverConfig()
+        self.breakers = (
+            breakers if breakers is not None else default_breakers(self.cfg.circuit_breaker)
+        )
+        self.stopper = stopper
 
     def acquirer(self, lease_duration_s: int = 600):
         def acquire(limit: int):
@@ -70,7 +91,42 @@ class CollectionJobDriver:
         if acquired.lease.attempts > self.cfg.maximum_attempts_before_failure:
             self.abandon_job(acquired)
             return
-        self.step_collection_job(acquired)
+        try:
+            self.step_collection_job(acquired)
+        except CircuitOpenError as e:
+            self.step_back(
+                acquired,
+                "circuit_open",
+                max(e.retry_in_s, self.cfg.min_step_back_delay_s),
+            )
+        except RequestAborted:
+            self.step_back(acquired, "shutdown_drain", 0.0)
+
+    def step_back(
+        self, acquired: AcquiredCollectionJob, reason: str, delay_s: float
+    ) -> None:
+        """See AggregationJobDriver.step_back: early lease release with
+        a reacquire delay, attempt refunded."""
+        from ..datastore.store import TxConflict
+
+        delay = max(0, int(delay_s))
+        log.warning(
+            "stepping back collection job %s (%s): lease released, reacquirable in %ds",
+            acquired.collection_job_id, reason, delay,
+        )
+        metrics.job_step_back_total.add(reason=reason)
+        try:
+            self.ds.run_tx(
+                lambda tx: tx.step_back_collection_job(
+                    acquired, reacquire_delay_s=delay, count_attempt=False
+                ),
+                "step_back_collection_job",
+            )
+        except TxConflict:
+            log.info(
+                "step-back of %s found the lease already gone",
+                acquired.collection_job_id,
+            )
 
     def step_collection_job(self, acquired: AcquiredCollectionJob) -> None:
         """reference step_collection_job_generic :108-300."""
@@ -279,16 +335,32 @@ class CollectionJobDriver:
         headers = {"Content-Type": AggregateShareReq.MEDIA_TYPE}
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
+        peer = peer_label(task.helper_aggregator_endpoint)
 
         def attempt():
-            # trailing headers element: a shedding helper's Retry-After
-            # paces the retry loop (core/retries.py)
-            status, body = self.http.post(
-                url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
-            )
+            # circuit gate per attempt; see aggregation_job_driver.py
+            self.breakers.check(peer)
+            try:
+                # trailing headers element: a shedding helper's
+                # Retry-After paces the retry loop (core/retries.py)
+                status, body = self.http.post(
+                    url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
+                )
+            except BaseException:
+                self.breakers.record_failure(peer)
+                raise
+            if 500 <= status < 600:
+                self.breakers.record_failure(peer)
+            else:
+                self.breakers.record_success(peer)
             return status, body, getattr(self.http, "last_response_headers", {})
 
-        status, body = retry_http_request(attempt, self.cfg.http_backoff, deadline=deadline)
+        status, body = retry_http_request(
+            attempt,
+            self.cfg.http_backoff,
+            deadline=deadline,
+            should_abort=(lambda: self.stopper.stopped) if self.stopper is not None else None,
+        )
         if status != 200:
             raise RuntimeError(f"helper aggregate share failed: HTTP {status}: {body[:300]!r}")
         return AggregateShare.from_bytes(body)

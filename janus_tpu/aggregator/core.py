@@ -13,7 +13,7 @@ import hashlib
 import secrets
 from dataclasses import dataclass, field
 
-from .. import metrics
+from .. import failpoints, metrics
 from ..core.hpke import HpkeApplicationInfo, HpkeError, Label, hpke_open, hpke_seal
 from ..core.time_util import Clock, RealClock
 from ..datastore.models import (
@@ -274,6 +274,10 @@ class TaskAggregator:
         request_bytes: bytes,
     ) -> AggregationJobResp:
         task = self.task
+        # helper-outage injection: an unhandled FailpointError here is a
+        # 500 to the leader driver over real HTTP — the chaos harness's
+        # "helper 5xx storm" (docs/ROBUSTNESS.md); the breaker counts it
+        failpoints.hit("helper.aggregate")
         request_hash = hashlib.sha256(request_bytes).digest()
 
         # idempotent replay (reference :1585,1884,1526)
@@ -1085,6 +1089,7 @@ class TaskAggregator:
     # ------------------------------------------------------------------
     def handle_aggregate_share(self, ds: Datastore, req: AggregateShareReq) -> AggregateShare:
         task = self.task
+        failpoints.hit("helper.aggregate_share")
         if req.batch_selector.query_type != task.query_type.code:
             raise errors.InvalidMessage("query type mismatch", task.task_id)
         if req.batch_selector.query_type == TimeInterval.CODE:

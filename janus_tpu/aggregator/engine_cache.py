@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import failpoints
 from ..vdaf.engine import STREAM_MIN_INPUT_LEN, stream_plan
 from ..vdaf.feasibility import device_memory_budget, feasible_bucket
 from ..vdaf.registry import VdafInstance, prio3_batched
@@ -347,6 +348,19 @@ def _split_rows(value, offsets):
             tuple(x[s:e] for x in value) for s, e in zip(offsets, offsets[1:])
         ]
     return [value[s:e] for s, e in zip(offsets, offsets[1:])]
+
+
+def _engine_dispatch_failpoint() -> None:
+    """`engine.dispatch` failpoint at the top of every device dispatch:
+    the oom action raises a RESOURCE_EXHAUSTED-shaped error so the
+    injected fault rides the REAL recovery path (_handle_engine_error's
+    halved-bucket retry / host fallback), exactly like a device OOM."""
+    failpoints.hit(
+        "engine.dispatch",
+        error_factory=lambda: RuntimeError(
+            "RESOURCE_EXHAUSTED: injected failpoint engine.dispatch"
+        ),
+    )
 
 
 class EngineCache:
@@ -760,6 +774,7 @@ class EngineCache:
         self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask,
         coalesced: int = 0,
     ):
+        _engine_dispatch_failpoint()
         p3 = self.p3
         n = nonce_lanes.shape[0]
         cap = self.bucket_cap  # read once — concurrent OOM recovery may
@@ -895,6 +910,7 @@ class EngineCache:
         coalesced: int = 0,
         allow_pipeline: bool = True,
     ):
+        _engine_dispatch_failpoint()
         p3 = self.p3
         n = nonce_lanes.shape[0]
         cap = self.bucket_cap
@@ -1099,6 +1115,7 @@ class EngineCache:
                 self._handle_engine_error(e, n)
 
     def _aggregate_inner(self, out_shares, mask):
+        _engine_dispatch_failpoint()
         p3 = self.p3
 
         if isinstance(out_shares, DeviceRowsChunks):

@@ -72,11 +72,23 @@ class JobDriver:
     stepper(acquired) -> None (owns release/cancel).
     """
 
-    def __init__(self, cfg: JobDriverConfig, acquirer, stepper, stopper: Stopper | None = None):
+    def __init__(
+        self,
+        cfg: JobDriverConfig,
+        acquirer,
+        stepper,
+        stopper: Stopper | None = None,
+        releaser=None,
+    ):
         self.cfg = cfg
         self.acquirer = acquirer
         self.stepper = stepper
         self.stopper = stopper or Stopper()
+        # optional releaser(acquired): called when a step fails during
+        # shutdown drain so the lease is handed back immediately instead
+        # of aging out a full TTL on the surviving peer (the drivers
+        # pass their step_back, which preserves the attempt ledger)
+        self.releaser = releaser
 
     def run_once(self) -> int:
         """One acquire+step pass (barrier semantics — tests and one-shot
@@ -97,7 +109,17 @@ class JobDriver:
             with span("job.step", job=type(acquired).__name__):
                 self.stepper(acquired)
         except Exception:
-            log.exception("job step failed (lease will expire and retry)")
+            if self.stopper.stopped and self.releaser is not None:
+                # shutdown drain: this process will not retry — release
+                # the lease now so a surviving peer picks the job up
+                # immediately instead of after the lease TTL
+                log.exception("job step failed during shutdown; releasing lease")
+                try:
+                    self.releaser(acquired)
+                except Exception:
+                    log.exception("shutdown lease release failed")
+            else:
+                log.exception("job step failed (lease will expire and retry)")
 
     def run(self) -> None:
         """Streaming discovery loop until stopped: acquire as worker

@@ -127,9 +127,20 @@ class ReportWriteBatcher:
 
     def _flush(self, batch: list[_Pending]) -> None:
         """One transaction for the whole batch (reference :96-165)."""
+        from .. import failpoints
         from ..trace import span
 
         try:
+            # flush-failure injection: the whole batch's waiters must see
+            # the error (fan-out below), and the upload handlers must map
+            # it to a 500 problem document, never a silent 201
+            failpoints.hit(
+                "report_writer.flush",
+                error_factory=lambda: RuntimeError(
+                    "injected flush failure (failpoint report_writer.flush)"
+                ),
+            )
+
             def tx_fn(tx):
                 return [tx.put_client_report(p.report) for p in batch]
 

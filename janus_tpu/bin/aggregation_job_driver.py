@@ -28,14 +28,21 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         ds,
         HttpClient(),
         AggregationJobDriverConfig(
-            maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure
+            maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
+            circuit_breaker=cfg.outbound_circuit_breaker,
         ),
+        # in-flight helper retries observe SIGTERM and step back instead
+        # of spending the remaining lease on a dead peer
+        stopper=stopper,
     )
     jd = JobDriver(
         cfg.job_driver,
         driver.acquirer(cfg.job_driver.worker_lease_duration_s),
         driver.stepper,
         stopper,
+        # a step failing during shutdown releases its lease immediately
+        # (reacquirable by the surviving peer, attempts preserved)
+        releaser=lambda acquired: driver.step_back(acquired, "shutdown_drain", 0.0),
     )
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:

@@ -28,14 +28,17 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         ds,
         HttpClient(),
         CollectionJobDriverConfig(
-            maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure
+            maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
+            circuit_breaker=cfg.outbound_circuit_breaker,
         ),
+        stopper=stopper,
     )
     jd = JobDriver(
         cfg.job_driver,
         driver.acquirer(cfg.job_driver.worker_lease_duration_s),
         driver.stepper,
         stopper,
+        releaser=lambda acquired: driver.step_back(acquired, "shutdown_drain", 0.0),
     )
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:

@@ -396,6 +396,15 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
     common: CommonConfig = cfg.common
     install_trace_subscriber(common.logging_config)
 
+    # fault injection: JANUS_FAILPOINTS env wins over the YAML
+    # `failpoints:` key; unset/empty compiles every site to a no-op.
+    # Always on /statusz so an operator can see at a glance whether a
+    # process is running with injected faults (docs/ROBUSTNESS.md).
+    from . import failpoints
+
+    failpoints.configure_from_env(default=common.failpoints)
+    register_status_provider("failpoints", failpoints.status)
+
     if common.jax_platform:
         os.environ["JAX_PLATFORMS"] = common.jax_platform
         try:

@@ -16,6 +16,7 @@ import yaml
 from .aggregator import Config as AggregatorProtocolConfig
 from .aggregator.aggregation_job_creator import AggregationJobCreatorConfig
 from .aggregator.job_driver import JobDriverConfig
+from .core.circuit_breaker import CircuitBreakerConfig
 from .trace import TraceConfiguration
 
 
@@ -79,6 +80,12 @@ class CommonConfig:
     # janus_jobs backlog gauges, lease age, aggregation lag). 0 disables.
     # Wired by the aggregator server and both job driver binaries.
     health_sampler_interval_s: float = 15.0
+    # Fault injection (janus_tpu/failpoints.py; docs/ROBUSTNESS.md): a
+    # spec string ("datastore.commit=error:0.3;helper.request=delay:2")
+    # or a {name: "action:arg,..."} mapping. The JANUS_FAILPOINTS env
+    # var overrides. None (the default) arms nothing and every
+    # instrumented site compiles to a one-flag-check no-op.
+    failpoints: object = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -93,6 +100,7 @@ class CommonConfig:
             warmup_engines_at_boot=bool(d.get("warmup_engines_at_boot", False)),
             warmup_buckets=tuple(int(b) for b in d.get("warmup_buckets", ())),
             health_sampler_interval_s=float(d.get("health_sampler_interval_secs", 15.0)),
+            failpoints=d.get("failpoints"),
         )
 
 
@@ -232,12 +240,20 @@ class JobDriverBinaryConfig:
 
     common: CommonConfig = field(default_factory=CommonConfig)
     job_driver: JobDriverConfig = field(default_factory=JobDriverConfig)
+    # leader->helper outbound circuit breaker knobs (YAML
+    # `outbound_circuit_breaker:` section; docs/ROBUSTNESS.md)
+    outbound_circuit_breaker: CircuitBreakerConfig = field(
+        default_factory=CircuitBreakerConfig
+    )
 
     @classmethod
     def from_dict(cls, d: dict) -> "JobDriverBinaryConfig":
         return cls(
             common=CommonConfig.from_dict(d),
             job_driver=_job_driver_from_dict(d),
+            outbound_circuit_breaker=CircuitBreakerConfig.from_dict(
+                d.get("outbound_circuit_breaker")
+            ),
         )
 
 

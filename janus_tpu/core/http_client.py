@@ -5,9 +5,23 @@ send_request_to_helper); this wraps urllib for the same purpose.
 
 from __future__ import annotations
 
+import socket
 import threading
 import urllib.error
 import urllib.request
+
+from .. import failpoints
+
+
+def _injected_transport_error() -> urllib.error.URLError:
+    return urllib.error.URLError("injected transport error (failpoint helper.request)")
+
+
+def _injected_timeout() -> urllib.error.URLError:
+    # what a real socket timeout looks like through urllib: a URLError
+    # wrapping socket.timeout (an OSError), so retry loops treat it as
+    # any other transport failure
+    return urllib.error.URLError(socket.timeout("injected timeout (failpoint)"))
 
 
 class HttpClient:
@@ -37,6 +51,19 @@ class HttpClient:
         headers: dict | None = None,
         timeout: float | None = None,
     ):
+        # clear this thread's previous response headers FIRST: a thrown
+        # URLError below would otherwise leave the prior response's
+        # headers visible, and retry_http_request could honor a stale
+        # Retry-After from an earlier attempt
+        self.last_response_headers = {}
+        # fault injection for the whole outbound path (error = transport
+        # failure, delay = slow WAN, timeout = hung peer, crash = the
+        # process dies mid-request); docs/ROBUSTNESS.md
+        failpoints.hit(
+            "helper.request",
+            error_factory=_injected_transport_error,
+            timeout_factory=_injected_timeout,
+        )
         headers = dict(headers or {})
         if not any(k.lower() == "traceparent" for k in headers):
             from ..trace import current_traceparent
@@ -50,10 +77,21 @@ class HttpClient:
                 req, timeout=self.timeout if timeout is None else min(self.timeout, timeout)
             ) as resp:
                 self.last_response_headers = dict(resp.headers.items())
+                # slow-body injection: the peer answered but trickles
+                # the payload
+                failpoints.hit("helper.response", timeout_factory=_injected_timeout)
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             self.last_response_headers = dict(e.headers.items())
-            return e.code, e.read()
+            try:
+                err_body = e.read()
+            except OSError as read_err:
+                # connection reset while draining the error body: this
+                # is a transport failure, not a conclusive response —
+                # surface it as a retryable URLError instead of letting
+                # a raw ConnectionResetError escape the retry loop
+                raise urllib.error.URLError(read_err) from read_err
+            return e.code, err_body
 
     def get(self, url: str, headers: dict | None = None, timeout: float | None = None):
         return self.request("GET", url, None, headers, timeout)

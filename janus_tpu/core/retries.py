@@ -12,6 +12,14 @@ import random
 import time
 from dataclasses import dataclass
 
+from .. import failpoints
+
+
+class RequestAborted(Exception):
+    """The caller's should_abort() tripped mid-retry (driver shutdown
+    drain): the request is abandoned without a conclusive response so
+    the job step can step back and release its lease immediately."""
+
 
 class DeadlineExceeded(TimeoutError):
     """The retry deadline (lease bound) tripped before a conclusive
@@ -72,7 +80,11 @@ def _retry_after_from(headers) -> float | None:
 
 
 def retry_http_request(
-    do_request, backoff: Backoff = Backoff(), sleep=time.sleep, deadline: float | None = None
+    do_request,
+    backoff: Backoff = Backoff(),
+    sleep=time.sleep,
+    deadline: float | None = None,
+    should_abort=None,
 ):
     """Call do_request() until success or budget exhausted.
 
@@ -96,12 +108,20 @@ def retry_http_request(
     Raises DeadlineExceeded (a TimeoutError) if the deadline passes
     before any conclusive response — a stale retryable (status, body)
     from an earlier attempt is never returned as if conclusive.
+
+    should_abort: optional callable checked before every attempt and
+    every backoff sleep; when it returns True the loop raises
+    RequestAborted instead of spending more of the budget (the job
+    drivers pass the shutdown Stopper so SIGTERM drains in-flight
+    steps instead of retrying a dead helper through a full lease).
     """
     interval = backoff.initial
     elapsed = 0.0
     last_exc = None
     status = body = None
     while True:
+        if should_abort is not None and should_abort():
+            raise RequestAborted("request abandoned (shutdown drain)")
         if deadline is not None and time.monotonic() >= deadline:
             if last_exc is not None:
                 raise last_exc
@@ -110,6 +130,14 @@ def retry_http_request(
             )
         retry_after = None
         try:
+            # inside the try: an injected transport error is retried
+            # exactly like a real one
+            failpoints.hit(
+                "retry.attempt",
+                error_factory=lambda: OSError(
+                    "injected transport error (failpoint retry.attempt)"
+                ),
+            )
             result = do_request()
             status, body = result[0], result[1]
             if not is_retryable_status(status):
@@ -146,6 +174,8 @@ def retry_http_request(
             )
         if retry_after is None:
             next_delay = interval * (1 + random.uniform(-backoff.jitter, backoff.jitter))
+        if should_abort is not None and should_abort():
+            raise RequestAborted("request abandoned (shutdown drain)")
         sleep(next_delay)
         elapsed += next_delay
         interval = min(interval * backoff.multiplier, backoff.max_interval)

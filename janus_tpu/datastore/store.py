@@ -619,6 +619,46 @@ class Transaction:
         if cur.rowcount != 1:
             raise TxConflict("lease token mismatch on release")
 
+    def step_back_aggregation_job(
+        self,
+        acquired: AcquiredAggregationJob,
+        reacquire_delay_s: int = 0,
+        count_attempt: bool = False,
+    ) -> None:
+        """Early lease release without resetting the attempt ledger (the
+        difference from release_aggregation_job, whose lease_attempts=0
+        is 'this step SUCCEEDED'): the job becomes reacquirable after
+        `reacquire_delay_s` instead of aging out a full lease TTL.
+
+        Used when the step could not run through no fault of the job —
+        outbound circuit open to the helper (wait out the cooldown) or
+        shutdown drain (delay 0: the surviving peer picks it up
+        immediately). count_attempt=False refunds the acquire's
+        lease_attempts increment so a helper outage cannot march jobs
+        to abandonment; True keeps it counted (a genuinely failed step
+        released early). Raises TxConflict if the lease was lost."""
+        now = self._clock.now().seconds
+        # CASE instead of MAX/GREATEST: scalar max() is sqlite-only and
+        # GREATEST needs sqlite >= 3.44 / postgres — CASE runs on both
+        attempts_sql = (
+            "lease_attempts"
+            if count_attempt
+            else "CASE WHEN lease_attempts > 0 THEN lease_attempts - 1 ELSE 0 END"
+        )
+        cur = self._c.execute(
+            "UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = NULL,"
+            f" lease_attempts = {attempts_sql}"
+            " WHERE task_id = ? AND job_id = ? AND lease_token = ?",
+            (
+                now + max(0, int(reacquire_delay_s)),
+                acquired.task_id.data,
+                acquired.job_id.data,
+                acquired.lease.token,
+            ),
+        )
+        if cur.rowcount != 1:
+            raise TxConflict("lease token mismatch on step-back")
+
     # ---- report aggregations (reference datastore.rs:2052-2455) ----
     def put_report_aggregation(self, ra: ReportAggregationModel) -> None:
         row_key = ra.task_id.data + ra.job_id.data + ra.ord.to_bytes(8, "big")
@@ -1046,6 +1086,36 @@ class Transaction:
         if cur.rowcount != 1:
             raise TxConflict("lease token mismatch on release")
 
+    def step_back_collection_job(
+        self,
+        acquired: AcquiredCollectionJob,
+        reacquire_delay_s: int = 0,
+        count_attempt: bool = False,
+    ) -> None:
+        """Collection-job analog of step_back_aggregation_job (early
+        release with a reacquire delay, attempts preserved/refunded)."""
+        now = self._clock.now().seconds
+        # CASE instead of MAX/GREATEST: scalar max() is sqlite-only and
+        # GREATEST needs sqlite >= 3.44 / postgres — CASE runs on both
+        attempts_sql = (
+            "lease_attempts"
+            if count_attempt
+            else "CASE WHEN lease_attempts > 0 THEN lease_attempts - 1 ELSE 0 END"
+        )
+        cur = self._c.execute(
+            "UPDATE collection_jobs SET lease_expiry = ?, lease_token = NULL,"
+            f" lease_attempts = {attempts_sql}"
+            " WHERE task_id = ? AND collection_job_id = ? AND lease_token = ?",
+            (
+                now + max(0, int(reacquire_delay_s)),
+                acquired.task_id.data,
+                acquired.collection_job_id.data,
+                acquired.lease.token,
+            ),
+        )
+        if cur.rowcount != 1:
+            raise TxConflict("lease token mismatch on step-back")
+
     # ---- aggregate share jobs (reference datastore.rs:3369-3706) ----
     def put_aggregate_share_job(self, job: AggregateShareJob) -> None:
         row_key = job.task_id.data + job.batch_identifier
@@ -1432,17 +1502,35 @@ class Datastore:
 
     def run_tx(self, fn, name: str = "tx"):
         """Run fn(Transaction) with retry on busy/conflict
-        (reference run_tx_with_name, datastore.rs:216-242)."""
-        from .. import metrics
+        (reference run_tx_with_name, datastore.rs:216-242).
+
+        Fault-injection seams (janus_tpu.failpoints, scoped by tx name
+        so a schedule can target one transaction): `datastore.tx_begin`
+        right after BEGIN, `datastore.commit` immediately before the
+        commit (a crash here is the classic mid-commit death: work done,
+        nothing durable), and `datastore.post_commit` after the commit
+        but before the result reaches the caller (a crash here models
+        dying after the DB committed but before anyone was acked — the
+        retry/idempotency story the chaos harness proves). The error
+        action raises TxConflict, i.e. a retryable conflict: run_tx's
+        own retry loop must absorb injected commit failures the same
+        way it absorbs real serialization failures."""
+        from .. import failpoints, metrics
+
+        def _inj() -> TxConflict:
+            return TxConflict(f"injected conflict (failpoint, tx={name})")
 
         start = _time.monotonic()
         for attempt in range(self.MAX_RETRIES):
             conn = self._connect()
             try:
                 self._begin(conn)
+                failpoints.hit_scoped("datastore.tx_begin", name, error_factory=_inj)
                 tx = self._tx_obj(conn)
                 result = fn(tx)
+                failpoints.hit_scoped("datastore.commit", name, error_factory=_inj)
                 conn.commit()
+                failpoints.hit_scoped("datastore.post_commit", name, error_factory=_inj)
                 elapsed = _time.monotonic() - start
                 metrics.tx_duration.observe(elapsed, tx=name)
                 if 0 < self.slow_tx_warn_s < elapsed:

@@ -1,0 +1,290 @@
+"""First-class fault injection: a registry of named failpoints.
+
+A DAP deployment's steady state includes helper outages, slow WANs and
+mid-commit crashes; this module lets tests, the chaos harness
+(scripts/chaos_run.py) and operators provoke those failures
+deterministically at the exact seams where they happen in production
+(docs/ROBUSTNESS.md has the full fault matrix).
+
+Configuration — the `JANUS_FAILPOINTS` environment variable or the
+`failpoints:` key of the common YAML config section (env wins):
+
+    JANUS_FAILPOINTS='datastore.commit=error:0.3;helper.request=delay:2.0,count=5;engine.dispatch=oom:1'
+
+Grammar (';'-separated entries):
+
+    <name>=<action>[:<arg>][,prob=<P>][,count=<N>]
+
+Actions:
+
+    error[:P]    raise at the site with probability P (default 1.0).
+                 The site chooses the exception type so the injected
+                 failure is indistinguishable from the real one (a
+                 retryable transport error at the HTTP client, a
+                 retryable conflict in run_tx, ...).
+    delay[:S]    sleep S seconds (default 1.0), then continue — a slow
+                 WAN / slow response body.
+    timeout[:S]  sleep S seconds (default 1.0), then raise the site's
+                 timeout error — a hung peer that eventually trips the
+                 socket timeout.
+    crash[:P]    os._exit(CRASH_EXIT_CODE) with probability P — the
+                 moral equivalent of SIGKILL at this exact line; no
+                 finally blocks, no flushes, no transaction rollback.
+    oom[:P]      raise a RESOURCE_EXHAUSTED-shaped error so the engine
+                 OOM-recovery path (halved-bucket retry, host fallback)
+                 takes over.
+
+Modifiers: `prob=P` overrides the firing probability regardless of
+action arg; `count=N` is a firing budget — after N firings the
+failpoint goes inert (failures that storm and then clear).
+
+Scoped names: sites that serve many logical operations fire both their
+base name and a scoped variant — run_tx fires `datastore.commit` and
+`datastore.commit.<tx_name>` — so a schedule can target one transaction
+("crash the leader's aggregation write, nothing else").
+
+Cost when disabled: `hit()` is a single module-flag check (measured in
+the bench --dry-run `failpoint_overhead` record); the registry compiles
+to a no-op on every production hot path unless explicitly armed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+# Distinctive exit status for the crash action so harnesses can tell an
+# injected crash from a real one.
+CRASH_EXIT_CODE = 77
+
+_ACTIONS = ("error", "delay", "timeout", "crash", "oom")
+
+
+class FailpointError(Exception):
+    """Deliberately injected failure (the default when a site does not
+    supply a more realistic exception type)."""
+
+
+class FailpointSpecError(ValueError):
+    """A JANUS_FAILPOINTS / YAML failpoint spec did not parse."""
+
+
+class _Failpoint:
+    __slots__ = ("name", "action", "arg", "prob", "count", "fired")
+
+    def __init__(self, name: str, action: str, arg: float, prob: float, count: int | None):
+        self.name = name
+        self.action = action
+        self.arg = arg
+        self.prob = prob
+        self.count = count  # None = unlimited
+        self.fired = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "action": self.action,
+            "arg": self.arg,
+            "prob": self.prob,
+            "count": self.count,
+            "fired": self.fired,
+        }
+
+
+# ENABLED is THE hot-path flag: hit() returns after one check when no
+# failpoint is armed. Everything else is guarded by _lock.
+ENABLED = False
+_lock = threading.Lock()
+_registry: dict[str, _Failpoint] = {}
+# deterministic under JANUS_FAILPOINTS_SEED (chaos schedules that want
+# reproducible probabilistic faults), process-random otherwise
+_rng = random.Random(
+    int(os.environ["JANUS_FAILPOINTS_SEED"])
+    if os.environ.get("JANUS_FAILPOINTS_SEED")
+    else None
+)
+
+
+def _parse_one(name: str, body: str) -> _Failpoint:
+    parts = [p.strip() for p in body.split(",") if p.strip()]
+    if not parts:
+        raise FailpointSpecError(f"failpoint {name!r}: empty action")
+    action, _, raw_arg = parts[0].partition(":")
+    action = action.strip()
+    if action not in _ACTIONS:
+        raise FailpointSpecError(
+            f"failpoint {name!r}: unknown action {action!r} (expected one of {_ACTIONS})"
+        )
+    try:
+        arg = float(raw_arg) if raw_arg else 1.0
+    except ValueError:
+        raise FailpointSpecError(f"failpoint {name!r}: bad action arg {raw_arg!r}") from None
+    # for error/crash/oom the positional arg IS the probability; for
+    # delay/timeout it is seconds and prob defaults to always
+    prob = arg if action in ("error", "crash", "oom") else 1.0
+    count = None
+    for mod in parts[1:]:
+        key, _, val = mod.partition("=")
+        key = key.strip()
+        try:
+            if key == "prob":
+                prob = float(val)
+            elif key == "count":
+                count = int(val)
+            else:
+                raise FailpointSpecError(
+                    f"failpoint {name!r}: unknown modifier {key!r} (expected prob=/count=)"
+                )
+        except ValueError:
+            raise FailpointSpecError(f"failpoint {name!r}: bad modifier {mod!r}") from None
+    if not 0.0 <= prob <= 1.0:
+        raise FailpointSpecError(f"failpoint {name!r}: prob {prob} outside [0, 1]")
+    if count is not None and count < 0:
+        raise FailpointSpecError(f"failpoint {name!r}: negative count")
+    return _Failpoint(name, action, arg, prob, count)
+
+
+def parse_spec(spec) -> dict[str, _Failpoint]:
+    """Parse a spec string (`name=action:arg,mod=...;name2=...`) or a
+    mapping ({name: "action:arg,mod=..."}, the YAML form) into
+    failpoints. Raises FailpointSpecError on malformed input — a chaos
+    schedule with a typo must fail loudly, not silently inject nothing.
+    """
+    entries: list[tuple[str, str]] = []
+    if isinstance(spec, dict):
+        entries = [(str(k).strip(), str(v)) for k, v in spec.items()]
+    else:
+        for chunk in str(spec).split(";"):
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            name, sep, body = chunk.partition("=")
+            if not sep:
+                raise FailpointSpecError(f"failpoint entry {chunk!r}: expected name=action")
+            entries.append((name.strip(), body))
+    out: dict[str, _Failpoint] = {}
+    for name, body in entries:
+        if not name:
+            raise FailpointSpecError(f"failpoint entry with empty name: {body!r}")
+        out[name] = _parse_one(name, body)
+    return out
+
+
+def configure(spec) -> None:
+    """Replace the active failpoint set. `spec` is a spec string, a
+    mapping, or None/''/{} to disarm everything."""
+    global ENABLED
+    parsed = parse_spec(spec) if spec else {}
+    with _lock:
+        _registry.clear()
+        _registry.update(parsed)
+        ENABLED = bool(_registry)
+    if parsed:
+        log.warning(
+            "failpoints ARMED: %s",
+            "; ".join(f"{n}={fp.action}:{fp.arg}" for n, fp in parsed.items()),
+        )
+
+
+def configure_from_env(default=None, environ=os.environ) -> None:
+    """Arm from JANUS_FAILPOINTS, falling back to `default` (the YAML
+    `failpoints:` value) when the env var is absent. An empty env var
+    explicitly disarms (overriding the YAML)."""
+    raw = environ.get("JANUS_FAILPOINTS")
+    configure(raw if raw is not None else default)
+
+
+def clear() -> None:
+    configure(None)
+
+
+def status() -> dict:
+    """Snapshot for /statusz: active failpoints with remaining budgets."""
+    with _lock:
+        if not _registry:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "failpoints": {name: fp.snapshot() for name, fp in _registry.items()},
+        }
+
+
+def _lookup_and_arm(name: str) -> _Failpoint | None:
+    """One armed firing of `name`, or None. Budget/probability are
+    evaluated under the lock so concurrent sites cannot overspend a
+    count= budget."""
+    with _lock:
+        fp = _registry.get(name)
+        if fp is None:
+            return None
+        if fp.count is not None and fp.fired >= fp.count:
+            return None
+        if fp.prob < 1.0 and _rng.random() >= fp.prob:
+            return None
+        fp.fired += 1
+    from . import metrics
+
+    metrics.failpoints_fired_total.add(name=name, action=fp.action)
+    return fp
+
+
+def _act(fp: _Failpoint, error_factory=None, timeout_factory=None) -> None:
+    if fp.action == "delay":
+        log.warning("failpoint %s: delaying %.3fs", fp.name, fp.arg)
+        time.sleep(fp.arg)
+        return
+    if fp.action == "timeout":
+        log.warning("failpoint %s: timing out after %.3fs", fp.name, fp.arg)
+        time.sleep(fp.arg)
+        exc = (
+            timeout_factory()
+            if timeout_factory is not None
+            else TimeoutError(f"injected timeout (failpoint {fp.name})")
+        )
+        raise exc
+    if fp.action == "crash":
+        # the point is to model SIGKILL mid-line: no cleanup, no
+        # rollback, no flush — only the log line (stderr) escapes
+        log.error("failpoint %s: crashing (os._exit %d)", fp.name, CRASH_EXIT_CODE)
+        os._exit(CRASH_EXIT_CODE)
+    if fp.action == "oom":
+        raise RuntimeError(f"RESOURCE_EXHAUSTED: injected failpoint {fp.name}")
+    # action == "error"
+    log.warning("failpoint %s: injecting error", fp.name)
+    exc = (
+        error_factory()
+        if error_factory is not None
+        else FailpointError(f"injected failure (failpoint {fp.name})")
+    )
+    raise exc
+
+
+def hit(name: str, error_factory=None, timeout_factory=None) -> None:
+    """The instrumented-site entry point. A no-op (one module-flag
+    check) unless failpoints are armed; otherwise evaluates `name`'s
+    probability/budget and performs its action. `error_factory` /
+    `timeout_factory` let the site raise its own realistic exception
+    types for the error/timeout actions."""
+    if not ENABLED:
+        return
+    fp = _lookup_and_arm(name)
+    if fp is not None:
+        _act(fp, error_factory, timeout_factory)
+
+
+def hit_scoped(base: str, scope: str, error_factory=None, timeout_factory=None) -> None:
+    """Fire `base` and `base.scope` (e.g. `datastore.commit` and
+    `datastore.commit.step_agg_job_write`) so schedules can target
+    either every operation through a seam or one specific one."""
+    if not ENABLED:
+        return
+    fp = _lookup_and_arm(base)
+    if fp is not None:
+        _act(fp, error_factory, timeout_factory)
+    fp = _lookup_and_arm(base + "." + scope)
+    if fp is not None:
+        _act(fp, error_factory, timeout_factory)

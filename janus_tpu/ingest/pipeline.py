@@ -41,7 +41,7 @@ import queue
 import threading
 import time
 
-from .. import metrics, trace
+from .. import failpoints, metrics, trace
 from ..messages import Report
 from .admission import ShedError
 
@@ -194,6 +194,7 @@ class IngestPipeline:
                 with trace.use_context(ticket.trace_ctx), trace.span(
                     "ingest.decode"
                 ):
+                    failpoints.hit("ingest.decode")
                     ticket.report = Report.from_bytes(ticket.body)
                     ticket.body = b""  # decoded; free the raw copy
                     ticket.keypair = ticket.ta.upload_prepare(
@@ -220,6 +221,7 @@ class IngestPipeline:
                 with trace.use_context(ticket.trace_ctx), trace.span(
                     "ingest.decrypt"
                 ):
+                    failpoints.hit("ingest.decrypt")
                     stored = ticket.ta.upload_decrypt_validate(
                         ticket.report, ticket.keypair
                     )

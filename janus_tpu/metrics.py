@@ -364,6 +364,27 @@ batches_pending_collection = REGISTRY.gauge(
     "collection jobs awaiting an aggregate result (sampled)",
 )
 
+# --- robustness: fault injection + outbound circuit breaker
+# (janus_tpu/failpoints.py, core/circuit_breaker.py; docs/ROBUSTNESS.md) ---
+failpoints_fired_total = REGISTRY.counter(
+    "janus_failpoints_fired_total",
+    "injected faults fired, by failpoint name and action (zero in production)",
+)
+outbound_circuit_state = REGISTRY.gauge(
+    "janus_outbound_circuit_state",
+    "leader->peer outbound circuit breaker state per peer "
+    "(0=closed, 1=open, 2=half-open)",
+)
+outbound_circuit_transitions = REGISTRY.counter(
+    "janus_outbound_circuit_transitions_total",
+    "circuit breaker state transitions, by peer and destination state",
+)
+job_step_back_total = REGISTRY.counter(
+    "janus_job_step_back_total",
+    "job steps that released their lease early (breaker open, shutdown drain) "
+    "instead of failing, by reason",
+)
+
 
 def _register_span_bridges() -> None:
     """Bind the engine span names to janus_engine_dispatch_seconds via

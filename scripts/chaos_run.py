@@ -1,0 +1,549 @@
+"""Crash-recovery chaos harness: prove exactly-once aggregation under
+injected faults (docs/ROBUSTNESS.md; the standing answer to "what
+breaks when X dies").
+
+Topology — chosen so the component being killed is the REAL binary
+while everything else stays fast:
+
+  - leader + helper DAP servers run in-process (DapServer threads over
+    loopback HTTP) with file-backed SQLite datastores in a temp dir,
+    so the aggregation job drivers cross a real process + HTTP + DB
+    boundary;
+  - the aggregation job driver — the thing that crashes — runs as the
+    real `python -m janus_tpu.bin.aggregation_job_driver` binary
+    against the leader database file, armed via JANUS_FAILPOINTS;
+  - the job creator and collection job driver run in-process.
+
+Deterministic schedule (all probabilistic faults are count-budgeted):
+
+  1. upload N reports through the real Client; the admitted
+     measurements are the ground truth.
+  2. driver A boots with
+       datastore.commit.step_agg_job_write=crash:1.0,count=1
+     — it steps the job: the helper aggregates and acks the init, and
+     the leader dies (os._exit, the SIGKILL analog) BEFORE its own
+     write commits. Assert exit code CRASH_EXIT_CODE and a still-held
+     lease.
+  3. driver B boots into a storm:
+       env  helper.request=error:1.0,count=2   (transport failures)
+            datastore.commit=error:0.2          (transient tx faults,
+                                                 absorbed by run_tx)
+       harness-side helper.aggregate=error:1.0,count=2 (real HTTP 500s
+                                                 from the helper)
+     Its outbound circuit must open, the job steps back (lease
+     released early, attempt refunded), the breaker half-opens and
+     closes once the storm budget is spent, and the job completes —
+     the helper's request-hash dedup makes the replayed init
+     idempotent. The lease must be reacquired within the lease TTL.
+  4. (full schedule only) a second batch + driver C with
+       datastore.post_commit.step_agg_job_write=crash:1.0,count=1
+     — death AFTER the commit, before anything was acked — then a
+     clean driver D that must find nothing left to redo.
+  5. collect through the real Collector and assert the aggregate
+     equals the ground truth EXACTLY (count and sum: no loss, no
+     double-count), the breaker cycle is visible in
+     janus_outbound_circuit_state / _transitions_total and on
+     /statusz, and driver B SIGTERM-drains cleanly.
+
+Usage:
+    python scripts/chaos_run.py --smoke --json   # fast deterministic
+    python scripts/chaos_run.py --json           # full schedule (slow)
+
+Exit code 0 iff every invariant held; the result JSON rides on stdout
+(bench.py --dry-run embeds the smoke as its chaos_smoke phase).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import re
+import secrets
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# single-device CPU everywhere, shared persistent compile cache: the
+# harness pre-warms the engine programs so the driver subprocesses load
+# them from disk instead of paying a cold jit inside a short lease
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "", _flags
+).strip()
+
+CRASH_SCHEDULE = "datastore.commit.step_agg_job_write=crash:1.0,count=1"
+POST_COMMIT_CRASH_SCHEDULE = (
+    "datastore.post_commit.step_agg_job_write=crash:1.0,count=1"
+)
+STORM_SCHEDULE = "helper.request=error:1.0,count=2;datastore.commit=error:0.2"
+HELPER_5XX_SCHEDULE = "helper.aggregate=error:1.0,count=2"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _driver_cfg(path, db, health_port, ttl_s, cooldown_s):
+    cfg = (
+        f"database: {{url: {db}}}\n"
+        f'health_check_listen_address: "127.0.0.1:{health_port}"\n'
+        "jax_platform: cpu\n"
+        "compilation_cache_dir: ~/.cache/janus_tpu_xla\n"
+        "min_job_discovery_delay_secs: 0.1\n"
+        "max_job_discovery_delay_secs: 0.5\n"
+        f"worker_lease_duration_secs: {ttl_s}\n"
+        "maximum_attempts_before_failure: 20\n"
+        "outbound_circuit_breaker:\n"
+        "  failure_threshold: 3\n"
+        f"  open_cooldown_secs: {cooldown_s}\n"
+    )
+    with open(path, "w") as f:
+        f.write(cfg)
+    return str(path)
+
+
+def _spawn_driver(cfg_path, key, log_path, failpoints: str | None):
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        DATASTORE_KEYS=key,
+        JAX_PLATFORMS="cpu",
+    )
+    if failpoints:
+        env["JANUS_FAILPOINTS"] = failpoints
+    else:
+        env.pop("JANUS_FAILPOINTS", None)
+    logf = open(log_path, "wb")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "janus_tpu.bin.aggregation_job_driver",
+            "--config-file",
+            str(cfg_path),
+        ],
+        env=env,
+        stdout=logf,
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+    )
+
+
+def _wait_healthz(port: int, deadline_s: float = 120.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2
+            ) as r:
+                assert r.status == 200
+                return
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def _scrape(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.read().decode()
+
+
+def _metric_samples(text: str, name: str) -> dict[str, float]:
+    """{label_block_or_'': value} for one family of a scraped /metrics
+    page, via the shared exposition parser (janus_tpu.exposition — the
+    same one scrape_check and the metrics tests use, incl. escaped
+    label values)."""
+    from janus_tpu.exposition import parse_exposition
+
+    fam = parse_exposition(text)[0].get(name)
+    if fam is None:
+        return {}
+    return {
+        ",".join(f'{k}="{v}"' for k, v in sorted(labels.items())): float(value)
+        for sample_name, labels, value in fam.samples
+        if sample_name == name
+    }
+
+
+def run_chaos(
+    n_reports: int = 5,
+    lease_ttl_s: int = 8,
+    breaker_cooldown_s: float = 1.5,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Run the schedule; returns the invariant-assertion record. Every
+    `*_ok` key must be True for the run to count as a pass."""
+    from janus_tpu import failpoints
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.binary_utils import enable_compile_cache, warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    import dataclasses
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-chaos-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    clock = RealClock()
+    leader_db = os.path.join(tmp, "leader.sqlite")
+    helper_db = os.path.join(tmp, "helper.sqlite")
+    leader_ds = Datastore(leader_db, Crypter([key_bytes]), clock)
+    helper_ds = Datastore(helper_db, Crypter([key_bytes]), clock)
+
+    result: dict = {"workdir": tmp, "schedule": "full" if full else "smoke"}
+    procs: list[subprocess.Popen] = []
+    leader_srv = helper_srv = None
+    try:
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+
+        vdaf = VdafInstance.count()
+        collector_kp = generate_hpke_config_and_private_key(config_id=200)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+
+        # pre-warm the engine programs into the persistent XLA cache:
+        # the driver subprocesses (same single-device CPU config) load
+        # them from disk instead of cold-compiling inside a short lease
+        enable_compile_cache()
+        warmup_engines(leader_ds)
+
+        # --- phase 1: ground truth -------------------------------------
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        measurements = [(i % 3 != 0) * 1 for i in range(n_reports)]
+        for m in measurements:
+            client.upload(m)
+        creator = AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=100
+            ),
+        )
+        creator.run_once()
+        result["admitted"] = len(measurements)
+        result["ground_truth_sum"] = sum(measurements)
+
+        def held_agg_leases():
+            return [
+                e
+                for e in leader_ds.run_tx(
+                    lambda tx: tx.get_held_lease_expiries(), "chaos_monitor"
+                )
+                if e[0] == "aggregation"
+            ]
+
+        def agg_jobs_by_state():
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "chaos_monitor"
+            )
+            return {
+                state: n for (typ, state), n in counts.items() if typ == "aggregation"
+            }
+
+        # --- phase 2: crash between helper ack and leader commit --------
+        from janus_tpu.failpoints import CRASH_EXIT_CODE
+
+        ttl = int(lease_ttl_s)
+        port_a = _free_port()
+        cfg_a = _driver_cfg(
+            os.path.join(tmp, "driver_a.yaml"), leader_db, port_a, ttl, breaker_cooldown_s
+        )
+        drv_a = _spawn_driver(
+            cfg_a, key, os.path.join(tmp, "driver_a.log"), CRASH_SCHEDULE
+        )
+        procs.append(drv_a)
+        rc_a = drv_a.wait(timeout=300)
+        t_crash = time.monotonic()
+        result["crash_exit_code"] = rc_a
+        result["crash_ok"] = rc_a == CRASH_EXIT_CODE
+        leases = held_agg_leases()
+        # the dead driver's lease is still outstanding: nobody rolled it
+        # back, exactly like SIGKILL
+        result["lease_held_after_crash_ok"] = len(leases) == 1
+        crashed_expiry = leases[0][3] if leases else 0
+        states = agg_jobs_by_state()
+        result["job_in_progress_after_crash_ok"] = states.get("in_progress", 0) >= 1
+
+        # --- phase 3: restart into a helper storm -----------------------
+        failpoints.configure(HELPER_5XX_SCHEDULE)  # helper-side real 500s
+        port_b = _free_port()
+        cfg_b = _driver_cfg(
+            os.path.join(tmp, "driver_b.yaml"), leader_db, port_b, ttl, breaker_cooldown_s
+        )
+        drv_b = _spawn_driver(
+            cfg_b, key, os.path.join(tmp, "driver_b.log"), STORM_SCHEDULE
+        )
+        procs.append(drv_b)
+        _wait_healthz(port_b)
+        # the recovery clock starts once a live driver exists: reacquire
+        # latency must not be charged for driver B's own boot time
+        t_recoverable = max(t_crash, time.monotonic())
+
+        reacquired_at = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if reacquired_at is None:
+                now_leases = held_agg_leases()
+                if any(e[3] != crashed_expiry for e in now_leases):
+                    reacquired_at = time.monotonic()
+            states = agg_jobs_by_state()
+            if states.get("in_progress", 0) == 0 and states.get("finished", 0) >= 1:
+                if reacquired_at is None:
+                    reacquired_at = time.monotonic()
+                break
+            time.sleep(0.05)
+        states = agg_jobs_by_state()
+        result["job_finished_ok"] = (
+            states.get("finished", 0) >= 1 and states.get("in_progress", 0) == 0
+        )
+        result["lease_reacquire_s"] = (
+            round(reacquired_at - t_recoverable, 3) if reacquired_at else None
+        )
+        # the crashed lease must be picked up within its TTL (plus
+        # discovery latency margin): leases are always recovered
+        result["lease_reacquired_within_ttl_ok"] = (
+            reacquired_at is not None and (reacquired_at - t_recoverable) <= ttl + 3.0
+        )
+        failpoints.clear()
+
+        # --- breaker cycle visibility (driver B is still alive) ---------
+        metrics_text = _scrape(port_b, "/metrics")
+        state_samples = _metric_samples(metrics_text, "janus_outbound_circuit_state")
+        trans = _metric_samples(
+            metrics_text, "janus_outbound_circuit_transitions_total"
+        )
+        result["circuit_state_samples"] = state_samples
+        result["circuit_transitions"] = trans
+        opened = sum(v for k, v in trans.items() if 'to="open"' in k)
+        half = sum(v for k, v in trans.items() if 'to="half_open"' in k)
+        closed = sum(v for k, v in trans.items() if 'to="closed"' in k)
+        result["circuit_cycle_ok"] = (
+            opened >= 1
+            and half >= 1
+            and closed >= 1
+            and state_samples
+            and all(v == 0.0 for v in state_samples.values())  # closed again
+        )
+        statusz = json.loads(_scrape(port_b, "/statusz"))
+        result["statusz_circuit_ok"] = bool(
+            statusz.get("outbound_circuit", {}).get("peers")
+        )
+        result["statusz_failpoints_armed_ok"] = (
+            statusz.get("failpoints", {}).get("enabled") is True
+        )
+        step_backs = _metric_samples(metrics_text, "janus_job_step_back_total")
+        result["step_backs"] = step_backs
+        result["stepped_back_ok"] = (
+            sum(v for k, v in step_backs.items() if "circuit_open" in k) >= 1
+        )
+
+        # --- SIGTERM drain of driver B ----------------------------------
+        drv_b.send_signal(signal.SIGTERM)
+        rc_b = drv_b.wait(timeout=60)
+        log_b = open(os.path.join(tmp, "driver_b.log"), "rb").read()
+        result["drain_ok"] = rc_b == 0 and b"shut down" in log_b
+
+        # --- phase 4 (full): crash AFTER commit, before ack --------------
+        if full:
+            extra = [1] * max(3, n_reports // 2)
+            for m in extra:
+                client.upload(m)
+            measurements += extra
+            result["admitted"] = len(measurements)
+            result["ground_truth_sum"] = sum(measurements)
+            creator.run_once()
+            port_c = _free_port()
+            cfg_c = _driver_cfg(
+                os.path.join(tmp, "driver_c.yaml"),
+                leader_db,
+                port_c,
+                ttl,
+                breaker_cooldown_s,
+            )
+            drv_c = _spawn_driver(
+                cfg_c, key, os.path.join(tmp, "driver_c.log"), POST_COMMIT_CRASH_SCHEDULE
+            )
+            procs.append(drv_c)
+            rc_c = drv_c.wait(timeout=300)
+            result["post_commit_crash_ok"] = rc_c == CRASH_EXIT_CODE
+            # death after the commit: the work IS durable; a clean
+            # restart must find nothing left to redo (and the final
+            # exact-count collection proves nothing was re-done)
+            states = agg_jobs_by_state()
+            result["post_commit_job_finished_ok"] = states.get("in_progress", 0) == 0
+            port_d = _free_port()
+            cfg_d = _driver_cfg(
+                os.path.join(tmp, "driver_d.yaml"),
+                leader_db,
+                port_d,
+                ttl,
+                breaker_cooldown_s,
+            )
+            drv_d = _spawn_driver(
+                cfg_d, key, os.path.join(tmp, "driver_d.log"), None
+            )
+            procs.append(drv_d)
+            _wait_healthz(port_d)
+            time.sleep(2.0)  # a couple of discovery passes
+            drv_d.send_signal(signal.SIGTERM)
+            rc_d = drv_d.wait(timeout=60)
+            states = agg_jobs_by_state()
+            result["clean_restart_ok"] = rc_d == 0 and states.get("in_progress", 0) == 0
+
+        # --- phase 5: collect and compare against ground truth ----------
+        import threading
+
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+
+            jd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                jd.run_once()
+                stop_collect.wait(0.3)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+        try:
+            collector = Collector(
+                CollectorParameters(
+                    leader_task.task_id,
+                    leader_srv.url,
+                    leader_task.collector_auth_token,
+                    collector_kp,
+                ),
+                vdaf,
+                HttpClient(),
+            )
+            tp = leader_task.time_precision
+            start = clock.now().to_batch_interval_start(tp)
+            query = Query.time_interval(
+                Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+            )
+            collected = collector.collect(query, timeout_s=120.0)
+            result["collected_count"] = collected.report_count
+            result["collected_sum"] = collected.aggregate_result
+            # THE invariant: exactly the admitted reports, no loss, no
+            # double count — across a mid-commit crash, commit faults,
+            # transport storms and helper 500s
+            result["exactly_once_ok"] = (
+                collected.report_count == len(measurements)
+                and collected.aggregate_result == sum(measurements)
+            )
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        failpoints_mod = sys.modules.get("janus_tpu.failpoints")
+        if failpoints_mod is not None:
+            failpoints_mod.clear()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if leader_srv is not None:
+            leader_srv.stop()
+        if helper_srv is not None:
+            helper_srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="fast deterministic schedule (crash + storm + collect); "
+        "the default runs the full schedule incl. the post-commit crash",
+    )
+    ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
+    ap.add_argument("--json", action="store_true", help="print the result record as JSON")
+    ap.add_argument("--workdir", default=None, help="keep artifacts here (default: temp dir)")
+    args = ap.parse_args(argv)
+
+    n = args.reports or (5 if args.smoke else 12)
+    result = run_chaos(
+        n_reports=n,
+        full=not args.smoke,
+        workdir=args.workdir,
+    )
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(json.dumps(result, indent=2))
+    if not result.get("ok"):
+        failed = [k for k, v in result.items() if k.endswith("_ok") and not v]
+        print(f"CHAOS FAILED: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Chaos harness schedules (scripts/chaos_run.py; docs/ROBUSTNESS.md).
+
+The fast deterministic smoke runs in tier-1 through bench --dry-run
+(test_tools.test_bench_dry_run_smoke asserts its record). This file
+holds the heavy full schedule — crash between helper ack and leader
+commit, restart into a transport/5xx storm through the circuit
+breaker, a SECOND crash after commit-before-ack, a clean restart that
+finds nothing to redo, and an exact-ground-truth collection — plus
+cheap schedule-definition sanity that does run in tier-1.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_chaos_module():
+    """Import scripts/chaos_run.py (not a package) without letting its
+    env setup leak into the test process."""
+    import importlib.util
+
+    saved = {k: os.environ.get(k) for k in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "chaos_run", os.path.join(REPO, "scripts", "chaos_run.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_schedules_parse():
+    """The harness's fault schedules must stay valid failpoint specs —
+    a typo would silently inject nothing and void the chaos proof."""
+    from janus_tpu import failpoints
+
+    chaos = _load_chaos_module()
+    for spec in (
+        chaos.CRASH_SCHEDULE,
+        chaos.POST_COMMIT_CRASH_SCHEDULE,
+        chaos.STORM_SCHEDULE,
+        chaos.HELPER_5XX_SCHEDULE,
+    ):
+        assert failpoints.parse_spec(spec)
+    crash = failpoints.parse_spec(chaos.CRASH_SCHEDULE)[
+        "datastore.commit.step_agg_job_write"
+    ]
+    assert crash.action == "crash" and crash.count == 1
+
+
+@pytest.mark.slow  # ~60-90s: four driver subprocess boots
+@pytest.mark.chaos
+def test_chaos_full_schedule(tmp_path):
+    """The full schedule end to end, as an operator would run it."""
+    import json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join("scripts", "chaos_run.py"),
+            "--json",
+            "--workdir",
+            str(tmp_path),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rec = json.loads([l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+    assert rec["ok"] is True
+    assert rec["schedule"] == "full"
+    assert rec["post_commit_crash_ok"] is True
+    assert rec["clean_restart_ok"] is True
+    assert rec["exactly_once_ok"] is True

@@ -1,0 +1,265 @@
+"""Outbound circuit breaker (core/circuit_breaker.py): the state
+machine, fail-fast behavior inside the driver's retry loop, the
+step-back lease semantics, and the /statusz + metrics surface."""
+
+import threading
+import time
+
+import pytest
+
+from janus_tpu import metrics
+from janus_tpu.core.circuit_breaker import (
+    CircuitBreakerConfig,
+    CircuitOpenError,
+    OutboundCircuitBreakers,
+    default_breakers,
+    peer_label,
+    reset_default_breakers,
+)
+
+
+def test_peer_label():
+    assert peer_label("http://helper.example:8080/dap/") == "helper.example:8080"
+    assert peer_label("https://helper.example/") == "helper.example"
+
+
+def test_closed_until_consecutive_threshold():
+    br = OutboundCircuitBreakers(CircuitBreakerConfig(failure_threshold=3))
+    for _ in range(2):
+        br.record_failure("p")
+    br.record_success("p")  # success resets the consecutive counter
+    for _ in range(2):
+        br.record_failure("p")
+    assert br.state("p") == "closed"
+    br.record_failure("p")  # third consecutive
+    assert br.state("p") == "open"
+    assert br.retry_in_s("p") > 0
+
+
+def test_open_rejects_then_half_open_probe_closes():
+    br = OutboundCircuitBreakers(
+        CircuitBreakerConfig(failure_threshold=1, open_cooldown_s=0.05)
+    )
+    br.record_failure("p")
+    with pytest.raises(CircuitOpenError) as ei:
+        br.check("p")
+    assert ei.value.retry_in_s <= 0.05
+    time.sleep(0.06)
+    br.check("p")  # admitted as the half-open probe
+    assert br.state("p") == "half_open"
+    br.record_success("p")
+    assert br.state("p") == "closed"
+    br.check("p")  # closed: free flow
+
+
+def test_half_open_admits_single_probe_and_reopens_on_failure():
+    br = OutboundCircuitBreakers(
+        CircuitBreakerConfig(failure_threshold=1, open_cooldown_s=0.01)
+    )
+    br.record_failure("p")
+    time.sleep(0.02)
+    br.check("p")  # probe slot taken
+    with pytest.raises(CircuitOpenError):
+        br.check("p")  # concurrent caller: rejected while probing
+    br.record_failure("p")  # probe failed
+    assert br.state("p") == "open"
+    assert br.retry_in_s("p") > 0  # cooldown restarted
+
+
+def test_metrics_and_status_surface():
+    br = OutboundCircuitBreakers(
+        CircuitBreakerConfig(failure_threshold=1, open_cooldown_s=60.0)
+    )
+    br.record_failure("helper.example:443")
+    assert metrics.outbound_circuit_state.get(peer="helper.example:443") == 1.0
+    assert (
+        metrics.outbound_circuit_transitions.get(peer="helper.example:443", to="open")
+        >= 1.0
+    )
+    st = br.status()
+    peer = st["peers"]["helper.example:443"]
+    assert peer["state"] == "open" and peer["retry_in_s"] > 0
+    assert st["config"]["failure_threshold"] == 1
+
+
+def test_default_registry_registers_statusz_provider():
+    from janus_tpu.statusz import status_snapshot
+
+    reset_default_breakers()
+    br = default_breakers(CircuitBreakerConfig(failure_threshold=9))
+    assert default_breakers() is br  # shared process-wide
+    snap = status_snapshot()
+    assert snap["outbound_circuit"]["config"]["failure_threshold"] == 9
+
+
+def test_disabled_breaker_is_inert():
+    br = OutboundCircuitBreakers(CircuitBreakerConfig(enabled=False, failure_threshold=1))
+    for _ in range(10):
+        br.record_failure("p")
+    br.check("p")  # never raises
+
+
+class _FailingHttp:
+    last_response_headers: dict = {}
+
+    def __init__(self, status=None):
+        self.calls = 0
+        self.status = status  # None = transport error, int = HTTP status
+
+    def _req(self, *a, **k):
+        self.calls += 1
+        if self.status is None:
+            raise ConnectionError("connection refused (test double)")
+        return self.status, b"boom"
+
+    put = post = _req
+
+
+def test_driver_request_opens_circuit_and_fails_fast():
+    """Transport failures inside _send_agg_job_request trip the breaker
+    at the configured threshold; the NEXT attempt is gated without
+    touching the network (fail fast, lease time preserved)."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        AggregationJobDriverConfig,
+    )
+    from janus_tpu.core.retries import Backoff
+    from janus_tpu.messages import AggregationJobId, AggregationJobInitializeReq, PartialBatchSelector, Role
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+        .with_(helper_aggregator_endpoint="http://helper.test:9999/")
+        .build()
+    )
+    http = _FailingHttp()
+    drv = AggregationJobDriver(
+        None,
+        http,
+        AggregationJobDriverConfig(http_backoff=Backoff.test()),
+        breakers=OutboundCircuitBreakers(
+            CircuitBreakerConfig(failure_threshold=2, open_cooldown_s=60.0)
+        ),
+    )
+    req = AggregationJobInitializeReq(b"", PartialBatchSelector.time_interval(), ())
+    with pytest.raises(CircuitOpenError):
+        drv._send_agg_job_request(task, AggregationJobId(bytes(16)), "PUT", req)
+    assert http.calls == 2  # exactly threshold attempts hit the wire
+    assert drv.breakers.state("helper.test:9999") == "open"
+
+
+def test_driver_5xx_storm_counts_as_failure():
+    """Real HTTP 500s (a melting helper, not a dead socket) trip the
+    breaker the same way."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        AggregationJobDriverConfig,
+    )
+    from janus_tpu.core.retries import Backoff
+    from janus_tpu.messages import AggregationJobId, AggregationJobInitializeReq, PartialBatchSelector, Role
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+        .with_(helper_aggregator_endpoint="http://helper5xx.test/")
+        .build()
+    )
+    http = _FailingHttp(status=503)
+    drv = AggregationJobDriver(
+        None,
+        http,
+        AggregationJobDriverConfig(http_backoff=Backoff.test()),
+        breakers=OutboundCircuitBreakers(
+            CircuitBreakerConfig(failure_threshold=3, open_cooldown_s=60.0)
+        ),
+    )
+    req = AggregationJobInitializeReq(b"", PartialBatchSelector.time_interval(), ())
+    with pytest.raises(CircuitOpenError):
+        drv._send_agg_job_request(task, AggregationJobId(bytes(16)), "PUT", req)
+    assert http.calls == 3
+
+
+def test_stepper_treats_circuit_open_as_step_back(monkeypatch):
+    """A breaker-open step releases the lease with the cooldown as the
+    reacquire delay and refunds the attempt — the job neither burns a
+    lease TTL nor marches toward abandonment."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        AggregationJobDriverConfig,
+    )
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Time
+    from test_lease_invariants import make_task, put_job
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        (acquired,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        assert acquired.lease.attempts == 1
+        drv = AggregationJobDriver(
+            ds, None, breakers=OutboundCircuitBreakers(CircuitBreakerConfig())
+        )
+        monkeypatch.setattr(
+            drv,
+            "step_aggregation_job",
+            lambda a: (_ for _ in ()).throw(CircuitOpenError("helper.test", 4.0)),
+        )
+        before = metrics.job_step_back_total.get(reason="circuit_open")
+        drv.stepper(acquired)  # must not raise
+        assert metrics.job_step_back_total.get(reason="circuit_open") == before + 1
+        # not reacquirable during the breaker cooldown...
+        assert (
+            ds.run_tx(lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1))
+            == []
+        )
+        clock.advance(Duration(5))
+        # ...but afterwards it is, and the attempt was refunded: this
+        # acquire's increment lands back on 1, not 2
+        (re,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        assert re.lease.attempts == 1
+    finally:
+        eph.cleanup()
+
+
+def test_concurrent_checks_race_safely():
+    """Many threads hammering check/record around a transition never
+    deadlock or corrupt state (the transition lock is the only guard)."""
+    br = OutboundCircuitBreakers(
+        CircuitBreakerConfig(failure_threshold=2, open_cooldown_s=0.005)
+    )
+    stop = threading.Event()
+    errors: list = []
+
+    def worker(i):
+        try:
+            while not stop.is_set():
+                try:
+                    br.check("p")
+                except CircuitOpenError:
+                    continue
+                if i % 2:
+                    br.record_failure("p")
+                else:
+                    br.record_success("p")
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+    assert br.state("p") in ("closed", "open", "half_open")

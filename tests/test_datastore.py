@@ -387,3 +387,142 @@ def test_concurrent_lease_acquire(eph):
         t.join()
     assert not set(results[0]) & set(results[1])
     assert len(results[0]) + len(results[1]) == 8
+
+
+# ---------------------------------------------------------------------------
+# failpoint-driven crash recovery (janus_tpu.failpoints; the unit-scale
+# companion of scripts/chaos_run.py): run_tx seams at tx begin /
+# pre-commit / post-commit, and the invariant that a crash AFTER commit
+# but BEFORE ack cannot double anything when the work is retried.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _failpoints():
+    from janus_tpu import failpoints
+
+    failpoints.clear()
+    yield failpoints
+    failpoints.clear()
+
+
+def test_run_tx_pre_commit_fault_is_retried_once_committed(eph, _failpoints):
+    """Injected pre-commit conflicts are absorbed by run_tx's own retry
+    loop: the closure re-runs, the datastore commits exactly once."""
+    ds = eph.datastore
+    task = mktask()
+    ds.run_tx(lambda tx: tx.put_task(task))
+    _failpoints.configure("datastore.commit.flaky_write=error:1,count=2")
+    runs = {"n": 0}
+
+    def fn(tx):
+        runs["n"] += 1
+        return tx.put_client_report(_report(task))
+
+    assert ds.run_tx(fn, "flaky_write") is True  # fresh on the attempt that lands
+    assert runs["n"] == 3  # two injected conflicts + the committing run
+    assert ds.run_tx(lambda tx: tx.check_report_replayed(task.task_id, _report(task).report_id))
+
+
+def test_run_tx_post_commit_crash_does_not_double_store(eph, _failpoints):
+    """Crash after COMMIT, before the caller saw the result (the
+    upload-ack window): the retry replays the closure against committed
+    state — put_client_report reports a replay, exactly one row exists,
+    and the caller's observed result is the idempotent one."""
+    ds = eph.datastore
+    task = mktask()
+    ds.run_tx(lambda tx: tx.put_task(task))
+    _failpoints.configure("datastore.post_commit.upload_batch=error:1,count=1")
+    fresh = ds.run_tx(lambda tx: tx.put_client_report(_report(task)), "upload_batch")
+    # the first attempt COMMITTED, then 'crashed' pre-ack; the retry's
+    # answer (replay) is what the caller observes
+    assert fresh is False
+    rows, _ = ds.run_tx(lambda tx: tx.count_client_reports_for_task(task.task_id))
+    assert rows == 1
+
+
+def test_run_tx_post_commit_crash_does_not_double_aggregate(eph, _failpoints):
+    """The exactly-once core, in the driver's REAL transaction shape:
+    the accumulator flush shares its transaction with the token-guarded
+    lease release (step_agg_job_write). A flush alone is idempotent
+    only under rollback-retry; when the commit LANDED and the worker
+    dies pre-ack, it is the lease release that refuses the replay — the
+    retry's release sees a cleared token, raises TxConflict, and the
+    whole replayed transaction rolls back. The ambiguous commit
+    surfaces as a loud failure; the batch aggregation is never silently
+    doubled."""
+    import secrets as _secrets
+
+    from janus_tpu.aggregator.accumulator import Accumulator
+
+    ds = eph.datastore
+    task = TaskBuilder(
+        QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER
+    ).with_(min_batch_size=1).build()
+    ds.run_tx(lambda tx: tx.put_task(task))
+    ds.run_tx(lambda tx: tx.put_aggregation_job(_aggjob(task, 1)))
+    (acquired,) = ds.run_tx(
+        lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+    )
+    acc = Accumulator(task, shard_count=1)
+    rid = ReportId(_secrets.token_bytes(16))
+    acc.update_single(b"batch-fp", [7], rid, Time(1_600_000_000))
+
+    def write(tx):
+        acc.flush_to_datastore(tx)
+        tx.release_aggregation_job(acquired)
+
+    _failpoints.configure("datastore.post_commit.step_agg_job_write=error:1,count=1")
+    with pytest.raises(TxConflict):
+        ds.run_tx(write, "step_agg_job_write")
+    rows = ds.run_tx(
+        lambda tx: tx.get_batch_aggregations_for_batch(task.task_id, b"batch-fp", b"")
+    )
+    assert len(rows) == 1 and rows[0].report_count == 1  # committed exactly once
+    # and the committed attempt DID release the lease: reacquirable now
+    (re,) = ds.run_tx(
+        lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+    )
+    assert re.lease.attempts == 1
+
+
+def test_run_tx_tx_begin_fault_never_half_commits(eph, _failpoints):
+    """A fault at BEGIN leaves nothing behind: the retry starts from a
+    clean transaction."""
+    ds = eph.datastore
+    task = mktask()
+    ds.run_tx(lambda tx: tx.put_task(task))
+    _failpoints.configure("datastore.tx_begin.begin_fault=error:1,count=1")
+    assert ds.run_tx(lambda tx: tx.put_client_report(_report(task, 9)), "begin_fault")
+    rows, _ = ds.run_tx(lambda tx: tx.count_client_reports_for_task(task.task_id))
+    assert rows == 1
+
+
+def test_step_back_lease_semantics(eph):
+    """step_back_aggregation_job: token cleared, reacquire delayed,
+    attempts refunded (count_attempt=False) or preserved (True); stale
+    tokens conflict."""
+    ds = eph.datastore
+    clock = eph.clock
+    task = mktask()
+    ds.run_tx(lambda tx: tx.put_task(task))
+    ds.run_tx(lambda tx: tx.put_aggregation_job(_aggjob(task, 1)))
+    (a1,) = ds.run_tx(lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1))
+    assert a1.lease.attempts == 1
+    ds.run_tx(lambda tx: tx.step_back_aggregation_job(a1, reacquire_delay_s=30))
+    assert (
+        ds.run_tx(lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)) == []
+    )
+    clock.advance(Duration(31))
+    (a2,) = ds.run_tx(lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1))
+    assert a2.lease.attempts == 1  # refunded, then re-incremented
+    # count_attempt=True keeps the ledger
+    ds.run_tx(
+        lambda tx: tx.step_back_aggregation_job(a2, reacquire_delay_s=0, count_attempt=True)
+    )
+    (a3,) = ds.run_tx(lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1))
+    assert a3.lease.attempts == 2
+    # a stale holder cannot step back the new holder's lease
+    with pytest.raises(TxConflict):
+        with ds.tx() as tx:
+            tx.step_back_aggregation_job(a1)

@@ -1,0 +1,308 @@
+"""Failpoint fault injection (janus_tpu.failpoints): spec parsing,
+probability/count budgets, the disabled no-op guarantee, and the wiring
+at every layer seam (HTTP client, retries, report writer, ingest
+pipeline, engine dispatch). docs/ROBUSTNESS.md is the operator view."""
+
+import time
+import urllib.error
+
+import pytest
+
+from janus_tpu import failpoints
+from janus_tpu.failpoints import FailpointError, FailpointSpecError
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends disarmed — failpoints are process
+    globals and a leak would fail unrelated suites."""
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_issue_example_spec():
+    fps = failpoints.parse_spec(
+        "datastore.commit=error:0.3;helper.request=delay:2.0,count=5;engine.dispatch=oom:1"
+    )
+    assert fps["datastore.commit"].action == "error"
+    assert fps["datastore.commit"].prob == pytest.approx(0.3)
+    assert fps["helper.request"].action == "delay"
+    assert fps["helper.request"].arg == pytest.approx(2.0)
+    assert fps["helper.request"].prob == 1.0  # delay arg is seconds, not prob
+    assert fps["helper.request"].count == 5
+    assert fps["engine.dispatch"].action == "oom"
+    assert fps["engine.dispatch"].prob == 1.0
+
+
+def test_parse_mapping_form_and_modifiers():
+    fps = failpoints.parse_spec({"a.b": "error:1.0,prob=0.5,count=2", "c.d": "crash"})
+    assert fps["a.b"].prob == 0.5 and fps["a.b"].count == 2
+    assert fps["c.d"].action == "crash" and fps["c.d"].prob == 1.0
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nameonly",  # no '='
+        "x=explode:1",  # unknown action
+        "x=error:notanumber",
+        "x=error:1,frequency=2",  # unknown modifier
+        "x=error:2.0",  # prob outside [0,1]
+        "x=delay:1,count=-1",
+    ],
+)
+def test_malformed_specs_fail_loudly(bad):
+    with pytest.raises(FailpointSpecError):
+        failpoints.parse_spec(bad)
+
+
+def test_configure_from_env_precedence():
+    failpoints.configure_from_env(
+        default="a.a=error:1", environ={"JANUS_FAILPOINTS": "b.b=error:1"}
+    )
+    assert "b.b" in failpoints.status()["failpoints"]
+    # empty env var explicitly disarms, overriding the YAML default
+    failpoints.configure_from_env(default="a.a=error:1", environ={"JANUS_FAILPOINTS": ""})
+    assert failpoints.status() == {"enabled": False}
+    # absent env var falls back to the YAML value
+    failpoints.configure_from_env(default="a.a=error:1", environ={})
+    assert "a.a" in failpoints.status()["failpoints"]
+
+
+# ---------------------------------------------------------------------------
+# firing semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_is_noop_and_flag_off():
+    assert failpoints.ENABLED is False
+    failpoints.hit("anything.at.all")  # no raise, no sleep
+
+
+def test_error_action_default_and_custom_type():
+    failpoints.configure("x.y=error:1")
+    with pytest.raises(FailpointError):
+        failpoints.hit("x.y")
+    with pytest.raises(ValueError, match="custom"):
+        failpoints.hit("x.y", error_factory=lambda: ValueError("custom"))
+
+
+def test_count_budget_exhausts():
+    failpoints.configure("x.y=error:1,count=2")
+    for _ in range(2):
+        with pytest.raises(FailpointError):
+            failpoints.hit("x.y")
+    failpoints.hit("x.y")  # budget spent: inert
+    assert failpoints.status()["failpoints"]["x.y"]["fired"] == 2
+
+
+def test_prob_zero_never_fires():
+    failpoints.configure("x.y=error:0.0")
+    for _ in range(50):
+        failpoints.hit("x.y")
+
+
+def test_delay_action_sleeps_then_continues():
+    failpoints.configure("x.y=delay:0.05")
+    t0 = time.monotonic()
+    failpoints.hit("x.y")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_timeout_action_raises_site_timeout():
+    failpoints.configure("x.y=timeout:0.01")
+    with pytest.raises(TimeoutError):
+        failpoints.hit("x.y")
+
+
+def test_scoped_hit_targets_one_transaction():
+    failpoints.configure("datastore.commit.step_agg_job_write=error:1")
+    failpoints.hit_scoped("datastore.commit", "upload_batch")  # different scope
+    with pytest.raises(FailpointError):
+        failpoints.hit_scoped("datastore.commit", "step_agg_job_write")
+
+
+def test_fired_counter_metric():
+    from janus_tpu import metrics
+
+    failpoints.configure("x.y=error:1")
+    before = metrics.failpoints_fired_total.get(name="x.y", action="error")
+    with pytest.raises(FailpointError):
+        failpoints.hit("x.y")
+    assert metrics.failpoints_fired_total.get(name="x.y", action="error") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# layer wiring
+# ---------------------------------------------------------------------------
+
+
+def test_http_client_transport_error_and_stale_header_clear():
+    """helper.request error raises a retryable URLError AND the
+    thread-local response headers are cleared at request start, so a
+    transport failure can never expose a previous response's
+    Retry-After to the retry loop."""
+    from janus_tpu.binary_utils import HealthServer
+    from janus_tpu.core.http_client import HttpClient
+
+    srv = HealthServer("127.0.0.1:0").start()
+    try:
+        http = HttpClient()
+        status, _ = http.get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200
+        assert http.last_response_headers  # populated by the real response
+        failpoints.configure("helper.request=error:1,count=1")
+        with pytest.raises(urllib.error.URLError):
+            http.get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert http.last_response_headers == {}  # stale headers cleared
+        status, _ = http.get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200  # budget spent: traffic flows again
+    finally:
+        srv.stop()
+
+
+def test_http_error_body_read_reset_is_retryable(monkeypatch):
+    """A connection reset while draining an HTTPError body surfaces as
+    a retryable URLError, not a raw ConnectionResetError."""
+    import email
+
+    from janus_tpu.core.http_client import HttpClient
+
+    class _ResettingBody:
+        def read(self):
+            raise ConnectionResetError(104, "Connection reset by peer")
+
+        def close(self):
+            pass
+
+    err = urllib.error.HTTPError(
+        "http://x/", 503, "busy", email.message_from_string("Retry-After: 1\n"),
+        _ResettingBody(),
+    )
+    # HTTPError.read delegates to the fp it was constructed with only
+    # when it has one; force the delegation explicitly for this double
+    monkeypatch.setattr(err, "read", _ResettingBody().read, raising=False)
+
+    def boom(*a, **k):
+        raise err
+
+    monkeypatch.setattr(urllib.request, "urlopen", boom)
+    http = HttpClient()
+    with pytest.raises(urllib.error.URLError) as ei:
+        http.request("GET", "http://x/")
+    assert not isinstance(ei.value, urllib.error.HTTPError)
+    # the retry loop treats URLError as any transport failure
+    from janus_tpu.core.retries import Backoff, retry_http_request
+
+    with pytest.raises(urllib.error.URLError):
+        retry_http_request(lambda: http.request("GET", "http://x/"), Backoff.test())
+
+
+def test_retry_attempt_failpoint_is_retried_and_bounded():
+    """retry.attempt injects transport errors INSIDE the retry loop; a
+    count budget below the backoff budget means the request still
+    succeeds after the storm passes."""
+    from janus_tpu.core.retries import Backoff, retry_http_request
+
+    failpoints.configure("retry.attempt=error:1,count=2")
+    calls = {"n": 0}
+
+    def do_request():
+        calls["n"] += 1
+        return 200, b"ok"
+
+    status, body = retry_http_request(do_request, Backoff.test())
+    assert (status, body) == (200, b"ok")
+    assert calls["n"] == 1  # two injected failures never reached do_request
+
+
+def test_report_writer_flush_failure_fans_out_and_recovers():
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import (
+        HpkeCiphertext,
+        HpkeConfigId,
+        ReportId,
+        Role,
+        Time,
+    )
+    from janus_tpu.datastore.models import LeaderStoredReport
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    import secrets
+
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)))
+    try:
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+            .with_(min_batch_size=1)
+            .build()
+        )
+        eph.datastore.run_tx(lambda tx: tx.put_task(task))
+        writer = ReportWriteBatcher(eph.datastore)
+
+        def report():
+            return LeaderStoredReport(
+                task.task_id,
+                ReportId(secrets.token_bytes(16)),
+                Time(1_600_000_000),
+                b"",
+                b"x",
+                HpkeCiphertext(HpkeConfigId(0), b"", b""),
+            )
+
+        failpoints.configure("report_writer.flush=error:1,count=1")
+        with pytest.raises(RuntimeError, match="injected flush failure"):
+            writer.write_report(report())
+        # the storm passed: the writer thread survived and commits again
+        assert writer.write_report(report()) is True
+    finally:
+        eph.cleanup()
+
+
+def test_ingest_decode_stage_failure_resolves_ticket():
+    from janus_tpu.ingest.pipeline import IngestPipeline
+
+    failpoints.configure("ingest.decode=error:1,count=1")
+    pipe = IngestPipeline(writer=None, decrypt_workers=1, queue_depth=4)
+    try:
+        ticket = pipe.submit(ta=None, clock=None, body=b"irrelevant")
+        with pytest.raises(FailpointError):
+            ticket.result(timeout_s=10)
+        assert pipe.depth()[0] == 0  # in-flight slot released
+    finally:
+        pipe.close()
+
+
+def test_engine_dispatch_oom_rides_recovery_path():
+    """engine.dispatch=oom:1,count=1 injects a RESOURCE_EXHAUSTED that
+    the EngineCache absorbs via the halved-bucket retry — the serving
+    path sees a slow success, never the injected exception."""
+    import numpy as np
+
+    from janus_tpu.aggregator.engine_cache import EngineCache
+    from janus_tpu.vdaf.registry import VdafInstance
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    inst = VdafInstance.count()
+    rng = np.random.default_rng(3)
+    (nonce, public, meas, proof, blind0, seeds, blind1), _ = make_report_batch(
+        inst, random_measurements(inst, 4, rng), seed=2
+    )
+    ok = np.ones(4, dtype=bool)
+    eng = EngineCache(inst, bytes(range(16)))
+    eng.bucket_cap = 32
+    out0, seed0, ver0, part0 = eng.leader_init(nonce, public, meas, proof, blind0)
+    failpoints.configure("engine.dispatch=oom:1,count=1")
+    _, mask, _ = eng.helper_init(nonce, public, seeds, blind1, ver0, part0, ok)
+    assert bool(mask.all())
+    assert eng._host_fallback is None  # recovered by retry, not fallback
+    assert failpoints.status()["failpoints"]["engine.dispatch"]["fired"] == 1

@@ -131,3 +131,124 @@ def test_streaming_pool_hung_job_does_not_block_others():
         stopper.stop()
         t.join(timeout=5)
     assert "hung" in done  # shutdown drained the in-flight step
+
+
+def test_retry_aborts_on_should_abort_mid_loop():
+    """SIGTERM mid-retry: should_abort() flips after the first attempt
+    and the loop raises RequestAborted promptly instead of retrying the
+    dead helper through the remaining backoff/lease budget."""
+    from janus_tpu.core.retries import RequestAborted
+
+    aborted = threading.Event()
+    calls = {"n": 0}
+
+    def do_request():
+        calls["n"] += 1
+        aborted.set()  # the 'signal' arrives while this attempt runs
+        return 503, b"unavailable"
+
+    with pytest.raises(RequestAborted):
+        retry_http_request(
+            do_request,
+            Backoff(initial=0.001, max_elapsed=60.0),
+            should_abort=aborted.is_set,
+        )
+    assert calls["n"] == 1
+
+
+def test_sigterm_drain_releases_lease_immediately():
+    """A step failing during shutdown hands its lease back through the
+    releaser (driver step_back) so the surviving peer reacquires NOW,
+    not after a full lease TTL; the attempt ledger survives."""
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Time
+    from test_lease_invariants import make_task, put_job
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        drv = AggregationJobDriver(ds, None)
+        acquired_box: list = []
+        in_step = threading.Event()
+        release_step = threading.Event()
+
+        def acquirer(limit):
+            if acquired_box:
+                return []
+            got = ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                    Duration(600), limit
+                )
+            )
+            acquired_box.extend(got)
+            return got
+
+        def stepper(acquired):
+            in_step.set()
+            release_step.wait(timeout=10)
+            raise RuntimeError("helper vanished mid-step (test)")
+
+        stopper = Stopper()
+        jd = JobDriver(
+            JobDriverConfig(job_discovery_interval_s=0.01),
+            acquirer,
+            stepper,
+            stopper,
+            releaser=lambda acq: drv.step_back(acq, "shutdown_drain", 0.0),
+        )
+        t = threading.Thread(target=jd.run, daemon=True)
+        t.start()
+        assert in_step.wait(timeout=10)
+        stopper.stop()  # SIGTERM: stop acquiring, drain in flight
+        release_step.set()  # the in-flight step now fails
+        t.join(timeout=10)
+        assert not t.is_alive()
+        # the 600s lease was released immediately: reacquirable without
+        # advancing the clock, and the refunded attempt lands back on 1
+        (re,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        assert re.lease.attempts == 1
+    finally:
+        eph.cleanup()
+
+
+def test_step_failure_without_shutdown_keeps_lease():
+    """Outside shutdown the age-out semantics are unchanged: a failed
+    step leaves the lease to expire (the retry pacing mechanism)."""
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Time
+    from test_lease_invariants import make_task, put_job
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        (acquired,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        released: list = []
+        stopper = Stopper()  # NOT stopped
+        jd = JobDriver(
+            JobDriverConfig(),
+            lambda limit: [],
+            lambda a: (_ for _ in ()).throw(RuntimeError("boom")),
+            stopper,
+            releaser=released.append,
+        )
+        jd._step_one(acquired)
+        assert released == []  # no shutdown: lease ages out as before
+        assert (
+            ds.run_tx(lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1))
+            == []
+        )
+    finally:
+        eph.cleanup()

@@ -149,6 +149,24 @@ def test_bench_dry_run_smoke():
     assert obs["profile_status_codes"] == [200, 409]  # concurrent capture 409s
     assert obs["profile_host_trace_loadable"] is True
     assert obs["scrape_check_rc"] == 0, obs.get("scrape_check_err")
+    # robustness (ISSUE 4): with JANUS_FAILPOINTS unset the failpoint
+    # sites compile to a no-op — sub-microsecond against the ms-scale
+    # upload/commit work they sit on (the bound is deliberately loose:
+    # it gates "accidentally armed / accidentally slow", not scheduler
+    # noise on a loaded 2-core runner)
+    fp = rec["failpoint_overhead"]
+    assert fp["disabled_ns_per_hit"] < 5_000, fp
+    # crash-recovery chaos smoke (scripts/chaos_run.py --smoke): driver
+    # killed between helper ack and leader commit, restart into a
+    # transport/5xx storm through the circuit breaker, lease reacquired
+    # within TTL, collection equals the admitted ground truth exactly
+    chaos = rec["chaos_smoke"]
+    assert chaos.get("ok") is True, chaos
+    assert chaos["crash_exit_code"] == 77  # failpoints.CRASH_EXIT_CODE
+    assert chaos["exactly_once_ok"] is True
+    assert chaos["lease_reacquired_within_ttl_ok"] is True
+    assert chaos["circuit_cycle_ok"] is True, chaos["circuit_transitions"]
+    assert chaos["drain_ok"] is True
 
 
 def test_collect_cli_end_to_end(capsys):
