@@ -621,7 +621,13 @@ def _tracing_overhead(iters: int = 1000) -> dict:
     # leak a writer into the rest of the run
     saved_writer = trace_mod._chrome_writer
     saved_otlp = trace_mod._otlp_exporter
+    saved_recorder = trace_mod._flight_recorder
     tmp = tempfile.mkdtemp(prefix="janus-bench-trace-")
+
+    class _NullRecorder:  # flight-recorder-off baseline (it is
+        def record(self, *a, **k):  # always armed in production)
+            pass
+
     try:
         trace_mod._chrome_writer = None
         trace_mod._otlp_exporter = None
@@ -633,8 +639,14 @@ def _tracing_overhead(iters: int = 1000) -> dict:
             workload_traced()
         # no-span baseline: disabled_vs_baseline isolates the cost of
         # the span machinery itself (contextvar + PRNG + the
-        # span->metric bridge lookup) with no exporter configured
+        # span->metric bridge lookup + the always-armed flight
+        # recorder) with no exporter configured
         baseline_rps, _ = measure(workload_plain)
+        # recorder-off vs recorder-armed: the marginal cost of the
+        # always-on flight recorder itself (ISSUE 6 "near-free" claim)
+        trace_mod._flight_recorder = _NullRecorder()
+        recorder_off_rps, recorder_off_ns = measure()
+        trace_mod._flight_recorder = saved_recorder
         disabled_rps, disabled_ns = measure()
 
         trace_mod.install_chrome_trace(os.path.join(tmp, "overhead.json"))
@@ -654,16 +666,19 @@ def _tracing_overhead(iters: int = 1000) -> dict:
     finally:
         trace_mod._chrome_writer = saved_writer
         trace_mod._otlp_exporter = saved_otlp
+        trace_mod._flight_recorder = saved_recorder
     return {
         "iters": iters,
         "spans_per_iter": 4,
         "baseline_rps": round(baseline_rps, 1),
         "disabled_vs_baseline": round(disabled_rps / baseline_rps, 3),
         "disabled_rps": round(disabled_rps, 1),
+        "recorder_off_rps": round(recorder_off_rps, 1),
         "chrome_rps": round(chrome_rps, 1),
         "otlp_rps": round(otlp_rps, 1),
         "chrome_vs_disabled": round(chrome_rps / disabled_rps, 3),
         "otlp_vs_disabled": round(otlp_rps / disabled_rps, 3),
+        "span_ns_recorder_off": round(recorder_off_ns),
         "span_ns_disabled": round(disabled_ns),
         "span_ns_chrome": round(chrome_ns),
         "span_ns_otlp": round(otlp_ns),
@@ -678,8 +693,11 @@ _SNAPSHOT_PREFIXES = (
     "janus_jobs",
     "janus_job_",
     "janus_oldest_",
+    "janus_unaggregated_",
     "janus_batches_",
     "janus_task_reports_",
+    "janus_report_",
+    "janus_span_",
     "janus_ingest_",
     "janus_upload_shed",
     "janus_database_",
@@ -743,6 +761,179 @@ def _scrape_health_listener(ds=None) -> dict:
         raise
 
 
+def _trace_lifecycle_smoke() -> dict:
+    """Prove the report-lifecycle tracing tentpole (ISSUE 6) on a live
+    loopback leader+helper pair with the two-round fake VDAF: the
+    creator persists a trace context in the aggregation job row; a
+    driver instance runs the init round; a SECOND, fresh driver
+    instance (the in-process analog of a driver restart — no shared
+    state beyond the datastore) runs the continue round; a collection
+    is created, persisted with its own trace context, and driven to a
+    released aggregate. The flight recorder must then show leader
+    driver spans and helper handler spans from BOTH rounds sharing the
+    persisted job trace id, the collect-finish span linking back to
+    it, and non-empty janus_report_e2e_seconds for both stages."""
+    import dataclasses
+
+    from janus_tpu import metrics as _m
+    from janus_tpu import trace as _tr
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    def _e2e_counts() -> dict:
+        fam = _m.REGISTRY.snapshot().get("janus_report_e2e_seconds", {})
+        return {
+            s["labels"].get("stage"): s["count"] for s in fam.get("samples", ())
+        }
+
+    e2e_before = _e2e_counts()
+    clock = MockClock(Time(1_600_000_000))
+    leader_eph = EphemeralDatastore(clock=clock)
+    helper_eph = EphemeralDatastore(clock=clock)
+    leader_ds, helper_ds = leader_eph.datastore, helper_eph.datastore
+    leader_srv = DapServer(DapHttpApp(Aggregator(leader_ds, clock, Config()))).start()
+    helper_srv = DapServer(DapHttpApp(Aggregator(helper_ds, clock, Config()))).start()
+    try:
+        vdaf = VdafInstance.fake_two_round()
+        collector_kp = generate_hpke_config_and_private_key(config_id=200)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=1),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task))
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task))
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        measurements = [1, 0, 1]
+        for m in measurements:
+            client.upload(m)
+
+        creator = AggregationJobCreator(
+            leader_ds, AggregationJobCreatorConfig(min_aggregation_job_size=1)
+        )
+        assert creator.run_once() == 1
+        job = leader_ds.run_tx(
+            lambda tx: tx.get_aggregation_jobs_for_task(leader_task.task_id)
+        )[0]
+        job_tc = job.trace_context
+        job_trace_id = _tr.trace_id_of(job_tc) or ""
+        helper_job_tc = None
+
+        # round 1 (init) with one driver instance, round 2 (continue)
+        # with a FRESH one: the only way the second can join the first's
+        # trace is through the persisted row — the restart story
+        jd_cfg = JobDriverConfig(max_concurrent_job_workers=1)
+        driver_a = AggregationJobDriver(leader_ds, http)
+        assert JobDriver(jd_cfg, driver_a.acquirer(), driver_a.stepper).run_once() == 1
+        helper_job = helper_ds.run_tx(
+            lambda tx: tx.get_aggregation_job(helper_task.task_id, job.job_id)
+        )
+        helper_job_tc = helper_job.trace_context if helper_job else None
+        driver_b = AggregationJobDriver(leader_ds, http)
+        assert JobDriver(jd_cfg, driver_b.acquirer(), driver_b.stepper).run_once() == 1
+
+        # collect end-to-end through the real collector + driver
+        start = Time(clock.now().seconds).to_batch_interval_start(
+            leader_task.time_precision
+        )
+        query = Query.time_interval(
+            Interval(Time(start.seconds - 3600), Duration(2 * 3600))
+        )
+        collector = Collector(
+            CollectorParameters(
+                leader_task.task_id,
+                leader_srv.url,
+                leader_task.collector_auth_token,
+                collector_kp,
+            ),
+            vdaf,
+            http,
+        )
+        cj_id = collector.start_collection(query)
+        cjob = leader_ds.run_tx(
+            lambda tx: tx.get_collection_job(leader_task.task_id, cj_id)
+        )
+        collection_tc = cjob.trace_context if cjob else None
+        cdriver = CollectionJobDriver(leader_ds, http)
+        assert JobDriver(jd_cfg, cdriver.acquirer(), cdriver.stepper).run_once() == 1
+        result = collector.poll_once(cj_id, query)
+
+        # the flight recorder (always armed — nothing was installed)
+        rec = _tr.flight_recorder()
+        spans = rec.snapshot(recent_limit=rec.capacity)["recent"]
+        in_job_trace = {s["name"] for s in spans if s["trace_id"] == job_trace_id}
+        finish = next(
+            (s for s in reversed(spans) if s["name"] == "driver.collect_finish"), None
+        )
+        linked = (finish or {}).get("args", {}).get("linked_traces", "")
+        e2e_after = _e2e_counts()
+        return {
+            "collected": result.report_count,
+            "aggregate": result.aggregate_result,
+            "job_trace_context_persisted": bool(job_tc),
+            # the helper's row carries the SAME trace id, adopted off
+            # the leader's wire request
+            "helper_row_same_trace": bool(
+                helper_job_tc and job_trace_id and job_trace_id in helper_job_tc
+            ),
+            "trace_span_names": sorted(in_job_trace),
+            "leader_init_span_in_trace": "driver.http_init" in in_job_trace,
+            "leader_continue_span_in_trace": "driver.http_continue" in in_job_trace,
+            "helper_init_span_in_trace": "dap.aggregate_init" in in_job_trace,
+            "helper_continue_span_in_trace": "dap.aggregate_continue" in in_job_trace,
+            "collection_trace_context_persisted": bool(collection_tc),
+            "collect_finish_span_in_collection_trace": bool(
+                finish
+                and collection_tc
+                and finish["trace_id"] == _tr.trace_id_of(collection_tc)
+            ),
+            "collect_links_include_job_trace": bool(job_trace_id) and job_trace_id in linked,
+            "e2e_aggregate_delta": e2e_after.get("aggregate", 0)
+            - e2e_before.get("aggregate", 0),
+            "e2e_collect_delta": e2e_after.get("collect", 0)
+            - e2e_before.get("collect", 0),
+        }
+    finally:
+        leader_srv.stop()
+        helper_srv.stop()
+        leader_eph.cleanup()
+        helper_eph.cleanup()
+
+
 def _observability_smoke() -> dict:
     """Drive the full observability surface on CPU and prove the
     acceptance criteria end-to-end: the live health listener's /metrics
@@ -778,6 +969,10 @@ def _observability_smoke() -> dict:
     )
     from janus_tpu.task import QueryTypeConfig, TaskBuilder
     from janus_tpu.vdaf.registry import VdafInstance
+
+    # the report-lifecycle tracing smoke runs FIRST so its e2e series
+    # and flight-recorder state are live in the scrape below
+    trace_lifecycle = _trace_lifecycle_smoke()
 
     # a label value that would corrupt an unescaped scrape
     _m.aggregate_step_failure_counter.add(type='hostile"label\nvalue\\end')
@@ -907,6 +1102,16 @@ def _observability_smoke() -> dict:
                 json.loads(raw if raw.endswith("]") else raw + "{}]")
                 host_trace_loadable = True
 
+        # the always-on flight recorder over live HTTP: /debug/traces
+        # must be valid JSON with the lifecycle smoke's spans in it
+        with urllib.request.urlopen(base + "/debug/traces?limit=50", timeout=10) as resp:
+            traces_doc = json.loads(resp.read())
+        debug_traces_ok = (
+            {"recent", "slow_traces", "digests", "recorded_total"} <= set(traces_doc)
+            and traces_doc["recorded_total"] > 0
+            and len(traces_doc["recent"]) > 0
+        )
+
         repo = pathlib.Path(__file__).resolve().parent
         check = subprocess.run(
             [
@@ -935,8 +1140,11 @@ def _observability_smoke() -> dict:
             .get("oldest_unaggregated_report_age_seconds", {}),
             "profile_status_codes": status_codes,
             "profile_host_trace_loadable": host_trace_loadable,
+            "debug_traces_ok": debug_traces_ok,
+            "statusz_flight_recorder_present": "flight_recorder" in statusz,
             "scrape_check_rc": check.returncode,
             "scrape_check_err": check.stderr[-500:] if check.returncode else "",
+            "trace_lifecycle": trace_lifecycle,
         }
     finally:
         srv.stop()

@@ -37,6 +37,43 @@ def count_reports_aggregated(task_id: TaskId, n: int) -> None:
     )
 
 
+def observe_report_e2e(clock, times, stage: str = "aggregate") -> None:
+    """Record janus_report_e2e_seconds{stage} for each client timestamp
+    in `times` (clock now - report time, floored at 0): the end-to-end
+    SLO signal "how old was this report when its output share was
+    verified/released". Call only AFTER the write transaction that
+    persists the work has committed — never inside a run_tx closure (a
+    retried transaction would observe every report again) and not
+    before the write (a failed step retried under a fresh lease would
+    leave phantom samples; same discipline as
+    count_reports_aggregated)."""
+    if clock is None or not times:
+        return
+    from .. import metrics
+
+    now = clock.now().seconds
+    for t in times:
+        metrics.report_e2e_seconds.observe(float(max(0, now - t.seconds)), stage=stage)
+
+
+def observe_finished_report_e2e(clock, ras, unmerged) -> None:
+    """Post-commit e2e observation for a write's report-aggregation
+    rows: only FINISHED rows whose report actually merged (not in the
+    committing attempt's `unmerged` set) count. One definition of the
+    retry-discipline-sensitive filter for every driver write path."""
+    from ..datastore.models import ReportAggregationState
+
+    observe_report_e2e(
+        clock,
+        [
+            ra.client_time
+            for ra in ras
+            if ra.state == ReportAggregationState.FINISHED
+            and ra.report_id.data not in unmerged
+        ],
+    )
+
+
 def add_encoded_aggregate_shares(field, a: bytes | None, b: bytes | None) -> bytes | None:
     """Element-wise mod-p sum of two encoded field vectors."""
     if a is None:
@@ -68,6 +105,10 @@ def accumulate_batched(
     `batch_identifier`: for fixed-size tasks, the job's BatchId bytes —
     every accepted lane lands in that one batch. None (time-interval
     tasks) buckets lanes by their time_precision window.
+
+    Does NOT record the e2e SLO histogram: callers observe via
+    observe_report_e2e AFTER their write transaction commits, so a
+    failed-and-retried step can't leave phantom samples.
     """
     import numpy as np
 

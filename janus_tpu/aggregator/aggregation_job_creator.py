@@ -31,6 +31,7 @@ from ..messages import (
     TimeInterval,
 )
 from ..task import Task
+from ..trace import current_traceparent, span
 
 
 @dataclass
@@ -195,19 +196,26 @@ class AggregationJobCreator:
     def _write_job_in_tx(self, tx, task: Task, claimed, pbs: PartialBatchSelector) -> None:
         job_id = AggregationJobId(secrets.token_bytes(16))
         times = [t.seconds for _, t in claimed]
-        job = AggregationJobModel(
-            task.task_id,
-            job_id,
-            b"",
-            pbs.to_bytes(),
-            Interval(Time(min(times)), Duration(max(times) - min(times) + 1)),
-            AggregationJobState.IN_PROGRESS,
-            0,
-        )
-        tx.put_aggregation_job(job)
-        for i, (rid, t) in enumerate(claimed):
-            tx.put_report_aggregation(
-                ReportAggregationModel(
-                    task.task_id, job_id, rid, t, i, ReportAggregationState.START
-                )
+        # the job's trace is rooted HERE: the creating span's context is
+        # persisted in the row, and both the leader driver (every step,
+        # every restart) and the helper (via the propagated traceparent)
+        # attach their spans under it — one trace id per job, across
+        # processes and time (docs/OBSERVABILITY.md)
+        with span("creator.create_job", reports=len(claimed)):
+            job = AggregationJobModel(
+                task.task_id,
+                job_id,
+                b"",
+                pbs.to_bytes(),
+                Interval(Time(min(times)), Duration(max(times) - min(times) + 1)),
+                AggregationJobState.IN_PROGRESS,
+                0,
+                trace_context=current_traceparent(),
             )
+            tx.put_aggregation_job(job)
+            for i, (rid, t) in enumerate(claimed):
+                tx.put_report_aggregation(
+                    ReportAggregationModel(
+                        task.task_id, job_id, rid, t, i, ReportAggregationState.START
+                    )
+                )

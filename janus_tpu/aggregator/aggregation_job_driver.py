@@ -258,7 +258,7 @@ class AggregationJobDriver:
                     )
             return task, job, ras, reports
 
-        from ..trace import span
+        from ..trace import span, use_traceparent
 
         with span("driver.read_tx"):
             task, job, ras, reports = self.ds.run_tx(read, "step_agg_job_read")
@@ -267,6 +267,17 @@ class AggregationJobDriver:
         if job.state != AggregationJobState.IN_PROGRESS:
             self.ds.run_tx(lambda tx: tx.release_aggregation_job(acquired), "release")
             return
+
+        # adopt the trace the job's CREATOR persisted in the row: every
+        # span below (stage/encode/http/engine/write — and the helper's
+        # handler spans, via the propagated traceparent header) joins
+        # that trace, no matter which driver process steps the job or
+        # how many restarts separate the steps
+        with use_traceparent(job.trace_context):
+            self._step_leased_job(acquired, task, job, ras, reports)
+
+    def _step_leased_job(self, acquired, task, job, ras, reports) -> None:
+        from ..trace import span
 
         # multi-round jobs park accepted reports in WaitingLeader after
         # init; a later step sends the continue request (reference
@@ -458,11 +469,16 @@ class AggregationJobDriver:
                 metrics.aggregate_step_failure_counter.add(type=err.name.lower())
                 new_ras.append(ra.failed(err))
 
+        # committing attempt's unmergeable set, carried out of the tx for
+        # the post-commit e2e observation (run_tx may retry the closure)
+        cell: dict = {}
+
         def write(tx):
             # flush first: reports whose batch was collected mid-flight
             # fail individually with BATCH_COLLECTED (reference
             # flush_to_datastore unmergeable set, accumulator.rs:133-215)
             unmerged = accumulator.flush_to_datastore(tx)
+            cell["unmerged"] = unmerged
             for ra in new_ras:
                 if ra.report_id.data in unmerged:
                     ra = ra.failed(PrepareError.BATCH_COLLECTED)
@@ -472,6 +488,11 @@ class AggregationJobDriver:
 
         with span("driver.write_tx", batch=n):
             self.ds.run_tx(write, "step_agg_job_write")
+        # e2e SLO observed only AFTER the write committed: a failed step
+        # retried under a fresh lease must not leave phantom samples
+        from .accumulator import observe_finished_report_e2e
+
+        observe_finished_report_e2e(self.ds.clock, new_ras, cell.get("unmerged", ()))
 
     def _step_poplar1_init(self, acquired, task: Task, job, pending, reports) -> None:
         """Poplar1 leader init (see aggregator.poplar1_ops docstring):
@@ -625,9 +646,12 @@ class AggregationJobDriver:
                 PrepareContinue(ra.report_id, msg) for ra, msg in zip(waiting, msgs)
             ),
         )
-        resp = self._send_continue_request(
-            task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
-        )
+        from ..trace import span
+
+        with span("driver.http_continue", reports=len(waiting)):
+            resp = self._send_continue_request(
+                task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
+            )
         by_id = {pr.report_id: pr for pr in resp.prepare_resps}
 
         accumulator = Accumulator(
@@ -668,9 +692,11 @@ class AggregationJobDriver:
         new_job = dataclasses.replace(
             job, state=AggregationJobState.FINISHED, step=job.step + 1
         )
+        cell: dict = {}
 
         def write(tx):
             unmerged = accumulator.flush_to_datastore(tx)
+            cell["unmerged"] = unmerged
             for ra in new_ras:
                 if ra.report_id.data in unmerged:
                     ra = ra.failed(PrepareError.BATCH_COLLECTED)
@@ -679,6 +705,10 @@ class AggregationJobDriver:
             tx.release_aggregation_job(acquired)
 
         self.ds.run_tx(write, "step_agg_job_continue_write")
+        # e2e SLO observed only post-commit (see the init path above)
+        from .accumulator import observe_finished_report_e2e
+
+        observe_finished_report_e2e(self.ds.clock, new_ras, cell.get("unmerged", ()))
 
     def _send_continue_request(
         self, task: Task, job_id, req: AggregationJobContinueReq, deadline: float | None = None

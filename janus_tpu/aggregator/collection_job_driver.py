@@ -130,6 +130,7 @@ class CollectionJobDriver:
 
     def step_collection_job(self, acquired: AcquiredCollectionJob) -> None:
         """reference step_collection_job_generic :108-300."""
+        from ..trace import use_traceparent
 
         def read(tx):
             task = tx.get_task(acquired.task_id)
@@ -143,6 +144,14 @@ class CollectionJobDriver:
             self.ds.run_tx(lambda tx: tx.release_collection_job(acquired), "release")
             return
 
+        # adopt the trace the collection-create handler persisted: the
+        # driver's spans (and the helper's aggregate_share handler, via
+        # the propagated traceparent) join the collector's trace across
+        # processes and driver restarts
+        with use_traceparent(job.trace_context):
+            self._step_leased_job(acquired, task, job)
+
+    def _step_leased_job(self, acquired: AcquiredCollectionJob, task: Task, job) -> None:
         if task.vdaf.has_aggregation_parameter:
             # parameterized VDAFs (Poplar1): aggregation happens per
             # collection parameter — the piece the reference punts on
@@ -160,21 +169,35 @@ class CollectionJobDriver:
             field = circuit_for(task.vdaf).FIELD
         query = Query.from_bytes(job.query)
 
-        # tx1: gather + mark collected (reference :160-199)
+        # tx1: gather + mark collected (reference :160-199); the same
+        # read also collects the covered aggregation jobs' persisted
+        # trace contexts — the collection span's causality links
         def gather(tx):
             if query.query_type == TimeInterval.CODE:
+                interval = Interval.from_bytes(job.batch_identifier)
                 rows = tx.get_batch_aggregations_intersecting_interval(
                     task.task_id,
-                    Interval.from_bytes(job.batch_identifier),
+                    interval,
                     aggregation_parameter=job.aggregation_parameter,
                 )
+                links = tx.get_aggregation_job_trace_contexts(
+                    task.task_id, interval=interval
+                )
             else:
+                from ..messages import PartialBatchSelector
+
                 rows = tx.get_batch_aggregations_for_batch(
                     task.task_id, job.batch_identifier, job.aggregation_parameter
                 )
-            return rows
+                links = tx.get_aggregation_job_trace_contexts(
+                    task.task_id,
+                    partial_batch_identifier=PartialBatchSelector.fixed_size(
+                        BatchId(job.batch_identifier)
+                    ).to_bytes(),
+                )
+            return rows, links
 
-        rows = self.ds.run_tx(gather, "step_collection_gather")
+        rows, links = self.ds.run_tx(gather, "step_collection_gather")
         share = None
         total = 0
         checksum = ReportIdChecksum()
@@ -229,9 +252,12 @@ class CollectionJobDriver:
         else:
             batch_selector = BatchSelector.fixed_size(BatchId(job.batch_identifier))
         req = AggregateShareReq(batch_selector, job.aggregation_parameter, total, checksum)
-        helper_share = self._send_aggregate_share_request(
-            task, req, deadline=self._lease_deadline(acquired)
-        )
+        from ..trace import span
+
+        with span("driver.http_aggregate_share", reports=total):
+            helper_share = self._send_aggregate_share_request(
+                task, req, deadline=self._lease_deadline(acquired)
+            )
 
         def mark_and_store(tx):
             for row in rows:
@@ -250,7 +276,36 @@ class CollectionJobDriver:
             )
             tx.release_collection_job(acquired)
 
-        self.ds.run_tx(mark_and_store, "step_collection_store")
+        # the finishing span links back to the aggregation jobs that
+        # filled the batch: their persisted trace ids ride as an
+        # attribute, so the flight recorder / Chrome trace shows which
+        # aggregation traces a released aggregate came from. Capped for
+        # span-attribute size, but never silently: the overflow shows.
+        from ..trace import trace_id_of
+
+        link_ids = sorted({t for t in (trace_id_of(h) for h in links) if t})
+        linked = ",".join(link_ids[:32])
+        with span(
+            "driver.collect_finish",
+            reports=total,
+            linked_traces=linked,
+            linked_truncated=len(link_ids) > 32,
+        ):
+            self.ds.run_tx(mark_and_store, "step_collection_store")
+        # collect-stage e2e SLO: batch close -> aggregate share
+        # released, outside the tx so a retry cannot double-observe.
+        # Batch close = the collected batch interval's end for
+        # time-interval queries (the documented boundary; the merged
+        # report interval can end long before it); fixed-size batch ids
+        # carry no time, so the newest report's window stands in.
+        if query.query_type == TimeInterval.CODE:
+            batch_close = Interval.from_bytes(job.batch_identifier).end.seconds
+        else:
+            batch_close = interval.end.seconds
+        metrics.report_e2e_seconds.observe(
+            float(max(0, self.ds.clock.now().seconds - batch_close)),
+            stage="collect",
+        )
 
     def _ensure_param_aggregation(self, task: Task, job) -> bool:
         """Create aggregation jobs for the collection's parameter over
@@ -278,6 +333,8 @@ class CollectionJobDriver:
                 task.task_id, [rid for rid, _ in in_interval], param
             )
             todo = [(rid, t) for rid, t in in_interval if rid.data not in done]
+            from ..trace import current_traceparent
+
             for lo in range(0, len(todo), 512):
                 chunk = todo[lo : lo + 512]
                 job_id = AggregationJobId(_secrets.token_bytes(16))
@@ -292,6 +349,9 @@ class CollectionJobDriver:
                         AggregationJobState.IN_PROGRESS,
                         0,
                         None,
+                        # param-driven jobs are spawned BY the collection:
+                        # they join its trace rather than rooting their own
+                        trace_context=current_traceparent(),
                     )
                 )
                 for ord_, (rid, t) in enumerate(chunk):

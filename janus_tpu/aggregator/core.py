@@ -471,6 +471,8 @@ class TaskAggregator:
                 )
 
         times = [pi.report_share.metadata.time.seconds for pi in inits]
+        from ..trace import current_traceparent
+
         job = AggregationJobModel(
             task.task_id,
             job_id,
@@ -480,6 +482,9 @@ class TaskAggregator:
             AggregationJobState.IN_PROGRESS if multi_round else AggregationJobState.FINISHED,
             0,
             request_hash,
+            # the leader's propagated traceparent: the helper's row
+            # records the same job trace id the leader persisted
+            trace_context=current_traceparent(),
         )
 
         def write(tx):
@@ -496,6 +501,20 @@ class TaskAggregator:
 
         with span("helper.write_tx", batch=n):
             unmerged = ds.run_tx(write, "aggregate_init")
+        # e2e SLO only after the commit (a retried request must not
+        # leave phantom samples); multi-round accumulates at continue
+        if not multi_round:
+            from .accumulator import observe_report_e2e
+
+            observe_report_e2e(
+                clock,
+                [
+                    pi.report_share.metadata.time
+                    for i, pi in enumerate(inits)
+                    if accept[i]
+                    and pi.report_share.metadata.report_id.data not in unmerged
+                ],
+            )
         if unmerged:
             resps = [
                 PrepareResp(
@@ -623,6 +642,8 @@ class TaskAggregator:
             )
 
         times = [pi.report_share.metadata.time.seconds for pi in inits]
+        from ..trace import current_traceparent
+
         job = AggregationJobModel(
             task.task_id,
             job_id,
@@ -634,6 +655,7 @@ class TaskAggregator:
             AggregationJobState.IN_PROGRESS,
             0,
             request_hash,
+            trace_context=current_traceparent(),
         )
 
         def write(tx):
@@ -843,6 +865,15 @@ class TaskAggregator:
 
             unmerged = accumulator.flush_to_datastore(tx)
             counted["n"] = accumulator.total_report_count() - len(unmerged)
+            # client times of the reports that actually merged, carried
+            # out of the committing attempt for the post-commit e2e
+            # observation (same retry discipline as the count)
+            counted["times"] = [
+                ra.client_time
+                for ra in updated
+                if ra.state == ReportAggregationState.FINISHED
+                and ra.report_id.data not in unmerged
+            ]
             tx.update_aggregation_job(
                 dataclasses.replace(
                     job,
@@ -874,9 +905,10 @@ class TaskAggregator:
             return AggregationJobResp(tuple(resps))
 
         resp = ds.run_tx(process, "aggregate_continue")
-        from .accumulator import count_reports_aggregated
+        from .accumulator import count_reports_aggregated, observe_report_e2e
 
         count_reports_aggregated(task.task_id, counted.get("n", 0))
+        observe_report_e2e(clock, counted.get("times", ()))
         return resp
 
     def _rebuild_continue_resps(self, tx, job_id, req) -> AggregationJobResp:
@@ -1019,6 +1051,8 @@ class TaskAggregator:
                 raise errors.BatchQueryCountExceeded(
                     "batch has reached max_batch_query_count", task.task_id
                 )
+            from ..trace import current_traceparent
+
             tx.put_collection_job(
                 CollectionJobModel(
                     task.task_id,
@@ -1027,6 +1061,9 @@ class TaskAggregator:
                     req.aggregation_parameter,
                     bid,
                     CollectionJobState.START,
+                    # the dap.collection_create handler span's context:
+                    # the collection job driver adopts it on every step
+                    trace_context=current_traceparent(),
                 )
             )
 

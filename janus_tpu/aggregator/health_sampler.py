@@ -11,6 +11,8 @@ and exports:
   janus_jobs{type,state}                          job backlog (gauge)
   janus_job_lease_age_seconds                     max outstanding lease age
   janus_oldest_unaggregated_report_age_seconds{task_id}
+  janus_unaggregated_report_age_seconds{task_id,quantile}
+                                                  freshness p50/p95/p99
   janus_batches_pending_collection                collection jobs pending
 
 plus a /statusz section with the latest snapshot. The companion
@@ -47,6 +49,7 @@ class HealthSampler:
         self._lease_first_seen: dict[tuple, int] = {}
         # task_id labels we exported last pass (stale ones reset to 0)
         self._lag_tasks: set[str] = set()
+        self._quantile_tasks: set[str] = set()
         self.last_snapshot: dict = {}
         from ..statusz import register_status_provider
 
@@ -85,21 +88,41 @@ class HealthSampler:
                 del self._lease_first_seen[key]
         metrics.job_lease_age_seconds.set(float(max_age))
 
-        oldest = self.ds.run_tx(
-            lambda tx: tx.min_unaggregated_report_time_by_task(),
-            "health_oldest_unaggregated",
+        # one scan feeds BOTH the oldest-age gauge (exact min) and the
+        # freshness DISTRIBUTION — per-task p50/p95/p99 unaggregated
+        # ages (a single stuck report and a systemically lagging task
+        # look identical on the min alone)
+        quants = self.ds.run_tx(
+            lambda tx: tx.unaggregated_report_time_quantiles_by_task(),
+            "health_freshness_quantiles",
         )
         seen_tasks = set()
         lag_by_task = {}
-        for task_id, min_time in oldest:
+        freshness = {}
+        for task_id, count, min_time, vals in quants:
             label = _b64_task_id(bytes(task_id))
             seen_tasks.add(label)
             age = float(max(0, now - min_time))
             lag_by_task[label] = age
             metrics.oldest_unaggregated_report_age_seconds.set(age, task_id=label)
+            per_task = {"count": count}
+            for q, t in vals.items():
+                qlabel = f"p{round(q * 100):d}"
+                qage = float(max(0, now - t))
+                per_task[qlabel] = qage
+                metrics.unaggregated_report_age_quantiles.set(
+                    qage, task_id=label, quantile=qlabel
+                )
+            freshness[label] = per_task
         for label in self._lag_tasks - seen_tasks:
             metrics.oldest_unaggregated_report_age_seconds.set(0.0, task_id=label)
+        for label in self._quantile_tasks - seen_tasks:
+            for qlabel in ("p50", "p95", "p99"):
+                metrics.unaggregated_report_age_quantiles.set(
+                    0.0, task_id=label, quantile=qlabel
+                )
         self._lag_tasks = seen_tasks
+        self._quantile_tasks = seen_tasks
 
         pending = self.ds.run_tx(
             lambda tx: tx.count_batches_pending_collection(), "health_batches_pending"
@@ -112,6 +135,7 @@ class HealthSampler:
             "outstanding_leases": len(leases),
             "max_lease_age_seconds": max_age,
             "oldest_unaggregated_report_age_seconds": lag_by_task,
+            "unaggregated_report_age_quantiles": freshness,
             "batches_pending_collection": pending,
             "interval_s": self.interval_s,
         }

@@ -237,6 +237,24 @@ class HealthServer:
                     self._send(
                         200, "application/json", _json.dumps(REGISTRY.snapshot()).encode()
                     )
+                elif parts.path == "/debug/traces":
+                    # always-on flight recorder: recent completed spans,
+                    # captured slow traces, per-name latency digests
+                    # (?limit=N bounds the recent list)
+                    from .trace import flight_recorder
+
+                    try:
+                        limit = max(1, min(int(query.get("limit", "100")), 10_000))
+                    except ValueError:
+                        limit = 100
+                    self._send(
+                        200,
+                        "application/json",
+                        _json.dumps(
+                            flight_recorder().snapshot(recent_limit=limit),
+                            default=str,
+                        ).encode(),
+                    )
                 else:
                     self._send(404, "text/plain", b"not found")
 

@@ -96,6 +96,11 @@ class AggregationJobModel:
     state: AggregationJobState
     step: int
     last_request_hash: bytes | None = None
+    # W3C traceparent persisted by whoever created the job (the leader's
+    # job creator / the helper's init handler); both job drivers adopt it
+    # so a step's spans join the creating trace across processes and
+    # driver restarts (janus_tpu.trace.use_traceparent)
+    trace_context: str | None = None
 
     def with_state(self, state: AggregationJobState) -> "AggregationJobModel":
         return replace(self, state=state)
@@ -200,6 +205,9 @@ class CollectionJobModel:
     client_timestamp_interval: Interval | None = None
     leader_aggregate_share: bytes | None = None  # encrypted at rest
     helper_encrypted_aggregate_share: bytes | None = None
+    # W3C traceparent persisted by the collection-create handler; the
+    # collection job driver adopts it (see AggregationJobModel)
+    trace_context: str | None = None
 
 
 @dataclass(frozen=True)

@@ -82,7 +82,7 @@ from .models import (
     ReportAggregationState,
 )
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # POSTGRES TRANSLATION CONSTRAINTS (tests/test_pg_dialect.py enforces):
 # the Postgres engine derives its DDL from this exact text via
@@ -124,6 +124,7 @@ CREATE TABLE IF NOT EXISTS aggregation_jobs (
     state TEXT NOT NULL,
     step INTEGER NOT NULL DEFAULT 0,
     last_request_hash BLOB,
+    trace_context TEXT,          -- W3C traceparent of the creating span
     lease_expiry INTEGER NOT NULL DEFAULT 0,
     lease_token BLOB,
     lease_attempts INTEGER NOT NULL DEFAULT 0,
@@ -173,6 +174,7 @@ CREATE TABLE IF NOT EXISTS collection_jobs (
     client_interval_duration INTEGER,
     leader_aggregate_share BLOB,           -- encrypted
     helper_encrypted_aggregate_share BLOB,
+    trace_context TEXT,          -- W3C traceparent of the creating span
     lease_expiry INTEGER NOT NULL DEFAULT 0,
     lease_token BLOB,
     lease_attempts INTEGER NOT NULL DEFAULT 0,
@@ -525,7 +527,7 @@ class Transaction:
         self._c.execute(
             "INSERT INTO aggregation_jobs (task_id, job_id, aggregation_parameter,"
             " partial_batch_identifier, client_interval_start, client_interval_duration,"
-            " state, step, last_request_hash) VALUES (?,?,?,?,?,?,?,?,?)",
+            " state, step, last_request_hash, trace_context) VALUES (?,?,?,?,?,?,?,?,?,?)",
             (
                 job.task_id.data,
                 job.job_id.data,
@@ -536,13 +538,14 @@ class Transaction:
                 job.state.value,
                 job.step,
                 job.last_request_hash,
+                job.trace_context,
             ),
         )
 
     def get_aggregation_job(self, task_id: TaskId, job_id: AggregationJobId) -> AggregationJobModel | None:
         row = self._c.execute(
             "SELECT aggregation_parameter, partial_batch_identifier, client_interval_start,"
-            " client_interval_duration, state, step, last_request_hash"
+            " client_interval_duration, state, step, last_request_hash, trace_context"
             " FROM aggregation_jobs WHERE task_id = ? AND job_id = ?",
             (task_id.data, job_id.data),
         ).fetchone()
@@ -557,6 +560,7 @@ class Transaction:
             AggregationJobState(row[4]),
             row[5],
             row[6],
+            row[7],
         )
 
     def update_aggregation_job(self, job: AggregationJobModel) -> None:
@@ -942,7 +946,7 @@ class Transaction:
     def put_collection_job(self, job: CollectionJobModel) -> None:
         self._c.execute(
             "INSERT INTO collection_jobs (task_id, collection_job_id, query, aggregation_parameter,"
-            " batch_identifier, state) VALUES (?,?,?,?,?,?)",
+            " batch_identifier, state, trace_context) VALUES (?,?,?,?,?,?,?)",
             (
                 job.task_id.data,
                 job.collection_job_id.data,
@@ -950,6 +954,7 @@ class Transaction:
                 job.aggregation_parameter,
                 job.batch_identifier,
                 job.state.value,
+                job.trace_context,
             ),
         )
 
@@ -959,7 +964,7 @@ class Transaction:
         row = self._c.execute(
             "SELECT query, aggregation_parameter, batch_identifier, state, report_count,"
             " client_interval_start, client_interval_duration, leader_aggregate_share,"
-            " helper_encrypted_aggregate_share FROM collection_jobs"
+            " helper_encrypted_aggregate_share, trace_context FROM collection_jobs"
             " WHERE task_id = ? AND collection_job_id = ?",
             (task_id.data, collection_job_id.data),
         ).fetchone()
@@ -982,6 +987,7 @@ class Transaction:
             Interval(Time(row[5]), Duration(row[6])) if row[5] is not None else None,
             las,
             row[8],
+            row[9],
         )
 
     def get_collection_job_batches_for_task(self, task_id: TaskId) -> list[tuple[bytes, bytes, str]]:
@@ -1379,6 +1385,91 @@ class Transaction:
                 " WHERE state IN ('start', 'collectable')"
             ).fetchone()[0]
         )
+
+    def unaggregated_report_time_quantiles_by_task(
+        self, quantiles: tuple[float, ...] = (0.5, 0.95, 0.99), bucket_s: int = 60
+    ) -> list[tuple[bytes, int, int, dict[float, int]]]:
+        """[(task_id, count, exact oldest client_time, {q: client_time
+        at the q age-quantile})] over unaggregated reports — the
+        freshness DISTRIBUTION (plus the exact min, so the sampler
+        feeds the oldest-age gauge and the quantile gauges from ONE
+        scan instead of walking the partial index twice per tick).
+
+        One index-only scan, aggregated DB-side into `bucket_s`-wide
+        client_time buckets (integer division truncates identically on
+        both engines), so a million-report backlog transfers a few
+        hundred rows and the sampler never does per-quantile OFFSET
+        walks. Quantiles come from the histogram: the q age-quantile is
+        the bucket holding the report at 1-based rank n - ceil(q*(n-1))
+        counting from the OLDEST, reported as that bucket's older edge
+        — both choices bias toward the older report, the conservative
+        direction for an SLO gauge. bucket_s bounds the resolution
+        error (default one minute, far below any meaningful
+        aggregation-lag alert threshold)."""
+        import math
+
+        rows = self._c.execute(
+            "SELECT task_id, client_time / ?, COUNT(*), MIN(client_time)"
+            " FROM client_reports"
+            " WHERE aggregation_started = 0 GROUP BY task_id, client_time / ?"
+            " ORDER BY task_id, client_time / ?",
+            (bucket_s, bucket_s, bucket_s),
+        ).fetchall()
+        by_task: dict[bytes, list[tuple[int, int, int]]] = {}
+        for task_id, bucket, cnt, bucket_min in rows:
+            by_task.setdefault(task_id, []).append(
+                (int(bucket), int(cnt), int(bucket_min))
+            )
+        out: list[tuple[bytes, int, int, dict[float, int]]] = []
+        for task_id, buckets in by_task.items():
+            n = sum(c for _, c, _ in buckets)
+            oldest = buckets[0][2]  # ascending: first bucket holds the min
+            vals: dict[float, int] = {}
+            for q in quantiles:
+                rank = n - math.ceil(q * (n - 1))
+                cum = 0
+                for bucket, cnt, _ in buckets:  # ascending time = oldest first
+                    cum += cnt
+                    if cum >= rank:
+                        vals[q] = bucket * bucket_s
+                        break
+            out.append((task_id, n, oldest, vals))
+        return out
+
+    def get_aggregation_job_trace_contexts(
+        self,
+        task_id: TaskId,
+        interval: Interval | None = None,
+        partial_batch_identifier: bytes | None = None,
+        limit: int = 64,
+    ) -> list[str]:
+        """Distinct persisted trace contexts of the aggregation jobs a
+        collection covers (time-interval INTERSECTION — the same
+        semantics as the batch gather, so a job whose claimed reports
+        straddle the collection boundary still links — or fixed-size
+        partial-batch-selector match) — the collection span's causality
+        links back to the aggregation work that filled the batch.
+        Callers wanting to detect truncation ask for one more than they
+        display."""
+        if interval is not None:
+            rows = self._c.execute(
+                "SELECT DISTINCT trace_context FROM aggregation_jobs"
+                " WHERE task_id = ? AND trace_context IS NOT NULL"
+                " AND client_interval_start < ?"
+                " AND client_interval_start + client_interval_duration > ?"
+                " LIMIT ?",
+                (task_id.data, interval.end.seconds, interval.start.seconds, limit),
+            ).fetchall()
+        elif partial_batch_identifier is not None:
+            rows = self._c.execute(
+                "SELECT DISTINCT trace_context FROM aggregation_jobs"
+                " WHERE task_id = ? AND trace_context IS NOT NULL"
+                " AND partial_batch_identifier = ? LIMIT ?",
+                (task_id.data, partial_batch_identifier, limit),
+            ).fetchall()
+        else:
+            return []
+        return [str(r[0]) for r in rows]
 
     # ---- GC (reference datastore.rs:4162-4315) ----
     def delete_expired_aggregation_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> int:

@@ -237,9 +237,16 @@ class IngestPipeline:
             def on_done(pending, ticket=ticket, t_commit=t_commit):
                 # flusher thread: the group commit carrying this report
                 # finished (fresh/replay) or failed
-                metrics.ingest_stage_duration.observe(
-                    time.monotonic() - t_commit, stage="commit"
-                )
+                wait_s = time.monotonic() - t_commit
+                metrics.ingest_stage_duration.observe(wait_s, stage="commit")
+                # marker span in the upload's trace: its position shows
+                # WHEN the group commit landed relative to decrypt, and
+                # its wait_s attribute carries the queue-to-durable gap
+                # (the flight recorder keeps it even with no writer)
+                with trace.use_context(ticket.trace_ctx), trace.span(
+                    "ingest.commit", wait_s=round(wait_s, 6)
+                ):
+                    pass
                 if pending.error is not None:
                     self._resolve(ticket, error=pending.error)
                 else:

@@ -385,6 +385,38 @@ job_step_back_total = REGISTRY.counter(
     "instead of failing, by reason",
 )
 
+# --- report-lifecycle tracing + end-to-end SLOs (ISSUE 6;
+# docs/OBSERVABILITY.md "Report-lifecycle tracing") ---
+span_errors_total = REGISTRY.counter(
+    "janus_span_errors_total",
+    "spans that exited with an exception (error=<ExcType> on the emitted "
+    "event), by span name",
+)
+otlp_spans_dropped_total = REGISTRY.counter(
+    "janus_otlp_spans_dropped_total",
+    "spans dropped oldest-first from the OTLP export buffer while the "
+    "collector was unreachable",
+)
+# DAP end-to-end latency runs seconds-to-hours (upload -> aggregate ->
+# collectable batch); the default DB/HTTP buckets top out at 30s
+E2E_BUCKETS = (
+    0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 1800.0, 3600.0, 7200.0,
+    21600.0, 86400.0,
+)
+report_e2e_seconds = REGISTRY.histogram(
+    "janus_report_e2e_seconds",
+    "end-to-end DAP latency by stage: client report timestamp to verified "
+    'output share (stage="aggregate", observed at accumulate time) and batch '
+    'close to aggregate share released (stage="collect")',
+    buckets=E2E_BUCKETS,
+)
+unaggregated_report_age_quantiles = REGISTRY.gauge(
+    "janus_unaggregated_report_age_seconds",
+    "per-task age quantiles (p50/p95/p99) of reports not yet claimed by an "
+    "aggregation job (sampled; the freshness distribution behind the "
+    "oldest-report gauge)",
+)
+
 
 def _register_span_bridges() -> None:
     """Bind the engine span names to janus_engine_dispatch_seconds via

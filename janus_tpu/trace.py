@@ -12,8 +12,10 @@ the device-side `jax.profiler.trace` output (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import atexit
+import collections
 import json
 import logging
+import math
 import os
 import random
 import sys
@@ -60,14 +62,45 @@ class TraceConfiguration:
 class ChromeTraceWriter:
     """Streams complete ('X') trace events; the file is a JSON array
     readable by chrome://tracing and Perfetto even if the tail comma
-    is left dangling on crash."""
+    is left dangling on crash.
 
-    def __init__(self, path: str):
+    Events are buffered and flushed on a size/time threshold (a daemon
+    flusher covers the idle case — a burst followed by silence still
+    reaches disk within FLUSH_INTERVAL_S) and on close() — the previous
+    per-event write+flush cost ~45 µs/span (bench `tracing_overhead`,
+    PR 3), dominating the span hot path. Crash tolerance trades down
+    accordingly: at most FLUSH_BYTES / FLUSH_INTERVAL_S of tail spans
+    can be lost with the process (the flight recorder keeps them in
+    memory regardless)."""
+
+    FLUSH_BYTES = 64 * 1024
+    FLUSH_INTERVAL_S = 1.0
+
+    def __init__(self, path: str, flush_interval_s: float | None = None):
         self._f = open(path, "w")
         self._f.write("[\n")
         self._lock = threading.Lock()
         self._pid = os.getpid()
         self._closed = False
+        self._buf: list[str] = []
+        self._buf_bytes = 0
+        self._last_flush = time.monotonic()
+        self._flush_interval = (
+            flush_interval_s if flush_interval_s is not None else self.FLUSH_INTERVAL_S
+        )
+        self._stop_flusher = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="chrome-trace-flush", daemon=True
+        )
+        self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        while not self._stop_flusher.wait(self._flush_interval):
+            with self._lock:
+                if self._closed:
+                    return
+                if self._buf:
+                    self._flush_locked()
 
     def event(self, name: str, ts_us: float, dur_us: float, args: dict) -> None:
         doc = {
@@ -79,19 +112,35 @@ class ChromeTraceWriter:
             "tid": threading.get_ident() % 1_000_000,
             "args": args,
         }
+        line = json.dumps(doc) + ",\n"
         with self._lock:
             if self._closed:
                 return  # a daemon thread's span outlived the writer
-            try:
-                self._f.write(json.dumps(doc) + ",\n")
-                self._f.flush()
-            except ValueError:
-                self._closed = True
+            self._buf.append(line)
+            self._buf_bytes += len(line)
+            now = time.monotonic()
+            if (
+                self._buf_bytes >= self.FLUSH_BYTES
+                or now - self._last_flush >= self._flush_interval
+            ):
+                self._flush_locked(now)
+
+    def _flush_locked(self, now: float | None = None) -> None:
+        try:
+            self._f.write("".join(self._buf))
+            self._f.flush()
+        except ValueError:
+            self._closed = True
+        self._buf.clear()
+        self._buf_bytes = 0
+        self._last_flush = now if now is not None else time.monotonic()
 
     def close(self) -> None:
+        self._stop_flusher.set()
         with self._lock:
             if self._closed:
                 return
+            self._flush_locked()
             self._closed = True
             try:
                 self._f.write("{}]\n")
@@ -107,6 +156,12 @@ class OtlpExporter:
     The reference ships the same capability via the opentelemetry-otlp
     crate (aggregator/src/trace.rs:44-90, metrics.rs:53-80)."""
 
+    # Bound on spans buffered between flushes: a down collector must
+    # not let the buffer grow with load for a whole flush interval;
+    # past the cap the OLDEST spans drop (counted by
+    # janus_otlp_spans_dropped_total) so the freshest context survives.
+    MAX_BUFFERED_SPANS = 4096
+
     def __init__(self, endpoint: str, service_name: str = "janus_tpu", flush_interval_s: float = 5.0):
         self.endpoint = endpoint.rstrip("/")
         self._resource = {
@@ -117,6 +172,10 @@ class OtlpExporter:
         }
         self._spans: list[dict] = []
         self._lock = threading.Lock()
+        # a hung collector must not stall the flush loop past its own
+        # interval (the old fixed 10 s timeout could back the loop up
+        # 2x per flush at the default 5 s interval)
+        self._post_timeout = max(0.1, min(float(flush_interval_s), 5.0))
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, args=(flush_interval_s,), daemon=True
@@ -139,8 +198,17 @@ class OtlpExporter:
         }
         if parent_span_id is not None:
             doc["parentSpanId"] = _hex(parent_span_id, 16)
+        dropped = 0
         with self._lock:
             self._spans.append(doc)
+            overflow = len(self._spans) - self.MAX_BUFFERED_SPANS
+            if overflow > 0:
+                del self._spans[:overflow]
+                dropped = overflow
+        if dropped:
+            from . import metrics
+
+            metrics.otlp_spans_dropped_total.add(dropped)
 
     @staticmethod
     def _any_value(v):
@@ -163,7 +231,7 @@ class OtlpExporter:
             method="POST",
         )
         try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
+            with urllib.request.urlopen(req, timeout=self._post_timeout) as resp:
                 resp.read()
         except Exception:
             logging.getLogger(__name__).debug("OTLP export to %s failed", path, exc_info=True)
@@ -360,34 +428,71 @@ def current_traceparent() -> str | None:
 _HEX_DIGITS = frozenset("0123456789abcdef")
 
 
+def _parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """(trace_id, span_id) from a W3C traceparent, or None when the
+    header is absent/malformed. Per the spec, ids must be lowercase hex
+    and non-zero, the version 2 hex digits != 'ff', flags 2 hex."""
+    if not header:
+        return None
+    parts = header.split("-")
+    if (
+        len(parts) == 4
+        and len(parts[0]) == 2
+        and len(parts[1]) == 32
+        and len(parts[2]) == 16
+        and len(parts[3]) == 2
+        and set(parts[0]) <= _HEX_DIGITS
+        and set(parts[1]) <= _HEX_DIGITS
+        and set(parts[2]) <= _HEX_DIGITS
+        and set(parts[3]) <= _HEX_DIGITS
+        and parts[0] != "ff"  # W3C: version 0xff is invalid
+        and set(parts[1]) != {"0"}
+        and set(parts[2]) != {"0"}
+    ):
+        return parts[1], parts[2]
+    return None
+
+
+def trace_id_of(header: str | None) -> str | None:
+    """Validated trace id of a traceparent header (the persisted
+    trace_context column), or None — the one place that parses it for
+    display/linking (driver linked_traces, bench, tests)."""
+    parsed = _parse_traceparent(header)
+    return parsed[0] if parsed else None
+
+
 def adopt_traceparent(header: str | None):
     """Enter the trace context of an incoming request (or clear it if
     the header is absent/malformed — the handler's span then starts a
     fresh trace as a true root, with no phantom parent). Returns a
-    token for contextvars reset. Per W3C trace-context, ids must be
-    lowercase hex and non-zero; anything else is treated as absent."""
-    if header:
-        parts = header.split("-")
-        if (
-            len(parts) == 4
-            and len(parts[0]) == 2
-            and len(parts[1]) == 32
-            and len(parts[2]) == 16
-            and len(parts[3]) == 2
-            and set(parts[0]) <= _HEX_DIGITS
-            and set(parts[1]) <= _HEX_DIGITS
-            and set(parts[2]) <= _HEX_DIGITS
-            and set(parts[3]) <= _HEX_DIGITS
-            and parts[0] != "ff"  # W3C: version 0xff is invalid
-            and set(parts[1]) != {"0"}
-            and set(parts[2]) != {"0"}
-        ):
-            return _trace_ctx.set((parts[1], parts[2]))
+    token for contextvars reset."""
+    parsed = _parse_traceparent(header)
+    if parsed is not None:
+        return _trace_ctx.set(parsed)
     return _trace_ctx.set(None)
 
 
 def reset_traceparent(token) -> None:
     _trace_ctx.reset(token)
+
+
+@contextmanager
+def use_traceparent(header: str | None):
+    """Run the body under a PERSISTED trace context (the datastore
+    `trace_context` column on aggregation/collection jobs): spans opened
+    inside become children of the span that created the job — across
+    processes and across driver restarts, because the header round-trips
+    through the database rather than living in any process. A falsy
+    header is a no-op (the caller's ambient context is preserved), so
+    rows written before the column existed keep today's behavior."""
+    if not header:
+        yield
+        return
+    token = adopt_traceparent(header)
+    try:
+        yield
+    finally:
+        _trace_ctx.reset(token)
 
 
 def current_context():
@@ -441,16 +546,228 @@ def _bridge_span(name: str, dur_s: float, args: dict) -> None:
     hist.observe(dur_s, **labels)
 
 
+# ---------------------------------------------------------------------------
+# Flight recorder: an always-on, bounded, in-process ring of completed
+# spans. Unlike the Chrome/OTLP writers (opt-in, file/network), this is
+# always armed, so "where did THIS report's time go" is answerable
+# after the fact without having pre-arranged a capture window:
+#
+#   - a deque ring of the last N completed spans (GIL-atomic appends —
+#     no lock on the ring itself),
+#   - per-name streaming latency digests (log2-microsecond buckets ->
+#     p50/p95/p99 without storing samples),
+#   - slow-op capture: when a ROOT span exceeds its per-name threshold,
+#     the whole span tree still present in the ring is retained in a
+#     separate bounded buffer (children complete before their root, so
+#     the tree is intact unless ring churn evicted it first).
+#
+# Served as GET /debug/traces on every binary's health listener and as
+# a /statusz section (binary_utils.HealthServer).
+# ---------------------------------------------------------------------------
+
+# log2(microsecond) duration buckets: index i covers [2^i, 2^(i+1)) µs;
+# 40 buckets reach ~12.7 days — far past any span this system emits
+_DIGEST_BUCKETS = 40
+
+
+class _NameDigest:
+    __slots__ = ("count", "errors", "sum_s", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.errors = 0
+        self.sum_s = 0.0
+        self.buckets = [0] * _DIGEST_BUCKETS
+
+    def observe(self, dur_s: float, error: bool) -> None:
+        us = dur_s * 1e6
+        idx = 0 if us < 2.0 else min(int(us).bit_length() - 1, _DIGEST_BUCKETS - 1)
+        self.buckets[idx] += 1
+        self.count += 1
+        self.sum_s += dur_s
+        if error:
+            self.errors += 1
+
+    def quantile(self, q: float) -> float:
+        """Upper bound (seconds) of the bucket holding the q-quantile."""
+        if self.count == 0:
+            return 0.0
+        target = max(1, math.ceil(q * self.count))
+        cum = 0
+        for i, c in enumerate(self.buckets):
+            cum += c
+            if cum >= target:
+                return (1 << (i + 1)) / 1e6
+        return (1 << _DIGEST_BUCKETS) / 1e6
+
+    def doc(self) -> dict:
+        return {
+            "count": self.count,
+            "errors": self.errors,
+            "mean_s": round(self.sum_s / self.count, 6) if self.count else 0.0,
+            "p50_s": self.quantile(0.50),
+            "p95_s": self.quantile(0.95),
+            "p99_s": self.quantile(0.99),
+        }
+
+
+class FlightRecorder:
+    """See the section comment above. `capacity` and the default slow
+    threshold come from JANUS_FLIGHT_RECORDER_SPANS /
+    JANUS_SLOW_TRACE_THRESHOLD_S when not passed explicitly."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        slow_capacity: int = 8,
+        slow_threshold_s: float | None = None,
+    ):
+        if capacity is None:
+            capacity = int(os.environ.get("JANUS_FLIGHT_RECORDER_SPANS", "512"))
+        self.capacity = max(16, capacity)
+        if slow_threshold_s is None:
+            slow_threshold_s = float(
+                os.environ.get("JANUS_SLOW_TRACE_THRESHOLD_S", "1.0")
+            )
+        self.default_slow_threshold_s = slow_threshold_s
+        # ring entries: (name, trace_id, span_id, parent_span_id,
+        # start_unix_ns, dur_s, args, error) — ids raw (int | hex str),
+        # hex-formatted only at snapshot time to keep record() cheap
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._slow: collections.deque = collections.deque(maxlen=max(1, slow_capacity))
+        self._slow_thresholds: dict[str, float] = {}
+        self._digests: dict[str, _NameDigest] = {}
+        # guards digests + slow capture only; the ring rides the GIL
+        self._lock = threading.Lock()
+        self._recorded = 0
+
+    def set_slow_threshold(self, name: str, seconds: float) -> None:
+        """Per-root-span-name slow-capture threshold: a root span of
+        `name` lasting >= `seconds` captures its tree. 0 captures every
+        root span of that name (tests); negative disables the name."""
+        self._slow_thresholds[name] = float(seconds)
+
+    def record(
+        self, name, trace_id, span_id, parent_span_id, start_unix_ns, dur_s, args, error
+    ) -> None:
+        entry = (name, trace_id, span_id, parent_span_id, start_unix_ns, dur_s, args, error)
+        self._ring.append(entry)
+        with self._lock:
+            self._recorded += 1
+            digest = self._digests.get(name)
+            if digest is None:
+                digest = self._digests[name] = _NameDigest()
+            digest.observe(dur_s, error is not None)
+            # slow capture triggers on LOCAL roots: spans with no parent
+            # at all, or whose parent is remote (hex-string ids adopted
+            # from a traceparent header / persisted trace_context —
+            # locally generated parents are ints). Without the latter, a
+            # driver step's work spans — all children of the persisted
+            # creator span — could never trigger capture in THIS process.
+            if parent_span_id is None or isinstance(parent_span_id, str):
+                threshold = self._slow_thresholds.get(name, self.default_slow_threshold_s)
+                if 0 < threshold <= dur_s or (threshold == 0.0 and name in self._slow_thresholds):
+                    # whole tree still in the ring (children completed
+                    # first); list() snapshots the deque atomically
+                    tree = [e for e in list(self._ring) if e[1] == trace_id]
+                    self._slow.append(
+                        {
+                            "root": name,
+                            "trace_id": _hex(trace_id, 32),
+                            "duration_s": round(dur_s, 6),
+                            "threshold_s": threshold,
+                            "captured_unix_ns": start_unix_ns + int(dur_s * 1e9),
+                            "spans": [self._entry_doc(e) for e in tree],
+                        }
+                    )
+
+    @staticmethod
+    def _entry_doc(entry) -> dict:
+        name, trace_id, span_id, parent, start_ns, dur_s, args, error = entry
+        doc = {
+            "name": name,
+            "trace_id": _hex(trace_id, 32),
+            "span_id": _hex(span_id, 16),
+            "start_unix_ns": str(start_ns),
+            "duration_s": round(dur_s, 6),
+        }
+        if parent is not None:
+            doc["parent_span_id"] = _hex(parent, 16)
+        if args:
+            doc["args"] = {k: v for k, v in args.items()}
+        if error is not None:
+            doc["error"] = error
+        return doc
+
+    def snapshot(self, recent_limit: int = 100) -> dict:
+        """The /debug/traces payload: recent spans (newest last), the
+        captured slow traces, and the per-name latency digests."""
+        recent = list(self._ring)[-recent_limit:] if recent_limit > 0 else []
+        with self._lock:
+            digests = {name: d.doc() for name, d in sorted(self._digests.items())}
+            slow = list(self._slow)
+        return {
+            "recorded_total": self._recorded,
+            "capacity": self.capacity,
+            "default_slow_threshold_s": self.default_slow_threshold_s,
+            "recent": [self._entry_doc(e) for e in recent],
+            "slow_traces": slow,
+            "digests": digests,
+        }
+
+    def status(self) -> dict:
+        """The compact /statusz section (no span bodies)."""
+        with self._lock:
+            digests = {name: d.doc() for name, d in sorted(self._digests.items())}
+            slow = len(self._slow)
+        return {
+            "recorded_total": self._recorded,
+            "ring": len(self._ring),
+            "capacity": self.capacity,
+            "slow_traces_captured": slow,
+            "default_slow_threshold_s": self.default_slow_threshold_s,
+            "names": digests,
+        }
+
+
+_flight_recorder = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-wide always-on recorder."""
+    return _flight_recorder
+
+
+# span-error counter resolved lazily (importing metrics at module level
+# would cycle: metrics.py binds span names via register_span_metric at
+# its import tail)
+_span_errors_counter = None
+
+
+def _count_span_error(name: str) -> None:
+    global _span_errors_counter
+    c = _span_errors_counter
+    if c is None:
+        from . import metrics
+
+        c = _span_errors_counter = metrics.span_errors_total
+    c.add(name=name)
+
+
 @contextmanager
 def span(name: str, **args):
-    """Record a host-side span (event emission is a no-op unless a
-    Chrome trace file is installed; the trace-context bookkeeping for
-    traceparent propagation always runs — contextvar ops plus a PRNG
-    draw, with hex formatting deferred to emission/header time so the
-    untraced hot path stays near-free; ids need uniqueness, not
-    unpredictability, so this is random.getrandbits, not a urandom
-    syscall). Span names registered with register_span_metric also
-    record their duration into the bound histogram on exit."""
+    """Record a host-side span. The always-on flight recorder and the
+    trace-context bookkeeping for traceparent propagation run on every
+    span (contextvar ops, a PRNG draw, a deque append and a digest
+    update — measured by the bench `tracing_overhead` phase; hex
+    formatting is deferred to emission/snapshot time; ids need
+    uniqueness, not unpredictability, so this is random.getrandbits,
+    not a urandom syscall). Chrome/OTLP emission additionally runs when
+    those writers are installed. Span names registered with
+    register_span_metric also record their duration into the bound
+    histogram on exit. An exception exiting the span is recorded as an
+    `error=<ExcType>` attribute on every emitted event and counted in
+    janus_span_errors_total{name} — then re-raised."""
     parent = _trace_ctx.get()
     trace_id = parent[0] if parent else _span_rng.getrandbits(128)
     span_id = _span_rng.getrandbits(64)
@@ -458,14 +775,26 @@ def span(name: str, **args):
     w = _chrome_writer
     ox = _otlp_exporter
     t0 = time.perf_counter_ns()
-    e0 = time.time_ns() if ox is not None else 0
+    e0 = time.time_ns()
+    err_name = None
     try:
         yield
+    except BaseException as e:
+        err_name = type(e).__name__
+        raise
     finally:
         t1 = time.perf_counter_ns()
         _trace_ctx.reset(token)
+        if err_name is not None:
+            args["error"] = err_name  # kwargs dict is per-call: safe to mutate
+            _count_span_error(name)
+        dur_s = (t1 - t0) / 1e9
         if _span_metrics:
-            _bridge_span(name, (t1 - t0) / 1e9, args)
+            _bridge_span(name, dur_s, args)
+        _flight_recorder.record(
+            name, trace_id, span_id, parent[1] if parent else None,
+            e0, dur_s, args, err_name,
+        )
         if w is not None:
             w.event(
                 name,
@@ -494,6 +823,12 @@ class JsonFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        # correlate structured logs with traces: a log line emitted
+        # under an active span carries its ids (docs/OBSERVABILITY.md)
+        ctx = _trace_ctx.get()
+        if ctx is not None:
+            doc["trace_id"] = _hex(ctx[0], 32)
+            doc["span_id"] = _hex(ctx[1], 16)
         if record.exc_info:
             doc["exception"] = self.formatException(record.exc_info)
         return json.dumps(doc)
@@ -521,3 +856,10 @@ def install_trace_subscriber(config: TraceConfiguration | None = None) -> None:
             logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
         )
     root.addHandler(handler)
+
+
+# /statusz section: the flight recorder's compact summary on every
+# binary (the full payload is GET /debug/traces on the health listener)
+from .statusz import register_status_provider as _register_status_provider
+
+_register_status_provider("flight_recorder", lambda: _flight_recorder.status())

@@ -70,6 +70,19 @@ def main(argv=None) -> int:
             if not isinstance(snap, dict) or not snap:
                 errors.append("/statusz snapshot is empty")
 
+    # the always-on flight recorder (janus_tpu.trace) serves
+    # /debug/traces on every binary; a listener that can't render it
+    # is a deploy regression
+    try:
+        body, _ = _fetch(base + "/debug/traces?limit=5", args.timeout)
+        traces = json.loads(body)
+    except Exception as e:
+        errors.append(f"/debug/traces not valid JSON: {e}")
+    else:
+        for key in ("recent", "slow_traces", "digests", "recorded_total"):
+            if key not in traces:
+                errors.append(f"/debug/traces missing {key!r}")
+
     for err in errors:
         print(f"scrape_check: {err}", file=sys.stderr)
     if errors:

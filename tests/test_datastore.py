@@ -526,3 +526,121 @@ def test_step_back_lease_semantics(eph):
     with pytest.raises(TxConflict):
         with ds.tx() as tx:
             tx.step_back_aggregation_job(a1)
+
+
+def test_trace_context_round_trip(eph):
+    """ISSUE 6: the persisted causality link — a W3C traceparent stored
+    on aggregation and collection job rows survives the round trip (and
+    the absence of one reads back as None), on every engine."""
+    ds = eph.datastore
+    task = mktask()
+    ds.run_tx(lambda tx: tx.put_task(task))
+
+    tp = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    import dataclasses
+
+    traced = dataclasses.replace(_aggjob(task, jid=1), trace_context=tp)
+    bare = _aggjob(task, jid=2)
+    ds.run_tx(lambda tx: tx.put_aggregation_job(traced))
+    ds.run_tx(lambda tx: tx.put_aggregation_job(bare))
+    got = ds.run_tx(lambda tx: tx.get_aggregation_job(task.task_id, traced.job_id))
+    assert got.trace_context == tp
+    assert got == traced
+    assert ds.run_tx(
+        lambda tx: tx.get_aggregation_job(task.task_id, bare.job_id)
+    ).trace_context is None
+    # state updates do not disturb the persisted context
+    ds.run_tx(
+        lambda tx: tx.update_aggregation_job(
+            got.with_state(AggregationJobState.FINISHED)
+        )
+    )
+    assert (
+        ds.run_tx(
+            lambda tx: tx.get_aggregation_job(task.task_id, traced.job_id)
+        ).trace_context
+        == tp
+    )
+
+    # the collection-link query finds jobs whose client interval
+    # INTERSECTS the collection (same semantics as the batch gather:
+    # a job straddling the boundary still contributed) — and only
+    # those with a context
+    links = ds.run_tx(
+        lambda tx: tx.get_aggregation_job_trace_contexts(
+            task.task_id, interval=Interval(Time(900), Duration(300))
+        )
+    )
+    assert links == [tp]
+    # straddle: job covers [1000, 1100), collection [1050, 1150)
+    assert ds.run_tx(
+        lambda tx: tx.get_aggregation_job_trace_contexts(
+            task.task_id, interval=Interval(Time(1050), Duration(100))
+        )
+    ) == [tp]
+    assert (
+        ds.run_tx(
+            lambda tx: tx.get_aggregation_job_trace_contexts(
+                task.task_id, interval=Interval(Time(0), Duration(10))
+            )
+        )
+        == []
+    )
+
+    cj = CollectionJobModel(
+        task.task_id,
+        CollectionJobId(b"\x07" * 16),
+        b"query",
+        b"",
+        Interval(Time(1000), Duration(100)).to_bytes(),
+        CollectionJobState.START,
+        trace_context=tp,
+    )
+    ds.run_tx(lambda tx: tx.put_collection_job(cj))
+    got_cj = ds.run_tx(
+        lambda tx: tx.get_collection_job(task.task_id, cj.collection_job_id)
+    )
+    assert got_cj.trace_context == tp
+
+
+def test_unaggregated_report_time_quantiles(eph):
+    """The freshness-distribution query behind the sampler's p50/p95/p99
+    gauges: quantile client_times over unaggregated reports only."""
+    ds = eph.datastore
+    task = mktask()
+    ds.run_tx(lambda tx: tx.put_task(task))
+
+    def put(tx):
+        for i in range(10):
+            tx.put_client_report(_report(task, i=i, t=1000 + i))
+
+    ds.run_tx(put)
+    # bucket_s=1: exact rank semantics (each bucket holds one second)
+    rows = ds.run_tx(
+        lambda tx: tx.unaggregated_report_time_quantiles_by_task(bucket_s=1)
+    )
+    assert len(rows) == 1
+    task_id, n, oldest, vals = rows[0]
+    assert bytes(task_id) == task.task_id.data and n == 10
+    # the same scan carries the EXACT oldest time (the sampler's
+    # oldest-age gauge rides it instead of a second index walk)
+    assert oldest == 1000
+    # ages ascending == client_time descending: p50 is the median time,
+    # p95/p99 the oldest (rank/edge choices bias toward the older report)
+    assert vals[0.5] == 1004
+    assert vals[0.95] == 1000
+    assert vals[0.99] == 1000
+    # the default minute-wide buckets floor to the bucket's older edge:
+    # one DB-side histogram scan, conservative within bucket_s
+    coarse = ds.run_tx(lambda tx: tx.unaggregated_report_time_quantiles_by_task())
+    assert coarse[0][1] == 10 and coarse[0][2] == 1000
+    assert all(v == (1000 // 60) * 60 for v in coarse[0][3].values())
+    # claimed (aggregation_started) reports leave the distribution
+    claimed = ds.run_tx(
+        lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 9)
+    )
+    assert len(claimed) == 9
+    rows = ds.run_tx(
+        lambda tx: tx.unaggregated_report_time_quantiles_by_task(bucket_s=1)
+    )
+    assert rows[0][1] == 1 and rows[0][2] == 1009 and rows[0][3][0.5] == 1009

@@ -194,6 +194,57 @@ def test_deployed_process_pair_end_to_end(tmp_path):
         assert result.report_count == len(measurements)
         assert result.aggregate_result == sum(measurements)
 
+        # --- cross-process trace causality (ISSUE 6): each process's
+        # always-on flight recorder is reachable at /debug/traces; the
+        # persisted trace_context must stitch spans from genuinely
+        # separate interpreters into one trace ---
+        import json as _json
+
+        def traces(idx):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{HEALTH_BASE + idx}/debug/traces?limit=2000",
+                timeout=10,
+            ) as r:
+                return _json.loads(r.read())["recent"]
+
+        helper_spans = traces(0)  # helper aggregator
+        creator_spans = traces(2)  # aggregation job creator
+        agg_driver_spans = traces(3)  # aggregation job driver
+        col_driver_spans = traces(4)  # collection job driver
+
+        def ids(spans, name):
+            return {s["trace_id"] for s in spans if s["name"] == name}
+
+        # the aggregation-job trace: rooted in the creator process,
+        # adopted off the datastore row by the driver process, carried
+        # over HTTP to the helper process — one trace id in all three
+        job_traces = (
+            ids(creator_spans, "creator.create_job")
+            & ids(agg_driver_spans, "driver.http_init")
+            & ids(helper_spans, "dap.aggregate_init")
+        )
+        assert job_traces, (
+            "no shared aggregation trace id across creator/driver/helper"
+        )
+        # the collect-time trace contains spans from both aggregator
+        # sides: the collection driver's finish span and the helper's
+        # aggregate_share handler share the persisted collection trace
+        collect_traces = ids(col_driver_spans, "driver.collect_finish") & ids(
+            helper_spans, "dap.aggregate_share"
+        )
+        assert collect_traces, (
+            "no shared collection trace id across collection driver/helper"
+        )
+        # and the collect-finish span links back to the aggregation
+        # jobs it covered (the persisted job trace ids)
+        finish = next(
+            s for s in col_driver_spans if s["name"] == "driver.collect_finish"
+        )
+        linked = finish.get("args", {}).get("linked_traces", "")
+        assert job_traces & set(linked.split(",")), (
+            f"collect links {linked!r} do not include the job trace"
+        )
+
         # --- SIGTERM-drain everything cleanly ---
         for proc in procs.values():
             proc.send_signal(signal.SIGTERM)

@@ -422,3 +422,126 @@ def test_accumulator_counts_reports_at_accumulate_time():
         assert m.task_reports_aggregated_total.get(task_id=label) - before == 2
     finally:
         eph.cleanup()
+
+
+def test_debug_traces_endpoint_serves_flight_recorder(health_server):
+    """GET /debug/traces: the always-on flight recorder as JSON —
+    recent spans, slow captures, per-name digests; ?limit bounds the
+    recent list (ISSUE 6)."""
+    from janus_tpu.trace import span
+
+    with span("debug.traces.test", probe=1):
+        pass
+    status, ctype, body = _get(health_server + "/debug/traces")
+    assert status == 200 and ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert {"recorded_total", "capacity", "recent", "slow_traces", "digests"} <= set(doc)
+    assert doc["recorded_total"] > 0
+    ours = [e for e in doc["recent"] if e["name"] == "debug.traces.test"]
+    assert ours and ours[-1]["args"]["probe"] == 1
+    assert "debug.traces.test" in doc["digests"]
+    assert doc["digests"]["debug.traces.test"]["count"] >= 1
+    # limit respected (and bad limits don't 500)
+    _, _, body = _get(health_server + "/debug/traces?limit=2")
+    assert len(json.loads(body)["recent"]) == 2
+    status, _, _ = _get(health_server + "/debug/traces?limit=bogus")
+    assert status == 200
+
+
+def test_statusz_carries_flight_recorder_section(health_server):
+    from janus_tpu.trace import span
+
+    with span("statusz.recorder.test"):
+        pass
+    _, _, body = _get(health_server + "/statusz")
+    snap = json.loads(body)
+    fr = snap["flight_recorder"]
+    assert fr["recorded_total"] > 0 and fr["capacity"] >= 16
+    assert "statusz.recorder.test" in fr["names"]
+
+
+def test_health_sampler_exports_freshness_quantiles():
+    """The sampler exports per-task unaggregated-report age QUANTILES
+    (p50/p95/p99), not only the oldest report (ISSUE 6 satellite)."""
+    from janus_tpu.aggregator.health_sampler import HealthSampler, _b64_task_id
+    from janus_tpu.datastore.models import LeaderStoredReport
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import HpkeCiphertext, HpkeConfigId, ReportId, Time
+
+    eph = EphemeralDatastore()
+    try:
+        ds = eph.datastore
+        task = _provision_backlog(ds, eph.clock)
+        now = eph.clock.now().seconds
+
+        def more(tx):
+            # ages 0..90 in 10s steps (plus the backlog's 500s report)
+            for i in range(10):
+                tx.put_client_report(
+                    LeaderStoredReport(
+                        task.task_id,
+                        ReportId(bytes([0x40 + i] * 16)),
+                        Time(now - 10 * i),
+                        b"",
+                        b"s",
+                        HpkeCiphertext(HpkeConfigId(0), b"e", b"p"),
+                    )
+                )
+
+        ds.run_tx(more)
+        sampler = HealthSampler(ds, interval_s=0.1)
+        snap = sampler.run_once()
+        label = _b64_task_id(task.task_id.data)
+        fresh = snap["unaggregated_report_age_quantiles"][label]
+        assert fresh["count"] == 11
+        # minute-bucketed, older-edge-biased: p99 covers the 500s-old
+        # report conservatively (>= true age, within one bucket)
+        assert fresh["p50"] <= fresh["p95"] <= fresh["p99"]
+        assert 500.0 <= fresh["p99"] < 560.0
+        assert (
+            m.unaggregated_report_age_quantiles.get(task_id=label, quantile="p99")
+            == fresh["p99"]
+        )
+        assert (
+            m.unaggregated_report_age_quantiles.get(task_id=label, quantile="p50")
+            == fresh["p50"]
+        )
+
+        # a drained task resets its quantile series to 0
+        ds.run_tx(
+            lambda tx: tx.get_unaggregated_client_reports_for_task(task.task_id, 20)
+        )
+        snap = sampler.run_once()
+        assert label not in snap["unaggregated_report_age_quantiles"]
+        for q in ("p50", "p95", "p99"):
+            assert m.unaggregated_report_age_quantiles.get(task_id=label, quantile=q) == 0.0
+    finally:
+        eph.cleanup()
+
+
+def test_report_e2e_histogram_observed_at_accumulate_time():
+    """janus_report_e2e_seconds{stage="aggregate"}: observed from the
+    client report timestamp at accumulate time, outside the write tx."""
+    from janus_tpu.aggregator.accumulator import observe_report_e2e
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.messages import Time
+
+    clock = MockClock(Time(10_000))
+
+    def count(stage):
+        fam = m.REGISTRY.snapshot().get("janus_report_e2e_seconds", {})
+        return next(
+            (
+                s["count"]
+                for s in fam.get("samples", ())
+                if s["labels"].get("stage") == stage
+            ),
+            0,
+        )
+
+    before = count("aggregate")
+    observe_report_e2e(clock, [Time(9_400), Time(10_000), Time(11_000)])
+    assert count("aggregate") - before == 3
+    # a clockless call (host paths without one) is a no-op, not a crash
+    observe_report_e2e(None, [Time(0)])
+    assert count("aggregate") - before == 3

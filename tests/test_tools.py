@@ -138,6 +138,11 @@ def test_bench_dry_run_smoke():
     # to carry the real numbers, not to gate on them
     assert 0 < overhead["disabled_vs_baseline"] < 2.0
     assert overhead["chrome_rps"] > 0 and overhead["otlp_rps"] > 0
+    # the always-on flight recorder stays the same order as the
+    # recorder-off span cost (the bound is loose for scheduler noise;
+    # the record carries the real numbers)
+    assert overhead["span_ns_recorder_off"] > 0
+    assert overhead["span_ns_disabled"] < 20 * overhead["span_ns_recorder_off"]
     obs = rec["observability_smoke"]
     assert obs["scrape_valid"] is True, obs.get("scrape_errors")
     assert obs["engine_dispatch_samples"] > 0  # non-zero dispatch histogram
@@ -148,7 +153,25 @@ def test_bench_dry_run_smoke():
     assert obs["statusz_job_health_present"] is True
     assert obs["profile_status_codes"] == [200, 409]  # concurrent capture 409s
     assert obs["profile_host_trace_loadable"] is True
+    assert obs["debug_traces_ok"] is True  # flight recorder over live HTTP
+    assert obs["statusz_flight_recorder_present"] is True
     assert obs["scrape_check_rc"] == 0, obs.get("scrape_check_err")
+    # report-lifecycle tracing (ISSUE 6): ONE persisted trace id spans
+    # creator -> driver round 1 -> helper init -> a FRESH driver
+    # instance's round 2 (the restart analog: nothing shared but the
+    # datastore row) -> helper continue; the collection job persists
+    # its own trace context, the collect-finish span links back to the
+    # aggregation trace, and both e2e SLO stages recorded samples
+    tl = obs["trace_lifecycle"]
+    assert tl["collected"] == 3 and tl["aggregate"] == 2
+    assert tl["job_trace_context_persisted"] is True
+    assert tl["helper_row_same_trace"] is True
+    assert tl["leader_init_span_in_trace"] and tl["leader_continue_span_in_trace"]
+    assert tl["helper_init_span_in_trace"] and tl["helper_continue_span_in_trace"]
+    assert tl["collection_trace_context_persisted"] is True
+    assert tl["collect_finish_span_in_collection_trace"] is True
+    assert tl["collect_links_include_job_trace"] is True
+    assert tl["e2e_aggregate_delta"] > 0 and tl["e2e_collect_delta"] > 0
     # robustness (ISSUE 4): with JANUS_FAILPOINTS unset the failpoint
     # sites compile to a no-op — sub-microsecond against the ms-scale
     # upload/commit work they sit on (the bound is deliberately loose:

@@ -51,11 +51,13 @@ def test_spans_stream_chrome_events(tmp_path):
 
 
 def test_traceparent_stitches_leader_and_helper(tmp_path):
-    """One trace follows a job step across the leader driver and the
-    helper's HTTP handler via the traceparent header (reference
-    trace.rs:44-90 OTLP propagation analog): the helper's
-    dap.aggregate_init span carries the SAME trace id as the leader's
-    job.step span, parented under driver.http_init."""
+    """One trace follows a job from its creation across the leader
+    driver and the helper's HTTP handler: the creator persists its
+    span context in the job row (trace_context column), the driver
+    adopts it, and the traceparent header carries it to the helper —
+    so creator.create_job, driver.http_init and dap.aggregate_init all
+    share ONE trace id, with the helper's handler span parented under
+    the leader's HTTP span."""
     import dataclasses
 
     from janus_tpu.aggregator import Aggregator, Config
@@ -116,6 +118,12 @@ def test_traceparent_stitches_leader_and_helper(tmp_path):
             leader_eph.datastore, AggregationJobCreatorConfig(min_aggregation_job_size=1)
         )
         assert creator.run_once() == 1
+        job = leader_eph.datastore.run_tx(
+            lambda tx: tx.get_aggregation_jobs_for_task(leader_task.task_id)
+        )[0]
+        # the creator persisted its span context in the job row
+        assert job.trace_context is not None
+        persisted_trace_id = job.trace_context.split("-")[1]
         driver = AggregationJobDriver(leader_eph.datastore, http)
         jd = JobDriver(
             JobDriverConfig(max_concurrent_job_workers=1),
@@ -132,13 +140,16 @@ def test_traceparent_stitches_leader_and_helper(tmp_path):
         helper_eph.cleanup()
 
     events = _read_events(_trace_file(out))
-    job_steps = [e for e in events if e["name"] == "job.step"]
+    created = [e for e in events if e["name"] == "creator.create_job"]
     http_inits = [e for e in events if e["name"] == "driver.http_init"]
     helper_inits = [e for e in events if e["name"] == "dap.aggregate_init"]
-    assert job_steps and http_inits and helper_inits
-    trace_id = job_steps[0]["args"]["trace_id"]
-    assert http_inits[0]["args"]["trace_id"] == trace_id
-    assert helper_inits[0]["args"]["trace_id"] == trace_id
+    assert created and http_inits and helper_inits
+    # creator span == the persisted job trace; the driver adopted it
+    # from the ROW (not from any in-process state), and the helper got
+    # it over the wire — one trace id across three actors
+    assert created[0]["args"]["trace_id"] == persisted_trace_id
+    assert http_inits[0]["args"]["trace_id"] == persisted_trace_id
+    assert helper_inits[0]["args"]["trace_id"] == persisted_trace_id
     # the helper's handler span is parented under the leader's HTTP span
     assert helper_inits[0]["args"]["parent_span_id"] == http_inits[0]["args"]["span_id"]
 
@@ -272,3 +283,259 @@ def test_otlp_export_spans_and_metrics():
     finally:
         tr._otlp_exporter = None
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (ISSUE 6): always-on ring, digests, slow capture,
+# span failure recording, writer buffering, OTLP buffer cap
+# ---------------------------------------------------------------------------
+
+
+def _with_recorder(capacity=16, slow_capacity=4):
+    """Swap in a fresh recorder; returns (recorder, restore_fn)."""
+    rec = trace_mod.FlightRecorder(capacity=capacity, slow_capacity=slow_capacity)
+    saved = trace_mod._flight_recorder
+    trace_mod._flight_recorder = rec
+    return rec, lambda: setattr(trace_mod, "_flight_recorder", saved)
+
+
+def test_flight_recorder_ring_and_digests():
+    rec, restore = _with_recorder(capacity=16)
+    try:
+        for i in range(40):
+            with span("ring.op", i=i):
+                pass
+    finally:
+        restore()
+    snap = rec.snapshot()
+    assert snap["recorded_total"] == 40
+    # the ring is bounded at capacity (16 is the construction floor)
+    assert len(snap["recent"]) == rec.capacity
+    # newest last, oldest evicted
+    assert snap["recent"][-1]["args"]["i"] == 39
+    assert all(e["name"] == "ring.op" for e in snap["recent"])
+    assert all("trace_id" in e and "span_id" in e for e in snap["recent"])
+    # streaming digest: all 40 observations, sane percentiles
+    d = snap["digests"]["ring.op"]
+    assert d["count"] == 40 and d["errors"] == 0
+    assert 0 < d["p50_s"] <= d["p95_s"] <= d["p99_s"]
+    # recent_limit bounds the payload without touching the ring
+    assert len(rec.snapshot(recent_limit=3)["recent"]) == 3
+
+
+def test_flight_recorder_slow_capture_retains_tree():
+    rec, restore = _with_recorder(capacity=32)
+    rec.set_slow_threshold("slow.root", 0.0)  # capture every root
+    try:
+        with span("slow.root", kind="t"):
+            with span("slow.child"):
+                pass
+        # a NON-root span never triggers capture, whatever its duration
+        with span("outer.holder"):
+            with span("slow.root"):
+                pass
+    finally:
+        restore()
+    snap = rec.snapshot()
+    assert len(snap["slow_traces"]) == 1
+    cap = snap["slow_traces"][0]
+    assert cap["root"] == "slow.root"
+    names = [s["name"] for s in cap["spans"]]
+    # the whole tree: child completed first, root last, same trace id
+    assert names == ["slow.child", "slow.root"]
+    assert {s["trace_id"] for s in cap["spans"]} == {cap["trace_id"]}
+    child, root = cap["spans"]
+    assert child["parent_span_id"] == root["span_id"]
+
+
+def test_span_exception_records_error_and_counter():
+    from janus_tpu import metrics as m
+
+    rec, restore = _with_recorder()
+    before = m.span_errors_total.get(name="err.op")
+    try:
+        import pytest
+
+        with pytest.raises(ValueError):
+            with span("err.op", n=1):
+                raise ValueError("boom")
+        with span("err.ok"):
+            pass
+    finally:
+        restore()
+    snap = rec.snapshot()
+    failed = next(e for e in snap["recent"] if e["name"] == "err.op")
+    ok = next(e for e in snap["recent"] if e["name"] == "err.ok")
+    # the emitted event carries error=<ExcType>; a clean span does not
+    assert failed["error"] == "ValueError"
+    assert failed["args"]["error"] == "ValueError"
+    assert "error" not in ok
+    assert m.span_errors_total.get(name="err.op") == before + 1
+    assert snap["digests"]["err.op"]["errors"] == 1
+
+
+def test_span_error_attribute_reaches_chrome_events(tmp_path):
+    import pytest
+
+    out = tmp_path / "err.json"
+    install_chrome_trace(str(out))
+    try:
+        with pytest.raises(RuntimeError):
+            with span("chrome.err"):
+                raise RuntimeError("x")
+    finally:
+        trace_mod._chrome_writer.close()
+        trace_mod._chrome_writer = None
+    events = _read_events(_trace_file(out))
+    assert any(
+        e["name"] == "chrome.err" and e["args"].get("error") == "RuntimeError"
+        for e in events
+    )
+
+
+def test_chrome_writer_buffers_until_threshold(tmp_path):
+    """The writer no longer write+flushes per event (~45 µs/span in
+    PR 3): events buffer until the size/time threshold or close()."""
+    from janus_tpu.trace import ChromeTraceWriter
+
+    path = str(tmp_path / "buffered.json")
+    w = ChromeTraceWriter(path, flush_interval_s=3600.0)  # size threshold only
+    w.event("a", 0.0, 1.0, {})
+    w.event("b", 1.0, 1.0, {})
+    # nothing flushed yet — no event has reached the disk
+    assert '"name"' not in open(path).read()
+    # crossing the size threshold flushes the buffer
+    w.FLUSH_BYTES = 1
+    w.event("c", 2.0, 1.0, {})
+    names = [e["name"] for e in _read_events(path)]
+    assert names == ["a", "b", "c"]
+    # close() flushes the tail and closes the array
+    w.FLUSH_BYTES = ChromeTraceWriter.FLUSH_BYTES
+    w.event("d", 3.0, 1.0, {})
+    w.close()
+    raw = open(path).read().rstrip()
+    assert raw.endswith("]")
+    assert [e["name"] for e in json.loads(raw) if e] == ["a", "b", "c", "d"]
+
+
+def test_otlp_buffer_caps_drop_oldest():
+    from janus_tpu import metrics as m
+    from janus_tpu.trace import OtlpExporter
+
+    before = m.otlp_spans_dropped_total.total()
+    # unroutable endpoint + huge interval: no flush during the test
+    ex = OtlpExporter("http://127.0.0.1:9", flush_interval_s=3600.0)
+    try:
+        ex.MAX_BUFFERED_SPANS = 5
+        for i in range(9):
+            ex.record_span(f"s{i}", 0, 1, 1, i + 1, None, {})
+        assert len(ex._spans) == 5
+        # oldest dropped, newest retained
+        assert [d["name"] for d in ex._spans] == ["s4", "s5", "s6", "s7", "s8"]
+        assert m.otlp_spans_dropped_total.total() - before == 4
+        # a hung collector can't stall the flush loop past its interval
+        assert ex._post_timeout <= 5.0
+    finally:
+        ex._stop.set()
+        ex._spans.clear()
+
+
+def test_json_formatter_carries_trace_ids():
+    import logging
+
+    from janus_tpu.trace import JsonFormatter, adopt_traceparent, reset_traceparent
+
+    fmt = JsonFormatter()
+    record = logging.LogRecord("t", logging.INFO, __file__, 1, "hello", (), None)
+    # no active context: no trace fields
+    doc = json.loads(fmt.format(record))
+    assert "trace_id" not in doc and "span_id" not in doc
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    tok = adopt_traceparent(f"00-{tid}-{sid}-01")
+    try:
+        doc = json.loads(fmt.format(record))
+        assert doc["trace_id"] == tid and doc["span_id"] == sid
+    finally:
+        reset_traceparent(tok)
+    # inside a span() the formatter sees that span's ids
+    with span("log.ctx"):
+        doc = json.loads(fmt.format(record))
+        assert len(doc["trace_id"]) == 32 and len(doc["span_id"]) == 16
+
+
+def test_use_traceparent_adopts_and_restores():
+    from janus_tpu.trace import current_traceparent, use_traceparent
+
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    header = f"00-{tid}-b7ad6b7169203331-01"
+    assert current_traceparent() is None
+    with use_traceparent(header):
+        assert current_traceparent() == header
+        with span("adopted.child"):
+            assert tid in current_traceparent()
+    assert current_traceparent() is None
+    # falsy header: ambient context preserved (no clearing)
+    with span("ambient"):
+        before = current_traceparent()
+        with use_traceparent(None):
+            assert current_traceparent() == before
+
+
+def test_chrome_writer_idle_tail_flushes_without_new_events(tmp_path):
+    """A burst below the size threshold followed by silence still
+    reaches disk within the flush interval (daemon flusher) — no new
+    event required."""
+    import time as _time
+
+    from janus_tpu.trace import ChromeTraceWriter
+
+    path = str(tmp_path / "idle.json")
+    w = ChromeTraceWriter(path, flush_interval_s=0.05)
+    try:
+        w.event("lone", 0.0, 1.0, {})
+        deadline = _time.monotonic() + 5.0
+        seen = False
+        while _time.monotonic() < deadline and not seen:
+            raw = open(path).read()
+            seen = '"lone"' in raw
+            if not seen:
+                _time.sleep(0.02)
+        assert seen, "idle buffer never flushed"
+    finally:
+        w.close()
+
+
+def test_slow_capture_fires_for_adopted_context_roots():
+    """A span whose parent is REMOTE (adopted from a persisted
+    trace_context / traceparent header) is this process's local root:
+    slow capture must fire for it — otherwise a driver step's work
+    spans (all children of the persisted creator span) could never be
+    captured anywhere."""
+    from janus_tpu.trace import use_traceparent
+
+    rec, restore = _with_recorder(capacity=32)
+    rec.set_slow_threshold("adopted.work", 0.0)
+    header = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    try:
+        with use_traceparent(header):
+            with span("adopted.work"):
+                with span("adopted.child"):
+                    pass
+    finally:
+        restore()
+    snap = rec.snapshot()
+    # only the adopted-parent root fired (its local child did not)
+    assert [c["root"] for c in snap["slow_traces"]] == ["adopted.work"]
+    cap = snap["slow_traces"][0]
+    assert cap["trace_id"] == "0af7651916cd43dd8448eb211c80319c"
+    assert [s["name"] for s in cap["spans"]] == ["adopted.child", "adopted.work"]
+
+
+def test_trace_id_of_validates():
+    from janus_tpu.trace import trace_id_of
+
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    assert trace_id_of(f"00-{tid}-b7ad6b7169203331-01") == tid
+    assert trace_id_of(None) is None
+    assert trace_id_of("garbage-with-three-dashes") is None
+    assert trace_id_of(f"ff-{tid}-b7ad6b7169203331-01") is None
