@@ -39,6 +39,7 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
 
     import numpy as np
 
+    from janus_tpu import metrics as _m
     from janus_tpu.aggregator import Aggregator, Config
     from janus_tpu.aggregator.aggregation_job_creator import (
         AggregationJobCreator,
@@ -62,6 +63,11 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
     clock = MockClock(Time(1_600_000_000))
     leader_eph = EphemeralDatastore(clock=clock)
     helper_eph = EphemeralDatastore(clock=clock)
+    # supervise the serving store like the real binaries do, so the
+    # record's datastore_up/janus_datastore_up series carry the real
+    # outage-survival signal (unsupervised, the gauge would read a
+    # misleading default 0)
+    leader_eph.datastore.start_supervision(probe_interval_s=2.0)
     leader_agg = Aggregator(leader_eph.datastore, clock, Config())
     helper_agg = Aggregator(helper_eph.datastore, clock, Config())
     leader_srv = DapServer(DapHttpApp(leader_agg)).start()
@@ -293,6 +299,12 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             "collect_s": round(collect_s, 2),
             "metrics_scrape_valid": scrape_ok,
             **({"metrics_scrape_errors": scrape_errors} if scrape_errors else {}),
+            # datastore/journal state at the end of the served run (the
+            # outage-survival dashboard series; full samples ride the
+            # snapshot below via the janus_datastore_/janus_upload_
+            # journal_ prefixes)
+            "datastore_up": _m.datastore_up.get(),
+            "upload_journal_depth": _m.upload_journal_depth.get(),
             "metrics_snapshot": _metrics_snapshot_rider(),
         }
     finally:
@@ -700,7 +712,10 @@ _SNAPSHOT_PREFIXES = (
     "janus_span_",
     "janus_ingest_",
     "janus_upload_shed",
+    "janus_upload_journal_",
     "janus_database_",
+    "janus_datastore_",
+    "janus_tx_retries",
 )
 
 
@@ -1196,13 +1211,12 @@ def _failpoint_overhead(iters: int = 200_000) -> dict:
     }
 
 
-def _chaos_smoke() -> dict:
-    """Run the crash-recovery chaos harness (scripts/chaos_run.py
-    --smoke) as a subprocess — its own metrics registry, its own driver
-    child processes — and embed the invariant record: driver killed
-    between helper ack and leader commit, helper transport/5xx storm
-    through the circuit breaker, lease reacquired within TTL, and the
-    final collection equal to the admitted ground truth exactly."""
+def _run_chaos_subprocess(extra_args: list, timeout: float) -> dict:
+    """Run scripts/chaos_run.py with `extra_args` and return its JSON
+    record. A hung/garbled/failed harness degrades to an ok:false
+    record — the dry run always emits its JSON line (the BENCH rc:124
+    lesson), and test_bench_dry_run_smoke reports THAT dict instead of
+    an opaque traceback."""
     import pathlib
     import subprocess
 
@@ -1211,12 +1225,12 @@ def _chaos_smoke() -> dict:
     env.pop("XLA_FLAGS", None)  # single-device, like the real drivers
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join("scripts", "chaos_run.py"), "--smoke", "--json"],
+            [sys.executable, os.path.join("scripts", "chaos_run.py"), *extra_args],
             cwd=repo,
             env=env,
             capture_output=True,
             text=True,
-            timeout=560,
+            timeout=timeout,
         )
         lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
         if proc.returncode != 0 or not lines:
@@ -1227,11 +1241,29 @@ def _chaos_smoke() -> dict:
             }
         return json.loads(lines[-1])
     except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError) as e:
-        # a hung/garbled harness must degrade to an ok:false record —
-        # the dry run always emits its JSON line (the BENCH rc:124
-        # lesson), and test_bench_dry_run_smoke reports THIS dict
-        # instead of an opaque traceback
         return {"ok": False, "error": f"{type(e).__name__}: {e}"[:1500]}
+
+
+def _chaos_smoke() -> dict:
+    """Crash-recovery chaos smoke (scripts/chaos_run.py --smoke):
+    driver killed between helper ack and leader commit, helper
+    transport/5xx storm through the circuit breaker, lease reacquired
+    within TTL, and the final collection equal to the admitted ground
+    truth exactly."""
+    return _run_chaos_subprocess(["--smoke", "--json"], timeout=560)
+
+
+def _db_outage_smoke() -> dict:
+    """Datastore-outage survival smoke (scripts/chaos_run.py
+    --scenario db_outage --smoke): uploads keep acking 201 through a
+    full datastore outage (durable spill journal, fsync-on-ack),
+    /readyz flips 503 -> 200 across recovery, the journal drains to
+    empty, and the final collection equals every 201-acked report
+    exactly once. Healthy-path proof rides along: the armed-but-idle
+    journal performed zero fsyncs."""
+    return _run_chaos_subprocess(
+        ["--scenario", "db_outage", "--smoke", "--json"], timeout=300
+    )
 
 
 # Planning default when the backend reports no memory budget (the axon
@@ -1303,6 +1335,7 @@ def run_dry(args, ap) -> None:
                 "observability_smoke": _observability_smoke(),
                 "failpoint_overhead": _failpoint_overhead(),
                 "chaos_smoke": _chaos_smoke(),
+                "db_outage_smoke": _db_outage_smoke(),
             }
         )
     )

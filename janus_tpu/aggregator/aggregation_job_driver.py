@@ -120,12 +120,17 @@ class AggregationJobDriver:
 
     # --- JobDriver callbacks (reference :840-894) ---
     def acquirer(self, lease_duration_s: int = 600):
+        from .job_driver import acquire_tolerating_outage
+
         def acquire(limit: int):
-            return self.ds.run_tx(
-                lambda tx: tx.acquire_incomplete_aggregation_jobs(
-                    Duration(lease_duration_s), limit
+            return acquire_tolerating_outage(
+                self.ds,
+                lambda: self.ds.run_tx(
+                    lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                        Duration(lease_duration_s), limit
+                    ),
+                    "acquire_agg_jobs",
                 ),
-                "acquire_agg_jobs",
             )
 
         return acquire
@@ -155,7 +160,17 @@ class AggregationJobDriver:
         except RequestAborted:
             # shutdown drain: hand the lease back immediately
             self.step_back(acquired, "shutdown_drain", 0.0)
-        except Exception:
+        except Exception as e:
+            from .job_driver import datastore_reconnect_delay_s, is_datastore_connection_error
+
+            if is_datastore_connection_error(self.ds, e):
+                # datastore outage mid-step: not this job's fault —
+                # step back with the reconnect cooldown (best effort;
+                # if the step-back tx also fails, the lease ages out)
+                self.step_back(
+                    acquired, "datastore_down", datastore_reconnect_delay_s(self.ds)
+                )
+                return
             log.exception(
                 "aggregation job %s step failed (attempt %d)",
                 acquired.job_id,
@@ -187,6 +202,13 @@ class AggregationJobDriver:
         except TxConflict:
             # lease already lost (expired / re-acquired): nothing to return
             log.info("step-back of %s found the lease already gone", acquired.job_id)
+        except Exception:
+            # datastore unreachable: the lease ages out on its own TTL —
+            # the step-back is an optimization, never a correctness need
+            log.warning(
+                "step-back of %s could not reach the datastore; lease will age out",
+                acquired.job_id,
+            )
 
     def _stage_pending(self, task, wire, engine, pending, reports):
         """Columnar staging of stored leader shares -> device-ready

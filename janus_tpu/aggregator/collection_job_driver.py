@@ -77,12 +77,17 @@ class CollectionJobDriver:
         self.stopper = stopper
 
     def acquirer(self, lease_duration_s: int = 600):
+        from .job_driver import acquire_tolerating_outage
+
         def acquire(limit: int):
-            return self.ds.run_tx(
-                lambda tx: tx.acquire_incomplete_collection_jobs(
-                    Duration(lease_duration_s), limit
+            return acquire_tolerating_outage(
+                self.ds,
+                lambda: self.ds.run_tx(
+                    lambda tx: tx.acquire_incomplete_collection_jobs(
+                        Duration(lease_duration_s), limit
+                    ),
+                    "acquire_collection_jobs",
                 ),
-                "acquire_collection_jobs",
             )
 
         return acquire
@@ -101,6 +106,17 @@ class CollectionJobDriver:
             )
         except RequestAborted:
             self.step_back(acquired, "shutdown_drain", 0.0)
+        except Exception as e:
+            from .job_driver import datastore_reconnect_delay_s, is_datastore_connection_error
+
+            if is_datastore_connection_error(self.ds, e):
+                # datastore outage mid-step: step back with the
+                # reconnect cooldown instead of burning the attempt
+                self.step_back(
+                    acquired, "datastore_down", datastore_reconnect_delay_s(self.ds)
+                )
+                return
+            raise
 
     def step_back(
         self, acquired: AcquiredCollectionJob, reason: str, delay_s: float
@@ -125,6 +141,11 @@ class CollectionJobDriver:
         except TxConflict:
             log.info(
                 "step-back of %s found the lease already gone",
+                acquired.collection_job_id,
+            )
+        except Exception:
+            log.warning(
+                "step-back of %s could not reach the datastore; lease will age out",
                 acquired.collection_job_id,
             )
 

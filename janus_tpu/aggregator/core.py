@@ -132,6 +132,17 @@ class Config:
     upload_shed_retry_after_s: float = 1.0
     # cap on concurrent HTTP handler threads in DapServer
     max_handler_threads: int = 32
+    # --- durable upload spill journal (docs/ROBUSTNESS.md "Datastore
+    # outages"): directory for the CRC-framed fsync-on-ack journal the
+    # report writer spills to when the datastore is unreachable. None
+    # (default) disarms it — the upload flush path is unchanged. ---
+    upload_journal_path: str | None = None
+    upload_journal_max_segment_bytes: int = 8 << 20
+    upload_journal_max_total_bytes: int = 256 << 20
+    upload_journal_max_segments: int = 1024
+    upload_journal_spill_latency_s: float = 0.0
+    upload_journal_replay_interval_s: float = 1.0
+    upload_journal_full_retry_after_s: float = 30.0
 
 
 class TaskAggregator:
@@ -1229,9 +1240,60 @@ class Aggregator:
         self._task_aggs: dict[bytes, TaskAggregator] = {}
         self.global_hpke_keypairs = GlobalHpkeKeypairCache(ds)
         self.peer_aggregators = PeerAggregatorCache(ds) if self.cfg.taskprov_enabled else None
+        # datastore-outage survival: with a journal path configured the
+        # report writer spills to the durable on-disk journal when the
+        # datastore is unreachable, and a background replayer drains it
+        # back on recovery (janus_tpu.ingest.journal)
+        self.upload_journal = None
+        self.journal_replayer = None
+        if self.cfg.upload_journal_path:
+            from ..ingest.journal import JournalReplayer, UploadJournal
+
+            self.upload_journal = UploadJournal(
+                self.cfg.upload_journal_path,
+                ds.crypter,
+                max_segment_bytes=self.cfg.upload_journal_max_segment_bytes,
+                max_total_bytes=self.cfg.upload_journal_max_total_bytes,
+                max_segments=self.cfg.upload_journal_max_segments,
+                full_retry_after_s=self.cfg.upload_journal_full_retry_after_s,
+            )
         self.report_writer = ReportWriteBatcher(
-            ds, self.cfg.max_upload_batch_size, self.cfg.max_upload_batch_write_delay_ms
+            ds,
+            self.cfg.max_upload_batch_size,
+            self.cfg.max_upload_batch_write_delay_ms,
+            journal=self.upload_journal,
+            spill_latency_s=self.cfg.upload_journal_spill_latency_s,
         )
+        if self.upload_journal is not None:
+            from ..binary_utils import register_readiness_check
+            from ..statusz import register_status_provider
+
+            self.journal_replayer = JournalReplayer(
+                self.upload_journal,
+                self.report_writer,
+                supervisor_fn=lambda: getattr(self.ds, "supervisor", None),
+                interval_s=self.cfg.upload_journal_replay_interval_s,
+            ).start()
+            register_status_provider("upload_journal", self.upload_journal.status)
+            # /readyz fails while the journal is full: this replica can
+            # no longer honor 201s through an outage
+            register_readiness_check("upload_journal", self.upload_journal.readiness)
+
+    def close(self) -> None:
+        """Shutdown: stop the journal replayer and flush/stop the report
+        writer (any uploads still buffered in the group-commit writer
+        land before exit; journaled ones survive on disk and replay on
+        the next boot)."""
+        if self.journal_replayer is not None:
+            self.journal_replayer.stop()
+        self.report_writer.close()
+        if self.upload_journal is not None:
+            from ..binary_utils import unregister_readiness_check
+            from ..statusz import unregister_status_provider
+
+            unregister_readiness_check("upload_journal")
+            unregister_status_provider("upload_journal")
+            self.upload_journal.close()
 
     def task_aggregator_for(
         self, task_id: TaskId, taskprov_task_config=None, headers=None, peer_role: Role = Role.LEADER

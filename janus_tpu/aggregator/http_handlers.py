@@ -179,6 +179,10 @@ class DapHttpApp:
                         shed_retry_after_s=cfg.upload_shed_retry_after_s,
                     ),
                     depth_fn=self._ingest.depth,
+                    # degraded-mode serving: aggregate-step routes shed
+                    # 503 while the datastore supervisor is not up
+                    # (uploads keep flowing into the spill journal)
+                    supervisor_fn=lambda: getattr(self.agg.ds, "supervisor", None),
                 )
             return self._ingest, self._admission
 
@@ -297,16 +301,19 @@ class DapHttpApp:
                     return getattr(self, "h_" + name)(match, query, headers, body)
             return 404, "text/plain", b"not found"
         except ShedError as e:
+            # 429 for capacity sheds, 503 for availability sheds
+            # (datastore down / journal full) — both with Retry-After
             from .. import metrics
 
+            status = getattr(e, "status", 429)
             metrics.upload_shed_counter.add(route=e.route_class, reason=e.reason)
             doc = {
                 "type": "about:blank",
-                "status": 429,
+                "status": status,
                 "detail": str(e),
             }
             return (
-                429,
+                status,
                 "application/problem+json",
                 json.dumps(doc).encode(),
                 {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))},

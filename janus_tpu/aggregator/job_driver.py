@@ -48,6 +48,52 @@ def deadline_request_timeout(deadline: float | None) -> float | None:
     return max(0.1, deadline - time.monotonic())
 
 
+def datastore_down(ds) -> bool:
+    """True while the datastore supervisor reports a hard outage —
+    both drivers' acquirers park instead of burning an acquire (and a
+    lease attempt on every job the tx WOULD claim) into a dead
+    database; the discovery loop retries on its backoff."""
+    supervisor = getattr(ds, "supervisor", None)
+    return supervisor is not None and supervisor.state == "down"
+
+
+def acquire_tolerating_outage(ds, acquire_tx):
+    """Shared acquirer body for both drivers: park (return []) while
+    the supervisor reports down, absorb a CONNECTION-class acquire
+    failure as 'no jobs this pass' (a datastore outage must not kill
+    the driver process — the discovery loop IS the recovery
+    mechanism), and re-raise everything else: a fatal error (broken
+    schema) retried forever behind a healthy /readyz would be a silent
+    stall, whereas a crash loop is visible to the orchestrator."""
+    if datastore_down(ds):
+        return []
+    try:
+        return acquire_tx()
+    except Exception as e:
+        if is_datastore_connection_error(ds, e):
+            log.warning(
+                "job acquisition failed (datastore connection lost); "
+                "backing off before rediscovery"
+            )
+            return []
+        raise
+
+
+def datastore_reconnect_delay_s(ds, default: float = 5.0) -> float:
+    """Step-back delay for a datastore-down step: the supervisor's
+    reconnect cooldown when supervised, `default` otherwise."""
+    supervisor = getattr(ds, "supervisor", None)
+    return supervisor.reconnect_delay_s() if supervisor is not None else default
+
+
+def is_datastore_connection_error(ds, e: BaseException) -> bool:
+    """Classify an exception as a datastore connection loss (shared by
+    both drivers' steppers; tolerant of test doubles without a
+    classifier)."""
+    classify = getattr(ds, "classify_error", None)
+    return classify is not None and classify(e) == "connection"
+
+
 class Stopper:
     """Cooperative shutdown flag (reference uses trillium Stopper)."""
 
@@ -137,6 +183,10 @@ class JobDriver:
                 free = self.cfg.max_concurrent_job_workers - len(in_flight)
                 n = 0
                 if free > 0:
+                    # outage tolerance lives in the drivers' acquirers
+                    # (acquire_tolerating_outage) so connection losses
+                    # park the loop while fatal errors still crash
+                    # loudly instead of stalling behind a ready /readyz
                     jobs = self.acquirer(free)
                     n = len(jobs)
                     for j in jobs:

@@ -12,6 +12,17 @@ A lone client therefore sees ~transaction latency (the reference's
 while concurrent bursts batch naturally — the batch size adapts to
 however many requests arrive per transaction. `max_write_delay_ms > 0`
 adds an optional coalescing wait, capped by `max_batch_size`.
+
+Datastore-outage survival (docs/ROBUSTNESS.md): with a journal
+attached, a flush that hits a connection-class datastore error — or
+that runs while the datastore supervisor reports the database not up,
+or after a commit exceeded `spill_latency_s` — spills the batch to the
+durable on-disk journal instead, and every waiter resolves fresh=True
+(201 on the strength of the journal fsync). The journal's replayer
+drains back through `flush_direct` on recovery; report-id dedup makes
+that exactly-once. With no journal (the default) the flush path is
+byte-identical to before — no new fsyncs, no new branches beyond one
+None check.
 """
 
 from __future__ import annotations
@@ -49,10 +60,18 @@ class ReportWriteBatcher:
         ds: Datastore,
         max_batch_size: int = 100,
         max_write_delay_ms: int = 0,
+        journal=None,
+        spill_latency_s: float = 0.0,
     ):
         self.ds = ds
         self.max_batch_size = max_batch_size
         self.max_write_delay_s = max_write_delay_ms / 1000.0
+        # optional durable spill journal (ingest.journal.UploadJournal):
+        # None = the pre-journal flush path, unchanged byte for byte
+        self.journal = journal
+        # commit latency past this spills subsequent flushes (0 = only
+        # connection-class errors / supervisor-down spill)
+        self.spill_latency_s = float(spill_latency_s)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._buffer: list[_Pending] = []
@@ -125,6 +144,35 @@ class ReportWriteBatcher:
             if batch:  # a concurrent flush_now may have drained it
                 self._flush(batch)
 
+    def flush_direct(self, reports: list[LeaderStoredReport]) -> list[bool]:
+        """One transaction for `reports`, NEVER spilling to the journal
+        (the journal replayer's path — spilling a replay back into the
+        journal would loop). Returns fresh-vs-replayed per report;
+        raises on failure."""
+
+        def tx_fn(tx):
+            return [tx.put_client_report(r) for r in reports]
+
+        return self.ds.run_tx(tx_fn, "upload_journal_replay")
+
+    def _should_spill_without_trying(self) -> bool:
+        """Skip the doomed datastore attempt entirely while the
+        supervisor says the database is not up: during an outage every
+        flush would otherwise burn run_tx's full retry budget before
+        spilling, turning ~ms acks into ~second acks."""
+        if self.journal is None:
+            return False
+        supervisor = getattr(self.ds, "supervisor", None)
+        return supervisor is not None and supervisor.state != "up"
+
+    def _spill(self, batch: list[_Pending]) -> None:
+        """Journal the batch (fsync-on-ack) and resolve every waiter as
+        fresh: durability now rests on the journal; replay dedups any
+        true duplicate. Raises (JournalFull included) on failure."""
+        self.journal.append_batch([p.report for p in batch])
+        for p in batch:
+            p.fresh = True
+
     def _flush(self, batch: list[_Pending]) -> None:
         """One transaction for the whole batch (reference :96-165)."""
         from .. import failpoints
@@ -141,11 +189,50 @@ class ReportWriteBatcher:
                 ),
             )
 
+            if self._should_spill_without_trying():
+                with span("upload.flush_spill", batch=len(batch)):
+                    self._spill(batch)
+                log.warning(
+                    "datastore not up: spilled %d upload(s) to the journal",
+                    len(batch),
+                )
+                return
+
             def tx_fn(tx):
                 return [tx.put_client_report(p.report) for p in batch]
 
-            with span("upload.flush_tx", batch=len(batch)):
-                results = self.ds.run_tx(tx_fn, "upload_batch")
+            t0 = time.monotonic()
+            try:
+                with span("upload.flush_tx", batch=len(batch)):
+                    results = self.ds.run_tx(tx_fn, "upload_batch")
+            except BaseException as e:
+                # connection-class failure + a journal: the ack contract
+                # survives on local disk. Anything else (integrity,
+                # injected flush faults, serialization exhaustion) still
+                # fails loudly — those are not outages.
+                if (
+                    self.journal is not None
+                    and getattr(self.ds, "classify_error", None) is not None
+                    and self.ds.classify_error(e) == "connection"
+                ):
+                    with span("upload.flush_spill", batch=len(batch)):
+                        self._spill(batch)
+                    log.warning(
+                        "datastore connection lost (%s); spilled %d upload(s)"
+                        " to the journal",
+                        e,
+                        len(batch),
+                    )
+                    return
+                raise
+            elapsed = time.monotonic() - t0
+            if self.journal is not None and 0 < self.spill_latency_s < elapsed:
+                # the commit landed but took too long: tell the
+                # supervisor so the NEXT flushes spill (bounded ack
+                # latency through a brownout)
+                supervisor = getattr(self.ds, "supervisor", None)
+                if supervisor is not None:
+                    supervisor.record_slow_commit(elapsed)
             for p, fresh in zip(batch, results):
                 p.fresh = fresh
         except BaseException as e:  # fan the failure out to every waiter

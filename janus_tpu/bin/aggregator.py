@@ -75,9 +75,11 @@ def run(cfg: AggregatorConfig, ds, stopper):
             sampler.stop()
         if api_server is not None:
             api_server.stop()
-        # flush any uploads still buffered in the group-commit writer so
-        # a graceful shutdown never drops admitted reports
-        aggregator.report_writer.close()
+        # flush any uploads still buffered in the group-commit writer
+        # and stop the journal replayer, so a graceful shutdown never
+        # drops admitted reports (journaled ones survive on disk and
+        # replay on the next boot)
+        aggregator.close()
     log.info("aggregator shut down")
 
 

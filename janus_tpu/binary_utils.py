@@ -36,6 +36,48 @@ log = logging.getLogger(__name__)
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+# ---------------------------------------------------------------------------
+# Readiness registry: /healthz is LIVENESS (the process is running —
+# restarting it would not help), /readyz is READINESS (this replica can
+# currently do useful work — take it out of rotation, don't kill it).
+# A datastore outage fails readiness, never liveness: killing the pod
+# would also kill the upload spill journal's replayer.
+# ---------------------------------------------------------------------------
+
+_readiness_lock = threading.Lock()
+_readiness_checks: dict[str, object] = {}
+
+
+def register_readiness_check(name: str, fn) -> None:
+    """Register (or replace) a readiness check: `fn()` returns None
+    when ready, or a human-readable reason string when not. A check
+    that raises counts as not ready (with the exception as reason)."""
+    with _readiness_lock:
+        _readiness_checks[name] = fn
+
+
+def unregister_readiness_check(name: str) -> None:
+    with _readiness_lock:
+        _readiness_checks.pop(name, None)
+
+
+def readiness_snapshot() -> tuple[bool, dict]:
+    """(ready, {check: reason}) across every registered check. No
+    checks registered = ready (a binary without a datastore supervisor
+    keeps its old semantics)."""
+    with _readiness_lock:
+        checks = dict(_readiness_checks)
+    reasons: dict = {}
+    for name, fn in sorted(checks.items()):
+        try:
+            reason = fn()
+        except Exception as e:
+            reason = f"readiness check failed: {type(e).__name__}: {e}"
+        if reason:
+            reasons[name] = str(reason)
+    return not reasons, reasons
+
+
 def parse_datastore_keys(raw: str) -> list[bytes]:
     keys = []
     for part in raw.split(","):
@@ -184,7 +226,13 @@ def capture_profile(seconds: float, out_dir: str | None = None) -> dict:
 class HealthServer:
     """The per-process introspection listener:
 
-      GET  /healthz                  -> 200 (liveness)
+      GET  /healthz                  -> 200 (liveness: always, while
+                                        the process runs)
+      GET  /readyz                   -> 200 when every registered
+                                        readiness check passes; 503
+                                        with a JSON reason map when
+                                        degraded (datastore down,
+                                        upload journal full)
       GET  /metrics                  -> Prometheus text exposition
       GET  /statusz                  -> JSON status snapshot (HTML with
                                         ?format=html or Accept: text/html)
@@ -214,6 +262,16 @@ class HealthServer:
                 query = dict(parse_qsl(parts.query))
                 if parts.path == "/healthz":
                     self._send(200, "text/plain", b"")
+                elif parts.path == "/readyz":
+                    ready, reasons = readiness_snapshot()
+                    body = {"ready": ready}
+                    if reasons:
+                        body["reasons"] = reasons
+                    self._send(
+                        200 if ready else 503,
+                        "application/json",
+                        _json.dumps(body).encode(),
+                    )
                 elif parts.path == "/metrics":
                     self._send(200, METRICS_CONTENT_TYPE, REGISTRY.render().encode())
                 elif parts.path == "/statusz":
@@ -454,6 +512,20 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         # applied when it's absent (else it would be silently dead in
         # every binary — the class default already read it)
         ds.slow_tx_warn_s = common.database.slow_tx_warn_secs
+    ds.retry_max_interval_s = common.database.retry_max_interval_secs
+
+    # datastore connection supervision: background health probe driving
+    # the up/degraded/down/recovering state machine, /statusz section
+    # and the /readyz readiness split (liveness /healthz stays up — a
+    # DB outage is a reason to stop routing, never to kill the process)
+    if common.database.health_probe_interval_secs > 0:
+        supervisor = ds.start_supervision(
+            probe_interval_s=common.database.health_probe_interval_secs,
+            down_threshold=common.database.down_after_failures,
+            reconnect_max_interval_s=common.database.reconnect_max_interval_secs,
+        )
+        register_status_provider("datastore", supervisor.status)
+        register_readiness_check("datastore", supervisor.readiness)
 
     # /statusz base sections: build/process info and the provisioned
     # tasks (subsystems — engine cache, ingest, health sampler — add

@@ -32,12 +32,33 @@ class DbConfig:
     # WARN-log threshold for one datastore transaction (run_tx wall
     # time, retries included); <= 0 disables the warning.
     slow_tx_warn_secs: float = 1.0
+    # Cap on one run_tx retry sleep (full-jitter exponential backoff
+    # below it). Stretch for outage-heavy deployments so a retry storm
+    # spreads out; janus_tx_retries_total{tx,kind} counts the retries.
+    retry_max_interval_secs: float = 0.128
+    # Datastore connection supervision (docs/ROBUSTNESS.md "Datastore
+    # outages"): background health-probe period driving the
+    # up/degraded/down/recovering state machine, /readyz, degraded-mode
+    # shedding and the upload journal spill decision. 0 disables.
+    health_probe_interval_secs: float = 5.0
+    # consecutive connection-class failures before the state goes down
+    down_after_failures: int = 3
+    # ceiling of the jittered reconnect/probe backoff while down
+    reconnect_max_interval_secs: float = 30.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "DbConfig":
         return cls(
             url=str(d.get("url", "janus.sqlite")),
             slow_tx_warn_secs=float(d.get("slow_tx_warn_secs", 1.0)),
+            retry_max_interval_secs=float(d.get("retry_max_interval_secs", 0.128)),
+            health_probe_interval_secs=float(
+                d.get("health_probe_interval_secs", 5.0)
+            ),
+            down_after_failures=int(d.get("down_after_failures", 3)),
+            reconnect_max_interval_secs=float(
+                d.get("reconnect_max_interval_secs", 30.0)
+            ),
         )
 
 
@@ -146,12 +167,27 @@ class AggregatorConfig:
     queue_high_watermark: float = 0.75
     upload_shed_retry_after_s: float = 1.0
     max_handler_threads: int = 32
+    # --- durable upload spill journal (YAML `upload_journal:` section;
+    # docs/ROBUSTNESS.md "Datastore outages"). No path = disarmed: the
+    # upload flush path is unchanged and adds no fsyncs. ---
+    upload_journal_path: str | None = None
+    upload_journal_max_segment_bytes: int = 8 << 20
+    upload_journal_max_total_bytes: int = 256 << 20
+    upload_journal_max_segments: int = 1024
+    # commit latency past this spills subsequent flushes to the journal
+    # (bounded ack latency through a brownout); 0 = connection-class
+    # errors / datastore-down only
+    upload_journal_spill_latency_secs: float = 0.0
+    upload_journal_replay_interval_secs: float = 1.0
+    # Retry-After advertised on the 503 when the journal is full
+    upload_journal_full_retry_after_secs: float = 30.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "AggregatorConfig":
         gc = d.get("garbage_collection", {}) or {}
         api = d.get("aggregator_api", {}) or {}
         ingest = d.get("ingest", {}) or {}
+        journal = d.get("upload_journal", {}) or {}
         return cls(
             common=CommonConfig.from_dict(d),
             listen_address=str(d.get("listen_address", "0.0.0.0:8080")),
@@ -178,6 +214,23 @@ class AggregatorConfig:
             queue_high_watermark=float(ingest.get("queue_high_watermark", 0.75)),
             upload_shed_retry_after_s=float(ingest.get("shed_retry_after_secs", 1.0)),
             max_handler_threads=int(ingest.get("max_handler_threads", 32)),
+            upload_journal_path=journal.get("path"),
+            upload_journal_max_segment_bytes=int(
+                journal.get("max_segment_bytes", 8 << 20)
+            ),
+            upload_journal_max_total_bytes=int(
+                journal.get("max_total_bytes", 256 << 20)
+            ),
+            upload_journal_max_segments=int(journal.get("max_segments", 1024)),
+            upload_journal_spill_latency_secs=float(
+                journal.get("spill_commit_latency_secs", 0.0)
+            ),
+            upload_journal_replay_interval_secs=float(
+                journal.get("replay_interval_secs", 1.0)
+            ),
+            upload_journal_full_retry_after_secs=float(
+                journal.get("full_retry_after_secs", 30.0)
+            ),
         )
 
     def protocol_config(self) -> AggregatorProtocolConfig:
@@ -198,6 +251,13 @@ class AggregatorConfig:
             queue_high_watermark=self.queue_high_watermark,
             upload_shed_retry_after_s=self.upload_shed_retry_after_s,
             max_handler_threads=self.max_handler_threads,
+            upload_journal_path=self.upload_journal_path,
+            upload_journal_max_segment_bytes=self.upload_journal_max_segment_bytes,
+            upload_journal_max_total_bytes=self.upload_journal_max_total_bytes,
+            upload_journal_max_segments=self.upload_journal_max_segments,
+            upload_journal_spill_latency_s=self.upload_journal_spill_latency_secs,
+            upload_journal_replay_interval_s=self.upload_journal_replay_interval_secs,
+            upload_journal_full_retry_after_s=self.upload_journal_full_retry_after_secs,
         )
 
 

@@ -24,6 +24,26 @@ def _injected_timeout() -> urllib.error.URLError:
     return urllib.error.URLError(socket.timeout("injected timeout (failpoint)"))
 
 
+def fetch_any_status(
+    url: str,
+    method: str = "GET",
+    body: bytes | None = None,
+    headers: dict | None = None,
+    timeout: float = 10.0,
+) -> tuple[int, bytes]:
+    """One request returning (status, body) for ANY status — urllib
+    raises HTTPError on non-2xx, but probes of degraded endpoints
+    (/readyz answering 503, shed routes) need the status and body, not
+    an exception. Shared by scripts/scrape_check.py and the chaos
+    harness so the quirk-workaround lives once."""
+    req = urllib.request.Request(url, data=body, headers=headers or {}, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
 class HttpClient:
     # Default generous: a cold aggregator's first request per task can
     # legitimately take minutes (XLA engine compile). The job drivers

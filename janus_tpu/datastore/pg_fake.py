@@ -290,15 +290,23 @@ class FakePostgresDriver:
     def _maybe_inject(self, conn, sql, params):
         with self._lock:
             for rule in self._injections:
-                pred, exc, once = rule
+                pred, exc, once, break_conn = rule
                 if pred(sql, params):
                     if once:
                         self._injections.remove(rule)
+                    if break_conn:
+                        # model a dropped server connection: psycopg
+                        # marks the connection broken and every later
+                        # operation on it (rollback included) fails
+                        conn.broken = True
                     raise exc
 
-    def inject_once(self, predicate, exc: Exception):
-        """Raise `exc` on the first statement matching predicate(sql, params)."""
-        self._injections.append([predicate, exc, True])
+    def inject_once(self, predicate, exc: Exception, break_connection: bool = False):
+        """Raise `exc` on the first statement matching predicate(sql,
+        params). With break_connection=True the connection is marked
+        broken first (the dropped-mid-transaction shape: the datastore
+        must discard it and redial, never retry into it)."""
+        self._injections.append([predicate, exc, True, break_connection])
 
     def statements(self, kind: str = "execute") -> list[tuple]:
         return [e for e in self.log if e[0] == kind]

@@ -1518,12 +1518,32 @@ class Datastore:
     # (binary_utils applies it) or the JANUS_SLOW_TX_WARN_S env var;
     # <= 0 disables.
     slow_tx_warn_s = float(os.environ.get("JANUS_SLOW_TX_WARN_S", "1.0"))
+    # cap on one run_tx retry sleep; the actual sleep is full-jitter
+    # uniform in [0, min(cap, base * 2^attempt)] so a retry storm after
+    # an outage doesn't re-land every worker on the same instant.
+    # Configurable via database.retry_max_interval_secs (binary_utils).
+    retry_max_interval_s = 0.128
+    retry_base_interval_s = 0.002
 
     def __init__(self, path: str, crypter: Crypter, clock):
         self._path = path
         self._crypter = crypter
         self._clock = clock
         self._local = threading.local()
+        # every live per-thread connection, so close() / SIGTERM drain
+        # can close them all instead of leaking every non-calling
+        # thread's socket (the thread-local alone only reaches one)
+        self._conn_registry: set = set()
+        self._conn_registry_lock = threading.Lock()
+        # scope suffix for the datastore.connect failpoint
+        # (hit as `datastore.connect` + `datastore.connect.<scope>`), so
+        # a schedule can take down ONE datastore in a multi-store
+        # process (the chaos harness names the leader's "leader")
+        self.failpoint_scope = os.path.basename(str(path)) or str(path)
+        # attached by start_supervision(); run_tx feeds it success /
+        # connection-failure observations even before the probe thread
+        # exists
+        self.supervisor: DatastoreSupervisor | None = None
         self._bootstrap_schema()
 
     def _bootstrap_schema(self) -> None:
@@ -1541,14 +1561,60 @@ class Datastore:
     def clock(self):
         return self._clock
 
+    @property
+    def crypter(self) -> Crypter:
+        """The at-rest crypter (shared with the upload spill journal so
+        journaled shares stay encrypted on disk under the same keys)."""
+        return self._crypter
+
+    def _hit_connect_failpoint(self) -> None:
+        """`datastore.connect` failpoint (error/delay/timeout): fires on
+        EVERY connection checkout, cached or fresh, so an armed outage
+        schedule models 'the database is unreachable' — not merely 'new
+        dials fail while cached sockets keep working'. The error action
+        raises this engine's connection-lost error type, which run_tx
+        classifies as connection-class (discard + supervisor signal)."""
+        from .. import failpoints
+
+        failpoints.hit_scoped(
+            "datastore.connect",
+            self.failpoint_scope,
+            error_factory=lambda: self._connection_lost_error(
+                "injected connect failure (failpoint datastore.connect)"
+            ),
+            timeout_factory=lambda: self._connection_lost_error(
+                "injected connect timeout (failpoint datastore.connect)"
+            ),
+        )
+
+    def _connection_lost_error(self, msg: str) -> Exception:
+        """This engine's connection-lost exception type (classified as
+        kind="connection" by classify_error)."""
+        return sqlite3.OperationalError(msg)
+
+    def _register_conn(self, conn) -> None:
+        with self._conn_registry_lock:
+            self._conn_registry.add(conn)
+
     def _connect(self) -> sqlite3.Connection:
+        self._hit_connect_failpoint()
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self._path, timeout=30.0, uri=self._path.startswith("file:"))
+            # check_same_thread=False: each thread still uses only its
+            # own connection (threading.local discipline), but close()
+            # and _discard() may run from another thread (test teardown,
+            # SIGTERM drain) and must be able to close it
+            conn = sqlite3.connect(
+                self._path,
+                timeout=30.0,
+                uri=self._path.startswith("file:"),
+                check_same_thread=False,
+            )
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
             conn.execute("PRAGMA foreign_keys=ON")
             self._local.conn = conn
+            self._register_conn(conn)
         return conn
 
     def _begin(self, conn) -> None:
@@ -1559,10 +1625,44 @@ class Datastore:
         return conn
 
     def _discard(self, conn) -> None:
-        """Drop a known-dead cached connection (engine hook)."""
+        """Drop a known-dead cached connection: close it, unregister it,
+        and clear the thread-local so the next _connect dials fresh."""
+        try:
+            conn.close()
+        except Exception:
+            pass
+        with self._conn_registry_lock:
+            self._conn_registry.discard(conn)
+        if getattr(self._local, "conn", None) is conn:
+            self._local.conn = None
 
     def _discard_if_broken(self, conn) -> None:
-        """Drop the cached connection if the engine marks it broken."""
+        """Drop the cached connection if the engine marks it broken
+        (engine hook; SQLite connections carry no broken flag)."""
+
+    def classify_error(self, e: BaseException) -> str:
+        """Typed datastore error classifier:
+
+          "serialization"  contention — safe to retry on the SAME
+                           connection (SQLITE_BUSY, injected TxConflict)
+          "connection"     the connection (or the database under it) is
+                           gone — discard the cached connection,
+                           reconnect, and tell the supervisor
+          "fatal"          schema/SQL error — retrying cannot help
+          "other"          anything else
+        """
+        if isinstance(e, TxConflict):
+            return "serialization"
+        if isinstance(e, sqlite3.OperationalError):
+            msg = str(e).lower()
+            if "locked" in msg or "busy" in msg:
+                return "serialization"
+            if "no such" in msg or "syntax error" in msg:
+                return "fatal"
+            # "unable to open database file", "disk I/O error",
+            # injected connect failures, ...
+            return "connection"
+        return "other"
 
     @property
     def _retryable_errors(self) -> tuple:
@@ -1591,6 +1691,45 @@ class Datastore:
 
         return cm()
 
+    def _retry_sleep_s(self, attempt: int) -> float:
+        """Full-jitter exponential backoff, capped at
+        retry_max_interval_s: uniform in [0, min(cap, base * 2^n)] so
+        concurrent workers retrying out of the same failure don't
+        re-collide, and an operator can stretch the cap for outage-heavy
+        deployments (database.retry_max_interval_secs)."""
+        import random
+
+        ceiling = min(
+            max(0.0, float(self.retry_max_interval_s)),
+            self.retry_base_interval_s * (1 << min(attempt, 30)),
+        )
+        return random.uniform(0.0, ceiling)
+
+    def probe(self) -> None:
+        """One cheap connectivity check on this thread's connection
+        (the supervisor's health probe). Raises the engine error on
+        failure, discarding the dead connection first so the next call
+        dials fresh."""
+        conn = None
+        try:
+            conn = self._connect()
+            conn.execute("SELECT 1").fetchone()
+            # leave no transaction open behind the probe (psycopg's
+            # implicit BEGIN opens one at the first statement)
+            conn.rollback()
+        except BaseException:
+            if conn is not None:
+                self._discard(conn)
+            raise
+
+    def start_supervision(self, **kwargs) -> "DatastoreSupervisor":
+        """Create, attach and start the background health supervisor
+        (idempotent). kwargs go to DatastoreSupervisor."""
+        if self.supervisor is None:
+            self.supervisor = DatastoreSupervisor(self, **kwargs)
+            self.supervisor.start()
+        return self.supervisor
+
     def run_tx(self, fn, name: str = "tx"):
         """Run fn(Transaction) with retry on busy/conflict
         (reference run_tx_with_name, datastore.rs:216-242).
@@ -1612,9 +1751,17 @@ class Datastore:
             return TxConflict(f"injected conflict (failpoint, tx={name})")
 
         start = _time.monotonic()
+        # supervisor accounting is per run_tx CALL, not per attempt: a
+        # single doomed transaction retrying 3 times in ~10ms must not
+        # masquerade as 3 independent outage observations and trip
+        # down_threshold from a sub-second blip
+        supervisor_notified = False
         for attempt in range(self.MAX_RETRIES):
-            conn = self._connect()
+            conn = None
             try:
+                # inside the try: a failed (re)connect is a retryable
+                # connection-class failure, not an immediate crash out
+                conn = self._connect()
                 self._begin(conn)
                 failpoints.hit_scoped("datastore.tx_begin", name, error_factory=_inj)
                 tx = self._tx_obj(conn)
@@ -1630,29 +1777,300 @@ class Datastore:
                         " (threshold %.2fs)",
                         name, elapsed, attempt + 1, self.slow_tx_warn_s,
                     )
+                if self.supervisor is not None:
+                    self.supervisor.record_success()
                 return result
             except self._retryable_errors as e:
-                # the connection itself may be dead (e.g. Postgres
-                # restart): rollback best-effort, let the engine decide
-                # whether to discard the cached connection
-                try:
-                    conn.rollback()
-                except Exception:
-                    self._discard(conn)
-                else:
-                    self._discard_if_broken(conn)
-                if attempt == self.MAX_RETRIES - 1:
+                kind = self.classify_error(e)
+                if kind != "fatal":
+                    # fatal errors raise below without a retry; counting
+                    # them here would invent an undocumented label value
+                    metrics.tx_retries_total.add(tx=name, kind=kind)
+                if conn is not None:
+                    if kind == "connection":
+                        # the connection (or the server) is gone: never
+                        # retry INTO a dead cached connection — discard
+                        # unconditionally so the next attempt redials
+                        try:
+                            conn.rollback()
+                        except Exception:
+                            pass
+                        self._discard(conn)
+                    else:
+                        # contention: rollback best-effort, let the
+                        # engine decide whether the connection survives
+                        try:
+                            conn.rollback()
+                        except Exception:
+                            self._discard(conn)
+                        else:
+                            self._discard_if_broken(conn)
+                if (
+                    kind == "connection"
+                    and self.supervisor is not None
+                    and not supervisor_notified
+                ):
+                    supervisor_notified = True
+                    self.supervisor.record_failure(e)
+                if kind == "fatal" or attempt == self.MAX_RETRIES - 1:
                     raise
-                _time.sleep(0.002 * (1 << min(attempt, 6)))
+                _time.sleep(self._retry_sleep_s(attempt))
             except BaseException:
-                conn.rollback()
+                if conn is not None:
+                    try:
+                        conn.rollback()
+                    except Exception:
+                        self._discard(conn)
                 raise
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        """Close EVERY per-thread connection (not just the calling
+        thread's): handler/flusher/sampler threads each cached one, and
+        test teardown or SIGTERM drain must not leak their sockets to
+        the server."""
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
+        with self._conn_registry_lock:
+            conns, self._conn_registry = list(self._conn_registry), set()
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._local.conn = None
+
+
+class DatastoreSupervisor:
+    """Per-process datastore connection supervisor: a background health
+    probe drives a four-state machine
+
+        up ──(connection failures / slow commits)──▶ degraded
+        degraded ──(failures ≥ down_threshold)─────▶ down
+        down ──(probe succeeds)────────────────────▶ recovering
+        recovering ──(recover_threshold successes)─▶ up
+                   └─(any failure)─────────────────▶ down
+
+    fed by BOTH the probe and real transactions (run_tx reports every
+    connection-class failure and every commit). Consumers:
+
+      - ReportWriteBatcher: state != up ⇒ spill uploads to the journal
+        instead of stalling handler threads on a dead database;
+      - the admission controller: state != up ⇒ shed aggregate-step
+        routes early (uploads keep flowing into the journal);
+      - both job drivers: state == down ⇒ stop acquiring and step back
+        with the reconnect cooldown instead of burning lease attempts;
+      - /readyz: state == down ⇒ not ready (liveness /healthz stays up).
+
+    While down, the probe retries on a full-jitter backoff growing from
+    probe_interval_s to reconnect_max_interval_s. Exported as
+    janus_datastore_up / janus_datastore_consecutive_failures and a
+    `datastore` /statusz section."""
+
+    STATES = ("up", "degraded", "down", "recovering")
+
+    def __init__(
+        self,
+        ds: Datastore,
+        probe_interval_s: float = 5.0,
+        down_threshold: int = 3,
+        recover_threshold: int = 2,
+        reconnect_max_interval_s: float = 30.0,
+        degraded_hold_s: float = 10.0,
+    ):
+        self._ds = ds
+        self.probe_interval_s = max(0.05, float(probe_interval_s))
+        self.down_threshold = max(1, int(down_threshold))
+        self.recover_threshold = max(1, int(recover_threshold))
+        self.reconnect_max_interval_s = max(
+            self.probe_interval_s, float(reconnect_max_interval_s)
+        )
+        self.degraded_hold_s = max(0.0, float(degraded_hold_s))
+        self._lock = threading.Lock()
+        self._state = "up"
+        self._consecutive_failures = 0
+        self._recover_successes = 0
+        self._down_since: float | None = None
+        self._degraded_until = 0.0
+        self._last_error: str | None = None
+        self._transitions: dict[str, int] = {}
+        self._stop = threading.Event()
+        # set on every state change so the probe loop re-probes now
+        # instead of sleeping out a full reconnect backoff (recovery
+        # observed by real traffic should not wait ~30s for the probe)
+        self._kick = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._publish_locked()
+
+    # ------------------------------------------------------------------
+    # state machine (callable from run_tx, the writer, and the probe)
+    # ------------------------------------------------------------------
+    def _set_state_locked(self, new: str) -> None:
+        if new == self._state:
+            return
+        _log.warning("datastore supervisor: %s -> %s", self._state, new)
+        self._state = new
+        self._transitions[new] = self._transitions.get(new, 0) + 1
+        self._down_since = _time.monotonic() if new == "down" else None
+        # a state change is a probe-relevant event: wake the probe loop
+        # so recovery isn't gated on a full backoff sleep
+        self._kick.set()
+
+    def _publish_locked(self) -> None:
+        from .. import metrics
+
+        metrics.datastore_up.set(0.0 if self._state == "down" else 1.0)
+        metrics.datastore_consecutive_failures.set(float(self._consecutive_failures))
+
+    def record_failure(self, error: BaseException | None = None) -> None:
+        """One connection-class failure (probe or real transaction)."""
+        with self._lock:
+            self._consecutive_failures += 1
+            self._recover_successes = 0
+            if error is not None:
+                self._last_error = f"{type(error).__name__}: {error}"
+            if self._consecutive_failures >= self.down_threshold:
+                self._set_state_locked("down")
+            elif self._state == "up":
+                self._set_state_locked("degraded")
+            elif self._state == "recovering":
+                self._set_state_locked("down")
+            self._publish_locked()
+
+    def record_success(self) -> None:
+        """One successful commit or probe."""
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == "down":
+                self._recover_successes = 1
+                self._set_state_locked("recovering")
+            elif self._state == "recovering":
+                self._recover_successes += 1
+                if self._recover_successes >= self.recover_threshold:
+                    self._set_state_locked("up")
+            elif self._state == "degraded" and _time.monotonic() >= self._degraded_until:
+                self._set_state_locked("up")
+            self._publish_locked()
+
+    def record_slow_commit(self, elapsed_s: float) -> None:
+        """A commit that exceeded the writer's spill latency threshold:
+        the database is up but drowning — degrade (spilling uploads to
+        the journal) for at least degraded_hold_s."""
+        with self._lock:
+            self._degraded_until = _time.monotonic() + self.degraded_hold_s
+            if self._state == "up":
+                self._set_state_locked("degraded")
+            self._last_error = f"slow commit: {elapsed_s:.3f}s"
+            self._publish_locked()
+
+    # ------------------------------------------------------------------
+    # consumers
+    # ------------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def is_up(self) -> bool:
+        return self.state == "up"
+
+    def reconnect_delay_s(self) -> float:
+        """How long a consumer (job driver step-back, Retry-After)
+        should wait before trying the datastore again."""
+        with self._lock:
+            if self._state != "down" or self._down_since is None:
+                return self.probe_interval_s
+            downtime = _time.monotonic() - self._down_since
+            return min(max(self.probe_interval_s, downtime / 2), self.reconnect_max_interval_s)
+
+    def readiness(self) -> str | None:
+        """None when ready; a human-readable reason when not (only a
+        hard DOWN fails readiness — degraded still serves)."""
+        with self._lock:
+            if self._state == "down":
+                return (
+                    f"datastore down ({self._consecutive_failures} consecutive"
+                    f" failures; last: {self._last_error})"
+                )
+            return None
+
+    def status(self) -> dict:
+        """/statusz section."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "down_for_s": (
+                    round(_time.monotonic() - self._down_since, 1)
+                    if self._down_since is not None
+                    else None
+                ),
+                "last_error": self._last_error,
+                "transitions": dict(self._transitions),
+                "probe_interval_s": self.probe_interval_s,
+            }
+
+    # ------------------------------------------------------------------
+    # probe loop
+    # ------------------------------------------------------------------
+    def _probe_once(self) -> None:
+        try:
+            self._ds.probe()
+        except Exception as e:
+            kind = self._ds.classify_error(e)
+            if kind in ("connection", "other", "fatal"):
+                self.record_failure(e)
+            # serialization-class probe failures are contention, not an
+            # outage: ignore (real traffic is getting through)
+        else:
+            self.record_success()
+
+    def _probe_delay_s(self) -> float:
+        import random
+
+        if self.state != "down":
+            return self.probe_interval_s
+        # jittered reconnect backoff while down: grow toward the cap so
+        # a long outage isn't hammered, full jitter so a fleet of
+        # workers doesn't reconnect in lockstep
+        with self._lock:
+            downtime = (
+                _time.monotonic() - self._down_since if self._down_since else 0.0
+            )
+        ceiling = min(
+            self.reconnect_max_interval_s,
+            self.probe_interval_s * (1 + downtime / (4 * self.probe_interval_s)),
+        )
+        return random.uniform(self.probe_interval_s * 0.5, ceiling)
+
+    def _run(self) -> None:
+        # first probe immediately: a process booted mid-outage must not
+        # advertise up for a full interval
+        while not self._stop.is_set():
+            self._probe_once()
+            self._kick.clear()
+            # the sleep is cut short by a state change (_kick) so e.g.
+            # a traffic-observed recovery while down re-probes at once
+            self._kick.wait(self._probe_delay_s())
+            if self._stop.is_set():
+                return
+
+    def start(self) -> "DatastoreSupervisor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="datastore-supervisor", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            self._thread = None
 
 
 def _pg_schema() -> str:
@@ -1731,6 +2149,7 @@ class PostgresDatastore(Datastore):
             raise
 
     def _connect(self):
+        self._hit_connect_failpoint()
         conn = getattr(self._local, "conn", None)
         if conn is None:
             kwargs = {}
@@ -1739,6 +2158,7 @@ class PostgresDatastore(Datastore):
             conn = self._driver.connect(self._dsn, autocommit=False, **kwargs)
             conn.isolation_level = self._driver.IsolationLevel.REPEATABLE_READ
             self._local.conn = conn
+            self._register_conn(conn)
         return conn
 
     def _begin(self, conn) -> None:
@@ -1749,17 +2169,27 @@ class PostgresDatastore(Datastore):
     def _adapt(self, conn):
         return _PgConnAdapter(conn)
 
-    def _discard(self, conn) -> None:
-        try:
-            conn.close()
-        except Exception:
-            pass
-        if getattr(self._local, "conn", None) is conn:
-            self._local.conn = None
+    def _connection_lost_error(self, msg: str) -> Exception:
+        return self._driver.OperationalError(msg)
 
     def _discard_if_broken(self, conn) -> None:
         if getattr(conn, "closed", False) or getattr(conn, "broken", False):
             self._discard(conn)
+
+    def classify_error(self, e: BaseException) -> str:
+        errs = self._driver.errors
+        if isinstance(
+            e, (errs.SerializationFailure, errs.DeadlockDetected, TxConflict)
+        ):
+            return "serialization"
+        if isinstance(e, self._driver.OperationalError):
+            # psycopg raises OperationalError for lost/refused
+            # connections and server shutdown ("server closed the
+            # connection unexpectedly", admin shutdown, ...)
+            return "connection"
+        if isinstance(e, getattr(self._driver, "ProgrammingError", ())):
+            return "fatal"
+        return "other"
 
     @property
     def _retryable_errors(self) -> tuple:

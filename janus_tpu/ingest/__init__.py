@@ -7,14 +7,18 @@ IngestPipeline (decode → parallel HPKE-decrypt pool → validation →
 group commit through the ReportWriteBatcher)."""
 
 from .admission import AdmissionConfig, AdmissionController, ShedError, TokenBucket
+from .journal import JournalFull, JournalReplayer, UploadJournal
 from .pipeline import IngestPipeline, UploadTicket, default_decrypt_workers
 
 __all__ = [
     "AdmissionConfig",
     "AdmissionController",
     "IngestPipeline",
+    "JournalFull",
+    "JournalReplayer",
     "ShedError",
     "TokenBucket",
+    "UploadJournal",
     "UploadTicket",
     "default_decrypt_workers",
 ]

@@ -34,15 +34,25 @@ from dataclasses import dataclass
 
 
 class ShedError(Exception):
-    """Request refused by admission control (HTTP 429)."""
+    """Request refused by admission control. `status` is the HTTP
+    answer: 429 for capacity sheds (try again soon), 503 for
+    availability sheds (datastore down, journal full — the server,
+    not the client, is the problem); both carry Retry-After."""
 
-    def __init__(self, route_class: str, reason: str, retry_after_s: float):
+    def __init__(
+        self,
+        route_class: str,
+        reason: str,
+        retry_after_s: float,
+        status: int = 429,
+    ):
         super().__init__(
             f"{route_class} shed ({reason}); retry after {retry_after_s:.1f}s"
         )
         self.route_class = route_class
         self.reason = reason
         self.retry_after_s = retry_after_s
+        self.status = status
 
 
 class TokenBucket:
@@ -97,9 +107,15 @@ class AdmissionController:
     occupancy; the controller derives per-class watermarks from the
     configured shed priority."""
 
-    def __init__(self, cfg: AdmissionConfig, depth_fn=None):
+    def __init__(self, cfg: AdmissionConfig, depth_fn=None, supervisor_fn=None):
         self.cfg = cfg
         self._depth_fn = depth_fn
+        # optional datastore supervisor accessor (degraded-mode serving,
+        # docs/ROBUSTNESS.md): while the datastore is not up, the
+        # aggregate-step routes — whose handlers go straight into
+        # datastore transactions — shed 503 up front, while client
+        # uploads keep flowing (they land in the durable spill journal)
+        self._supervisor_fn = supervisor_fn or (lambda: None)
         self._buckets: dict[str, TokenBucket] = {}
         if cfg.upload_bucket_rate > 0:
             self._buckets["upload"] = TokenBucket(
@@ -124,6 +140,15 @@ class AdmissionController:
 
     def admit(self, route_class: str) -> None:
         """Raise ShedError if this request must be refused."""
+        if route_class == "aggregate":
+            supervisor = self._supervisor_fn()
+            if supervisor is not None and supervisor.state != "up":
+                raise ShedError(
+                    route_class,
+                    f"datastore_{supervisor.state}",
+                    supervisor.reconnect_delay_s(),
+                    status=503,
+                )
         wm = self._watermarks.get(route_class)
         if wm is not None and self._depth_fn is not None:
             depth, bound = self._depth_fn()

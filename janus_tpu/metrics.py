@@ -271,6 +271,43 @@ http_request_duration = REGISTRY.histogram(
 tx_duration = REGISTRY.histogram(
     "janus_database_transaction_duration_seconds", "datastore transaction latency"
 )
+tx_retries_total = REGISTRY.counter(
+    "janus_tx_retries_total",
+    "datastore transaction attempts that failed retryably, by tx name and "
+    'error class (kind="serialization" is contention, kind="connection" is '
+    "an outage — alert on the latter)",
+)
+# --- datastore connection supervision (datastore/store.py
+# DatastoreSupervisor; docs/ROBUSTNESS.md "Datastore outages") ---
+datastore_up = REGISTRY.gauge(
+    "janus_datastore_up",
+    "1 while the datastore health probe reports the database reachable "
+    "(state up/degraded/recovering), 0 while down",
+)
+datastore_consecutive_failures = REGISTRY.gauge(
+    "janus_datastore_consecutive_failures",
+    "consecutive connection-class datastore failures observed by the "
+    "supervisor (probe + real transactions); resets on success",
+)
+# --- durable upload spill journal (janus_tpu/ingest/journal.py) ---
+upload_journal_depth = REGISTRY.gauge(
+    "janus_upload_journal_depth",
+    "reports sitting in the on-disk upload spill journal awaiting replay "
+    "(0 in steady state; alert on sustained growth)",
+)
+upload_journal_bytes = REGISTRY.gauge(
+    "janus_upload_journal_bytes", "on-disk bytes held by the upload spill journal"
+)
+upload_journal_appends_total = REGISTRY.counter(
+    "janus_upload_journal_appends_total",
+    "reports spilled to the upload journal instead of the datastore "
+    "(each was acked 201 on the strength of the journal fsync)",
+)
+upload_journal_replayed_total = REGISTRY.counter(
+    "janus_upload_journal_replayed_total",
+    "journaled reports replayed into the datastore, by outcome "
+    '(outcome="fresh" newly written, outcome="replayed" deduplicated)',
+)
 # --- ingest pipeline (janus_tpu.ingest; docs/INGEST.md) ---
 upload_shed_counter = REGISTRY.counter(
     "janus_upload_shed_total",

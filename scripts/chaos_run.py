@@ -45,12 +45,32 @@ Deterministic schedule (all probabilistic faults are count-budgeted):
      janus_outbound_circuit_state / _transitions_total and on
      /statusz, and driver B SIGTERM-drains cleanly.
 
+A second scenario, `--scenario db_outage`, proves DATASTORE-outage
+survival (docs/ROBUSTNESS.md "Datastore outages"): under a sustained
+upload load, the leader's database is taken down via the
+`datastore.connect` failpoint (scoped to the leader's store — no real
+process is killed). Invariants:
+
+  - every upload acked 201 before, DURING and after the outage window
+    is present exactly once in the final collected aggregate — during
+    the outage the acks rest on the durable spill journal's fsync;
+  - the datastore supervisor walks up → degraded → down → recovering →
+    up, `/readyz` flips 200 → 503 (with a JSON reason) → 200 while
+    `/healthz` stays live, and aggregate-step routes shed 503 while
+    the store is down;
+  - on recovery the journal drains to empty (replay through the write
+    batcher, report-id dedup = exactly-once) and is truncated;
+  - while the datastore is healthy the armed-but-idle journal performs
+    ZERO fsyncs — the hot path is unchanged.
+
 Usage:
     python scripts/chaos_run.py --smoke --json   # fast deterministic
     python scripts/chaos_run.py --json           # full schedule (slow)
+    python scripts/chaos_run.py --scenario db_outage --smoke --json
 
 Exit code 0 iff every invariant held; the result JSON rides on stdout
-(bench.py --dry-run embeds the smoke as its chaos_smoke phase).
+(bench.py --dry-run embeds the smokes as its chaos_smoke and
+db_outage_smoke phases).
 """
 
 from __future__ import annotations
@@ -87,6 +107,10 @@ POST_COMMIT_CRASH_SCHEDULE = (
 )
 STORM_SCHEDULE = "helper.request=error:1.0,count=2;datastore.commit=error:0.2"
 HELPER_5XX_SCHEDULE = "helper.aggregate=error:1.0,count=2"
+# full datastore outage, scoped to the store whose failpoint_scope is
+# "leader" (the harness names the leader's store; the in-process
+# helper's store keeps its default scope and stays up)
+DB_OUTAGE_SCHEDULE = "datastore.connect.leader=error:1.0"
 
 
 def _free_port() -> int:
@@ -515,6 +539,338 @@ def run_chaos(
         helper_ds.close()
 
 
+def _http_status(url: str, method: str = "GET", body: bytes | None = None,
+                 headers: dict | None = None, timeout: float = 10.0):
+    """(status, body bytes) tolerating non-2xx (urllib raises on those);
+    the shared helper lives beside the HTTP client."""
+    from janus_tpu.core.http_client import fetch_any_status
+
+    return fetch_any_status(url, method=method, body=body, headers=headers, timeout=timeout)
+
+
+def run_db_outage(
+    n_warm: int = 4,
+    outage_hold_s: float = 1.5,
+    probe_interval_s: float = 0.15,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Datastore-outage survival schedule (see module docstring); every
+    `*_ok` key must be True for the run to pass."""
+    import threading
+
+    from janus_tpu import failpoints
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.binary_utils import (
+        HealthServer,
+        enable_compile_cache,
+        register_readiness_check,
+        unregister_readiness_check,
+        warmup_engines,
+    )
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore
+    from janus_tpu.messages import (
+        AggregationJobInitializeReq,
+        Duration,
+        Interval,
+        Query,
+        Role,
+        Time,
+    )
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    import dataclasses
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-dbout-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    clock = RealClock()
+    leader_ds = Datastore(
+        os.path.join(tmp, "leader.sqlite"), Crypter([key_bytes]), clock
+    )
+    # the outage schedule targets ONLY this store (the in-process
+    # helper's store keeps its default scope and stays up)
+    leader_ds.failpoint_scope = "leader"
+    helper_ds = Datastore(
+        os.path.join(tmp, "helper.sqlite"), Crypter([key_bytes]), clock
+    )
+    sup = leader_ds.start_supervision(
+        probe_interval_s=probe_interval_s,
+        down_threshold=2,
+        reconnect_max_interval_s=max(1.0, 4 * probe_interval_s),
+    )
+    register_readiness_check("datastore", sup.readiness)
+
+    result: dict = {
+        "workdir": tmp,
+        "schedule": "db_outage_full" if full else "db_outage_smoke",
+    }
+    leader_srv = helper_srv = health_srv = None
+    leader_agg = None
+    try:
+        journal_dir = os.path.join(tmp, "upload-journal")
+        leader_agg = Aggregator(
+            leader_ds,
+            clock,
+            Config(
+                collection_retry_after_s=1,
+                upload_journal_path=journal_dir,
+                upload_journal_replay_interval_s=0.2,
+            ),
+        )
+        journal = leader_agg.upload_journal
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(DapHttpApp(leader_agg)).start()
+        health_srv = HealthServer("127.0.0.1:0").start()
+        hp = health_srv.port
+
+        vdaf = VdafInstance.count()
+        collector_kp = generate_hpke_config_and_private_key(config_id=201)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=2),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+        enable_compile_cache()
+        warmup_engines(leader_ds)
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id,
+            leader_srv.url,
+            helper_srv.url,
+            leader_task.time_precision,
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+
+        # --- sustained upload load: one background uploader running
+        # across the whole schedule; every 201-acked measurement is
+        # ground truth, wherever the ack came from -------------------
+        acked: list[int] = []
+        upload_errors: list[str] = []
+        stop_uploader = threading.Event()
+
+        def uploader():
+            i = 0
+            while not stop_uploader.is_set():
+                m = (i % 3 != 0) * 1
+                try:
+                    client.upload(m)
+                    acked.append(m)
+                except Exception as e:  # shed/refused: NOT ground truth
+                    upload_errors.append(f"{type(e).__name__}: {e}")
+                i += 1
+                stop_uploader.wait(0.04)
+
+        # --- phase 1: healthy, journal armed but idle ----------------
+        t0 = time.monotonic()
+        for i in range(n_warm):
+            client.upload(1)
+            acked.append(1)
+        result["healthy_upload_ms"] = round(
+            (time.monotonic() - t0) / max(1, n_warm) * 1000, 2
+        )
+        # the armed-but-idle journal must not touch the hot path
+        result["healthy_fsyncs"] = journal.fsyncs
+        result["healthy_fsyncs_ok"] = journal.fsyncs == 0
+        status, body = _http_status(f"http://127.0.0.1:{hp}/readyz")
+        result["readyz_up_ok"] = (
+            status == 200 and json.loads(body).get("ready") is True
+        )
+        # jobs created now but NOT stepped: the outage-window driver
+        # pass below must park instead of burning their lease attempts
+        creator = AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=100
+            ),
+        )
+        creator.run_once()
+
+        ut = threading.Thread(target=uploader, daemon=True)
+        ut.start()
+        time.sleep(6 * 0.04)  # a few sustained-load acks while healthy
+
+        # --- phase 2: kill the datastore under load ------------------
+        acked_before_outage = len(acked)
+        failpoints.configure(DB_OUTAGE_SCHEDULE)
+        deadline = time.monotonic() + 30
+        while sup.state != "down" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        result["supervisor_down_ok"] = sup.state == "down"
+        status, body = _http_status(f"http://127.0.0.1:{hp}/readyz")
+        try:
+            reasons = json.loads(body).get("reasons", {})
+        except Exception:
+            reasons = {}
+        result["readyz_down_status"] = status
+        result["readyz_down_ok"] = status == 503 and bool(reasons)
+        # aggregate-step routes shed 503 up front while the store is
+        # down (the helper would only waste work on a doomed handler)
+        tid = base64.urlsafe_b64encode(leader_task.task_id.data).decode().rstrip("=")
+        jid = base64.urlsafe_b64encode(secrets.token_bytes(16)).decode().rstrip("=")
+        status, _ = _http_status(
+            f"{leader_srv.url}tasks/{tid}/aggregation_jobs/{jid}",
+            method="PUT",
+            body=b"x",
+            headers={"Content-Type": AggregationJobInitializeReq.MEDIA_TYPE},
+        )
+        result["aggregate_shed_status"] = status
+        result["aggregate_shed_ok"] = status == 503
+        # a driver pass during the outage parks (no acquire, no lease
+        # attempts burned) instead of crashing or marching to abandon
+        drv = AggregationJobDriver(leader_ds, http)
+        jd = JobDriver(
+            JobDriverConfig(job_discovery_interval_s=0.1),
+            drv.acquirer(60),
+            drv.stepper,
+        )
+        result["driver_parked_ok"] = jd.run_once() == 0
+        time.sleep(outage_hold_s)  # sustained load keeps acking into the journal
+        depth_during = journal.depth()
+        result["journal_depth_during_outage"] = depth_during[0]
+        acked_during_outage = len(acked) - acked_before_outage
+        result["acked_during_outage"] = acked_during_outage
+        result["spilled_acked_ok"] = (
+            acked_during_outage > 0 and depth_during[0] > 0
+        )
+
+        # --- phase 3: recovery ---------------------------------------
+        failpoints.clear()
+        deadline = time.monotonic() + 60
+        while (
+            sup.state != "up" or journal.depth()[0] > 0
+        ) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        result["supervisor_recovered_ok"] = sup.state == "up"
+        result["journal_drained_ok"] = journal.depth()[0] == 0
+        status, body = _http_status(f"http://127.0.0.1:{hp}/readyz")
+        result["readyz_recovered_ok"] = (
+            status == 200 and json.loads(body).get("ready") is True
+        )
+        time.sleep(6 * 0.04)  # a few more sustained-load acks while healthy
+        stop_uploader.set()
+        ut.join(timeout=30)
+        result["admitted"] = len(acked)
+        result["ground_truth_sum"] = sum(acked)
+        result["upload_errors"] = upload_errors[:5]
+        result["uploads_all_acked_ok"] = not upload_errors
+
+        # --- phase 4: aggregate + collect == ground truth ------------
+        creator.run_once()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            jd.run_once()
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "dbout_monitor"
+            )
+            agg = {s: n for (t, s), n in counts.items() if t == "aggregation"}
+            if agg.get("in_progress", 0) == 0:
+                break
+            time.sleep(0.1)
+        # absent key = zero jobs in that state (count_jobs_by_state only
+        # returns states with rows)
+        result["aggregation_done_ok"] = agg.get("in_progress", 0) == 0 and bool(
+            agg.get("finished", 0)
+        )
+
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            cjd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                cjd.run_once()
+                stop_collect.wait(0.3)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+        try:
+            collector = Collector(
+                CollectorParameters(
+                    leader_task.task_id,
+                    leader_srv.url,
+                    leader_task.collector_auth_token,
+                    collector_kp,
+                ),
+                vdaf,
+                HttpClient(),
+            )
+            tp = leader_task.time_precision
+            start = clock.now().to_batch_interval_start(tp)
+            query = Query.time_interval(
+                Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+            )
+            collected = collector.collect(query, timeout_s=120.0)
+            result["collected_count"] = collected.report_count
+            result["collected_sum"] = collected.aggregate_result
+            # THE invariant: every 201 — healthy, spilled, replayed —
+            # exactly once; no loss, no double count
+            result["exactly_once_ok"] = (
+                collected.report_count == len(acked)
+                and collected.aggregate_result == sum(acked)
+            )
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+
+        result["journal_fsyncs_total"] = journal.fsyncs
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        failpoints_mod = sys.modules.get("janus_tpu.failpoints")
+        if failpoints_mod is not None:
+            failpoints_mod.clear()
+        unregister_readiness_check("datastore")
+        unregister_readiness_check("upload_journal")
+        if leader_agg is not None:
+            leader_agg.close()
+        for srv in (leader_srv, helper_srv):
+            if srv is not None:
+                srv.stop()
+        if health_srv is not None:
+            health_srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -523,17 +879,33 @@ def main(argv=None) -> int:
         help="fast deterministic schedule (crash + storm + collect); "
         "the default runs the full schedule incl. the post-commit crash",
     )
+    ap.add_argument(
+        "--scenario",
+        choices=["crash_storm", "db_outage"],
+        default="crash_storm",
+        help="crash_storm = driver SIGKILL + helper storms (default); "
+        "db_outage = datastore outage under upload load (journal spill, "
+        "degraded serving, replay, exactly-once)",
+    )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
     ap.add_argument("--workdir", default=None, help="keep artifacts here (default: temp dir)")
     args = ap.parse_args(argv)
 
-    n = args.reports or (5 if args.smoke else 12)
-    result = run_chaos(
-        n_reports=n,
-        full=not args.smoke,
-        workdir=args.workdir,
-    )
+    if args.scenario == "db_outage":
+        result = run_db_outage(
+            n_warm=args.reports or (4 if args.smoke else 10),
+            outage_hold_s=1.5 if args.smoke else 5.0,
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    else:
+        n = args.reports or (5 if args.smoke else 12)
+        result = run_chaos(
+            n_reports=n,
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
     if args.json:
         print(json.dumps(result))
     else:

@@ -32,6 +32,15 @@ def _fetch(url: str, timeout: float) -> tuple[str, str]:
         return resp.read().decode("utf-8"), resp.headers.get("Content-Type", "")
 
 
+def _fetch_any_status(url: str, timeout: float) -> tuple[int, str]:
+    """(status, body) tolerating non-2xx (a degraded /readyz answers
+    503, which urllib raises as HTTPError)."""
+    from janus_tpu.core.http_client import fetch_any_status
+
+    status, body = fetch_any_status(url, timeout=timeout)
+    return status, body.decode("utf-8")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -69,6 +78,33 @@ def main(argv=None) -> int:
         else:
             if not isinstance(snap, dict) or not snap:
                 errors.append("/statusz snapshot is empty")
+
+    # /readyz semantics (docs/ROBUSTNESS.md "Datastore outages"): 200
+    # with {"ready": true} when serving, 503 with a JSON reason map when
+    # degraded (datastore down / upload journal full). Anything else —
+    # missing route, non-JSON body, status/body disagreement — is a
+    # deploy regression.
+    try:
+        status, body = _fetch_any_status(base + "/readyz", args.timeout)
+    except Exception as e:
+        errors.append(f"GET /readyz failed: {e}")
+    else:
+        if status not in (200, 503):
+            errors.append(f"/readyz answered {status} (want 200 or 503)")
+        else:
+            try:
+                ready = json.loads(body)
+            except Exception as e:
+                errors.append(f"/readyz not valid JSON: {e}")
+            else:
+                if not isinstance(ready, dict) or "ready" not in ready:
+                    errors.append("/readyz JSON missing 'ready'")
+                elif ready["ready"] is not (status == 200):
+                    errors.append(
+                        f"/readyz status {status} disagrees with body {ready}"
+                    )
+                elif status == 503 and not ready.get("reasons"):
+                    errors.append("/readyz degraded (503) without a JSON reason")
 
     # the always-on flight recorder (janus_tpu.trace) serves
     # /debug/traces on every binary; a listener that can't render it

@@ -50,12 +50,17 @@ def test_schedules_parse():
         chaos.POST_COMMIT_CRASH_SCHEDULE,
         chaos.STORM_SCHEDULE,
         chaos.HELPER_5XX_SCHEDULE,
+        chaos.DB_OUTAGE_SCHEDULE,
     ):
         assert failpoints.parse_spec(spec)
     crash = failpoints.parse_spec(chaos.CRASH_SCHEDULE)[
         "datastore.commit.step_agg_job_write"
     ]
     assert crash.action == "crash" and crash.count == 1
+    outage = failpoints.parse_spec(chaos.DB_OUTAGE_SCHEDULE)[
+        "datastore.connect.leader"
+    ]
+    assert outage.action == "error" and outage.prob == 1.0
 
 
 @pytest.mark.slow  # ~60-90s: four driver subprocess boots
@@ -87,3 +92,41 @@ def test_chaos_full_schedule(tmp_path):
     assert rec["post_commit_crash_ok"] is True
     assert rec["clean_restart_ok"] is True
     assert rec["exactly_once_ok"] is True
+
+
+@pytest.mark.slow  # ~15s: outage window + replay drain + collection
+@pytest.mark.chaos
+def test_chaos_db_outage_full_schedule(tmp_path):
+    """Datastore-outage survival, full schedule: a sustained upload
+    load rides through a multi-second datastore outage on the spill
+    journal, /readyz cycles, the journal drains, and the collection
+    equals every 201-acked report exactly once."""
+    import json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join("scripts", "chaos_run.py"),
+            "--scenario",
+            "db_outage",
+            "--json",
+            "--workdir",
+            str(tmp_path),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rec = json.loads([l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+    assert rec["ok"] is True
+    assert rec["schedule"] == "db_outage_full"
+    assert rec["acked_during_outage"] > 0
+    assert rec["healthy_fsyncs_ok"] is True
+    assert rec["journal_drained_ok"] is True
+    assert rec["exactly_once_ok"] is True
+    assert rec["collected_count"] == rec["admitted"]

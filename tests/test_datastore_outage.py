@@ -1,0 +1,463 @@
+"""Datastore connection supervision (datastore/store.py): the typed
+error classifier, run_tx's jittered/capped/metered retry, the per-
+thread connection registry behind close(), the connection-lost discard
+path over pg_fake, the up/degraded/down/recovering supervisor, the
+/healthz-vs-/readyz split, and degraded-mode admission shedding
+(docs/ROBUSTNESS.md "Datastore outages").
+"""
+
+import json
+import sqlite3
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from janus_tpu import failpoints, metrics
+from janus_tpu.datastore.pg_fake import (
+    OperationalError as PgOperationalError,
+    SerializationFailure,
+)
+from janus_tpu.datastore.store import (
+    DatastoreSupervisor,
+    EphemeralDatastore,
+    TxConflict,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+@pytest.fixture
+def eph():
+    e = EphemeralDatastore()
+    yield e
+    e.cleanup()
+
+
+@pytest.fixture
+def pgfake():
+    e = EphemeralDatastore(engine="pgfake")
+    yield e
+    e.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# error classifier
+# ---------------------------------------------------------------------------
+
+
+def test_classify_error_sqlite(eph):
+    ds = eph.datastore
+    assert ds.classify_error(TxConflict("x")) == "serialization"
+    assert ds.classify_error(sqlite3.OperationalError("database is locked")) == (
+        "serialization"
+    )
+    assert ds.classify_error(
+        sqlite3.OperationalError("unable to open database file")
+    ) == "connection"
+    assert ds.classify_error(sqlite3.OperationalError("disk I/O error")) == (
+        "connection"
+    )
+    assert ds.classify_error(sqlite3.OperationalError("no such table: nope")) == (
+        "fatal"
+    )
+    assert ds.classify_error(ValueError("x")) == "other"
+
+
+def test_classify_error_pgfake(pgfake):
+    ds = pgfake.datastore
+    assert ds.classify_error(SerializationFailure("concurrent update")) == (
+        "serialization"
+    )
+    assert ds.classify_error(TxConflict("x")) == "serialization"
+    assert ds.classify_error(
+        PgOperationalError("server closed the connection unexpectedly")
+    ) == "connection"
+    assert ds.classify_error(ValueError("x")) == "other"
+
+
+# ---------------------------------------------------------------------------
+# run_tx retry behavior
+# ---------------------------------------------------------------------------
+
+
+def test_tx_retries_metric_by_kind(eph):
+    ds = eph.datastore
+    ds.failpoint_scope = "retrymetric"
+    ser0 = metrics.tx_retries_total.get(tx="kindtest", kind="serialization")
+    conn0 = metrics.tx_retries_total.get(tx="kindtest", kind="connection")
+    failpoints.configure("datastore.commit.kindtest=error:1.0,count=2")
+    assert ds.run_tx(lambda tx: tx.get_task_ids(), "kindtest") == []
+    assert metrics.tx_retries_total.get(tx="kindtest", kind="serialization") == ser0 + 2
+    failpoints.configure("datastore.connect.retrymetric=error:1.0,count=3")
+    assert ds.run_tx(lambda tx: tx.get_task_ids(), "kindtest") == []
+    assert metrics.tx_retries_total.get(tx="kindtest", kind="connection") == conn0 + 3
+
+
+def test_retry_backoff_full_jitter_and_cap(eph):
+    ds = eph.datastore
+    # jitter: uniform in [0, min(cap, base * 2^n)], never above the cap
+    ds.retry_max_interval_s = 0.01
+    samples = [ds._retry_sleep_s(a) for a in range(20) for _ in range(5)]
+    assert all(0.0 <= s <= 0.01 for s in samples)
+    assert len(set(samples)) > 10  # actually jittered, not a fixed ladder
+    # early attempts stay under the exponential envelope
+    assert all(ds._retry_sleep_s(0) <= 0.002 for _ in range(20))
+    # a 16-attempt connection-failure walk under a tight cap stays fast
+    ds.failpoint_scope = "captest"
+    failpoints.configure("datastore.connect.captest=error:1.0")
+    t0 = time.monotonic()
+    with pytest.raises(sqlite3.OperationalError):
+        ds.run_tx(lambda tx: tx.get_task_ids(), "captest")
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_fatal_errors_do_not_retry(eph):
+    ds = eph.datastore
+    calls = {"n": 0}
+
+    def fn(tx):
+        calls["n"] += 1
+        tx._c.execute("SELECT * FROM definitely_not_a_table")
+
+    with pytest.raises(sqlite3.OperationalError):
+        ds.run_tx(fn, "fataltest")
+    assert calls["n"] == 1  # retrying a schema error cannot help
+
+
+# ---------------------------------------------------------------------------
+# connection registry / close()
+# ---------------------------------------------------------------------------
+
+
+def test_close_closes_every_threads_connection(eph):
+    ds = eph.datastore
+    conns = {}
+
+    def worker(name):
+        ds.run_tx(lambda tx: tx.get_task_ids(), "reg")
+        conns[name] = ds._connect()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    conns["main"] = ds._connect()
+    assert len(set(map(id, conns.values()))) == 4  # one per thread
+    ds.close()
+    for conn in conns.values():
+        with pytest.raises(sqlite3.ProgrammingError):
+            conn.execute("SELECT 1")
+
+
+def test_discard_unregisters(eph):
+    ds = eph.datastore
+    conn = ds._connect()
+    assert conn in ds._conn_registry
+    ds._discard(conn)
+    assert conn not in ds._conn_registry
+    assert ds._connect() is not conn  # fresh dial
+
+
+# ---------------------------------------------------------------------------
+# connection-lost over pg_fake (the Postgres engine's discard path)
+# ---------------------------------------------------------------------------
+
+
+def test_pg_connection_dropped_mid_tx_discarded_and_reconnected(pgfake):
+    """A connection dropped mid-transaction (broken flag set, every
+    later call on it fails — the psycopg shape) must be DISCARDED
+    (closed, unregistered) and the next run_tx attempt must reconnect
+    and succeed. Pins the engine behavior the no-op _discard hook used
+    to leave untested."""
+    ds = pgfake.datastore
+    driver = pgfake._pg_driver
+    from tests.test_datastore import mktask
+
+    task = mktask()
+    conn0 = ds._connect()
+    driver.inject_once(
+        lambda sql, p: sql.startswith("INSERT INTO tasks"),
+        PgOperationalError("server closed the connection unexpectedly"),
+        break_connection=True,
+    )
+    n_before = len(driver.statements("connect"))
+    ds.run_tx(lambda tx: tx.put_task(task), "conn_lost")
+    # reconnected (fresh dial) and the dead connection was CLOSED, not
+    # leaked to the server
+    assert len(driver.statements("connect")) == n_before + 1
+    assert conn0.closed
+    assert conn0 not in ds._conn_registry
+    assert ds._connect() is not conn0
+    assert ds.run_tx(lambda tx: tx.get_task(task.task_id), "readback") is not None
+
+
+def test_pg_connection_lost_feeds_supervisor(pgfake):
+    """run_tx reports connection-class failures to the attached
+    supervisor — at most ONE per run_tx call (a single doomed
+    transaction retrying N times is one outage observation, not N),
+    and a success afterward starts recovery. No probe thread here: the
+    transitions under test are driven purely by real traffic."""
+    ds = pgfake.datastore
+    ds.supervisor = DatastoreSupervisor(ds, probe_interval_s=3600, down_threshold=2)
+    ds.failpoint_scope = "supfeed"
+    ds.retry_max_interval_s = 0.001
+    failpoints.configure("datastore.connect.supfeed=error:1.0")
+    for _ in range(2):
+        with pytest.raises(PgOperationalError):
+            ds.run_tx(lambda tx: tx.get_task_ids(), "sup_feed")
+    # two failed CALLS (not two failed attempts of one call) -> down
+    assert ds.supervisor.state == "down"
+    assert metrics.datastore_consecutive_failures.get() == 2.0
+    failpoints.clear()
+    assert ds.run_tx(lambda tx: tx.get_task_ids(), "sup_feed") == []
+    assert ds.supervisor.state == "recovering"
+    assert metrics.datastore_consecutive_failures.get() == 0.0
+
+
+def test_one_run_tx_reports_at_most_one_supervisor_failure(eph):
+    """A transient blip absorbed by run_tx's own retry must not march
+    the supervisor toward down: 2 failed attempts inside one call are
+    one observation, and the call's success resets it."""
+    ds = eph.datastore
+    ds.supervisor = DatastoreSupervisor(ds, probe_interval_s=3600, down_threshold=2)
+    ds.failpoint_scope = "blip"
+    failpoints.configure("datastore.connect.blip=error:1.0,count=2")
+    assert ds.run_tx(lambda tx: tx.get_task_ids(), "blip") == []
+    assert ds.supervisor.state == "up"  # never reached down_threshold
+    assert ds.supervisor.status()["transitions"].get("down") is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor state machine
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_state_machine_transitions(eph):
+    sup = DatastoreSupervisor(eph.datastore, probe_interval_s=3600, down_threshold=3)
+    assert sup.state == "up" and sup.readiness() is None
+    sup.record_failure(RuntimeError("x"))
+    assert sup.state == "degraded"
+    assert sup.readiness() is None  # degraded still serves
+    sup.record_failure()
+    sup.record_failure()
+    assert sup.state == "down"
+    assert "datastore down" in sup.readiness()
+    assert metrics.datastore_up.get() == 0.0
+    sup.record_success()
+    assert sup.state == "recovering"
+    sup.record_failure()  # relapse during recovery
+    assert sup.state == "down"
+    sup.record_success()
+    sup.record_success()
+    assert sup.state == "up"
+    assert metrics.datastore_up.get() == 1.0
+    assert sup.status()["transitions"]["down"] == 2
+
+
+def test_supervisor_slow_commit_degrades_with_hold(eph):
+    sup = DatastoreSupervisor(
+        eph.datastore, probe_interval_s=3600, degraded_hold_s=0.2
+    )
+    sup.record_slow_commit(3.0)
+    assert sup.state == "degraded"
+    sup.record_success()
+    assert sup.state == "degraded"  # hold window still open
+    time.sleep(0.25)
+    sup.record_success()
+    assert sup.state == "up"
+
+
+def test_supervisor_probe_cycle_end_to_end(eph):
+    ds = eph.datastore
+    ds.failpoint_scope = "probecycle"
+    sup = ds.start_supervision(
+        probe_interval_s=0.05, down_threshold=2, recover_threshold=2
+    )
+    deadline = time.monotonic() + 5
+    while sup.state != "up" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    failpoints.configure("datastore.connect.probecycle=error:1.0")
+    deadline = time.monotonic() + 10
+    while sup.state != "down" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sup.state == "down"
+    assert sup.reconnect_delay_s() >= sup.probe_interval_s
+    failpoints.clear()
+    deadline = time.monotonic() + 10
+    while sup.state != "up" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sup.state == "up"
+
+
+# ---------------------------------------------------------------------------
+# /healthz vs /readyz
+# ---------------------------------------------------------------------------
+
+
+def _get_status(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_readyz_splits_from_healthz():
+    from janus_tpu.binary_utils import (
+        HealthServer,
+        register_readiness_check,
+        unregister_readiness_check,
+    )
+
+    reason = [None]
+    register_readiness_check("t_ds", lambda: reason[0])
+    srv = HealthServer("127.0.0.1:0").start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _get_status(base + "/readyz")
+        assert status == 200 and json.loads(body)["ready"] is True
+        reason[0] = "datastore down (3 consecutive failures)"
+        status, body = _get_status(base + "/readyz")
+        doc = json.loads(body)
+        assert status == 503 and doc["ready"] is False
+        assert doc["reasons"]["t_ds"].startswith("datastore down")
+        # liveness is NOT readiness: /healthz stays 200 (restarting the
+        # process would not bring the database back)
+        status, _ = _get_status(base + "/healthz")
+        assert status == 200
+    finally:
+        unregister_readiness_check("t_ds")
+        srv.stop()
+
+
+def test_readiness_check_exception_counts_as_not_ready():
+    from janus_tpu.binary_utils import (
+        readiness_snapshot,
+        register_readiness_check,
+        unregister_readiness_check,
+    )
+
+    def boom():
+        raise RuntimeError("kaput")
+
+    register_readiness_check("t_boom", boom)
+    try:
+        ready, reasons = readiness_snapshot()
+        assert not ready and "kaput" in reasons["t_boom"]
+    finally:
+        unregister_readiness_check("t_boom")
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode admission
+# ---------------------------------------------------------------------------
+
+
+def test_admission_sheds_aggregate_routes_while_datastore_not_up():
+    from janus_tpu.ingest.admission import (
+        AdmissionConfig,
+        AdmissionController,
+        ShedError,
+    )
+
+    class FakeSup:
+        state = "down"
+
+        def reconnect_delay_s(self):
+            return 7.0
+
+    sup = FakeSup()
+    ctl = AdmissionController(AdmissionConfig(), supervisor_fn=lambda: sup)
+    with pytest.raises(ShedError) as ei:
+        ctl.admit("aggregate")
+    assert ei.value.status == 503
+    assert ei.value.reason == "datastore_down"
+    assert ei.value.retry_after_s == 7.0
+    # uploads are NOT shed: they flow into the spill journal
+    ctl.admit("upload")
+    sup.state = "up"
+    ctl.admit("aggregate")  # healthy again
+
+
+def test_drivers_park_acquire_while_down(eph):
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+
+    ds = eph.datastore
+    sup = ds.start_supervision(probe_interval_s=3600, down_threshold=1)
+    sup.record_failure()
+    assert sup.state == "down"
+    assert AggregationJobDriver(ds, None).acquirer(60)(4) == []
+    assert CollectionJobDriver(ds, None).acquirer(60)(4) == []
+
+
+def test_driver_acquirer_absorbs_connection_errors_raises_fatal(eph):
+    """The drivers' acquirers absorb CONNECTION-class failures as 'no
+    jobs this pass' (a datastore outage must not kill the driver
+    process) but re-raise fatal errors — a broken schema retried
+    forever behind a healthy /readyz would be a silent stall."""
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+
+    ds = eph.datastore
+    ds.failpoint_scope = "acqtol"
+    ds.retry_max_interval_s = 0.001
+    acquire = AggregationJobDriver(ds, None).acquirer(60)
+    failpoints.configure("datastore.connect.acqtol=error:1.0")
+    assert acquire(4) == []  # outage absorbed: park, don't crash
+    failpoints.clear()
+    assert acquire(4) == []  # recovered: acquires normally (no jobs)
+
+    class FatalDs:
+        supervisor = None
+
+        def classify_error(self, e):
+            return "fatal"
+
+        def run_tx(self, fn, name):
+            raise sqlite3.OperationalError("no such table: aggregation_jobs")
+
+    with pytest.raises(sqlite3.OperationalError):
+        AggregationJobDriver(FatalDs(), None).acquirer(60)(4)
+
+
+def test_job_driver_loop_parks_through_outage(eph):
+    """End to end through the generic loop: an outage makes the
+    acquirer return [] (connection errors absorbed in the driver's
+    acquirer), the loop keeps running on its backoff, and recovery
+    resumes acquiring — the process never dies."""
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig, Stopper
+
+    ds = eph.datastore
+    ds.failpoint_scope = "looppark"
+    ds.retry_max_interval_s = 0.001
+    calls = {"n": 0}
+    stopper = Stopper()
+    inner = AggregationJobDriver(ds, None).acquirer(60)
+
+    def acquirer(limit):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            stopper.stop()
+        return inner(limit)
+
+    failpoints.configure("datastore.connect.looppark=error:1.0")
+    jd = JobDriver(
+        JobDriverConfig(
+            job_discovery_interval_s=0.01, max_job_discovery_interval_s=0.02
+        ),
+        acquirer,
+        lambda acquired: None,
+        stopper,
+    )
+    jd.run()  # must exit via the stopper, not via the outage
+    assert calls["n"] >= 3

@@ -143,6 +143,46 @@ def test_fired_counter_metric():
 # ---------------------------------------------------------------------------
 
 
+def test_datastore_connect_failpoint_wiring():
+    """`datastore.connect` (error/delay/timeout) fires at the _connect
+    seam on EVERY checkout — cached connections included — raising the
+    engine's connection-lost error type, so an outage schedule can take
+    a datastore down without killing a real server. Scoped per store
+    via failpoint_scope (default: the db file's basename), so one store
+    of a multi-store process can go dark alone."""
+    import sqlite3
+
+    from janus_tpu.datastore.store import EphemeralDatastore
+
+    e = EphemeralDatastore()
+    other = EphemeralDatastore()
+    try:
+        ds = e.datastore
+        ds.failpoint_scope = "connwire"
+        # a count-budgeted connect storm is absorbed by run_tx's retry
+        failpoints.configure("datastore.connect.connwire=error:1.0,count=2")
+        assert ds.run_tx(lambda tx: tx.get_task_ids(), "t") == []
+        # a full outage surfaces as the engine's connection error class
+        failpoints.configure("datastore.connect.connwire=error:1.0")
+        with pytest.raises(sqlite3.OperationalError) as ei:
+            ds.run_tx(lambda tx: tx.get_task_ids(), "t")
+        assert ds.classify_error(ei.value) == "connection"
+        # the scope is honored: an unrelated store keeps working
+        assert other.datastore.run_tx(lambda tx: tx.get_task_ids(), "t") == []
+        # disarm = instant recovery (no dead cached connection retried into)
+        failpoints.clear()
+        assert ds.run_tx(lambda tx: tx.get_task_ids(), "t") == []
+        # delay action: connection checkout stalls but succeeds (a slow
+        # dial / saturated pooler), covered by the same seam
+        failpoints.configure("datastore.connect.connwire=delay:0.05")
+        t0 = time.monotonic()
+        assert ds.run_tx(lambda tx: tx.get_task_ids(), "t") == []
+        assert time.monotonic() - t0 >= 0.05
+    finally:
+        e.cleanup()
+        other.cleanup()
+
+
 def test_http_client_transport_error_and_stale_header_clear():
     """helper.request error raises a retryable URLError AND the
     thread-local response headers are cleared at request start, so a

@@ -190,6 +190,27 @@ def test_bench_dry_run_smoke():
     assert chaos["lease_reacquired_within_ttl_ok"] is True
     assert chaos["circuit_cycle_ok"] is True, chaos["circuit_transitions"]
     assert chaos["drain_ok"] is True
+    # datastore-outage survival (ISSUE 7; chaos_run.py --scenario
+    # db_outage): uploads keep acking 201 through a full datastore
+    # outage on the strength of the spill journal's fsync, /readyz
+    # flips 503 -> 200 across recovery while aggregate routes shed 503,
+    # the journal drains to empty on recovery, the final collection
+    # equals every 201-acked report exactly once, and the armed-but-
+    # idle journal performed ZERO fsyncs while the datastore was
+    # healthy (no new hot-path cost)
+    dbout = rec["db_outage_smoke"]
+    assert dbout.get("ok") is True, dbout
+    assert dbout["healthy_fsyncs_ok"] is True  # journal idle = no fsyncs
+    assert dbout["readyz_up_ok"] and dbout["readyz_down_ok"]
+    assert dbout["readyz_recovered_ok"] is True
+    assert dbout["aggregate_shed_status"] == 503
+    assert dbout["driver_parked_ok"] is True  # no lease attempts burned
+    assert dbout["acked_during_outage"] > 0
+    assert dbout["spilled_acked_ok"] is True
+    assert dbout["journal_drained_ok"] is True
+    assert dbout["uploads_all_acked_ok"] is True, dbout["upload_errors"]
+    assert dbout["exactly_once_ok"] is True
+    assert dbout["collected_count"] == dbout["admitted"]
 
 
 def test_collect_cli_end_to_end(capsys):

@@ -1,0 +1,525 @@
+"""Durable upload spill journal (janus_tpu/ingest/journal.py) and the
+ReportWriteBatcher spill path (docs/ROBUSTNESS.md "Datastore outages").
+
+The contract under test: 201 ⇒ durably written — when the datastore is
+unreachable the ack may rest on the journal's fsync, and replay after
+recovery lands every journaled report exactly once (report-id dedup
+makes duplicates replayed-ok). The journal is bounded (full ⇒ 503
+shed), torn tails from a crash mid-append are tolerated, sealed-segment
+corruption is loud, and while the datastore is healthy the armed
+journal performs ZERO fsyncs (the hot path is unchanged).
+"""
+
+import os
+import time
+
+import pytest
+
+from janus_tpu import failpoints, metrics
+from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+from janus_tpu.datastore.models import LeaderStoredReport
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.ingest.admission import ShedError
+from janus_tpu.ingest.journal import JournalFull, JournalReplayer, UploadJournal
+from janus_tpu.messages import HpkeCiphertext, HpkeConfigId, ReportId, TaskId, Time
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+@pytest.fixture
+def eph():
+    e = EphemeralDatastore()
+    yield e
+    e.cleanup()
+
+
+def mkreport(i: int, share: bytes = b"secret-share") -> LeaderStoredReport:
+    return LeaderStoredReport(
+        TaskId(bytes([i % 256]) * 32),
+        ReportId(i.to_bytes(16, "big")),
+        Time(1_600_000_000 + i),
+        b"public" + bytes([i % 256]),
+        share,
+        HpkeCiphertext(HpkeConfigId(7), b"ek", b"ct" * 4),
+    )
+
+
+def db_report_count(ds) -> int:
+    return ds.run_tx(
+        lambda tx: tx._c.execute("SELECT COUNT(*) FROM client_reports").fetchone()[0],
+        "count",
+    )
+
+
+# ---------------------------------------------------------------------------
+# journal core
+# ---------------------------------------------------------------------------
+
+
+def test_append_read_roundtrip_encrypted_at_rest(tmp_path, eph):
+    j = UploadJournal(str(tmp_path / "j"), eph.datastore.crypter)
+    reports = [mkreport(i, share=b"PLAINTEXT-SHARE-%d" % i) for i in range(5)]
+    j.append_batch(reports)
+    assert j.fsyncs == 1  # one fsync per batch, not per report
+    assert j.depth()[0] == 5
+    j.seal_active()
+    (seq,) = j.sealed_segments()
+    rows, reason = j.read_segment(seq)
+    assert reason == "clean"
+    assert [r.report_id.data for r in rows] == [r.report_id.data for r in reports]
+    assert rows[0].leader_input_share == b"PLAINTEXT-SHARE-0"
+    assert rows[0].public_share == reports[0].public_share
+    assert rows[0].helper_encrypted_input_share.to_bytes() == reports[
+        0
+    ].helper_encrypted_input_share.to_bytes()
+    # encrypted at rest: the plaintext share never touches disk
+    raw = open(j._seg_path(seq), "rb").read()
+    assert b"PLAINTEXT-SHARE" not in raw
+
+
+def test_torn_tail_tolerated_on_crash_recovery(tmp_path, eph):
+    """A crash mid-append leaves a truncated tail frame; those rows
+    were never acked (the fsync hadn't returned), so boot recovery
+    keeps the valid prefix and replay may truncate the segment."""
+    d = str(tmp_path / "j")
+    j = UploadJournal(d, eph.datastore.crypter)
+    j.append_batch([mkreport(i) for i in range(3)])
+    j.close()  # crash: segment left unsealed on disk
+    path = j._seg_path(1)
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad")  # header claims 64B, file ends
+    j2 = UploadJournal(d, eph.datastore.crypter)
+    (seq,) = j2.sealed_segments()
+    rows, reason = j2.read_segment(seq)
+    assert reason == "truncated"
+    assert len(rows) == 3  # the acked frames survive, the torn tail is dropped
+    # a torn-crash segment is NOT corruption: it drains + truncates
+    w = ReportWriteBatcher(eph.datastore, journal=j2)
+    r = JournalReplayer(j2, w, interval_s=60)
+    assert r.drain_once() == 3
+    assert j2.quarantined == 0
+    assert j2.depth()[0] == 0
+    w.close()
+
+
+def test_double_crash_torn_segments_both_replayed(tmp_path, eph):
+    """Two crashes in a row (outage outlives a process twice) leave TWO
+    torn segments; both valid prefixes must replay — neither may be
+    mistaken for corruption and quarantined away from auto-replay."""
+    d = str(tmp_path / "j")
+    j = UploadJournal(d, eph.datastore.crypter)
+    j.append_batch([mkreport(i) for i in range(2)])
+    j.close()
+    with open(j._seg_path(1), "ab") as f:
+        f.write(b"\x10\x00\x00\x00")  # crash 1: torn tail
+    j2 = UploadJournal(d, eph.datastore.crypter)
+    j2.append_batch([mkreport(10 + i) for i in range(2)])
+    j2.close()
+    with open(j2._seg_path(2), "ab") as f:
+        f.write(b"\x10\x00\x00\x00")  # crash 2: torn tail again
+    j3 = UploadJournal(d, eph.datastore.crypter)
+    assert j3.depth()[0] == 4 and j3.quarantined == 0
+    w = ReportWriteBatcher(eph.datastore, journal=j3)
+    r = JournalReplayer(j3, w, interval_s=60)
+    assert r.drain_once() == 4
+    assert j3.depth()[0] == 0 and j3.quarantined == 0
+    assert db_report_count(eph.datastore) == 4
+    w.close()
+
+
+def test_mid_segment_crc_damage_prefix_replayed_then_quarantined(tmp_path, eph):
+    """CRC damage inside a sealed segment: the valid prefix still
+    replays, but the file is quarantined (bytes preserved as .corrupt)
+    instead of truncated — frames past the damage may be acked data —
+    and later segments still drain."""
+    ds = eph.datastore
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    j.append_batch([mkreport(i) for i in range(3)])
+    j.seal_active()
+    j.append_batch([mkreport(10 + i) for i in range(2)])
+    j.seal_active()
+    first, second = j.sealed_segments()
+    path = j._seg_path(first)
+    data = bytearray(open(path, "rb").read())
+    # flip a byte inside the SECOND frame's payload: frame 1 is the
+    # replayable prefix, frames 2-3 are behind the damage
+    frame1_len = 8 + (len(data) // 3 - 8)
+    data[frame1_len + 12] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    rows, reason = j.read_segment(first)
+    assert reason == "crc" and len(rows) == 1
+    w = ReportWriteBatcher(ds, journal=j)
+    r = JournalReplayer(j, w, interval_s=60)
+    assert r.drain_once() == 3  # prefix of the damaged + all of the healthy
+    assert j.sealed_segments() == []
+    assert j.quarantined == 1
+    assert os.path.exists(path + ".corrupt")  # preserved for manual recovery
+    assert db_report_count(ds) == 3
+    w.close()
+
+
+def test_corrupt_length_field_quarantines_not_truncates(tmp_path, eph):
+    """A bit-flipped LENGTH field makes the frame overshoot EOF — which
+    looks like a torn tail, except acked frames follow it. The reader
+    must spot the later frame magic and classify damage (quarantine),
+    or truncate_segment would silently destroy the acked tail."""
+    ds = eph.datastore
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    j.append_batch([mkreport(i) for i in range(3)])
+    j.seal_active()
+    (seq,) = j.sealed_segments()
+    path = j._seg_path(seq)
+    data = bytearray(open(path, "rb").read())
+    data[6] |= 0x80  # blow up frame 1's u32 length field (offset 4..8)
+    open(path, "wb").write(bytes(data))
+    rows, reason = j.read_segment(seq)
+    assert reason == "crc" and rows == []  # NOT "truncated"
+    w = ReportWriteBatcher(ds, journal=j)
+    r = JournalReplayer(j, w, interval_s=60)
+    r.drain_once()
+    assert j.quarantined == 1
+    assert os.path.exists(path + ".corrupt")  # acked frames preserved
+    w.close()
+
+
+def test_undecodable_row_quarantines_instead_of_wedging(tmp_path, eph):
+    """A CRC-valid row the crypter can no longer decrypt (rotated-out
+    key) must not wedge the replayer forever: the decodable prefix
+    replays and the segment is quarantined."""
+    from janus_tpu.datastore.store import Crypter
+
+    ds = eph.datastore
+    other = Crypter()  # a different key: decrypt will fail
+    j = UploadJournal(str(tmp_path / "j"), other)
+    j.append_batch([mkreport(1)])
+    j.seal_active()
+    j2 = UploadJournal(str(tmp_path / "j2"), ds.crypter)
+    j2.append_batch([mkreport(2)])
+    j2.seal_active()
+    # read the wrong-key journal through the datastore's crypter
+    j.crypter = ds.crypter
+    rows, reason = j.read_segment(j.sealed_segments()[0])
+    assert reason == "crc" and rows == []
+    w = ReportWriteBatcher(ds, journal=j)
+    r = JournalReplayer(j, w, interval_s=60)
+    r.drain_once()
+    assert j.quarantined == 1 and j.depth()[0] == 0  # not wedged
+    w.close()
+
+
+def test_quarantined_seq_never_reused_across_restart(tmp_path, eph):
+    """After a restart, a fresh segment must never take a quarantined
+    file's sequence number — a later quarantine's rename would
+    overwrite the preserved .corrupt bytes."""
+    d = str(tmp_path / "j")
+    j = UploadJournal(d, eph.datastore.crypter)
+    j.append_batch([mkreport(1)])
+    j.seal_active()
+    (seq,) = j.sealed_segments()
+    j.quarantine_segment(seq)
+    j.close()
+    j2 = UploadJournal(d, eph.datastore.crypter)
+    assert j2._active_seq > seq
+    # and an in-process name collision appends .corrupt.N, never clobbers
+    j2.append_batch([mkreport(2)])
+    j2.seal_active()
+    (seq2,) = j2.sealed_segments()
+    open(j2._seg_path(seq2) + ".corrupt", "wb").write(b"preserved")
+    j2.quarantine_segment(seq2)
+    assert open(j2._seg_path(seq2) + ".corrupt", "rb").read() == b"preserved"
+    assert os.path.exists(j2._seg_path(seq2) + ".corrupt.1")
+
+
+def test_zero_record_torn_segment_is_cleaned_up(tmp_path, eph):
+    """A crash during the very FIRST append of an outage leaves a
+    segment holding only a torn partial frame (0 valid records): the
+    drain must still truncate it, or its bytes pin journal capacity
+    forever."""
+    d = str(tmp_path / "j")
+    os.makedirs(d, exist_ok=True)
+    open(os.path.join(d, "upload-journal-0000000000000001.wal"), "wb").write(
+        b"JUJ1\x40\x00\x00\x00"  # torn first frame, nothing valid
+    )
+    j = UploadJournal(d, eph.datastore.crypter)
+    assert j.depth() == (0, 8, 1)
+    w = ReportWriteBatcher(eph.datastore, journal=j)
+    r = JournalReplayer(j, w, interval_s=60)
+    r.drain_once()
+    assert j.depth() == (0, 0, 0)  # dead segment truncated, capacity freed
+    assert j.quarantined == 0
+    w.close()
+
+
+def test_quarantined_bytes_count_toward_the_bound(tmp_path, eph):
+    """Quarantine preserves bytes, and preserved bytes still occupy the
+    bounded disk: .corrupt files are charged against max_total_bytes
+    (including across restarts) until an operator removes them."""
+    d = str(tmp_path / "j")
+    j = UploadJournal(d, eph.datastore.crypter, max_total_bytes=1 << 20)
+    j.append_batch([mkreport(i) for i in range(4)])
+    j.seal_active()
+    (seq,) = j.sealed_segments()
+    size = os.path.getsize(j._seg_path(seq))
+    j.quarantine_segment(seq)
+    assert j.quarantined_bytes == size
+    # a fresh process still accounts for the quarantined file
+    j2 = UploadJournal(d, eph.datastore.crypter, max_total_bytes=1 << 20)
+    assert j2.quarantined == 1 and j2.quarantined_bytes == size
+
+
+def test_boot_survives_corrupt_segment(tmp_path, eph):
+    """CRC damage in any segment at boot must not crash-loop the
+    aggregator: recovery keeps the valid prefix in the queue (ERROR
+    logged) and the drain quarantines the file after landing it."""
+    d = str(tmp_path / "j")
+    j = UploadJournal(d, eph.datastore.crypter)
+    j.append_batch([mkreport(i) for i in range(3)])
+    j.seal_active()
+    j.append_batch([mkreport(10 + i) for i in range(2)])
+    j.close()
+    first = j.sealed_segments()[0]
+    path = j._seg_path(first)
+    data = bytearray(open(path, "rb").read())
+    data[12] ^= 0xFF  # first frame damaged: prefix is empty
+    open(path, "wb").write(bytes(data))
+    j2 = UploadJournal(d, eph.datastore.crypter)  # must not raise
+    w = ReportWriteBatcher(eph.datastore, journal=j2)
+    r = JournalReplayer(j2, w, interval_s=60)
+    assert r.drain_once() == 2  # the healthy rows land
+    assert j2.quarantined == 1
+    assert os.path.exists(path + ".corrupt")
+    assert db_report_count(eph.datastore) == 2
+    w.close()
+
+
+def test_segment_rotation_and_bound(tmp_path, eph):
+    j = UploadJournal(
+        str(tmp_path / "j"),
+        eph.datastore.crypter,
+        max_segment_bytes=4096,
+        max_total_bytes=8192,
+    )
+    with pytest.raises(JournalFull) as ei:
+        for i in range(200):
+            j.append_batch([mkreport(i)])
+    # JournalFull is a ShedError answering 503 (availability, not rate)
+    assert isinstance(ei.value, ShedError)
+    assert ei.value.status == 503
+    assert ei.value.reason == "journal_full"
+    assert len(j.sealed_segments()) >= 1  # rotation happened on the way
+    assert j.is_full()
+    assert j.readiness() is not None  # /readyz fails while full
+
+
+def test_boot_recovery_scan(tmp_path, eph):
+    d = str(tmp_path / "j")
+    j1 = UploadJournal(d, eph.datastore.crypter)
+    j1.append_batch([mkreport(i) for i in range(4)])
+    j1.close()  # process death with a non-empty journal
+    j2 = UploadJournal(d, eph.datastore.crypter)
+    records, _, segments = j2.depth()
+    assert records == 4 and segments == 1
+    # the recovered segment is already sealed and replayable
+    assert len(j2.sealed_segments()) == 1
+
+
+# ---------------------------------------------------------------------------
+# replayer
+# ---------------------------------------------------------------------------
+
+
+def test_replay_drains_and_truncates_after_commit(tmp_path, eph):
+    ds = eph.datastore
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    j.append_batch([mkreport(i) for i in range(6)])
+    r = JournalReplayer(j, w, interval_s=60)  # no thread: drive by hand
+    assert r.drain_once() == 6
+    assert j.depth() == (0, 0, 0)
+    assert db_report_count(ds) == 6
+    assert r.replayed_fresh == 6 and r.replayed_dupes == 0
+    # segment files are gone
+    assert not [f for f in os.listdir(j.dir) if f.endswith(".wal")]
+    w.close()
+
+
+def test_replay_failure_keeps_segment_for_retry(tmp_path, eph):
+    """Truncate only after the covering commit: a failed replay pass
+    must leave the segment on disk, and the next pass (datastore back)
+    must drain it."""
+    ds = eph.datastore
+    ds.failpoint_scope = "jtest"
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    j.append_batch([mkreport(i) for i in range(3)])
+    failpoints.configure("datastore.connect.jtest=error:1.0")
+    r = JournalReplayer(j, w, interval_s=60)
+    assert r.drain_once() == 0
+    assert j.depth()[0] == 3  # nothing lost, nothing truncated
+    failpoints.clear()
+    assert r.drain_once() == 3
+    assert j.depth()[0] == 0
+    assert db_report_count(ds) == 3
+    w.close()
+
+
+def test_replay_duplicate_is_replayed_ok(tmp_path, eph):
+    """A journaled report that already landed in the datastore (e.g. a
+    retry that was acked twice, once from each path) dedups on replay —
+    exactly-once, counted as outcome=replayed."""
+    ds = eph.datastore
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    dup = mkreport(1)
+    assert w.write_report(dup) is True  # already durable in the DB
+    j.append_batch([dup, mkreport(2)])
+    before = metrics.upload_journal_replayed_total.get(outcome="replayed")
+    r = JournalReplayer(j, w, interval_s=60)
+    assert r.drain_once() == 2
+    assert db_report_count(ds) == 2  # no double row
+    assert r.replayed_dupes == 1 and r.replayed_fresh == 1
+    assert metrics.upload_journal_replayed_total.get(outcome="replayed") == before + 1
+    w.close()
+
+
+def test_replayer_waits_out_datastore_down(tmp_path, eph):
+    class FakeSup:
+        state = "down"
+
+    ds = eph.datastore
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    j.append_batch([mkreport(1)])
+    r = JournalReplayer(j, w, supervisor_fn=lambda: FakeSup(), interval_s=60)
+    assert r.drain_once() == 0  # replaying into a dead DB is pointless
+    assert j.depth()[0] == 1
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# writer spill integration
+# ---------------------------------------------------------------------------
+
+
+def test_healthy_path_has_no_fsyncs_and_no_spill(tmp_path, eph):
+    ds = eph.datastore
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    for i in range(5):
+        assert w.write_report(mkreport(i)) is True
+    assert j.fsyncs == 0  # armed but idle: the hot path is unchanged
+    assert j.depth()[0] == 0
+    assert db_report_count(ds) == 5
+    w.close()
+
+
+def test_spill_on_connection_error_resolves_201(tmp_path, eph):
+    ds = eph.datastore
+    ds.failpoint_scope = "spill"
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    failpoints.configure("datastore.connect.spill=error:1.0")
+    assert w.write_report(mkreport(1)) is True  # ack rests on the journal
+    assert j.depth()[0] == 1 and j.fsyncs == 1
+    assert db_report_count.__name__  # (db unreachable: no count here)
+    failpoints.clear()
+    # recovery drains it into the DB exactly once
+    r = JournalReplayer(j, w, interval_s=60)
+    assert r.drain_once() == 1
+    assert db_report_count(ds) == 1
+    w.close()
+
+
+def test_no_journal_connection_error_still_fails_loudly(eph):
+    """Without a journal the old contract holds: a datastore outage is
+    a loud 500, never a silent 201."""
+    import sqlite3
+
+    ds = eph.datastore
+    ds.failpoint_scope = "nojournal"
+    w = ReportWriteBatcher(ds)
+    failpoints.configure("datastore.connect.nojournal=error:1.0")
+    with pytest.raises(sqlite3.OperationalError):
+        w.write_report(mkreport(1))
+    failpoints.clear()
+    w.close()
+
+
+def test_non_connection_errors_never_spill(tmp_path, eph):
+    """Only connection-class failures spill: the injected flush fault
+    (a RuntimeError) must keep failing loudly even with a journal."""
+    ds = eph.datastore
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    failpoints.configure("report_writer.flush=error:1,count=1")
+    with pytest.raises(RuntimeError):
+        w.write_report(mkreport(1))
+    assert j.depth()[0] == 0
+    assert w.write_report(mkreport(2)) is True  # writer recovered
+    w.close()
+
+
+def test_supervisor_down_bypasses_doomed_tx(tmp_path, eph):
+    """While the supervisor says not-up, flushes go straight to the
+    journal without burning run_tx's retry budget: ack latency through
+    an outage stays ~fsync, not ~seconds."""
+    ds = eph.datastore
+    ds.failpoint_scope = "bypass"
+    sup = ds.start_supervision(probe_interval_s=0.05, down_threshold=2)
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    w = ReportWriteBatcher(ds, journal=j)
+    failpoints.configure("datastore.connect.bypass=error:1.0")
+    deadline = time.monotonic() + 10
+    while sup.state != "down" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sup.state == "down"
+    t0 = time.monotonic()
+    assert w.write_report(mkreport(1)) is True
+    assert time.monotonic() - t0 < 0.5  # no 16-attempt retry walk
+    assert j.depth()[0] == 1
+    failpoints.clear()
+    w.close()
+
+
+def test_journal_full_resolves_shed_error(tmp_path, eph):
+    from janus_tpu.datastore.store import DatastoreSupervisor
+
+    ds = eph.datastore
+    # attach WITHOUT starting the probe thread: its immediate first
+    # probe would race the manual failures below
+    sup = ds.supervisor = DatastoreSupervisor(ds, probe_interval_s=3600)
+    # force not-up so the writer takes the spill path
+    sup.record_failure()
+    sup.record_failure()
+    sup.record_failure()
+    assert sup.state == "down"
+    j = UploadJournal(
+        str(tmp_path / "j"), ds.crypter, max_segment_bytes=4096, max_total_bytes=4096
+    )
+    w = ReportWriteBatcher(ds, journal=j)
+    with pytest.raises(JournalFull) as ei:
+        for i in range(200):
+            w.write_report(mkreport(i))
+    assert ei.value.status == 503 and ei.value.retry_after_s > 0
+    w.close()
+
+
+def test_slow_commit_degrades_and_spills_next_flush(tmp_path, eph):
+    """A commit past spill_latency_s marks the supervisor degraded, so
+    the NEXT flush spills — bounded ack latency through a brownout."""
+    ds = eph.datastore
+    ds.start_supervision(probe_interval_s=3600)
+    j = UploadJournal(str(tmp_path / "j"), ds.crypter)
+    # every commit "exceeds" a microscopic threshold
+    w = ReportWriteBatcher(ds, journal=j, spill_latency_s=1e-9)
+    assert w.write_report(mkreport(1)) is True  # lands in DB, trips the threshold
+    assert db_report_count(ds) == 1
+    assert ds.supervisor.state == "degraded"
+    assert w.write_report(mkreport(2)) is True  # spilled
+    assert j.depth()[0] == 1
+    w.close()
