@@ -30,6 +30,7 @@ from ..core.circuit_breaker import (
     default_breakers,
     peer_label,
 )
+from ..core.deadline import DEADLINE_EXCEEDED_STATUS, DeadlineExceeded, deadline_scope
 from ..core.retries import Backoff, RequestAborted, retry_http_request
 from ..datastore.models import (
     AcquiredAggregationJob,
@@ -67,7 +68,7 @@ from ..vdaf.wire import (
     seeds_to_lanes,
 )
 from .accumulator import Accumulator, accumulate_batched, fixed_size_batch_id
-from .engine_cache import engine_cache
+from .engine_cache import DeviceHangError, engine_cache
 
 log = logging.getLogger(__name__)
 
@@ -160,6 +161,19 @@ class AggregationJobDriver:
         except RequestAborted:
             # shutdown drain: hand the lease back immediately
             self.step_back(acquired, "shutdown_drain", 0.0)
+        except DeadlineExceeded:
+            # the lease budget died (expired lease, retry loop past the
+            # bound, or the helper answered the conclusive 408): dead
+            # work is dropped here and redone under a fresh lease —
+            # never amplified by burning the attempt ledger
+            self.step_back(acquired, "deadline_expired", 0.0)
+        except DeviceHangError:
+            # the device dispatch hung and was abandoned; the engine is
+            # quarantined (host fallback serves the retry) — not this
+            # job's fault, step back with a short reacquire delay
+            self.step_back(
+                acquired, "device_hang", self.cfg.min_step_back_delay_s
+            )
         except Exception as e:
             from .job_driver import datastore_reconnect_delay_s, is_datastore_connection_error
 
@@ -294,8 +308,13 @@ class AggregationJobDriver:
         # span below (stage/encode/http/engine/write — and the helper's
         # handler spans, via the propagated traceparent header) joins
         # that trace, no matter which driver process steps the job or
-        # how many restarts separate the steps
-        with use_traceparent(job.trace_context):
+        # how many restarts separate the steps. The lease budget rides
+        # the same scope (core/deadline.py): the engine watchdog bounds
+        # device dispatches with it and the HTTP client stamps the
+        # remainder on outbound helper requests (DAP-Janus-Deadline).
+        with use_traceparent(job.trace_context), deadline_scope(
+            self._lease_deadline(acquired)
+        ):
             self._step_leased_job(acquired, task, job, ras, reports)
 
     def _step_leased_job(self, acquired, task, job, ras, reports) -> None:
@@ -795,6 +814,13 @@ class AggregationJobDriver:
             deadline=deadline,
             should_abort=(lambda: self.stopper.stopped) if self.stopper is not None else None,
         )
+        if status == DEADLINE_EXCEEDED_STATUS:
+            # the helper's conclusive "your budget is dead" answer
+            # (docs/ROBUSTNESS.md deadline contract): step back, don't
+            # fail the job and don't retry against the same dead budget
+            raise DeadlineExceeded(
+                "helper reported deadline exceeded", last_status=status
+            )
         if status not in (200, 201):
             raise RuntimeError(
                 f"helper {method} aggregation job failed: HTTP {status}: {body[:300]!r}"

@@ -20,6 +20,7 @@ from ..core.circuit_breaker import (
     default_breakers,
     peer_label,
 )
+from ..core.deadline import DEADLINE_EXCEEDED_STATUS, DeadlineExceeded, deadline_scope
 from ..core.retries import Backoff, RequestAborted, retry_http_request
 from ..datastore.models import (
     AcquiredCollectionJob,
@@ -106,6 +107,10 @@ class CollectionJobDriver:
             )
         except RequestAborted:
             self.step_back(acquired, "shutdown_drain", 0.0)
+        except DeadlineExceeded:
+            # lease budget dead (expired lease / retry bound / helper's
+            # conclusive 408): step back, refund the attempt
+            self.step_back(acquired, "deadline_expired", 0.0)
         except Exception as e:
             from .job_driver import datastore_reconnect_delay_s, is_datastore_connection_error
 
@@ -168,8 +173,12 @@ class CollectionJobDriver:
         # adopt the trace the collection-create handler persisted: the
         # driver's spans (and the helper's aggregate_share handler, via
         # the propagated traceparent) join the collector's trace across
-        # processes and driver restarts
-        with use_traceparent(job.trace_context):
+        # processes and driver restarts. The lease budget rides the
+        # same scope: device work (Poplar1 IDPF walks) is watchdog-
+        # bounded and outbound requests carry DAP-Janus-Deadline.
+        with use_traceparent(job.trace_context), deadline_scope(
+            self._lease_deadline(acquired)
+        ):
             self._step_leased_job(acquired, task, job)
 
     def _step_leased_job(self, acquired: AcquiredCollectionJob, task: Task, job) -> None:
@@ -442,6 +451,10 @@ class CollectionJobDriver:
             deadline=deadline,
             should_abort=(lambda: self.stopper.stopped) if self.stopper is not None else None,
         )
+        if status == DEADLINE_EXCEEDED_STATUS:
+            raise DeadlineExceeded(
+                "helper reported deadline exceeded", last_status=status
+            )
         if status != 200:
             raise RuntimeError(f"helper aggregate share failed: HTTP {status}: {body[:300]!r}")
         return AggregateShare.from_bytes(body)

@@ -14,6 +14,7 @@ import secrets
 from dataclasses import dataclass, field
 
 from .. import failpoints, metrics
+from ..core import deadline as deadline_mod
 from ..core.hpke import HpkeApplicationInfo, HpkeError, Label, hpke_open, hpke_seal
 from ..core.time_util import Clock, RealClock
 from ..datastore.models import (
@@ -331,6 +332,10 @@ class TaskAggregator:
         leader_prep_rows: list[bytes | None] = [None] * n
         with span("helper.hpke_stage", batch=n):
             for i, pi in enumerate(inits):
+                # propagated-deadline check per report: the decrypt loop
+                # is the helper's dominant host cost, and a leader whose
+                # lease died mid-batch is not waiting for the rest
+                deadline_mod.check("helper_decrypt")
                 rs = pi.report_share
                 md = rs.metadata
                 if task.task_expiration and md.time > task.task_expiration:
@@ -374,6 +379,7 @@ class TaskAggregator:
         # replay check against prior aggregations (reference replay
         # semantics) — one set-valued query for the whole batch, not a
         # per-report query loop
+        deadline_mod.check("helper_replay_tx")
         fresh_ids = [rid for i, rid in enumerate(ids) if prep_err[i] is None]
         with span("helper.replay_tx", batch=len(fresh_ids)):
             replayed_ids = ds.run_tx(
@@ -510,6 +516,11 @@ class TaskAggregator:
                 tx.put_report_aggregation(ra)
             return unmerged
 
+        # last pre-commit deadline check: a budget that died during the
+        # engine step means nobody is waiting for this response — drop
+        # the work (the leader's fresh-lease retry replays the init
+        # idempotently) rather than commit + answer into the void
+        deadline_mod.check("helper_write_tx")
         with span("helper.write_tx", batch=n):
             unmerged = ds.run_tx(write, "aggregate_init")
         # e2e SLO only after the commit (a retried request must not
@@ -738,6 +749,7 @@ class TaskAggregator:
         import dataclasses
 
         task = self.task
+        deadline_mod.check("helper_continue")
         if task.vdaf.rounds == 1:
             # all production Prio3 VDAFs are 1-round; a continue request
             # is always a step mismatch for them (reference parity gate)
@@ -1137,6 +1149,7 @@ class TaskAggregator:
     # ------------------------------------------------------------------
     def handle_aggregate_share(self, ds: Datastore, req: AggregateShareReq) -> AggregateShare:
         task = self.task
+        deadline_mod.check("helper_aggregate_share")
         failpoints.hit("helper.aggregate_share")
         if req.batch_selector.query_type != task.query_type.code:
             raise errors.InvalidMessage("query type mismatch", task.task_id)

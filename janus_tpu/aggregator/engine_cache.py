@@ -33,9 +33,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import failpoints
+from ..core.deadline import current_deadline
 from ..vdaf.engine import STREAM_MIN_INPUT_LEN, stream_plan
 from ..vdaf.feasibility import device_memory_budget, feasible_bucket
 from ..vdaf.registry import VdafInstance, prio3_batched
+from . import device_watchdog
+from .device_watchdog import DeviceHangError  # noqa: F401 - re-export: the
+# job drivers catch it at the step boundary (step_back, not job failure)
 
 log = logging.getLogger(__name__)
 
@@ -351,10 +355,13 @@ def _split_rows(value, offsets):
 
 
 def _engine_dispatch_failpoint() -> None:
-    """`engine.dispatch` failpoint at the top of every device dispatch:
-    the oom action raises a RESOURCE_EXHAUSTED-shaped error so the
-    injected fault rides the REAL recovery path (_handle_engine_error's
-    halved-bucket retry / host fallback), exactly like a device OOM."""
+    """`engine.dispatch` failpoint INSIDE every watchdog-supervised
+    device region: the oom action raises a RESOURCE_EXHAUSTED-shaped
+    error so the injected fault rides the REAL recovery path
+    (_handle_engine_error's halved-bucket retry / host fallback),
+    exactly like a device OOM; the hang action parks the supervised
+    worker exactly like a wedged XLA dispatch, so the watchdog's
+    abandon/quarantine path is what recovers it."""
     failpoints.hit(
         "engine.dispatch",
         error_factory=lambda: RuntimeError(
@@ -469,6 +476,21 @@ class EngineCache:
         self._initial_round_rows = round_rows
         self._co_leader = _Coalescer(self._run_leader_round, round_rows)
         self._co_helper = _Coalescer(self._run_helper_round, round_rows)
+        # device-circuit quarantine (ISSUE 8; docs/ROBUSTNESS.md "Device
+        # hangs & deadlines"): a watchdog-abandoned dispatch opens the
+        # circuit — serving moves to the host engine immediately (the
+        # interim work must land), and a background canary thread
+        # recompiles + probe-dispatches until the device answers again,
+        # then restores the device path with the initial caps. Unlike
+        # the OOM timed_fallback this is EVENT-driven (proof the device
+        # responds), not timer-driven hope.
+        self._quarantined = False
+        # set by stop_canary() (process teardown): wakes the canary's
+        # cool-down wait so the loop exits instead of launching a probe
+        # whose native device work would race interpreter finalization
+        self._canary_wakeup = threading.Event()
+        self._canary_stop = False
+        self._canary_thread: threading.Thread | None = None
         # observability (docs/OBSERVABILITY.md "Engine metrics"): first
         # dispatch per (op, bucket) is the compile; OOM events feed the
         # /statusz engine-cache section
@@ -477,7 +499,13 @@ class EngineCache:
         self.oom_history: deque = deque(maxlen=16)
         self._publish_state()
 
+    # every state the janus_engine_backend gauge manages; exactly one
+    # is 1 per VDAF kind at any time (docs/OBSERVABILITY.md)
+    BACKEND_STATES = ("device", "host_fallback", "timed_fallback", "quarantined", "host")
+
     def _backend_state(self) -> str:
+        if self._quarantined:
+            return "quarantined"
         if self._host_fallback is None:
             return "device"
         return "host_fallback" if self._host_fallback_until is None else "timed_fallback"
@@ -486,7 +514,7 @@ class EngineCache:
         """Refresh the janus_engine_backend / janus_engine_bucket_cap
         gauges for this engine's VDAF kind (callers hold _oom_lock when
         mutating fallback state; the gauges take their own locks).
-        All four states are managed — including "host", which only
+        All states are managed — including "host", which only
         _build_engine sets to 1 — so exactly one state is 1 per kind
         and a draft-mode host engine followed by a fast-mode device
         engine of the same kind can't leave both at 1. Same-kind
@@ -495,7 +523,7 @@ class EngineCache:
         from ..metrics import engine_backend_state, engine_bucket_cap
 
         state = self._backend_state()
-        for s in ("device", "host_fallback", "timed_fallback", "host"):
+        for s in self.BACKEND_STATES:
             engine_backend_state.set(1.0 if s == state else 0.0, vdaf=self.inst.kind, state=s)
         engine_bucket_cap.set(float(self.bucket_cap or 0), vdaf=self.inst.kind)
 
@@ -667,7 +695,27 @@ class EngineCache:
         fallback expires after HOST_FALLBACK_RETRY_SECS, restoring the
         initial feasibility caps so a recovered tunnel serves at full
         device speed again (a still-broken one just re-walks the
-        halving ladder once per cool-down)."""
+        halving ladder once per cool-down).
+
+        Two further sources outrank the timer: process-wide HOST-ONLY
+        mode (the watchdog's abandoned-thread cap tripped — the device
+        has eaten too many threads to trust again this process) and the
+        per-engine hang QUARANTINE, whose exit is the canary probe, not
+        a clock."""
+        if device_watchdog.WATCHDOG.host_only():
+            host = self._host_fallback
+            if host is None:
+                with self._oom_lock:
+                    if self._host_fallback is None:
+                        self._host_fallback = HostEngineCache(self.inst, self.verify_key)
+                        self._host_fallback_until = None
+                        self._publish_state()
+                    host = self._host_fallback
+            return host
+        if self._quarantined:
+            # canary-driven: serve host until the probe proves the
+            # device answers again (_canary_loop clears the state)
+            return self._host_fallback
         host = self._host_fallback
         if host is None:
             return None
@@ -687,6 +735,167 @@ class EngineCache:
                 self._co_helper._max_rows = self._initial_round_rows
                 self._publish_state()
             return self._host_fallback
+
+    # --- hang quarantine + canary rebuild (ISSUE 8) ---
+    # Env defaults let harnesses (chaos_run device_hang) shrink the
+    # cycle; janus_main applies the YAML `device_watchdog:` values to
+    # these class attributes at boot.
+    QUARANTINE_CANARY_DELAY_SECS = float(os.environ.get("JANUS_CANARY_DELAY_S", "5.0"))
+    QUARANTINE_CANARY_TIMEOUT_SECS = float(os.environ.get("JANUS_CANARY_TIMEOUT_S", "30.0"))
+    QUARANTINE_CANARY_MAX_DELAY_SECS = 60.0
+
+    def _supervised(self, label: str, fn):
+        """Route a device-touching closure through the process dispatch
+        watchdog under the AMBIENT deadline (job drivers: lease bound;
+        helper handlers: propagated request budget — core/deadline.py).
+        No ambient deadline = direct call: one contextvar read, the
+        bench --dry-run `watchdog_overhead` record keeps it honest."""
+        return device_watchdog.WATCHDOG.run(
+            fn,
+            deadline=current_deadline(),
+            label=label,
+            vdaf=self.inst.kind,
+            on_hang=self._quarantine_on_hang,
+        )
+
+    def _quarantine_on_hang(self, label: str) -> None:
+        """Watchdog hang hook: open the device circuit. Serving moves
+        to the host engine NOW (the step that hung steps back; its
+        retry and every other job must land through host fallback), and
+        the canary thread owns the way back."""
+        from .. import metrics
+
+        with self._oom_lock:
+            if self._quarantined:
+                return
+            # order matters for the lock-free readers in _host(): the
+            # fallback must exist BEFORE the flag flips, or a racing
+            # caller sees quarantined-with-no-host and dispatches to
+            # the known-wedged device
+            if self._host_fallback is None:
+                self._host_fallback = HostEngineCache(self.inst, self.verify_key)
+            self._host_fallback_until = None
+            self._quarantined = True
+            self.oom_history.append(
+                {
+                    "at": time.time(),
+                    "bucket": None,
+                    "action": "quarantined",
+                    "error": f"hung dispatch {label}",
+                }
+            )
+            self._publish_state()
+            start_canary = not device_watchdog.WATCHDOG.host_only()
+        metrics.engine_quarantines_total.add(vdaf=self.inst.kind, event="open")
+        log.error(
+            "engine %s QUARANTINED after hung %s dispatch; serving from the host "
+            "engine while the canary probes the device",
+            self.inst.kind,
+            label,
+        )
+        if start_canary:
+            t = threading.Thread(
+                target=self._canary_loop,
+                name=f"engine-canary-{self.inst.kind}",
+                daemon=True,
+            )
+            self._canary_thread = t
+            t.start()
+
+    def _canary_loop(self) -> None:
+        """Background canary: after a cool-down, recompile + probe the
+        device; on success restore the device path with the initial
+        caps, on failure back off and try again (a still-wedged device
+        keeps quarantine open; repeated hung probes walk the abandoned
+        cap toward host-only mode, which ends the loop)."""
+        from .. import metrics
+
+        delay = self.QUARANTINE_CANARY_DELAY_SECS
+        while True:
+            self._canary_wakeup.wait(delay)
+            self._canary_wakeup.clear()
+            if (
+                self._canary_stop
+                or not self._quarantined
+                or device_watchdog.WATCHDOG.host_only()
+            ):
+                return
+            metrics.engine_quarantines_total.add(vdaf=self.inst.kind, event="canary_probe")
+            try:
+                self._canary_probe()
+            except BaseException as e:  # noqa: BLE001 - incl. DeviceHangError
+                metrics.engine_quarantines_total.add(
+                    vdaf=self.inst.kind, event="canary_failed"
+                )
+                log.warning(
+                    "canary probe for %s failed (%s: %s); next probe in %.1fs",
+                    self.inst.kind, type(e).__name__, e, delay,
+                )
+                delay = min(delay * 2, self.QUARANTINE_CANARY_MAX_DELAY_SECS)
+                continue
+            with self._oom_lock:
+                self._quarantined = False
+                self._host_fallback = None
+                self._host_fallback_until = None
+                self.bucket_cap = self._initial_bucket_cap
+                self._co_leader._max_rows = self._initial_round_rows
+                self._co_helper._max_rows = self._initial_round_rows
+                self.oom_history.append(
+                    {"at": time.time(), "bucket": None, "action": "restored", "error": ""}
+                )
+                self._publish_state()
+            metrics.engine_quarantines_total.add(vdaf=self.inst.kind, event="restored")
+            log.warning(
+                "engine %s restored to the device path (canary probe succeeded)",
+                self.inst.kind,
+            )
+            return
+
+    def stop_canary(self, timeout_s: float = 2.0) -> None:
+        """Process-teardown hook (shutdown_engines): stop the canary
+        loop and give an in-flight probe a bounded window to finish —
+        a daemon worker mid-probe re-entering native device code while
+        the interpreter finalizes crashes the runtime (the same hazard
+        as woken hang workers; ROBUSTNESS.md)."""
+        self._canary_stop = True
+        self._canary_wakeup.set()
+        t = self._canary_thread
+        if t is not None and t.is_alive():
+            t.join(timeout_s)
+
+    def _canary_probe(self) -> None:
+        """Recompile + probe dispatch: drop the cached executables (the
+        hung program may be wedged inside the runtime) and run a small
+        REAL masked aggregate — device put, fresh trace+compile,
+        dispatch, fetch — under the watchdog with its own bounded
+        deadline. Success means the device answers end to end. The
+        `engine.canary` failpoint lets tests hold the quarantine open."""
+        p3 = self.p3
+        self._jits = {}  # atomic swap; abandoned threads keep old refs
+        b = max(MIN_BUCKET, self.dp)
+        value = tuple(
+            np.zeros((b, p3.circ.output_len), dtype=np.uint64)
+            for _ in range(p3.jf.LIMBS)
+        )
+        mask = np.zeros(b, dtype=bool)
+
+        def step(v, m):
+            return p3.aggregate(v, m)
+
+        fn = self._jit("aggregate", step)
+        deadline = time.monotonic() + self.QUARANTINE_CANARY_TIMEOUT_SECS
+
+        def probe():
+            failpoints.hit("engine.canary")
+            staged = put_args((value, mask), block=True)
+            agg = fn(*staged)
+            return [int(x) for x in p3.jf.to_ints(agg)]
+
+        result = device_watchdog.WATCHDOG.run(
+            probe, deadline=deadline, label="canary", vdaf=self.inst.kind
+        )
+        if any(result):
+            raise RuntimeError(f"canary probe returned garbage: {result[:4]}")
 
     # --- helper side: init + combine + decide in one traced step ---
     def helper_init(self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
@@ -774,7 +983,6 @@ class EngineCache:
         self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask,
         coalesced: int = 0,
     ):
-        _engine_dispatch_failpoint()
         p3 = self.p3
         n = nonce_lanes.shape[0]
         cap = self.bucket_cap  # read once — concurrent OOM recovery may
@@ -813,11 +1021,17 @@ class EngineCache:
             )
         fn = self._jit("helper_init", step, in_shardings=shardings)
         args = pad_args(b, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask)
+
         # the np.asarray conversions block on device execution — they
         # must sit inside the span or it measures only async dispatch.
         # out1 stays ON DEVICE (DeviceRows): the aggregate step reads it
-        # there; only the small mask/prep_msg come back.
-        try:
+        # there; only the small mask/prep_msg come back. The whole
+        # device-touching region (put/dispatch/fetch — every point a
+        # wedged device can park the thread, failpoint included so the
+        # hang action models exactly that) runs under the dispatch
+        # watchdog (_supervised).
+        def device_call():
+            _engine_dispatch_failpoint()
             with span(
                 "engine.helper_init",
                 vdaf=self.inst.kind,
@@ -826,14 +1040,18 @@ class EngineCache:
                 coalesced=coalesced,
             ):
                 with span("engine.helper_init.put", vdaf=self.inst.kind):
-                    args = put_args(args, block=True, shardings=shardings)
+                    staged = put_args(args, block=True, shardings=shardings)
                 t_disp = time.monotonic()
                 with span("engine.helper_init.dispatch", vdaf=self.inst.kind):
-                    out1, mask, prep_msg = fn(*args)
+                    out1, mask, prep_msg = fn(*staged)
                 self._record_dispatch("helper_init", n, b, time.monotonic() - t_disp)
                 with span("engine.helper_init.fetch", vdaf=self.inst.kind):
                     mask = np.asarray(mask)[:n]
                     prep_msg = np.asarray(prep_msg)[:n]
+            return out1, mask, prep_msg
+
+        try:
+            out1, mask, prep_msg = self._supervised("helper_init", device_call)
         except Exception as e:
             _annotate_dispatch_bucket(e, b)
             raise
@@ -910,7 +1128,6 @@ class EngineCache:
         coalesced: int = 0,
         allow_pipeline: bool = True,
     ):
-        _engine_dispatch_failpoint()
         p3 = self.p3
         n = nonce_lanes.shape[0]
         cap = self.bucket_cap
@@ -947,10 +1164,13 @@ class EngineCache:
             )
         fn = self._jit("leader_init", step, in_shardings=shardings)
         args = pad_args(b, nonce_lanes, public_parts, meas, proof, blind0)
+
         # conversions block on device execution — keep inside the span.
         # out0 stays ON DEVICE (DeviceRows) for the later aggregate;
         # seed0/ver0/part0 are needed host-side for the wire round trip.
-        try:
+        # Whole device region watchdog-supervised (see _helper_init_inner).
+        def device_call():
+            _engine_dispatch_failpoint()
             with span(
                 "engine.leader_init",
                 vdaf=self.inst.kind,
@@ -959,10 +1179,10 @@ class EngineCache:
                 coalesced=coalesced,
             ):
                 with span("engine.leader_init.put", vdaf=self.inst.kind):
-                    args = put_args(args, block=True, shardings=shardings)
+                    staged = put_args(args, block=True, shardings=shardings)
                 t_disp = time.monotonic()
                 with span("engine.leader_init.dispatch", vdaf=self.inst.kind):
-                    out0, seed0, ver0, part0 = fn(*args)
+                    out0, seed0, ver0, part0 = fn(*staged)
                 self._record_dispatch("leader_init", n, b, time.monotonic() - t_disp)
                 with span("engine.leader_init.fetch_seed", vdaf=self.inst.kind):
                     seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
@@ -970,6 +1190,10 @@ class EngineCache:
                     ver0 = tuple(np.asarray(x)[:n] for x in ver0)
                 with span("engine.leader_init.fetch_part", vdaf=self.inst.kind):
                     part0 = np.asarray(part0)[:n] if part0 is not None else None
+            return out0, seed0, ver0, part0
+
+        try:
+            out0, seed0, ver0, part0 = self._supervised("leader_init", device_call)
         except Exception as e:
             _annotate_dispatch_bucket(e, b)
             raise
@@ -1025,7 +1249,11 @@ class EngineCache:
         fn = self._jit("leader_init", step)
 
         spans_ = [(s, min(s + C, n)) for s in range(0, n, C)]
-        try:
+
+        # one supervised region for the whole pipeline: every chunk's
+        # block_until_ready/dispatch/fetch can park on a wedged device
+        def device_call():
+            _engine_dispatch_failpoint()
             with span("engine.leader_init", vdaf=self.inst.kind, batch=n, pipelined=len(spans_)):
                 staged = []
                 with span("engine.leader_init.put_all_async", vdaf=self.inst.kind):
@@ -1074,10 +1302,13 @@ class EngineCache:
                         if outs[0][3] is not None
                         else None
                     )
+            return DeviceRowsChunks(out_chunks), seed0, ver0, part0
+
+        try:
+            return self._supervised("leader_init", device_call)
         except Exception as exc:
             _annotate_dispatch_bucket(exc, bucket_size(min(n, C)))
             raise
-        return DeviceRowsChunks(out_chunks), seed0, ver0, part0
 
     # --- masked aggregate over the batch axis ---
     def aggregate(self, out_shares, mask):
@@ -1089,7 +1320,12 @@ class EngineCache:
             host = self._host()
             if host is not None:
                 if isinstance(out_shares, (DeviceRows, DeviceRowsChunks)):
-                    return host.aggregate(out_shares.to_numpy(), np.asarray(mask))
+                    # fetching a buffer resident on a possibly-wedged
+                    # device is itself a device wait: supervise it, so
+                    # a hung fetch steps the job back instead of
+                    # parking the host path unbounded
+                    rows = self._supervised("fetch_resident", out_shares.to_numpy)
+                    return host.aggregate(rows, np.asarray(mask))
                 return host.aggregate(out_shares, mask)
             try:
                 return self._aggregate_inner(out_shares, mask)
@@ -1115,7 +1351,6 @@ class EngineCache:
                 self._handle_engine_error(e, n)
 
     def _aggregate_inner(self, out_shares, mask):
-        _engine_dispatch_failpoint()
         p3 = self.p3
 
         if isinstance(out_shares, DeviceRowsChunks):
@@ -1190,12 +1425,14 @@ class EngineCache:
             dispatch = lambda: fn(*pad_args(b, out_shares, mask))  # noqa: E731
         from ..trace import span
 
-        try:
-            # PJRT raises allocation failures synchronously from the
-            # dispatch; other device errors realize async at the fetch.
-            # Both need the bucket annotation, so both live in this try.
-            # to_ints forces the fetch, so the span bounds true device
-            # wall time, not async dispatch.
+        # PJRT raises allocation failures synchronously from the
+        # dispatch; other device errors realize async at the fetch.
+        # Both need the bucket annotation, so both live in this try.
+        # to_ints forces the fetch, so the span bounds true device
+        # wall time, not async dispatch. Watchdog-supervised: the fetch
+        # is exactly where a wedged device parks the thread.
+        def device_call():
+            _engine_dispatch_failpoint()
             t_disp = time.monotonic()
             with span(
                 "engine.aggregate.dispatch",
@@ -1207,6 +1444,9 @@ class EngineCache:
                 result = [int(x) for x in p3.jf.to_ints(agg)]
             self._record_dispatch("aggregate", n, dispatch_b, time.monotonic() - t_disp)
             return result
+
+        try:
+            return self._supervised("aggregate", device_call)
         except Exception as e:
             _annotate_dispatch_bucket(e, dispatch_b, fixed=dispatch_fixed)
             raise
@@ -1361,7 +1601,7 @@ def _build_engine(inst: VdafInstance, verify_key: bytes):
         except ValueError:
             from ..metrics import engine_backend_state
 
-            for s in ("device", "host_fallback", "timed_fallback", "host"):
+            for s in EngineCache.BACKEND_STATES:
                 engine_backend_state.set(
                     1.0 if s == "host" else 0.0, vdaf=inst.kind, state=s
                 )
@@ -1412,6 +1652,21 @@ def _engine_cache_clear() -> None:
     metrics.engine_cache_entries.set(0.0)
 
 
+def shutdown_engines(timeout_s: float = 2.0) -> None:
+    """Process-teardown: stop every live engine's canary loop (bounded)
+    so no probe's native device work races interpreter finalization.
+    Called from janus_main's finally, before the watchdog drain."""
+    with _engine_cache_lock:
+        engines = list(_engine_cache.values())
+    for eng in engines:
+        stop = getattr(eng, "stop_canary", None)
+        if stop is not None:
+            try:
+                stop(timeout_s)
+            except Exception:
+                log.exception("stopping canary for %s failed", eng.inst.kind)
+
+
 # lru_cache-compatible surface (tests/conftest.py calls cache_clear
 # between modules to drop compiled callables)
 engine_cache.cache_clear = _engine_cache_clear
@@ -1437,6 +1692,7 @@ def engine_cache_status() -> dict:
             "vdaf": eng.inst.kind,
             "xof_mode": eng.inst.xof_mode,
             "backend": eng._backend_state(),
+            "quarantined": eng._quarantined,
             "bucket_cap": eng.bucket_cap,
             "initial_bucket_cap": eng._initial_bucket_cap,
             "dp": eng.dp,

@@ -25,6 +25,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler
 
+from ..core.deadline import DEADLINE_EXCEEDED_STATUS, DeadlineExceeded
 from ..ingest import AdmissionConfig, AdmissionController, IngestPipeline, ShedError
 from ..messages import (
     AggregateShareReq,
@@ -295,9 +296,28 @@ class DapHttpApp:
                     if route_class is not None:
                         # shed BEFORE any decode/crypto/datastore work:
                         # the whole point of admission control is that a
-                        # refused request costs ~nothing
+                        # refused request costs ~nothing. The leader's
+                        # propagated budget (DAP-Janus-Deadline,
+                        # backdated by the request's accept-queue wait)
+                        # is an admission signal too: already-dead work
+                        # sheds 503 here instead of burning HPKE.
+                        from ..core import deadline as deadline_mod
+
+                        dl = deadline_mod.parse_header(
+                            headers,
+                            queue_age_s=deadline_mod.request_queue_age(),
+                        )
                         _, admission = self._ensure_ingest()
-                        admission.admit(route_class)
+                        admission.admit(route_class, deadline=dl)
+                        # thread the budget through the handler: the
+                        # decrypt loop / pre-tx checks raise
+                        # DeadlineExceeded (mapped to the conclusive
+                        # 408 below) and the engine watchdog bounds the
+                        # device dispatch with it
+                        with deadline_mod.deadline_scope(dl):
+                            return getattr(self, "h_" + name)(
+                                match, query, headers, body
+                            )
                     return getattr(self, "h_" + name)(match, query, headers, body)
             return 404, "text/plain", b"not found"
         except ShedError as e:
@@ -317,6 +337,22 @@ class DapHttpApp:
                 "application/problem+json",
                 json.dumps(doc).encode(),
                 {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))},
+            )
+        except DeadlineExceeded as e:
+            # the caller's budget died mid-handler (decrypt loop,
+            # watchdog-bounded engine, pre-commit check): answer the
+            # CONCLUSIVE deadline status — not a retryable 5xx — so the
+            # leader steps back instead of re-sending dead work
+            # (docs/ROBUSTNESS.md "Device hangs & deadlines")
+            doc = {
+                "type": "about:blank",
+                "status": DEADLINE_EXCEEDED_STATUS,
+                "detail": f"request deadline exceeded: {e}",
+            }
+            return (
+                DEADLINE_EXCEEDED_STATUS,
+                "application/problem+json",
+                json.dumps(doc).encode(),
             )
         except AggregatorError as e:
             doc = e.problem_document()
@@ -492,10 +528,21 @@ class DapServer:
             def _dispatch(self, method):
                 from urllib.parse import parse_qsl, urlsplit
 
+                from ..core import deadline as deadline_mod
+
                 parts = urlsplit(self.path)
                 if parts.path == "/healthz":
                     self._reply(200, "text/plain", b"ok")
                     return
+                # charge the accept-queue wait against this request's
+                # propagated deadline (stamped at accept by
+                # BoundedThreadingHTTPServer.queue_age_s; consumed on
+                # read, so later keep-alive requests — parsed the
+                # instant they arrive, their wait is the CLIENT's idle
+                # time — read age 0)
+                age_fn = getattr(self.server, "queue_age_s", None)
+                age = age_fn(self.request) if age_fn is not None else None
+                deadline_mod.set_request_queue_age(age or 0.0)
                 query = dict(parse_qsl(parts.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""

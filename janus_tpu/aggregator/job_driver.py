@@ -28,24 +28,49 @@ class JobDriverConfig:
 
 
 def lease_deadline(clock, lease, skew_s: int) -> float:
-    """time.monotonic() bound for one job step's network work: lease
-    remaining minus clock skew (reference job_driver.rs:191-196) — a
-    stuck helper must not outlive the lease and run the job
-    concurrently with its re-acquirer.
+    """time.monotonic() bound for one job step's work (device dispatch,
+    helper HTTP, writes): lease remaining minus clock skew (reference
+    job_driver.rs:191-196) — a stuck helper or a hung device must not
+    outlive the lease and run the job concurrently with its
+    re-acquirer.
 
     The skew must not swallow short (test/interop) leases: when the
     lease is shorter than twice the skew, keep half the remaining
-    lease instead."""
+    lease instead.
+
+    An ALREADY-EXPIRED lease raises DeadlineExceeded instead of
+    granting a floor budget (the old max(1.0, …) handed a dead lease a
+    full second of doomed network time): the steppers translate it
+    into an immediate step-back
+    (janus_job_step_back_total{reason="deadline_expired"})."""
     remaining = lease.expiry.seconds - clock.now().seconds
+    if remaining <= 0:
+        from ..core.deadline import DeadlineExceeded
+
+        raise DeadlineExceeded(
+            f"lease already expired {-remaining}s ago; stepping back, not dialing"
+        )
     bound = remaining - skew_s if remaining > 2 * skew_s else remaining / 2
-    return time.monotonic() + max(1.0, bound)
+    # the 1 s floor keeps short test/interop leases workable, but must
+    # never extend PAST the lease: a near-expired lease's budget is
+    # capped at exactly its remaining seconds, so the step can't run
+    # concurrently with a re-acquirer
+    return time.monotonic() + max(min(1.0, remaining), bound)
 
 
 def deadline_request_timeout(deadline: float | None) -> float | None:
-    """Per-attempt socket timeout capped to the remaining deadline."""
+    """Per-attempt socket timeout capped to the remaining deadline.
+    A deadline already in the past raises DeadlineExceeded — firing a
+    doomed 0.1 s network attempt on a dead budget (the old floor) only
+    burned helper admission and masked the step-back signal."""
     if deadline is None:
         return None
-    return max(0.1, deadline - time.monotonic())
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+        from ..core.deadline import DeadlineExceeded
+
+        raise DeadlineExceeded("request budget exhausted before the attempt")
+    return remaining
 
 
 def datastore_down(ds) -> bool:

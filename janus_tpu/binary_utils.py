@@ -19,6 +19,7 @@ import logging
 import os
 import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .aggregator.job_driver import Stopper
@@ -113,15 +114,30 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
     request_queue_size = 128
 
     def __init__(self, addr, handler_cls, max_handler_threads: int = 32):
+        import weakref
         from concurrent.futures import ThreadPoolExecutor
 
         super().__init__(addr, handler_cls)
         self._max_handler_threads = max(1, max_handler_threads)
         self._active_connections = 0
         self._active_lock = threading.Lock()
+        # accept-time per connection (weak: entries vanish with the
+        # socket) — socket objects define __slots__, so the stamp
+        # cannot ride the object itself
+        self._accept_times = weakref.WeakKeyDictionary()
         self._pool = ThreadPoolExecutor(
             max_workers=self._max_handler_threads, thread_name_prefix="dap-handler"
         )
+
+    def queue_age_s(self, request) -> float | None:
+        """Seconds `request` (a connection socket) waited between
+        accept and the handler picking it up, once: the entry is
+        consumed, so later keep-alive requests on the same connection —
+        whose wait is the CLIENT's idle time, not ours — read None.
+        Handlers charge this against a request's propagated deadline
+        (docs/ROBUSTNESS.md deadline contract)."""
+        t = self._accept_times.pop(request, None)
+        return None if t is None else time.monotonic() - t
 
     @property
     def saturated(self) -> bool:
@@ -134,6 +150,14 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
         return self._active_connections >= self._max_handler_threads
 
     def process_request(self, request, client_address):
+        # queue-entry stamp (docs/ROBUSTNESS.md deadline contract):
+        # handlers charge the pool-queue wait against a request's
+        # propagated deadline — a request that expired while queued is
+        # shed before any crypto
+        try:
+            self._accept_times[request] = time.monotonic()
+        except TypeError:  # exotic non-weakref-able socket impls
+            pass
         try:
             self._pool.submit(self._process_in_pool, request, client_address)
         except RuntimeError:  # pool already shut down (server closing)
@@ -373,6 +397,11 @@ def setup_signal_handler(stopper: Stopper) -> None:
     def handle(signum, frame):
         log.info("received signal %s, shutting down", signum)
         stopper.stop()
+        # release threads parked by hang failpoints (a modeled device
+        # wedge must not outlive the process's intent to exit)
+        from . import failpoints
+
+        failpoints.release_hangs()
 
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
@@ -481,6 +510,26 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
     failpoints.configure_from_env(default=common.failpoints)
     register_status_provider("failpoints", failpoints.status)
 
+    # device-path watchdog + quarantine knobs (registers the /statusz
+    # `device_watchdog` section — abandoned-thread count + live stack
+    # dumps of stalled dispatches — as an import side effect)
+    from .aggregator import device_watchdog
+    from .aggregator.engine_cache import EngineCache, shutdown_engines
+
+    if "JANUS_WATCHDOG_ABANDONED_CAP" not in os.environ:
+        # like the canary knobs below: the env var is the operator
+        # override — applying the YAML/default over it would silently
+        # kill the documented knob in every binary
+        device_watchdog.configure(
+            abandoned_thread_cap=common.watchdog_abandoned_thread_cap
+        )
+    if "JANUS_CANARY_DELAY_S" not in os.environ:
+        EngineCache.QUARANTINE_CANARY_DELAY_SECS = common.quarantine_canary_delay_secs
+    if "JANUS_CANARY_TIMEOUT_S" not in os.environ:
+        EngineCache.QUARANTINE_CANARY_TIMEOUT_SECS = (
+            common.quarantine_canary_timeout_secs
+        )
+
     if common.jax_platform:
         os.environ["JAX_PLATFORMS"] = common.jax_platform
         try:
@@ -584,4 +633,13 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         return run(cfg, ds, stopper)
     finally:
         health.stop()
+        # teardown ordering against interpreter finalization — a daemon
+        # thread running REAL device work while the interpreter
+        # finalizes crashes inside native XLA: (1) stop engine canary
+        # loops (bounded join of an in-flight probe), (2) unpark
+        # hang-failpoint wedges (they raise at the site), (3) let
+        # abandoned watchdog workers retire
+        shutdown_engines(2.0)
+        failpoints.release_hangs()
+        device_watchdog.WATCHDOG.drain(2.0)
         ds.close()

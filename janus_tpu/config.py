@@ -107,9 +107,17 @@ class CommonConfig:
     # var overrides. None (the default) arms nothing and every
     # instrumented site compiles to a one-flag-check no-op.
     failpoints: object = None
+    # Device-path watchdog + quarantine (YAML `device_watchdog:`
+    # section; docs/ROBUSTNESS.md "Device hangs & deadlines"): parked
+    # abandoned-dispatch threads tolerated before the process trips
+    # host-only mode, and the quarantined engine's canary cadence.
+    watchdog_abandoned_thread_cap: int = 8
+    quarantine_canary_delay_secs: float = 5.0
+    quarantine_canary_timeout_secs: float = 30.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
+        wd = d.get("device_watchdog", {}) or {}
         return cls(
             database=DbConfig.from_dict(d.get("database", {})),
             logging_config=TraceConfiguration.from_dict(d.get("logging_config")),
@@ -122,6 +130,9 @@ class CommonConfig:
             warmup_buckets=tuple(int(b) for b in d.get("warmup_buckets", ())),
             health_sampler_interval_s=float(d.get("health_sampler_interval_secs", 15.0)),
             failpoints=d.get("failpoints"),
+            watchdog_abandoned_thread_cap=int(wd.get("abandoned_thread_cap", 8)),
+            quarantine_canary_delay_secs=float(wd.get("canary_delay_secs", 5.0)),
+            quarantine_canary_timeout_secs=float(wd.get("canary_timeout_secs", 30.0)),
         )
 
 

@@ -1,0 +1,166 @@
+"""Request/step deadline propagation (docs/ROBUSTNESS.md "Device hangs
+& deadlines").
+
+One ambient deadline per unit of work, carried in a contextvar:
+
+* a **job driver** enters `deadline_scope(lease_deadline(...))` around a
+  leased step, so every stage of the step — engine dispatch, helper
+  HTTP, datastore writes — shares the lease budget;
+* the **HTTP client** stamps the remaining budget on outbound requests
+  as the `DAP-Janus-Deadline` header (seconds, decimal — a duration,
+  not a wall-clock instant, so leader/helper clock skew cannot corrupt
+  it);
+* the **helper** turns the header back into an absolute monotonic
+  deadline at admission — backdated by the time the request sat in the
+  accept queue (`set_request_queue_age`, stamped by the serving layer)
+  so a request that expired while queued is shed before any HPKE work —
+  and enters `deadline_scope` for the handler, where
+  `check(stage)` raises `DeadlineExceeded` between stages and the
+  device watchdog bounds the engine dispatch itself.
+
+`DeadlineExceeded` is the one exception type for "the budget is dead":
+the retry loop (core/retries.py), the watchdog-bounded engine and the
+helper handler all raise it, and the job drivers translate it into a
+step-back (`janus_job_step_back_total{reason="deadline_expired"}`)
+instead of a failed attempt. A helper that hits it mid-handler answers
+the conclusive `DEADLINE_EXCEEDED_STATUS` (408 — deliberately NOT a
+retryable 5xx: dead work must be dropped, never amplified by retries
+against the same dead budget), which the leader maps back to
+DeadlineExceeded and steps back on.
+
+With no scope entered, every hook here is a no-op: `current_deadline()`
+is one contextvar read, so un-deadlined paths (tests, bench, uploads)
+pay nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+
+# Header carrying the sender's REMAINING budget in seconds (decimal).
+# A duration survives clock skew between aggregators; the receiver
+# anchors it to its own monotonic clock at admission.
+DEADLINE_HEADER = "DAP-Janus-Deadline"
+
+# Conclusive "your budget is dead" answer (helper -> leader). 408 is
+# not in core.retries.RETRYABLE_STATUS, so the leader's retry loop
+# returns it immediately and the driver steps back instead of hammering
+# the helper with more already-dead work.
+DEADLINE_EXCEEDED_STATUS = 408
+
+# Refuse to anchor absurd header values: a buggy/hostile remaining
+# beyond this simply means "effectively unbounded" and is clamped.
+MAX_REMAINING_S = 24 * 3600.0
+
+
+class DeadlineExceeded(TimeoutError):
+    """The work's deadline (lease bound / propagated request budget)
+    tripped before completion. Carries the last retryable status, if
+    any, so callers can log it — but deliberately NOT as a
+    (status, body) return value: a stale 5xx from an earlier attempt
+    must not masquerade as the conclusive outcome of the request."""
+
+    def __init__(self, msg: str, last_status: int | None = None):
+        super().__init__(msg)
+        self.last_status = last_status
+
+
+_deadline_var: contextvars.ContextVar[float | None] = contextvars.ContextVar(
+    "janus_deadline", default=None
+)
+# seconds the CURRENT request spent in the server's accept queue before
+# a handler thread picked it up (set per-request by DapServer)
+_queue_age_var: contextvars.ContextVar[float] = contextvars.ContextVar(
+    "janus_request_queue_age", default=0.0
+)
+
+
+def current_deadline() -> float | None:
+    """The ambient time.monotonic() deadline, or None (unbounded)."""
+    return _deadline_var.get()
+
+
+def remaining_s() -> float | None:
+    """Seconds left on the ambient deadline (may be negative), or None."""
+    dl = _deadline_var.get()
+    if dl is None:
+        return None
+    return dl - time.monotonic()
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: float | None):
+    """Set the ambient deadline (a time.monotonic() value, or None to
+    explicitly clear an inherited one) for the duration of the block."""
+    token = _deadline_var.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _deadline_var.reset(token)
+
+
+def check(stage: str) -> None:
+    """Raise DeadlineExceeded if the ambient deadline has passed.
+    Sprinkled between a handler's stages (decrypt loop, pre-run_tx) so
+    dead work is dropped at the next seam instead of carried through to
+    a response nobody is waiting for. Counted per stage in
+    janus_request_deadline_exceeded_total."""
+    dl = _deadline_var.get()
+    if dl is None or time.monotonic() < dl:
+        return
+    from .. import metrics
+
+    metrics.request_deadline_exceeded_total.add(stage=stage)
+    raise DeadlineExceeded(f"deadline exceeded during {stage}")
+
+
+def header_value(deadline: float | None) -> str | None:
+    """Encode a monotonic deadline as the DAP-Janus-Deadline header
+    value (remaining seconds), or None when unbounded/already dead (an
+    expired budget is the sender's problem to step back on, not a
+    header worth sending)."""
+    if deadline is None:
+        return None
+    rem = deadline - time.monotonic()
+    if rem <= 0:
+        return None
+    return f"{min(rem, MAX_REMAINING_S):.3f}"
+
+
+def parse_header(headers, queue_age_s: float = 0.0) -> float | None:
+    """Absolute monotonic deadline from a request's headers, or None.
+
+    `queue_age_s` backdates the anchor: the sender stamped its
+    remaining budget when the request left its socket, so time the
+    request spent waiting in OUR accept queue has already been spent —
+    a request that expired while queued parses to a deadline in the
+    past and is shed at admission. Unparseable/negative values are
+    ignored (None): the deadline contract is an optimization, never a
+    correctness dependency."""
+    raw = None
+    for k, v in headers.items():
+        if str(k).lower() == DEADLINE_HEADER.lower():
+            raw = v
+            break
+    if raw is None:
+        return None
+    try:
+        rem = float(raw)
+    except (TypeError, ValueError):
+        return None
+    if rem < 0:
+        return None
+    rem = min(rem, MAX_REMAINING_S)
+    return time.monotonic() - max(0.0, queue_age_s) + rem
+
+
+def set_request_queue_age(age_s: float) -> None:
+    """Record how long the current request sat in the accept queue
+    (stamped by the serving layer before dispatching to handlers)."""
+    _queue_age_var.set(max(0.0, age_s))
+
+
+def request_queue_age() -> float:
+    return _queue_age_var.get()

@@ -11,6 +11,7 @@ import urllib.error
 import urllib.request
 
 from .. import failpoints
+from . import deadline
 
 
 def _injected_transport_error() -> urllib.error.URLError:
@@ -91,6 +92,15 @@ class HttpClient:
             tp = current_traceparent()
             if tp is not None:
                 headers["traceparent"] = tp
+        # deadline propagation (core/deadline.py): inside a driver's
+        # lease-bounded step the REMAINING budget rides every outbound
+        # request (re-stamped per retry attempt, so the helper always
+        # sees the true residue), and the helper sheds work whose
+        # budget died in transit or in its accept queue
+        if not any(k.lower() == deadline.DEADLINE_HEADER.lower() for k in headers):
+            dl = deadline.header_value(deadline.current_deadline())
+            if dl is not None:
+                headers[deadline.DEADLINE_HEADER] = dl
         req = urllib.request.Request(url, data=body, method=method, headers=headers)
         try:
             with urllib.request.urlopen(

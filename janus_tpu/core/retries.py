@@ -14,23 +14,17 @@ from dataclasses import dataclass
 
 from .. import failpoints
 
+# Canonical home moved to core.deadline (the same type now covers the
+# lease-bounded retry loop, the watchdog-bounded engine dispatch and
+# the helper's propagated request budget); re-exported here for the
+# existing importers.
+from .deadline import DeadlineExceeded  # noqa: F401
+
 
 class RequestAborted(Exception):
     """The caller's should_abort() tripped mid-retry (driver shutdown
     drain): the request is abandoned without a conclusive response so
     the job step can step back and release its lease immediately."""
-
-
-class DeadlineExceeded(TimeoutError):
-    """The retry deadline (lease bound) tripped before a conclusive
-    response. Carries the last retryable status, if any, so callers can
-    log it — but deliberately NOT as a (status, body) return value: a
-    stale 5xx from an earlier attempt must not masquerade as the
-    conclusive outcome of the request."""
-
-    def __init__(self, msg: str, last_status: int | None = None):
-        super().__init__(msg)
-        self.last_status = last_status
 
 
 @dataclass(frozen=True)

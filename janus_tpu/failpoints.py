@@ -33,6 +33,18 @@ Actions:
     oom[:P]      raise a RESOURCE_EXHAUSTED-shaped error so the engine
                  OOM-recovery path (halved-bucket retry, host fallback)
                  takes over.
+    hang[:S]     park the calling thread — a wedged device dispatch /
+                 tunnel stall that never returns. S seconds when given;
+                 default (0) parks FOREVER, released only by the
+                 process stopper (release_hangs(), wired to SIGTERM) or
+                 by re-configuring/disarming the registry. A timed park
+                 or a reconfigure-release RESUMES the site (the device
+                 finally answered); a STOPPER release raises
+                 FailpointError instead — a thread woken mid-teardown
+                 must not re-enter real device work while the
+                 interpreter finalizes. Pair with the dispatch watchdog
+                 (docs/ROBUSTNESS.md "Device hangs & deadlines") to
+                 prove hung work is abandoned, not waited out.
 
 Modifiers: `prob=P` overrides the firing probability regardless of
 action arg; `count=N` is a firing budget — after N firings the
@@ -62,7 +74,7 @@ log = logging.getLogger(__name__)
 # injected crash from a real one.
 CRASH_EXIT_CODE = 77
 
-_ACTIONS = ("error", "delay", "timeout", "crash", "oom")
+_ACTIONS = ("error", "delay", "timeout", "crash", "oom", "hang")
 
 
 class FailpointError(Exception):
@@ -107,6 +119,28 @@ _rng = random.Random(
     if os.environ.get("JANUS_FAILPOINTS_SEED")
     else None
 )
+# Threads parked by the hang action wait on this event. It is set (and
+# replaced with a fresh one) on every reconfigure/disarm, and by
+# release_hangs() — which the binaries' SIGTERM handler calls — so a
+# parked "wedged device" releases on shutdown or schedule change
+# instead of pinning teardown.
+_hang_release = threading.Event()
+
+
+def release_hangs() -> None:
+    """Unpark every thread currently held by a hang failpoint (the
+    process stopper hook: a modeled device wedge must not outlive the
+    process's intent to exit). Unlike a reconfigure — where the site
+    RESUMES, modeling a device that finally answered — a stopper
+    release makes the site RAISE FailpointError: a thread woken during
+    teardown must not re-enter real (native device) work while the
+    interpreter finalizes underneath it."""
+    global _hang_release
+    with _lock:
+        old = _hang_release
+        _hang_release = threading.Event()
+    old._janus_hang_raise = True  # waiters captured THIS event
+    old.set()
 
 
 def _parse_one(name: str, body: str) -> _Failpoint:
@@ -120,11 +154,12 @@ def _parse_one(name: str, body: str) -> _Failpoint:
             f"failpoint {name!r}: unknown action {action!r} (expected one of {_ACTIONS})"
         )
     try:
-        arg = float(raw_arg) if raw_arg else 1.0
+        # hang's arg is seconds with 0 = forever, so its default is 0
+        arg = float(raw_arg) if raw_arg else (0.0 if action == "hang" else 1.0)
     except ValueError:
         raise FailpointSpecError(f"failpoint {name!r}: bad action arg {raw_arg!r}") from None
     # for error/crash/oom the positional arg IS the probability; for
-    # delay/timeout it is seconds and prob defaults to always
+    # delay/timeout/hang it is seconds and prob defaults to always
     prob = arg if action in ("error", "crash", "oom") else 1.0
     count = None
     for mod in parts[1:]:
@@ -177,12 +212,16 @@ def parse_spec(spec) -> dict[str, _Failpoint]:
 def configure(spec) -> None:
     """Replace the active failpoint set. `spec` is a spec string, a
     mapping, or None/''/{} to disarm everything."""
-    global ENABLED
+    global ENABLED, _hang_release
     parsed = parse_spec(spec) if spec else {}
     with _lock:
         _registry.clear()
         _registry.update(parsed)
         ENABLED = bool(_registry)
+        # re-arming or disarming releases threads parked by the OLD
+        # schedule's hang entries (the modeled wedge "recovers")
+        old_release, _hang_release = _hang_release, threading.Event()
+    old_release.set()
     if parsed:
         log.warning(
             "failpoints ARMED: %s",
@@ -253,6 +292,20 @@ def _act(fp: _Failpoint, error_factory=None, timeout_factory=None) -> None:
         os._exit(CRASH_EXIT_CODE)
     if fp.action == "oom":
         raise RuntimeError(f"RESOURCE_EXHAUSTED: injected failpoint {fp.name}")
+    if fp.action == "hang":
+        with _lock:
+            release = _hang_release
+        log.warning(
+            "failpoint %s: hanging %s",
+            fp.name,
+            f"{fp.arg:.3f}s" if fp.arg > 0 else "forever (until released)",
+        )
+        release.wait(fp.arg if fp.arg > 0 else None)
+        if getattr(release, "_janus_hang_raise", False):
+            # stopper release (process exiting): abort the site instead
+            # of resuming the modeled device work mid-teardown
+            raise FailpointError(f"hang released by process stop (failpoint {fp.name})")
+        return
     # action == "error"
     log.warning("failpoint %s: injecting error", fp.name)
     exc = (

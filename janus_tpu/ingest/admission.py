@@ -138,8 +138,23 @@ class AdmissionController:
     def watermark(self, route_class: str) -> float | None:
         return self._watermarks.get(route_class)
 
-    def admit(self, route_class: str) -> None:
-        """Raise ShedError if this request must be refused."""
+    def admit(self, route_class: str, deadline: float | None = None) -> None:
+        """Raise ShedError if this request must be refused.
+
+        `deadline`: the caller's propagated budget as an absolute
+        time.monotonic() value (core.deadline.parse_header — already
+        backdated by the time the request sat in the accept queue).
+        Work whose budget died in transit or while queued is shed 503
+        BEFORE any HPKE/datastore cost: the leader has already stepped
+        back (or will, on this 503's heels within its own budget), so
+        every cycle spent on it would be pure amplification."""
+        if deadline is not None and time.monotonic() >= deadline:
+            raise ShedError(
+                route_class,
+                "deadline_expired",
+                self.cfg.shed_retry_after_s,
+                status=503,
+            )
         if route_class == "aggregate":
             supervisor = self._supervisor_fn()
             if supervisor is not None and supervisor.state != "up":

@@ -375,7 +375,32 @@ engine_coalesced_rows_total = REGISTRY.counter(
 engine_backend_state = REGISTRY.gauge(
     "janus_engine_backend",
     "1 for the active engine backend per VDAF kind "
-    '(state="device|host_fallback|timed_fallback|host"), 0 otherwise',
+    '(state="device|host_fallback|timed_fallback|quarantined|host"), 0 otherwise',
+)
+
+# --- device-path watchdog + quarantine (aggregator/device_watchdog.py,
+# engine_cache quarantine/canary; docs/ROBUSTNESS.md "Device hangs &
+# deadlines") ---
+hung_dispatches_total = REGISTRY.counter(
+    "janus_hung_dispatches_total",
+    "device dispatches abandoned by the watchdog after exceeding the "
+    "caller's deadline (lease budget / propagated request deadline), by "
+    "VDAF and op — alert on any nonzero rate",
+)
+abandoned_dispatch_threads = REGISTRY.gauge(
+    "janus_abandoned_dispatch_threads",
+    "watchdog worker threads currently parked on a hung device dispatch; "
+    "reaching the configured cap trips host-only mode",
+)
+engine_quarantines_total = REGISTRY.counter(
+    "janus_engine_quarantines_total",
+    "device-circuit quarantine events per VDAF kind, by event "
+    '(event="open|canary_probe|canary_failed|restored")',
+)
+request_deadline_exceeded_total = REGISTRY.counter(
+    "janus_request_deadline_exceeded_total",
+    "units of work dropped mid-stage because their propagated deadline "
+    "(DAP-Janus-Deadline / lease budget) expired, by stage",
 )
 
 # --- job/task health (aggregator/health_sampler.py; sampled except the

@@ -63,10 +63,31 @@ process is killed). Invariants:
   - while the datastore is healthy the armed-but-idle journal performs
     ZERO fsyncs — the hot path is unchanged.
 
+A third scenario, `--scenario device_hang`, proves the DEADLINE-AWARE
+DEVICE PATH (docs/ROBUSTNESS.md "Device hangs & deadlines"): the real
+aggregation job driver binary runs with `engine.dispatch=hang,count=1`
+armed — its first device dispatch wedges forever, exactly like a hung
+XLA dispatch / tunnel stall. Invariants:
+
+  - the hung step never outlives its lease: the dispatch watchdog
+    abandons the dispatch within the lease budget and the job steps
+    back (`janus_job_step_back_total{reason="device_hang"}`), releasing
+    the lease BEFORE its expiry;
+  - the abandoned thread is visible (`janus_hung_dispatches_total`,
+    `janus_abandoned_dispatch_threads` under the cap, a live stack dump
+    in /statusz `device_watchdog.stalled`) and the engine transitions
+    device → quarantined → (canary recompile + probe) → device, all
+    observed live over the driver's /metrics + /statusz;
+  - interim work lands through the host fallback while quarantined, and
+    the final collection equals the admitted ground truth exactly;
+  - the driver SIGTERM-drains cleanly (release_hangs unparks the
+    modeled wedge on shutdown).
+
 Usage:
     python scripts/chaos_run.py --smoke --json   # fast deterministic
     python scripts/chaos_run.py --json           # full schedule (slow)
     python scripts/chaos_run.py --scenario db_outage --smoke --json
+    python scripts/chaos_run.py --scenario device_hang --smoke --json
 
 Exit code 0 iff every invariant held; the result JSON rides on stdout
 (bench.py --dry-run embeds the smokes as its chaos_smoke and
@@ -111,6 +132,9 @@ HELPER_5XX_SCHEDULE = "helper.aggregate=error:1.0,count=2"
 # "leader" (the harness names the leader's store; the in-process
 # helper's store keeps its default scope and stays up)
 DB_OUTAGE_SCHEDULE = "datastore.connect.leader=error:1.0"
+# the driver's first device dispatch wedges FOREVER (released only by
+# the stopper): the hung-XLA-dispatch model for --scenario device_hang
+DEVICE_HANG_SCHEDULE = "engine.dispatch=hang,count=1"
 
 
 def _free_port() -> int:
@@ -138,13 +162,14 @@ def _driver_cfg(path, db, health_port, ttl_s, cooldown_s):
     return str(path)
 
 
-def _spawn_driver(cfg_path, key, log_path, failpoints: str | None):
+def _spawn_driver(cfg_path, key, log_path, failpoints: str | None, extra_env=None):
     env = dict(
         os.environ,
         PYTHONPATH=REPO,
         DATASTORE_KEYS=key,
         JAX_PLATFORMS="cpu",
     )
+    env.update(extra_env or {})
     if failpoints:
         env["JANUS_FAILPOINTS"] = failpoints
     else:
@@ -871,6 +896,311 @@ def run_db_outage(
         helper_ds.close()
 
 
+def run_device_hang(
+    n_reports: int = 5,
+    lease_ttl_s: int = 8,
+    canary_delay_s: float = 1.5,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Deadline-aware device-path schedule (see module docstring):
+    hung dispatch → watchdog abandon within the lease budget → engine
+    quarantine → host-fallback serving → canary restore → exactly-once
+    collection. Every `*_ok` key must be True to pass."""
+    import threading
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.binary_utils import enable_compile_cache, warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    import dataclasses
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-devhang-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    clock = RealClock()
+    leader_db = os.path.join(tmp, "leader.sqlite")
+    leader_ds = Datastore(leader_db, Crypter([key_bytes]), clock)
+    helper_ds = Datastore(os.path.join(tmp, "helper.sqlite"), Crypter([key_bytes]), clock)
+
+    result: dict = {
+        "workdir": tmp,
+        "schedule": "device_hang_full" if full else "device_hang_smoke",
+    }
+    procs: list[subprocess.Popen] = []
+    leader_srv = helper_srv = None
+    try:
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+
+        vdaf = VdafInstance.count()
+        collector_kp = generate_hpke_config_and_private_key(config_id=202)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=3),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+        enable_compile_cache()
+        warmup_engines(leader_ds)
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        measurements = [(i % 3 != 0) * 1 for i in range(n_reports)]
+        for m in measurements:
+            client.upload(m)
+        AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=100
+            ),
+        ).run_once()
+        result["admitted"] = len(measurements)
+        result["ground_truth_sum"] = sum(measurements)
+
+        def held_agg_leases():
+            return [
+                e
+                for e in leader_ds.run_tx(
+                    lambda tx: tx.get_held_lease_expiries(), "devhang_monitor"
+                )
+                if e[0] == "aggregation"
+            ]
+
+        def agg_jobs_by_state():
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "devhang_monitor"
+            )
+            return {
+                state: n for (typ, state), n in counts.items() if typ == "aggregation"
+            }
+
+        # --- spawn the real driver with the hang armed ------------------
+        port = _free_port()
+        cfg = _driver_cfg(
+            os.path.join(tmp, "driver.yaml"), leader_db, port, int(lease_ttl_s), 1.5
+        )
+        drv = _spawn_driver(
+            cfg,
+            key,
+            os.path.join(tmp, "driver.log"),
+            DEVICE_HANG_SCHEDULE,
+            extra_env={
+                # fast canary cycle so the quarantine window is short but
+                # still reliably observable by the 0.05s poll below
+                "JANUS_CANARY_DELAY_S": str(canary_delay_s),
+                "JANUS_CANARY_TIMEOUT_S": "30",
+            },
+        )
+        procs.append(drv)
+        _wait_healthz(port)
+
+        # --- observe: lease bounded, watchdog + quarantine visible -----
+        first_expiry = None
+        released_at = None  # wall clock when the FIRST (hung) lease left
+        quarantined_seen = False
+        stalled_stack_seen = False
+        abandoned_max = 0.0
+        cap = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            leases = held_agg_leases()
+            now_wall = clock.now().seconds
+            if leases and first_expiry is None:
+                first_expiry = leases[0][3]
+                result["first_lease_expiry"] = first_expiry
+            if (
+                first_expiry is not None
+                and released_at is None
+                and not any(e[3] == first_expiry for e in leases)
+            ):
+                released_at = now_wall
+            try:
+                mtext = _scrape(port, "/metrics")
+                backend = _metric_samples(mtext, "janus_engine_backend")
+                if backend.get('state="quarantined",vdaf="count"') == 1.0:
+                    quarantined_seen = True
+                ab = _metric_samples(mtext, "janus_abandoned_dispatch_threads")
+                abandoned_max = max(abandoned_max, *(ab.values() or [0.0]))
+                statusz = json.loads(_scrape(port, "/statusz"))
+                wd = statusz.get("device_watchdog", {})
+                cap = wd.get("abandoned_thread_cap", cap)
+                for ent in wd.get("stalled", []):
+                    if ent.get("stack"):
+                        stalled_stack_seen = True
+            except Exception:
+                pass  # scrape raced the driver's own work; retry next poll
+            states = agg_jobs_by_state()
+            if states.get("in_progress", 0) == 0 and states.get("finished", 0) >= 1:
+                break
+            time.sleep(0.05)
+
+        states = agg_jobs_by_state()
+        result["job_finished_ok"] = (
+            states.get("finished", 0) >= 1 and states.get("in_progress", 0) == 0
+        )
+        # THE lease-bound invariant: the hung step released its lease
+        # (stepped back) BEFORE the lease expired — the wedge never
+        # outlives the lease and runs concurrently with a re-acquirer.
+        # (+1s margin covers the 0.05s poll + second-granularity clock.)
+        result["hung_lease_released_at"] = released_at
+        result["lease_bounded_ok"] = (
+            first_expiry is not None
+            and released_at is not None
+            and released_at <= first_expiry + 1
+        )
+        result["quarantined_observed_ok"] = quarantined_seen
+        result["stalled_stack_ok"] = stalled_stack_seen
+        result["abandoned_max"] = abandoned_max
+        result["abandoned_under_cap_ok"] = (
+            abandoned_max >= 1.0 and cap is not None and abandoned_max < cap
+        )
+
+        # --- wait for the canary to restore the device path (the job
+        # usually finishes on host fallback BEFORE the canary's
+        # cool-down elapses; the restore is observed live) ------------
+        restore_deadline = time.monotonic() + 60
+        mtext = _scrape(port, "/metrics")
+        while time.monotonic() < restore_deadline:
+            mtext = _scrape(port, "/metrics")
+            quar = _metric_samples(mtext, "janus_engine_quarantines_total")
+            if sum(v for k, v in quar.items() if 'event="restored"' in k) >= 1:
+                break
+            time.sleep(0.1)
+
+        # --- steady state: restored to device, counters tell the story --
+        hung = _metric_samples(mtext, "janus_hung_dispatches_total")
+        result["hung_dispatches"] = hung
+        result["hung_dispatch_ok"] = sum(hung.values()) >= 1
+        step_backs = _metric_samples(mtext, "janus_job_step_back_total")
+        result["step_backs"] = step_backs
+        result["stepped_back_device_hang_ok"] = (
+            sum(v for k, v in step_backs.items() if "device_hang" in k) >= 1
+        )
+        quar = _metric_samples(mtext, "janus_engine_quarantines_total")
+        result["quarantine_events"] = quar
+        result["quarantine_cycle_ok"] = (
+            sum(v for k, v in quar.items() if 'event="open"' in k) >= 1
+            and sum(v for k, v in quar.items() if 'event="restored"' in k) >= 1
+        )
+        backend = _metric_samples(mtext, "janus_engine_backend")
+        result["restored_ok"] = (
+            backend.get('state="device",vdaf="count"') == 1.0
+            and backend.get('state="quarantined",vdaf="count"') == 0.0
+        )
+        statusz = json.loads(_scrape(port, "/statusz"))
+        result["statusz_watchdog_ok"] = (
+            statusz.get("device_watchdog", {}).get("hung_dispatches_total", 0) >= 1
+        )
+
+        # --- SIGTERM drain (release_hangs unparks the modeled wedge) ----
+        drv.send_signal(signal.SIGTERM)
+        rc = drv.wait(timeout=60)
+        log_text = open(os.path.join(tmp, "driver.log"), "rb").read()
+        result["drain_rc"] = rc
+        result["drain_ok"] = rc == 0 and b"shut down" in log_text
+
+        # --- collect and compare against ground truth -------------------
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            cjd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                cjd.run_once()
+                stop_collect.wait(0.3)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+        try:
+            collector = Collector(
+                CollectorParameters(
+                    leader_task.task_id,
+                    leader_srv.url,
+                    leader_task.collector_auth_token,
+                    collector_kp,
+                ),
+                vdaf,
+                HttpClient(),
+            )
+            tp = leader_task.time_precision
+            start = clock.now().to_batch_interval_start(tp)
+            query = Query.time_interval(
+                Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+            )
+            collected = collector.collect(query, timeout_s=120.0)
+            result["collected_count"] = collected.report_count
+            result["collected_sum"] = collected.aggregate_result
+            # interim work landed through the host fallback, restored
+            # work on device — and every admitted report exactly once
+            result["exactly_once_ok"] = (
+                collected.report_count == len(measurements)
+                and collected.aggregate_result == sum(measurements)
+            )
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        failpoints_mod = sys.modules.get("janus_tpu.failpoints")
+        if failpoints_mod is not None:
+            failpoints_mod.clear()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if leader_srv is not None:
+            leader_srv.stop()
+        if helper_srv is not None:
+            helper_srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -881,11 +1211,13 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--scenario",
-        choices=["crash_storm", "db_outage"],
+        choices=["crash_storm", "db_outage", "device_hang"],
         default="crash_storm",
         help="crash_storm = driver SIGKILL + helper storms (default); "
         "db_outage = datastore outage under upload load (journal spill, "
-        "degraded serving, replay, exactly-once)",
+        "degraded serving, replay, exactly-once); device_hang = wedged "
+        "device dispatch (watchdog abandon, quarantine + canary "
+        "restore, host-fallback serving, exactly-once)",
     )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
@@ -896,6 +1228,12 @@ def main(argv=None) -> int:
         result = run_db_outage(
             n_warm=args.reports or (4 if args.smoke else 10),
             outage_hold_s=1.5 if args.smoke else 5.0,
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    elif args.scenario == "device_hang":
+        result = run_device_hang(
+            n_reports=args.reports or (5 if args.smoke else 12),
             full=not args.smoke,
             workdir=args.workdir,
         )

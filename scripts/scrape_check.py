@@ -68,6 +68,16 @@ def main(argv=None) -> int:
     errors.extend(lint_metric_names({f.name: f.type for f in families.values()}))
     if not families:
         errors.append("/metrics exposed no metric families")
+    # device-path watchdog/quarantine families (docs/ROBUSTNESS.md
+    # "Device hangs & deadlines"): registered at import in every
+    # binary, so absence is a deploy regression, not an idle process
+    for fam in (
+        "janus_hung_dispatches_total",
+        "janus_abandoned_dispatch_threads",
+        "janus_engine_quarantines_total",
+    ):
+        if fam not in families:
+            errors.append(f"/metrics missing the {fam} family")
 
     if args.statusz:
         try:
@@ -78,6 +88,23 @@ def main(argv=None) -> int:
         else:
             if not isinstance(snap, dict) or not snap:
                 errors.append("/statusz snapshot is empty")
+            else:
+                # the device_watchdog section must carry the abandoned-
+                # thread accounting and, for every stalled dispatch, a
+                # live stack dump — the first artifact an operator
+                # needs when a dispatch wedges
+                wd = snap.get("device_watchdog")
+                if not isinstance(wd, dict):
+                    errors.append("/statusz missing the device_watchdog section")
+                else:
+                    for key in ("abandoned_threads", "abandoned_thread_cap", "host_only", "stalled"):
+                        if key not in wd:
+                            errors.append(f"/statusz device_watchdog missing {key!r}")
+                    for ent in wd.get("stalled", []) or []:
+                        if not ent.get("stack"):
+                            errors.append(
+                                "/statusz device_watchdog stalled entry without a stack dump"
+                            )
 
     # /readyz semantics (docs/ROBUSTNESS.md "Datastore outages"): 200
     # with {"ready": true} when serving, 503 with a JSON reason map when

@@ -1,0 +1,676 @@
+"""Deadline-aware device path (ISSUE 8; docs/ROBUSTNESS.md "Device
+hangs & deadlines"): the deadline contextvar/header contract, the
+dispatch watchdog (abandon + cap + stack dumps), the engine's hang
+quarantine with canary restore, deadline-expired admission shedding,
+and the job drivers' step-back translation — a wedged device or a dead
+lease budget must never burn a lease TTL or amplify dead work."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from janus_tpu import failpoints, metrics
+from janus_tpu.aggregator import device_watchdog
+from janus_tpu.aggregator.device_watchdog import DeviceHangError, DispatchWatchdog
+from janus_tpu.core import deadline as dl
+
+VK = bytes(range(16))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Failpoints and the process watchdog are globals: start and end
+    each test disarmed / un-tripped so a hang here can't walk the
+    SHARED abandoned cap toward host-only mode for unrelated suites."""
+    failpoints.clear()
+    device_watchdog.WATCHDOG.reset_for_tests()
+    yield
+    failpoints.clear()
+    time.sleep(0.05)  # released hang-parked workers finish retiring
+    device_watchdog.WATCHDOG.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# deadline module
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_scope_and_remaining():
+    assert dl.current_deadline() is None
+    assert dl.remaining_s() is None
+    with dl.deadline_scope(time.monotonic() + 5.0) as d:
+        assert dl.current_deadline() == d
+        assert 4.0 < dl.remaining_s() <= 5.0
+        with dl.deadline_scope(None):  # explicit clear nests
+            assert dl.current_deadline() is None
+        assert dl.current_deadline() == d
+    assert dl.current_deadline() is None
+
+
+def test_deadline_check_raises_past_deadline_and_counts():
+    dl.check("idle")  # no scope: no-op
+    with dl.deadline_scope(time.monotonic() + 60):
+        dl.check("fresh")  # within budget: no-op
+    before = metrics.request_deadline_exceeded_total.get(stage="t_stage")
+    with dl.deadline_scope(time.monotonic() - 0.01):
+        with pytest.raises(dl.DeadlineExceeded):
+            dl.check("t_stage")
+    assert metrics.request_deadline_exceeded_total.get(stage="t_stage") == before + 1
+
+
+def test_deadline_header_roundtrip_and_queue_age():
+    # encode: remaining seconds; None when unbounded or already dead
+    assert dl.header_value(None) is None
+    assert dl.header_value(time.monotonic() - 1) is None
+    raw = dl.header_value(time.monotonic() + 10)
+    assert 9.0 < float(raw) <= 10.0
+    # parse anchors to the receiver's monotonic clock
+    parsed = dl.parse_header({dl.DEADLINE_HEADER: raw})
+    assert 8.5 < parsed - time.monotonic() <= 10.0
+    # header names are case-insensitive (urllib normalizes)
+    assert dl.parse_header({dl.DEADLINE_HEADER.lower(): "5"}) is not None
+    # queue age backdates: a request that waited 8s of its 5s budget
+    # parses to a deadline in the past
+    stale = dl.parse_header({dl.DEADLINE_HEADER: "5"}, queue_age_s=8.0)
+    assert stale < time.monotonic()
+    # garbage/negative/absent are ignored, never fatal
+    assert dl.parse_header({dl.DEADLINE_HEADER: "bogus"}) is None
+    assert dl.parse_header({dl.DEADLINE_HEADER: "-3"}) is None
+    assert dl.parse_header({}) is None
+
+
+def test_deadline_exceeded_importable_from_retries():
+    # canonical home moved; the old import path must keep working
+    from janus_tpu.core.retries import DeadlineExceeded
+
+    assert DeadlineExceeded is dl.DeadlineExceeded
+    assert issubclass(DeadlineExceeded, TimeoutError)
+
+
+def test_http_client_stamps_deadline_header():
+    """Inside a deadline scope every outbound request carries the
+    remaining budget; outside, no header is added."""
+    from janus_tpu.binary_utils import HealthServer
+    from janus_tpu.core.http_client import HttpClient
+
+    seen = {}
+    srv = HealthServer("127.0.0.1:0").start()
+    try:
+        http = HttpClient()
+
+        # observe what urllib would send by spying on urlopen (the
+        # request object carries the merged headers at that point)
+        import urllib.request as _ur
+
+        orig_urlopen = _ur.urlopen
+
+        def spy(req, timeout=None):
+            seen["headers"] = dict(req.headers)
+            return orig_urlopen(req, timeout=timeout)
+
+        _ur.urlopen = spy
+        try:
+            http.get(f"http://127.0.0.1:{srv.port}/healthz")
+            assert not any(
+                k.lower() == dl.DEADLINE_HEADER.lower() for k in seen["headers"]
+            )
+            with dl.deadline_scope(time.monotonic() + 30):
+                http.get(f"http://127.0.0.1:{srv.port}/healthz")
+            hdr = {k.lower(): v for k, v in seen["headers"].items()}
+            assert 28.0 < float(hdr[dl.DEADLINE_HEADER.lower()]) <= 30.0
+        finally:
+            _ur.urlopen = orig_urlopen
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# watchdog mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_disarmed_is_direct_call():
+    wd = DispatchWatchdog()
+    calls = []
+    assert wd.run(lambda: calls.append(threading.get_ident()) or 42) == 42
+    # no deadline: ran inline on the caller's thread
+    assert calls == [threading.get_ident()]
+
+
+def test_watchdog_supervised_success_propagates_result_and_errors():
+    wd = DispatchWatchdog()
+    deadline = time.monotonic() + 10
+    assert wd.run(lambda: 7, deadline=deadline) == 7
+    with pytest.raises(ValueError, match="boom"):
+        wd.run(lambda: (_ for _ in ()).throw(ValueError("boom")), deadline=deadline)
+    # worker reuse: successive calls don't grow the thread population
+    before = threading.active_count()
+    for _ in range(20):
+        assert wd.run(lambda: 1, deadline=time.monotonic() + 10) == 1
+    assert threading.active_count() <= before + 1
+
+
+def test_watchdog_propagates_context_into_worker():
+    """Trace/deadline contextvars must ride into the worker (spans and
+    nested checks depend on it)."""
+    wd = DispatchWatchdog()
+    with dl.deadline_scope(time.monotonic() + 30):
+        got = wd.run(dl.current_deadline, deadline=time.monotonic() + 10)
+    assert got is not None
+
+
+def test_watchdog_abandons_hung_dispatch_and_dumps_stack():
+    wd = DispatchWatchdog(abandoned_thread_cap=4)
+    gate = threading.Event()
+    before_hung = metrics.hung_dispatches_total.get(vdaf="t", op="op1")
+    t0 = time.monotonic()
+    with pytest.raises(DeviceHangError) as ei:
+        wd.run(gate.wait, deadline=time.monotonic() + 0.2, label="op1", vdaf="t")
+    assert 0.15 < time.monotonic() - t0 < 2.0  # raised AT the deadline
+    assert ei.value.label == "op1"
+    assert metrics.hung_dispatches_total.get(vdaf="t", op="op1") == before_hung + 1
+    st = wd.status()
+    assert st["abandoned_threads"] == 1 and st["host_only"] is False
+    (stalled,) = st["stalled"]
+    assert stalled["label"] == "op1" and stalled["stack"]  # live stack dump
+    assert any("wait" in line for line in stalled["stack"])
+    # the wedge clears: the worker retires and the accounting drains
+    gate.set()
+    deadline = time.monotonic() + 5
+    while wd.status()["abandoned_threads"] and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert wd.status()["abandoned_threads"] == 0
+
+
+def test_watchdog_on_hang_hook_fires_before_raise():
+    wd = DispatchWatchdog()
+    gate = threading.Event()
+    hooked = []
+    with pytest.raises(DeviceHangError):
+        wd.run(
+            gate.wait,
+            deadline=time.monotonic() + 0.1,
+            label="op",
+            on_hang=hooked.append,
+        )
+    assert hooked == ["op"]
+    gate.set()
+
+
+def test_watchdog_cap_trips_host_only_mode():
+    wd = DispatchWatchdog(abandoned_thread_cap=2)
+    gates = [threading.Event() for _ in range(2)]
+    for g in gates:
+        with pytest.raises(DeviceHangError):
+            wd.run(g.wait, deadline=time.monotonic() + 0.05, label="op")
+    assert wd.host_only() is True
+    # once tripped, further supervised dispatches refuse immediately
+    with pytest.raises(DeviceHangError):
+        wd.run(lambda: 1, deadline=time.monotonic() + 10)
+    for g in gates:
+        g.set()
+
+
+def test_watchdog_expired_deadline_refuses_before_dispatch():
+    wd = DispatchWatchdog()
+    with pytest.raises(dl.DeadlineExceeded):
+        wd.run(lambda: 1, deadline=time.monotonic() - 0.1)
+
+
+# ---------------------------------------------------------------------------
+# engine quarantine + canary (the device circuit)
+# ---------------------------------------------------------------------------
+
+
+def _job(inst, n=4, seed=1):
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    rng = np.random.default_rng(seed)
+    return make_report_batch(inst, random_measurements(inst, n, rng), seed=seed)
+
+
+def test_hang_quarantines_engine_then_canary_restores():
+    """The full device-circuit cycle on a real engine: a hung dispatch
+    raises DeviceHangError to the caller (NOT absorbed by the OOM
+    ladder), the engine serves from the host fallback while
+    quarantined (interim work lands), and the canary recompile+probe
+    restores the device path with the initial caps."""
+    from janus_tpu.aggregator.engine_cache import EngineCache, HostEngineCache
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    eng.QUARANTINE_CANARY_DELAY_SECS = 0.1
+    args, m = _job(inst)
+    nonce, public, meas, proof, blind0, seeds, blind1 = args
+    # healthy reference (also pays the compile outside the hang window)
+    want = eng.leader_init(nonce, public, meas, proof, blind0)[2]
+
+    failpoints.configure("engine.dispatch=hang,count=1")
+    with dl.deadline_scope(time.monotonic() + 0.4):
+        with pytest.raises(DeviceHangError):
+            eng.leader_init(nonce, public, meas, proof, blind0)
+    assert eng._quarantined is True
+    assert eng._backend_state() == "quarantined"
+    assert metrics.engine_backend_state.get(vdaf="count", state="quarantined") == 1.0
+    assert isinstance(eng._host_fallback, HostEngineCache)
+
+    # interim work lands through the host fallback with correct results
+    _, _, ver0_host, _ = eng.leader_init(nonce, public, meas, proof, blind0)
+    for a, b in zip(want, ver0_host):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+    # the hang budget is spent: the canary probe succeeds and restores
+    failpoints.clear()
+    deadline = time.monotonic() + 30
+    while eng._quarantined and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng._quarantined is False
+    assert eng._backend_state() == "device"
+    assert eng.bucket_cap == eng._initial_bucket_cap
+    assert metrics.engine_quarantines_total.get(vdaf="count", event="open") >= 1
+    assert metrics.engine_quarantines_total.get(vdaf="count", event="restored") >= 1
+    # device path actually serves again
+    out0, _, _, _ = eng.leader_init(nonce, public, meas, proof, blind0)
+    agg = eng.aggregate(out0, np.ones(4, dtype=bool))
+    assert len(agg) >= 1
+
+
+def test_canary_failure_keeps_quarantine_open():
+    """While the device is still wedged (engine.canary hangs too) the
+    engine stays quarantined and keeps serving from host; the canary
+    backs off and succeeds once the wedge clears."""
+    from janus_tpu.aggregator.engine_cache import EngineCache
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    eng.QUARANTINE_CANARY_DELAY_SECS = 0.05
+    eng.QUARANTINE_CANARY_TIMEOUT_SECS = 0.2
+    args, _ = _job(inst, seed=3)
+    nonce, public, meas, proof, blind0, seeds, blind1 = args
+    eng.leader_init(nonce, public, meas, proof, blind0)  # compile
+
+    # dispatch hang opens the circuit; the canary's probe hangs as well
+    failpoints.configure("engine.dispatch=hang,count=1;engine.canary=hang")
+    with dl.deadline_scope(time.monotonic() + 0.4):
+        with pytest.raises(DeviceHangError):
+            eng.leader_init(nonce, public, meas, proof, blind0)
+    deadline = time.monotonic() + 10
+    while (
+        metrics.engine_quarantines_total.get(vdaf="count", event="canary_failed") < 1
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.02)
+    assert eng._quarantined is True  # failed probe: still quarantined
+    # the wedge clears; the backed-off canary restores
+    failpoints.clear()
+    deadline = time.monotonic() + 30
+    while eng._quarantined and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng._quarantined is False
+
+
+# ---------------------------------------------------------------------------
+# admission + helper handler + driver step-back
+# ---------------------------------------------------------------------------
+
+
+def test_admission_sheds_expired_deadline_503():
+    from janus_tpu.ingest.admission import AdmissionConfig, AdmissionController, ShedError
+
+    adm = AdmissionController(AdmissionConfig())
+    adm.admit("aggregate")  # no deadline: through
+    adm.admit("aggregate", deadline=time.monotonic() + 5)  # live budget
+    with pytest.raises(ShedError) as ei:
+        adm.admit("aggregate", deadline=time.monotonic() - 0.01)
+    assert ei.value.status == 503
+    assert ei.value.reason == "deadline_expired"
+
+
+def test_real_server_queue_age_sheds_expired_deadline():
+    """The REAL serving path (socket accept stamp → pool queue →
+    handler): with a single-worker pool occupied by a slow request, a
+    queued aggregate request whose propagated budget dies while
+    waiting sheds 503 deadline_expired — the accept-time stamp must
+    survive socket.socket's __slots__ (it rides the server's weak
+    map, not the socket object)."""
+    import threading as _threading
+
+    from janus_tpu.aggregator.http_handlers import DapServer
+    from janus_tpu.core.http_client import fetch_any_status
+    from janus_tpu.ingest.admission import AdmissionConfig, AdmissionController
+    from janus_tpu.messages import AggregationJobInitializeReq
+
+    adm = AdmissionController(AdmissionConfig())
+    first_in = _threading.Event()
+
+    class _App:
+        """Minimal DapHttpApp-alike: route PUT aggregation_jobs through
+        real admission with the real deadline parse, stall the first
+        request to force the second into the accept queue."""
+
+        calls = 0
+
+        def handle(self, method, path, query, headers, body):
+            import json as _json
+
+            from janus_tpu.ingest.admission import ShedError
+
+            _App.calls += 1
+            me = _App.calls
+            try:
+                deadline = dl.parse_header(headers, queue_age_s=dl.request_queue_age())
+                adm.admit("aggregate", deadline=deadline)
+            except ShedError as e:
+                return (
+                    e.status,
+                    "application/problem+json",
+                    _json.dumps({"detail": str(e)}).encode(),
+                    {},
+                )
+            if me == 1:
+                first_in.set()
+                time.sleep(0.8)  # pin the single pool worker
+            return 200, "text/plain", b"ok", {}
+
+    srv = DapServer(_App(), max_handler_threads=1)
+    srv.start()
+    try:
+        results = {}
+
+        def send(name, deadline_header):
+            headers = {"Content-Type": AggregationJobInitializeReq.MEDIA_TYPE}
+            if deadline_header is not None:
+                headers[dl.DEADLINE_HEADER] = deadline_header
+            results[name] = fetch_any_status(
+                srv.url + "tasks/x/aggregation_jobs/y",
+                method="PUT",
+                body=b"",
+                headers=headers,
+                timeout=10,
+            )
+
+        t1 = _threading.Thread(target=send, args=("slow", "30"))
+        t1.start()
+        assert first_in.wait(5)
+        # queued behind the pinned worker with a 0.2s budget: by the
+        # time the worker frees (~0.8s) the budget died IN THE QUEUE
+        t2 = _threading.Thread(target=send, args=("queued", "0.2"))
+        t2.start()
+        t1.join(10)
+        t2.join(10)
+        assert results["slow"][0] == 200
+        status, body = results["queued"]
+        assert status == 503, (status, body)
+        assert b"deadline_expired" in body
+    finally:
+        srv.stop()
+
+
+def test_lease_deadline_floor_never_extends_past_lease():
+    """A near-expired (but live) lease gets AT MOST its remaining
+    seconds — the old 1 s floor let the step overrun lease expiry and
+    run concurrently with a re-acquirer."""
+    from janus_tpu.aggregator.job_driver import lease_deadline
+    from janus_tpu.messages import Time
+
+    class _Clock:
+        def now(self):
+            return Time(1_600_000_000)
+
+    class _Lease:
+        class expiry:
+            seconds = 1_600_000_000 + 1  # 1s of lease left
+
+    d = lease_deadline(_Clock(), _Lease(), skew_s=60)
+    assert d - time.monotonic() <= 1.0 + 1e-6  # capped at remaining
+
+
+def test_stop_canary_ends_loop_without_probe():
+    """Process-teardown hook: stop_canary() wakes the quarantined
+    engine's canary out of its cool-down and the loop exits WITHOUT
+    probing (no native device work racing interpreter finalization);
+    the engine stays quarantined, serving host."""
+    from janus_tpu.aggregator.engine_cache import EngineCache
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    eng = EngineCache(VdafInstance.count(), VK)
+    eng.QUARANTINE_CANARY_DELAY_SECS = 30.0  # far future: wait is real
+    before = metrics.engine_quarantines_total.get(vdaf="count", event="canary_probe")
+    eng._quarantine_on_hang("test")
+    assert eng._quarantined and eng._canary_thread.is_alive()
+    eng.stop_canary(timeout_s=5.0)
+    assert not eng._canary_thread.is_alive()
+    assert eng._quarantined is True  # no probe ran, no restore
+    assert (
+        metrics.engine_quarantines_total.get(vdaf="count", event="canary_probe")
+        == before
+    )
+
+
+def test_lease_deadline_raises_on_expired_lease():
+    from janus_tpu.aggregator.job_driver import lease_deadline
+
+    class _Lease:
+        pass
+
+    class _Clock:
+        def now(self):
+            from janus_tpu.messages import Time
+
+            return Time(1_600_000_000)
+
+    lease = _Lease()
+
+    class _T:
+        def __init__(self, s):
+            self.seconds = s
+
+    lease.expiry = _T(1_600_000_000 - 5)  # expired 5s ago
+    with pytest.raises(dl.DeadlineExceeded):
+        lease_deadline(_Clock(), lease, skew_s=60)
+    # a live lease still yields a monotonic bound
+    lease.expiry = _T(1_600_000_000 + 100)
+    assert lease_deadline(_Clock(), lease, skew_s=60) > time.monotonic()
+
+
+def test_deadline_request_timeout_raises_instead_of_doomed_floor():
+    from janus_tpu.aggregator.job_driver import deadline_request_timeout
+
+    assert deadline_request_timeout(None) is None
+    t = deadline_request_timeout(time.monotonic() + 2.0)
+    assert 1.5 < t <= 2.0
+    # the old max(0.1, …) floor fired a doomed 0.1s attempt here
+    with pytest.raises(dl.DeadlineExceeded):
+        deadline_request_timeout(time.monotonic() - 0.01)
+
+
+def _acquired_job(ds):
+    from janus_tpu.messages import Duration
+    from test_lease_invariants import make_task, put_job
+
+    task = make_task(ds)
+    put_job(ds, task, bytes(16))
+    (acquired,) = ds.run_tx(
+        lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+    )
+    return acquired
+
+
+@pytest.mark.parametrize(
+    "exc,reason",
+    [
+        (dl.DeadlineExceeded("budget dead"), "deadline_expired"),
+        (DeviceHangError("leader_init", 4.0), "device_hang"),
+    ],
+)
+def test_stepper_steps_back_on_deadline_and_hang(monkeypatch, exc, reason):
+    """DeadlineExceeded / DeviceHangError from a step are STEP-BACKS
+    (lease released, attempt refunded, distinct reason label) — never
+    failed attempts marching toward abandonment."""
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Time
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ds = eph.datastore
+    try:
+        acquired = _acquired_job(ds)
+        drv = AggregationJobDriver(ds, None)
+        monkeypatch.setattr(
+            drv, "step_aggregation_job", lambda a: (_ for _ in ()).throw(exc)
+        )
+        before = metrics.job_step_back_total.get(reason=reason)
+        drv.stepper(acquired)  # must not raise
+        assert metrics.job_step_back_total.get(reason=reason) == before + 1
+        clock.advance(Duration(5))
+        (re,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        assert re.lease.attempts == 1  # attempt refunded
+    finally:
+        eph.cleanup()
+
+
+def test_leader_maps_helper_408_to_deadline_exceeded():
+    """The helper's conclusive DEADLINE_EXCEEDED_STATUS answer raises
+    DeadlineExceeded at the leader (→ step-back), not a generic job
+    failure, and is not retried."""
+    from janus_tpu.aggregator.aggregation_job_driver import (
+        AggregationJobDriver,
+        AggregationJobDriverConfig,
+    )
+    from janus_tpu.core.retries import Backoff
+    from janus_tpu.messages import (
+        AggregationJobId,
+        AggregationJobInitializeReq,
+        PartialBatchSelector,
+        Role,
+    )
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    class _DeadlineHttp:
+        last_response_headers: dict = {}
+
+        def __init__(self):
+            self.calls = 0
+
+        def _req(self, *a, **k):
+            self.calls += 1
+            return dl.DEADLINE_EXCEEDED_STATUS, b'{"status":408}'
+
+        put = post = _req
+
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+        .with_(helper_aggregator_endpoint="http://helper.test/")
+        .build()
+    )
+    http = _DeadlineHttp()
+    drv = AggregationJobDriver(
+        None, http, AggregationJobDriverConfig(http_backoff=Backoff.test())
+    )
+    req = AggregationJobInitializeReq(b"", PartialBatchSelector.time_interval(), ())
+    with pytest.raises(dl.DeadlineExceeded):
+        drv._send_agg_job_request(task, AggregationJobId(bytes(16)), "PUT", req)
+    assert http.calls == 1  # conclusive: never retried
+
+
+def test_handler_maps_deadline_exceeded_to_408(monkeypatch):
+    """A DeadlineExceeded escaping an aggregate handler answers the
+    conclusive 408 problem document, not a retryable 5xx."""
+    from janus_tpu.aggregator.http_handlers import DapHttpApp
+    from janus_tpu.messages import AggregationJobInitializeReq
+
+    app = DapHttpApp.__new__(DapHttpApp)
+
+    class _Admission:
+        def admit(self, route_class, deadline=None):
+            pass
+
+    monkeypatch.setattr(
+        DapHttpApp, "_ensure_ingest", lambda self: (None, _Admission())
+    )
+    monkeypatch.setattr(
+        DapHttpApp,
+        "h_aggregate_init",
+        lambda self, match, query, headers, body: (_ for _ in ()).throw(
+            dl.DeadlineExceeded("died in decrypt")
+        ),
+    )
+    tid = "A" * 43
+    jid = "B" * 22
+    status, ctype, body, *_ = app._handle(
+        "PUT",
+        f"/tasks/{tid}/aggregation_jobs/{jid}",
+        {},
+        {"Content-Type": AggregationJobInitializeReq.MEDIA_TYPE},
+        b"",
+    )
+    assert status == dl.DEADLINE_EXCEEDED_STATUS
+    assert ctype == "application/problem+json"
+    import json
+
+    assert json.loads(body)["status"] == dl.DEADLINE_EXCEEDED_STATUS
+
+
+def test_helper_sheds_expired_deadline_before_crypto(monkeypatch):
+    """End-to-end handler path: an aggregate-init whose propagated
+    deadline is already dead (expired while queued) sheds 503 with the
+    deadline_expired reason BEFORE reaching the handler body."""
+    from janus_tpu.aggregator.http_handlers import DapHttpApp
+    from janus_tpu.ingest.admission import AdmissionConfig, AdmissionController
+    from janus_tpu.messages import AggregationJobInitializeReq
+
+    app = DapHttpApp.__new__(DapHttpApp)
+    adm = AdmissionController(AdmissionConfig())
+    monkeypatch.setattr(DapHttpApp, "_ensure_ingest", lambda self: (None, adm))
+    reached = []
+    monkeypatch.setattr(
+        DapHttpApp,
+        "h_aggregate_init",
+        lambda self, match, query, headers, body: reached.append(1)
+        or (200, "text/plain", b""),
+    )
+    tid = "A" * 43
+    jid = "B" * 22
+    before = metrics.upload_shed_counter.get(route="aggregate", reason="deadline_expired")
+    # remaining 0.05s, but the request sat 10s in the accept queue
+    dl.set_request_queue_age(10.0)
+    try:
+        result = app._handle(
+            "PUT",
+            f"/tasks/{tid}/aggregation_jobs/{jid}",
+            {},
+            {
+                "Content-Type": AggregationJobInitializeReq.MEDIA_TYPE,
+                dl.DEADLINE_HEADER: "0.05",
+            },
+            b"",
+        )
+    finally:
+        dl.set_request_queue_age(0.0)
+    assert result[0] == 503
+    assert reached == []  # shed before any handler/crypto work
+    assert (
+        metrics.upload_shed_counter.get(route="aggregate", reason="deadline_expired")
+        == before + 1
+    )
+    # a live budget goes through (and the scope is set for the handler)
+    result = app._handle(
+        "PUT",
+        f"/tasks/{tid}/aggregation_jobs/{jid}",
+        {},
+        {
+            "Content-Type": AggregationJobInitializeReq.MEDIA_TYPE,
+            dl.DEADLINE_HEADER: "30",
+        },
+        b"",
+    )
+    assert result[0] == 200 and reached == [1]

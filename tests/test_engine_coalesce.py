@@ -154,6 +154,94 @@ def test_concurrent_jobs_match_serial(kind):
         assert agg[: len(want)] == [int(x) for x in want]
 
 
+def test_coalesced_cross_job_masked_aggregate_excludes_neighbors():
+    """The cross-JOB form of the window invariant (ISSUE 8 satellite,
+    round-5 advisory): force a REAL coalesced round — several jobs'
+    rows landing in ONE shared device buffer — where every neighbor row
+    carries a nonzero out-share and each job additionally REJECTS one
+    of its own lanes. Each job's masked aggregate over its
+    [offset, offset+n) view must equal exactly its own accepted rows:
+    neighbor rows inside the dynamic-slice window (offset+bucket often
+    covers several neighbors at these sizes) must never leak in, and a
+    job's own rejected lane must stay out."""
+    inst = VdafInstance.sum_vec(length=3, bits=2)
+    engine = EngineCache(inst, VK)
+    jf = engine.p3.jf
+    p = jf.MODULUS
+    n_jobs, n = 5, 4
+    rng = np.random.default_rng(7)
+    jobs = []
+    for j in range(n_jobs):
+        meas = [[int(x) for x in rng.integers(1, 4, size=3)] for _ in range(n)]
+        args, m = make_report_batch(inst, meas, seed=300 + j)
+        jobs.append((args, m))
+    # per-job masks with one rejected lane each (different positions)
+    masks = [np.array([i != (j % n) for i in range(n)]) for j in range(n_jobs)]
+
+    serial = []
+    for (args, m), mask in zip(jobs, masks):
+        nonce, public, meas_v, proof, blind0, seeds, blind1 = args
+        out0, _, _, _ = engine.leader_init(nonce, public, meas_v, proof, blind0)
+        serial.append(engine.aggregate(out0, mask))
+
+    # force one coalesced round: gate the leader round until all submit
+    gate = threading.Event()
+    orig = engine._run_leader_round
+
+    def gated(args_list, ns):
+        gate.wait(5)
+        return orig(args_list, ns)
+
+    engine._co_leader._run = gated
+    engine._co_leader.rounds.clear()
+    with ThreadPoolExecutor(max_workers=n_jobs) as pool:
+        futs = [
+            pool.submit(
+                lambda a: engine.leader_init(a[0], a[1], a[2], a[3], a[4]),
+                args,
+            )
+            for args, _ in jobs
+        ]
+        import time
+
+        time.sleep(0.3)
+        gate.set()
+        outs = [f.result(timeout=120) for f in futs]
+    assert max(engine._co_leader.rounds) > 1, engine._co_leader.rounds
+    # the coalesced out-shares genuinely share one buffer (offset views)
+    from janus_tpu.aggregator.engine_cache import DeviceRows
+
+    device_rows = [o[0] for o in outs if isinstance(o[0], DeviceRows)]
+    assert len({id(d.value[0]) for d in device_rows}) < len(device_rows) or any(
+        d.offset for d in device_rows
+    )
+
+    # each job's masked aggregate over its view of the SHARED buffer
+    # equals its solo-dispatch reference: no neighbor leak, no own
+    # rejected lane (the leader aggregate is one additive share, so the
+    # plaintext check rides the two-party closure below)
+    for (out0, *_), mask, want in zip(outs, masks, serial):
+        got = engine.aggregate(out0, mask)
+        assert got == want
+
+    # full two-party closure for one job: masked sum over accepted rows
+    args, m = jobs[0]
+    nonce, public, meas_v, proof, blind0, seeds, blind1 = args
+    out0, _, ver0, part0 = engine.leader_init(nonce, public, meas_v, proof, blind0)
+    out1, ok_mask, _ = engine.helper_init(
+        nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+    )
+    assert np.asarray(ok_mask).all()
+    mask = masks[0]
+    agg0 = engine.aggregate(out0, mask)
+    agg1 = engine.aggregate(out1, mask)
+    total = [(a + b) % p for a, b in zip(agg0, agg1)]
+    cols = np.asarray(m, dtype=object)
+    assert total == [
+        int(sum(int(cols[i][k]) for i in range(n) if mask[i]) % p) for k in range(3)
+    ]
+
+
 @pytest.mark.parametrize("offset", [0, 8, 40])
 def test_coalesced_view_never_leaks_neighbor_rows(offset):
     """Window invariant (round-5 advisory): a job's masked aggregate

@@ -121,6 +121,79 @@ def test_timeout_action_raises_site_timeout():
         failpoints.hit("x.y")
 
 
+def test_hang_spec_grammar_and_budget():
+    fps = failpoints.parse_spec("a.b=hang;c.d=hang:2.5,count=1")
+    assert fps["a.b"].action == "hang"
+    assert fps["a.b"].arg == 0.0  # default: forever (stopper-released)
+    assert fps["a.b"].prob == 1.0  # arg is seconds, not probability
+    assert fps["c.d"].arg == pytest.approx(2.5)
+    assert fps["c.d"].count == 1
+    # budgets apply like any other action: one firing, then inert
+    failpoints.configure("x.y=hang:0.01,count=1")
+    t0 = time.monotonic()
+    failpoints.hit("x.y")
+    assert time.monotonic() - t0 >= 0.01
+    t0 = time.monotonic()
+    failpoints.hit("x.y")  # budget spent: no park
+    assert time.monotonic() - t0 < 0.01
+
+
+def test_hang_bounded_parks_then_continues():
+    """hang:S is a delay that models a device answering late: nothing
+    is raised when the park ends."""
+    failpoints.configure("x.y=hang:0.05")
+    t0 = time.monotonic()
+    failpoints.hit("x.y")  # no exception
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_hang_forever_released_by_disarm_resumes():
+    """A registry reconfigure/disarm releases a forever-hang and the
+    site RESUMES — the modeled device finally answered."""
+    import threading
+
+    failpoints.configure("x.y=hang")
+    done = threading.Event()
+
+    def park():
+        failpoints.hit("x.y")
+        done.set()
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not done.is_set()  # genuinely parked
+    failpoints.clear()
+    assert done.wait(5)
+    t.join(5)
+
+
+def test_hang_released_by_stopper_raises():
+    """The process-stopper release (release_hangs, wired to SIGTERM and
+    janus_main teardown) RAISES at the site: a thread woken during
+    teardown must not resume real device work while the interpreter
+    finalizes underneath it (that segfaulted inside native XLA)."""
+    import threading
+
+    failpoints.configure("x.y=hang")
+    outcome: dict = {}
+
+    def park():
+        try:
+            failpoints.hit("x.y")
+            outcome["r"] = "resumed"
+        except FailpointError:
+            outcome["r"] = "raised"
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not outcome  # genuinely parked
+    failpoints.release_hangs()
+    t.join(5)
+    assert outcome.get("r") == "raised"
+
+
 def test_scoped_hit_targets_one_transaction():
     failpoints.configure("datastore.commit.step_agg_job_write=error:1")
     failpoints.hit_scoped("datastore.commit", "upload_batch")  # different scope
@@ -346,3 +419,38 @@ def test_engine_dispatch_oom_rides_recovery_path():
     assert bool(mask.all())
     assert eng._host_fallback is None  # recovered by retry, not fallback
     assert failpoints.status()["failpoints"]["engine.dispatch"]["fired"] == 1
+
+
+def test_engine_dispatch_hang_rides_watchdog_quarantine_path():
+    """engine.dispatch=hang under an ambient deadline models the wedged
+    XLA dispatch: the watchdog abandons it at the deadline, the engine
+    quarantines, and DeviceHangError reaches the caller (the job
+    drivers' step-back signal) instead of an unbounded park."""
+    import numpy as np
+
+    from janus_tpu.aggregator import device_watchdog
+    from janus_tpu.aggregator.engine_cache import DeviceHangError, EngineCache
+    from janus_tpu.core.deadline import deadline_scope
+    from janus_tpu.vdaf.registry import VdafInstance
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    inst = VdafInstance.count()
+    rng = np.random.default_rng(3)
+    (nonce, public, meas, proof, blind0, *_), _ = make_report_batch(
+        inst, random_measurements(inst, 4, rng), seed=4
+    )
+    eng = EngineCache(inst, bytes(range(16)))
+    eng.QUARANTINE_CANARY_DELAY_SECS = 30.0  # keep the canary out of this test
+    eng.leader_init(nonce, public, meas, proof, blind0)  # compile first
+    failpoints.configure("engine.dispatch=hang,count=1")
+    try:
+        t0 = time.monotonic()
+        with deadline_scope(time.monotonic() + 0.3):
+            with pytest.raises(DeviceHangError):
+                eng.leader_init(nonce, public, meas, proof, blind0)
+        assert time.monotonic() - t0 < 5.0  # bounded by the deadline
+        assert eng._quarantined is True
+    finally:
+        failpoints.clear()  # unparks the abandoned worker
+        time.sleep(0.05)
+        device_watchdog.WATCHDOG.reset_for_tests()

@@ -211,6 +211,30 @@ def test_bench_dry_run_smoke():
     assert dbout["uploads_all_acked_ok"] is True, dbout["upload_errors"]
     assert dbout["exactly_once_ok"] is True
     assert dbout["collected_count"] == dbout["admitted"]
+    # deadline-aware device path (ISSUE 8): the disarmed dispatch
+    # watchdog is one contextvar read — the acceptance bound is
+    # ≤ 1 µs/dispatch (the record carries the real numbers)
+    wd = rec["watchdog_overhead"]
+    assert 0 <= wd["disarmed_overhead_ns"] < 1_000, wd
+    assert wd["armed_ns_per_dispatch"] > 0
+    # device-hang chaos smoke (chaos_run.py --scenario device_hang):
+    # with engine.dispatch=hang armed in the REAL driver binary, the
+    # hung step releases its lease BEFORE expiry (watchdog abandon +
+    # step-back, never a TTL burn), the engine runs quarantined →
+    # canary-probed → restored observed live via /metrics + /statusz
+    # (incl. the stalled-thread stack dump), the abandoned-thread count
+    # stays under the cap, interim work lands through host fallback,
+    # and the final collection equals the admitted ground truth exactly
+    dh = rec["device_hang_smoke"]
+    assert dh.get("ok") is True, dh
+    assert dh["lease_bounded_ok"] is True
+    assert dh["hung_dispatch_ok"] and dh["stepped_back_device_hang_ok"]
+    assert dh["quarantined_observed_ok"] and dh["quarantine_cycle_ok"]
+    assert dh["restored_ok"] is True
+    assert dh["abandoned_under_cap_ok"] and dh["stalled_stack_ok"]
+    assert dh["drain_ok"] is True
+    assert dh["exactly_once_ok"] is True
+    assert dh["collected_count"] == dh["admitted"]
 
 
 def test_collect_cli_end_to_end(capsys):
