@@ -227,12 +227,17 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             ),
         )
         driver = AggregationJobDriver(leader_eph.datastore, http)
-        # two workers: job B's host->device staging transfer overlaps
-        # job A's helper round trip + datastore writes
+        # the production stepper: the stage pipeline (ISSUE 9) — job
+        # B's read+staging and HTTP legs overlap job A's device phases
+        # behind the serialized device lane
+        from janus_tpu.aggregator.step_pipeline import StepPipeline, StepPipelineConfig
+
+        pipeline = StepPipeline(driver, StepPipelineConfig())
         jd = JobDriver(
-            JobDriverConfig(max_concurrent_job_workers=2),
+            JobDriverConfig(max_concurrent_job_workers=4),
             driver.acquirer(),
             driver.stepper,
+            pipeline=pipeline,
         )
         t0 = _time.time()
         creator.run_once()
@@ -240,6 +245,15 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             progress["t"] = time.monotonic()
         aggregate_s = _time.time() - t0
         progress["t"] = time.monotonic()
+        # p50/p95 aggregation-job step latency from the flight-recorder
+        # digest (PR 5) — BASELINE's second metric, read BEFORE the
+        # collection driver adds its own job.step observations
+        from janus_tpu import trace as _tr
+
+        _step_digest = (
+            _tr.flight_recorder().snapshot(recent_limit=0)["digests"].get("job.step")
+        )
+        step_pipeline_status = pipeline.status()
 
         collector = Collector(
             CollectorParameters(
@@ -296,6 +310,26 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             "ingest_pipeline_rps": round(len(sample) / pipeline_s, 2),
             "ingest_pipeline_speedup": round(serial_path_s / pipeline_s, 2),
             "served_aggregate_rps": round(n_reports / aggregate_s, 2),
+            # BASELINE's second metric: aggregation-job step latency
+            # quantiles, sourced from the flight-recorder digests
+            "agg_job_step_latency": (
+                {
+                    "p50_s": _step_digest["p50_s"],
+                    "p95_s": _step_digest["p95_s"],
+                    "mean_s": _step_digest["mean_s"],
+                    "count": _step_digest["count"],
+                }
+                if _step_digest
+                else None
+            ),
+            # stage-pipeline overlap proof for the measured form of the
+            # step_pipeline record (the dry-run form rides pipeline_smoke)
+            "step_pipeline": {
+                "overlap_ratio": step_pipeline_status["overlap_ratio"],
+                "overlapped_dispatches": step_pipeline_status["overlapped_dispatches"],
+                "device_lane_busy_ratio": step_pipeline_status["device_lane"]["busy_ratio"],
+                "device_lane_dispatches": step_pipeline_status["device_lane"]["dispatches"],
+            },
             "collect_s": round(collect_s, 2),
             "metrics_scrape_valid": scrape_ok,
             **({"metrics_scrape_errors": scrape_errors} if scrape_errors else {}),
@@ -308,6 +342,10 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             "metrics_snapshot": _metrics_snapshot_rider(),
         }
     finally:
+        try:
+            pipeline.close()
+        except NameError:
+            pass  # failed before the aggregate phase built it
         leader_srv.stop()
         helper_srv.stop()
         leader_eph.cleanup()
@@ -1211,6 +1249,196 @@ def _failpoint_overhead(iters: int = 200_000) -> dict:
     }
 
 
+def _codec_speed_record(inst=None, batch: int = 2048) -> dict:
+    """Measured leader<->helper wire-codec speed (ISSUE 9 acceptance:
+    columnar >= 5x the per-report loop at batch >= 1024, bit-identical
+    bytes). Builds a prepare-shaped init request two ways — the
+    pre-ISSUE-9 per-report loop (encode_field_rows rows ->
+    encode_prep_share_raw -> encode_pingpong -> PrepareInit dataclasses
+    -> items encode) and the columnar path (one vectorized framing pass
+    + PreEncoded splices) — asserts the request bytes are IDENTICAL,
+    and times both; the response side (AggregationJobResp.from_bytes vs
+    decode_prepare_resps_fast) rides along."""
+    import secrets
+    import time as _time
+
+    import numpy as np
+
+    from janus_tpu.messages import (
+        AggregationJobInitializeReq,
+        AggregationJobResp,
+        HpkeCiphertext,
+        HpkeConfigId,
+        PartialBatchSelector,
+        PreEncoded,
+        PrepareInit,
+        PrepareResp,
+        PrepareStepResult,
+        ReportId,
+        ReportMetadata,
+        ReportShare,
+        Time,
+        decode_prepare_resps_fast,
+        encode_report_share_raw,
+    )
+    from janus_tpu.vdaf.registry import VdafInstance, circuit_for
+    from janus_tpu.vdaf.wire import (
+        PP_FINISH,
+        PP_INITIALIZE,
+        Prio3Wire,
+        encode_field_rows,
+        encode_pingpong,
+        encode_pingpong_share_column,
+    )
+
+    if inst is None or inst.kind == "poplar1":
+        inst = VdafInstance.histogram(10)
+    circ = circuit_for(inst)
+    wire = Prio3Wire(circ)
+
+    class _JF:
+        LIMBS = circ.FIELD.ENCODED_SIZE // 8
+        MODULUS = circ.FIELD.MODULUS
+
+    jf = _JF()
+    rng = np.random.default_rng(0xC0DEC)
+    n = batch
+    v = circ.verifier_len
+    ver0 = tuple(
+        rng.integers(0, 1 << 31, size=(n, v), dtype=np.uint64)
+        for _ in range(jf.LIMBS)
+    )
+    part0 = (
+        rng.integers(0, 1 << 63, size=(n, 2), dtype=np.uint64)
+        if wire.uses_jr
+        else None
+    )
+    # stored-report columns shared by both paths (the driver reads
+    # these from the datastore rows)
+    rids = [secrets.token_bytes(16) for _ in range(n)]
+    t = Time(1_600_000_000)
+    pub = secrets.token_bytes(wire.public_share_len)
+    ct = HpkeCiphertext(
+        HpkeConfigId(1),
+        secrets.token_bytes(32),
+        secrets.token_bytes(wire.helper_share_len + 44),
+    )
+    pbs = PartialBatchSelector.time_interval()
+
+    def loop_path() -> bytes:
+        ver_rows = encode_field_rows(jf, ver0)
+        part_rows = (
+            [row.tobytes() for row in np.asarray(part0, dtype="<u8")]
+            if wire.uses_jr
+            else [None] * n
+        )
+        prep_inits = []
+        for i in range(n):
+            prep_share = wire.encode_prep_share_raw(ver_rows[i], part_rows[i])
+            prep_inits.append(
+                PrepareInit(
+                    ReportShare(ReportMetadata(ReportId(rids[i]), t), pub, ct),
+                    encode_pingpong(PP_INITIALIZE, None, prep_share),
+                )
+            )
+        return AggregationJobInitializeReq(b"", pbs, tuple(prep_inits)).to_bytes()
+
+    def columnar_path() -> bytes:
+        frames = encode_pingpong_share_column(jf, ver0, part0)
+        items = tuple(
+            PreEncoded(
+                encode_report_share_raw(rids[i], t.seconds, pub, ct) + frames.row(i)
+            )
+            for i in range(n)
+        )
+        return AggregationJobInitializeReq(b"", pbs, items).to_bytes()
+
+    identical = loop_path() == columnar_path()
+
+    def timed(fn) -> float:
+        t0 = _time.perf_counter()
+        fn()
+        return _time.perf_counter() - t0
+
+    def paired(slow_fn, fast_fn, iters: int = 15):
+        # measure in INTERLEAVED pairs with GC paused and take the
+        # median per-pair ratio: the two paths must see the same CPU
+        # frequency / cache / scheduler conditions, or whole-run drift
+        # lands on one side and the acceptance gate flakes (observed
+        # a 4.9x outlier from separate-block best-of-N against a 6.5x
+        # steady state)
+        import gc
+        import statistics
+
+        slow_ts, fast_ts, ratios = [], [], []
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            timed(slow_fn), timed(fast_fn)  # warm first-touch pages
+            for _ in range(iters):
+                s = timed(slow_fn)
+                f = timed(fast_fn)
+                slow_ts.append(s)
+                fast_ts.append(f)
+                ratios.append(s / f)
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        return min(slow_ts), min(fast_ts), statistics.median(ratios)
+
+    enc_loop_s, enc_col_s, enc_ratio = paired(loop_path, columnar_path)
+
+    # response side: the helper's typical 1-round answer per report
+    msg = encode_pingpong(PP_FINISH, b"x" * 16, None)
+    body = AggregationJobResp(
+        tuple(
+            PrepareResp(ReportId(r), PrepareStepResult.cont(msg)) for r in rids
+        )
+    ).to_bytes()
+    dec_loop_s, dec_col_s, dec_ratio = paired(
+        lambda: AggregationJobResp.from_bytes(body),
+        lambda: decode_prepare_resps_fast(body),
+    )
+    # content equivalence, not just count: the record's claim must be
+    # the one tests/test_wire_columnar.py pins
+    ref = AggregationJobResp.from_bytes(body)
+    col = decode_prepare_resps_fast(body)
+    decoded_identical = (
+        col.report_ids == [r.report_id.data for r in ref.prepare_resps]
+        and list(col.kinds) == [r.result.kind for r in ref.prepare_resps]
+        and col.messages == [r.result.message for r in ref.prepare_resps]
+        and col.errors == [r.result.prepare_error for r in ref.prepare_resps]
+    )
+
+    return {
+        "vdaf": inst.kind,
+        "batch": n,
+        "wire_bytes_identical": identical,
+        "decode_roundtrip_ok": decoded_identical,
+        "encode_us_per_report_loop": round(enc_loop_s / n * 1e6, 3),
+        "encode_us_per_report_columnar": round(enc_col_s / n * 1e6, 3),
+        "encode_speedup": round(enc_ratio, 2),
+        "decode_us_per_report_loop": round(dec_loop_s / n * 1e6, 3),
+        "decode_us_per_report_columnar": round(dec_col_s / n * 1e6, 3),
+        "decode_speedup": round(dec_ratio, 2),
+    }
+
+
+def _pipeline_smoke() -> dict:
+    """Stage-pipeline overlap smoke (scripts/chaos_run.py --scenario
+    pipeline --smoke): the REAL driver binary with the pipelined
+    stepper (the default) steps many small jobs against a loopback
+    helper whose RTT is stretched by a delay failpoint; the smoke
+    asserts overlap actually happened — the device lane was busy while
+    an HTTP leg was in flight (janus_step_pipeline_overlap_total > 0,
+    overlap ratio > 0 recorded), stage metrics populated, SIGTERM
+    drain clean, and the final collection exactly equals the admitted
+    ground truth."""
+    return _run_chaos_subprocess(
+        ["--scenario", "pipeline", "--smoke", "--json"], timeout=300
+    )
+
+
 def _run_chaos_subprocess(extra_args: list, timeout: float) -> dict:
     """Run scripts/chaos_run.py with `extra_args` and return its JSON
     record. A hung/garbled/failed harness degrades to an ok:false
@@ -1397,6 +1625,11 @@ def run_dry(args, ap) -> None:
                 "chaos_smoke": _chaos_smoke(),
                 "db_outage_smoke": _db_outage_smoke(),
                 "device_hang_smoke": _device_hang_smoke(),
+                # ISSUE 9: columnar wire codec vs the per-report loop
+                # (bit-identical bytes asserted) + the stage-pipeline
+                # overlap proof against the REAL driver binary
+                "step_pipeline": {"codec": _codec_speed_record(inst)},
+                "pipeline_smoke": _pipeline_smoke(),
             }
         )
     )
@@ -1814,6 +2047,26 @@ def main() -> None:
         # the span() hot path claims to be near-free; measure it in the
         # same record the throughput numbers live in
         riders["tracing_overhead"] = _tracing_overhead()
+    except Exception:
+        pass
+    try:
+        # ISSUE 9: measured step_pipeline record — codec speed on this
+        # config's circuit, plus the overlap numbers from the served
+        # phase when it ran (the dry-run form gets them from
+        # pipeline_smoke against the real driver binary)
+        riders["step_pipeline"] = {
+            "codec": _codec_speed_record(inst),
+            **(
+                {
+                    "overlap_ratio": served["step_pipeline"]["overlap_ratio"],
+                    "device_lane_busy_ratio": served["step_pipeline"][
+                        "device_lane_busy_ratio"
+                    ],
+                }
+                if served and served.get("step_pipeline")
+                else {}
+            ),
+        }
     except Exception:
         pass
     if args.mode != "served":

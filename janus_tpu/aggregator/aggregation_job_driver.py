@@ -19,7 +19,7 @@ init idempotently (helper request-hash dedup).
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -30,7 +30,12 @@ from ..core.circuit_breaker import (
     default_breakers,
     peer_label,
 )
-from ..core.deadline import DEADLINE_EXCEEDED_STATUS, DeadlineExceeded, deadline_scope
+from ..core.deadline import (
+    DEADLINE_EXCEEDED_STATUS,
+    DeadlineExceeded,
+    current_deadline,
+    deadline_scope,
+)
 from ..core.retries import Backoff, RequestAborted, retry_http_request
 from ..datastore.models import (
     AcquiredAggregationJob,
@@ -46,12 +51,14 @@ from ..messages import (
     AggregationJobStep,
     Duration,
     PartialBatchSelector,
-    PrepareContinue,
+    PreEncoded,
     PrepareError,
     PrepareInit,
     PrepareStepResult,
     ReportShare,
     ReportMetadata,
+    decode_prepare_resps_fast,
+    encode_report_share_raw,
 )
 from ..messages.codec import DecodeError
 from ..task import Task
@@ -65,6 +72,8 @@ from ..vdaf.wire import (
     decode_pingpong,
     encode_field_rows,
     encode_pingpong,
+    encode_pingpong_share_column,
+    pingpong_finish_frame_matches,
     seeds_to_lanes,
 )
 from .accumulator import Accumulator, accumulate_batched, fixed_size_batch_id
@@ -94,6 +103,43 @@ class AggregationJobDriverConfig:
     # floor for the breaker-open step-back reacquire delay so a job
     # whose cooldown is nearly over doesn't spin acquire/step-back
     min_step_back_delay_s: int = 1
+
+
+@dataclass
+class InitStepState:
+    """Carrier of one prio3 init step through the stage chain. The
+    serial stepper and the step_pipeline schedule the SAME stage
+    methods over this state, so the two execution modes cannot drift:
+    stage_init fills the staging columns, device_init the device
+    outputs, http_init the accept/continue columns, and the commit
+    stages consume them."""
+
+    acquired: AcquiredAggregationJob
+    task: Task
+    job: object
+    pending: list
+    reports: dict
+    wire: Prio3Wire
+    engine: object
+    multi_round: bool
+    # columnar staging (host prefetch stage)
+    meas: object = None
+    proof: object = None
+    nonce_lanes: object = None
+    blind_lanes: object = None
+    public_parts: object = None
+    ok: object = None
+    failed: list = field(default_factory=list)
+    # device init outputs (device lane)
+    out0: object = None
+    seed0: object = None
+    ver0: object = None
+    part0: object = None
+    # HTTP leg outputs
+    accept: object = None
+    continue_msgs: list | None = None
+    # accumulate output (device lane)
+    accumulator: Accumulator | None = None
 
 
 class AggregationJobDriver:
@@ -149,41 +195,8 @@ class AggregationJobDriver:
             return
         try:
             self.step_aggregation_job(acquired)
-        except CircuitOpenError as e:
-            # the helper's circuit is open: not this job's fault — step
-            # back (release the lease with the cooldown as backoff,
-            # refund the attempt) instead of failing the step
-            self.step_back(
-                acquired,
-                "circuit_open",
-                max(e.retry_in_s, self.cfg.min_step_back_delay_s),
-            )
-        except RequestAborted:
-            # shutdown drain: hand the lease back immediately
-            self.step_back(acquired, "shutdown_drain", 0.0)
-        except DeadlineExceeded:
-            # the lease budget died (expired lease, retry loop past the
-            # bound, or the helper answered the conclusive 408): dead
-            # work is dropped here and redone under a fresh lease —
-            # never amplified by burning the attempt ledger
-            self.step_back(acquired, "deadline_expired", 0.0)
-        except DeviceHangError:
-            # the device dispatch hung and was abandoned; the engine is
-            # quarantined (host fallback serves the retry) — not this
-            # job's fault, step back with a short reacquire delay
-            self.step_back(
-                acquired, "device_hang", self.cfg.min_step_back_delay_s
-            )
         except Exception as e:
-            from .job_driver import datastore_reconnect_delay_s, is_datastore_connection_error
-
-            if is_datastore_connection_error(self.ds, e):
-                # datastore outage mid-step: not this job's fault —
-                # step back with the reconnect cooldown (best effort;
-                # if the step-back tx also fails, the lease ages out)
-                self.step_back(
-                    acquired, "datastore_down", datastore_reconnect_delay_s(self.ds)
-                )
+            if self.handle_step_error(acquired, e):
                 return
             log.exception(
                 "aggregation job %s step failed (attempt %d)",
@@ -191,6 +204,52 @@ class AggregationJobDriver:
                 acquired.lease.attempts,
             )
             raise
+
+    def handle_step_error(self, acquired: AcquiredAggregationJob, e: Exception) -> bool:
+        """Map a step failure to the step-back / attempt-ledger
+        semantics. Returns True when the failure was translated into a
+        step-back (lease released early, attempt refunded) — the step
+        is NOT the job's fault and must not march it toward
+        abandonment. Shared by the serial stepper and every
+        step_pipeline stage, so a failure maps identically no matter
+        which stage thread it surfaced on."""
+        if isinstance(e, CircuitOpenError):
+            # the helper's circuit is open: release the lease with the
+            # cooldown as backoff instead of failing the step
+            self.step_back(
+                acquired,
+                "circuit_open",
+                max(e.retry_in_s, self.cfg.min_step_back_delay_s),
+            )
+            return True
+        if isinstance(e, RequestAborted):
+            # shutdown drain: hand the lease back immediately
+            self.step_back(acquired, "shutdown_drain", 0.0)
+            return True
+        if isinstance(e, DeadlineExceeded):
+            # the lease budget died (expired lease, retry loop past the
+            # bound, or the helper answered the conclusive 408): dead
+            # work is dropped here and redone under a fresh lease —
+            # never amplified by burning the attempt ledger
+            self.step_back(acquired, "deadline_expired", 0.0)
+            return True
+        if isinstance(e, DeviceHangError):
+            # the device dispatch hung and was abandoned; the engine is
+            # quarantined (host fallback serves the retry) — not this
+            # job's fault, step back with a short reacquire delay
+            self.step_back(acquired, "device_hang", self.cfg.min_step_back_delay_s)
+            return True
+        from .job_driver import datastore_reconnect_delay_s, is_datastore_connection_error
+
+        if is_datastore_connection_error(self.ds, e):
+            # datastore outage mid-step: step back with the reconnect
+            # cooldown (best effort; if the step-back tx also fails,
+            # the lease ages out)
+            self.step_back(
+                acquired, "datastore_down", datastore_reconnect_delay_s(self.ds)
+            )
+            return True
+        return False
 
     def step_back(
         self, acquired: AcquiredAggregationJob, reason: str, delay_s: float
@@ -279,9 +338,15 @@ class AggregationJobDriver:
             public_parts = None
         return meas, proof, nonce_lanes, blind_lanes, public_parts, ok, failed
 
-    # --- the step (reference :102-726) ---
-    def step_aggregation_job(self, acquired: AcquiredAggregationJob) -> None:
-        # tx1: read everything (reference :144-233)
+    # --- the step (reference :102-726), decomposed into the stage
+    # methods the step_pipeline schedules across its executors. The
+    # serial path below composes exactly the same stages in order, so
+    # the pipelined and classic steppers cannot drift apart. ---
+    def read_job(self, acquired: AcquiredAggregationJob):
+        """tx1: read everything (reference :144-233). Runs on the
+        pipeline's prefetch stage — job k+1's read overlaps job k's
+        device/HTTP phases."""
+
         def read(tx):
             task = tx.get_task(acquired.task_id)
             job = tx.get_aggregation_job(acquired.task_id, acquired.job_id)
@@ -294,15 +359,23 @@ class AggregationJobDriver:
                     )
             return task, job, ras, reports
 
-        from ..trace import span, use_traceparent
+        from ..trace import span
 
         with span("driver.read_tx"):
-            task, job, ras, reports = self.ds.run_tx(read, "step_agg_job_read")
+            return self.ds.run_tx(read, "step_agg_job_read")
+
+    def release_job(self, acquired: AcquiredAggregationJob) -> None:
+        self.ds.run_tx(lambda tx: tx.release_aggregation_job(acquired), "release")
+
+    def step_aggregation_job(self, acquired: AcquiredAggregationJob) -> None:
+        task, job, ras, reports = self.read_job(acquired)
         if job is None or task is None:
             raise RuntimeError("job or task vanished while leased")
         if job.state != AggregationJobState.IN_PROGRESS:
-            self.ds.run_tx(lambda tx: tx.release_aggregation_job(acquired), "release")
+            self.release_job(acquired)
             return
+
+        from ..trace import use_traceparent
 
         # adopt the trace the job's CREATOR persisted in the row: every
         # span below (stage/encode/http/engine/write — and the helper's
@@ -317,34 +390,60 @@ class AggregationJobDriver:
         ):
             self._step_leased_job(acquired, task, job, ras, reports)
 
-    def _step_leased_job(self, acquired, task, job, ras, reports) -> None:
-        from ..trace import span
-
-        # multi-round jobs park accepted reports in WaitingLeader after
-        # init; a later step sends the continue request (reference
-        # :439-514 CONTINUE path)
-        waiting = [ra for ra in ras if ra.state == ReportAggregationState.WAITING_LEADER]
+    def plan_step(self, acquired, task, job, ras):
+        """Classify the leased step -> (kind, payload): 'continue'
+        (WaitingLeader rows), 'poplar1', 'empty', or 'init' (the
+        pipelined prio3 hot path) with the rows the stage works on."""
+        waiting = [
+            ra for ra in ras if ra.state == ReportAggregationState.WAITING_LEADER
+        ]
         if waiting:
-            self._continue_step(acquired, task, job, waiting)
-            return
-
+            return "continue", waiting
         pending = [ra for ra in ras if ra.state == ReportAggregationState.START]
         if task.vdaf.kind == "poplar1":
-            self._step_poplar1_init(acquired, task, job, pending, reports)
+            return "poplar1", pending
+        if not pending:
+            return "empty", pending
+        return "init", pending
+
+    def _step_leased_job(self, acquired, task, job, ras, reports) -> None:
+        kind, rows = self.plan_step(acquired, task, job, ras)
+        if kind == "continue":
+            # multi-round jobs park accepted reports in WaitingLeader
+            # after init; a later step sends the continue request
+            # (reference :439-514 CONTINUE path)
+            self._continue_step(acquired, task, job, rows)
             return
+        if kind == "poplar1":
+            self._step_poplar1_init(acquired, task, job, rows, reports)
+            return
+        if kind == "empty":
+            self.finish_empty(acquired, job)
+            return
+        st = self.stage_init(acquired, task, job, rows, reports)
+        self.device_init(st)
+        self.http_init(st)
+        if st.multi_round:
+            self.commit_park(st)
+        else:
+            self.device_accumulate(st)
+            self.commit_finish(st)
+
+    def finish_empty(self, acquired, job) -> None:
+        # nothing to do; mark job finished
+        def finish(tx):
+            tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
+            tx.release_aggregation_job(acquired)
+
+        self.ds.run_tx(finish, "step_agg_job_finish_empty")
+
+    def stage_init(self, acquired, task, job, pending, reports) -> "InitStepState":
+        """Host stage: columnar staging of stored leader shares into
+        device-ready arrays (prefetch stage under the pipeline)."""
+        from ..trace import span
 
         wire = Prio3Wire(circuit_for(task.vdaf))
         engine = engine_cache(task.vdaf, task.vdaf_verify_key)
-        if not pending:
-            # nothing to do; mark job finished
-            def finish_empty(tx):
-                tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
-                tx.release_aggregation_job(acquired)
-
-            self.ds.run_tx(finish_empty, "step_agg_job_finish_empty")
-            return
-
-        # columnar staging of stored leader shares
         n = len(pending)
         with span("driver.stage", batch=n):
             (
@@ -356,43 +455,73 @@ class AggregationJobDriver:
                 ok,
                 failed,
             ) = self._stage_pending(task, wire, engine, pending, reports)
-        jf = engine.p3.jf
-
-        # device: batched leader prepare-init (reference hot loop :329-402)
-        out0, seed0, ver0, part0 = engine.leader_init(
-            nonce_lanes, public_parts, meas, proof, blind_lanes, ok=ok
+        return InitStepState(
+            acquired=acquired,
+            task=task,
+            job=job,
+            pending=pending,
+            reports=reports,
+            wire=wire,
+            engine=engine,
+            multi_round=task.vdaf.rounds > 1,
+            meas=meas,
+            proof=proof,
+            nonce_lanes=nonce_lanes,
+            blind_lanes=blind_lanes,
+            public_parts=public_parts,
+            ok=ok,
+            failed=failed,
         )
 
-        # build + send the init request (reference :404-424)
+    def device_init(self, st: "InitStepState") -> None:
+        """Device stage: batched leader prepare-init (reference hot
+        loop :329-402). Owned by the pipeline's device lane."""
+        st.out0, st.seed0, st.ver0, st.part0 = st.engine.leader_init(
+            st.nonce_lanes, st.public_parts, st.meas, st.proof, st.blind_lanes, ok=st.ok
+        )
+
+    def http_init(self, st: "InitStepState") -> None:
+        """HTTP stage: columnar request framing, the helper round trip,
+        columnar response decode + host-side verification (reference
+        :404-424 build/send, :530-726 response processing)."""
+        from ..trace import span
+
+        acquired, task, job, pending, reports = (
+            st.acquired, st.task, st.job, st.pending, st.reports,
+        )
+        wire = st.wire
+        n = len(pending)
+        failed = st.failed
+        # one vectorized framing pass over the whole batch (ISSUE 9):
+        # the prep-share column becomes framed ping-pong messages in a
+        # single numpy pass, and each PrepareInit body is spliced from
+        # pre-encoded rows instead of running the Encoder per report
         with span("driver.encode_init", batch=n):
-            ver0_rows = encode_field_rows(jf, ver0)
-            part0_rows = (
-                [row.tobytes() for row in np.asarray(part0, dtype="<u8")]
-                if wire.uses_jr
-                else [None] * n
+            frames = encode_pingpong_share_column(
+                st.engine.p3.jf, st.ver0, st.part0 if wire.uses_jr else None
             )
             prep_inits = []
             send_idx = []
             for i, ra in enumerate(pending):
-                if failed[i] is not None or not ok[i]:
+                if failed[i] is not None or not st.ok[i]:
                     if failed[i] is None:
                         failed[i] = PrepareError.INVALID_MESSAGE
                     continue
                 rep = reports[ra.report_id.data]
-                prep_share = wire.encode_prep_share_raw(ver0_rows[i], part0_rows[i])
                 prep_inits.append(
-                    PrepareInit(
-                        ReportShare(
-                            ReportMetadata(ra.report_id, ra.client_time),
+                    PreEncoded(
+                        encode_report_share_raw(
+                            ra.report_id.data,
+                            ra.client_time.seconds,
                             rep.public_share,
                             rep.helper_encrypted_input_share,
-                        ),
-                        encode_pingpong(PP_INITIALIZE, None, prep_share),
+                        )
+                        + frames.row(i)
                     )
                 )
                 send_idx.append(i)
 
-        multi_round = task.vdaf.rounds > 1
+        multi_round = st.multi_round
         accept = np.zeros(n, dtype=bool)
         continue_msgs: list[bytes | None] = [None] * n
         if prep_inits:
@@ -402,29 +531,40 @@ class AggregationJobDriver:
                 tuple(prep_inits),
             )
             with span("driver.http_init", reports=len(prep_inits)):
-                resp = self._send_init_request(
-                    task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
+                body = self._send_init_request_raw(
+                    task, acquired.job_id, req, acquired=acquired
                 )
-            by_id = {pr.report_id: pr for pr in resp.prepare_resps}
+            col = decode_prepare_resps_fast(body)
+            mapping = self._match_resps(
+                [pending[i].report_id.data for i in send_idx], col
+            )
+            # jr seed rows for the order-aligned verify below, one
+            # vectorized conversion for the whole batch
+            seed_rows = (
+                np.ascontiguousarray(np.asarray(st.seed0, dtype="<u8")).view(np.uint8)
+                if wire.uses_jr and not multi_round
+                else None
+            )
             # process response (reference :530-726), host-side lane checks
             for k, i in enumerate(send_idx):
-                ra = pending[i]
-                pr = by_id.get(ra.report_id)
-                if pr is None:
+                j = k if mapping is None else mapping[k]
+                if j is None:
                     failed[i] = PrepareError.INVALID_MESSAGE
                     continue
-                if pr.result.kind == PrepareStepResult.REJECT:
-                    failed[i] = _err_or_default(pr.result.prepare_error)
+                kind = col.kinds[j]
+                if kind == PrepareStepResult.REJECT:
+                    failed[i] = _err_or_default(col.errors[j])
                     continue
-                if pr.result.kind not in (PrepareStepResult.CONTINUE, PrepareStepResult.FINISHED):
-                    failed[i] = PrepareError.INVALID_MESSAGE
-                    continue
+                msg = col.messages[j]
                 if multi_round:
                     # helper answered ping-pong CONTINUE; the leader's
                     # next message (sent on a later step) finishes with
                     # the combined prep message (fake: echo)
+                    if msg is None:
+                        failed[i] = PrepareError.INVALID_MESSAGE
+                        continue
                     try:
-                        tag, prep_msg, _share = decode_pingpong(pr.result.message)
+                        tag, prep_msg, _share = decode_pingpong(msg)
                     except DecodeError:
                         failed[i] = PrepareError.INVALID_MESSAGE
                         continue
@@ -435,16 +575,19 @@ class AggregationJobDriver:
                     accept[i] = True
                     continue
                 if wire.uses_jr:
-                    try:
-                        tag, prep_msg, _ = decode_pingpong(pr.result.message)
-                    except DecodeError:
+                    # the helper's answer must be finish(our jr seed):
+                    # a two-compare fast path over the raw frame (the
+                    # column decoder guarantees msg is exactly one
+                    # well-formed self-delimiting frame)
+                    verdict = (
+                        pingpong_finish_frame_matches(msg, seed_rows[i].tobytes())
+                        if msg is not None
+                        else None
+                    )
+                    if verdict is None:
                         failed[i] = PrepareError.INVALID_MESSAGE
                         continue
-                    if tag != PP_FINISH or prep_msg is None or len(prep_msg) != 16:
-                        failed[i] = PrepareError.INVALID_MESSAGE
-                        continue
-                    want = np.asarray(seed0[i], dtype="<u8").tobytes()
-                    if prep_msg != want:
+                    if verdict is False:
                         failed[i] = PrepareError.VDAF_PREP_ERROR
                         continue
                 accept[i] = True
@@ -457,62 +600,95 @@ class AggregationJobDriver:
                     accept[i] = False
                     failed[i] = PrepareError.VDAF_PREP_ERROR
 
-        if multi_round:
-            # park accepted reports as WaitingLeader(out_share || msg);
-            # job stays in progress — a later driver step sends the
-            # continue request (reference stores the transition the same
-            # way, models.rs:714 WaitingLeader)
-            import dataclasses
+        st.accept = accept
+        st.continue_msgs = continue_msgs
 
-            out0_rows = encode_field_rows(jf, out0)
-            new_ras = []
-            for i, ra in enumerate(pending):
-                if accept[i]:
-                    msg = continue_msgs[i]
-                    blob = len(msg).to_bytes(4, "big") + msg + out0_rows[i]
-                    new_ras.append(
-                        dataclasses.replace(
-                            ra,
-                            state=ReportAggregationState.WAITING_LEADER,
-                            prep_blob=blob,
-                        )
+    def _match_resps(self, sent_ids: list[bytes], col) -> list[int | None] | None:
+        """Order-aligned prepare-resp matching: DAP requires the helper
+        to answer in request order, so verify alignment cheaply (one
+        bytes compare per report, C speed) and skip the O(n) dict build.
+        Returns None when aligned (identity mapping); otherwise counts
+        the contract violation and falls back to the id->index dict."""
+        if len(col.report_ids) == len(sent_ids) and all(
+            a == b for a, b in zip(col.report_ids, sent_ids)
+        ):
+            return None
+        metrics.prep_resp_order_mismatch_total.add()
+        by_id = {rid: j for j, rid in enumerate(col.report_ids)}
+        return [by_id.get(rid) for rid in sent_ids]
+
+    def commit_park(self, st: "InitStepState") -> None:
+        """Commit stage, multi-round: park accepted reports as
+        WaitingLeader(out_share || msg); job stays in progress — a later
+        driver step sends the continue request (reference stores the
+        transition the same way, models.rs:714 WaitingLeader)."""
+        import dataclasses
+
+        out0_rows = encode_field_rows(st.engine.p3.jf, st.out0)
+        new_ras = []
+        for i, ra in enumerate(st.pending):
+            if st.accept[i]:
+                msg = st.continue_msgs[i]
+                blob = len(msg).to_bytes(4, "big") + msg + out0_rows[i]
+                new_ras.append(
+                    dataclasses.replace(
+                        ra,
+                        state=ReportAggregationState.WAITING_LEADER,
+                        prep_blob=blob,
                     )
-                else:
-                    err = _err_or_default(failed[i])
-                    metrics.aggregate_step_failure_counter.add(type=err.name.lower())
-                    new_ras.append(ra.failed(err))
+                )
+            else:
+                err = _err_or_default(st.failed[i])
+                metrics.aggregate_step_failure_counter.add(type=err.name.lower())
+                new_ras.append(ra.failed(err))
 
-            def write_waiting(tx):
-                for ra in new_ras:
-                    tx.update_report_aggregation(ra)
-                tx.release_aggregation_job(acquired)
+        acquired = st.acquired
 
-            self.ds.run_tx(write_waiting, "step_agg_job_park")
-            return
+        def write_waiting(tx):
+            for ra in new_ras:
+                tx.update_report_aggregation(ra)
+            tx.release_aggregation_job(acquired)
 
-        # masked accumulate (reference Accumulator::update :605-627)
-        accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
-        metadatas = [ReportMetadata(ra.report_id, ra.client_time) for ra in pending]
-        pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
-        fixed_bid = fixed_size_batch_id(pbs)
-        with span("driver.accumulate", batch=n):
+        self.ds.run_tx(write_waiting, "step_agg_job_park")
+
+    def device_accumulate(self, st: "InitStepState") -> None:
+        """Device stage: masked accumulate (reference
+        Accumulator::update :605-627). Owned by the device lane."""
+        from ..trace import span
+
+        st.accumulator = Accumulator(st.task, self.cfg.batch_aggregation_shard_count)
+        metadatas = [ReportMetadata(ra.report_id, ra.client_time) for ra in st.pending]
+        pbs = PartialBatchSelector.from_bytes(st.job.partial_batch_identifier)
+        with span("driver.accumulate", batch=len(st.pending)):
             accumulate_batched(
-                task, engine, accumulator, out0, accept, metadatas, batch_identifier=fixed_bid
+                st.task,
+                st.engine,
+                st.accumulator,
+                st.out0,
+                st.accept,
+                metadatas,
+                batch_identifier=fixed_size_batch_id(pbs),
             )
 
-        # tx2: write results + release (reference :698-724)
+    def commit_finish(self, st: "InitStepState") -> None:
+        """Commit stage: tx2 writes results + releases the lease
+        (reference :698-724)."""
+        from ..trace import span
+
+        acquired, job = st.acquired, st.job
         new_ras = []
-        for i, ra in enumerate(pending):
-            if accept[i]:
+        for i, ra in enumerate(st.pending):
+            if st.accept[i]:
                 new_ras.append(ra.finished())
             else:
-                err = _err_or_default(failed[i])
+                err = _err_or_default(st.failed[i])
                 metrics.aggregate_step_failure_counter.add(type=err.name.lower())
                 new_ras.append(ra.failed(err))
 
         # committing attempt's unmergeable set, carried out of the tx for
         # the post-commit e2e observation (run_tx may retry the closure)
         cell: dict = {}
+        accumulator = st.accumulator
 
         def write(tx):
             # flush first: reports whose batch was collected mid-flight
@@ -527,7 +703,7 @@ class AggregationJobDriver:
             tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
             tx.release_aggregation_job(acquired)
 
-        with span("driver.write_tx", batch=n):
+        with span("driver.write_tx", batch=len(st.pending)):
             self.ds.run_tx(write, "step_agg_job_write")
         # e2e SLO observed only AFTER the write committed: a failed step
         # retried under a fresh lease must not leave phantom samples
@@ -603,9 +779,7 @@ class AggregationJobDriver:
                 PartialBatchSelector.from_bytes(job.partial_batch_identifier),
                 tuple(prep_inits),
             )
-            resp = self._send_init_request(
-                task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
-            )
+            resp = self._send_init_request(task, acquired.job_id, req, acquired=acquired)
             by_id = {pr.report_id: pr for pr in resp.prepare_resps}
             for i in send_idx:
                 ra = pending[i]
@@ -681,19 +855,24 @@ class AggregationJobDriver:
             mlen = int.from_bytes(ra.prep_blob[:4], "big")
             msgs.append(ra.prep_blob[4 : 4 + mlen])
             outs.append(ra.prep_blob[4 + mlen :])
+        # the stored msgs are already-framed ping-pong messages; splice
+        # them raw (PrepareContinue = report_id || message) instead of
+        # re-validating each frame through the dataclass codec
         req = AggregationJobContinueReq(
             AggregationJobStep(job.step + 1),
             tuple(
-                PrepareContinue(ra.report_id, msg) for ra, msg in zip(waiting, msgs)
+                PreEncoded(ra.report_id.data + msg)
+                for ra, msg in zip(waiting, msgs)
             ),
         )
         from ..trace import span
 
         with span("driver.http_continue", reports=len(waiting)):
-            resp = self._send_continue_request(
-                task, acquired.job_id, req, deadline=self._lease_deadline(acquired)
+            body = self._send_agg_job_request_raw(
+                task, acquired.job_id, "POST", req, acquired=acquired
             )
-        by_id = {pr.report_id: pr for pr in resp.prepare_resps}
+        col = decode_prepare_resps_fast(body)
+        mapping = self._match_resps([ra.report_id.data for ra in waiting], col)
 
         accumulator = Accumulator(
             task,
@@ -704,9 +883,9 @@ class AggregationJobDriver:
         pbs = PartialBatchSelector.from_bytes(job.partial_batch_identifier)
         fixed_bid = fixed_size_batch_id(pbs)
         new_ras = []
-        for ra, out_enc in zip(waiting, outs):
-            pr = by_id.get(ra.report_id)
-            if pr is not None and pr.result.kind == PrepareStepResult.FINISHED:
+        for k, (ra, out_enc) in enumerate(zip(waiting, outs)):
+            j = k if mapping is None else mapping[k]
+            if j is not None and col.kinds[j] == PrepareStepResult.FINISHED:
                 from ..messages import Interval
 
                 bid = fixed_bid or Interval(
@@ -723,8 +902,8 @@ class AggregationJobDriver:
                 )
             else:
                 err = _err_or_default(
-                    pr.result.prepare_error
-                    if pr is not None and pr.result.kind == PrepareStepResult.REJECT
+                    col.errors[j]
+                    if j is not None and col.kinds[j] == PrepareStepResult.REJECT
                     else None
                 )
                 metrics.aggregate_step_failure_counter.add(type=err.name.lower())
@@ -751,11 +930,6 @@ class AggregationJobDriver:
 
         observe_finished_report_e2e(self.ds.clock, new_ras, cell.get("unmerged", ()))
 
-    def _send_continue_request(
-        self, task: Task, job_id, req: AggregationJobContinueReq, deadline: float | None = None
-    ) -> AggregationJobResp:
-        return self._send_agg_job_request(task, job_id, "POST", req, deadline=deadline)
-
     def _send_agg_job_request(
         self,
         task: Task,
@@ -764,13 +938,45 @@ class AggregationJobDriver:
         req,
         extra_headers: dict | None = None,
         deadline: float | None = None,
+        acquired=None,
     ) -> AggregationJobResp:
+        return AggregationJobResp.from_bytes(
+            self._send_agg_job_request_raw(
+                task, job_id, method, req,
+                extra_headers=extra_headers, deadline=deadline, acquired=acquired,
+            )
+        )
+
+    def _send_agg_job_request_raw(
+        self,
+        task: Task,
+        job_id,
+        method: str,
+        req,
+        extra_headers: dict | None = None,
+        deadline: float | None = None,
+        acquired=None,
+    ) -> bytes:
         """Shared PUT(init)/POST(continue) to the helper's
         aggregation_jobs endpoint: URL, auth, deadline-capped timeouts,
-        retries, response decode."""
+        retries; returns the raw response body (the callers' columnar
+        decoders parse it)."""
         import base64
 
         from .job_driver import deadline_request_timeout
+
+        if acquired is not None:
+            # recompute the lease budget AT CALL TIME: the staging +
+            # device phases (and, pipelined, the stage queues) consumed
+            # arbitrary wall time since the step captured its budget —
+            # an expired lease raises here and steps back instead of
+            # dialing the helper on a dead budget. Clamped to the
+            # ambient step scope so a DB-clock-granularity recompute
+            # can never EXTEND past the bound the watchdog enforced.
+            deadline = self._lease_deadline(acquired)
+            ambient = current_deadline()
+            if ambient is not None:
+                deadline = min(deadline, ambient)
 
         url = (
             task.helper_aggregator_endpoint.rstrip("/")
@@ -781,6 +987,7 @@ class AggregationJobDriver:
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
         peer = peer_label(task.helper_aggregator_endpoint)
+        payload = req.to_bytes()  # encode once, not once per retry attempt
 
         def attempt():
             # circuit gate per ATTEMPT: a breaker opened by a concurrent
@@ -793,7 +1000,7 @@ class AggregationJobDriver:
             fn = self.http.put if method == "PUT" else self.http.post
             try:
                 status, body = fn(
-                    url, req.to_bytes(), headers, timeout=deadline_request_timeout(deadline)
+                    url, payload, headers, timeout=deadline_request_timeout(deadline)
                 )
             except BaseException:
                 # transport failure (or anything else before a response):
@@ -825,20 +1032,32 @@ class AggregationJobDriver:
             raise RuntimeError(
                 f"helper {method} aggregation job failed: HTTP {status}: {body[:300]!r}"
             )
-        return AggregationJobResp.from_bytes(body)
+        return body
 
     def _send_init_request(
-        self, task: Task, job_id, req: AggregationJobInitializeReq, deadline: float | None = None
+        self, task: Task, job_id, req: AggregationJobInitializeReq, deadline: float | None = None,
+        acquired=None,
     ) -> AggregationJobResp:
+        return AggregationJobResp.from_bytes(
+            self._send_init_request_raw(
+                task, job_id, req, deadline=deadline, acquired=acquired
+            )
+        )
+
+    def _send_init_request_raw(
+        self, task: Task, job_id, req: AggregationJobInitializeReq, deadline: float | None = None,
+        acquired=None,
+    ) -> bytes:
         from .http_handlers import XOF_MODE_HEADER
 
-        return self._send_agg_job_request(
+        return self._send_agg_job_request_raw(
             task,
             job_id,
             "PUT",
             req,
             extra_headers={XOF_MODE_HEADER: task.vdaf.xof_mode},
             deadline=deadline,
+            acquired=acquired,
         )
 
     # --- abandon (reference :728) ---

@@ -150,6 +150,7 @@ class JobDriver:
         stepper,
         stopper: Stopper | None = None,
         releaser=None,
+        pipeline=None,
     ):
         self.cfg = cfg
         self.acquirer = acquirer
@@ -160,6 +161,18 @@ class JobDriver:
         # of aging out a full TTL on the surviving peer (the drivers
         # pass their step_back, which preserves the attempt ledger)
         self.releaser = releaser
+        # optional stage pipeline (aggregator/step_pipeline.py): when
+        # set, leased jobs are submitted to pipeline.submit(acquired)
+        # instead of running the serial stepper on a worker thread. The
+        # returned futures resolve when the job's step fully completed
+        # (the pipeline owns error mapping and drain-release), so the
+        # discovery loop's worker accounting is unchanged.
+        self.pipeline = pipeline
+
+    def _submit(self, pool, acquired):
+        if self.pipeline is not None:
+            return self.pipeline.submit(acquired)
+        return pool.submit(self._step_one, acquired)
 
     def run_once(self) -> int:
         """One acquire+step pass (barrier semantics — tests and one-shot
@@ -169,7 +182,7 @@ class JobDriver:
         if not jobs:
             return 0
         with ThreadPoolExecutor(max_workers=self.cfg.max_concurrent_job_workers) as pool:
-            futures = [pool.submit(self._step_one, j) for j in jobs]
+            futures = [self._submit(pool, j) for j in jobs]
             wait(futures)
         return len(jobs)
 
@@ -215,7 +228,7 @@ class JobDriver:
                     jobs = self.acquirer(free)
                     n = len(jobs)
                     for j in jobs:
-                        in_flight.add(pool.submit(self._step_one, j))
+                        in_flight.add(self._submit(pool, j))
                 if n > 0:
                     delay = self.cfg.job_discovery_interval_s
                 else:

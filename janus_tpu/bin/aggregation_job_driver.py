@@ -23,6 +23,7 @@ log = logging.getLogger(__name__)
 
 def run(cfg: JobDriverBinaryConfig, ds, stopper):
     from ..aggregator.health_sampler import HealthSampler
+    from ..aggregator.step_pipeline import StepPipeline
 
     driver = AggregationJobDriver(
         ds,
@@ -35,14 +36,24 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         # of spending the remaining lease on a dead peer
         stopper=stopper,
     )
+    # a step failing during shutdown releases its lease immediately
+    # (reacquirable by the surviving peer, attempts preserved)
+    releaser = lambda acquired: driver.step_back(acquired, "shutdown_drain", 0.0)  # noqa: E731
+    # stage-pipelined stepper (aggregator/step_pipeline.py): prefetch,
+    # serialized device lane, detached HTTP/commit stages. Disable with
+    # `step_pipeline: {enabled: false}` to fall back to serial steps.
+    pipeline = None
+    if cfg.step_pipeline.enabled:
+        pipeline = StepPipeline(
+            driver, cfg.step_pipeline, stopper=stopper, releaser=releaser
+        )
     jd = JobDriver(
         cfg.job_driver,
         driver.acquirer(cfg.job_driver.worker_lease_duration_s),
         driver.stepper,
         stopper,
-        # a step failing during shutdown releases its lease immediately
-        # (reacquirable by the surviving peer, attempts preserved)
-        releaser=lambda acquired: driver.step_back(acquired, "shutdown_drain", 0.0),
+        releaser=releaser,
+        pipeline=pipeline,
     )
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:
@@ -52,6 +63,10 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
     finally:
         if sampler is not None:
             sampler.stop()
+        if pipeline is not None:
+            # jd.run() drained the in-flight chains; this only retires
+            # the idle stage workers
+            pipeline.close()
     log.info("aggregation job driver shut down")
 
 

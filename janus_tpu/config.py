@@ -16,6 +16,7 @@ import yaml
 from .aggregator import Config as AggregatorProtocolConfig
 from .aggregator.aggregation_job_creator import AggregationJobCreatorConfig
 from .aggregator.job_driver import JobDriverConfig
+from .aggregator.step_pipeline import StepPipelineConfig
 from .core.circuit_breaker import CircuitBreakerConfig
 from .trace import TraceConfiguration
 
@@ -316,6 +317,11 @@ class JobDriverBinaryConfig:
     outbound_circuit_breaker: CircuitBreakerConfig = field(
         default_factory=CircuitBreakerConfig
     )
+    # stage-pipelined leader stepper knobs (YAML `step_pipeline:`
+    # section; docs/ARCHITECTURE.md "The stepper pipeline"). Enabled by
+    # default — `step_pipeline: {enabled: false}` restores the serial
+    # per-worker stepper.
+    step_pipeline: StepPipelineConfig = field(default_factory=StepPipelineConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "JobDriverBinaryConfig":
@@ -325,6 +331,7 @@ class JobDriverBinaryConfig:
             outbound_circuit_breaker=CircuitBreakerConfig.from_dict(
                 d.get("outbound_circuit_breaker")
             ),
+            step_pipeline=StepPipelineConfig.from_dict(d.get("step_pipeline")),
         )
 
 

@@ -43,10 +43,12 @@ from .core import (
     Interval,
     PartialBatchSelector,
     PlaintextInputShare,
+    PreEncoded,
     PrepareContinue,
     PrepareError,
     PrepareInit,
     PrepareResp,
+    PrepareRespColumn,
     PrepareStepResult,
     Query,
     Report,
@@ -59,6 +61,8 @@ from .core import (
     Time,
     TimeInterval,
     QUERY_TYPES,
+    decode_prepare_resps_fast,
+    encode_report_share_raw,
 )
 from .problem_type import DapProblemType
 

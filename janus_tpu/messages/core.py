@@ -12,9 +12,13 @@ from __future__ import annotations
 import enum
 import hashlib
 import secrets
+import struct
 from dataclasses import dataclass, field
 
 from .codec import (
+    PP_CONTINUE,
+    PP_FINISH,
+    PP_INITIALIZE,
     Codec,
     DecodeError,
     Decoder,
@@ -856,6 +860,137 @@ class AggregationJobResp(Codec):
     @classmethod
     def decode(cls, dec: Decoder):
         return cls(tuple(dec.items_u32(PrepareResp.decode)))
+
+
+# ---------------------------------------------------------------------------
+# columnar leader<->helper codec (ISSUE 9): the leader's hot path builds
+# whole request bodies from pre-framed rows and parses whole responses
+# into parallel columns, bypassing the per-report dataclass/Encoder
+# machinery while keeping the wire bytes bit-identical (pinned by the
+# codec-equivalence fuzz in tests/test_wire_columnar.py).
+# ---------------------------------------------------------------------------
+
+
+class PreEncoded(Codec):
+    """An already-encoded wire item: encode() splices the raw bytes
+    verbatim. The columnar leader codecs build whole batches of
+    PrepareInit/PrepareContinue bodies in vectorized passes and hand
+    them to the existing request containers through this, so the
+    container's items_u32 framing — and therefore the request bytes —
+    stays bit-identical to the per-item encode path. (A slotted plain
+    class, not a dataclass: one is built per report on the hot path.)"""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: bytes):
+        self.raw = raw
+
+    def __eq__(self, other):
+        return isinstance(other, PreEncoded) and self.raw == other.raw
+
+    def __repr__(self):
+        return f"PreEncoded({len(self.raw)}B)"
+
+    def encode(self, enc: Encoder) -> None:
+        enc.write(self.raw)
+
+
+def encode_report_share_raw(
+    report_id: bytes, time_seconds: int, public_share: bytes, ciphertext: HpkeCiphertext
+) -> bytes:
+    """ReportShare.to_bytes without the Encoder/dataclass machinery
+    (the leader init hot loop builds one per pending report)."""
+    return b"".join(
+        (
+            report_id,
+            struct.pack(">QI", time_seconds, len(public_share)),
+            public_share,
+            struct.pack(">BH", ciphertext.config_id.id, len(ciphertext.encapsulated_key)),
+            ciphertext.encapsulated_key,
+            struct.pack(">I", len(ciphertext.payload)),
+            ciphertext.payload,
+        )
+    )
+
+
+class PrepareRespColumn:
+    """An AggregationJobResp body parsed into parallel columns: 16-byte
+    report ids, PrepareStepResult kinds, raw ping-pong message frames
+    (kind=continue) and PrepareError values (kind=reject) — no
+    per-report dataclass construction. Accepts exactly the inputs
+    AggregationJobResp.from_bytes accepts and raises DecodeError on
+    exactly the inputs it rejects."""
+
+    __slots__ = ("report_ids", "kinds", "messages", "errors")
+
+    def __init__(self, report_ids, kinds, messages, errors):
+        self.report_ids: list[bytes] = report_ids
+        self.kinds: bytearray = kinds
+        self.messages: list[bytes | None] = messages
+        self.errors: list[PrepareError | None] = errors
+
+    def __len__(self) -> int:
+        return len(self.report_ids)
+
+
+def decode_prepare_resps_fast(raw: bytes) -> PrepareRespColumn:
+    """Columnar AggregationJobResp parse (see PrepareRespColumn)."""
+    total = len(raw)
+    if total < 4:
+        raise DecodeError("unexpected end of input")
+    (body_len,) = struct.unpack_from(">I", raw, 0)
+    end = 4 + body_len
+    if end > total:
+        raise DecodeError("unexpected end of input")
+    if end != total:
+        raise DecodeError(f"{total - end} trailing bytes")
+    ids: list[bytes] = []
+    kinds = bytearray()
+    msgs: list[bytes | None] = []
+    errs: list[PrepareError | None] = []
+    pos = 4
+    while pos < end:
+        if end - pos < 17:
+            raise DecodeError("unexpected end of input")
+        rid = raw[pos : pos + 16]
+        kind = raw[pos + 16]
+        pos += 17
+        msg = None
+        err = None
+        if kind == PrepareStepResult.CONTINUE:
+            # one self-delimiting ping-pong frame, kept raw
+            frame_start = pos
+            if pos >= end:
+                raise DecodeError("unexpected end of input")
+            tag = raw[pos]
+            pos += 1
+            fields = 2 if tag == PP_CONTINUE else 1
+            if tag not in (PP_INITIALIZE, PP_CONTINUE, PP_FINISH):
+                raise DecodeError(f"bad ping-pong message tag {tag}")
+            for _ in range(fields):
+                if end - pos < 4:
+                    raise DecodeError("unexpected end of input")
+                (flen,) = struct.unpack_from(">I", raw, pos)
+                pos += 4
+                if end - pos < flen:
+                    raise DecodeError("unexpected end of input")
+                pos += flen
+            msg = raw[frame_start:pos]
+        elif kind == PrepareStepResult.REJECT:
+            if pos >= end:
+                raise DecodeError("unexpected end of input")
+            try:
+                err = PrepareError(raw[pos])
+            except ValueError as e:
+                raise DecodeError(str(e))
+            pos += 1
+        elif kind != PrepareStepResult.FINISHED:
+            raise DecodeError(f"bad PrepareStepResult kind {kind}")
+        ids.append(rid)
+        kinds.append(kind)
+        msgs.append(msg)
+        errs.append(err)
+    return PrepareRespColumn(ids, kinds, msgs, errs)
 
 
 @dataclass(frozen=True)

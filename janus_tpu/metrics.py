@@ -447,6 +447,45 @@ job_step_back_total = REGISTRY.counter(
     "instead of failing, by reason",
 )
 
+# --- stage-pipelined leader stepper (aggregator/step_pipeline.py;
+# docs/ARCHITECTURE.md "The stepper pipeline", ISSUE 9) ---
+step_pipeline_stage_seconds = REGISTRY.histogram(
+    "janus_step_pipeline_stage_seconds",
+    "per-stage execution wall time of the pipelined leader stepper, by "
+    'stage (stage="read|device|http|commit|classic"; queue wait excluded)',
+)
+step_pipeline_queue_depth = REGISTRY.gauge(
+    "janus_step_pipeline_queue_depth",
+    "jobs handed to a pipeline stage and not yet executing, by stage",
+)
+device_lane_busy_ratio = REGISTRY.gauge(
+    "janus_device_lane_busy_ratio",
+    "fraction of wall time the pipeline's serialized device lane spent "
+    "executing device stages over a rolling ~60-120s window (the "
+    "chip-saturation signal; sustained ~1.0 = device-bound — compare "
+    "with stage seconds to find the bottleneck stage)",
+)
+device_lane_busy_seconds = REGISTRY.counter(
+    "janus_device_lane_busy_seconds_total",
+    "cumulative seconds the device lane spent executing device stages — "
+    "rate() this for alerting windows of any width (the gauge above is "
+    "a fixed rolling window)",
+)
+step_pipeline_overlap_total = REGISTRY.counter(
+    "janus_step_pipeline_overlap_total",
+    "pipeline overlap events, by direction: a device-lane stage started "
+    'while a helper HTTP leg was in flight (direction="device_start") or '
+    'an HTTP leg started while the lane was busy (direction="http_start") '
+    "— either nonzero proves the pipeline is hiding the helper RTT "
+    "behind device work",
+)
+prep_resp_order_mismatch_total = REGISTRY.counter(
+    "janus_prep_resp_order_mismatch_total",
+    "helper responses whose prepare_resps came back out of request order "
+    "(a DAP ordering-contract violation; the driver falls back to the "
+    "id->index dict match)",
+)
+
 # --- report-lifecycle tracing + end-to-end SLOs (ISSUE 6;
 # docs/OBSERVABILITY.md "Report-lifecycle tracing") ---
 span_errors_total = REGISTRY.counter(

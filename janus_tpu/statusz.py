@@ -31,9 +31,13 @@ def register_status_provider(name: str, fn) -> None:
         _providers[name] = fn
 
 
-def unregister_status_provider(name: str) -> None:
+def unregister_status_provider(name: str, fn=None) -> None:
+    """Remove the section `name`. With `fn`, remove only if it is still
+    the registered provider — a closing subsystem must not tear down a
+    successor's registration (latest registration wins)."""
     with _lock:
-        _providers.pop(name, None)
+        if fn is None or _providers.get(name) is fn:
+            _providers.pop(name, None)
 
 
 def status_snapshot() -> dict:

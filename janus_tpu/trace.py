@@ -814,6 +814,28 @@ def span(name: str, **args):
             )
 
 
+def record_operation(name: str, dur_s: float, **args) -> None:
+    """Feed a completed cross-thread operation into the flight
+    recorder's per-name digests (and the span->metric bridge) without a
+    live span context. The step pipeline uses it for the end-to-end
+    "job.step" duration: the stages run on different threads, so no
+    single span() block can cover the whole step, but the digest —
+    which the bench's served phase reads for the p50/p95 aggregation-
+    job-step SLO — must still see one observation per stepped job."""
+    if _span_metrics:
+        _bridge_span(name, dur_s, args)
+    _flight_recorder.record(
+        name,
+        _span_rng.getrandbits(128),
+        _span_rng.getrandbits(64),
+        None,
+        time.time_ns() - int(dur_s * 1e9),
+        dur_s,
+        args,
+        args.get("error"),
+    )
+
+
 class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         doc = {

@@ -46,8 +46,10 @@ SEED_SIZE = 16
 # ---------------------------------------------------------------------------
 
 
-def encode_field_rows(jf, value) -> list[bytes]:
-    """Device field value [batch, n] -> per-row little-endian encodings."""
+def field_rows_u8(jf, value) -> np.ndarray:
+    """Device field value [batch, n] -> one uint8 matrix [batch, n*enc]
+    of the per-row little-endian encodings (the whole-batch form behind
+    encode_field_rows; the columnar framing passes splice it directly)."""
     if hasattr(value, "to_numpy"):  # engine_cache.DeviceRows
         value = value.to_numpy()
     limbs = [np.asarray(x, dtype=np.uint64) for x in value]
@@ -55,8 +57,14 @@ def encode_field_rows(jf, value) -> list[bytes]:
         lanes = limbs[0]
     else:
         lanes = np.stack(limbs, axis=-1).reshape(limbs[0].shape[0], -1)
-    le = lanes.astype("<u8")
-    return [row.tobytes() for row in le]
+    le = np.ascontiguousarray(lanes.astype("<u8"))
+    return le.view(np.uint8).reshape(le.shape[0], -1)
+
+
+def encode_field_rows(jf, value) -> list[bytes]:
+    """Device field value [batch, n] -> per-row little-endian encodings."""
+    u8 = field_rows_u8(jf, value)
+    return [row.tobytes() for row in u8]
 
 
 def lanes_in_range(lanes: np.ndarray, modulus: int, limbs: int) -> np.ndarray:
@@ -114,6 +122,81 @@ def seeds_to_lanes(rows: list[bytes | None]) -> tuple[np.ndarray, np.ndarray]:
 
 def lanes_to_seed_rows(lanes) -> list[bytes]:
     return [row.tobytes() for row in np.asarray(lanes, dtype="<u8")]
+
+
+# ---------------------------------------------------------------------------
+# columnar ping-pong framing (leader hot path)
+# ---------------------------------------------------------------------------
+
+
+class PingPongFrameColumn:
+    """A whole batch of uniform-stride ping-pong frames in ONE buffer.
+
+    The leader's init path frames every report's prep share with the
+    same tag and the same length prefix (all prep shares of a batch are
+    the same size), so the frames can be built in a single vectorized
+    pass instead of one Encoder round per report. `row(i)` slices
+    report i's frame out of the shared buffer — bit-identical to
+    `encode_pingpong(tag, ..., share)` for that row (pinned by the
+    codec-equivalence fuzz in tests/test_wire_columnar.py)."""
+
+    __slots__ = ("buf", "stride", "n")
+
+    def __init__(self, buf: bytes, stride: int, n: int):
+        self.buf = buf
+        self.stride = stride
+        self.n = n
+
+    def row(self, i: int) -> bytes:
+        s = i * self.stride
+        return self.buf[s : s + self.stride]
+
+    def rows(self) -> list[bytes]:
+        return [self.row(i) for i in range(self.n)]
+
+
+def encode_pingpong_share_column(jf, ver_value, part_value) -> PingPongFrameColumn:
+    """Batched `encode_pingpong(PP_INITIALIZE, None,
+    encode_prep_share_raw(ver_row, part_row))`: one numpy pass building
+    every report's framed prep share.
+
+    ver_value: device/host field value [batch, verifier_len] (limb
+    tuple or DeviceRows); part_value: [batch, 2] u64 joint-rand part
+    lanes, or None for circuits without joint randomness."""
+    ver_u8 = field_rows_u8(jf, ver_value)
+    n = ver_u8.shape[0]
+    cols = [ver_u8]
+    share_len = ver_u8.shape[1]
+    if part_value is not None:
+        part_u8 = (
+            np.ascontiguousarray(np.asarray(part_value, dtype="<u8"))
+            .view(np.uint8)
+            .reshape(n, -1)
+        )
+        cols.append(part_u8)
+        share_len += part_u8.shape[1]
+    # frame header: u8 tag || u32 big-endian share length — constant
+    # across the batch, broadcast into the leading 5 columns
+    hdr = np.empty((n, 5), dtype=np.uint8)
+    hdr[:] = np.frombuffer(
+        bytes([PP_INITIALIZE]) + share_len.to_bytes(4, "big"), dtype=np.uint8
+    )
+    mat = np.concatenate([hdr] + cols, axis=1)
+    return PingPongFrameColumn(mat.tobytes(), 5 + share_len, n)
+
+
+def pingpong_finish_frame_matches(frame: bytes, want_msg: bytes) -> bool | None:
+    """Fast verify of a helper's 1-round answer against the expected
+    prep message: True = frame is `finish(want_msg)`, False = a finish
+    frame carrying a DIFFERENT message of the right length (VDAF prep
+    error), None = not a well-formed finish-of-that-length frame at all
+    (invalid message). `frame` must be exactly one self-delimiting
+    ping-pong message (the response decoder guarantees this), so the
+    check reduces to two bytes compares instead of a Decoder pass."""
+    hdr = bytes([PP_FINISH]) + len(want_msg).to_bytes(4, "big")
+    if len(frame) != len(hdr) + len(want_msg) or frame[: len(hdr)] != hdr:
+        return None
+    return frame[len(hdr) :] == want_msg
 
 
 # ---------------------------------------------------------------------------

@@ -135,6 +135,10 @@ DB_OUTAGE_SCHEDULE = "datastore.connect.leader=error:1.0"
 # the driver's first device dispatch wedges FOREVER (released only by
 # the stopper): the hung-XLA-dispatch model for --scenario device_hang
 DEVICE_HANG_SCHEDULE = "engine.dispatch=hang,count=1"
+# --scenario pipeline: stretch every helper RTT so the stage pipeline
+# has a real window to overlap device work with (loopback RTTs are
+# otherwise microseconds and the overlap proof would be flaky)
+PIPELINE_RTT_SCHEDULE = "helper.request=delay:0.08"
 
 
 def _free_port() -> int:
@@ -143,7 +147,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _driver_cfg(path, db, health_port, ttl_s, cooldown_s):
+def _driver_cfg(path, db, health_port, ttl_s, cooldown_s, extra: str = ""):
     cfg = (
         f"database: {{url: {db}}}\n"
         f'health_check_listen_address: "127.0.0.1:{health_port}"\n'
@@ -156,6 +160,7 @@ def _driver_cfg(path, db, health_port, ttl_s, cooldown_s):
         "outbound_circuit_breaker:\n"
         "  failure_threshold: 3\n"
         f"  open_cooldown_secs: {cooldown_s}\n"
+        + extra
     )
     with open(path, "w") as f:
         f.write(cfg)
@@ -1201,6 +1206,266 @@ def run_device_hang(
         helper_ds.close()
 
 
+def _histogram_counts(text: str, name: str) -> dict[str, float]:
+    """{label_block: value} of a histogram family's _count samples."""
+    from janus_tpu.exposition import parse_exposition
+
+    fam = parse_exposition(text)[0].get(name)
+    if fam is None:
+        return {}
+    return {
+        ",".join(f'{k}="{v}"' for k, v in sorted(labels.items())): float(value)
+        for sample_name, labels, value in fam.samples
+        if sample_name == name + "_count"
+    }
+
+
+def run_pipeline(
+    n_reports: int = 24,
+    job_size: int = 3,
+    lease_ttl_s: int = 60,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Stage-pipeline overlap proof (ISSUE 9): the REAL driver binary —
+    pipelined stepper enabled via its YAML `step_pipeline:` stanza —
+    steps many small jobs against a loopback helper whose RTT is
+    stretched by a `helper.request=delay` failpoint. Asserts the
+    overlap actually happened (the device lane ran while an HTTP leg
+    was in flight: janus_step_pipeline_overlap_total > 0 and a
+    recorded overlap ratio > 0), every pipeline stage executed
+    (stage-seconds counts for read/device/http/commit), the device-lane
+    busy ratio is live, SIGTERM drains rc 0, and the final collection
+    equals the admitted ground truth exactly — the pipeline never loses
+    or double-steps a job. Every `*_ok` key must be True to pass."""
+    import threading
+
+    import dataclasses
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.binary_utils import enable_compile_cache, warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-pipeline-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    clock = RealClock()
+    leader_db = os.path.join(tmp, "leader.sqlite")
+    leader_ds = Datastore(leader_db, Crypter([key_bytes]), clock)
+    helper_ds = Datastore(os.path.join(tmp, "helper.sqlite"), Crypter([key_bytes]), clock)
+
+    result: dict = {
+        "workdir": tmp,
+        "schedule": "pipeline_full" if full else "pipeline_smoke",
+    }
+    procs: list[subprocess.Popen] = []
+    leader_srv = helper_srv = None
+    try:
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+
+        vdaf = VdafInstance.count()
+        collector_kp = generate_hpke_config_and_private_key(config_id=204)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=3),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+        enable_compile_cache()
+        warmup_engines(leader_ds, batch=job_size)
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        measurements = [(i % 3 != 0) * 1 for i in range(n_reports)]
+        for m in measurements:
+            client.upload(m)
+        # many SMALL jobs: the pipeline needs several concurrently
+        # leased steps for its stages to interleave
+        AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=job_size
+            ),
+        ).run_once()
+        result["admitted"] = len(measurements)
+        result["ground_truth_sum"] = sum(measurements)
+        result["jobs_created"] = (n_reports + job_size - 1) // job_size
+
+        def agg_jobs_by_state():
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "pipeline_monitor"
+            )
+            return {
+                state: n for (typ, state), n in counts.items() if typ == "aggregation"
+            }
+
+        # --- spawn the real driver: pipelined stepper via YAML ----------
+        port = _free_port()
+        cfg = _driver_cfg(
+            os.path.join(tmp, "driver.yaml"),
+            leader_db,
+            port,
+            int(lease_ttl_s),
+            1.5,
+            extra=(
+                "max_concurrent_job_workers: 4\n"
+                "step_pipeline:\n"
+                "  enabled: true\n"
+                "  prefetch_depth: 2\n"
+                "  http_inflight: 2\n"
+                "  commit_inflight: 2\n"
+            ),
+        )
+        drv = _spawn_driver(
+            cfg, key, os.path.join(tmp, "driver.log"), PIPELINE_RTT_SCHEDULE
+        )
+        procs.append(drv)
+        _wait_healthz(port)
+
+        # --- wait for all jobs to finish, scraping the pipeline live ----
+        deadline = time.monotonic() + 180
+        mtext = ""
+        while time.monotonic() < deadline:
+            states = agg_jobs_by_state()
+            if states.get("in_progress", 0) == 0 and states.get("finished", 0) >= result[
+                "jobs_created"
+            ]:
+                break
+            time.sleep(0.1)
+        states = agg_jobs_by_state()
+        result["job_states"] = states
+        result["jobs_finished_ok"] = (
+            states.get("finished", 0) >= result["jobs_created"]
+            and states.get("in_progress", 0) == 0
+        )
+
+        mtext = _scrape(port, "/metrics")
+        overlap = _metric_samples(mtext, "janus_step_pipeline_overlap_total")
+        result["overlapped_dispatches"] = sum(overlap.values())
+        result["overlap_ok"] = result["overlapped_dispatches"] >= 1
+        busy = _metric_samples(mtext, "janus_device_lane_busy_ratio")
+        result["device_lane_busy_ratio"] = max(busy.values() or [0.0])
+        result["device_lane_busy_ok"] = result["device_lane_busy_ratio"] > 0
+        stage_counts = _histogram_counts(mtext, "janus_step_pipeline_stage_seconds")
+        result["stage_seconds_counts"] = stage_counts
+        result["stages_executed_ok"] = all(
+            any(f'stage="{s}"' in k and v > 0 for k, v in stage_counts.items())
+            for s in ("read", "device", "http", "commit")
+        )
+        statusz = json.loads(_scrape(port, "/statusz"))
+        sp = statusz.get("step_pipeline", {})
+        result["statusz_overlap_ratio"] = sp.get("overlap_ratio", 0)
+        result["statusz_overlap_events"] = sp.get("overlap_events", 0)
+        result["statusz_pipeline_ok"] = (
+            sp.get("jobs_done", 0) >= result["jobs_created"]
+            and sp.get("overlap_events", 0) > 0
+            and sp.get("device_lane", {}).get("concurrent_peak", 99) <= 1
+        )
+
+        # --- SIGTERM drain ---------------------------------------------
+        drv.send_signal(signal.SIGTERM)
+        rc = drv.wait(timeout=60)
+        log_text = open(os.path.join(tmp, "driver.log"), "rb").read()
+        result["drain_rc"] = rc
+        result["drain_ok"] = rc == 0 and b"shut down" in log_text
+
+        # --- collect and compare against ground truth -------------------
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            cjd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                cjd.run_once()
+                stop_collect.wait(0.3)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+        try:
+            collector = Collector(
+                CollectorParameters(
+                    leader_task.task_id,
+                    leader_srv.url,
+                    leader_task.collector_auth_token,
+                    collector_kp,
+                ),
+                vdaf,
+                HttpClient(),
+            )
+            tp = leader_task.time_precision
+            start = clock.now().to_batch_interval_start(tp)
+            query = Query.time_interval(
+                Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+            )
+            collected = collector.collect(query, timeout_s=120.0)
+            result["collected_count"] = collected.report_count
+            result["collected_sum"] = collected.aggregate_result
+            result["exactly_once_ok"] = (
+                collected.report_count == len(measurements)
+                and collected.aggregate_result == sum(measurements)
+            )
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if leader_srv is not None:
+            leader_srv.stop()
+        if helper_srv is not None:
+            helper_srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -1211,13 +1476,15 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--scenario",
-        choices=["crash_storm", "db_outage", "device_hang"],
+        choices=["crash_storm", "db_outage", "device_hang", "pipeline"],
         default="crash_storm",
         help="crash_storm = driver SIGKILL + helper storms (default); "
         "db_outage = datastore outage under upload load (journal spill, "
         "degraded serving, replay, exactly-once); device_hang = wedged "
         "device dispatch (watchdog abandon, quarantine + canary "
-        "restore, host-fallback serving, exactly-once)",
+        "restore, host-fallback serving, exactly-once); pipeline = "
+        "stage-pipelined stepper overlap proof (device lane busy while "
+        "a stretched helper RTT is in flight, exactly-once)",
     )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
@@ -1234,6 +1501,12 @@ def main(argv=None) -> int:
     elif args.scenario == "device_hang":
         result = run_device_hang(
             n_reports=args.reports or (5 if args.smoke else 12),
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    elif args.scenario == "pipeline":
+        result = run_pipeline(
+            n_reports=args.reports or (24 if args.smoke else 60),
             full=not args.smoke,
             workdir=args.workdir,
         )

@@ -75,6 +75,14 @@ def main(argv=None) -> int:
         "janus_hung_dispatches_total",
         "janus_abandoned_dispatch_threads",
         "janus_engine_quarantines_total",
+        # stage-pipelined leader stepper (ISSUE 9; registered at import
+        # in every binary — absence is a deploy regression)
+        "janus_step_pipeline_stage_seconds",
+        "janus_step_pipeline_queue_depth",
+        "janus_device_lane_busy_ratio",
+        "janus_device_lane_busy_seconds_total",
+        "janus_step_pipeline_overlap_total",
+        "janus_prep_resp_order_mismatch_total",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")

@@ -235,6 +235,31 @@ def test_bench_dry_run_smoke():
     assert dh["drain_ok"] is True
     assert dh["exactly_once_ok"] is True
     assert dh["collected_count"] == dh["admitted"]
+    # columnar wire codec (ISSUE 9): one vectorized framing pass must be
+    # >= 5x the per-report loop at batch >= 1024 with BIT-IDENTICAL
+    # request bytes (the acceptance criterion, measured not assumed)
+    codec = rec["step_pipeline"]["codec"]
+    assert codec["batch"] >= 1024
+    assert codec["wire_bytes_identical"] is True
+    assert codec["decode_roundtrip_ok"] is True
+    assert codec["encode_speedup"] >= 5.0, codec
+    assert codec["decode_speedup"] >= 5.0, codec
+    # stage-pipelined stepper (ISSUE 9; chaos_run.py --scenario
+    # pipeline): the REAL driver binary with the pipelined stepper
+    # proves overlap on loopback — the device lane ran while a
+    # (failpoint-stretched) helper RTT was in flight, every stage
+    # executed, the drain is clean, and the collection equals the
+    # admitted ground truth exactly (never a lost/double-stepped job)
+    ps = rec["pipeline_smoke"]
+    assert ps.get("ok") is True, ps
+    assert ps["overlap_ok"] and ps["overlapped_dispatches"] >= 1
+    assert ps["device_lane_busy_ok"] is True
+    assert ps["statusz_overlap_events"] > 0  # overlap recorded in statusz
+    assert ps["stages_executed_ok"] is True
+    assert ps["statusz_pipeline_ok"] is True  # serialized lane, jobs done
+    assert ps["drain_ok"] is True
+    assert ps["exactly_once_ok"] is True
+    assert ps["collected_count"] == ps["admitted"]
 
 
 def test_collect_cli_end_to_end(capsys):

@@ -1,0 +1,356 @@
+"""Codec-equivalence fuzz for the columnar leader<->helper wire path
+(ISSUE 9): the vectorized framing/parsing must be BIT-IDENTICAL to the
+per-report dataclass codec for every registered VDAF — same bytes out,
+same accepts/rejects in — and the order-aligned prepare-resp fast path
+must fall back (and count) on a helper that violates the DAP ordering
+contract."""
+
+import secrets
+import struct
+
+import numpy as np
+import pytest
+
+from janus_tpu import metrics
+from janus_tpu.messages import (
+    AggregationJobContinueReq,
+    AggregationJobInitializeReq,
+    AggregationJobResp,
+    AggregationJobStep,
+    DecodeError,
+    HpkeCiphertext,
+    HpkeConfigId,
+    PartialBatchSelector,
+    PreEncoded,
+    PrepareContinue,
+    PrepareError,
+    PrepareInit,
+    PrepareResp,
+    PrepareStepResult,
+    ReportId,
+    ReportMetadata,
+    ReportShare,
+    Time,
+    decode_prepare_resps_fast,
+    encode_report_share_raw,
+)
+from janus_tpu.vdaf.registry import VdafInstance, circuit_for
+from janus_tpu.vdaf.wire import (
+    PP_CONTINUE,
+    PP_FINISH,
+    PP_INITIALIZE,
+    Prio3Wire,
+    encode_field_rows,
+    encode_pingpong,
+    encode_pingpong_share_column,
+    pingpong_finish_frame_matches,
+)
+
+# every registered Prio3 VDAF kind (poplar1 has no FLP circuit and its
+# leader path is not columnar), incl. the multi-round fake
+ALL_INSTANCES = [
+    VdafInstance.count(),
+    VdafInstance.sum(8),
+    VdafInstance.sum_vec(16, 4),
+    VdafInstance.count_vec(6),
+    VdafInstance.histogram(10),
+    VdafInstance.fixed_point_vec(4),
+    VdafInstance.fake(),
+    VdafInstance.fake_two_round(),
+]
+
+
+class _JF:
+    def __init__(self, circ):
+        self.LIMBS = circ.FIELD.ENCODED_SIZE // 8
+        self.MODULUS = circ.FIELD.MODULUS
+
+
+def _random_device_outputs(circ, wire, n, rng):
+    v = circ.verifier_len
+    jf = _JF(circ)
+    ver0 = tuple(
+        rng.integers(0, 1 << 31, size=(n, v), dtype=np.uint64)
+        for _ in range(jf.LIMBS)
+    )
+    part0 = (
+        rng.integers(0, 1 << 63, size=(n, 2), dtype=np.uint64)
+        if wire.uses_jr
+        else None
+    )
+    return jf, ver0, part0
+
+
+def _random_report_columns(wire, n, rng):
+    rids = [secrets.token_bytes(16) for _ in range(n)]
+    times = [Time(1_600_000_000 + int(rng.integers(0, 10_000))) for _ in range(n)]
+    pubs = [secrets.token_bytes(wire.public_share_len) for _ in range(n)]
+    cts = [
+        HpkeCiphertext(
+            HpkeConfigId(int(rng.integers(0, 256))),
+            secrets.token_bytes(int(rng.integers(16, 64))),
+            secrets.token_bytes(wire.helper_share_len + 44),
+        )
+        for _ in range(n)
+    ]
+    return rids, times, pubs, cts
+
+
+@pytest.mark.parametrize("inst", ALL_INSTANCES, ids=lambda i: i.kind + str(i.rounds))
+def test_init_request_columnar_bytes_identical(inst):
+    """The columnar init-request build (framing column + PreEncoded
+    splices) produces byte-for-byte the per-report loop's request, for
+    every registered VDAF (incl. the multi-round fake)."""
+    circ = circuit_for(inst)
+    wire = Prio3Wire(circ)
+    rng = np.random.default_rng(hash(inst.kind) & 0xFFFF)
+    n = 33
+    jf, ver0, part0 = _random_device_outputs(circ, wire, n, rng)
+    rids, times, pubs, cts = _random_report_columns(wire, n, rng)
+    pbs = PartialBatchSelector.time_interval()
+
+    # pre-ISSUE-9 per-report loop
+    ver_rows = encode_field_rows(jf, ver0)
+    part_rows = (
+        [row.tobytes() for row in np.asarray(part0, dtype="<u8")]
+        if wire.uses_jr
+        else [None] * n
+    )
+    loop_items = tuple(
+        PrepareInit(
+            ReportShare(ReportMetadata(ReportId(rids[i]), times[i]), pubs[i], cts[i]),
+            encode_pingpong(
+                PP_INITIALIZE, None, wire.encode_prep_share_raw(ver_rows[i], part_rows[i])
+            ),
+        )
+        for i in range(n)
+    )
+    loop_bytes = AggregationJobInitializeReq(b"", pbs, loop_items).to_bytes()
+
+    # columnar path (what AggregationJobDriver.http_init does)
+    frames = encode_pingpong_share_column(jf, ver0, part0)
+    col_items = tuple(
+        PreEncoded(
+            encode_report_share_raw(rids[i], times[i].seconds, pubs[i], cts[i])
+            + frames.row(i)
+        )
+        for i in range(n)
+    )
+    col_bytes = AggregationJobInitializeReq(b"", pbs, col_items).to_bytes()
+    assert col_bytes == loop_bytes
+    # and the helper-side decoder accepts them identically
+    decoded = AggregationJobInitializeReq.from_bytes(col_bytes)
+    assert len(decoded.prepare_inits) == n
+
+
+def test_continue_request_preencoded_bytes_identical():
+    """The continue request's PreEncoded splices (report_id || framed
+    ping-pong message, incl. multi-round PP_CONTINUE/PP_FINISH frames)
+    equal the PrepareContinue dataclass encoding."""
+    rng = np.random.default_rng(7)
+    n = 17
+    rids = [secrets.token_bytes(16) for _ in range(n)]
+    msgs = []
+    for i in range(n):
+        body = secrets.token_bytes(int(rng.integers(0, 40)))
+        if i % 3 == 0:
+            msgs.append(encode_pingpong(PP_FINISH, body, None))
+        elif i % 3 == 1:
+            msgs.append(encode_pingpong(PP_CONTINUE, body, secrets.token_bytes(8)))
+        else:
+            msgs.append(encode_pingpong(PP_INITIALIZE, None, body))
+    loop = AggregationJobContinueReq(
+        AggregationJobStep(2),
+        tuple(PrepareContinue(ReportId(r), m) for r, m in zip(rids, msgs)),
+    ).to_bytes()
+    col = AggregationJobContinueReq(
+        AggregationJobStep(2),
+        tuple(PreEncoded(r + m) for r, m in zip(rids, msgs)),
+    ).to_bytes()
+    assert col == loop
+
+
+def test_report_share_raw_fuzz():
+    rng = np.random.default_rng(11)
+    for _ in range(50):
+        rid = secrets.token_bytes(16)
+        t = int(rng.integers(0, 1 << 40))
+        pub = secrets.token_bytes(int(rng.integers(0, 64)))
+        ct = HpkeCiphertext(
+            HpkeConfigId(int(rng.integers(0, 256))),
+            secrets.token_bytes(int(rng.integers(0, 96))),
+            secrets.token_bytes(int(rng.integers(0, 200))),
+        )
+        assert encode_report_share_raw(rid, t, pub, ct) == ReportShare(
+            ReportMetadata(ReportId(rid), Time(t)), pub, ct
+        ).to_bytes()
+
+
+def _random_resp(rng, n):
+    resps = []
+    for _ in range(n):
+        kind = int(rng.integers(0, 3))
+        rid = ReportId(secrets.token_bytes(16))
+        if kind == PrepareStepResult.CONTINUE:
+            tag = int(rng.integers(0, 3))
+            body = secrets.token_bytes(int(rng.integers(0, 30)))
+            if tag == PP_CONTINUE:
+                msg = encode_pingpong(tag, body, secrets.token_bytes(4))
+            elif tag == PP_FINISH:
+                msg = encode_pingpong(tag, body, None)
+            else:
+                msg = encode_pingpong(tag, None, body)
+            resps.append(PrepareResp(rid, PrepareStepResult.cont(msg)))
+        elif kind == PrepareStepResult.FINISHED:
+            resps.append(PrepareResp(rid, PrepareStepResult.finished()))
+        else:
+            err = PrepareError(int(rng.integers(0, 10)))
+            resps.append(PrepareResp(rid, PrepareStepResult.reject(err)))
+    return AggregationJobResp(tuple(resps))
+
+
+def test_response_fast_parse_equivalent_on_valid_bodies():
+    rng = np.random.default_rng(13)
+    for trial in range(30):
+        resp = _random_resp(rng, int(rng.integers(0, 20)))
+        body = resp.to_bytes()
+        col = decode_prepare_resps_fast(body)
+        ref = AggregationJobResp.from_bytes(body)
+        assert col.report_ids == [r.report_id.data for r in ref.prepare_resps]
+        assert list(col.kinds) == [r.result.kind for r in ref.prepare_resps]
+        assert col.messages == [r.result.message for r in ref.prepare_resps]
+        assert col.errors == [r.result.prepare_error for r in ref.prepare_resps]
+
+
+def test_response_fast_parse_rejects_what_the_codec_rejects():
+    """Mutational fuzz: truncations, trailing bytes and corrupted
+    tag/kind/error bytes must raise DecodeError from BOTH parsers, or
+    parse successfully in both — never diverge."""
+    rng = np.random.default_rng(17)
+    base = _random_resp(rng, 8).to_bytes()
+    mutants = [base[:k] for k in range(0, len(base), 3)]
+    mutants += [base + b"\x00", base + secrets.token_bytes(3)]
+    for _ in range(200):
+        m = bytearray(base)
+        pos = int(rng.integers(0, len(m)))
+        m[pos] = int(rng.integers(0, 256))
+        mutants.append(bytes(m))
+    for m in mutants:
+        try:
+            ref = AggregationJobResp.from_bytes(m)
+            ref_outcome = [
+                (r.report_id.data, r.result.kind, r.result.message, r.result.prepare_error)
+                for r in ref.prepare_resps
+            ]
+        except DecodeError:
+            ref_outcome = "DecodeError"
+        try:
+            col = decode_prepare_resps_fast(m)
+            col_outcome = list(
+                zip(col.report_ids, (int(k) for k in col.kinds), col.messages, col.errors)
+            )
+        except DecodeError:
+            col_outcome = "DecodeError"
+        if ref_outcome == "DecodeError" or col_outcome == "DecodeError":
+            assert ref_outcome == col_outcome == "DecodeError", m.hex()
+        else:
+            assert [tuple(t) for t in col_outcome] == ref_outcome, m.hex()
+
+
+def test_order_aligned_fast_path_and_fallback():
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+
+    drv = AggregationJobDriver.__new__(AggregationJobDriver)  # matching is stateless
+    rng = np.random.default_rng(19)
+    n = 12
+    rids = [secrets.token_bytes(16) for _ in range(n)]
+    body = AggregationJobResp(
+        tuple(PrepareResp(ReportId(r), PrepareStepResult.finished()) for r in rids)
+    ).to_bytes()
+    col = decode_prepare_resps_fast(body)
+
+    before = metrics.prep_resp_order_mismatch_total.total()
+    # aligned: identity mapping, no counter, no dict
+    assert drv._match_resps(rids, col) is None
+    assert metrics.prep_resp_order_mismatch_total.total() == before
+
+    # shuffled: fallback mapping resolves every id, counter ticks
+    perm = list(rng.permutation(n))
+    shuffled_body = AggregationJobResp(
+        tuple(
+            PrepareResp(ReportId(rids[j]), PrepareStepResult.finished()) for j in perm
+        )
+    ).to_bytes()
+    shuffled = decode_prepare_resps_fast(shuffled_body)
+    mapping = drv._match_resps(rids, shuffled)
+    assert mapping is not None
+    assert metrics.prep_resp_order_mismatch_total.total() == before + 1
+    for k, j in enumerate(mapping):
+        assert shuffled.report_ids[j] == rids[k]
+
+    # missing id: None lane (the driver marks it INVALID_MESSAGE)
+    short = decode_prepare_resps_fast(
+        AggregationJobResp(
+            tuple(
+                PrepareResp(ReportId(r), PrepareStepResult.finished())
+                for r in rids[1:]
+            )
+        ).to_bytes()
+    )
+    mapping = drv._match_resps(rids, short)
+    assert mapping[0] is None and all(m is not None for m in mapping[1:])
+
+
+def test_pingpong_finish_fast_verify_matches_decode_semantics():
+    """pingpong_finish_frame_matches must agree with the old
+    decode_pingpong-based check on every well-formed frame."""
+    from janus_tpu.vdaf.wire import decode_pingpong
+
+    want = secrets.token_bytes(16)
+    frames = [
+        encode_pingpong(PP_FINISH, want, None),
+        encode_pingpong(PP_FINISH, secrets.token_bytes(16), None),
+        encode_pingpong(PP_FINISH, secrets.token_bytes(8), None),
+        encode_pingpong(PP_FINISH, b"", None),
+        encode_pingpong(PP_CONTINUE, want, b"share"),
+        encode_pingpong(PP_INITIALIZE, None, want),
+    ]
+    for frame in frames:
+        tag, prep_msg, _ = decode_pingpong(frame)
+        if tag != PP_FINISH or prep_msg is None or len(prep_msg) != len(want):
+            expected = None  # invalid for this verify
+        elif prep_msg == want:
+            expected = True
+        else:
+            expected = False
+        assert pingpong_finish_frame_matches(frame, want) is expected, frame.hex()
+
+
+def test_frame_column_matches_scalar_encoder_for_all_instances():
+    for inst in ALL_INSTANCES:
+        circ = circuit_for(inst)
+        wire = Prio3Wire(circ)
+        rng = np.random.default_rng(23)
+        n = 9
+        jf, ver0, part0 = _random_device_outputs(circ, wire, n, rng)
+        frames = encode_pingpong_share_column(jf, ver0, part0)
+        ver_rows = encode_field_rows(jf, ver0)
+        part_rows = (
+            [row.tobytes() for row in np.asarray(part0, dtype="<u8")]
+            if wire.uses_jr
+            else [None] * n
+        )
+        for i in range(n):
+            assert frames.row(i) == encode_pingpong(
+                PP_INITIALIZE, None, wire.encode_prep_share_raw(ver_rows[i], part_rows[i])
+            ), inst.kind
+
+
+def test_length_prefix_layout_pinned():
+    """The framing layout (u8 tag || u32 BE length || share) is pinned
+    against the codec module's own constants — a drive-by change to
+    either side must fail here, not in an interop lab."""
+    share = b"\xaa" * 7
+    assert encode_pingpong(PP_INITIALIZE, None, share) == b"\x00" + struct.pack(
+        ">I", 7
+    ) + share
