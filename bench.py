@@ -72,6 +72,14 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
     helper_agg = Aggregator(helper_eph.datastore, clock, Config())
     leader_srv = DapServer(DapHttpApp(leader_agg)).start()
     helper_srv = DapServer(DapHttpApp(helper_agg)).start()
+    # the SLO engine runs through the served phase like in the real
+    # binaries (default definitions, fast cadence so the windows hold
+    # real samples by scrape time) — the record's alertz_ok and the
+    # exemplar round-trip come from the live /alertz + OpenMetrics
+    # scrape at the end
+    from janus_tpu import slo as _slo
+
+    _slo.install_slo_engine(_slo.SloEngineConfig(evaluation_interval_s=0.5))
     try:
         collector_kp = generate_hpke_config_and_private_key(config_id=200)
         leader_task = (
@@ -288,11 +296,26 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
         # snapshot even when the accelerator phases stall
         scrape_ok = False
         scrape_errors: list = []
+        alertz_ok = False
+        alertz_firing: list = []
+        exemplar_roundtrip: dict = {}
         try:
             scrape = _scrape_health_listener(ds=leader_eph.datastore)
             scrape["server"].stop()
             scrape_ok = not scrape["errors"]
             scrape_errors = scrape["errors"][:5]
+            alertz = scrape["alertz"]
+            alertz_ok = (
+                alertz.get("enabled") is True
+                and {"firing", "alerts", "slos"} <= set(alertz)
+                and len(alertz["slos"]) >= 5
+                and all("burn_rates" in s for s in alertz["slos"])
+                and not scrape["openmetrics_errors"]
+            )
+            alertz_firing = alertz.get("firing", [])
+            # exemplar resolution over live HTTP: a latency exemplar in
+            # the OpenMetrics scrape links to a /debug/traces capture
+            exemplar_roundtrip = _exemplar_roundtrip(scrape)
         except Exception as e:  # the bench record must survive
             scrape_errors = [f"scrape failed: {e}"]
         return {
@@ -332,6 +355,13 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             },
             "collect_s": round(collect_s, 2),
             "metrics_scrape_valid": scrape_ok,
+            # SLO engine + exemplar surface over the served run (ISSUE
+            # 10): /alertz well-formed with burn rates for every
+            # default SLO, and an OpenMetrics exemplar resolving to a
+            # live /debug/traces span
+            "alertz_ok": alertz_ok,
+            "alertz_firing": alertz_firing,
+            "exemplar_roundtrip": exemplar_roundtrip,
             **({"metrics_scrape_errors": scrape_errors} if scrape_errors else {}),
             # datastore/journal state at the end of the served run (the
             # outage-survival dashboard series; full samples ride the
@@ -342,6 +372,7 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             "metrics_snapshot": _metrics_snapshot_rider(),
         }
     finally:
+        _slo.uninstall_slo_engine()
         try:
             pipeline.close()
         except NameError:
@@ -801,17 +832,84 @@ def _scrape_health_listener(ds=None) -> dict:
         families, _ = parse_exposition(text)
         with urllib.request.urlopen(base + "/statusz", timeout=10) as resp:
             statusz = json.loads(resp.read())
+        # the SLO engine state and the OpenMetrics exemplar mode ride
+        # every scrape record (the served phase distils alertz_ok and
+        # the exemplar round-trip from these)
+        with urllib.request.urlopen(base + "/alertz", timeout=10) as resp:
+            alertz = json.loads(resp.read())
+        with urllib.request.urlopen(base + "/metrics?openmetrics=1", timeout=10) as resp:
+            om_text = resp.read().decode()
+        om_errors = validate_exposition(om_text, openmetrics=True)
+        with urllib.request.urlopen(base + "/debug/traces?limit=10000", timeout=10) as resp:
+            debug_traces = json.loads(resp.read())
         return {
             "base": base,
             "text": text,
             "families": families,
             "errors": errors,
             "statusz": statusz,
+            "alertz": alertz,
+            "openmetrics_text": om_text,
+            "openmetrics_errors": om_errors,
+            "debug_traces": debug_traces,
             "server": srv,
         }
     except BaseException:
         srv.stop()
         raise
+
+
+def _live_trace_ids(traces_doc: dict) -> set:
+    """Trace ids currently resolvable on a /debug/traces snapshot."""
+    return {s["trace_id"] for s in traces_doc.get("recent", ())} | {
+        t["trace_id"] for t in traces_doc.get("slow_traces", ())
+    }
+
+
+def _freshest_resolving_exemplar(exemplars, live_ids) -> tuple:
+    """(trace_id, resolved) over parser exemplar dicts, NEWEST first:
+    a stale exemplar (a slow request from an earlier phase)
+    legitimately outlives the bounded span ring — the claim under test
+    is always that a FRESH exemplar resolves. Shared by the served
+    phase's roundtrip record and the slo_alert smoke."""
+    chosen = None
+    for ex in sorted(exemplars, key=lambda e: e.get("ts") or 0, reverse=True):
+        tid = ex["labels"].get("trace_id")
+        if tid is None:
+            continue
+        chosen = chosen or tid
+        if tid in live_ids:
+            return tid, True
+    return chosen, False
+
+
+def _exemplar_roundtrip(scrape: dict) -> dict:
+    """Resolve the freshest exemplar of each histogram family in the
+    scrape's OpenMetrics text against the same listener's
+    /debug/traces snapshot: {checked, resolved, example_trace_id}."""
+    from janus_tpu.exposition import parse_exposition
+
+    fams, _ = parse_exposition(scrape["openmetrics_text"], openmetrics=True)
+    live_ids = _live_trace_ids(scrape["debug_traces"])
+    checked = resolved = 0
+    example = None
+    for fam in fams.values():
+        exemplars = [ex for _, _, ex in fam.exemplars]
+        if not any(ex["labels"].get("trace_id") for ex in exemplars):
+            continue
+        checked += 1
+        tid, ok = _freshest_resolving_exemplar(exemplars, live_ids)
+        if ok:
+            resolved += 1
+            example = example or tid
+    return {
+        "checked": checked,
+        "resolved": resolved,
+        "example_trace_id": example,
+        # at least one exemplar must exist AND resolve once real spans
+        # have flowed; a ring-evicted older exemplar is not a failure
+        "ok": checked > 0 and resolved > 0,
+    }
 
 
 def _trace_lifecycle_smoke() -> dict:
@@ -987,6 +1085,260 @@ def _trace_lifecycle_smoke() -> dict:
         helper_eph.cleanup()
 
 
+def _slo_alert_smoke() -> dict:
+    """Live proof of the SLO burn-rate engine (ISSUE 10) over loopback
+    HTTP against real listeners: a failpoint-driven 5xx storm on real
+    uploads flips the default upload_availability alert to firing on
+    /alertz (burn rates over threshold, firing_since set,
+    janus_alert_active=1 in /metrics), a latency exemplar from the
+    OpenMetrics scrape resolves against a live /debug/traces capture,
+    recovery clears the alert, scripts/debug_bundle.py produces a tar
+    whose MANIFEST inventories every captured endpoint, and the default
+    scrape stays exemplar-free (bit-compatible)."""
+    import pathlib
+    import subprocess
+    import tarfile
+    import tempfile
+    import urllib.request
+
+    from janus_tpu import failpoints
+    from janus_tpu import metrics as _m
+    from janus_tpu import slo as _slo
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.binary_utils import HealthServer
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.exposition import parse_exposition, validate_exposition
+    from janus_tpu.messages import Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    agg = Aggregator(eph.datastore, clock, Config(ingest_decrypt_workers=2))
+    srv = DapServer(DapHttpApp(agg), max_handler_threads=4).start()
+    health = HealthServer("127.0.0.1:0").start()
+    # the production ladder with every window shrunk 900x: the 1h/5m
+    # page rung becomes 4s/0.33s — observable in a CI smoke without
+    # forking the shipped definitions
+    engine = _slo.install_slo_engine(
+        _slo.SloEngineConfig(
+            evaluation_interval_s=0.05, window_scale=1.0 / 900, budget_window_s=30.0
+        )
+    )
+    base = f"http://127.0.0.1:{health.port}"
+    out: dict = {}
+    try:
+        vdaf = VdafInstance.count()
+        leader_kp = generate_hpke_config_and_private_key(config_id=0)
+        helper_kp = generate_hpke_config_and_private_key(config_id=1)
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=srv.url,
+                helper_aggregator_endpoint=srv.url,
+                hpke_keys=(leader_kp,),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        eph.datastore.run_tx(lambda tx: tx.put_task(task))
+        params = ClientParameters(task.task_id, srv.url, srv.url, task.time_precision)
+        client = Client(params, vdaf, leader_kp.config, helper_kp.config, clock=clock)
+        http = HttpClient()
+
+        def upload_once() -> int:
+            report = client.prepare_report(1)
+            status, _ = http.put(
+                params.upload_uri(),
+                report.to_bytes(),
+                {"Content-Type": "application/dap-report"},
+            )
+            return status
+
+        def get_json(path: str) -> dict:
+            with urllib.request.urlopen(base + path, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        def upload_alerts(doc: dict) -> dict:
+            return {
+                a["severity"]: a
+                for a in doc["alerts"]
+                if a["alert"] == "upload_availability"
+            }
+
+        # --- healthy baseline: real 201s, no alert ---
+        good_statuses = [upload_once() for _ in range(3)]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if upload_alerts(get_json("/alertz")):
+                break
+            time.sleep(0.05)
+        baseline = upload_alerts(get_json("/alertz"))
+        out["baseline_statuses"] = good_statuses
+        out["baseline_firing"] = sorted(
+            s for s, a in baseline.items() if a["state"] == "firing"
+        )
+
+        # --- failpoint-driven 5xx storm: the report-write flush fails,
+        # so REAL uploads (admitted, decrypted) answer 500 ---
+        failpoints.configure("report_writer.flush=error")
+        storm_statuses = []
+        try:
+            deadline = time.monotonic() + 20
+            fired = None
+            while time.monotonic() < deadline:
+                storm_statuses.append(upload_once())
+                doc = get_json("/alertz")
+                page = upload_alerts(doc).get("page")
+                if page and page["state"] == "firing":
+                    fired = (doc, page)
+                    break
+                time.sleep(0.05)
+        finally:
+            failpoints.clear()
+        out["storm_statuses_5xx"] = sum(1 for s in storm_statuses if 500 <= s < 600)
+        out["alert_fired"] = fired is not None
+        if fired:
+            doc, page = fired
+            out["burn_rate_long"] = page["burn_rate_long"]
+            out["burn_rate_short"] = page["burn_rate_short"]
+            out["burn_rate_threshold"] = page["burn_rate_threshold"]
+            out["burn_over_threshold"] = (
+                page["burn_rate_long"] >= page["burn_rate_threshold"]
+                and page["burn_rate_short"] >= page["burn_rate_threshold"]
+            )
+            out["firing_since_set"] = page["firing_since_unix"] is not None
+            out["alertz_firing_list"] = doc["firing"]
+            slo_doc = next(
+                s for s in doc["slos"] if s["name"] == "upload_availability"
+            )
+            out["budget_remaining_while_firing"] = slo_doc[
+                "error_budget_remaining_ratio"
+            ]
+            out["evidence_present"] = bool(slo_doc["evidence"])
+
+        # --- janus_alert_active visible in the default /metrics scrape
+        # (and the default scrape stays exemplar-free) ---
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            default_text = resp.read().decode()
+        fams, _ = parse_exposition(default_text)
+        active = fams.get("janus_alert_active")
+        out["alert_active_in_metrics"] = any(
+            labels.get("alert") == "upload_availability"
+            and labels.get("severity") == "page"
+            and v == 1.0
+            for _, labels, v in (active.samples if active else [])
+        )
+        # re-reading the default scrape WITH exemplar parsing must find
+        # none (a substring test would false-positive on a legal label
+        # value containing ' # {')
+        leak_fams, _ = parse_exposition(default_text, openmetrics=True)
+        out["default_scrape_exemplar_free"] = not any(
+            f.exemplars for f in leak_fams.values()
+        )
+        out["default_scrape_valid"] = not validate_exposition(default_text)
+
+        # --- exemplar round-trip: an upload-route latency exemplar from
+        # the OpenMetrics scrape resolves to a live /debug/traces span ---
+        with urllib.request.urlopen(
+            base + "/metrics?openmetrics=1", timeout=10
+        ) as resp:
+            om_text = resp.read().decode()
+            om_ctype = resp.headers.get("Content-Type", "")
+        out["openmetrics_content_type_ok"] = om_ctype.startswith(
+            "application/openmetrics-text"
+        )
+        om_errors = validate_exposition(om_text, openmetrics=True)
+        out["openmetrics_scrape_valid"] = not om_errors
+        out["openmetrics_errors"] = om_errors[:3]
+        om_fams, _ = parse_exposition(om_text, openmetrics=True)
+        dur = om_fams.get("janus_http_request_duration_seconds")
+        upload_exemplars = [
+            ex
+            for _, labels, ex in (dur.exemplars if dur else [])
+            if labels.get("route") == "upload"
+        ]
+        out["upload_exemplar_count"] = len(upload_exemplars)
+        resolved = False
+        exemplar_trace = None
+        if upload_exemplars:
+            exemplar_trace, resolved = _freshest_resolving_exemplar(
+                upload_exemplars,
+                _live_trace_ids(get_json("/debug/traces?limit=10000")),
+            )
+        out["exemplar_trace_id"] = exemplar_trace
+        out["exemplar_resolves_in_debug_traces"] = resolved
+
+        # --- recovery: healthy uploads, the windows slide past the
+        # storm, the alert clears and the gauge drops to 0 ---
+        deadline = time.monotonic() + 20
+        cleared = False
+        while time.monotonic() < deadline:
+            upload_once()
+            doc = get_json("/alertz")
+            if not any(
+                a["state"] == "firing" for a in upload_alerts(doc).values()
+            ):
+                cleared = True
+                break
+            time.sleep(0.2)
+        out["alert_cleared_after_recovery"] = cleared
+        out["alert_active_gauge_after_recovery"] = _m.alert_active.get(
+            alert="upload_availability", severity="page"
+        )
+
+        # --- one-command incident debug bundle against the live
+        # listener: every endpoint captured, MANIFEST inventories them ---
+        repo = pathlib.Path(__file__).resolve().parent
+        with tempfile.TemporaryDirectory() as td:
+            bundle_path = os.path.join(td, "bundle.tar.gz")
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    str(repo / "scripts" / "debug_bundle.py"),
+                    "--url",
+                    base,
+                    "--out",
+                    bundle_path,
+                ],
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
+            out["bundle_rc"] = proc.returncode
+            out["bundle_err"] = proc.stderr[-300:] if proc.returncode else ""
+            if proc.returncode == 0:
+                from janus_tpu.tools.debug_bundle import ENDPOINTS
+
+                with tarfile.open(bundle_path) as tar:
+                    names = tar.getnames()
+                    manifest_name = next(
+                        n for n in names if n.endswith("MANIFEST.json")
+                    )
+                    manifest = json.loads(
+                        tar.extractfile(manifest_name).read()
+                    )
+                target = next(iter(manifest["targets"].values()))
+                captured = target["endpoints"]
+                out["bundle_endpoints_captured"] = sorted(captured)
+                out["bundle_manifest_complete"] = all(
+                    name in captured and captured[name].get("status") is not None
+                    for name, _ in ENDPOINTS
+                )
+                out["bundle_files"] = len(manifest["files"])
+        return out
+    finally:
+        _slo.uninstall_slo_engine()
+        health.stop()
+        srv.stop()
+        eph.cleanup()
+
+
 def _observability_smoke() -> dict:
     """Drive the full observability surface on CPU and prove the
     acceptance criteria end-to-end: the live health listener's /metrics
@@ -1026,6 +1378,10 @@ def _observability_smoke() -> dict:
     # the report-lifecycle tracing smoke runs FIRST so its e2e series
     # and flight-recorder state are live in the scrape below
     trace_lifecycle = _trace_lifecycle_smoke()
+
+    # the SLO burn-rate engine's live proof (ISSUE 10): 5xx storm ->
+    # /alertz firing -> exemplar round-trip -> recovery -> debug bundle
+    slo_alert = _slo_alert_smoke()
 
     # a label value that would corrupt an unescaped scrape
     _m.aggregate_step_failure_counter.add(type='hostile"label\nvalue\\end')
@@ -1198,6 +1554,7 @@ def _observability_smoke() -> dict:
             "scrape_check_rc": check.returncode,
             "scrape_check_err": check.stderr[-500:] if check.returncode else "",
             "trace_lifecycle": trace_lifecycle,
+            "slo_alert": slo_alert,
         }
     finally:
         srv.stop()

@@ -236,7 +236,7 @@ class DapHttpApp:
 
         from .. import metrics
 
-        from ..trace import adopt_traceparent, reset_traceparent, span
+        from ..trace import adopt_traceparent, current_context, reset_traceparent, span
 
         route = "none"
         for m, rx, name in _ROUTES:
@@ -250,12 +250,21 @@ class DapHttpApp:
         tp_token = adopt_traceparent(
             next((v for k, v in headers.items() if k.lower() == "traceparent"), None)
         )
+        exemplar_ctx = None
         try:
             with span(f"dap.{route}", method=method):
+                # the request span's trace id becomes the latency
+                # histogram sample's exemplar (the span itself has
+                # already reset its context by observation time below)
+                exemplar_ctx = current_context()
                 result = self._handle(method, path, query, headers, body)
         finally:
             reset_traceparent(tp_token)
-        metrics.http_request_duration.observe(monotonic() - start, route=route)
+        metrics.http_request_duration.observe(
+            monotonic() - start,
+            exemplar_trace_id=exemplar_ctx[0] if exemplar_ctx else None,
+            route=route,
+        )
         metrics.http_request_counter.add(route=route, status=str(result[0]))
         if len(result) == 3:
             result = result + ({},)

@@ -35,6 +35,42 @@ log = logging.getLogger(__name__)
 # Prometheus text exposition content type (version 0.0.4); the charset
 # matters — label values may carry escaped non-ASCII task ids/errors.
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# The OpenMetrics exposition mode (?openmetrics=1 or Accept-negotiated):
+# same families plus histogram exemplars and the # EOF terminator.
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+# GET / on the health listener: a tiny discovery page so an operator
+# pointed at a port can find every endpoint from a browser (previously
+# a bare 404).
+_INDEX_ENDPOINTS = (
+    ("/healthz", "liveness (always 200 while the process runs)"),
+    ("/readyz", "readiness (503 + JSON reasons while degraded)"),
+    ("/metrics", "Prometheus text exposition"),
+    ("/metrics?openmetrics=1", "OpenMetrics mode with trace exemplars"),
+    ("/statusz", "process status snapshot (JSON; ?format=html)"),
+    ("/alertz", "SLO burn-rate engine: alert state, budgets, evidence"),
+    ("/debug/vars", "raw metrics-registry JSON dump"),
+    ("/debug/traces", "flight recorder: recent spans, slow traces, digests"),
+)
+
+
+def _render_index() -> bytes:
+    import html as _html
+
+    rows = "".join(
+        f'<li><a href="{path}"><code>{_html.escape(path)}</code></a>'
+        f" — {_html.escape(desc)}</li>"
+        for path, desc in _INDEX_ENDPOINTS
+    )
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<title>janus_tpu health listener</title>"
+        "<style>body{font-family:monospace;margin:2em;}li{margin:0.3em 0;}</style>"
+        "</head><body><h1>janus_tpu health listener</h1>"
+        f"<ul>{rows}</ul>"
+        "<p>POST /debug/profile?seconds=N opens an on-demand profiler "
+        "capture window.</p></body></html>"
+    ).encode()
 
 
 # ---------------------------------------------------------------------------
@@ -286,6 +322,20 @@ class HealthServer:
                 query = dict(parse_qsl(parts.query))
                 if parts.path == "/healthz":
                     self._send(200, "text/plain", b"")
+                elif parts.path in ("/", "/index.html"):
+                    self._send(200, "text/html; charset=utf-8", _render_index())
+                elif parts.path == "/alertz":
+                    # in-process SLO burn-rate engine state (installed
+                    # by janus_main from the YAML `slo:` stanza; a
+                    # process without one answers a well-formed
+                    # disabled document)
+                    from .slo import alertz_snapshot
+
+                    self._send(
+                        200,
+                        "application/json",
+                        _json.dumps(alertz_snapshot(), default=str).encode(),
+                    )
                 elif parts.path == "/readyz":
                     ready, reasons = readiness_snapshot()
                     body = {"ready": ready}
@@ -297,7 +347,18 @@ class HealthServer:
                         _json.dumps(body).encode(),
                     )
                 elif parts.path == "/metrics":
-                    self._send(200, METRICS_CONTENT_TYPE, REGISTRY.render().encode())
+                    # OpenMetrics mode (exemplar syntax + # EOF) via
+                    # ?openmetrics=1 or Accept negotiation; the default
+                    # scrape's bytes are unaffected by stored exemplars
+                    openmetrics = query.get("openmetrics") == "1" or (
+                        "application/openmetrics-text"
+                        in (self.headers.get("Accept") or "")
+                    )
+                    self._send(
+                        200,
+                        OPENMETRICS_CONTENT_TYPE if openmetrics else METRICS_CONTENT_TYPE,
+                        REGISTRY.render(openmetrics=openmetrics).encode(),
+                    )
                 elif parts.path == "/statusz":
                     snap = status_snapshot()
                     wants_html = query.get("format") == "html" or "text/html" in (
@@ -501,6 +562,14 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
     common: CommonConfig = cfg.common
     install_trace_subscriber(common.logging_config)
 
+    # refresh janus_build_info with the YAML-configured backend (the
+    # import-time registration guessed from the environment)
+    from .metrics import register_build_info
+
+    register_build_info(
+        backend=common.jax_platform or os.environ.get("JAX_PLATFORMS")
+    )
+
     # fault injection: JANUS_FAILPOINTS env wins over the YAML
     # `failpoints:` key; unset/empty compiles every site to a no-op.
     # Always on /statusz so an operator can see at a glance whether a
@@ -624,6 +693,15 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         else:
             warmup_engines(ds)
 
+    # in-process SLO burn-rate engine (YAML `slo:` stanza; ISSUE 10):
+    # evaluates the burn-rate ladder over the live registry and serves
+    # GET /alertz + the `slo` statusz section on the health listener
+    from . import slo as slo_mod
+
+    slo_engine = None
+    if common.slo.enabled:
+        slo_engine = slo_mod.install_slo_engine(common.slo)
+
     stopper = Stopper()
     if install_signals:
         setup_signal_handler(stopper)
@@ -633,6 +711,8 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         return run(cfg, ds, stopper)
     finally:
         health.stop()
+        if slo_engine is not None:
+            slo_mod.uninstall_slo_engine()
         # teardown ordering against interpreter finalization — a daemon
         # thread running REAL device work while the interpreter
         # finalizes crashes inside native XLA: (1) stop engine canary

@@ -18,6 +18,7 @@ from .aggregator.aggregation_job_creator import AggregationJobCreatorConfig
 from .aggregator.job_driver import JobDriverConfig
 from .aggregator.step_pipeline import StepPipelineConfig
 from .core.circuit_breaker import CircuitBreakerConfig
+from .slo import SloEngineConfig
 from .trace import TraceConfiguration
 
 
@@ -115,6 +116,11 @@ class CommonConfig:
     watchdog_abandoned_thread_cap: int = 8
     quarantine_canary_delay_secs: float = 5.0
     quarantine_canary_timeout_secs: float = 30.0
+    # In-process SLO burn-rate engine (YAML `slo:` section;
+    # docs/OBSERVABILITY.md "SLO engine & /alertz"): evaluation cadence
+    # and alert definitions (merged over the shipped defaults by name).
+    # Enabled by default — every binary answers GET /alertz.
+    slo: SloEngineConfig = field(default_factory=SloEngineConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -134,6 +140,7 @@ class CommonConfig:
             watchdog_abandoned_thread_cap=int(wd.get("abandoned_thread_cap", 8)),
             quarantine_canary_delay_secs=float(wd.get("canary_delay_secs", 5.0)),
             quarantine_canary_timeout_secs=float(wd.get("canary_timeout_secs", 30.0)),
+            slo=SloEngineConfig.from_dict(d.get("slo")),
         )
 
 

@@ -12,8 +12,10 @@ disagree about what "scrape-valid" means:
 
 Covers the subset of the format janus_tpu.metrics emits: # HELP /
 # TYPE comments, samples with escaped label values, histogram
-_bucket/_sum/_count families. Not a general-purpose OpenMetrics
-parser.
+_bucket/_sum/_count families, and — in OpenMetrics mode
+(openmetrics=True, the `?openmetrics=1` exposition) — histogram-bucket
+exemplars (`... # {trace_id="..."} value ts`) plus the `# EOF`
+terminator. Not a general-purpose OpenMetrics parser.
 """
 
 from __future__ import annotations
@@ -55,6 +57,9 @@ class Family:
     help: str = ""
     # [(sample_name, labels dict, value)]
     samples: list = field(default_factory=list)
+    # OpenMetrics mode: [(sample_name, labels dict, exemplar dict)]
+    # where exemplar = {"labels": {...}, "value": float, "ts": float|None}
+    exemplars: list = field(default_factory=list)
 
 
 def _parse_labels(raw: str, errors: list[str], where: str) -> dict:
@@ -108,11 +113,100 @@ def _parse_value(raw: str) -> float:
     return float(raw)
 
 
-def parse_exposition(text: str) -> tuple[dict[str, Family], list[str]]:
+def _split_unquoted_hash(line: str) -> tuple[str, str | None]:
+    """Split a sample line at the first '#' that sits OUTSIDE a quoted
+    label value (the OpenMetrics exemplar marker). Returns
+    (base, exemplar_clause or None); a '#' inside a label value —
+    hostile task ids are legal — never splits."""
+    in_q = False
+    i, n = 0, len(line)
+    while i < n:
+        c = line[i]
+        if c == "\\" and in_q:
+            i += 2
+            continue
+        if c == '"':
+            in_q = not in_q
+        elif c == "#" and not in_q:
+            return line[:i].rstrip(), line[i + 1 :].strip()
+        i += 1
+    return line, None
+
+
+# OpenMetrics spec: the combined rune length of an exemplar's label
+# names and values must not exceed 128
+_EXEMPLAR_MAX_RUNES = 128
+
+
+def _parse_exemplar(clause: str, errors: list[str], where: str) -> dict | None:
+    """Parse `{labels} value [ts]` (the clause after the unquoted '#').
+    Appends errors and returns None when malformed."""
+    if not clause.startswith("{"):
+        errors.append(f"{where}: malformed exemplar clause {clause!r}")
+        return None
+    # find the matching close brace outside quoted values
+    in_q = False
+    end = -1
+    i = 1
+    while i < len(clause):
+        c = clause[i]
+        if c == "\\" and in_q:
+            i += 2
+            continue
+        if c == '"':
+            in_q = not in_q
+        elif c == "}" and not in_q:
+            end = i
+            break
+        i += 1
+    if end < 0:
+        errors.append(f"{where}: unterminated exemplar label set")
+        return None
+    label_errors: list[str] = []
+    labels = (
+        _parse_labels(clause[1:end], label_errors, where) if end > 1 else {}
+    )
+    if label_errors:
+        errors.extend(label_errors)
+        return None
+    runes = sum(len(k) + len(v) for k, v in labels.items())
+    if runes > _EXEMPLAR_MAX_RUNES:
+        errors.append(
+            f"{where}: exemplar label set exceeds {_EXEMPLAR_MAX_RUNES} runes"
+        )
+        return None
+    rest = clause[end + 1 :].split()
+    if not rest or len(rest) > 2:
+        errors.append(f"{where}: exemplar needs `value [timestamp]`, got {clause!r}")
+        return None
+    try:
+        value = _parse_value(rest[0])
+    except ValueError:
+        errors.append(f"{where}: unparseable exemplar value {rest[0]!r}")
+        return None
+    ts = None
+    if len(rest) == 2:
+        try:
+            ts = float(rest[1])
+        except ValueError:
+            errors.append(f"{where}: unparseable exemplar timestamp {rest[1]!r}")
+            return None
+    return {"labels": labels, "value": value, "ts": ts}
+
+
+def parse_exposition(
+    text: str, openmetrics: bool = False
+) -> tuple[dict[str, Family], list[str]]:
     """-> ({family name: Family}, [error strings]). Sample names like
-    foo_bucket/_sum/_count attach to their histogram family `foo`."""
+    foo_bucket/_sum/_count attach to their histogram family `foo`.
+    With openmetrics=True, histogram-bucket/counter exemplars are
+    parsed into Family.exemplars (malformed ones are errors) and a
+    `# EOF` terminator line is accepted; in the default mode any
+    exemplar clause is a parse error — the stock scrape must stay
+    bit-compatible with the 0.0.4 text format."""
     families: dict[str, Family] = {}
     errors: list[str] = []
+    saw_eof = False
 
     def family_for(sample_name: str) -> Family | None:
         if sample_name in families:
@@ -130,6 +224,12 @@ def parse_exposition(text: str) -> tuple[dict[str, Family], list[str]]:
         if not line.strip():
             continue
         where = f"line {lineno}"
+        if saw_eof:
+            errors.append(f"{where}: content after # EOF")
+            break
+        if openmetrics and line.strip() == "# EOF":
+            saw_eof = True
+            continue
         if line.startswith("# HELP "):
             parts = line[len("# HELP ") :].split(" ", 1)
             name = parts[0]
@@ -154,6 +254,14 @@ def parse_exposition(text: str) -> tuple[dict[str, Family], list[str]]:
         elif line.startswith("#"):
             continue  # other comments are legal
         else:
+            exemplar = None
+            if openmetrics:
+                base, clause = _split_unquoted_hash(line)
+                if clause is not None:
+                    exemplar = _parse_exemplar(clause, errors, where)
+                    if exemplar is None:
+                        continue
+                    line = base
             m = _SAMPLE_RE.match(line)
             if not m:
                 errors.append(f"{where}: unparseable sample {line!r}")
@@ -173,7 +281,32 @@ def parse_exposition(text: str) -> tuple[dict[str, Family], list[str]]:
             if fam is None:
                 errors.append(f"{where}: sample {name!r} has no # TYPE family")
                 continue
+            if exemplar is not None:
+                # OpenMetrics allows exemplars on histogram buckets and
+                # counters only — and a bucket exemplar must sit within
+                # its bucket's bound
+                if name.endswith("_bucket") and fam.type == "histogram":
+                    le = labels.get("le")
+                    try:
+                        bound = _parse_value(le) if le is not None else math.inf
+                    except ValueError:
+                        bound = math.inf
+                    if exemplar["value"] > bound:
+                        errors.append(
+                            f"{where}: exemplar value {exemplar['value']:g} above "
+                            f"bucket bound le={le}"
+                        )
+                        continue
+                elif fam.type != "counter":
+                    errors.append(
+                        f"{where}: exemplar on a {fam.type} sample {name!r} "
+                        "(only histogram buckets and counters may carry one)"
+                    )
+                    continue
+                fam.exemplars.append((name, labels, exemplar))
             fam.samples.append((name, labels, value))
+    if openmetrics and not saw_eof:
+        errors.append("missing # EOF terminator (OpenMetrics mode)")
     return families, errors
 
 
@@ -219,10 +352,11 @@ def _histogram_errors(fam: Family) -> list[str]:
     return errors
 
 
-def validate_exposition(text: str) -> list[str]:
+def validate_exposition(text: str, openmetrics: bool = False) -> list[str]:
     """Full scrape validation: parse errors + per-family semantic checks.
-    Empty list = scrape-valid."""
-    families, errors = parse_exposition(text)
+    Empty list = scrape-valid. openmetrics=True validates the exemplar
+    exposition mode (exemplar syntax + # EOF terminator)."""
+    families, errors = parse_exposition(text, openmetrics=openmetrics)
     for fam in families.values():
         if fam.type == "histogram":
             errors.extend(_histogram_errors(fam))

@@ -10,7 +10,11 @@ the health/metrics listener in janus_tpu.binary_utils.
 
 from __future__ import annotations
 
+import os
+import re
+import sys
 import threading
+import time
 from bisect import bisect_left
 from collections import defaultdict
 
@@ -27,6 +31,51 @@ def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
         return ""
     inner = ",".join(f'{k}="{_escape_label_value(str(v))}"' for k, v in labels)
     return "{" + inner + "}"
+
+
+# ---------------------------------------------------------------------------
+# Label matchers: the SLO engine (janus_tpu/slo.py) selects registry
+# series by {label: matcher} where a matcher value is an exact string,
+# a "~regex" (fullmatch), or a list of exact alternatives. Compiled
+# once per SLO definition; absent labels never match.
+# ---------------------------------------------------------------------------
+
+
+def compile_matchers(matchers: dict | None) -> tuple:
+    """{label: "v" | "~regex" | [alts]} -> immutable compiled form for
+    labels_match (regexes pre-compiled)."""
+    out = []
+    for k, v in sorted((matchers or {}).items()):
+        if isinstance(v, (list, tuple)):
+            out.append((k, "in", frozenset(str(x) for x in v)))
+        elif isinstance(v, str) and v.startswith("~"):
+            out.append((k, "re", re.compile(v[1:])))
+        else:
+            out.append((k, "eq", str(v)))
+    return tuple(out)
+
+
+def labels_match(key: tuple[tuple[str, str], ...], compiled: tuple) -> bool:
+    """True when every compiled matcher accepts the label set `key`
+    (a metric-store key: sorted (name, value) tuples)."""
+    if not compiled:
+        return True
+    d = dict(key)
+    for name, kind, want in compiled:
+        got = d.get(name)
+        if got is None:
+            return False
+        got = str(got)
+        if kind == "eq":
+            if got != want:
+                return False
+        elif kind == "in":
+            if got not in want:
+                return False
+        else:  # "re"
+            if not want.fullmatch(got):
+                return False
+    return True
 
 
 class Counter:
@@ -52,6 +101,20 @@ class Counter:
         """Sum across all label sets (shed accounting in bench/tests)."""
         with self._lock:
             return sum(self._values.values())
+
+    def sum_matching(self, compiled: tuple) -> tuple[float, int]:
+        """(sum, matched series count) over label sets accepted by the
+        compiled matchers (compile_matchers). The count lets a caller
+        distinguish "0 because idle" from "0 because the series does
+        not exist yet" — the SLO engine treats the latter as no-data."""
+        total = 0.0
+        n = 0
+        with self._lock:
+            for key, v in self._values.items():
+                if labels_match(key, compiled):
+                    total += v
+                    n += 1
+        return total, n
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -92,6 +155,17 @@ class Gauge:
         with self._lock:
             return sum(self._values.values())
 
+    def sum_matching(self, compiled: tuple) -> tuple[float, int]:
+        """(sum, matched series count) — see Counter.sum_matching."""
+        total = 0.0
+        n = 0
+        with self._lock:
+            for key, v in self._values.items():
+                if labels_match(key, compiled):
+                    total += v
+                    n += 1
+        return total, n
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
@@ -110,7 +184,20 @@ DEFAULT_BUCKETS = (
 )
 
 
+def _exemplar_trace_hex(raw) -> str:
+    """Hex form of a stored exemplar trace id (raw int for locally
+    generated spans, hex str when adopted from a traceparent)."""
+    return raw if isinstance(raw, str) else f"{raw:032x}"
+
+
 class Histogram:
+    # Bound on the (label set, bucket) exemplar store per histogram:
+    # exemplars are a debugging aid (a firing latency alert links to a
+    # concrete /debug/traces capture), never an unbounded cardinality
+    # vector. Past the cap, NEW label sets stop collecting exemplars;
+    # existing ones keep last-write semantics.
+    MAX_EXEMPLAR_LABEL_SETS = 64
+
     def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
         self.name = name
         self.help = help_
@@ -119,34 +206,130 @@ class Histogram:
         self._counts: dict[tuple[tuple[str, str], ...], list[int]] = {}
         self._sums: dict[tuple[tuple[str, str], ...], float] = defaultdict(float)
         self._totals: dict[tuple[tuple[str, str], ...], int] = defaultdict(int)
+        # {label key: {bucket idx: (trace_id raw, value, unix_ts)}};
+        # bucket idx == len(buckets) is the +Inf bucket. Last write
+        # wins — the freshest trace for "what blew this bucket".
+        self._exemplars: dict[tuple, dict[int, tuple]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar_trace_id=None, **labels) -> None:
+        """Record `value`. An exemplar trace id is attached to the
+        observed bucket when given explicitly (the span->metric bridge
+        passes the exiting span's trace id) or when an ambient trace
+        context is live on this thread (trace.current_context) — so a
+        latency histogram sample can be resolved to a concrete
+        /debug/traces capture. Rendered only in OpenMetrics mode; the
+        default exposition stays bit-compatible."""
         key = tuple(sorted(labels.items()))
         # first bucket with bound >= value; == len(buckets) -> only +Inf
         idx = bisect_left(self.buckets, value)
+        if exemplar_trace_id is None:
+            ctx = _trace_context()
+            if ctx is not None:
+                exemplar_trace_id = ctx[0]
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             if idx < len(self.buckets):
                 counts[idx] += 1
             self._sums[key] += value
             self._totals[key] += 1
+            if exemplar_trace_id is not None:
+                slot = self._exemplars.get(key)
+                if slot is None:
+                    if len(self._exemplars) >= self.MAX_EXEMPLAR_LABEL_SETS:
+                        return
+                    slot = self._exemplars[key] = {}
+                slot[idx] = (exemplar_trace_id, value, time.time())
 
-    def render(self) -> str:
+    def le_total_matching(self, le: float, compiled: tuple) -> tuple[float, float, int]:
+        """(observations <= bucket bound `le`, total observations,
+        matched series count) summed over the label sets accepted by
+        `compiled` (compile_matchers). `le` must be one of this
+        histogram's bucket bounds (use nearest_bucket_le); the SLO
+        engine's latency signals read good/total from here."""
+        idx = bisect_left(self.buckets, le)
+        good = 0.0
+        total = 0.0
+        n = 0
+        with self._lock:
+            for key, counts in self._counts.items():
+                if labels_match(key, compiled):
+                    good += sum(counts[: idx + 1])
+                    total += self._totals[key]
+                    n += 1
+        return good, total, n
+
+    def nearest_bucket_le(self, threshold_s: float) -> float:
+        """Smallest bucket bound >= threshold_s (the effective latency
+        threshold — an SLO threshold between bounds rounds UP so "under
+        threshold" never overcounts good events). Falls back to the
+        largest finite bound when the threshold exceeds every bucket."""
+        idx = bisect_left(self.buckets, threshold_s)
+        return self.buckets[min(idx, len(self.buckets) - 1)]
+
+    def exemplars(self) -> list[dict]:
+        """Snapshot of the stored exemplars (debug bundle / tests):
+        [{labels, le, trace_id, value, ts}]."""
+        out = []
+        with self._lock:
+            items = [
+                (key, dict(slots)) for key, slots in sorted(self._exemplars.items())
+            ]
+        for key, slots in items:
+            for idx, (tid, value, ts) in sorted(slots.items()):
+                le = f"{self.buckets[idx]:g}" if idx < len(self.buckets) else "+Inf"
+                out.append(
+                    {
+                        "labels": _labels_dict(key),
+                        "le": le,
+                        "trace_id": _exemplar_trace_hex(tid),
+                        "value": value,
+                        "ts": ts,
+                    }
+                )
+        return out
+
+    def _exemplar_suffix(self, key: tuple, idx: int) -> str:
+        """OpenMetrics exemplar clause for bucket `idx` of label set
+        `key` (lock held), or ''."""
+        slot = self._exemplars.get(key)
+        if not slot or idx not in slot:
+            return ""
+        tid, value, ts = slot[idx]
+        return f' # {{trace_id="{_exemplar_trace_hex(tid)}"}} {value:g} {ts:.3f}'
+
+    def render(self, openmetrics: bool = False) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
             keys = sorted(self._counts)
             for key in keys:
                 cum = 0
-                for b, c in zip(self.buckets, self._counts[key]):
+                for i, (b, c) in enumerate(zip(self.buckets, self._counts[key])):
                     cum += c
                     lbl = _fmt_labels(key + (("le", f"{b:g}"),))
-                    lines.append(f"{self.name}_bucket{lbl} {cum}")
+                    ex = self._exemplar_suffix(key, i) if openmetrics else ""
+                    lines.append(f"{self.name}_bucket{lbl} {cum}{ex}")
+                ex = (
+                    self._exemplar_suffix(key, len(self.buckets))
+                    if openmetrics
+                    else ""
+                )
                 lines.append(
-                    f'{self.name}_bucket{_fmt_labels(key + (("le", "+Inf"),))} {self._totals[key]}'
+                    f'{self.name}_bucket{_fmt_labels(key + (("le", "+Inf"),))} {self._totals[key]}{ex}'
                 )
                 lines.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
                 lines.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
         return "\n".join(lines)
+
+
+def _trace_context():
+    """Lazy indirection to trace.current_context (importing trace at
+    module level here would cycle: trace's import tail feeds the
+    span->metric bridge registrations from this module)."""
+    global _trace_context
+    from .trace import current_context
+
+    _trace_context = current_context
+    return current_context()
 
 
 def _labels_dict(key: tuple[tuple[str, str], ...]) -> dict:
@@ -202,8 +385,24 @@ class MetricsRegistry:
         with self._lock:
             return list(self._metrics.values())
 
-    def render(self) -> str:
-        return "\n".join(m.render() for m in self.metrics_list()) + "\n"
+    def get(self, name: str):
+        """The registered metric object named `name`, or None (the SLO
+        engine resolves YAML-named series lazily per tick)."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition. With openmetrics=True, histogram
+        buckets additionally carry their stored exemplars in OpenMetrics
+        exemplar syntax and the output ends with `# EOF`; the default
+        mode's bytes are unaffected by any stored exemplar."""
+        parts = [
+            m.render(openmetrics) if isinstance(m, Histogram) else m.render()
+            for m in self.metrics_list()
+        ]
+        if openmetrics:
+            parts.append("# EOF")
+        return "\n".join(parts) + "\n"
 
     def snapshot(self) -> dict:
         """JSON-shaped dump of every metric (the /debug/vars payload and
@@ -517,6 +716,95 @@ unaggregated_report_age_quantiles = REGISTRY.gauge(
     "aggregation job (sampled; the freshness distribution behind the "
     "oldest-report gauge)",
 )
+
+# --- in-process SLO burn-rate engine (janus_tpu/slo.py; ISSUE 10,
+# docs/OBSERVABILITY.md "SLO engine & /alertz") ---
+alert_active = REGISTRY.gauge(
+    "janus_alert_active",
+    "1 while the named burn-rate alert is firing, 0 otherwise "
+    "(evaluated in-process by the SLO engine; the full state — burn "
+    "rates, budget, firing-since, evidence — is GET /alertz)",
+)
+slo_error_budget_remaining = REGISTRY.gauge(
+    "janus_slo_error_budget_remaining_ratio",
+    "fraction of the SLO's error budget left over its budget window "
+    "(1 = untouched, 0 = exhausted, negative = overspent)",
+)
+slo_burn_rate = REGISTRY.gauge(
+    "janus_slo_burn_rate",
+    "error-budget burn rate per SLO and evaluation window (1.0 = "
+    "spending exactly the budget; the SRE-workbook ladder pages at "
+    "14.4x over 1h and tickets at 6x over 6h)",
+)
+
+# --- standard process/build families scrapers expect (janus_-prefixed
+# per the repo naming lint; populated by register_build_info at import
+# and refreshed by janus_main once the configured backend is known) ---
+build_info = REGISTRY.gauge(
+    "janus_build_info",
+    "constant 1, with the build identity as labels "
+    "(version/python/jax/backend) — join against it in dashboards",
+)
+process_start_time_seconds = REGISTRY.gauge(
+    "janus_process_start_time_seconds",
+    "unix time this process started (kernel starttime when /proc is "
+    "available; import time otherwise) — rate() windows and restart "
+    "detection key off it",
+)
+
+_IMPORT_TIME = time.time()
+
+
+def _process_start_time() -> float:
+    """Kernel-reported process start (field 22 of /proc/self/stat,
+    ticks since boot, plus /proc/stat btime); falls back to this
+    module's import time off Linux."""
+    try:
+        with open("/proc/self/stat") as f:
+            stat = f.read()
+        # comm may contain spaces/parens: fields start after the last ')'
+        fields = stat.rsplit(")", 1)[1].split()
+        start_ticks = float(fields[19])  # field 22 overall
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("btime "):
+                    btime = float(line.split()[1])
+                    break
+            else:
+                return _IMPORT_TIME
+        return btime + start_ticks / os.sysconf("SC_CLK_TCK")
+    except Exception:
+        return _IMPORT_TIME
+
+
+def register_build_info(backend: str | None = None) -> None:
+    """(Re-)populate janus_build_info / janus_process_start_time_seconds.
+    Called at import with the environment's backend guess; janus_main
+    calls it again once the YAML-configured jax_platform is known. The
+    gauge is exclusive: re-registering zeroes the previous label set so
+    two backends never both read 1."""
+    from . import __version__
+
+    try:
+        import importlib.metadata
+
+        jax_version = importlib.metadata.version("jax")
+    except Exception:
+        jax_version = "unknown"
+    with build_info._lock:
+        for key in list(build_info._values):
+            build_info._values[key] = 0.0
+    build_info.set(
+        1,
+        version=__version__,
+        python="%d.%d.%d" % sys.version_info[:3],
+        jax=jax_version,
+        backend=backend or os.environ.get("JAX_PLATFORMS", "") or "default",
+    )
+    process_start_time_seconds.set(_process_start_time())
+
+
+register_build_info()
 
 
 def _register_span_bridges() -> None:

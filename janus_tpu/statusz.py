@@ -54,7 +54,11 @@ def status_snapshot() -> dict:
 
 def render_statusz_html(snapshot: dict) -> str:
     """Minimal dependency-free HTML view of the snapshot (one <section>
-    per provider, pretty-printed JSON bodies)."""
+    per provider, pretty-printed JSON bodies). Every provider-supplied
+    string — section names and values alike — must pass through
+    html.escape before it reaches the page: hostile label values (a
+    task id carrying <script>) render inert, pinned by
+    tests/test_metrics_exposition.py::test_statusz_html_escapes_hostile_values."""
     parts = [
         "<!doctype html><html><head><meta charset='utf-8'>",
         "<title>janus_tpu statusz</title>",

@@ -1,2 +1,4 @@
 """Operator CLIs (reference tools/src/bin/): collect, dap_decode,
-hpke_keygen. Invoke as `python -m janus_tpu.tools.<name>`."""
+hpke_keygen, gen_alert_rules (Prometheus rules from the in-process SLO
+definitions), debug_bundle (incident snapshot of a health listener).
+Invoke as `python -m janus_tpu.tools.<name>`."""

@@ -533,7 +533,7 @@ def register_span_metric(
     _span_metrics[span_name] = (histogram, dict(labels or {}), tuple(arg_labels))
 
 
-def _bridge_span(name: str, dur_s: float, args: dict) -> None:
+def _bridge_span(name: str, dur_s: float, args: dict, trace_id=None) -> None:
     reg = _span_metrics.get(name)
     if reg is None:
         return
@@ -543,7 +543,10 @@ def _bridge_span(name: str, dur_s: float, args: dict) -> None:
         v = args.get(k)
         if v is not None:
             labels[k] = str(v)
-    hist.observe(dur_s, **labels)
+    # the exiting span's trace id rides the histogram sample as an
+    # OpenMetrics exemplar (metrics.Histogram.observe), so a latency
+    # bucket jump resolves to a concrete /debug/traces capture
+    hist.observe(dur_s, exemplar_trace_id=trace_id, **labels)
 
 
 # ---------------------------------------------------------------------------
@@ -790,7 +793,7 @@ def span(name: str, **args):
             _count_span_error(name)
         dur_s = (t1 - t0) / 1e9
         if _span_metrics:
-            _bridge_span(name, dur_s, args)
+            _bridge_span(name, dur_s, args, trace_id)
         _flight_recorder.record(
             name, trace_id, span_id, parent[1] if parent else None,
             e0, dur_s, args, err_name,
@@ -822,11 +825,15 @@ def record_operation(name: str, dur_s: float, **args) -> None:
     single span() block can cover the whole step, but the digest —
     which the bench's served phase reads for the p50/p95 aggregation-
     job-step SLO — must still see one observation per stepped job."""
+    trace_id = _span_rng.getrandbits(128)
     if _span_metrics:
-        _bridge_span(name, dur_s, args)
+        # the synthesized trace id still resolves: the recorder ring
+        # entry below carries the same id, so a bridged exemplar from a
+        # cross-thread operation links to its /debug/traces record
+        _bridge_span(name, dur_s, args, trace_id)
     _flight_recorder.record(
         name,
-        _span_rng.getrandbits(128),
+        trace_id,
         _span_rng.getrandbits(64),
         None,
         time.time_ns() - int(dur_s * 1e9),

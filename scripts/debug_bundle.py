@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""One-command incident debug bundle (thin wrapper).
+
+    python scripts/debug_bundle.py --url http://127.0.0.1:9001 \\
+        [--url ...] [--config-file cfg.yaml] [--journal-dir DIR] [--out X.tar.gz]
+
+Snapshots /metrics (both exposition modes), /statusz, /debug/vars,
+/debug/traces, /alertz, /readyz and /healthz from each listener, plus
+a secrets-redacted config and the upload-journal directory state, into
+a timestamped tar.gz with a MANIFEST.json. See
+janus_tpu/tools/debug_bundle.py (importable, tested) for the logic.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from janus_tpu.tools.debug_bundle import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

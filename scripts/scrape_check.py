@@ -64,10 +64,37 @@ def main(argv=None) -> int:
     if not ctype.startswith("text/plain") or "version=0.0.4" not in ctype:
         errors.append(f"/metrics Content-Type not exposition format: {ctype!r}")
     errors.extend(validate_exposition(text))
+    # exemplars belong to the OpenMetrics mode only: re-reading the
+    # default scrape WITH exemplar parsing must find none (a substring
+    # test would false-positive on a legal label value containing
+    # ' # {'; real leaked clauses also fail validate_exposition above)
+    leak_fams, _ = parse_exposition(text, openmetrics=True)
+    if any(f.exemplars for f in leak_fams.values()):
+        errors.append("/metrics default mode leaked an exemplar clause")
     families, _ = parse_exposition(text)
     errors.extend(lint_metric_names({f.name: f.type for f in families.values()}))
     if not families:
         errors.append("/metrics exposed no metric families")
+
+    # OpenMetrics exposition mode (?openmetrics=1): same families plus
+    # histogram exemplars and the # EOF terminator, exemplar syntax
+    # validated by the shared parser
+    try:
+        om_text, om_ctype = _fetch(base + "/metrics?openmetrics=1", args.timeout)
+    except Exception as e:
+        errors.append(f"GET /metrics?openmetrics=1 failed: {e}")
+    else:
+        if not om_ctype.startswith("application/openmetrics-text"):
+            errors.append(
+                f"/metrics?openmetrics=1 Content-Type not OpenMetrics: {om_ctype!r}"
+            )
+        om_errors = validate_exposition(om_text, openmetrics=True)
+        errors.extend(f"openmetrics: {e}" for e in om_errors)
+        om_families, _ = parse_exposition(om_text, openmetrics=True)
+        if set(om_families) != set(families):
+            errors.append(
+                "openmetrics mode exposes a different family set than the default scrape"
+            )
     # device-path watchdog/quarantine families (docs/ROBUSTNESS.md
     # "Device hangs & deadlines"): registered at import in every
     # binary, so absence is a deploy regression, not an idle process
@@ -83,9 +110,29 @@ def main(argv=None) -> int:
         "janus_device_lane_busy_seconds_total",
         "janus_step_pipeline_overlap_total",
         "janus_prep_resp_order_mismatch_total",
+        # SLO burn-rate engine (ISSUE 10) + the standard process/build
+        # families scrapers expect — all registered at import in every
+        # binary, so absence is a deploy regression
+        "janus_alert_active",
+        "janus_slo_error_budget_remaining_ratio",
+        "janus_slo_burn_rate",
+        "janus_build_info",
+        "janus_process_start_time_seconds",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
+
+    # janus_build_info must carry the identity labels with value 1
+    bi = families.get("janus_build_info")
+    if bi is not None:
+        live = [(labels, v) for _, labels, v in bi.samples if v == 1]
+        if len(live) != 1 or not {"version", "python", "jax", "backend"} <= set(
+            live[0][0]
+        ):
+            errors.append(
+                "janus_build_info needs exactly one value-1 sample with "
+                "version/python/jax/backend labels"
+            )
 
     if args.statusz:
         try:
@@ -153,6 +200,50 @@ def main(argv=None) -> int:
         for key in ("recent", "slow_traces", "digests", "recorded_total"):
             if key not in traces:
                 errors.append(f"/debug/traces missing {key!r}")
+
+    # /alertz (ISSUE 10): every binary answers the SLO engine state as
+    # well-formed JSON — enabled or not — with the alert/slo lists; a
+    # firing alert must carry its burn rates and firing-since
+    try:
+        body, _ = _fetch(base + "/alertz", args.timeout)
+        alertz = json.loads(body)
+    except Exception as e:
+        errors.append(f"/alertz not valid JSON: {e}")
+    else:
+        for key in ("enabled", "firing", "alerts", "slos"):
+            if key not in alertz:
+                errors.append(f"/alertz missing {key!r}")
+        for a in alertz.get("alerts", []) or []:
+            for key in ("alert", "severity", "state", "burn_rate_threshold"):
+                if key not in a:
+                    errors.append(f"/alertz alert entry missing {key!r}: {a}")
+                    break
+            if a.get("state") == "firing" and a.get("firing_since_unix") is None:
+                errors.append(f"/alertz firing alert without firing_since: {a}")
+        if alertz.get("enabled"):
+            for s in alertz.get("slos", []) or []:
+                for key in (
+                    "name",
+                    "objective",
+                    "burn_rates",
+                    "error_budget_remaining_ratio",
+                    "evidence",
+                ):
+                    if key not in s:
+                        errors.append(f"/alertz slo entry missing {key!r}: {s}")
+                        break
+
+    # the endpoint-discovery index page (GET /) must link the surface
+    try:
+        body, ctype = _fetch(base + "/", args.timeout)
+    except Exception as e:
+        errors.append(f"GET / failed: {e}")
+    else:
+        if not ctype.startswith("text/html"):
+            errors.append(f"GET / Content-Type not HTML: {ctype!r}")
+        for link in ("/metrics", "/statusz", "/alertz", "/debug/traces", "/readyz"):
+            if link not in body:
+                errors.append(f"GET / index page does not link {link}")
 
     for err in errors:
         print(f"scrape_check: {err}", file=sys.stderr)

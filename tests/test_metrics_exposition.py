@@ -545,3 +545,285 @@ def test_report_e2e_histogram_observed_at_accumulate_time():
     # a clockless call (host paths without one) is a no-op, not a crash
     observe_report_e2e(None, [Time(0)])
     assert count("aggregate") - before == 3
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplars (ISSUE 10): Histogram.observe samples the
+# ambient trace context (or the bridge's explicit trace id) per bucket,
+# rendered only in the openmetrics exposition mode; the parser accepts
+# well-formed exemplars and rejects malformed ones.
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exemplar_storage_and_openmetrics_render():
+    h = m.Histogram("janus_t_ex_seconds", "t", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar_trace_id="ab" * 16, route="u")
+    h.observe(0.5, exemplar_trace_id=0x1234, route="u")
+    h.observe(7.0, exemplar_trace_id="cd" * 16, route="u")  # +Inf bucket
+    default = h.render()
+    assert " # {" not in default  # default mode is bit-compatible
+    om = h.render(openmetrics=True)
+    assert '# {trace_id="' + "ab" * 16 + '"} 0.05' in om
+    assert '# {trace_id="cd' in om  # +Inf bucket carries one too
+    # last-write wins within a bucket
+    h.observe(0.06, exemplar_trace_id="ef" * 16, route="u")
+    om = h.render(openmetrics=True)
+    assert "ab" * 16 not in om
+    assert "ef" * 16 in om
+    exemplars = h.exemplars()
+    assert {e["le"] for e in exemplars} == {"0.1", "1", "+Inf"}
+    assert all(e["trace_id"] for e in exemplars)
+
+
+def test_histogram_exemplar_from_ambient_trace_context():
+    from janus_tpu.trace import span, trace_id_of, current_traceparent
+
+    h = m.Histogram("janus_t_ex2_seconds", "t")
+    captured = {}
+    with span("t.exemplar_ambient"):
+        captured["trace_id"] = trace_id_of(current_traceparent())
+        h.observe(0.2)
+    (ex,) = h.exemplars()
+    assert ex["trace_id"] == captured["trace_id"]
+    # without a context: no exemplar
+    h2 = m.Histogram("janus_t_ex3_seconds", "t")
+    h2.observe(0.2)
+    assert h2.exemplars() == []
+
+
+def test_histogram_exemplar_label_set_bound():
+    h = m.Histogram("janus_t_ex4_seconds", "t")
+    for i in range(m.Histogram.MAX_EXEMPLAR_LABEL_SETS + 10):
+        h.observe(0.2, exemplar_trace_id="aa" * 16, series=str(i))
+    assert len(h._exemplars) == m.Histogram.MAX_EXEMPLAR_LABEL_SETS
+    # counts are unaffected by the exemplar cap
+    assert sum(h._totals.values()) == m.Histogram.MAX_EXEMPLAR_LABEL_SETS + 10
+
+
+def test_span_metric_bridge_attaches_exemplar_trace_id():
+    from janus_tpu.trace import (
+        _span_metrics,
+        register_span_metric,
+        span,
+        trace_id_of,
+        current_traceparent,
+    )
+
+    h = m.Histogram("janus_t_ex5_seconds", "t")
+    register_span_metric("t.bridge_exemplar", h, labels={"op": "x"})
+    try:
+        seen = {}
+        with span("t.bridge_exemplar"):
+            seen["trace_id"] = trace_id_of(current_traceparent())
+        (ex,) = h.exemplars()
+        assert ex["trace_id"] == seen["trace_id"]
+        assert ex["labels"] == {"op": "x"}
+    finally:
+        _span_metrics.pop("t.bridge_exemplar", None)
+
+
+def test_openmetrics_parser_accepts_and_rejects_exemplars():
+    header = "# HELP x_seconds t\n# TYPE x_seconds histogram\n"
+    tail = 'x_seconds_bucket{le="+Inf"} 1\nx_seconds_sum 0.05\nx_seconds_count 1\n# EOF\n'
+    good = (
+        header
+        + 'x_seconds_bucket{le="0.1"} 1 # {trace_id="abc"} 0.05 1700000000.0\n'
+        + tail
+    )
+    assert validate_exposition(good, openmetrics=True) == []
+    fams, _ = parse_exposition(good, openmetrics=True)
+    (name, labels, ex) = fams["x_seconds"].exemplars[0]
+    assert ex == {"labels": {"trace_id": "abc"}, "value": 0.05, "ts": 1700000000.0}
+
+    # default mode rejects exemplar syntax outright
+    assert validate_exposition(good) != []
+
+    # exemplar above its bucket bound
+    bad = (
+        header
+        + 'x_seconds_bucket{le="0.1"} 1 # {trace_id="abc"} 5.0\n'
+        + tail
+    )
+    assert any("above bucket bound" in e for e in validate_exposition(bad, openmetrics=True))
+
+    # exemplar on a gauge
+    bad = '# TYPE g gauge\ng 1 # {trace_id="a"} 0.5\n# EOF\n'
+    assert any("only histogram buckets" in e for e in validate_exposition(bad, openmetrics=True))
+
+    # unterminated label set / junk value / oversized label set
+    bad = header + 'x_seconds_bucket{le="0.1"} 1 # {trace_id="a 0.05\n' + tail
+    assert any("unterminated" in e for e in validate_exposition(bad, openmetrics=True))
+    bad = header + 'x_seconds_bucket{le="0.1"} 1 # {trace_id="a"} zap\n' + tail
+    assert any("unparseable exemplar value" in e for e in validate_exposition(bad, openmetrics=True))
+    bad = header + 'x_seconds_bucket{le="0.1"} 1 # {trace_id="' + "x" * 200 + '"} 0.05\n' + tail
+    assert any("128 runes" in e for e in validate_exposition(bad, openmetrics=True))
+
+    # missing # EOF
+    assert any(
+        "missing # EOF" in e
+        for e in validate_exposition(header + tail.replace("# EOF\n", ""), openmetrics=True)
+    )
+    # content after # EOF
+    assert any(
+        "content after # EOF" in e
+        for e in validate_exposition(good + "x_seconds_count 2\n", openmetrics=True)
+    )
+
+
+def test_hash_inside_label_value_is_not_an_exemplar():
+    c = m.Counter("janus_t_hash_total", "t")
+    c.add(reason='before # {fake="exemplar"} 1 after')
+    text = "# TYPE janus_t_hash_total counter\n" + c.render().splitlines()[-1] + "\n# EOF\n"
+    fams, errors = parse_exposition(text, openmetrics=True)
+    assert errors == []
+    assert fams["janus_t_hash_total"].exemplars == []
+    (_, labels, _) = fams["janus_t_hash_total"].samples[0]
+    assert labels["reason"] == 'before # {fake="exemplar"} 1 after'
+
+
+def test_registry_openmetrics_mode_is_superset_and_default_unchanged():
+    h = m.REGISTRY.histogram("janus_t_ex6_seconds", "t")
+    default_before = m.REGISTRY.render()
+    h.observe(0.2, exemplar_trace_id="ab" * 16)
+    default_after = m.REGISTRY.render()
+    # storing an exemplar changes the default scrape only by the new
+    # histogram SAMPLE, never by exemplar clauses
+    assert " # {" not in default_after
+    om = m.REGISTRY.render(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    assert validate_exposition(om, openmetrics=True) == []
+    fams_om, _ = parse_exposition(om, openmetrics=True)
+    fams_def, _ = parse_exposition(default_after)
+    assert set(fams_om) == set(fams_def)
+
+
+# ---------------------------------------------------------------------------
+# build info / process start time (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_build_info_and_process_start_time_registered():
+    import sys
+
+    m.register_build_info(backend="cpu")
+    snap = m.REGISTRY.snapshot()
+    info = snap["janus_build_info"]
+    live = [s for s in info["samples"] if s["value"] == 1]
+    assert len(live) == 1
+    labels = live[0]["labels"]
+    assert labels["backend"] == "cpu"
+    assert labels["python"] == "%d.%d.%d" % sys.version_info[:3]
+    assert set(labels) == {"version", "python", "jax", "backend"}
+    start = m.process_start_time_seconds.get()
+    assert 0 < start <= time.time()
+    # re-registration with a different backend zeroes the old series
+    m.register_build_info(backend="tpu")
+    info = m.REGISTRY.snapshot()["janus_build_info"]
+    live = [s for s in info["samples"] if s["value"] == 1]
+    assert len(live) == 1 and live[0]["labels"]["backend"] == "tpu"
+    m.register_build_info()  # restore the environment default
+
+
+# ---------------------------------------------------------------------------
+# /alertz + index page on the health listener (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def test_alertz_endpoint_disabled_and_enabled(health_server):
+    from janus_tpu import slo
+
+    slo.uninstall_slo_engine()
+    status, ctype, body = _get(health_server + "/alertz")
+    assert status == 200 and ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert doc == {"enabled": False, "firing": [], "alerts": [], "slos": []}
+
+    slo.install_slo_engine(slo.SloEngineConfig(evaluation_interval_s=0.02))
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            doc = json.loads(_get(health_server + "/alertz")[2])
+            if doc.get("evaluations", 0) >= 1:
+                break
+            time.sleep(0.01)
+        assert doc["enabled"] is True
+        assert {s["name"] for s in doc["slos"]} >= {"upload_availability"}
+        for a in doc["alerts"]:
+            assert {"alert", "severity", "state", "burn_rate_threshold"} <= set(a)
+    finally:
+        slo.uninstall_slo_engine()
+
+
+def test_index_page_links_endpoints(health_server):
+    status, ctype, body = _get(health_server + "/")
+    assert status == 200 and ctype.startswith("text/html")
+    text = body.decode()
+    for link in (
+        "/healthz",
+        "/readyz",
+        "/metrics",
+        "/statusz",
+        "/alertz",
+        "/debug/vars",
+        "/debug/traces",
+    ):
+        assert f'href="{link}"' in text
+    # still 404 on unknown paths
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _get(health_server + "/nope")
+    assert exc_info.value.code == 404
+
+
+def test_metrics_endpoint_openmetrics_negotiation(health_server):
+    h = m.REGISTRY.histogram("janus_t_ex7_seconds", "t")
+    h.observe(0.2, exemplar_trace_id="ab" * 16)
+    status, ctype, body = _get(health_server + "/metrics?openmetrics=1")
+    assert status == 200
+    assert ctype == "application/openmetrics-text; version=1.0.0; charset=utf-8"
+    text = body.decode()
+    assert validate_exposition(text, openmetrics=True) == []
+    assert 'janus_t_ex7_seconds_bucket' in text and "ab" * 16 in text
+    # Accept negotiation
+    req = urllib.request.Request(
+        health_server + "/metrics",
+        headers={"Accept": "application/openmetrics-text"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers["Content-Type"].startswith("application/openmetrics-text")
+    # the default mode stays exemplar-free and 0.0.4-typed
+    status, ctype, body = _get(health_server + "/metrics")
+    assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+    assert " # {" not in body.decode()
+
+
+# ---------------------------------------------------------------------------
+# /statusz HTML escaping (ISSUE 10 satellite: hostile label values must
+# render inert — the text exposition has escaped them since PR 3, the
+# HTML path now has the same pin)
+# ---------------------------------------------------------------------------
+
+
+def test_statusz_html_escapes_hostile_values(health_server):
+    from janus_tpu.statusz import register_status_provider, unregister_status_provider
+
+    hostile = {
+        "task_id": '<script>alert(1)</script>"quoted"\nnewline\\end',
+        "<img src=x onerror=alert(2)>": "key is hostile too",
+    }
+    register_status_provider("hostile_section<script>", lambda: hostile)
+    try:
+        status, ctype, body = _get(health_server + "/statusz?format=html")
+        assert status == 200 and ctype.startswith("text/html")
+        text = body.decode()
+        assert "<script>alert(1)</script>" not in text
+        assert "<img src=x" not in text
+        assert "hostile_section<script>" not in text
+        # escaped forms present: the data survives, inert
+        assert "&lt;script&gt;alert(1)&lt;/script&gt;" in text
+        assert "hostile_section&lt;script&gt;" in text
+        # the JSON view carries the raw values (escaping is the HTML
+        # renderer's job, not the provider's)
+        snap = json.loads(_get(health_server + "/statusz")[2])
+        assert snap["hostile_section<script>"]["task_id"].startswith("<script>")
+    finally:
+        unregister_status_provider("hostile_section<script>")

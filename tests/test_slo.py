@@ -1,0 +1,511 @@
+"""In-process SLO burn-rate engine (janus_tpu/slo.py; ISSUE 10).
+
+Unit tests drive the engine with a synthetic clock over the real
+metrics registry: burn-rate math, multi-window AND semantics, firing/
+recovery transitions, latency and condition signals, YAML config
+merging over the built-ins, the exported gauges, and the /alertz +
+statusz snapshots. The live-HTTP proof (a failpoint 5xx storm flipping
+the default alert over a real listener) rides the bench dry-run's
+`slo_alert` record, pinned by tests/test_tools.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from janus_tpu import metrics as m
+from janus_tpu import slo
+from janus_tpu.metrics import compile_matchers
+
+
+class FakeTime:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _counter(name, **kw):
+    return m.REGISTRY.counter(name)
+
+
+@pytest.fixture()
+def clock():
+    return FakeTime()
+
+
+def _ratio_slo(name, good_counter, bad_counter, objective=0.999, windows=None):
+    return slo.SloDefinition(
+        name=name,
+        objective=objective,
+        signal=slo.RatioSignal(
+            good=(slo.Selector(good_counter, ()),),
+            bad=(slo.Selector(bad_counter, ()),),
+        ),
+        windows=tuple(
+            slo.BurnWindow.from_dict(w)
+            for w in (
+                windows
+                or (
+                    {"long_secs": 10.0, "short_secs": 2.0, "burn_rate": 14.4, "severity": "page"},
+                )
+            )
+        ),
+    )
+
+
+def test_burn_rate_math_and_firing_transitions(clock):
+    good = m.REGISTRY.counter("janus_t_slo_good_a_total")
+    bad = m.REGISTRY.counter("janus_t_slo_bad_a_total")
+    bad.add(0)  # materialize the series so the window starts sampling
+    eng = slo.SloEngine(
+        [_ratio_slo("t_ratio_a", good.name, bad.name)],
+        interval_s=1.0,
+        time_fn=clock,
+    )
+    # healthy traffic: no burn
+    for _ in range(5):
+        good.add(10)
+        eng.evaluate_once()
+        clock.advance(1.0)
+    doc = eng.alertz_doc()
+    (alert,) = doc["alerts"]
+    assert alert["state"] == "ok"
+    assert alert["burn_rate_long"] == 0.0
+    assert m.alert_active.get(alert="t_ratio_a", severity="page") == 0.0
+
+    # 50% errors in the recent ticks: the SHORT window sees pure 50%
+    # (burn 500x the 0.001 budget), the LONG window dilutes it with the
+    # healthy phase — both far over the 14.4 threshold
+    for _ in range(3):
+        good.add(5)
+        bad.add(5)
+        eng.evaluate_once()
+        clock.advance(1.0)
+    doc = eng.alertz_doc()
+    (alert,) = doc["alerts"]
+    assert alert["state"] == "firing"
+    assert alert["firing_since_unix"] is not None
+    assert 14.4 <= alert["burn_rate_long"] <= 500.0
+    assert alert["burn_rate_short"] == pytest.approx(500.0, rel=0.3)
+    assert doc["firing"] == ["t_ratio_a/page"]
+    assert m.alert_active.get(alert="t_ratio_a", severity="page") == 1.0
+    # burn-rate gauge exported per window
+    assert m.slo_burn_rate.get(slo="t_ratio_a", window="10s") > 14.4
+
+    # recovery: healthy traffic until the bad burst slides out of the
+    # 10s long window
+    for _ in range(15):
+        good.add(10)
+        eng.evaluate_once()
+        clock.advance(1.0)
+    doc = eng.alertz_doc()
+    (alert,) = doc["alerts"]
+    assert alert["state"] == "ok"
+    assert alert["firing_since_unix"] is None
+    assert m.alert_active.get(alert="t_ratio_a", severity="page") == 0.0
+
+
+def test_multiwindow_and_semantics_short_window_gates(clock):
+    """A burst that has already stopped keeps the LONG window hot but
+    empties the SHORT window — the alert must NOT fire (the whole point
+    of multi-window alerting: no paging on stale burn)."""
+    good = m.REGISTRY.counter("janus_t_slo_good_b_total")
+    bad = m.REGISTRY.counter("janus_t_slo_bad_b_total")
+    good.add(0)
+    bad.add(0)
+    eng = slo.SloEngine(
+        [_ratio_slo("t_ratio_b", good.name, bad.name)],
+        interval_s=1.0,
+        time_fn=clock,
+    )
+    eng.evaluate_once()
+    clock.advance(1.0)
+    bad.add(100)  # one hard burst
+    eng.evaluate_once()
+    clock.advance(1.0)
+    # 3s later: short window (2s) covers only quiet ticks
+    for _ in range(3):
+        good.add(10)
+        eng.evaluate_once()
+        clock.advance(1.0)
+    doc = eng.alertz_doc()
+    (alert,) = doc["alerts"]
+    assert alert["burn_rate_long"] > 14.4  # long window still remembers
+    assert alert["burn_rate_short"] == 0.0
+    assert alert["state"] == "ok"
+
+
+def test_no_traffic_means_no_burn(clock):
+    good = m.REGISTRY.counter("janus_t_slo_good_c_total")
+    bad = m.REGISTRY.counter("janus_t_slo_bad_c_total")
+    eng = slo.SloEngine(
+        [_ratio_slo("t_ratio_c", good.name, bad.name)], interval_s=1.0, time_fn=clock
+    )
+    # a registered-but-never-incremented counter has no samples: the
+    # window freezes as no-data rather than recording fake all-good
+    eng.evaluate_once()
+    assert eng.alertz_doc()["slos"][0]["no_data"] is True
+    good.add(0)  # series born, still zero traffic
+    for _ in range(5):
+        eng.evaluate_once()
+        clock.advance(1.0)
+    doc = eng.alertz_doc()
+    (alert,) = doc["alerts"]
+    assert alert["state"] == "ok"
+    assert alert["burn_rate_long"] == 0.0
+    assert doc["slos"][0]["no_data"] is False
+
+
+def test_missing_series_is_no_data_not_all_good(clock):
+    eng = slo.SloEngine(
+        [_ratio_slo("t_ratio_d", "janus_t_never_registered_a", "janus_t_never_registered_b")],
+        interval_s=1.0,
+        time_fn=clock,
+    )
+    eng.evaluate_once()
+    doc = eng.alertz_doc()
+    assert doc["slos"][0]["no_data"] is True
+    assert doc["slos"][0]["evidence"] == {
+        "good:janus_t_never_registered_a": None,
+        "bad:janus_t_never_registered_b": None,
+    }
+
+
+def test_latency_signal_threshold_rounds_up_to_bucket(clock):
+    hist = m.REGISTRY.histogram("janus_t_slo_lat_seconds", buckets=(0.1, 1.0, 10.0))
+    definition = slo.SloDefinition(
+        name="t_latency",
+        objective=0.9,
+        signal=slo.LatencySignal(
+            metric=hist.name, labels=compile_matchers({"stage": "x"}), threshold_s=0.5
+        ),
+        windows=(
+            slo.BurnWindow(long_s=10.0, short_s=2.0, burn_rate=2.0, severity="page"),
+        ),
+    )
+    assert definition.signal.effective_threshold_s() == 1.0  # 0.5 rounds up
+    eng = slo.SloEngine([definition], interval_s=1.0, time_fn=clock)
+    # prime the series with fast observations, then a slow burst: the
+    # window delta is 4 fast + 4 slow -> err 0.5, budget 0.1 -> burn 5
+    for _ in range(4):
+        hist.observe(0.2, stage="x")
+    eng.evaluate_once()
+    clock.advance(1.0)
+    for _ in range(4):
+        hist.observe(0.2, stage="x")
+        hist.observe(5.0, stage="x")
+    eng.evaluate_once()
+    doc = eng.alertz_doc()
+    (alert,) = doc["alerts"]
+    assert alert["state"] == "firing"
+    assert doc["slos"][0]["effective_threshold_s"] == 1.0
+    # other-label observations are invisible to the matcher
+    hist.observe(99.0, stage="other")
+    good_n, total, n = hist.le_total_matching(1.0, compile_matchers({"stage": "x"}))
+    assert total == 12 and good_n == 8 and n == 1
+
+
+def test_condition_signal_gauge_and_delta(clock):
+    gauge = m.REGISTRY.gauge("janus_t_slo_cond_gauge")
+    counter = m.REGISTRY.counter("janus_t_slo_cond_delta_total")
+    definition = slo.SloDefinition(
+        name="t_condition",
+        objective=0.5,  # budget 0.5: fires when >50% of ticks are bad
+        signal=slo.ConditionSignal(
+            conditions=(
+                slo.Condition(selector=slo.Selector(gauge.name, ()), op=">", value=0.0),
+                slo.Condition(
+                    selector=slo.Selector(counter.name, ()),
+                    op=">",
+                    value=0.0,
+                    mode="delta",
+                ),
+            )
+        ),
+        windows=(
+            slo.BurnWindow(long_s=6.0, short_s=2.0, burn_rate=1.5, severity="page"),
+        ),
+    )
+    gauge.set(0)
+    eng = slo.SloEngine([definition], interval_s=1.0, time_fn=clock)
+    for _ in range(3):
+        eng.evaluate_once()
+        clock.advance(1.0)
+    assert eng.alertz_doc()["alerts"][0]["state"] == "ok"
+
+    # gauge goes unhealthy: every tick is bad -> burn = 1/0.5 = 2 > 1.5
+    gauge.set(3)
+    for _ in range(6):
+        eng.evaluate_once()
+        clock.advance(1.0)
+    assert eng.alertz_doc()["alerts"][0]["state"] == "firing"
+
+    # recover the gauge; ticks go good again
+    gauge.set(0)
+    for _ in range(8):
+        eng.evaluate_once()
+        clock.advance(1.0)
+    assert eng.alertz_doc()["alerts"][0]["state"] == "ok"
+
+    # a counter DELTA (new hung dispatch) makes the tick bad once,
+    # without latching forever
+    counter.add(2)
+    eng.evaluate_once()
+    ev = eng.alertz_doc()["slos"][0]["evidence"]
+    assert ev[f"increase({counter.name}) > 0"] == 2.0
+    st = eng._condition_state[id(definition.signal)]
+    assert st["bad"] >= 1
+
+
+def test_window_scale_shrinks_ladder_uniformly(clock):
+    good = m.REGISTRY.counter("janus_t_slo_good_e_total")
+    bad = m.REGISTRY.counter("janus_t_slo_bad_e_total")
+    definition = _ratio_slo(
+        "t_ratio_e",
+        good.name,
+        bad.name,
+        windows=(
+            {"long_secs": 3600.0, "short_secs": 300.0, "burn_rate": 14.4, "severity": "page"},
+        ),
+    )
+    # scale 1/900: the 1h window behaves as 4s, but the LABEL keeps the
+    # nominal window (dashboards stay stable across test configs)
+    eng = slo.SloEngine(
+        [definition], interval_s=1.0, window_scale=1.0 / 900, time_fn=clock
+    )
+    bad.add(10)
+    eng.evaluate_once()
+    clock.advance(1.0)
+    bad.add(10)
+    eng.evaluate_once()
+    assert eng.alertz_doc()["alerts"][0]["state"] == "firing"
+    assert m.slo_burn_rate.get(slo="t_ratio_e", window="1h") > 14.4
+    # 6 scaled seconds later the 4s-effective long window is clean
+    for _ in range(6):
+        clock.advance(1.0)
+        good.add(1)
+        eng.evaluate_once()
+    assert eng.alertz_doc()["alerts"][0]["state"] == "ok"
+
+
+def test_error_budget_remaining_ratio(clock):
+    good = m.REGISTRY.counter("janus_t_slo_good_f_total")
+    bad = m.REGISTRY.counter("janus_t_slo_bad_f_total")
+    definition = _ratio_slo("t_ratio_f", good.name, bad.name, objective=0.9)
+    eng = slo.SloEngine(
+        [definition], interval_s=1.0, budget_window_s=100.0, time_fn=clock
+    )
+    good.add(0)
+    bad.add(0)
+    eng.evaluate_once()
+    clock.advance(1.0)
+    good.add(95)
+    bad.add(5)  # 5% errors against a 10% budget: half the budget left
+    eng.evaluate_once()
+    doc = eng.alertz_doc()
+    assert doc["slos"][0]["error_budget_remaining_ratio"] == pytest.approx(0.5, abs=0.01)
+    assert m.slo_error_budget_remaining.get(slo="t_ratio_f") == pytest.approx(
+        0.5, abs=0.01
+    )
+
+
+def test_builtin_definitions_cover_the_paper_surface():
+    names = {d.name for d in slo.BUILTIN_SLOS()}
+    assert names == {
+        "upload_availability",
+        "aggregate_step_latency",
+        "collect_latency",
+        "datastore_up",
+        "device_health",
+    }
+    for d in slo.BUILTIN_SLOS():
+        assert 0 < d.objective < 1
+        # every built-in ships the two-rung workbook ladder
+        assert {w.severity for w in d.windows} == {"page", "ticket"}
+
+
+def test_config_merges_over_builtins_by_name():
+    cfg = slo.SloEngineConfig.from_dict(
+        {
+            "evaluation_interval_secs": 2.5,
+            "window_scale": 0.5,
+            "definitions": [
+                # partial override: tighten a built-in without
+                # re-stating its signal
+                {"name": "upload_availability", "objective": 0.9999},
+                # drop one
+                {"name": "device_health", "enabled": False},
+                # add a custom one
+                {
+                    "name": "custom_ratio",
+                    "objective": 0.99,
+                    "signal": {
+                        "kind": "counter_ratio",
+                        "good": [{"metric": "janus_t_cfg_good_total"}],
+                        "bad": [
+                            {
+                                "metric": "janus_t_cfg_bad_total",
+                                "labels": {"reason": "~x.*"},
+                            }
+                        ],
+                    },
+                    "windows": [
+                        {
+                            "long_secs": 60,
+                            "short_secs": 5,
+                            "burn_rate": 10,
+                            "severity": "page",
+                        }
+                    ],
+                },
+            ],
+        }
+    )
+    assert cfg.evaluation_interval_s == 2.5
+    defs = {d.name: d for d in cfg.build_definitions()}
+    assert "device_health" not in defs
+    assert defs["upload_availability"].objective == 0.9999
+    # the built-in signal survived the partial override
+    assert isinstance(defs["upload_availability"].signal, slo.RatioSignal)
+    custom = defs["custom_ratio"]
+    assert isinstance(custom.signal, slo.RatioSignal)
+    assert custom.windows[0].burn_rate == 10.0
+
+
+def test_config_rejects_unknown_signal_kind_and_missing_name():
+    with pytest.raises(ValueError, match="unknown SLO signal kind"):
+        slo.signal_from_dict({"kind": "nope"})
+    cfg = slo.SloEngineConfig(definitions=({"objective": 0.9},))
+    with pytest.raises(ValueError, match="without a name"):
+        cfg.build_definitions()
+
+
+def test_install_uninstall_and_alertz_snapshot():
+    assert slo.get_slo_engine() is None or slo.uninstall_slo_engine() is None
+    disabled = slo.alertz_snapshot()
+    assert disabled == {"enabled": False, "firing": [], "alerts": [], "slos": []}
+    engine = slo.install_slo_engine(
+        slo.SloEngineConfig(evaluation_interval_s=0.05), start=False
+    )
+    try:
+        engine.evaluate_once()
+        doc = slo.alertz_snapshot()
+        assert doc["enabled"] is True
+        assert len(doc["slos"]) == 5
+        assert all("burn_rates" in s for s in doc["slos"])
+        # the statusz section is registered and compact
+        from janus_tpu.statusz import status_snapshot
+
+        snap = status_snapshot()
+        assert "slo" in snap
+        assert "budget_remaining" in snap["slo"]
+    finally:
+        slo.uninstall_slo_engine()
+    assert slo.get_slo_engine() is None
+    from janus_tpu.statusz import status_snapshot
+
+    assert "slo" not in status_snapshot()
+
+
+def test_engine_thread_runs_and_stops():
+    import time as _time
+
+    engine = slo.SloEngine(
+        [  # a tiny definition so the loop does real work
+            _ratio_slo(
+                "t_thread", "janus_t_slo_good_a_total", "janus_t_slo_bad_a_total"
+            )
+        ],
+        interval_s=0.02,
+    )
+    engine.start()
+    deadline = _time.monotonic() + 5
+    while engine.alertz_doc()["evaluations"] < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert engine.alertz_doc()["evaluations"] >= 3
+    engine.stop()
+    n = engine.alertz_doc()["evaluations"]
+    _time.sleep(0.1)
+    assert engine.alertz_doc()["evaluations"] == n  # loop really stopped
+
+
+def test_broken_definition_does_not_kill_the_ladder(clock):
+    class ExplodingSignal:
+        kind = "exploding"
+
+        def read(self, engine):
+            raise RuntimeError("boom")
+
+        def evidence(self):
+            return {}
+
+    good = m.REGISTRY.counter("janus_t_slo_good_g_total")
+    bad = m.REGISTRY.counter("janus_t_slo_bad_g_total")
+    eng = slo.SloEngine(
+        [
+            slo.SloDefinition(
+                name="t_exploding", objective=0.99, signal=ExplodingSignal()
+            ),
+            _ratio_slo("t_ratio_g", good.name, bad.name),
+        ],
+        interval_s=1.0,
+        time_fn=clock,
+    )
+    good.add(0)
+    eng.evaluate_once()  # must not raise
+    clock.advance(1.0)
+    good.add(5)
+    eng.evaluate_once()
+    doc = eng.alertz_doc()
+    healthy = next(s for s in doc["slos"] if s["name"] == "t_ratio_g")
+    assert healthy["budget_window_events"] == 5.0
+
+
+def test_same_severity_rungs_do_not_clobber_each_other(clock):
+    """The Workbook's 3-rung ladder has TWO page rungs; a quiet later
+    rung must not resolve an earlier firing one in the same pass
+    (alert state is per rung, the gauge ORs rungs per severity)."""
+    good = m.REGISTRY.counter("janus_t_slo_good_h_total")
+    bad = m.REGISTRY.counter("janus_t_slo_bad_h_total")
+    good.add(0)
+    bad.add(0)
+    definition = _ratio_slo(
+        "t_ratio_h",
+        good.name,
+        bad.name,
+        windows=(
+            {"long_secs": 4.0, "short_secs": 1.0, "burn_rate": 14.4, "severity": "page"},
+            # second page rung with an unreachable threshold: stays ok
+            {"long_secs": 8.0, "short_secs": 2.0, "burn_rate": 1e9, "severity": "page"},
+        ),
+    )
+    eng = slo.SloEngine([definition], interval_s=1.0, time_fn=clock)
+    eng.evaluate_once()
+    clock.advance(1.0)
+    bad.add(50)
+    eng.evaluate_once()
+    doc = eng.alertz_doc()
+    states = [a["state"] for a in doc["alerts"]]
+    assert states == ["firing", "ok"]
+    # the severity gauge ORs the rungs; the firing list dedupes
+    assert m.alert_active.get(alert="t_ratio_h", severity="page") == 1.0
+    assert doc["firing"] == ["t_ratio_h/page"]
+    # stays latched across further passes while the burn persists
+    clock.advance(0.2)
+    bad.add(50)
+    eng.evaluate_once()
+    doc = eng.alertz_doc()
+    assert [a["state"] for a in doc["alerts"]] == ["firing", "ok"]
+    assert m.alert_active.get(alert="t_ratio_h", severity="page") == 1.0
+
+
+def test_condition_mode_typo_is_rejected():
+    with pytest.raises(ValueError, match="unknown condition mode"):
+        slo.Condition.from_dict(
+            {"metric": "janus_x_total", "op": ">", "value": 0, "mode": "deltas"}
+        )

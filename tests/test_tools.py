@@ -143,6 +143,45 @@ def test_bench_dry_run_smoke():
     # the record carries the real numbers)
     assert overhead["span_ns_recorder_off"] > 0
     assert overhead["span_ns_disabled"] < 20 * overhead["span_ns_recorder_off"]
+    # SLO burn-rate engine live proof (ISSUE 10): a failpoint-driven
+    # 5xx storm on REAL uploads over loopback HTTP flips the default
+    # upload_availability alert on /alertz with burn rates over the
+    # 14.4x threshold, janus_alert_active=1 lands in /metrics, an
+    # OpenMetrics latency exemplar resolves against a live
+    # /debug/traces capture, recovery clears the alert, and the
+    # one-command debug bundle inventories every endpoint
+    sa = rec["observability_smoke"]["slo_alert"]
+    assert sa["baseline_statuses"] == [201, 201, 201]
+    assert sa["baseline_firing"] == []
+    assert sa["storm_statuses_5xx"] >= 1
+    assert sa["alert_fired"] is True, sa
+    assert sa["burn_over_threshold"] is True
+    assert sa["burn_rate_long"] >= sa["burn_rate_threshold"] == 14.4
+    assert sa["firing_since_set"] is True
+    assert "upload_availability/page" in sa["alertz_firing_list"]
+    assert sa["budget_remaining_while_firing"] < 1.0
+    assert sa["evidence_present"] is True
+    assert sa["alert_active_in_metrics"] is True
+    assert sa["default_scrape_exemplar_free"] is True
+    assert sa["default_scrape_valid"] is True
+    assert sa["openmetrics_content_type_ok"] is True
+    assert sa["openmetrics_scrape_valid"] is True, sa.get("openmetrics_errors")
+    assert sa["upload_exemplar_count"] >= 1
+    assert sa["exemplar_resolves_in_debug_traces"] is True
+    assert sa["alert_cleared_after_recovery"] is True
+    assert sa["alert_active_gauge_after_recovery"] == 0.0
+    assert sa["bundle_rc"] == 0, sa.get("bundle_err")
+    assert sa["bundle_manifest_complete"] is True
+    assert set(sa["bundle_endpoints_captured"]) == {
+        "healthz",
+        "readyz",
+        "metrics",
+        "metrics_openmetrics",
+        "statusz",
+        "debug_vars",
+        "debug_traces",
+        "alertz",
+    }
     obs = rec["observability_smoke"]
     assert obs["scrape_valid"] is True, obs.get("scrape_errors")
     assert obs["engine_dispatch_samples"] > 0  # non-zero dispatch histogram
@@ -340,3 +379,120 @@ def test_collect_cli_end_to_end(capsys):
         helper_srv.stop()
         leader_eph.cleanup()
         helper_eph.cleanup()
+
+
+def test_alert_rules_file_in_sync_with_slo_definitions():
+    """docs/alerts/janus-alerts.yaml is GENERATED from the in-process
+    SLO definitions (python -m janus_tpu.tools.gen_alert_rules); a
+    drifted checked-in file is a CI failure, not an operator surprise
+    (ISSUE 10 satellite — replaces the prose alert sketches)."""
+    import pathlib
+
+    import yaml
+
+    from janus_tpu.slo import BUILTIN_SLOS
+    from janus_tpu.tools.gen_alert_rules import generate_rules_text
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "docs" / "alerts" / "janus-alerts.yaml"
+    generated = generate_rules_text()
+    assert path.read_text() == generated, (
+        "docs/alerts/janus-alerts.yaml drifted from janus_tpu/slo.py; "
+        "regenerate: python -m janus_tpu.tools.gen_alert_rules > docs/alerts/janus-alerts.yaml"
+    )
+    # and the file is a structurally valid Prometheus rule file covering
+    # every built-in SLO at both severities
+    doc = yaml.safe_load(generated)
+    rules = doc["groups"][0]["rules"]
+    assert len(rules) == 2 * len(BUILTIN_SLOS())
+    for rule in rules:
+        assert rule["alert"].startswith("Janus")
+        assert rule["expr"].strip()
+        assert rule["labels"]["severity"] in ("page", "ticket")
+        assert rule["labels"]["slo"] in {d.name for d in BUILTIN_SLOS()}
+        assert "runbook" in rule["annotations"]
+
+
+def test_gen_alert_rules_check_mode(tmp_path, capsys):
+    from janus_tpu.tools.gen_alert_rules import generate_rules_text, main
+
+    good = tmp_path / "rules.yaml"
+    good.write_text(generate_rules_text())
+    assert main(["--check", str(good)]) == 0
+    stale = tmp_path / "stale.yaml"
+    stale.write_text("groups: []\n")
+    assert main(["--check", str(stale)]) == 1
+
+
+def test_debug_bundle_collects_endpoints_config_and_journal(tmp_path):
+    """scripts/debug_bundle.py (ISSUE 10): one command against a live
+    health listener yields a tar.gz whose MANIFEST inventories every
+    endpoint capture, the config rides along with secrets REDACTED,
+    and the journal directory state is inventoried without contents."""
+    import io
+    import json
+    import tarfile
+
+    from janus_tpu.binary_utils import HealthServer
+    from janus_tpu.tools.debug_bundle import ENDPOINTS, collect_bundle, redact_config
+
+    # redaction unit: secret-smelling keys masked at any depth
+    redacted = redact_config(
+        {
+            "database": {"url": "x.sqlite"},
+            "aggregator_api": {"auth_tokens": ["hunter2"], "listen_address": "a:1"},
+            "collector_auth_token": "t0",
+            "nested": [{"hpke_private_key": "k"}],
+        }
+    )
+    assert redacted["aggregator_api"]["auth_tokens"] == "**REDACTED**"
+    assert redacted["collector_auth_token"] == "**REDACTED**"
+    assert redacted["nested"][0]["hpke_private_key"] == "**REDACTED**"
+    assert redacted["database"]["url"] == "x.sqlite"
+    assert redacted["aggregator_api"]["listen_address"] == "a:1"
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("database:\n  url: x.sqlite\naggregator_api:\n  auth_tokens: [hunter2]\n")
+    journal = tmp_path / "journal"
+    journal.mkdir()
+    (journal / "seg-000001.journal").write_bytes(b"x" * 64)
+    (journal / "seg-000002.corrupt").write_bytes(b"y" * 32)
+
+    srv = HealthServer("127.0.0.1:0").start()
+    try:
+        out = tmp_path / "bundle.tar.gz"
+        manifest = collect_bundle(
+            [f"http://127.0.0.1:{srv.port}"],
+            out_path=str(out),
+            config_file=str(cfg),
+            journal_dir=str(journal),
+        )
+    finally:
+        srv.stop()
+
+    assert out.exists()
+    target = next(iter(manifest["targets"].values()))
+    assert set(target["endpoints"]) == {name for name, _ in ENDPOINTS}
+    assert all("error" not in e for e in target["endpoints"].values())
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+        top = names[0].split("/")[0]
+        members = {n.split("/", 1)[1] if "/" in n else n for n in names}
+        # MANIFEST inventories exactly the files in the tar
+        mf = json.load(tar.extractfile(f"{top}/MANIFEST.json"))
+        assert {f["path"] for f in mf["files"]} == set(names) - {f"{top}/MANIFEST.json"}
+        for entry in mf["files"]:
+            assert entry["sha256"] and entry["bytes"] >= 0
+        cfg_text = tar.extractfile(f"{top}/resolved-config.yaml").read().decode()
+        assert "hunter2" not in cfg_text and "**REDACTED**" in cfg_text
+        jd = json.load(tar.extractfile(f"{top}/upload-journal.json"))
+        assert jd["segment_count"] == 2
+        assert jd["total_bytes"] == 96
+        assert jd["corrupt_segments"] == ["seg-000002.corrupt"]
+        # alertz capture present for the target
+        assert any(n.endswith("/alertz.json") for n in names)
+    # an unreachable listener degrades to a manifest error, not a crash
+    manifest2 = collect_bundle(
+        ["http://127.0.0.1:1"], out_path=str(tmp_path / "b2.tar.gz"), timeout=0.5
+    )
+    t2 = next(iter(manifest2["targets"].values()))
+    assert all("error" in e for e in t2["endpoints"].values())
