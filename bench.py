@@ -1606,6 +1606,40 @@ def _failpoint_overhead(iters: int = 200_000) -> dict:
     }
 
 
+def _paired_ratio(slow_fn, fast_fn, iters: int = 15):
+    """(min slow s, min fast s, median per-pair ratio). Measures in
+    INTERLEAVED pairs with GC paused and takes the median per-pair
+    ratio: the two paths must see the same CPU frequency / cache /
+    scheduler conditions, or whole-run drift lands on one side and an
+    acceptance gate flakes (observed on the codec bench: a 4.9x
+    outlier from separate-block best-of-N against a 6.5x steady
+    state). Shared by the codec record and the upload-batch record."""
+    import gc
+    import statistics
+    import time as _time
+
+    def timed(fn) -> float:
+        t0 = _time.perf_counter()
+        fn()
+        return _time.perf_counter() - t0
+
+    slow_ts, fast_ts, ratios = [], [], []
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        timed(slow_fn), timed(fast_fn)  # warm first-touch pages
+        for _ in range(iters):
+            s = timed(slow_fn)
+            f = timed(fast_fn)
+            slow_ts.append(s)
+            fast_ts.append(f)
+            ratios.append(s / f)
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    return min(slow_ts), min(fast_ts), statistics.median(ratios)
+
+
 def _codec_speed_record(inst=None, batch: int = 2048) -> dict:
     """Measured leader<->helper wire-codec speed (ISSUE 9 acceptance:
     columnar >= 5x the per-report loop at batch >= 1024, bit-identical
@@ -1712,38 +1746,7 @@ def _codec_speed_record(inst=None, batch: int = 2048) -> dict:
 
     identical = loop_path() == columnar_path()
 
-    def timed(fn) -> float:
-        t0 = _time.perf_counter()
-        fn()
-        return _time.perf_counter() - t0
-
-    def paired(slow_fn, fast_fn, iters: int = 15):
-        # measure in INTERLEAVED pairs with GC paused and take the
-        # median per-pair ratio: the two paths must see the same CPU
-        # frequency / cache / scheduler conditions, or whole-run drift
-        # lands on one side and the acceptance gate flakes (observed
-        # a 4.9x outlier from separate-block best-of-N against a 6.5x
-        # steady state)
-        import gc
-        import statistics
-
-        slow_ts, fast_ts, ratios = [], [], []
-        gc_was_enabled = gc.isenabled()
-        gc.disable()
-        try:
-            timed(slow_fn), timed(fast_fn)  # warm first-touch pages
-            for _ in range(iters):
-                s = timed(slow_fn)
-                f = timed(fast_fn)
-                slow_ts.append(s)
-                fast_ts.append(f)
-                ratios.append(s / f)
-        finally:
-            if gc_was_enabled:
-                gc.enable()
-        return min(slow_ts), min(fast_ts), statistics.median(ratios)
-
-    enc_loop_s, enc_col_s, enc_ratio = paired(loop_path, columnar_path)
+    enc_loop_s, enc_col_s, enc_ratio = _paired_ratio(loop_path, columnar_path)
 
     # response side: the helper's typical 1-round answer per report
     msg = encode_pingpong(PP_FINISH, b"x" * 16, None)
@@ -1752,7 +1755,7 @@ def _codec_speed_record(inst=None, batch: int = 2048) -> dict:
             PrepareResp(ReportId(r), PrepareStepResult.cont(msg)) for r in rids
         )
     ).to_bytes()
-    dec_loop_s, dec_col_s, dec_ratio = paired(
+    dec_loop_s, dec_col_s, dec_ratio = _paired_ratio(
         lambda: AggregationJobResp.from_bytes(body),
         lambda: decode_prepare_resps_fast(body),
     )
@@ -1779,6 +1782,348 @@ def _codec_speed_record(inst=None, batch: int = 2048) -> dict:
         "decode_us_per_report_columnar": round(dec_col_s / n * 1e6, 3),
         "decode_speedup": round(dec_ratio, 2),
     }
+
+
+def _hist_totals(metric) -> tuple[int, float]:
+    """(observation count, sum) across every label set of a Histogram
+    (delta-based batching evidence for the ingest-batch records)."""
+    with metric._lock:
+        return sum(metric._totals.values()), sum(metric._sums.values())
+
+
+def _upload_client_stack(cfg=None, inst=None, max_handler_threads: int = 24):
+    """A served upload stack on loopback HTTP (leader Aggregator +
+    DapServer + a Client for one provisioned task), shared by the
+    ingest-batch smoke and the open-loop load generator. Returns
+    (eph, srv, task, params, client, clock)."""
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    agg = Aggregator(eph.datastore, clock, cfg or Config())
+    srv = DapServer(DapHttpApp(agg), max_handler_threads=max_handler_threads).start()
+    vdaf = inst or VdafInstance.count()
+    leader_kp = generate_hpke_config_and_private_key(config_id=0)
+    helper_kp = generate_hpke_config_and_private_key(config_id=1)
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+        .with_(
+            leader_aggregator_endpoint=srv.url,
+            helper_aggregator_endpoint=srv.url,
+            hpke_keys=(leader_kp,),
+            min_batch_size=1,
+        )
+        .build()
+    )
+    eph.datastore.run_tx(lambda tx: tx.put_task(task))
+    params = ClientParameters(task.task_id, srv.url, srv.url, task.time_precision)
+    client = Client(params, vdaf, leader_kp.config, helper_kp.config, clock=clock)
+    return eph, srv, task, params, client, clock
+
+
+def _upload_batch_speed_record(inst=None, window: int = 256) -> dict:
+    """Measured server-side upload decrypt+decode speed (ISSUE 11
+    acceptance: batched >= 3x the per-report path at window >= 256,
+    bit-identical results). Runs the same window of REAL client upload
+    bodies two ways — the per-report oracle (Report.from_bytes ->
+    upload_prepare -> upload_decrypt_validate, exactly what the
+    pre-batching decrypt pool executed per report) and the batched
+    path (decode_reports_fast -> upload_prepare_columns ->
+    upload_decrypt_validate_batch) — asserts the stored reports are
+    IDENTICAL, and times both interleaved (median per-pair ratio, GC
+    paused; the codec bench's anti-drift discipline)."""
+    import numpy as np
+
+    from janus_tpu.aggregator import Config
+    from janus_tpu.aggregator.core import TaskAggregator
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.messages import Report, Role, Time, decode_reports_fast
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+    from janus_tpu.vdaf.testing import random_measurements
+
+    if inst is None or inst.kind == "poplar1":
+        inst = VdafInstance.count()
+    clock = MockClock(Time(1_600_000_000))
+    leader_kp = generate_hpke_config_and_private_key(config_id=0)
+    helper_kp = generate_hpke_config_and_private_key(config_id=1)
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), inst, Role.LEADER)
+        .with_(
+            leader_aggregator_endpoint="http://leader",
+            helper_aggregator_endpoint="http://helper",
+            hpke_keys=(leader_kp,),
+            min_batch_size=1,
+        )
+        .build()
+    )
+    params = ClientParameters(
+        task.task_id, "http://leader", "http://helper", task.time_precision
+    )
+    client = Client(params, inst, leader_kp.config, helper_kp.config, clock=clock)
+    rng = np.random.default_rng(0xB47C4)
+    meas = random_measurements(inst, window, rng)
+    bodies = [
+        client.prepare_report(
+            m.tolist() if getattr(m, "ndim", 0) else int(m)
+        ).to_bytes()
+        for m in meas
+    ]
+    ta = TaskAggregator(task, Config())
+
+    def per_report():
+        out = []
+        for b in bodies:
+            r = Report.from_bytes(b)
+            kp = ta.upload_prepare(clock, r)
+            out.append(ta.upload_decrypt_validate(r, kp))
+        return out
+
+    idxs = list(range(len(bodies)))
+
+    def batched():
+        col = decode_reports_fast(bodies)
+        kps = ta.upload_prepare_columns(clock, col, idxs)
+        return ta.upload_decrypt_validate_batch(col, idxs, kps[0])
+
+    identical = per_report() == batched()
+    slow_s, fast_s, ratio = _paired_ratio(per_report, batched, iters=9)
+    return {
+        "vdaf": inst.kind,
+        "window": window,
+        "stored_reports_identical": identical,
+        "per_report_us_per_report": round(slow_s / window * 1e6, 2),
+        "batched_us_per_report": round(fast_s / window * 1e6, 2),
+        "per_report_rps": round(window / slow_s, 1),
+        "batched_rps": round(window / fast_s, 1),
+        "speedup": round(ratio, 2),
+    }
+
+
+def _ingest_batch_smoke() -> dict:
+    """Batched-ingest smoke (ISSUE 11): a real loopback HTTP burst
+    through the window-batched decode/decrypt stages — 12 valid
+    uploads, 1 with a tampered leader ciphertext, 3 undecodable bodies
+    — must answer EXACTLY 12x201 + 4x400 with the 12 committed exactly
+    once (a replayed PUT stays 201 and adds no row); a direct
+    pipeline feed then proves the windowing deterministically (8
+    submits inside one linger -> ONE hpke_open_batch call of 8
+    lanes)."""
+    import dataclasses
+    from concurrent.futures import ThreadPoolExecutor
+
+    from janus_tpu import metrics as _m
+    from janus_tpu.aggregator import Config
+    from janus_tpu.aggregator.core import TaskAggregator
+    from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.ingest import IngestPipeline
+
+    cfg = Config(ingest_batch_linger_ms=40.0)
+    eph, srv, task, params, client, clock = _upload_client_stack(
+        cfg, max_handler_threads=24
+    )
+    try:
+        reports = [client.prepare_report(1) for _ in range(13)]
+        tampered = dataclasses.replace(
+            reports[12],
+            leader_encrypted_input_share=dataclasses.replace(
+                reports[12].leader_encrypted_input_share,
+                payload=bytes(
+                    [reports[12].leader_encrypted_input_share.payload[0] ^ 1]
+                )
+                + reports[12].leader_encrypted_input_share.payload[1:],
+            ),
+        )
+        bodies = [r.to_bytes() for r in reports[:12]]
+        burst = bodies + [tampered.to_bytes()] + [b"not-a-dap-report"] * 3
+
+        def put(body):
+            http = HttpClient()
+            return http.put(
+                params.upload_uri(), body, {"Content-Type": "application/dap-report"}
+            )[0]
+
+        calls0, lanes0 = _hist_totals(_m.hpke_batch_size)
+        with ThreadPoolExecutor(max_workers=len(burst)) as pool:
+            statuses = list(pool.map(put, burst))
+        http_calls, http_lanes = _hist_totals(_m.hpke_batch_size)
+        http_calls -= calls0
+        http_lanes -= lanes0
+        replay_status = put(bodies[0])  # exactly-once: replays stay 201
+        stored, _ = eph.datastore.run_tx(
+            lambda tx: tx.count_client_reports_for_task(task.task_id)
+        )
+
+        # windowing proof: 8 back-to-back submits (microseconds of
+        # work) against a 2 s linger — the decode worker drains them
+        # into one window and returns the moment the 8th arrives, so
+        # the linger costs nothing in the good case and only a >2 s
+        # scheduler stall between two queue puts could split the
+        # window (tier-1 pins direct_batch_calls == 1 on this)
+        eph2 = EphemeralDatastore(clock=clock)
+        try:
+            eph2.datastore.run_tx(lambda tx: tx.put_task(task))
+            ta = TaskAggregator(task, cfg)
+            writer = ReportWriteBatcher(eph2.datastore, 100, 0)
+            pipe = IngestPipeline(
+                writer, queue_depth=16, batch_window=8, batch_linger_ms=2000.0
+            )
+            try:
+                calls0, lanes0 = _hist_totals(_m.hpke_batch_size)
+                tickets = [pipe.submit(ta, clock, b) for b in bodies[:8]]
+                ok = all(t.result(timeout_s=60) for t in tickets)
+                calls1, lanes1 = _hist_totals(_m.hpke_batch_size)
+            finally:
+                pipe.close()
+                writer.close()
+        finally:
+            eph2.cleanup()
+        batch_secs_count, _ = _hist_totals(_m.ingest_decrypt_batch_seconds)
+        return {
+            "accepted": statuses.count(201),
+            "rejected_4xx": sum(1 for s in statuses if 400 <= s < 500),
+            "statuses_other": sorted(
+                {s for s in statuses if s != 201 and not 400 <= s < 500}
+            ),
+            "stored_reports": int(stored),
+            "committed_exactly_once": int(stored) == statuses.count(201),
+            "replay_still_201": replay_status == 201,
+            # batching evidence over HTTP (informational: arrival
+            # clustering depends on host load) and the deterministic
+            # direct-feed proof (asserted by test_bench_dry_run_smoke)
+            "http_batch_calls": int(http_calls),
+            "http_batched_reports": int(http_lanes),
+            "direct_feed_ok": bool(ok),
+            "direct_batch_calls": int(calls1 - calls0),
+            "direct_batch_lanes": int(lanes1 - lanes0),
+            "decrypt_batch_seconds_sampled": batch_secs_count > 0,
+        }
+    finally:
+        srv.stop()
+        eph.cleanup()
+
+
+def _open_loop_upload_record(
+    duration_s: float = 3.0,
+    capacity_rps: float = 120.0,
+    rate_factor: float = 2.0,
+) -> dict:
+    """Open-loop (coordinated-omission-free) upload load generator
+    (ISSUE 11): arrivals on a FIXED schedule at `rate_factor`x the
+    configured admission capacity, each request's latency measured
+    from its INTENDED send time — a stalled server accumulates
+    lateness into the recorded tail instead of silently slowing the
+    generator down (the classic closed-loop bench lie). The stack is
+    given a token-bucket capacity (`capacity_rps`) so sustained
+    overload is a deterministic condition, not a host-speed accident:
+    ~half the offered load must shed 429 while admitted uploads'
+    p50/p99-under-overload and the exact shed split become tracked
+    BENCH numbers."""
+    import threading
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from janus_tpu import metrics as _m
+    from janus_tpu.aggregator import Config
+    from janus_tpu.core.http_client import HttpClient
+
+    cfg = Config(
+        ingest_batch_linger_ms=5.0,
+        upload_bucket_rate=capacity_rps,
+        upload_bucket_burst=max(8, int(capacity_rps / 4)),
+    )
+    eph, srv, task, params, client, clock = _upload_client_stack(
+        cfg, max_handler_threads=32
+    )
+    try:
+        hdrs = {"Content-Type": "application/dap-report"}
+        offered_rps = capacity_rps * rate_factor
+        n = min(1500, max(30, int(offered_rps * duration_s)))
+        bodies = [client.prepare_report(1).to_bytes() for _ in range(n)]
+
+        local = threading.local()
+
+        def get_http() -> HttpClient:
+            h = getattr(local, "http", None)
+            if h is None:
+                h = local.http = HttpClient()
+            return h
+
+        start = _time.perf_counter() + 0.2
+        results = []
+        lock = threading.Lock()
+
+        def fire(k: int, body: bytes) -> None:
+            intended = start + k / offered_rps
+            now = _time.perf_counter()
+            if intended > now:
+                _time.sleep(intended - now)
+            t_begin = _time.perf_counter()
+            try:
+                status, _body = get_http().put(params.upload_uri(), body, hdrs)
+            except Exception:
+                status = -1
+            done = _time.perf_counter()
+            with lock:
+                # latency FROM INTENDED send: queueing in the generator
+                # (all workers busy) and in the server both count
+                results.append((status, done - intended, t_begin - intended))
+
+        shed0 = _m.upload_shed_counter.total()
+        with ThreadPoolExecutor(max_workers=48) as pool:
+            for k, body in enumerate(bodies):
+                pool.submit(fire, k, body)
+        wall = _time.perf_counter() - start
+        shed_delta = _m.upload_shed_counter.total() - shed0
+
+        def pctl(vals, q):
+            if not vals:
+                return None
+            vals = sorted(vals)
+            return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+        lat_ok = [lat for s, lat, _ in results if s == 201]
+        lat_all = [lat for s, lat, _ in results if s > 0]
+        lag = [b for _, _, b in results]
+        n201 = sum(1 for s, _, _ in results if s == 201)
+        n429 = sum(1 for s, _, _ in results if s == 429)
+        return {
+            "capacity_rps_configured": capacity_rps,
+            "offered_rps": round(offered_rps, 1),
+            "requests": len(results),
+            "duration_s": round(wall, 2),
+            "accepted_201": n201,
+            "shed_429": n429,
+            "errors": sum(1 for s, _, _ in results if s not in (201, 429) ),
+            "served_rps": round(n201 / wall, 1) if wall > 0 else None,
+            "shed_accounted": shed_delta == n429,
+            # the tracked overload numbers: latency measured from the
+            # intended (scheduled) send instant
+            "p50_ms_201": round(pctl(lat_ok, 0.50) * 1000, 1) if lat_ok else None,
+            "p99_ms_201": round(pctl(lat_ok, 0.99) * 1000, 1) if lat_ok else None,
+            "p50_ms_all": round(pctl(lat_all, 0.50) * 1000, 1) if lat_all else None,
+            "p99_ms_all": round(pctl(lat_all, 0.99) * 1000, 1) if lat_all else None,
+            # generator honesty: how late requests LEFT the generator
+            # relative to their schedule (large = the generator itself
+            # could not offer the load; the lateness is still charged
+            # to the recorded latencies above, never hidden)
+            "start_lag_p99_ms": round(pctl(lag, 0.99) * 1000, 1) if lag else None,
+        }
+    finally:
+        srv.stop()
+        eph.cleanup()
 
 
 def _pipeline_smoke() -> dict:
@@ -1987,6 +2332,14 @@ def run_dry(args, ap) -> None:
                 # overlap proof against the REAL driver binary
                 "step_pipeline": {"codec": _codec_speed_record(inst)},
                 "pipeline_smoke": _pipeline_smoke(),
+                # ISSUE 11: batched ingest crypto/decode — server-side
+                # speed vs the per-report oracle (bit-identical stored
+                # reports asserted), a real loopback burst through the
+                # batched path, and the open-loop upload-overload
+                # p50/p99 + shed split
+                "upload_batch_speed": _upload_batch_speed_record(inst, window=256),
+                "ingest_batch_smoke": _ingest_batch_smoke(),
+                "open_loop_upload": _open_loop_upload_record(),
             }
         )
     )
@@ -2423,6 +2776,15 @@ def main() -> None:
                 if served and served.get("step_pipeline")
                 else {}
             ),
+        }
+    except Exception:
+        pass
+    try:
+        # ISSUE 11: batched ingest crypto — measured on this config's
+        # circuit — plus the open-loop upload-overload numbers
+        riders["ingest_batch"] = {
+            "upload_batch_speed": _upload_batch_speed_record(inst, window=256),
+            "open_loop_upload": _open_loop_upload_record(),
         }
     except Exception:
         pass

@@ -38,6 +38,7 @@ from ..messages import (
     CollectionReq,
     Duration,
     HpkeCiphertext,
+    HpkeConfigId,
     HpkeConfigList,
     InputShareAad,
     Interval,
@@ -107,9 +108,18 @@ class Config:
     # honors it (reference collector/src/lib.rs:466)
     collection_retry_after_s: int = 1
     # --- ingest pipeline + admission control (docs/INGEST.md) ---
-    # HPKE-decrypt pool size; 0 = one per host core
+    # HPKE-decrypt pool size; 0 = sized from the crypto backend's
+    # batch GIL-release capability (cores when the batch open releases
+    # the GIL, 2 on the GIL-holding libcrypto fallback — see
+    # ingest.pipeline.default_decrypt_workers)
     ingest_decrypt_workers: int = 0
     ingest_decode_workers: int = 1
+    # flush-window batching of the decode + decrypt stages (ISSUE 11;
+    # docs/INGEST.md "Batched decrypt"): max reports per window and the
+    # linger a decode worker waits for the window to fill. window 1 =
+    # the per-report oracle path.
+    ingest_batch_window: int = 32
+    ingest_batch_linger_ms: float = 2.0
     # Bound on uploads in flight through the pipeline (admission's
     # queue-depth signal and the hard queue-full backstop). Every
     # in-flight upload also parks one handler thread on its ticket, so
@@ -252,6 +262,165 @@ class TaskAggregator:
             report.helper_encrypted_input_share,
         )
 
+    # ------------------------------------------------------------------
+    # batched upload stages (ISSUE 11; docs/INGEST.md "Batched decrypt").
+    # Column forms of upload_prepare / upload_decrypt_validate over a
+    # decoded ReportColumn window: same checks, same error types, same
+    # metrics, applied per lane — the per-report methods above stay the
+    # verification oracle (equivalence fuzz-pinned by
+    # tests/test_ingest_batch.py) and the single-report fallback.
+    # ------------------------------------------------------------------
+    def upload_prepare_columns(self, clock: Clock, col, idxs) -> list:
+        """upload_prepare over lanes `idxs` of a ReportColumn. Returns
+        a list aligned with idxs: the lane's HPKE keypair when
+        admitted, else the error instance upload_prepare would have
+        raised for that report."""
+        task = self.task
+        now = clock.now()
+        max_time = now.add(task.tolerable_clock_skew).seconds
+        expiry = task.task_expiration.seconds if task.task_expiration else None
+        kp_cache: dict[int, object] = {}
+        out: list = []
+        for i in idxs:
+            t = col.times[i]
+            if t > max_time:
+                out.append(errors.ReportTooEarly("report from the future", task.task_id))
+                continue
+            if expiry is not None and t > expiry:
+                out.append(errors.ReportRejected("task expired", task.task_id))
+                continue
+            if task.report_expired(Time(t), now):
+                out.append(errors.ReportRejected("report expired", task.task_id))
+                continue
+            if self.poplar is None:
+                try:
+                    self.wire.decode_public_share(col.public_shares[i])
+                except DecodeError as e:
+                    metrics.upload_decode_failure_counter.add()
+                    out.append(
+                        errors.InvalidMessage(f"bad public share: {e}", task.task_id)
+                    )
+                    continue
+            cfg = col.leader_config_ids[i]
+            if cfg not in kp_cache:
+                kp_cache[cfg] = self._hpke_keypair(HpkeConfigId(cfg))
+            keypair = kp_cache[cfg]
+            if keypair is None:
+                out.append(
+                    errors.OutdatedHpkeConfig("unknown HPKE config id", task.task_id)
+                )
+                continue
+            out.append(keypair)
+        return out
+
+    def upload_decrypt_validate_batch(self, col, idxs, keypair) -> list:
+        """upload_decrypt_validate over lanes `idxs` of a ReportColumn,
+        all carrying `keypair`'s config id (the pipeline groups lanes
+        by config id before calling). One hpke_open_batch spans the
+        window, the leader-share range validation collapses into one
+        numpy pass, and each lane comes back as its LeaderStoredReport
+        or the error instance the per-report oracle would have raised."""
+        import struct as _struct
+
+        from ..core.hpke import hpke_open_batch
+        from ..datastore.models import LeaderStoredReport
+        from ..messages import plaintext_input_share_payload_fast
+        from ..trace import span
+
+        task = self.task
+        tid = task.task_id.data
+        n = len(idxs)
+        # raw InputShareAad build: task_id || report_id || time ||
+        # u32-length-prefixed public share (== InputShareAad.to_bytes)
+        aads = [
+            tid
+            + col.report_ids[i]
+            + _struct.pack(">QI", col.times[i], len(col.public_shares[i]))
+            + col.public_shares[i]
+            for i in idxs
+        ]
+        with span("upload.hpke_validate_batch", batch=n):
+            metrics.hpke_batch_size.observe(n)
+            opened = hpke_open_batch(
+                keypair,
+                HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER),
+                [col.leader_encs[i] for i in idxs],
+                [col.leader_payloads[i] for i in idxs],
+                aads,
+            )
+
+            def reject(e) -> errors.ReportRejected:
+                metrics.upload_decrypt_failure_counter.add()
+                return errors.ReportRejected(
+                    f"undecryptable/undecodable share: {e}", task.task_id
+                )
+
+            out: list = [None] * n
+            payloads: list = [None] * n
+            for j in range(n):
+                if isinstance(opened[j], HpkeError):
+                    out[j] = reject(opened[j])
+                    continue
+                try:
+                    payloads[j] = plaintext_input_share_payload_fast(opened[j])
+                except DecodeError as e:
+                    out[j] = reject(e)
+
+            if self.poplar is not None:
+                for j, i in enumerate(idxs):
+                    if out[j] is not None:
+                        continue
+                    try:
+                        self.poplar.validate_shares(
+                            col.public_shares[i], payloads[j], party=0
+                        )
+                    except (DecodeError, ValueError) as e:
+                        out[j] = reject(e)
+            else:
+                # columnar range validation, one numpy pass for the
+                # whole window (validate_leader_share semantics:
+                # length + field range over the meas||proof prefix)
+                want_len = self.wire.leader_share_len
+                nb = (self.circ.input_len + self.circ.proof_len) * self.wire.enc_size
+                live: list[int] = []
+                rows: list[bytes] = []
+                for j in range(n):
+                    if out[j] is not None:
+                        continue
+                    if len(payloads[j]) != want_len:
+                        out[j] = reject(DecodeError("bad leader share length"))
+                        continue
+                    live.append(j)
+                    rows.append(payloads[j][:nb])
+                if live:
+                    from ..vdaf.wire import lanes_in_range
+
+                    limbs = self.wire.enc_size // 8
+                    mat = np.frombuffer(b"".join(rows), dtype="<u8").reshape(
+                        len(live), -1
+                    )
+                    ok = lanes_in_range(mat, self.circ.FIELD.MODULUS, limbs).all(
+                        axis=-1
+                    )
+                    for k, j in enumerate(live):
+                        if not ok[k]:
+                            out[j] = reject(
+                                DecodeError("leader share element out of field range")
+                            )
+
+            for j, i in enumerate(idxs):
+                if out[j] is not None:
+                    continue
+                out[j] = LeaderStoredReport(
+                    task.task_id,
+                    ReportId(col.report_ids[i]),
+                    Time(col.times[i]),
+                    col.public_shares[i],
+                    payloads[j],
+                    col.helper_ciphertext(i),
+                )
+        return out
+
     def handle_upload(self, ds: Datastore, clock: Clock, report: Report, writer=None) -> None:
         """Single-threaded upload path (tests, tools; the serving HTTP
         layer goes through janus_tpu.ingest.IngestPipeline, which runs
@@ -324,18 +493,24 @@ class TaskAggregator:
         from ..trace import span
 
         # host-side staging: HPKE open + decode columns (the per-report
-        # failure modes become mask lanes; reference :1633-1768)
+        # failure modes become mask lanes; reference :1633-1768). The
+        # HPKE opens run WINDOW-BATCHED through the same surface as the
+        # upload path (ISSUE 11): lanes grouped by config id share one
+        # EVP key/derive context and one cipher context per group.
+        from ..core.hpke import hpke_open_batch
+        from ..messages import plaintext_input_share_payload_fast
+
         helper_seed_rows: list[bytes | None] = [None] * n
         blind_rows: list[bytes | None] = [None] * n
         part_rows0: list[bytes | None] = [None] * n  # public part 0
         part_rows1: list[bytes | None] = [None] * n
         leader_prep_rows: list[bytes | None] = [None] * n
         with span("helper.hpke_stage", batch=n):
+            # pass 1: cheap per-report checks + keypair lookup; HPKE
+            # lanes collect per config id for the batched opens
+            kp_cache: dict = {}
+            hpke_groups: dict = {}  # config id -> (keypair, [i], encs, pays, aads)
             for i, pi in enumerate(inits):
-                # propagated-deadline check per report: the decrypt loop
-                # is the helper's dominant host cost, and a leader whose
-                # lease died mid-batch is not waiting for the rest
-                deadline_mod.check("helper_decrypt")
                 rs = pi.report_share
                 md = rs.metadata
                 if task.task_expiration and md.time > task.task_expiration:
@@ -344,23 +519,45 @@ class TaskAggregator:
                 if task.report_expired(md.time, now):
                     prep_err[i] = PrepareError.REPORT_DROPPED
                     continue
-                keypair = self._hpke_keypair(rs.encrypted_input_share.config_id)
+                cfg_id = rs.encrypted_input_share.config_id
+                if cfg_id not in kp_cache:
+                    kp_cache[cfg_id] = self._hpke_keypair(cfg_id)
+                keypair = kp_cache[cfg_id]
                 if keypair is None:
                     prep_err[i] = PrepareError.HPKE_UNKNOWN_CONFIG_ID
                     continue
-                aad = InputShareAad(task.task_id, md, rs.public_share).to_bytes()
-                try:
-                    plaintext = hpke_open(
-                        keypair,
-                        HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER),
-                        rs.encrypted_input_share,
-                        aad,
-                    )
-                except HpkeError:
-                    prep_err[i] = PrepareError.HPKE_DECRYPT_ERROR
+                group = hpke_groups.setdefault(cfg_id, (keypair, [], [], [], []))
+                group[1].append(i)
+                group[2].append(rs.encrypted_input_share.encapsulated_key)
+                group[3].append(rs.encrypted_input_share.payload)
+                group[4].append(
+                    InputShareAad(task.task_id, md, rs.public_share).to_bytes()
+                )
+
+            # pass 2: one batched open per config-id group. The
+            # propagated-deadline check moved from per-report to
+            # per-group: the batch amortizes the decrypt to ~tens of µs
+            # per report, so the check granularity a dead leader waits
+            # for is one window, not one report
+            plaintexts: list[bytes | None] = [None] * n
+            info = HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.HELPER)
+            for keypair, idxs_g, encs_g, pays_g, aads_g in hpke_groups.values():
+                deadline_mod.check("helper_decrypt")
+                metrics.hpke_batch_size.observe(len(idxs_g))
+                opened = hpke_open_batch(keypair, info, encs_g, pays_g, aads_g)
+                for i, pt in zip(idxs_g, opened):
+                    if isinstance(pt, HpkeError):
+                        prep_err[i] = PrepareError.HPKE_DECRYPT_ERROR
+                    else:
+                        plaintexts[i] = pt
+
+            # pass 3: per-report payload/message decode into columns
+            for i, pi in enumerate(inits):
+                if prep_err[i] is not None or plaintexts[i] is None:
                     continue
+                rs = pi.report_share
                 try:
-                    payload = PlaintextInputShare.from_bytes(plaintext).payload
+                    payload = plaintext_input_share_payload_fast(plaintexts[i])
                     seed, blind = self.wire.decode_helper_share(payload)
                     parts = self.wire.decode_public_share(rs.public_share)
                     tag, _, prep_share = decode_pingpong(pi.message)
@@ -1247,10 +1444,18 @@ class Aggregator:
         from .cache import GlobalHpkeKeypairCache, PeerAggregatorCache
         from .report_writer import ReportWriteBatcher
 
+        import threading
+
         self.ds = ds
         self.clock = clock or RealClock()
         self.cfg = cfg or Config()
         self._task_aggs: dict[bytes, TaskAggregator] = {}
+        # guards the cache INSERT (first-insert-wins): a concurrent
+        # upload burst on a fresh task used to hand each handler thread
+        # its OWN TaskAggregator — and since the ingest decrypt stage
+        # groups a window's lanes by task identity, a first-burst
+        # window degenerated into singleton "batches"
+        self._task_aggs_lock = threading.Lock()
         self.global_hpke_keypairs = GlobalHpkeKeypairCache(ds)
         self.peer_aggregators = PeerAggregatorCache(ds) if self.cfg.taskprov_enabled else None
         # datastore-outage survival: with a journal path configured the
@@ -1326,8 +1531,15 @@ class Aggregator:
                     task = self.ds.run_tx(lambda tx: tx.get_task(task_id), "get_task")
                 if task is None:
                     raise errors.UnrecognizedTask("unknown task", task_id)
-            ta = TaskAggregator(task, self.cfg, self.global_hpke_keypairs)
-            self._task_aggs[task_id.data] = ta
+            # first-insert-wins (the engine_cache idiom): construction
+            # touches circuit/engine lookup and must not serialize
+            # unrelated tasks' cold starts behind one global lock —
+            # racing builders each construct, the first insert wins,
+            # and every caller returns the SAME object so the ingest
+            # decrypt stage's (task, config) batch grouping holds
+            candidate = TaskAggregator(task, self.cfg, self.global_hpke_keypairs)
+            with self._task_aggs_lock:
+                ta = self._task_aggs.setdefault(task_id.data, candidate)
         return ta
 
     # ------------------------------------------------------------------

@@ -148,6 +148,8 @@ class DapHttpApp:
                     decrypt_workers=cfg.ingest_decrypt_workers,
                     decode_workers=cfg.ingest_decode_workers,
                     queue_depth=cfg.ingest_queue_depth,
+                    batch_window=cfg.ingest_batch_window,
+                    batch_linger_ms=cfg.ingest_batch_linger_ms,
                 )
                 # /statusz occupancy section (binary_utils health
                 # listener): in-flight uploads vs the admission bound
@@ -163,6 +165,8 @@ class DapHttpApp:
                         "occupancy": round(inflight / bound, 3) if bound else 0.0,
                         "decrypt_workers": pipe.decrypt_workers,
                         "decode_workers": pipe.decode_workers,
+                        "batch_window": pipe.batch_window,
+                        "batch_linger_ms": pipe.batch_linger_s * 1000.0,
                         "queue_high_watermark": cfg.queue_high_watermark,
                     }
 

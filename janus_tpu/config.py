@@ -173,8 +173,12 @@ class AggregatorConfig:
     collection_retry_after_s: int = 1
     # --- ingest pipeline + admission control (YAML `ingest:` section;
     # docs/INGEST.md tuning table) ---
-    ingest_decrypt_workers: int = 0  # 0 = one per host core
+    ingest_decrypt_workers: int = 0  # 0 = GIL-capability-sized (INGEST.md)
     ingest_decode_workers: int = 1
+    # flush-window batching of decode+decrypt (docs/INGEST.md "Batched
+    # decrypt"); window 1 restores the per-report path
+    ingest_batch_window: int = 32
+    ingest_batch_linger_ms: float = 2.0
     # must stay below max_handler_threads (each in-flight upload parks
     # a handler thread, so a larger bound can never fill)
     ingest_queue_depth: int = 24
@@ -224,6 +228,8 @@ class AggregatorConfig:
             collection_retry_after_s=int(d.get("collection_retry_after_secs", 1)),
             ingest_decrypt_workers=int(ingest.get("decrypt_workers", 0)),
             ingest_decode_workers=int(ingest.get("decode_workers", 1)),
+            ingest_batch_window=int(ingest.get("decrypt_batch_window", 32)),
+            ingest_batch_linger_ms=float(ingest.get("decrypt_batch_linger_ms", 2.0)),
             ingest_queue_depth=int(ingest.get("queue_depth", 24)),
             upload_bucket_rate=float(ingest.get("upload_bucket_rate", 0.0)),
             upload_bucket_burst=int(ingest.get("upload_bucket_burst", 0)),
@@ -261,6 +267,8 @@ class AggregatorConfig:
             collection_retry_after_s=self.collection_retry_after_s,
             ingest_decrypt_workers=self.ingest_decrypt_workers,
             ingest_decode_workers=self.ingest_decode_workers,
+            ingest_batch_window=self.ingest_batch_window,
+            ingest_batch_linger_ms=self.ingest_batch_linger_ms,
             ingest_queue_depth=self.ingest_queue_depth,
             upload_bucket_rate=self.upload_bucket_rate,
             upload_bucket_burst=self.upload_bucket_burst,

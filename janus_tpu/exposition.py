@@ -45,6 +45,15 @@ GRANDFATHERED_COUNTERS = frozenset(
     }
 )
 
+# Histograms whose unit is a COUNT, not a duration (their name carries
+# the unit implicitly). Everything else ending up as a histogram must
+# be a duration and end _seconds.
+SIZE_HISTOGRAMS = frozenset(
+    {
+        "janus_hpke_batch_size",
+    }
+)
+
 
 class ExpositionError(ValueError):
     pass
@@ -379,7 +388,11 @@ def lint_metric_names(
             errors.append(f"{name}: metric names must start with janus_")
         if typ == "counter" and not name.endswith("_total") and name not in grandfathered:
             errors.append(f"{name}: counters must end _total (or be grandfathered)")
-        if typ == "histogram" and not name.endswith("_seconds"):
+        if (
+            typ == "histogram"
+            and not name.endswith("_seconds")
+            and name not in SIZE_HISTOGRAMS
+        ):
             errors.append(f"{name}: duration histograms must end _seconds")
     return errors
 

@@ -1,36 +1,52 @@
-"""Staged, bounded upload ingest pipeline.
+"""Staged, bounded upload ingest pipeline with window-batched crypto.
 
 Replaces the per-handler-thread upload path (decode + HPKE open +
 validate + write, all on the request thread) with fixed-size stages
 connected by bounded queues:
 
     handler thread ──submit──▶ [decode q] ─▶ decode worker(s)
-        (parse Report, cheap time/keypair checks)
-                              ─▶ [decrypt q] ─▶ decrypt pool (≈ host cores)
-        (HPKE open + columnar share validation — the CPU-heavy stage.
-         What actually runs in parallel is the numpy share validation,
-         which releases the GIL; the HPKE open itself holds the GIL on
-         the ctypes-libcrypto fallback — deliberately, see the PyDLL
-         note in core/hpke_backend.py — and releases it only with the
-         `cryptography` wheel installed)
+        (drains a flush WINDOW of raw bodies, parses them columnar via
+         decode_reports_fast, runs the cheap time/keypair checks per
+         lane — one malformed upload rejects its own lane, never its
+         window)
+                              ─▶ [decrypt q] ─▶ decrypt pool
+        (whole windows: lanes grouped by (task, HPKE config) run ONE
+         hpke_open_batch — shared EVP objects, one-shot HKDF, one
+         reused cipher context — and one numpy range-validation pass.
+         Whether the batch call parallelizes across workers is a
+         backend property: the `cryptography` wheel releases the GIL,
+         the ctypes-libcrypto fallback holds it for the window (PyDLL
+         convoy note in core/hpke_backend.py) — the default pool size
+         comes from that capability, see default_decrypt_workers)
                               ─▶ ReportWriteBatcher group commit
         (one datastore transaction per accumulated batch; the batch's
          flush resolves every ticket it carried)
 
 The handler thread parks on an `UploadTicket` until its report's batch
 commits, so HTTP semantics are unchanged (201 after durable write,
-replays still 201). What changes is capacity behavior: in-flight
-uploads are bounded by `queue_depth`; when the bound is hit `submit`
-raises ShedError (429 + Retry-After at the HTTP layer) instead of
-growing threads; and decryption throughput scales with the worker pool
-rather than with the (unbounded) number of connections.
+replays still 201, stage errors map to the same problem documents).
+Capacity behavior is also unchanged from the pre-batching pipeline:
+in-flight uploads are bounded by `queue_depth`, the bound sheds
+ShedError (429 + Retry-After at the HTTP layer), and per-report
+admission/problem-document mapping is preserved lane-by-lane.
+
+`batch_window` bounds how many uploads one decode pass drains;
+`batch_linger_ms` is how long a decode worker waits for the window to
+fill once it holds at least one upload (group-commit style: drain
+whatever is queued, linger briefly for stragglers). `batch_window: 1`
+restores the exact per-report path (the verification oracle), which is
+also what lanes fall back to when a TaskAggregator double doesn't
+implement the batch surface.
 
 Stage occupancy is exported as `janus_ingest_queue_depth{stage=…}` /
 `janus_ingest_inflight` gauges, per-report stage latency as
-`janus_ingest_stage_duration_seconds{stage=…}`, and each stage runs in
-an `ingest.decode` / `ingest.decrypt` span parented under the
+`janus_ingest_stage_duration_seconds{stage=…}` (batched windows
+observe the window's amortized per-report share), achieved batch sizes
+as `janus_hpke_batch_size`, whole-window decrypt wall time as
+`janus_ingest_decrypt_batch_seconds`, and each report's stages emit
+`ingest.decode` / `ingest.decrypt` spans parented under the
 originating request's `dap.upload` span (trace context rides the
-ticket across threads).
+ticket across threads; batched spans carry a `batch=` attribute).
 """
 
 from __future__ import annotations
@@ -42,7 +58,7 @@ import threading
 import time
 
 from .. import failpoints, metrics, trace
-from ..messages import Report
+from ..messages import Report, decode_reports_fast
 from .admission import ShedError
 
 log = logging.getLogger(__name__)
@@ -50,10 +66,25 @@ log = logging.getLogger(__name__)
 _STOP = object()
 
 
-def default_decrypt_workers() -> int:
-    """One per host core, floor 2 (the decrypt stage is the CPU-heavy
-    one; cores beyond the queue bound buy nothing)."""
-    return max(2, os.cpu_count() or 2)
+def default_decrypt_workers(batched: bool = True) -> int:
+    """Decrypt-pool size when the config leaves it 0.
+
+    With a backend whose batch HPKE-open releases the GIL (the
+    `cryptography` wheel), the pool scales with cores: one worker per
+    host core, floor 2. On the ctypes-libcrypto fallback a batched
+    open HOLDS the GIL for its whole window (PyDLL — see
+    core/hpke_backend.py), so crypto from N workers serializes anyway
+    and extra workers only add convoy switches; 2 workers is the
+    measured crossover on this host — the second overlaps the numpy
+    validation (which releases the GIL) and the commit bookkeeping
+    with the next window's GIL-held crypto (docs/INGEST.md "Sizing the
+    decrypt pool")."""
+    from ..core import hpke_backend
+
+    cores = max(2, os.cpu_count() or 2)
+    if batched and not hpke_backend.BATCH_RELEASES_GIL:
+        return min(2, cores)
+    return cores
 
 
 class UploadTicket:
@@ -95,6 +126,17 @@ class UploadTicket:
         return self.fresh
 
 
+class _DecryptWindow:
+    """One decoded window headed for the decrypt stage: the shared
+    ReportColumn plus the surviving (ticket, lane index) pairs."""
+
+    __slots__ = ("col", "lanes")
+
+    def __init__(self, col, lanes):
+        self.col = col
+        self.lanes = lanes  # list[(UploadTicket, int)]
+
+
 class IngestPipeline:
     """Bounded staged ingest; see module docstring.
 
@@ -110,9 +152,19 @@ class IngestPipeline:
         # default matches aggregator Config.ingest_queue_depth; must
         # stay below the HTTP handler-pool bound to be reachable
         queue_depth: int = 24,
+        # flush-window batching (ISSUE 11): how many uploads one decode
+        # pass may drain into a single columnar decode + batched
+        # decrypt, and how long to linger for the window to fill once
+        # at least one upload is held. window 1 = per-report oracle.
+        batch_window: int = 32,
+        batch_linger_ms: float = 2.0,
     ):
         self.writer = writer
-        self.decrypt_workers = decrypt_workers or default_decrypt_workers()
+        self.batch_window = max(1, batch_window)
+        self.batch_linger_s = max(0.0, batch_linger_ms) / 1000.0
+        self.decrypt_workers = decrypt_workers or default_decrypt_workers(
+            self.batch_window > 1
+        )
         self.decode_workers = max(1, decode_workers)
         self.queue_depth = max(1, queue_depth)
         # queues sized to the in-flight bound so intra-pipeline puts
@@ -158,22 +210,28 @@ class IngestPipeline:
         return ticket
 
     def _start_locked(self) -> None:
+        decode_target = (
+            self._decode_loop if self.batch_window > 1 else self._decode_loop_single
+        )
+        decrypt_target = (
+            self._decrypt_loop if self.batch_window > 1 else self._decrypt_loop_single
+        )
         for i in range(self.decode_workers):
             t = threading.Thread(
-                target=self._decode_loop, name=f"ingest-decode-{i}", daemon=True
+                target=decode_target, name=f"ingest-decode-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
         for i in range(self.decrypt_workers):
             t = threading.Thread(
-                target=self._decrypt_loop, name=f"ingest-decrypt-{i}", daemon=True
+                target=decrypt_target, name=f"ingest-decrypt-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
         self._started = True
 
     # ------------------------------------------------------------------
-    # stages
+    # shared stage plumbing
     # ------------------------------------------------------------------
     def _resolve(self, ticket: UploadTicket, fresh=None, error=None) -> None:
         ticket.fresh = fresh
@@ -183,7 +241,223 @@ class IngestPipeline:
             metrics.ingest_inflight.set(self._inflight)
         ticket.event.set()
 
+    def _submit_stored(self, ticket: UploadTicket, stored) -> None:
+        """Hand one validated report to the group-commit writer; the
+        flusher thread resolves the ticket when its batch lands."""
+        t_commit = time.monotonic()
+
+        def on_done(pending, ticket=ticket, t_commit=t_commit):
+            # flusher thread: the group commit carrying this report
+            # finished (fresh/replay) or failed
+            wait_s = time.monotonic() - t_commit
+            metrics.ingest_stage_duration.observe(wait_s, stage="commit")
+            # marker span in the upload's trace: its position shows
+            # WHEN the group commit landed relative to decrypt, and
+            # its wait_s attribute carries the queue-to-durable gap
+            # (the flight recorder keeps it even with no writer)
+            with trace.use_context(ticket.trace_ctx), trace.span(
+                "ingest.commit", wait_s=round(wait_s, 6)
+            ):
+                pass
+            if pending.error is not None:
+                self._resolve(ticket, error=pending.error)
+            else:
+                self._resolve(ticket, fresh=pending.fresh)
+
+        try:
+            self.writer.submit_report(stored, on_done=on_done)
+        except BaseException as e:
+            self._resolve(ticket, error=e)
+
+    # ------------------------------------------------------------------
+    # batched stages (the serving path; ISSUE 11)
+    # ------------------------------------------------------------------
+    def _drain_window(self, first: UploadTicket):
+        """Collect up to batch_window tickets: whatever is already
+        queued, lingering batch_linger_s for stragglers. A _STOP
+        drained mid-window is honored AFTER the window (returned as
+        stop=True so the worker processes what it holds, then exits —
+        close() inserts one sentinel per worker)."""
+        window = [first]
+        deadline = time.monotonic() + self.batch_linger_s
+        while len(window) < self.batch_window:
+            timeout = deadline - time.monotonic()
+            try:
+                if timeout > 0:
+                    t = self._decode_q.get(timeout=timeout)
+                else:
+                    t = self._decode_q.get_nowait()
+            except queue.Empty:
+                break
+            if t is _STOP:
+                return window, True
+            window.append(t)
+        return window, False
+
     def _decode_loop(self) -> None:
+        while True:
+            first = self._decode_q.get()
+            if first is _STOP:
+                return
+            window, stop = self._drain_window(first)
+            metrics.ingest_queue_depth.set(self._decode_q.qsize(), stage="decode")
+            try:
+                self._decode_window(window)
+            except BaseException:  # never kill the worker; fail the window
+                log.exception("ingest decode window failed")
+                for t in window:
+                    if not t.event.is_set():
+                        self._resolve(
+                            t, error=RuntimeError("ingest decode stage failed")
+                        )
+            if stop:
+                return
+
+    def _decode_window(self, window: list) -> None:
+        t0 = time.monotonic()
+        col = decode_reports_fast([t.body for t in window])
+        for t in window:
+            t.body = b""  # decoded; free the raw copy
+
+        # pass 1 per lane: failpoint + parse verdict, inside the lane's
+        # own trace context (failpoint BEFORE the decode error, exactly
+        # like the per-report path: an armed ingest.decode failpoint
+        # wins over a malformed body)
+        survivors: list[tuple[UploadTicket, int]] = []
+        by_ta: dict[int, list[tuple[UploadTicket, int]]] = {}
+        for i, ticket in enumerate(window):
+            try:
+                with trace.use_context(ticket.trace_ctx), trace.span(
+                    "ingest.decode", batch=len(window)
+                ):
+                    failpoints.hit("ingest.decode")
+                    err = col.errors[i]
+                    if err is not None:
+                        raise err
+            except BaseException as e:
+                self._resolve(ticket, error=e)
+                continue
+            by_ta.setdefault(id(ticket.ta), []).append((ticket, i))
+
+        # pass 2 per task group: the cheap admission checks. Tasks with
+        # the batch surface run them columnar; doubles without it fall
+        # back to the per-report oracle on a realized Report.
+        for lanes in by_ta.values():
+            ta = lanes[0][0].ta
+            prepare_cols = getattr(ta, "upload_prepare_columns", None)
+            if prepare_cols is not None:
+                results = prepare_cols(lanes[0][0].clock, col, [i for _, i in lanes])
+                for (ticket, i), res in zip(lanes, results):
+                    if isinstance(res, BaseException):
+                        self._resolve(ticket, error=res)
+                    else:
+                        ticket.keypair = res
+                        survivors.append((ticket, i))
+            else:
+                for ticket, i in lanes:
+                    try:
+                        ticket.report = col.report(i)
+                        ticket.keypair = ticket.ta.upload_prepare(
+                            ticket.clock, ticket.report
+                        )
+                    except BaseException as e:
+                        self._resolve(ticket, error=e)
+                        continue
+                    survivors.append((ticket, i))
+
+        dt = time.monotonic() - t0
+        per_report = dt / max(1, len(window))
+        for _ in window:
+            metrics.ingest_stage_duration.observe(per_report, stage="decode")
+        if not survivors:
+            return
+        self._decrypt_q.put(_DecryptWindow(col, survivors))
+        metrics.ingest_queue_depth.set(self._decrypt_q.qsize(), stage="decrypt")
+
+    def _decrypt_loop(self) -> None:
+        while True:
+            item = self._decrypt_q.get()
+            if item is _STOP:
+                return
+            metrics.ingest_queue_depth.set(self._decrypt_q.qsize(), stage="decrypt")
+            try:
+                self._decrypt_window(item)
+            except BaseException:
+                log.exception("ingest decrypt window failed")
+                for ticket, _ in item.lanes:
+                    if not ticket.event.is_set():
+                        self._resolve(
+                            ticket, error=RuntimeError("ingest decrypt stage failed")
+                        )
+
+    def _decrypt_window(self, item: _DecryptWindow) -> None:
+        t0 = time.monotonic()
+        col = item.col
+        # per-lane failpoint first (budget semantics match the
+        # per-report path: a fired lane rejects without crypto)
+        live: list[tuple[UploadTicket, int]] = []
+        for ticket, i in item.lanes:
+            try:
+                with trace.use_context(ticket.trace_ctx):
+                    failpoints.hit("ingest.decrypt")
+            except BaseException as e:
+                self._resolve(ticket, error=e)
+                continue
+            live.append((ticket, i))
+
+        # group by (task, HPKE config id): one batched open per group.
+        # The config id comes from the decoded column, not keypair
+        # object identity — equal configs resolved through different
+        # lookups must still share a batch.
+        groups: dict[tuple, list[tuple[UploadTicket, int]]] = {}
+        for ticket, i in live:
+            groups.setdefault(
+                (id(ticket.ta), col.leader_config_ids[i]), []
+            ).append((ticket, i))
+
+        for lanes in groups.values():
+            ta = lanes[0][0].ta
+            keypair = lanes[0][0].keypair
+            batch = getattr(ta, "upload_decrypt_validate_batch", None)
+            if batch is not None:
+                with trace.span("ingest.decrypt_batch", batch=len(lanes)):
+                    results = batch(col, [i for _, i in lanes], keypair)
+                for (ticket, i), res in zip(lanes, results):
+                    with trace.use_context(ticket.trace_ctx), trace.span(
+                        "ingest.decrypt", batch=len(lanes)
+                    ):
+                        pass  # marker: this lane's decrypt ran in the batch
+                    if isinstance(res, BaseException):
+                        self._resolve(ticket, error=res)
+                    else:
+                        self._submit_stored(ticket, res)
+            else:
+                # oracle fallback for doubles without the batch surface
+                for ticket, i in lanes:
+                    try:
+                        with trace.use_context(ticket.trace_ctx), trace.span(
+                            "ingest.decrypt"
+                        ):
+                            report = ticket.report or col.report(i)
+                            stored = ticket.ta.upload_decrypt_validate(
+                                report, ticket.keypair
+                            )
+                    except BaseException as e:
+                        self._resolve(ticket, error=e)
+                        continue
+                    self._submit_stored(ticket, stored)
+
+        dt = time.monotonic() - t0
+        metrics.ingest_decrypt_batch_seconds.observe(dt)
+        per_report = dt / max(1, len(item.lanes))
+        for _ in item.lanes:
+            metrics.ingest_stage_duration.observe(per_report, stage="decrypt")
+
+    # ------------------------------------------------------------------
+    # single-report stages (batch_window=1: the pre-batching path,
+    # kept verbatim as the verification oracle and fallback mode)
+    # ------------------------------------------------------------------
+    def _decode_loop_single(self) -> None:
         while True:
             ticket = self._decode_q.get()
             if ticket is _STOP:
@@ -210,7 +484,7 @@ class IngestPipeline:
             self._decrypt_q.put(ticket)
             metrics.ingest_queue_depth.set(self._decrypt_q.qsize(), stage="decrypt")
 
-    def _decrypt_loop(self) -> None:
+    def _decrypt_loop_single(self) -> None:
         while True:
             ticket = self._decrypt_q.get()
             if ticket is _STOP:
@@ -232,30 +506,7 @@ class IngestPipeline:
                 metrics.ingest_stage_duration.observe(
                     time.monotonic() - t0, stage="decrypt"
                 )
-            t_commit = time.monotonic()
-
-            def on_done(pending, ticket=ticket, t_commit=t_commit):
-                # flusher thread: the group commit carrying this report
-                # finished (fresh/replay) or failed
-                wait_s = time.monotonic() - t_commit
-                metrics.ingest_stage_duration.observe(wait_s, stage="commit")
-                # marker span in the upload's trace: its position shows
-                # WHEN the group commit landed relative to decrypt, and
-                # its wait_s attribute carries the queue-to-durable gap
-                # (the flight recorder keeps it even with no writer)
-                with trace.use_context(ticket.trace_ctx), trace.span(
-                    "ingest.commit", wait_s=round(wait_s, 6)
-                ):
-                    pass
-                if pending.error is not None:
-                    self._resolve(ticket, error=pending.error)
-                else:
-                    self._resolve(ticket, fresh=pending.fresh)
-
-            try:
-                self.writer.submit_report(stored, on_done=on_done)
-            except BaseException as e:
-                self._resolve(ticket, error=e)
+            self._submit_stored(ticket, stored)
 
     # ------------------------------------------------------------------
     # shutdown
@@ -282,7 +533,16 @@ class IngestPipeline:
                     t = q.get_nowait()
                 except queue.Empty:
                     break
-                if t is not _STOP:
+                if t is _STOP:
+                    continue
+                if isinstance(t, _DecryptWindow):
+                    for ticket, _ in t.lanes:
+                        if not ticket.event.is_set():
+                            self._resolve(
+                                ticket,
+                                error=RuntimeError("ingest pipeline is closed"),
+                            )
+                else:
                     self._resolve(
                         t, error=RuntimeError("ingest pipeline is closed")
                     )

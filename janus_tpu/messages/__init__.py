@@ -52,6 +52,7 @@ from .core import (
     PrepareStepResult,
     Query,
     Report,
+    ReportColumn,
     ReportId,
     ReportIdChecksum,
     ReportMetadata,
@@ -62,7 +63,9 @@ from .core import (
     TimeInterval,
     QUERY_TYPES,
     decode_prepare_resps_fast,
+    decode_reports_fast,
     encode_report_share_raw,
+    plaintext_input_share_payload_fast,
 )
 from .problem_type import DapProblemType
 

@@ -993,6 +993,178 @@ def decode_prepare_resps_fast(raw: bytes) -> PrepareRespColumn:
     return PrepareRespColumn(ids, kinds, msgs, errs)
 
 
+class ReportColumn:
+    """A window of upload bodies parsed into parallel columns: 16-byte
+    report ids, u64 client times, public shares, and the two HPKE
+    ciphertexts decomposed into (config id, encapsulated key, payload)
+    columns — no per-report dataclass/Decoder machinery (the upload
+    analog of PrepareRespColumn; ISSUE 11). A lane that fails to parse
+    carries its DecodeError in `errors` and None in the data columns,
+    so one malformed upload rejects its own lane, never its window.
+    Accept/reject per lane is identical to `Report.from_bytes`
+    (fuzz-pinned by tests/test_ingest_batch.py)."""
+
+    __slots__ = (
+        "report_ids",
+        "times",
+        "public_shares",
+        "leader_config_ids",
+        "leader_encs",
+        "leader_payloads",
+        "helper_config_ids",
+        "helper_encs",
+        "helper_payloads",
+        "errors",
+    )
+
+    def __init__(self):
+        self.report_ids: list[bytes | None] = []
+        self.times: list[int | None] = []
+        self.public_shares: list[bytes | None] = []
+        self.leader_config_ids: list[int | None] = []
+        self.leader_encs: list[bytes | None] = []
+        self.leader_payloads: list[bytes | None] = []
+        self.helper_config_ids: list[int | None] = []
+        self.helper_encs: list[bytes | None] = []
+        self.helper_payloads: list[bytes | None] = []
+        self.errors: list[DecodeError | None] = []
+
+    def __len__(self) -> int:
+        return len(self.report_ids)
+
+    def report(self, i: int) -> Report:
+        """Realize lane i as the Report dataclass (the single-report
+        fallback path and TaskAggregator doubles without the batch
+        surface use this; the batched stages never do)."""
+        if self.errors[i] is not None:
+            raise self.errors[i]
+        return Report(
+            ReportMetadata(ReportId(self.report_ids[i]), Time(self.times[i])),
+            self.public_shares[i],
+            HpkeCiphertext(
+                HpkeConfigId(self.leader_config_ids[i]),
+                self.leader_encs[i],
+                self.leader_payloads[i],
+            ),
+            HpkeCiphertext(
+                HpkeConfigId(self.helper_config_ids[i]),
+                self.helper_encs[i],
+                self.helper_payloads[i],
+            ),
+        )
+
+    def helper_ciphertext(self, i: int) -> HpkeCiphertext:
+        return HpkeCiphertext(
+            HpkeConfigId(self.helper_config_ids[i]),
+            self.helper_encs[i],
+            self.helper_payloads[i],
+        )
+
+
+def _parse_report_fast(raw: bytes):
+    """One upload body -> (rid, time, public_share, leader_ct_parts,
+    helper_ct_parts); raises DecodeError on exactly the inputs
+    Report.from_bytes rejects (truncation anywhere, trailing bytes —
+    there are no value-level rejects in the Report layout: any u8
+    config id and any u64 time are valid)."""
+    total = len(raw)
+    if total < 28:  # report_id(16) + time(8) + public-share length(4)
+        raise DecodeError("unexpected end of input")
+    rid = raw[0:16]
+    t, plen = struct.unpack_from(">QI", raw, 16)
+    pos = 28
+    if total - pos < plen:
+        raise DecodeError("unexpected end of input")
+    pub = raw[pos : pos + plen]
+    pos += plen
+    cts = []
+    for _ in range(2):
+        if total - pos < 3:
+            raise DecodeError("unexpected end of input")
+        cfg = raw[pos]
+        (elen,) = struct.unpack_from(">H", raw, pos + 1)
+        pos += 3
+        if total - pos < elen:
+            raise DecodeError("unexpected end of input")
+        enc = raw[pos : pos + elen]
+        pos += elen
+        if total - pos < 4:
+            raise DecodeError("unexpected end of input")
+        (paylen,) = struct.unpack_from(">I", raw, pos)
+        pos += 4
+        if total - pos < paylen:
+            raise DecodeError("unexpected end of input")
+        pay = raw[pos : pos + paylen]
+        pos += paylen
+        cts.append((cfg, enc, pay))
+    if pos != total:
+        raise DecodeError(f"{total - pos} trailing bytes")
+    return rid, t, pub, cts[0], cts[1]
+
+
+def plaintext_input_share_payload_fast(raw: bytes) -> bytes:
+    """PlaintextInputShare.from_bytes(raw).payload without the
+    Decoder/dataclass machinery, accepting and rejecting exactly the
+    same inputs (the extension list's inner structure is still walked —
+    a skip-over parser would admit bodies the codec rejects). The
+    batched decrypt stage runs this once per opened plaintext."""
+    total = len(raw)
+    if total < 2:
+        raise DecodeError("unexpected end of input")
+    (elen,) = struct.unpack_from(">H", raw, 0)
+    pos = 2
+    ext_end = 2 + elen
+    if total < ext_end:
+        raise DecodeError("unexpected end of input")
+    while pos < ext_end:
+        if ext_end - pos < 4:  # u16 type + u16 data length
+            raise DecodeError("unexpected end of input")
+        (dlen,) = struct.unpack_from(">H", raw, pos + 2)
+        pos += 4 + dlen
+        if pos > ext_end:
+            raise DecodeError("unexpected end of input")
+    if total - ext_end < 4:
+        raise DecodeError("unexpected end of input")
+    (plen,) = struct.unpack_from(">I", raw, ext_end)
+    pos = ext_end + 4
+    if total - pos < plen:
+        raise DecodeError("unexpected end of input")
+    if pos + plen != total:
+        raise DecodeError(f"{total - pos - plen} trailing bytes")
+    return raw[pos : pos + plen]
+
+
+def decode_reports_fast(bodies) -> ReportColumn:
+    """Columnar upload-window decode (see ReportColumn)."""
+    col = ReportColumn()
+    for raw in bodies:
+        try:
+            rid, t, pub, lct, hct = _parse_report_fast(raw)
+        except DecodeError as e:
+            col.report_ids.append(None)
+            col.times.append(None)
+            col.public_shares.append(None)
+            col.leader_config_ids.append(None)
+            col.leader_encs.append(None)
+            col.leader_payloads.append(None)
+            col.helper_config_ids.append(None)
+            col.helper_encs.append(None)
+            col.helper_payloads.append(None)
+            col.errors.append(e)
+            continue
+        col.report_ids.append(rid)
+        col.times.append(t)
+        col.public_shares.append(pub)
+        col.leader_config_ids.append(lct[0])
+        col.leader_encs.append(lct[1])
+        col.leader_payloads.append(lct[2])
+        col.helper_config_ids.append(hct[0])
+        col.helper_encs.append(hct[1])
+        col.helper_payloads.append(hct[2])
+        col.errors.append(None)
+    return col
+
+
 @dataclass(frozen=True)
 class AggregateShareReq(Codec):
     """reference messages/src/lib.rs:2733."""

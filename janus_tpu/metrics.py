@@ -520,7 +520,23 @@ ingest_inflight = REGISTRY.gauge(
 )
 ingest_stage_duration = REGISTRY.histogram(
     "janus_ingest_stage_duration_seconds",
-    "per-report ingest stage latency (decode, decrypt, commit), by stage",
+    "per-report ingest stage latency (decode, decrypt, commit), by stage "
+    "(batched windows observe the window's amortized per-report share)",
+)
+# --- batched ingest crypto/decode (ISSUE 11; docs/INGEST.md "Batched
+# decrypt"): window sizes actually achieved by the flush-window
+# batching, and the wall time of one batched decrypt+validate pass ---
+hpke_batch_size = REGISTRY.histogram(
+    "janus_hpke_batch_size",
+    "reports per batched HPKE-open call (upload decrypt stage and the "
+    "helper's aggregate-init stage; 1 = the batching never found a "
+    "window — watch with the linger knob)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+ingest_decrypt_batch_seconds = REGISTRY.histogram(
+    "janus_ingest_decrypt_batch_seconds",
+    "wall time of one window-batched decrypt+validate pass on the "
+    "ingest pipeline (whole window, not per report)",
 )
 
 # --- device path: engine/dispatch metrics (docs/OBSERVABILITY.md

@@ -118,6 +118,10 @@ def main(argv=None) -> int:
         "janus_slo_burn_rate",
         "janus_build_info",
         "janus_process_start_time_seconds",
+        # batched ingest crypto (ISSUE 11) — registered at import in
+        # every binary, so absence is a deploy regression
+        "janus_hpke_batch_size",
+        "janus_ingest_decrypt_batch_seconds",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")

@@ -1,0 +1,559 @@
+"""Batched ingest crypto + columnar upload decode (ISSUE 11;
+docs/INGEST.md "Batched decrypt"): decode_reports_fast must be
+bit-identical to Report.from_bytes per lane (accept AND reject),
+hpke_open_batch must agree with the per-report hpke_open oracle on
+every lane — tamper/wrong-key/truncation rejects landing on the right
+report index — the reused EVP cipher context must be
+correct across interleaved keys/algorithms/threads, and the
+window-batched IngestPipeline must preserve per-report ticket
+semantics."""
+
+import dataclasses
+import secrets
+import threading
+
+import numpy as np
+import pytest
+
+from janus_tpu import metrics
+from janus_tpu.aggregator import Config
+from janus_tpu.aggregator.core import TaskAggregator
+from janus_tpu.aggregator.report_writer import ReportWriteBatcher
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.core import hpke_backend
+from janus_tpu.core.hpke import (
+    HpkeApplicationInfo,
+    HpkeError,
+    Label,
+    generate_hpke_config_and_private_key,
+    hpke_open,
+    hpke_open_batch,
+    hpke_seal,
+)
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.ingest import IngestPipeline
+from janus_tpu.ingest.pipeline import default_decrypt_workers
+from janus_tpu.messages import (
+    DecodeError,
+    HpkeAeadId,
+    HpkeCiphertext,
+    HpkeConfigId,
+    HpkeKdfId,
+    HpkeKemId,
+    PlaintextInputShare,
+    Report,
+    ReportId,
+    ReportMetadata,
+    Role,
+    Time,
+    decode_reports_fast,
+    plaintext_input_share_payload_fast,
+)
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+UPLOAD_INFO = HpkeApplicationInfo(Label.INPUT_SHARE, Role.CLIENT, Role.LEADER)
+
+
+# ---------------------------------------------------------------------------
+# decode_reports_fast vs Report.from_bytes
+# ---------------------------------------------------------------------------
+
+
+def _random_report(rng) -> Report:
+    return Report(
+        ReportMetadata(
+            ReportId(secrets.token_bytes(16)), Time(int(rng.integers(0, 1 << 40)))
+        ),
+        secrets.token_bytes(int(rng.integers(0, 48))),
+        HpkeCiphertext(
+            HpkeConfigId(int(rng.integers(0, 256))),
+            secrets.token_bytes(int(rng.integers(0, 64))),
+            secrets.token_bytes(int(rng.integers(0, 120))),
+        ),
+        HpkeCiphertext(
+            HpkeConfigId(int(rng.integers(0, 256))),
+            secrets.token_bytes(int(rng.integers(0, 64))),
+            secrets.token_bytes(int(rng.integers(0, 120))),
+        ),
+    )
+
+
+def test_decode_reports_fast_equivalent_on_valid_bodies():
+    rng = np.random.default_rng(31)
+    reports = [_random_report(rng) for _ in range(60)]
+    col = decode_reports_fast([r.to_bytes() for r in reports])
+    assert len(col) == 60
+    for i, r in enumerate(reports):
+        assert col.errors[i] is None
+        assert col.report_ids[i] == r.metadata.report_id.data
+        assert col.times[i] == r.metadata.time.seconds
+        assert col.public_shares[i] == r.public_share
+        assert col.leader_config_ids[i] == r.leader_encrypted_input_share.config_id.id
+        assert col.leader_encs[i] == r.leader_encrypted_input_share.encapsulated_key
+        assert col.leader_payloads[i] == r.leader_encrypted_input_share.payload
+        assert col.helper_ciphertext(i) == r.helper_encrypted_input_share
+        assert col.report(i) == r
+
+
+def test_decode_reports_fast_reject_divergence_fuzz():
+    """Mutational fuzz: truncations, trailing bytes and corrupted bytes
+    must produce a DecodeError lane exactly when Report.from_bytes
+    raises — and one bad lane never poisons its window."""
+    rng = np.random.default_rng(37)
+    base = _random_report(rng).to_bytes()
+    mutants = [base[:k] for k in range(0, len(base), 2)]
+    mutants += [base + b"\x00", base + secrets.token_bytes(5)]
+    for _ in range(300):
+        m = bytearray(base)
+        m[int(rng.integers(0, len(m)))] = int(rng.integers(0, 256))
+        mutants.append(bytes(m))
+    # decode the WHOLE mutant set as one window: per-lane verdicts
+    col = decode_reports_fast(mutants)
+    for i, m in enumerate(mutants):
+        try:
+            ref = Report.from_bytes(m)
+        except DecodeError:
+            ref = None
+        if ref is None:
+            assert isinstance(col.errors[i], DecodeError), m.hex()
+        else:
+            assert col.errors[i] is None, m.hex()
+            assert col.report(i) == ref
+
+
+def test_plaintext_input_share_fast_parse_divergence_fuzz():
+    rng = np.random.default_rng(41)
+    from janus_tpu.messages import Extension
+
+    base = PlaintextInputShare(
+        (Extension(0, b"ab"), Extension(0xFF00, b"")), secrets.token_bytes(33)
+    ).to_bytes()
+    mutants = [base[:k] for k in range(len(base))] + [base + b"\x00"]
+    for _ in range(250):
+        m = bytearray(base)
+        m[int(rng.integers(0, len(m)))] = int(rng.integers(0, 256))
+        mutants.append(bytes(m))
+    for m in mutants:
+        try:
+            want = PlaintextInputShare.from_bytes(m).payload
+        except DecodeError:
+            want = "ERR"
+        try:
+            got = plaintext_input_share_payload_fast(m)
+        except DecodeError:
+            got = "ERR"
+        assert got == want, m.hex()
+
+
+# ---------------------------------------------------------------------------
+# hpke_open_batch vs the per-report oracle
+# ---------------------------------------------------------------------------
+
+SUITES = [
+    (HpkeKemId.X25519_HKDF_SHA256, HpkeKdfId.HKDF_SHA256, HpkeAeadId.AES_128_GCM),
+    (HpkeKemId.X25519_HKDF_SHA256, HpkeKdfId.HKDF_SHA512, HpkeAeadId.CHACHA20POLY1305),
+    (HpkeKemId.P256_HKDF_SHA256, HpkeKdfId.HKDF_SHA384, HpkeAeadId.AES_256_GCM),
+]
+
+
+@pytest.mark.parametrize("kem,kdf,aead", SUITES, ids=lambda v: getattr(v, "name", v))
+def test_hpke_open_batch_equivalence_fuzz(kem, kdf, aead):
+    """Every lane of a mixed window (valid, tampered payload, truncated
+    payload, wrong/malformed encapsulated key, wrong AAD) must agree
+    with the per-report oracle: same plaintext on accepts, an
+    HpkeError lane exactly where the oracle raises — on the SAME
+    index."""
+    kp = generate_hpke_config_and_private_key(0, kem, kdf, aead)
+    other = generate_hpke_config_and_private_key(0, kem, kdf, aead)
+    rng = np.random.default_rng(43)
+    n = 24
+    pts = [secrets.token_bytes(int(rng.integers(1, 90))) for _ in range(n)]
+    aads = [secrets.token_bytes(int(rng.integers(0, 24))) for _ in range(n)]
+    cts = [hpke_seal(kp.config, UPLOAD_INFO, p, a) for p, a in zip(pts, aads)]
+    encs = [c.encapsulated_key for c in cts]
+    pays = [c.payload for c in cts]
+    # sabotage specific lanes
+    pays[3] = bytes([pays[3][0] ^ 1]) + pays[3][1:]  # tampered ciphertext
+    pays[5] = pays[5][:7]  # shorter than the AEAD tag
+    encs[7] = secrets.token_bytes(3)  # malformed encapsulated key
+    encs[9] = hpke_seal(other.config, UPLOAD_INFO, b"x", b"").encapsulated_key
+    pays[9] = hpke_seal(other.config, UPLOAD_INFO, b"x", b"").payload  # wrong key
+    aads[11] = aads[11] + b"!"  # AAD mismatch
+
+    got = hpke_open_batch(kp, UPLOAD_INFO, encs, pays, aads)
+    expected_err = {3, 5, 7, 9, 11}
+    for i in range(n):
+        try:
+            want = hpke_open(
+                kp, UPLOAD_INFO, HpkeCiphertext(kp.config.id, encs[i], pays[i]), aads[i]
+            )
+        except HpkeError:
+            want = None
+        if want is None:
+            assert isinstance(got[i], HpkeError), i
+            assert i in expected_err or i not in range(n)
+        else:
+            assert got[i] == want == pts[i], i
+    # sanity: the sabotaged lanes really were the reject lanes
+    assert {i for i in range(n) if isinstance(got[i], HpkeError)} == expected_err
+
+
+def test_hpke_open_batch_bad_recipient_key_rejects_per_lane():
+    """A corrupt RECIPIENT private key (bad provisioning) must come
+    back as per-lane HpkeError values — the oracle rejects each report
+    individually, so the batch must never throw a window-wide
+    exception (which the pipeline would surface as 500s)."""
+    from janus_tpu.core.hpke import HpkeKeypair
+
+    kp = generate_hpke_config_and_private_key(0)
+    ct = hpke_seal(kp.config, UPLOAD_INFO, b"x", b"a")
+    bad = HpkeKeypair(kp.config, b"not-32-bytes")
+    out = hpke_open_batch(
+        bad, UPLOAD_INFO, [ct.encapsulated_key] * 3, [ct.payload] * 3, [b"a"] * 3
+    )
+    assert len(out) == 3 and all(isinstance(o, HpkeError) for o in out)
+    with pytest.raises(HpkeError):
+        hpke_open(bad, UPLOAD_INFO, ct, b"a")
+
+
+def test_x25519_exchange_batch_matches_scalar():
+    if hpke_backend.BACKEND != "libcrypto":
+        pytest.skip("libcrypto-only surface")
+    pk_a, sk_a = hpke_backend.x25519_generate()
+    peers = [hpke_backend.x25519_generate()[0] for _ in range(8)]
+    got = hpke_backend.x25519_exchange_batch(sk_a, peers)
+    for pk, dh in zip(peers, got):
+        assert dh == hpke_backend.x25519_exchange(sk_a, pk)
+    # malformed lanes are None, in place, without failing the window
+    mixed = [peers[0], b"short", None, peers[1]]
+    got = hpke_backend.x25519_exchange_batch(sk_a, mixed)
+    assert got[0] == hpke_backend.x25519_exchange(sk_a, peers[0])
+    assert got[1] is None and got[2] is None
+    assert got[3] == hpke_backend.x25519_exchange(sk_a, peers[1])
+
+
+def test_aead_context_reuse_correctness_across_keys_and_threads():
+    """The pooled/reused EVP cipher context (the per-call create/free
+    fix) must not leak state between ops: interleaved encrypt/decrypt
+    across AES-128/AES-256/ChaCha instances, auth failures in the
+    middle, and 4 threads hammering concurrently all round-trip."""
+    rng = np.random.default_rng(47)
+    ciphers = [
+        hpke_backend.AESGCM(secrets.token_bytes(16)),
+        hpke_backend.AESGCM(secrets.token_bytes(32)),
+        hpke_backend.ChaCha20Poly1305(secrets.token_bytes(32)),
+    ]
+    errors = []
+
+    def hammer(seed: int) -> None:
+        local_rng = np.random.default_rng(seed)
+        try:
+            for k in range(120):
+                c = ciphers[k % 3]
+                nonce = secrets.token_bytes(12)
+                pt = secrets.token_bytes(int(local_rng.integers(0, 64)))
+                aad = secrets.token_bytes(int(local_rng.integers(0, 16)))
+                blob = c.encrypt(nonce, pt, aad)
+                assert c.decrypt(nonce, blob, aad) == pt
+                if k % 5 == 0:  # auth failure mid-stream must not poison
+                    bad = bytes([blob[0] ^ 1]) + blob[1:]
+                    try:
+                        c.decrypt(nonce, bad, aad)
+                        raise AssertionError("tampered ciphertext accepted")
+                    except ValueError:
+                        pass
+                    assert c.decrypt(nonce, blob, aad) == pt
+        except BaseException as e:  # surfaced on the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # batch open interleaves keys of both AES sizes through ONE context
+    keys = [secrets.token_bytes(16), secrets.token_bytes(32)] * 4
+    nonces = [secrets.token_bytes(12) for _ in keys]
+    pts = [secrets.token_bytes(20) for _ in keys]
+    blobs = [
+        hpke_backend.AESGCM(k).encrypt(nn, p, b"a")
+        for k, nn, p in zip(keys, nonces, pts)
+    ]
+    blobs[3] = blobs[3][:-1] + bytes([blobs[3][-1] ^ 1])
+    out = hpke_backend.aead_open_batch(
+        hpke_backend.AESGCM, keys, nonces, blobs, [b"a"] * len(keys)
+    )
+    for i, p in enumerate(pts):
+        if i == 3:
+            assert out[i] is None
+        else:
+            assert out[i] == p
+
+
+# ---------------------------------------------------------------------------
+# TaskAggregator batch stages vs the per-report oracle
+# ---------------------------------------------------------------------------
+
+
+def _leader_task(inst=None):
+    clock = MockClock(Time(1_600_000_000))
+    vdaf = inst or VdafInstance.count()
+    leader_kp = generate_hpke_config_and_private_key(config_id=0)
+    helper_kp = generate_hpke_config_and_private_key(config_id=1)
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+        .with_(
+            leader_aggregator_endpoint="http://leader",
+            helper_aggregator_endpoint="http://helper",
+            hpke_keys=(leader_kp,),
+            min_batch_size=1,
+        )
+        .build()
+    )
+    params = ClientParameters(
+        task.task_id, "http://leader", "http://helper", task.time_precision
+    )
+    client = Client(params, vdaf, leader_kp.config, helper_kp.config, clock=clock)
+    return clock, task, client
+
+
+@pytest.mark.parametrize(
+    "inst",
+    [VdafInstance.count(), VdafInstance.histogram(6), VdafInstance.sum_vec(16, 4)],
+    ids=lambda i: i.kind,
+)
+def test_upload_batch_stages_equivalent_to_oracle(inst):
+    """A window mixing valid reports with every per-report failure mode
+    (future timestamp, unknown config id, tampered ciphertext, bad
+    plaintext structure, share out of range) must resolve each lane to
+    exactly what the per-report oracle produces: same stored reports,
+    same error types, on the same indexes."""
+    clock, task, client = _leader_task(inst)
+    from janus_tpu.vdaf.testing import random_measurements
+
+    rng = np.random.default_rng(53)
+    meas = random_measurements(inst, 10, rng)
+    reports = [
+        client.prepare_report(m.tolist() if getattr(m, "ndim", 0) else int(m))
+        for m in meas
+    ]
+    # lane 2: report from the future
+    reports[2] = client.prepare_report(
+        meas[2].tolist() if getattr(meas[2], "ndim", 0) else int(meas[2]),
+        when=Time(1_600_000_000 + 30 * 24 * 3600),
+    )
+    # lane 4: unknown HPKE config id
+    reports[4] = dataclasses.replace(
+        reports[4],
+        leader_encrypted_input_share=dataclasses.replace(
+            reports[4].leader_encrypted_input_share, config_id=HpkeConfigId(99)
+        ),
+    )
+    # lane 6: tampered leader ciphertext
+    p6 = reports[6].leader_encrypted_input_share.payload
+    reports[6] = dataclasses.replace(
+        reports[6],
+        leader_encrypted_input_share=dataclasses.replace(
+            reports[6].leader_encrypted_input_share,
+            payload=bytes([p6[0] ^ 1]) + p6[1:],
+        ),
+    )
+    bodies = [r.to_bytes() for r in reports]
+
+    ta = TaskAggregator(task, Config())
+    # oracle pass
+    want = []
+    for r in reports:
+        try:
+            kp = ta.upload_prepare(clock, r)
+            want.append(ta.upload_decrypt_validate(r, kp))
+        except Exception as e:
+            want.append(e)
+    # batch pass
+    col = decode_reports_fast(bodies)
+    idxs = list(range(len(bodies)))
+    prepared = ta.upload_prepare_columns(clock, col, idxs)
+    got = [None] * len(bodies)
+    live = []
+    for i, res in enumerate(prepared):
+        if isinstance(res, BaseException):
+            got[i] = res
+        else:
+            live.append(i)
+    keypair = next(prepared[i] for i in live)
+    for i, res in zip(live, ta.upload_decrypt_validate_batch(col, live, keypair)):
+        got[i] = res
+
+    for i in range(len(bodies)):
+        if isinstance(want[i], BaseException):
+            assert type(got[i]) is type(want[i]), (i, got[i], want[i])
+            # same reject CLASS and same handler-visible prefix; the
+            # crypto-internal detail after the first colon may phrase
+            # the same failure differently (batch lanes can't always
+            # tell which EVP step rejected)
+            assert str(got[i]).split(":")[0] == str(want[i]).split(":")[0]
+        else:
+            assert got[i] == want[i], i
+
+
+def test_upload_batch_share_out_of_range_rejects_right_lane():
+    """An in-range window with ONE out-of-field-range share: the numpy
+    batch validation must reject that lane (same error type as the
+    oracle) and keep its neighbors."""
+    clock, task, client = _leader_task(VdafInstance.sum(8))
+    from janus_tpu.aggregator import errors as agg_errors
+    from janus_tpu.core.hpke import hpke_seal as seal
+    from janus_tpu.messages import InputShareAad
+
+    reports = [client.prepare_report(3) for _ in range(5)]
+    # re-seal lane 2's leader share with an out-of-range field element
+    r = reports[2]
+    ta = TaskAggregator(task, Config())
+    keypair = task.hpke_keys[0]
+    aad = InputShareAad(task.task_id, r.metadata, r.public_share).to_bytes()
+    plaintext = hpke_open(
+        keypair, UPLOAD_INFO, r.leader_encrypted_input_share, aad
+    )
+    share = bytearray(PlaintextInputShare.from_bytes(plaintext).payload)
+    share[: ta.wire.enc_size] = b"\xff" * ta.wire.enc_size  # >= MODULUS
+    forged = PlaintextInputShare((), bytes(share)).to_bytes()
+    reports[2] = dataclasses.replace(
+        r, leader_encrypted_input_share=seal(keypair.config, UPLOAD_INFO, forged, aad)
+    )
+
+    bodies = [x.to_bytes() for x in reports]
+    col = decode_reports_fast(bodies)
+    idxs = list(range(5))
+    kps = ta.upload_prepare_columns(clock, col, idxs)
+    out = ta.upload_decrypt_validate_batch(col, idxs, kps[0])
+    for i in range(5):
+        if i == 2:
+            assert isinstance(out[i], agg_errors.ReportRejected)
+            assert "out of field range" in str(out[i])
+        else:
+            assert not isinstance(out[i], BaseException)
+    # …and the oracle agrees about lane 2
+    with pytest.raises(agg_errors.ReportRejected):
+        ta.upload_decrypt_validate(reports[2], kps[2])
+
+
+# ---------------------------------------------------------------------------
+# window-batched pipeline semantics
+# ---------------------------------------------------------------------------
+
+
+def test_batched_pipeline_mixed_window_per_ticket_outcomes():
+    """One window holding valid, undecodable, future-dated and
+    tampered uploads: every ticket resolves to its own verdict and the
+    batched path demonstrably ran (one hpke_open_batch for the
+    window's surviving lanes)."""
+    clock, task, client = _leader_task()
+    from janus_tpu.aggregator import errors as agg_errors
+
+    eph = EphemeralDatastore(clock=clock)
+    try:
+        eph.datastore.run_tx(lambda tx: tx.put_task(task))
+        ta = TaskAggregator(task, Config())
+        writer = ReportWriteBatcher(eph.datastore, 100, 0)
+        # window == submit count so the window flushes on FILL, with a
+        # long linger only as backstop — the calls==1 assertion must
+        # not ride a 200 ms scheduler-stall race (the bench windowing
+        # proof uses the same discipline)
+        pipe = IngestPipeline(
+            writer, queue_depth=16, batch_window=6, batch_linger_ms=2000.0
+        )
+        try:
+            good = [client.prepare_report(1) for _ in range(4)]
+            future = client.prepare_report(1, when=Time(1_600_000_000 + 30 * 24 * 3600))
+            p = good[3].leader_encrypted_input_share.payload
+            tampered = dataclasses.replace(
+                good[3],
+                metadata=ReportMetadata(ReportId.random(), good[3].metadata.time),
+                leader_encrypted_input_share=dataclasses.replace(
+                    good[3].leader_encrypted_input_share,
+                    payload=bytes([p[0] ^ 1]) + p[1:],
+                ),
+            )
+            calls0, lanes0 = 0, 0
+            with metrics.hpke_batch_size._lock:
+                calls0 = sum(metrics.hpke_batch_size._totals.values())
+                lanes0 = sum(metrics.hpke_batch_size._sums.values())
+            bodies = [r.to_bytes() for r in good[:3]] + [
+                b"garbage",
+                future.to_bytes(),
+                tampered.to_bytes(),
+            ]
+            tickets = [pipe.submit(ta, clock, b) for b in bodies]
+            outcomes = []
+            for t in tickets:
+                try:
+                    outcomes.append(t.result(timeout_s=60))
+                except Exception as e:
+                    outcomes.append(e)
+            assert outcomes[0] is True and outcomes[1] is True and outcomes[2] is True
+            assert isinstance(outcomes[3], DecodeError)
+            assert isinstance(outcomes[4], agg_errors.ReportTooEarly)
+            assert isinstance(outcomes[5], agg_errors.ReportRejected)
+            with metrics.hpke_batch_size._lock:
+                calls = sum(metrics.hpke_batch_size._totals.values()) - calls0
+                lanes = sum(metrics.hpke_batch_size._sums.values()) - lanes0
+            assert calls == 1  # one batched open for the window
+            assert lanes == 4  # 3 valid + the tampered lane reached crypto
+            total, _ = eph.datastore.run_tx(
+                lambda tx: tx.count_client_reports_for_task(task.task_id)
+            )
+            assert total == 3
+        finally:
+            pipe.close()
+            writer.close()
+    finally:
+        eph.cleanup()
+
+
+def test_single_report_fallback_mode_still_works():
+    """batch_window=1 restores the per-report path end to end."""
+    clock, task, client = _leader_task()
+    eph = EphemeralDatastore(clock=clock)
+    try:
+        eph.datastore.run_tx(lambda tx: tx.put_task(task))
+        ta = TaskAggregator(task, Config())
+        writer = ReportWriteBatcher(eph.datastore, 100, 0)
+        pipe = IngestPipeline(writer, queue_depth=8, batch_window=1)
+        try:
+            tickets = [
+                pipe.submit(ta, clock, client.prepare_report(1).to_bytes())
+                for _ in range(3)
+            ]
+            assert all(t.result(timeout_s=60) for t in tickets)
+            with pytest.raises(DecodeError):
+                pipe.submit(ta, clock, b"junk").result(timeout_s=60)
+        finally:
+            pipe.close()
+            writer.close()
+        total, _ = eph.datastore.run_tx(
+            lambda tx: tx.count_client_reports_for_task(task.task_id)
+        )
+        assert total == 3
+    finally:
+        eph.cleanup()
+
+
+def test_decrypt_pool_sizing_follows_batch_gil_capability(monkeypatch):
+    """Satellite fix: the default decrypt pool is sized from the crypto
+    backend's batch GIL-release capability, not blindly from cores — a
+    GIL-holding PyDLL batch call serializes workers, so extra threads
+    only add convoy switches."""
+    import os as _os
+
+    monkeypatch.setattr(_os, "cpu_count", lambda: 16)
+    monkeypatch.setattr(hpke_backend, "BATCH_RELEASES_GIL", False)
+    assert default_decrypt_workers(batched=True) == 2
+    monkeypatch.setattr(hpke_backend, "BATCH_RELEASES_GIL", True)
+    assert default_decrypt_workers(batched=True) == 16
+    # the per-report fallback mode keeps the old cores-wide pool (its
+    # parallelizable stage is the GIL-releasing numpy validation)
+    monkeypatch.setattr(hpke_backend, "BATCH_RELEASES_GIL", False)
+    assert default_decrypt_workers(batched=False) == 16
