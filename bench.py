@@ -234,10 +234,26 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
                 min_aggregation_job_size=1, max_aggregation_job_size=job_size
             ),
         )
-        driver = AggregationJobDriver(leader_eph.datastore, http)
+        # resident accumulators on (ISSUE 12): the masked accumulate
+        # merges into device-resident per-bucket buffers (no per-job
+        # share fetch); the drain flush below writes them out before
+        # collection — the production resident-mode shape
+        from janus_tpu.aggregator.aggregation_job_driver import (
+            AggregationJobDriverConfig,
+            ResidentConfig,
+        )
+
+        driver = AggregationJobDriver(
+            leader_eph.datastore,
+            http,
+            AggregationJobDriverConfig(
+                resident=ResidentConfig(enabled=True, flush_interval_s=3600.0)
+            ),
+        )
         # the production stepper: the stage pipeline (ISSUE 9) — job
         # B's read+staging and HTTP legs overlap job A's device phases
-        # behind the serialized device lane
+        # behind the serialized device lane (double-buffered staging on
+        # by default: job k+1's H2D overlaps job k's dispatch)
         from janus_tpu.aggregator.step_pipeline import StepPipeline, StepPipelineConfig
 
         pipeline = StepPipeline(driver, StepPipelineConfig())
@@ -247,11 +263,32 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
             driver.stepper,
             pipeline=pipeline,
         )
+        hd_h2d0 = _m.engine_hd_bytes_total.get(direction="h2d")
+        hd_d2h0 = _m.engine_hd_bytes_total.get(direction="d2h")
+        prestage0 = {
+            o: _m.engine_prestage_total.get(outcome=o) for o in ("hit", "fallback")
+        }
         t0 = _time.time()
         creator.run_once()
         while jd.run_once():
             progress["t"] = time.monotonic()
+        resident_flushed = driver.flush_resident_state(reason="drain")
         aggregate_s = _time.time() - t0
+        resident_rider = {
+            "enabled": True,
+            "flushed_buffers": resident_flushed,
+            "hd_bytes_h2d": _m.engine_hd_bytes_total.get(direction="h2d") - hd_h2d0,
+            "hd_bytes_d2h": _m.engine_hd_bytes_total.get(direction="d2h") - hd_d2h0,
+            "prestage_hits": _m.engine_prestage_total.get(outcome="hit")
+            - prestage0["hit"],
+            "prestage_fallbacks": _m.engine_prestage_total.get(outcome="fallback")
+            - prestage0["fallback"],
+        }
+        resident_rider["hd_bytes_per_report"] = round(
+            (resident_rider["hd_bytes_h2d"] + resident_rider["hd_bytes_d2h"])
+            / max(1, n_reports),
+            1,
+        )
         progress["t"] = time.monotonic()
         # p50/p95 aggregation-job step latency from the flight-recorder
         # digest (PR 5) — BASELINE's second metric, read BEFORE the
@@ -353,6 +390,11 @@ def run_served(inst, n_reports: int, job_size: int, progress) -> dict:
                 "device_lane_busy_ratio": step_pipeline_status["device_lane"]["busy_ratio"],
                 "device_lane_dispatches": step_pipeline_status["device_lane"]["dispatches"],
             },
+            # resident accumulators + double-buffered staging over the
+            # served run (ISSUE 12): drain-flushed buffer count, the
+            # engine layer's host<->device bytes/report, and the
+            # prestage hit/fallback split
+            "resident": resident_rider,
             "collect_s": round(collect_s, 2),
             "metrics_scrape_valid": scrape_ok,
             # SLO engine + exemplar surface over the served run (ISSUE
@@ -2242,6 +2284,124 @@ def _device_hang_smoke() -> dict:
     )
 
 
+def _resident_chaos_smoke() -> dict:
+    """Resident-state flush-contract smoke (scripts/chaos_run.py
+    --scenario resident --smoke): the real driver binary with resident
+    accumulators on — LRU eviction, mid-stream quarantine sweep, and
+    SIGTERM drain each flush resident state through the write-tx path,
+    no flush reports outcome=lost, and both tasks' collections equal
+    their admitted ground truths exactly."""
+    return _run_chaos_subprocess(
+        ["--scenario", "resident", "--smoke", "--json"], timeout=300
+    )
+
+
+def _resident_accumulate_record(inst=None, n: int = 256, k: int = 16, jobs: int = 4) -> dict:
+    """Resident vs re-stage A/B on the SAME dataset (ISSUE 12): `jobs`
+    job steps of `n` out-share rows spread over `k` batch buckets run
+    through BOTH accumulate legs on one engine — the classic per-bucket
+    path (one n-bool mask upload + one aggregate fetch per bucket per
+    job) and the resident path (one [n] int32 upload per job, one fetch
+    for the whole run at take time). Reports host<->device bytes per
+    report on the accumulate leg from the real janus_engine_hd_bytes
+    accounting, rows per dispatch from the real dispatch counter, and
+    asserts the aggregate shares BIT-IDENTICAL (field elements mod p).
+    The >=2x bytes/report acceptance gate reads this record."""
+    import numpy as np
+
+    from janus_tpu import metrics as _m
+    from janus_tpu.aggregator.engine_cache import EngineCache
+    from janus_tpu.messages import Duration, Interval, Time
+    from janus_tpu.vdaf.registry import VdafInstance
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    inst = inst or VdafInstance.count()
+    eng = EngineCache(inst, bytes(range(16)))
+    p = eng.p3.jf.MODULUS
+    iv = Interval(Time(0), Duration(3600))
+    rng = np.random.default_rng(0xAB12)
+
+    def hd_totals() -> tuple[float, float]:
+        return (
+            _m.engine_hd_bytes_total.get(direction="h2d"),
+            _m.engine_hd_bytes_total.get(direction="d2h"),
+        )
+
+    total_rows = n * jobs
+    classic_totals: dict[int, list[int]] = {}
+    classic_h2d = classic_d2h = 0.0
+    resident_h2d = resident_d2h = 0.0
+    classic_dispatches = resident_dispatches = 0
+    out_shares = []
+    lane_buckets = []
+    for j in range(jobs):
+        meas = random_measurements(inst, n, rng)
+        args, _ = make_report_batch(inst, meas, seed=0xC0 + j)
+        nonce, public, mv, proof, blind0, _, _ = args
+        out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+        out_shares.append(out0)
+        lane_buckets.append(rng.integers(0, k, size=n).astype(np.int32))
+
+    # --- A: classic re-stage leg (the pre-resident shape) -------------
+    d0 = _m.engine_dispatches_total.get(op="aggregate")
+    h0, f0 = hd_totals()
+    for out0, lane_bucket in zip(out_shares, lane_buckets):
+        for j in range(k):
+            share = eng.aggregate(out0, lane_bucket == j)
+            tot = classic_totals.setdefault(j, [0] * len(share))
+            for i, x in enumerate(share):
+                tot[i] = (tot[i] + x) % p
+    h1, f1 = hd_totals()
+    classic_h2d, classic_d2h = h1 - h0, f1 - f0
+    classic_dispatches = int(_m.engine_dispatches_total.get(op="aggregate") - d0)
+
+    # --- B: resident leg (same rows, same buckets) --------------------
+    d0 = _m.engine_dispatches_total.get(op="aggregate")
+    h0, f0 = hd_totals()
+    for out0, lane_bucket in zip(out_shares, lane_buckets):
+        pend = eng.aggregate_pending(out0, lane_bucket, k)
+        entries = [
+            ((b"bench-task", b"", b"bucket-%d" % j), j, int((lane_bucket == j).sum()), iv)
+            for j in range(k)
+        ]
+        evicted = eng.resident_merge(entries, pend)
+        assert evicted == [], "bench run must not hit the byte cap"
+    recs = {r["key"][2]: r["share"] for r in eng.resident_take()}
+    h1, f1 = hd_totals()
+    resident_h2d, resident_d2h = h1 - h0, f1 - f0
+    resident_dispatches = int(_m.engine_dispatches_total.get(op="aggregate") - d0)
+
+    identical = all(
+        recs.get(b"bucket-%d" % j) == classic_totals[j] for j in range(k)
+    )
+    classic_bpr = (classic_h2d + classic_d2h) / total_rows
+    resident_bpr = (resident_h2d + resident_d2h) / total_rows
+    return {
+        "n_per_job": n,
+        "jobs": jobs,
+        "buckets": k,
+        "total_rows": total_rows,
+        "classic": {
+            "h2d_bytes_per_report": round(classic_h2d / total_rows, 2),
+            "d2h_bytes_per_report": round(classic_d2h / total_rows, 2),
+            "hd_bytes_per_report": round(classic_bpr, 2),
+            "dispatches": classic_dispatches,
+            "rows_per_dispatch": round(total_rows / max(1, classic_dispatches), 1),
+        },
+        "resident": {
+            "h2d_bytes_per_report": round(resident_h2d / total_rows, 2),
+            "d2h_bytes_per_report": round(resident_d2h / total_rows, 2),
+            "hd_bytes_per_report": round(resident_bpr, 2),
+            "dispatches": resident_dispatches,
+            "rows_per_dispatch": round(total_rows / max(1, resident_dispatches), 1),
+        },
+        # THE acceptance number: host<->device bytes/report on the
+        # accumulate leg, classic / resident (gate: >= 2.0)
+        "hd_bytes_per_report_ratio": round(classic_bpr / max(1e-9, resident_bpr), 2),
+        "aggregates_identical": identical,
+    }
+
+
 def _db_outage_smoke() -> dict:
     """Datastore-outage survival smoke (scripts/chaos_run.py
     --scenario db_outage --smoke): uploads keep acking 201 through a
@@ -2327,6 +2487,12 @@ def run_dry(args, ap) -> None:
                 "chaos_smoke": _chaos_smoke(),
                 "db_outage_smoke": _db_outage_smoke(),
                 "device_hang_smoke": _device_hang_smoke(),
+                # ISSUE 12: resident vs re-stage accumulate A/B
+                # (bit-identical shares asserted; the >=2x bytes/report
+                # gate reads hd_bytes_per_report_ratio) + the live
+                # flush-contract proof against the real driver binary
+                "resident_accumulate": _resident_accumulate_record(inst),
+                "resident_smoke": _resident_chaos_smoke(),
                 # ISSUE 9: columnar wire codec vs the per-report loop
                 # (bit-identical bytes asserted) + the stage-pipeline
                 # overlap proof against the REAL driver binary
@@ -2786,6 +2952,12 @@ def main() -> None:
             "upload_batch_speed": _upload_batch_speed_record(inst, window=256),
             "open_loop_upload": _open_loop_upload_record(),
         }
+    except Exception:
+        pass
+    try:
+        # ISSUE 12: resident vs re-stage accumulate A/B on this
+        # config's circuit (the >=2x bytes/report acceptance gate)
+        riders["resident_accumulate"] = _resident_accumulate_record(inst)
     except Exception:
         pass
     if args.mode != "served":

@@ -94,6 +94,42 @@ def fixed_size_batch_id(pbs) -> bytes | None:
     return pbs.batch_id.data if pbs.query_type == FixedSize.CODE else None
 
 
+def group_batch_buckets(
+    task, metadatas, accept, batch_identifier: bytes | None
+) -> dict[bytes, list[int]]:
+    """Accepted lane indices grouped by batch identifier — the ONE
+    definition of the bucket mapping, shared by the classic per-bucket
+    reduce below and the device-resident delta path (a divergence here
+    would silently put the two paths' shares in different batches)."""
+    buckets: dict[bytes, list[int]] = {}
+    for i, md in enumerate(metadatas):
+        if not accept[i]:
+            continue
+        if batch_identifier is not None:
+            bid = batch_identifier
+        else:
+            start = md.time.to_batch_interval_start(task.time_precision)
+            bid = Interval(start, task.time_precision).to_bytes()
+        buckets.setdefault(bid, []).append(i)
+    return buckets
+
+
+def bucket_metadata(task, metadatas, lanes):
+    """(checksum, client interval) over one bucket's lanes — shared by
+    the classic and resident accumulate paths."""
+    checksum = ReportIdChecksum()
+    lo = hi = None
+    for i in lanes:
+        checksum = checksum.updated_with(metadatas[i].report_id)
+        t = metadatas[i].time
+        lo = t if lo is None or t < lo else lo
+        hi = t if hi is None or t > hi else hi
+    interval = Interval(
+        lo.to_batch_interval_start(task.time_precision), task.time_precision
+    )
+    return checksum, interval
+
+
 def accumulate_batched(
     task, engine, accumulator: "Accumulator", out_shares, accept, metadatas,
     batch_identifier: bytes | None = None,
@@ -112,34 +148,21 @@ def accumulate_batched(
     """
     import numpy as np
 
-    from ..messages import Interval
-
     n = len(metadatas)
     if n == 0:
         return
     field = accumulator.field
-    buckets: dict[bytes, list[int]] = {}
-    for i, md in enumerate(metadatas):
-        if not accept[i]:
-            continue
-        if batch_identifier is not None:
-            bid = batch_identifier
-        else:
-            start = md.time.to_batch_interval_start(task.time_precision)
-            bid = Interval(start, task.time_precision).to_bytes()
-        buckets.setdefault(bid, []).append(i)
+    buckets = group_batch_buckets(task, metadatas, accept, batch_identifier)
+    # one reusable mask scratch for the whole job: a many-bucket
+    # time-interval job used to allocate a fresh n-bool array per
+    # bucket (visible in the PR 8 lane profile); lanes are reset after
+    # each dispatch instead
+    bucket_mask = np.zeros(n, dtype=bool)
     for bid, lanes in buckets.items():
-        bucket_mask = np.zeros(n, dtype=bool)
         bucket_mask[lanes] = True
         share_ints = engine.aggregate(out_shares, bucket_mask)
-        checksum = ReportIdChecksum()
-        lo = hi = None
-        for i in lanes:
-            checksum = checksum.updated_with(metadatas[i].report_id)
-            t = metadatas[i].time
-            lo = t if lo is None or t < lo else lo
-            hi = t if hi is None or t > hi else hi
-        interval = Interval(lo.to_batch_interval_start(task.time_precision), task.time_precision)
+        bucket_mask[lanes] = False
+        checksum, interval = bucket_metadata(task, metadatas, lanes)
         accumulator.update(
             bid,
             field.encode_vec(share_ints),

@@ -19,6 +19,8 @@ init idempotently (helper request-hash dedup).
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -55,6 +57,7 @@ from ..messages import (
     PrepareError,
     PrepareInit,
     PrepareStepResult,
+    ReportIdChecksum,
     ReportShare,
     ReportMetadata,
     decode_prepare_resps_fast,
@@ -76,8 +79,14 @@ from ..vdaf.wire import (
     pingpong_finish_frame_matches,
     seeds_to_lanes,
 )
-from .accumulator import Accumulator, accumulate_batched, fixed_size_batch_id
-from .engine_cache import DeviceHangError, engine_cache
+from .accumulator import (
+    Accumulator,
+    accumulate_batched,
+    bucket_metadata,
+    fixed_size_batch_id,
+    group_batch_buckets,
+)
+from .engine_cache import DeviceHangError, EngineCache, engine_cache
 
 log = logging.getLogger(__name__)
 
@@ -86,6 +95,37 @@ def _err_or_default(err) -> PrepareError:
     """PrepareError.BATCH_COLLECTED has enum value 0 (falsy), so the
     `err or DEFAULT` idiom silently rewrites it; compare against None."""
     return err if err is not None else PrepareError.VDAF_PREP_ERROR
+
+
+# watchdog bound for resident-state fetches issued from threads with no
+# ambient lease deadline (background flusher, drain): long enough for a
+# busy device to answer, short enough that a wedged one can't park the
+# flush pass holding the engine's resident lock
+RESIDENT_FLUSH_FETCH_BOUND_S = 30.0
+
+
+@dataclass
+class ResidentConfig:
+    """Device-resident accumulator knobs (YAML `resident_accumulators:`
+    stanza of the driver binary; docs/ARCHITECTURE.md "Resident
+    aggregate state"). Disabled by default: resident mode trades the
+    per-job share fetch + write for a bounded durability window (a HARD
+    crash — not drain/eviction/quarantine, which all flush — loses the
+    unflushed window; see ROBUSTNESS.md fault matrix)."""
+
+    enabled: bool = False
+    # flush-to-datastore cadence for dirty resident buffers (also the
+    # background flusher's pass interval); the loss window of a hard
+    # crash is bounded by roughly this much accumulation
+    flush_interval_s: float = 5.0
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "ResidentConfig":
+        d = d or {}
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            flush_interval_s=float(d.get("flush_interval_secs", 5.0)),
+        )
 
 
 @dataclass
@@ -103,6 +143,8 @@ class AggregationJobDriverConfig:
     # floor for the breaker-open step-back reacquire delay so a job
     # whose cooldown is nearly over doesn't spin acquire/step-back
     min_step_back_delay_s: int = 1
+    # device-resident accumulator state (ISSUE 12)
+    resident: ResidentConfig = field(default_factory=ResidentConfig)
 
 
 @dataclass
@@ -140,6 +182,14 @@ class InitStepState:
     continue_msgs: list | None = None
     # accumulate output (device lane)
     accumulator: Accumulator | None = None
+    # double-buffered staging handle (engine.prestage_leader, issued by
+    # the pipeline's read stage while the lane runs the previous job)
+    prestaged: object = None
+    # resident-accumulate handles (device PendingDeltas + the per-bucket
+    # merge entries), consumed post-commit by commit_finish
+    resident_delta: object = None
+    resident_entries: list | None = None
+    resident_rids: list | None = None
 
 
 class AggregationJobDriver:
@@ -164,6 +214,13 @@ class AggregationJobDriver:
         # shutdown Stopper: in-flight helper retries abort on SIGTERM so
         # the step can step back instead of spending the whole lease
         self.stopper = stopper
+        # resident-flush cadence state (ISSUE 12): the last time this
+        # driver pushed dirty resident buffers through the write-tx path
+        self._resident_flush_lock = threading.Lock()
+        # seeded to "now" so the first inline flush waits a full
+        # interval (0.0 would flush on the very first commit: monotonic
+        # time is process uptime, always past the interval)
+        self._resident_last_flush = time.monotonic()
 
     # --- JobDriver callbacks (reference :840-894) ---
     def acquirer(self, lease_duration_s: int = 600):
@@ -475,9 +532,15 @@ class AggregationJobDriver:
 
     def device_init(self, st: "InitStepState") -> None:
         """Device stage: batched leader prepare-init (reference hot
-        loop :329-402). Owned by the pipeline's device lane."""
+        loop :329-402). Owned by the pipeline's device lane. A
+        prestaged column set (double-buffered staging: the read stage
+        issued the H2D async while the lane ran the previous job) is
+        consumed here; leader_init falls back to the host columns when
+        it can't use it."""
+        prestaged, st.prestaged = st.prestaged, None
         st.out0, st.seed0, st.ver0, st.part0 = st.engine.leader_init(
-            st.nonce_lanes, st.public_parts, st.meas, st.proof, st.blind_lanes, ok=st.ok
+            st.nonce_lanes, st.public_parts, st.meas, st.proof, st.blind_lanes,
+            ok=st.ok, prestaged=prestaged,
         )
 
     def http_init(self, st: "InitStepState") -> None:
@@ -653,13 +716,31 @@ class AggregationJobDriver:
 
     def device_accumulate(self, st: "InitStepState") -> None:
         """Device stage: masked accumulate (reference
-        Accumulator::update :605-627). Owned by the device lane."""
+        Accumulator::update :605-627). Owned by the device lane.
+
+        Resident mode (ISSUE 12): instead of one masked reduce + host
+        fetch per batch bucket, compute ALL buckets' sums as one device
+        PendingDeltas (one [n] int32 upload, zero fetch) and record
+        share=None entries in the job's Accumulator — the share bytes
+        stay in device memory and merge into the engine's resident
+        buffers only after the write tx commits (commit_finish). The
+        classic path remains the fallback whenever the engine can't
+        serve it (host fallback/quarantine) or the delta dispatch fails
+        for a non-hang reason."""
         from ..trace import span
 
         st.accumulator = Accumulator(st.task, self.cfg.batch_aggregation_shard_count)
         metadatas = [ReportMetadata(ra.report_id, ra.client_time) for ra in st.pending]
         pbs = PartialBatchSelector.from_bytes(st.job.partial_batch_identifier)
+        bid_fixed = fixed_size_batch_id(pbs)
         with span("driver.accumulate", batch=len(st.pending)):
+            if (
+                self.cfg.resident.enabled
+                and isinstance(st.engine, EngineCache)
+                and st.engine.resident_ready()
+                and self._device_accumulate_resident(st, metadatas, bid_fixed)
+            ):
+                return
             accumulate_batched(
                 st.task,
                 st.engine,
@@ -667,8 +748,59 @@ class AggregationJobDriver:
                 st.out0,
                 st.accept,
                 metadatas,
-                batch_identifier=fixed_size_batch_id(pbs),
+                batch_identifier=bid_fixed,
             )
+
+    def _device_accumulate_resident(self, st, metadatas, bid_fixed) -> bool:
+        """Resident accumulate attempt. True = st.accumulator holds
+        share=None entries and st.resident_delta carries the device
+        sums; False = caller must run the classic path."""
+        n = len(metadatas)
+        buckets = group_batch_buckets(st.task, metadatas, st.accept, bid_fixed)
+        if not buckets:
+            return True  # nothing accepted; nothing to merge either
+        keys = list(buckets)
+        lane_bucket = np.full(n, -1, dtype=np.int32)
+        for j, bid in enumerate(keys):
+            lane_bucket[buckets[bid]] = j
+        try:
+            delta = st.engine.aggregate_pending(st.out0, lane_bucket, len(keys))
+        except (DeviceHangError, DeadlineExceeded):
+            raise  # step-back semantics, identical to the classic path
+        except Exception:
+            log.warning(
+                "resident accumulate failed for job %s; falling back to the "
+                "classic per-bucket path",
+                st.acquired.job_id,
+                exc_info=True,
+            )
+            return False
+        entries = []
+        rids0 = []
+        for j, bid in enumerate(keys):
+            lanes = buckets[bid]
+            checksum, interval = bucket_metadata(st.task, metadatas, lanes)
+            st.accumulator.update(
+                bid,
+                None,  # the share bytes live on device until flush
+                len(lanes),
+                checksum,
+                interval,
+                [metadatas[i].report_id for i in lanes],
+            )
+            entries.append(
+                (
+                    (st.task.task_id.data, st.job.aggregation_parameter, bid),
+                    j,
+                    len(lanes),
+                    interval,
+                )
+            )
+            rids0.append(metadatas[lanes[0]].report_id.data)
+        st.resident_delta = delta
+        st.resident_entries = entries
+        st.resident_rids = rids0
+        return True
 
     def commit_finish(self, st: "InitStepState") -> None:
         """Commit stage: tx2 writes results + releases the lease
@@ -705,11 +837,229 @@ class AggregationJobDriver:
 
         with span("driver.write_tx", batch=len(st.pending)):
             self.ds.run_tx(write, "step_agg_job_write")
+        # resident mode: the device deltas merge into the engine's
+        # resident buffers ONLY now, after the commit landed — a failed
+        # write tx (or a step-back anywhere earlier) just drops the
+        # PendingDeltas object, so the re-step under a fresh lease can
+        # never double-merge. A hard crash in this window loses the
+        # delta (the documented resident durability window,
+        # ROBUSTNESS.md fault matrix).
+        if st.resident_delta is not None:
+            self._resident_post_commit(st, cell.get("unmerged", set()))
         # e2e SLO observed only AFTER the write committed: a failed step
         # retried under a fresh lease must not leave phantom samples
         from .accumulator import observe_finished_report_e2e
 
         observe_finished_report_e2e(self.ds.clock, new_ras, cell.get("unmerged", ()))
+
+    # --- resident aggregate state: merge + flush (ISSUE 12) -----------
+    def _resident_post_commit(self, st, unmerged: set) -> None:
+        """Merge the job's committed deltas into resident buffers; flush
+        LRU-evicted slots immediately; honor the flush cadence."""
+        engine = st.engine
+        # a bucket whose batch was collected mid-flight had ALL its
+        # reports refused by flush_to_datastore (BATCH_COLLECTED) — its
+        # delta must not enter the resident share either
+        entries = [
+            e
+            for e, rid0 in zip(st.resident_entries, st.resident_rids)
+            if rid0 not in unmerged
+        ]
+        delta, st.resident_delta = st.resident_delta, None
+        if entries:
+            try:
+                evicted = engine.resident_merge(entries, delta)
+            except Exception as merge_exc:
+                # the commit LANDED but the merge didn't: the
+                # contributions must not vanish — fetch the delta rows
+                # directly and push them through the flush path. A
+                # mid-loop failure leaves a merged PREFIX safely on
+                # device (ResidentMergeError.merged); flushing those
+                # again would double-count them when their slot
+                # flushes, so only the remainder goes out directly.
+                merged_keys = getattr(merge_exc, "merged", frozenset())
+                remaining = [e for e in entries if e[0] not in merged_keys]
+                log.error(
+                    "resident merge failed post-commit for job %s (%d of %d "
+                    "buckets merged before the failure); flushing the "
+                    "remaining delta rows directly",
+                    st.acquired.job_id,
+                    len(merged_keys),
+                    len(entries),
+                    exc_info=True,
+                )
+                recs = []
+                try:
+                    recs = engine.fetch_delta_records(remaining, delta)
+                except Exception:
+                    metrics.engine_resident_flushes_total.add(
+                        len(remaining), reason="merge_failed", outcome="lost"
+                    )
+                    log.exception(
+                        "resident delta fetch also failed; %d bucket "
+                        "contribution(s) of job %s are LOST",
+                        len(remaining),
+                        st.acquired.job_id,
+                    )
+                    recs = []
+                if recs:
+                    self.flush_resident_records(engine, recs, reason="merge_failed")
+            else:
+                if evicted:
+                    self.flush_resident_records(engine, evicted, reason="eviction")
+        self.maybe_flush_resident(engine)
+
+    def maybe_flush_resident(self, engine) -> None:
+        """Honor the flush cadence inline (the background flusher covers
+        idle periods; this keeps a busy serial driver bounded too)."""
+        interval = self.cfg.resident.flush_interval_s
+        now = time.monotonic()
+        with self._resident_flush_lock:
+            if now - self._resident_last_flush < interval:
+                return
+            self._resident_last_flush = now
+        self.flush_engine_resident(engine, reason="interval")
+
+    def flush_engine_resident(self, engine, reason: str = "interval") -> int:
+        """Take every resident slot of `engine` and write the shares
+        through the existing batch-aggregation write-tx path. Returns
+        the number of buffers flushed. A take failure (wedged device)
+        leaves the slots resident — retried on the next pass/drain."""
+        if not isinstance(engine, EngineCache):
+            return 0
+        from .job_driver import datastore_down
+
+        if reason != "drain" and datastore_down(self.ds):
+            # flushing into a known-down store would pop the slots and
+            # then LOSE the fetched shares when the tx fails (the flush
+            # is at-most-once by design — no idempotency key guards a
+            # re-flush against double-merging on a commit-ack loss).
+            # Leave the state resident; the flusher retries after the
+            # supervisor reports the store back up.
+            return 0
+        try:
+            if current_deadline() is None:
+                # flusher/drain threads carry no ambient lease deadline,
+                # and without one the dispatch watchdog degrades to a
+                # direct call — a wedged device would then block this
+                # fetch FOREVER while resident_take holds the engine's
+                # resident lock, deadlocking every commit worker behind
+                # it. Bound the fetch; a timeout restores the slots and
+                # the next pass retries.
+                with deadline_scope(
+                    time.monotonic() + RESIDENT_FLUSH_FETCH_BOUND_S
+                ):
+                    recs = engine.resident_take()
+            else:
+                recs = engine.resident_take()
+        except Exception:
+            log.warning(
+                "resident take failed for %s (%s); state stays resident for retry",
+                engine.inst.kind,
+                reason,
+                exc_info=True,
+            )
+            return 0
+        if not recs:
+            return 0
+        return self.flush_resident_records(engine, recs, reason)
+
+    def flush_resident_state(self, reason: str = "interval") -> int:
+        """Flush every live engine's resident buffers (drain hook; also
+        the background flusher's pass body)."""
+        from .engine_cache import live_engines
+
+        # share the cadence stamp with the inline post-commit check:
+        # without this, a busy driver with the background flusher
+        # running pays the full take + flush tx TWICE per interval
+        with self._resident_flush_lock:
+            self._resident_last_flush = time.monotonic()
+        flushed = 0
+        for eng in live_engines():
+            flushed += self.flush_engine_resident(
+                eng,
+                reason if eng.resident_ready() else "quarantine",
+            )
+        return flushed
+
+    def flush_resident_records(self, engine, recs: list, reason: str) -> int:
+        """Persist fetched resident shares through the existing
+        Accumulator write-tx path (share-only merges: count 0, identity
+        checksum — counts/checksums were durable at each job's commit).
+        A batch collected before its flush arrived is a LOST share
+        (counted + ERROR-logged); a deleted task is stale state."""
+        from ..messages import TaskId
+
+        by_task: dict[bytes, list] = {}
+        for r in recs:
+            by_task.setdefault(r["key"][0], []).append(r)
+        flushed = 0
+        for task_id_bytes, rows in by_task.items():
+            outcome_cell: dict = {}
+
+            def write(tx, task_id_bytes=task_id_bytes, rows=rows, cell=outcome_cell):
+                cell.clear()
+                task = tx.get_task(TaskId(task_id_bytes))
+                if task is None:
+                    cell["stale"] = len(rows)
+                    return
+                accs: dict[bytes, Accumulator] = {}
+                lost = flushed_n = 0
+                for r in rows:
+                    _, agg_param, bid = r["key"]
+                    if tx.batch_has_collected_shard(task.task_id, bid, agg_param):
+                        lost += 1
+                        log.error(
+                            "resident share for task %s batch %r arrived AFTER "
+                            "collection; the share is lost (flush reason=%s)",
+                            task.task_id,
+                            bid[:16],
+                            reason,
+                        )
+                        continue
+                    acc = accs.get(agg_param)
+                    if acc is None:
+                        acc = accs[agg_param] = Accumulator(
+                            task,
+                            self.cfg.batch_aggregation_shard_count,
+                            aggregation_parameter=agg_param,
+                            count_metrics=False,
+                        )
+                    acc.update(
+                        bid,
+                        acc.field.encode_vec(r["share"]),
+                        0,
+                        ReportIdChecksum(),
+                        r["interval"],
+                        [],
+                    )
+                    flushed_n += 1
+                for acc in accs.values():
+                    acc.flush_to_datastore(tx)
+                cell["lost"] = lost
+                cell["flushed"] = flushed_n
+
+            try:
+                self.ds.run_tx(write, "flush_resident")
+            except Exception:
+                log.exception(
+                    "resident flush tx failed (%d buffer(s), reason=%s); the "
+                    "fetched shares are LOST",
+                    len(rows),
+                    reason,
+                )
+                metrics.engine_resident_flushes_total.add(
+                    len(rows), reason=reason, outcome="lost"
+                )
+                continue
+            for outcome in ("flushed", "lost", "stale"):
+                n = outcome_cell.get(outcome, 0)
+                if n:
+                    metrics.engine_resident_flushes_total.add(
+                        n, reason=reason, outcome=outcome
+                    )
+            flushed += outcome_cell.get("flushed", 0)
+        return flushed
 
     def _step_poplar1_init(self, acquired, task: Task, job, pending, reports) -> None:
         """Poplar1 leader init (see aggregator.poplar1_ops docstring):
@@ -1077,3 +1427,55 @@ class AggregationJobDriver:
         self.ds.run_tx(cancel, "abandon_agg_job")
         metrics.job_cancel_counter.add(kind="aggregation")
         log.warning("abandoned aggregation job %s after max attempts", acquired.job_id)
+
+
+class ResidentFlusher:
+    """Background resident-state flusher (driver binary, resident mode):
+    every flush_interval_s it pushes dirty resident buffers of every
+    live engine through the driver's write-tx flush path, so an IDLE
+    driver's last job doesn't sit unflushed until the next job arrives,
+    and a QUARANTINED engine's state flushes within one pass (the
+    interim host engine's jobs then see the complete batch rows — the
+    quarantine-mid-job contract). stop() + a final flush is the drain
+    hook (the binary calls driver.flush_resident_state("drain") after
+    the job loop exits)."""
+
+    def __init__(self, driver: AggregationJobDriver, interval_s: float):
+        self.driver = driver
+        self.interval_s = max(0.1, float(interval_s))
+        # quarantine sweep cadence: a quarantined engine's resident
+        # state must flush within ~a second, NOT within the interval
+        # cadence — the interim host engine's jobs read the batch rows
+        self.poll_s = min(1.0, self.interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="resident-flusher", daemon=True
+        )
+
+    def start(self) -> "ResidentFlusher":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        from .engine_cache import live_engines
+
+        elapsed = 0.0
+        while not self._stop.wait(self.poll_s):
+            elapsed += self.poll_s
+            try:
+                if elapsed >= self.interval_s:
+                    elapsed = 0.0
+                    self.driver.flush_resident_state(reason="interval")
+                else:
+                    for eng in live_engines():
+                        if not eng.resident_ready():
+                            self.driver.flush_engine_resident(
+                                eng, reason="quarantine"
+                            )
+            except Exception:
+                log.exception("resident flush pass failed; retrying next pass")
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout_s)

@@ -126,6 +126,35 @@ def _pad(arr, b: int):
     return np.pad(np.asarray(arr), widths)
 
 
+def _tree_nbytes(tree) -> int:
+    """Host bytes of an arg pytree (arrays / field-limb tuples / None /
+    scalars) — the h2d/d2h accounting unit of janus_engine_hd_bytes_total."""
+    if tree is None or isinstance(tree, (bytes, int, float, bool)):
+        return 0
+    if isinstance(tree, (tuple, list)):
+        return sum(_tree_nbytes(x) for x in tree)
+    nb = getattr(tree, "nbytes", None)
+    return int(nb) if nb is not None else 0
+
+
+def count_h2d(tree_or_bytes) -> None:
+    """Account host->device bytes (staged uploads, masks, bucket ids)."""
+    from .. import metrics
+
+    n = tree_or_bytes if isinstance(tree_or_bytes, int) else _tree_nbytes(tree_or_bytes)
+    if n:
+        metrics.engine_hd_bytes_total.add(n, direction="h2d")
+
+
+def count_d2h(tree_or_bytes) -> None:
+    """Account device->host bytes (fetches of masks, seeds, aggregates)."""
+    from .. import metrics
+
+    n = tree_or_bytes if isinstance(tree_or_bytes, int) else _tree_nbytes(tree_or_bytes)
+    if n:
+        metrics.engine_hd_bytes_total.add(n, direction="d2h")
+
+
 def put_args(args, block: bool = False, shardings=None):
     """Explicitly dispatch every staged host array to the device, all
     puts in flight at once (async), before invoking the jit — one slow
@@ -139,6 +168,7 @@ def put_args(args, block: bool = False, shardings=None):
     shardings: optional pytree (matching args) of NamedShardings so
     multi-device placement happens in the transfer itself instead of a
     resharding copy at dispatch."""
+    count_h2d(args)
     if shardings is not None:
         out = jax.device_put(args, shardings)
     else:
@@ -181,9 +211,11 @@ class DeviceRows:
         self.offset = offset
 
     def to_numpy(self):
-        return tuple(
+        rows = tuple(
             np.asarray(x)[self.offset : self.offset + self.n] for x in self.value
         )
+        count_d2h(rows)
+        return rows
 
 
 class DeviceRowsChunks:
@@ -204,6 +236,117 @@ class DeviceRowsChunks:
     def to_numpy(self):
         parts = [c.to_numpy() for c in self.chunks]
         return tuple(np.concatenate([p[i] for p in parts]) for i in range(len(parts[0])))
+
+
+class PrestagedInit:
+    """Async-uploaded leader-init columns (double-buffered staging,
+    ISSUE 12): pad_args + device_put issued while the device lane runs
+    the PREVIOUS job's dispatch, consumed by leader_init when the
+    direct path applies at the same bucket. Holds only the device
+    pytree — discard() drops the references so a fallback (coalesced
+    multi-job round, bucket cap moved under OOM recovery, host
+    fallback) frees the transfer's buffers immediately."""
+
+    __slots__ = ("b", "_staged", "meshed")
+
+    def __init__(self, b: int, staged, meshed: bool):
+        self.b = b
+        self._staged = staged
+        self.meshed = meshed
+
+    def usable(self, b: int, meshed: bool) -> bool:
+        return self._staged is not None and self.b == b and self.meshed == meshed
+
+    def take(self):
+        staged, self._staged = self._staged, None
+        return staged
+
+    def discard(self) -> None:
+        self._staged = None
+
+
+class ResidentMergeError(RuntimeError):
+    """resident_merge died partway through its entry loop. `merged`
+    holds the keys whose delta DID land in a resident slot before the
+    failure — those contributions are safe on device and flush with the
+    slot; the caller must directly flush only the REMAINING entries'
+    delta rows (re-flushing a merged one double-counts it)."""
+
+    def __init__(self, merged: frozenset, cause: BaseException):
+        super().__init__(
+            f"resident merge failed after {len(merged)} bucket(s): {cause!r}"
+        )
+        self.merged = merged
+
+
+class ResidentSlot:
+    """One per-(task, batch bucket) aggregate buffer living in device
+    memory across job steps: `value` is a [output_len] field limb tuple
+    of device arrays. Host-side metadata rides along so a flush can
+    write through the existing batch-aggregation path (the interval is
+    the union of every merged contribution's; counts/checksums are
+    already durable — the per-job write tx records them at commit time,
+    only the share bytes live here)."""
+
+    __slots__ = ("key", "value", "interval", "rows", "nbytes", "last_used")
+
+    def __init__(self, key: tuple, value, interval, rows: int, nbytes: int):
+        self.key = key  # (task_id bytes, agg_param bytes, batch_identifier bytes)
+        self.value = value
+        self.interval = interval
+        self.rows = rows
+        self.nbytes = nbytes
+        self.last_used = time.monotonic()
+
+
+class PendingDeltas:
+    """Per-bucket masked sums of ONE job step, still on device
+    ([k, output_len] field limb tuple): computed by aggregate_pending
+    on the device lane, merged into resident slots only AFTER the job's
+    write transaction committed (resident_merge). A failed commit just
+    drops the object — no rollback, no double-merge on the re-step."""
+
+    __slots__ = ("value", "k", "row_nbytes")
+
+    def __init__(self, value, k: int, row_nbytes: int):
+        self.value = value
+        self.k = k
+        self.row_nbytes = row_nbytes
+
+    def row(self, j: int):
+        """Row j as a device field value (lazy jnp slice — no fetch)."""
+        return tuple(x[j] for x in self.value)
+
+
+# process-wide resident accounting (the HBM the resident layer holds
+# across every engine; the eviction cap reads the byte total). The
+# per-kind buffer counts live here too: several engines share a vdaf
+# kind (one per task verify key), so a per-engine gauge set would have
+# them overwrite each other's value instead of summing.
+_resident_bytes_lock = threading.Lock()
+_resident_bytes_total = 0
+_resident_buffer_counts: dict[str, int] = {}
+
+
+def _resident_bytes_add(delta: int, kind: str, nbuf: int) -> int:
+    """Account one slot insert/remove: `delta` device bytes and `nbuf`
+    (+1/-1) buffers of vdaf `kind`. Publishes both gauges."""
+    global _resident_bytes_total
+    from .. import metrics
+
+    with _resident_bytes_lock:
+        _resident_bytes_total += delta
+        total = _resident_bytes_total
+        n = _resident_buffer_counts.get(kind, 0) + nbuf
+        _resident_buffer_counts[kind] = n
+    metrics.engine_resident_bytes.set(float(total))
+    metrics.engine_resident_buffers.set(float(n), vdaf=kind)
+    return total
+
+
+def resident_bytes_total() -> int:
+    with _resident_bytes_lock:
+        return _resident_bytes_total
 
 
 class _Coalescer:
@@ -354,6 +497,145 @@ def _split_rows(value, offsets):
     return [value[s:e] for s, e in zip(offsets, offsets[1:])]
 
 
+# ---------------------------------------------------------------------------
+# Cross-TASK dispatch coalescing (ISSUE 12). The PR 7 coalescer merged
+# concurrent small jobs of ONE engine (one task's vdaf+verify_key) into
+# shared dispatches; here engines of the SAME VdafInstance — identical
+# circuit geometry, identical compiled steps, differing only in the
+# 16-byte verify key — share one round-based coalescer per (inst,
+# side), and a mixed round dispatches ONE device call whose verify key
+# is a per-LANE input (the XOF already consumes per-lane seed segments,
+# so the kernel change is just the key's segment becoming an array).
+# The cross-job mask-leak invariant is unchanged by construction: each
+# job still holds an [offset, offset+n) view of the shared buffer and
+# aggregates under its own mask (re-pinned cross-task in
+# tests/test_engine_coalesce.py).
+# ---------------------------------------------------------------------------
+
+# default ON: single-engine rounds take byte-identical code paths (the
+# scalar-key jit), so behavior only changes when two tasks' small jobs
+# genuinely overlap — exactly the fleet shape ROADMAP item 2 adds.
+XTASK_COALESCE = os.environ.get("JANUS_XTASK_COALESCE", "1") != "0"
+
+_xtask_lock = threading.Lock()
+_xtask_coalescers: dict[tuple, "_Coalescer"] = {}
+
+
+def _shared_coalescer(inst, side: str, max_rows: int) -> "_Coalescer":
+    key = (inst, side)
+    with _xtask_lock:
+        co = _xtask_coalescers.get(key)
+        if co is None:
+            run = _run_leader_round if side == "leader" else _run_helper_round
+            co = _Coalescer(run, max_rows)
+            _xtask_coalescers[key] = co
+        return co
+
+
+def _clear_shared_coalescers() -> None:
+    with _xtask_lock:
+        _xtask_coalescers.clear()
+
+
+def _verify_key_lanes(engines, ns) -> np.ndarray:
+    """[sum(ns), 2] u64 lane array carrying each entry's task verify
+    key across its rows (the per-lane key input of a cross-task round)."""
+    rows = [
+        np.broadcast_to(
+            np.frombuffer(e.verify_key, dtype="<u8").astype(np.uint64), (n, 2)
+        )
+        for e, n in zip(engines, ns)
+    ]
+    return np.ascontiguousarray(np.concatenate(rows, axis=0))
+
+
+def _round_prestage_fallback(prestaged_list) -> None:
+    from .. import metrics
+
+    for p in prestaged_list:
+        if p is not None:
+            p.discard()  # a merged round re-stages from host columns
+            metrics.engine_prestage_total.add(outcome="fallback")
+
+
+def _run_leader_round(args_list, ns):
+    """Coalescer round callback (leader init). Entries carry their
+    submitting engine: a single-engine round is exactly the PR 7 path
+    (scalar verify key, same jit); a mixed round merges across tasks
+    with per-lane verify keys, executed by the first entry's engine
+    (same VdafInstance => same Prio3Batched object => same geometry)."""
+    engines = [a[0] for a in args_list]
+    if len(args_list) == 1:
+        eng, prestaged, *rest = args_list[0]
+        return [eng._leader_init_inner(*rest, prestaged=prestaged)]
+    from .. import metrics
+
+    exec_eng = engines[0]
+    cross = any(e is not exec_eng for e in engines)
+    offsets = list(np.cumsum([0] + ns))
+    metrics.engine_coalesced_rounds_total.add()
+    metrics.engine_coalesced_rows_total.add(int(sum(ns)))
+    _round_prestage_fallback([a[1] for a in args_list])
+    merged = _concat_args([a[2:] for a in args_list])
+    vk = _verify_key_lanes(engines, ns) if cross else None
+    # one padded dispatch for the whole round (no intra-call
+    # pipelining: round-to-round overlap already covers H2D)
+    out0, seed0, ver0, part0 = exec_eng._leader_init_inner(
+        *merged, coalesced=len(ns), allow_pipeline=False, vk_lanes=vk
+    )
+    if isinstance(out0, DeviceRowsChunks):
+        # cap halved mid-round (concurrent OOM recovery): split on
+        # host rows instead of device-buffer views
+        rows = out0.to_numpy()
+        outs = [
+            tuple(x[s:e] for x in rows) for s, e in zip(offsets, offsets[1:])
+        ]
+    else:
+        outs = [
+            DeviceRows(out0.value, e - s, offset=s)
+            for s, e in zip(offsets, offsets[1:])
+        ]
+    seeds = _split_rows(seed0, offsets)
+    vers = _split_rows(ver0, offsets)
+    parts = _split_rows(part0, offsets)
+    return list(zip(outs, seeds, vers, parts))
+
+
+def _run_helper_round(args_list, ns):
+    """Coalescer round callback (helper init); see _run_leader_round."""
+    engines = [a[0] for a in args_list]
+    offsets = list(np.cumsum([0] + ns))
+    if len(args_list) == 1:
+        eng, *rest = args_list[0]
+        out1, mask, prep_msg = eng._helper_init_inner(*rest)
+        return [(out1, mask, prep_msg)]
+    from .. import metrics
+
+    exec_eng = engines[0]
+    cross = any(e is not exec_eng for e in engines)
+    metrics.engine_coalesced_rounds_total.add()
+    metrics.engine_coalesced_rows_total.add(int(sum(ns)))
+    merged = _concat_args([a[1:] for a in args_list])
+    vk = _verify_key_lanes(engines, ns) if cross else None
+    out1, mask, prep_msg = exec_eng._helper_init_inner(
+        *merged, coalesced=len(ns), vk_lanes=vk
+    )
+    if isinstance(out1, DeviceRowsChunks):
+        # the bucket cap halved between round admission and dispatch
+        # (concurrent OOM recovery) and the merged round chunked:
+        # split on host rows — plain limb tuples are valid out-share
+        # currency (HostEngineCache returns them)
+        rows = out1.to_numpy()
+        return [
+            (tuple(x[s:e] for x in rows), mask[s:e], prep_msg[s:e])
+            for s, e in zip(offsets, offsets[1:])
+        ]
+    return [
+        (DeviceRows(out1.value, e - s, offset=s), mask[s:e], prep_msg[s:e])
+        for s, e in zip(offsets, offsets[1:])
+    ]
+
+
 def _engine_dispatch_failpoint() -> None:
     """`engine.dispatch` failpoint INSIDE every watchdog-supervised
     device region: the oom action raises a RESOURCE_EXHAUSTED-shaped
@@ -474,8 +756,31 @@ class EngineCache:
         if self.bucket_cap is not None:
             round_rows = min(round_rows, self.bucket_cap)
         self._initial_round_rows = round_rows
-        self._co_leader = _Coalescer(self._run_leader_round, round_rows)
-        self._co_helper = _Coalescer(self._run_helper_round, round_rows)
+        # round-based coalescers. With cross-task coalescing (the
+        # default) engines of the same VdafInstance SHARE one coalescer
+        # per side, so small jobs of different tasks ride one dispatch
+        # (per-lane verify keys); disabled, each engine keeps its own
+        # (the PR 7 shape). Entries always carry their engine.
+        if XTASK_COALESCE:
+            self._co_leader = _shared_coalescer(inst, "leader", round_rows)
+            self._co_helper = _shared_coalescer(inst, "helper", round_rows)
+        else:
+            self._co_leader = _Coalescer(_run_leader_round, round_rows)
+            self._co_helper = _Coalescer(_run_helper_round, round_rows)
+        # device-resident aggregate state (ISSUE 12): per-(task, batch
+        # bucket) accumulator buffers living in device memory across job
+        # steps. The ENGINE owns the buffers and the device ops
+        # (delta/merge/fetch); the DRIVER owns the flush policy (the
+        # write-tx path) — see aggregation_job_driver.ResidentConfig.
+        self._resident: "OrderedDict[tuple, ResidentSlot]" = OrderedDict()
+        self._resident_lock = threading.Lock()
+        self._resident_stats = {
+            "merged_rows": 0,
+            "merges": 0,
+            "evictions": 0,
+            "eviction_deferred": 0,
+            "takes": 0,
+        }
         # device-circuit quarantine (ISSUE 8; docs/ROBUSTNESS.md "Device
         # hangs & deadlines"): a watchdog-abandoned dispatch opens the
         # circuit — serving moves to the host engine immediately (the
@@ -918,7 +1223,7 @@ class EngineCache:
         cap = self.bucket_cap
         if self._coalesce and n <= self.COALESCE_MAX_JOB and (cap is None or n <= cap):
             return self._co_helper.submit(
-                (nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask),
+                (self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask),
                 n,
             )
         if cap is not None and n > cap:
@@ -930,7 +1235,8 @@ class EngineCache:
         )
 
     def _helper_init_chunked(
-        self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask, cap: int
+        self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask, cap: int,
+        vk_lanes=None,
     ):
         """Serial cap-sized dispatches for a batch past the HBM bound —
         each chunk's working set fits the budget; out shares stay
@@ -947,41 +1253,16 @@ class EngineCache:
                 _cut_rows(ver0, s, e),
                 _cut_rows(part0, s, e),
                 _cut_rows(ok_mask, s, e),
+                vk_lanes=_cut_rows(vk_lanes, s, e),
             )
             outs.append(out1)
             masks.append(mask)
             preps.append(prep)
         return DeviceRowsChunks(outs), np.concatenate(masks), np.concatenate(preps)
 
-    def _run_helper_round(self, args_list, ns):
-        offsets = list(np.cumsum([0] + ns))
-        if len(args_list) == 1:
-            out1, mask, prep_msg = self._helper_init_inner(*args_list[0])
-            return [(out1, mask, prep_msg)]
-        from .. import metrics
-
-        metrics.engine_coalesced_rounds_total.add()
-        metrics.engine_coalesced_rows_total.add(int(sum(ns)))
-        merged = _concat_args(args_list)
-        out1, mask, prep_msg = self._helper_init_inner(*merged, coalesced=len(ns))
-        if isinstance(out1, DeviceRowsChunks):
-            # the bucket cap halved between round admission and dispatch
-            # (concurrent OOM recovery) and the merged round chunked:
-            # split on host rows — plain limb tuples are valid out-share
-            # currency (HostEngineCache returns them)
-            rows = out1.to_numpy()
-            return [
-                (tuple(x[s:e] for x in rows), mask[s:e], prep_msg[s:e])
-                for s, e in zip(offsets, offsets[1:])
-            ]
-        return [
-            (DeviceRows(out1.value, e - s, offset=s), mask[s:e], prep_msg[s:e])
-            for s, e in zip(offsets, offsets[1:])
-        ]
-
     def _helper_init_inner(
         self, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask,
-        coalesced: int = 0,
+        coalesced: int = 0, vk_lanes=None,
     ):
         p3 = self.p3
         n = nonce_lanes.shape[0]
@@ -990,13 +1271,14 @@ class EngineCache:
         # smaller cap with n > cap must chunk, never pad negative
         if cap is not None and n > cap:
             return self._helper_init_chunked(
-                nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask, cap
+                nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask, cap,
+                vk_lanes=vk_lanes,
             )
         b = bucket_size(n, cap)
 
-        def step(nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
+        def step_body(vkey, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask):
             out1, seed1, ver1, part1 = p3.prepare_init_helper(
-                self.verify_key, nonce_lanes, public_parts, helper_seeds, blinds
+                vkey, nonce_lanes, public_parts, helper_seeds, blinds
             )
             mask, prep_msg = p3.prep_shares_to_prep(ver0, ver1, part0, part1)
             mask = p3.prepare_finish(seed1, prep_msg, mask)
@@ -1008,19 +1290,37 @@ class EngineCache:
         from ..trace import span
 
         L = len(ver0)
+        arg_nds = (
+            2,
+            None if public_parts is None else 3,
+            2,
+            None if blinds is None else 2,
+            (2,) * L,
+            2,
+            1,
+        )
+        if vk_lanes is None:
+            # single-task round: the verify key stays a trace constant —
+            # byte-identical compiled steps to the pre-cross-task engine
+            def step(*a):
+                return step_body(self.verify_key, *a)
+
+            name = "helper_init"
+        else:
+            # cross-task round: the key is a per-lane input
+            def step(vk, *a):
+                return step_body(vk, *a)
+
+            name = "helper_init_vk"
+            arg_nds = (2,) + arg_nds
         shardings = None
         if self.mesh is not None:
-            shardings = self._shard(
-                2,
-                None if public_parts is None else 3,
-                2,
-                None if blinds is None else 2,
-                (2,) * L,
-                2,
-                1,
-            )
-        fn = self._jit("helper_init", step, in_shardings=shardings)
-        args = pad_args(b, nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask)
+            shardings = self._shard(*arg_nds)
+        fn = self._jit(name, step, in_shardings=shardings)
+        raw_args = (nonce_lanes, public_parts, helper_seeds, blinds, ver0, part0, ok_mask)
+        if vk_lanes is not None:
+            raw_args = (vk_lanes,) + raw_args
+        args = pad_args(b, *raw_args)
 
         # the np.asarray conversions block on device execution — they
         # must sit inside the span or it measures only async dispatch.
@@ -1048,6 +1348,7 @@ class EngineCache:
                 with span("engine.helper_init.fetch", vdaf=self.inst.kind):
                     mask = np.asarray(mask)[:n]
                     prep_msg = np.asarray(prep_msg)[:n]
+                    count_d2h((mask, prep_msg))
             return out1, mask, prep_msg
 
         try:
@@ -1065,58 +1366,37 @@ class EngineCache:
     PIPELINE_CHUNK = 256
 
     # --- leader side: init only (network round trip follows) ---
-    def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0, ok=None):
+    def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0, ok=None, prestaged=None):
         # ok is accepted for interface parity with HostEngineCache; the
         # batched device step costs nothing extra for failed lanes
         # (their rows are zeroed and masked downstream).
         while True:
             host = self._host()
             if host is not None:
+                if prestaged is not None:
+                    prestaged.discard()
+                    prestaged = None
                 return host.leader_init(nonce_lanes, public_parts, meas, proof, blind0, ok)
             try:
-                return self._leader_init_entry(nonce_lanes, public_parts, meas, proof, blind0)
+                return self._leader_init_entry(
+                    nonce_lanes, public_parts, meas, proof, blind0, prestaged
+                )
             except Exception as e:  # noqa: BLE001 - OOM filter inside
+                if prestaged is not None:
+                    prestaged.discard()
+                    prestaged = None  # the retry re-stages from host
                 self._handle_engine_error(e, nonce_lanes.shape[0])
 
-    def _leader_init_entry(self, nonce_lanes, public_parts, meas, proof, blind0):
+    def _leader_init_entry(self, nonce_lanes, public_parts, meas, proof, blind0, prestaged=None):
         n = nonce_lanes.shape[0]
         cap = self.bucket_cap
         if self._coalesce and n <= self.COALESCE_MAX_JOB and (cap is None or n <= cap):
             return self._co_leader.submit(
-                (nonce_lanes, public_parts, meas, proof, blind0), n
+                (self, prestaged, nonce_lanes, public_parts, meas, proof, blind0), n
             )
-        return self._leader_init_inner(nonce_lanes, public_parts, meas, proof, blind0)
-
-    def _run_leader_round(self, args_list, ns):
-        offsets = list(np.cumsum([0] + ns))
-        if len(args_list) == 1:
-            return [self._leader_init_inner(*args_list[0])]
-        from .. import metrics
-
-        metrics.engine_coalesced_rounds_total.add()
-        metrics.engine_coalesced_rows_total.add(int(sum(ns)))
-        merged = _concat_args(args_list)
-        # one padded dispatch for the whole round (no intra-call
-        # pipelining: round-to-round overlap already covers H2D)
-        out0, seed0, ver0, part0 = self._leader_init_inner(
-            *merged, coalesced=len(ns), allow_pipeline=False
+        return self._leader_init_inner(
+            nonce_lanes, public_parts, meas, proof, blind0, prestaged=prestaged
         )
-        if isinstance(out0, DeviceRowsChunks):
-            # cap halved mid-round (concurrent OOM recovery): split on
-            # host rows instead of device-buffer views
-            rows = out0.to_numpy()
-            outs = [
-                tuple(x[s:e] for x in rows) for s, e in zip(offsets, offsets[1:])
-            ]
-        else:
-            outs = [
-                DeviceRows(out0.value, e - s, offset=s)
-                for s, e in zip(offsets, offsets[1:])
-            ]
-        seeds = _split_rows(seed0, offsets)
-        vers = _split_rows(ver0, offsets)
-        parts = _split_rows(part0, offsets)
-        return list(zip(outs, seeds, vers, parts))
 
     def _leader_init_inner(
         self,
@@ -1127,6 +1407,8 @@ class EngineCache:
         blind0,
         coalesced: int = 0,
         allow_pipeline: bool = True,
+        vk_lanes=None,
+        prestaged=None,
     ):
         p3 = self.p3
         n = nonce_lanes.shape[0]
@@ -1135,35 +1417,74 @@ class EngineCache:
             # past the HBM bound: serial cap-sized dispatches (staging
             # everything up front, as the pipelined path does, would
             # resident-stage exactly the bytes the cap exists to avoid)
+            if prestaged is not None:
+                prestaged.discard()
             return self._leader_init_chunked(
-                nonce_lanes, public_parts, meas, proof, blind0, cap
+                nonce_lanes, public_parts, meas, proof, blind0, cap, vk_lanes=vk_lanes
             )
-        if allow_pipeline and self.mesh is None and n >= 2 * self.PIPELINE_CHUNK:
+        if (
+            allow_pipeline
+            and vk_lanes is None
+            and self.mesh is None
+            and n >= 2 * self.PIPELINE_CHUNK
+        ):
+            if prestaged is not None:
+                prestaged.discard()
             return self._leader_init_pipelined(
                 nonce_lanes, public_parts, meas, proof, blind0
             )
         b = bucket_size(n, cap)
 
-        def step(nonce_lanes, public_parts, meas, proof, blind0):
-            return p3.prepare_init_leader(
-                self.verify_key, nonce_lanes, public_parts, meas, proof, blind0
-            )
-
         from ..trace import span
 
         L = len(meas)
+        meas_nd = "vec2" if self.sp > 1 else 2
+        arg_nds = (
+            2,
+            None if public_parts is None else 3,
+            (meas_nd,) * L,
+            (2,) * L,
+            None if blind0 is None else 2,
+        )
+        if vk_lanes is None:
+
+            def step(*a):
+                return p3.prepare_init_leader(self.verify_key, *a)
+
+            name = "leader_init"
+        else:
+            # cross-task round: per-lane verify keys ride the dispatch
+            def step(vk, *a):
+                return p3.prepare_init_leader(vk, *a)
+
+            name = "leader_init_vk"
+            arg_nds = (2,) + arg_nds
         shardings = None
         if self.mesh is not None:
-            meas_nd = "vec2" if self.sp > 1 else 2
-            shardings = self._shard(
-                2,
-                None if public_parts is None else 3,
-                (meas_nd,) * L,
-                (2,) * L,
-                None if blind0 is None else 2,
+            shardings = self._shard(*arg_nds)
+        fn = self._jit(name, step, in_shardings=shardings)
+        # double-buffered staging (ISSUE 12): a usable prestaged column
+        # set (same bucket, issued while the PREVIOUS job occupied the
+        # device lane) skips the host put entirely — its transfers are
+        # already in flight or landed
+        use_prestaged = (
+            prestaged is not None
+            and vk_lanes is None
+            and prestaged.usable(b, self.mesh is not None)
+        )
+        if prestaged is not None:
+            from .. import metrics
+
+            metrics.engine_prestage_total.add(
+                outcome="hit" if use_prestaged else "fallback"
             )
-        fn = self._jit("leader_init", step, in_shardings=shardings)
-        args = pad_args(b, nonce_lanes, public_parts, meas, proof, blind0)
+            if not use_prestaged:
+                prestaged.discard()
+        if not use_prestaged:
+            raw_args = (nonce_lanes, public_parts, meas, proof, blind0)
+            if vk_lanes is not None:
+                raw_args = (vk_lanes,) + raw_args
+            args = pad_args(b, *raw_args)
 
         # conversions block on device execution — keep inside the span.
         # out0 stays ON DEVICE (DeviceRows) for the later aggregate;
@@ -1177,9 +1498,14 @@ class EngineCache:
                 batch=n,
                 bucket=b,
                 coalesced=coalesced,
+                prestaged=bool(use_prestaged),
             ):
                 with span("engine.leader_init.put", vdaf=self.inst.kind):
-                    staged = put_args(args, block=True, shardings=shardings)
+                    if use_prestaged:
+                        staged = prestaged.take()  # transfers already in flight
+                        jax.block_until_ready(staged)
+                    else:
+                        staged = put_args(args, block=True, shardings=shardings)
                 t_disp = time.monotonic()
                 with span("engine.leader_init.dispatch", vdaf=self.inst.kind):
                     out0, seed0, ver0, part0 = fn(*staged)
@@ -1190,6 +1516,7 @@ class EngineCache:
                     ver0 = tuple(np.asarray(x)[:n] for x in ver0)
                 with span("engine.leader_init.fetch_part", vdaf=self.inst.kind):
                     part0 = np.asarray(part0)[:n] if part0 is not None else None
+                count_d2h((seed0, ver0, part0))
             return out0, seed0, ver0, part0
 
         try:
@@ -1199,7 +1526,41 @@ class EngineCache:
             raise
         return DeviceRows(out0, n), seed0, ver0, part0
 
-    def _leader_init_chunked(self, nonce_lanes, public_parts, meas, proof, blind0, cap: int):
+    def prestage_leader(self, nonce_lanes, public_parts, meas, proof, blind0):
+        """Double-buffered host->device staging: issue the padded column
+        uploads ASYNC now (typically from the pipeline's read stage,
+        while the device lane runs the previous job's dispatch) and hand
+        back a PrestagedInit for leader_init to consume. Returns None
+        when the direct-dispatch path can't use it (host fallback /
+        quarantine, chunked past the HBM cap, or the big-batch pipelined
+        path which stages its own chunks)."""
+        if self._host() is not None:
+            return None
+        n = nonce_lanes.shape[0]
+        cap = self.bucket_cap
+        if cap is not None and n > cap:
+            return None
+        if self.mesh is None and n >= 2 * self.PIPELINE_CHUNK:
+            return None
+        b = bucket_size(n, cap)
+        L = len(meas)
+        shardings = None
+        if self.mesh is not None:
+            meas_nd = "vec2" if self.sp > 1 else 2
+            shardings = self._shard(
+                2,
+                None if public_parts is None else 3,
+                (meas_nd,) * L,
+                (2,) * L,
+                None if blind0 is None else 2,
+            )
+        args = pad_args(b, nonce_lanes, public_parts, meas, proof, blind0)
+        staged = put_args(args, block=False, shardings=shardings)
+        return PrestagedInit(b, staged, self.mesh is not None)
+
+    def _leader_init_chunked(
+        self, nonce_lanes, public_parts, meas, proof, blind0, cap: int, vk_lanes=None
+    ):
         """Serial cap-sized leader inits for a batch past the HBM bound.
         Unlike _leader_init_pipelined, chunk k+1's transfer is NOT
         staged while chunk k computes — bounding resident bytes is the
@@ -1215,6 +1576,7 @@ class EngineCache:
                 _cut_rows(proof, s, e),
                 _cut_rows(blind0, s, e),
                 allow_pipeline=False,
+                vk_lanes=_cut_rows(vk_lanes, s, e),
             )
             outs.append(out0)
             seeds.append(seed0)
@@ -1396,11 +1758,13 @@ class EngineCache:
                 fnv = self._jit(f"aggregate_view_{vb}", step_view)
                 mask_vb = np.zeros(vb, dtype=bool)
                 mask_vb[:n] = np.asarray(mask, dtype=bool)
+                count_h2d(int(mask_vb.nbytes))
                 dispatch_b, dispatch_fixed = vb, True
                 dispatch = lambda: fnv(value, np.int32(s), mask_vb)  # noqa: E731
             else:
                 full = np.zeros(b, dtype=bool)
                 full[s : s + n] = np.asarray(mask, dtype=bool)
+                count_h2d(int(full.nbytes))
                 dispatch_b, dispatch_fixed = b, True
                 dispatch = lambda: fn(value, full)  # noqa: E731
         else:
@@ -1422,7 +1786,9 @@ class EngineCache:
                 return total
             b = bucket_size(n, cap)
             dispatch_b, dispatch_fixed = b, False
-            dispatch = lambda: fn(*pad_args(b, out_shares, mask))  # noqa: E731
+            host_args = pad_args(b, out_shares, mask)
+            count_h2d(host_args)
+            dispatch = lambda: fn(*host_args)  # noqa: E731
         from ..trace import span
 
         # PJRT raises allocation failures synchronously from the
@@ -1442,6 +1808,7 @@ class EngineCache:
             ):
                 agg = dispatch()
                 result = [int(x) for x in p3.jf.to_ints(agg)]
+                count_d2h(len(result) * p3.jf.LIMBS * 8)
             self._record_dispatch("aggregate", n, dispatch_b, time.monotonic() - t_disp)
             return result
 
@@ -1450,6 +1817,300 @@ class EngineCache:
         except Exception as e:
             _annotate_dispatch_bucket(e, dispatch_b, fixed=dispatch_fixed)
             raise
+
+    # --- device-resident aggregate state (ISSUE 12; docs/ARCHITECTURE.md
+    # "Resident aggregate state"). The engine owns the per-(task, batch
+    # bucket) buffers and the device ops; the DRIVER owns flush policy
+    # (interval / eviction / quarantine / drain all go through its
+    # write-tx path — aggregation_job_driver.flush_resident_state). ---
+
+    # process-wide device-byte bound on resident buffers; overflow
+    # evicts this engine's LRU slots through the flush path. Env is the
+    # operator override; janus_main applies the YAML `engine:` value.
+    RESIDENT_MAX_BYTES = int(os.environ.get("JANUS_RESIDENT_MAX_BYTES", str(256 << 20)))
+
+    def resident_ready(self) -> bool:
+        """True while the device path serves. Resident accumulation is
+        a device feature: under host fallback / quarantine the driver
+        uses the classic per-job flush, so interim work is durable
+        immediately (the quarantine-mid-job contract)."""
+        return self._host() is None
+
+    def aggregate_pending(self, out_shares, bucket_idx, k: int) -> PendingDeltas:
+        """Per-bucket masked sums of one job's out shares as a DEVICE
+        [k, output_len] value — ONE dispatch, one [n] int32 upload,
+        nothing fetched (the classic path uploads a full n-bool mask
+        and fetches the aggregate per bucket). k pads to the next power
+        of two so the traced program specializes O(log k) times.
+        Errors propagate: the driver falls back to the classic
+        accumulate for OOM-class failures and steps back on hangs."""
+        p3 = self.p3
+        kk = 1 << max(0, int(k - 1).bit_length())
+        row_nbytes = p3.circ.output_len * p3.jf.LIMBS * 8
+
+        n_rows = len(bucket_idx)
+
+        def device_call():
+            _engine_dispatch_failpoint()
+            t_disp = time.monotonic()
+            value = self._pending_dispatch(out_shares, np.asarray(bucket_idx, np.int32), kk)
+            self._record_dispatch(
+                "aggregate", n_rows, bucket_size(n_rows), time.monotonic() - t_disp
+            )
+            return value
+
+        try:
+            value = self._supervised("aggregate_pending", device_call)
+        except Exception as e:
+            _annotate_dispatch_bucket(e, kk, fixed=True)
+            raise
+        return PendingDeltas(value, k, row_nbytes)
+
+    def _pending_dispatch(self, out_shares, bucket_idx, kk: int):
+        p3 = self.p3
+        if isinstance(out_shares, DeviceRowsChunks):
+            total = None
+            off = 0
+            for chunk in out_shares.chunks:
+                part = self._pending_dispatch(
+                    chunk, bucket_idx[off : off + chunk.n], kk
+                )
+                off += chunk.n
+                total = part if total is None else p3.jf.add(total, part)
+            return total
+        if isinstance(out_shares, DeviceRows):
+            n = out_shares.n
+            value = out_shares.value
+            b = value[0].shape[0]
+            vb = bucket_size(n)
+            s = out_shares.offset
+            if (s or vb < b) and s + vb <= b:
+                # coalesced view: dynamic-slice the job's own bucket
+                # (same window discipline as the aggregate view path)
+                idx = np.full(vb, -1, np.int32)
+                idx[:n] = bucket_idx
+
+                def step_view(value, start, idx, _vb=vb, _kk=kk):
+                    v = tuple(
+                        jax.lax.dynamic_slice_in_dim(x, start, _vb, axis=0)
+                        for x in value
+                    )
+                    return p3.aggregate_buckets(v, idx, _kk)
+
+                fn = self._jit(f"agg_buckets_view_{kk}_{vb}", step_view)
+                count_h2d(int(idx.nbytes))
+                return fn(value, np.int32(s), idx)
+            idx = np.full(b, -1, np.int32)
+            idx[s : s + n] = bucket_idx
+
+            def step_full(value, idx, _kk=kk):
+                return p3.aggregate_buckets(value, idx, _kk)
+
+            fn = self._jit(f"agg_buckets_{kk}", step_full)
+            count_h2d(int(idx.nbytes))
+            return fn(value, idx)
+        # host limb rows (a round that degraded to host currency):
+        # stage them — rare, and still one dispatch for all buckets
+        n = bucket_idx.shape[0]
+        bb = bucket_size(n)
+        idx = np.full(bb, -1, np.int32)
+        idx[:n] = bucket_idx
+        (padded,) = pad_args(bb, out_shares)
+        count_h2d((padded, idx))
+
+        def step_host(value, idx, _kk=kk):
+            return p3.aggregate_buckets(value, idx, _kk)
+
+        fn = self._jit(f"agg_buckets_{kk}", step_host)
+        return fn(padded, idx)
+
+    def _resident_add(self, acc, row):
+        """acc + row on device. Single-device: the accumulator buffer
+        is DONATED so the merge is in place (no HBM growth per merge);
+        CPU ignores donation, mesh dispatches go through the serialized
+        _jit wrapper instead."""
+        if self.mesh is not None:
+            fn = self._jit("resident_add", lambda a, r: self.p3.jf.add(a, r))
+            return fn(acc, row)
+        name = "resident_add"
+        if name not in self._jits:
+            p3 = self.p3
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._jits[name] = jax.jit(
+                lambda a, r: p3.jf.add(a, r), donate_argnums=donate
+            )
+        return self._jits[name](acc, row)
+
+    def resident_merge(self, entries, deltas: PendingDeltas) -> list[dict]:
+        """Merge one job's committed deltas into the resident slots.
+
+        entries: [(key, delta_row, report_count, interval)] — call only
+        AFTER the job's write transaction committed (the post-commit
+        discipline that makes a failed/retried step unable to
+        double-merge: an uncommitted PendingDeltas is simply dropped).
+        Returns flush records for slots LRU-evicted past
+        RESIDENT_MAX_BYTES — already fetched and removed from device
+        state; the caller MUST persist them through the write-tx path.
+        """
+        from ..messages import Interval
+
+        evicted: list[ResidentSlot] = []
+        merged: set = set()
+        with self._resident_lock:
+            try:
+                for key, j, rows, interval in entries:
+                    slot = self._resident.get(key)
+                    if slot is None:
+                        slot = ResidentSlot(
+                            key, deltas.row(j), interval, rows, deltas.row_nbytes
+                        )
+                        self._resident[key] = slot
+                        _resident_bytes_add(slot.nbytes, self.inst.kind, +1)
+                    else:
+                        slot.value = self._resident_add(slot.value, deltas.row(j))
+                        slot.interval = Interval.merged(slot.interval, interval)
+                        slot.rows += rows
+                        self._resident.move_to_end(key)
+                    slot.last_used = time.monotonic()
+                    self._resident_stats["merged_rows"] += rows
+                    merged.add(key)
+            except BaseException as e:
+                # a mid-loop failure leaves a merged PREFIX on device —
+                # report exactly which keys landed so the caller flushes
+                # only the remainder (re-flushing a merged entry's delta
+                # would double-count it when the slot later flushes)
+                raise ResidentMergeError(frozenset(merged), e) from e
+            self._resident_stats["merges"] += 1
+            while resident_bytes_total() > self.RESIDENT_MAX_BYTES and self._resident:
+                _, slot = self._resident.popitem(last=False)
+                _resident_bytes_add(-slot.nbytes, self.inst.kind, -1)
+                evicted.append(slot)
+                self._resident_stats["evictions"] += 1
+            if not evicted:
+                return []
+            try:
+                return self._fetch_slots_locked(evicted)
+            except BaseException:
+                for slot in evicted:  # restore: eviction must not LOSE state
+                    self._resident[slot.key] = slot
+                    _resident_bytes_add(slot.nbytes, self.inst.kind, +1)
+                # the DELTAS all merged — raising here would make the
+                # caller's merge-failed recovery re-flush them (double
+                # count). The eviction is merely DEFERRED: bytes stay
+                # over the cap, the next merge/flusher pass retries.
+                self._resident_stats["eviction_deferred"] += 1
+                log.warning(
+                    "resident eviction fetch failed for %s; eviction deferred "
+                    "(state restored, retried next pass)",
+                    self.inst.kind,
+                    exc_info=True,
+                )
+                return []
+
+    def resident_take(self, keys=None) -> list[dict]:
+        """Pop (all, or `keys`) resident slots and fetch their encoded
+        shares for a flush. On a fetch failure every popped slot is
+        RESTORED and the error propagates — resident state is never
+        dropped because the device was slow once; the flusher retries
+        after the canary restores the path."""
+        with self._resident_lock:
+            take = (
+                list(self._resident.keys())
+                if keys is None
+                else [k for k in keys if k in self._resident]
+            )
+            slots = [self._resident.pop(k) for k in take]
+            for slot in slots:
+                _resident_bytes_add(-slot.nbytes, self.inst.kind, -1)
+            if not slots:
+                return []
+            try:
+                recs = self._fetch_slots_locked(slots)
+            except BaseException:
+                for slot in slots:
+                    self._resident[slot.key] = slot
+                    _resident_bytes_add(slot.nbytes, self.inst.kind, +1)
+                raise
+            self._resident_stats["takes"] += len(slots)
+            return recs
+
+    def fetch_delta_records(self, entries, deltas: PendingDeltas) -> list[dict]:
+        """Supervised d2h fetch of a job's raw delta rows — the driver's
+        merge-failed recovery path. Bounded like every other resident
+        fetch: a raw to_ints() here would park the commit worker in
+        native code forever on exactly the wedged device that likely
+        just failed the merge."""
+        p3 = self.p3
+
+        def fetch():
+            out = []
+            for key, j, rows, interval in entries:
+                out.append(
+                    {
+                        "key": key,
+                        "share": [int(x) for x in p3.jf.to_ints(deltas.row(j))],
+                        "rows": rows,
+                        "interval": interval,
+                    }
+                )
+            return out
+
+        recs = self._supervised("resident_delta_fetch", fetch)
+        count_d2h(deltas.row_nbytes * len(entries))
+        return recs
+
+    def _fetch_slots_locked(self, slots: list) -> list[dict]:
+        """Supervised d2h fetch of popped slots (callers hold
+        _resident_lock; a watchdog-abandoned fetch raises back to them
+        with the lock released by their unwind)."""
+        p3 = self.p3
+
+        def fetch():
+            out = []
+            for slot in slots:
+                out.append(
+                    {
+                        "key": slot.key,
+                        "share": [int(x) for x in p3.jf.to_ints(slot.value)],
+                        "rows": slot.rows,
+                        "interval": slot.interval,
+                    }
+                )
+            return out
+
+        recs = self._supervised("resident_fetch", fetch)
+        count_d2h(sum(slot.nbytes for slot in slots))
+        return recs
+
+    def has_resident(self) -> bool:
+        """True while unflushed resident slots live on this engine —
+        the process engine-cache LRU must not evict such an engine (the
+        flusher only walks CACHED engines; dropping one silently loses
+        the share bytes and leaks the resident-bytes ledger)."""
+        with self._resident_lock:
+            return bool(self._resident)
+
+    def would_coalesce(self, n: int) -> bool:
+        """True when a leader init of n rows would enter a coalesced
+        round (the _leader_init_entry routing predicate). A prestage
+        for such a job is wasted whenever the round MERGES — the merged
+        round re-stages from concatenated host columns — so a parallel
+        device lane declines prestaging exactly these jobs."""
+        cap = self.bucket_cap
+        return bool(
+            self._coalesce
+            and n <= self.COALESCE_MAX_JOB
+            and (cap is None or n <= cap)
+        )
+
+    def resident_status(self) -> dict:
+        with self._resident_lock:
+            return {
+                "vdaf": self.inst.kind,
+                "buffers": len(self._resident),
+                "bytes": sum(s.nbytes for s in self._resident.values()),
+                **dict(self._resident_stats),
+            }
 
 
 class _HostP3:
@@ -1545,8 +2206,16 @@ class HostEngineCache:
         out1 = self._ints_to_limbs(out_rows, self.circ.output_len)
         return out1, accept, prep_msg
 
-    def leader_init(self, nonce_lanes, public_parts, meas, proof, blind0, ok=None):
+    def leader_init(
+        self, nonce_lanes, public_parts, meas, proof, blind0, ok=None, prestaged=None
+    ):
         from ..vdaf.reference import LeaderShare
+
+        if prestaged is not None:
+            # signature parity with EngineCache: the pipeline's
+            # device_init passes prestaged= unconditionally; a host
+            # engine has no device path, so free the transfer's buffers
+            prestaged.discard()
 
         n = nonce_lanes.shape[0]
         uses_jr = self.host.uses_joint_rand
@@ -1639,7 +2308,21 @@ def engine_cache(inst: VdafInstance, verify_key: bytes):
             return cur
         _engine_cache[key] = eng
         while len(_engine_cache) > _ENGINE_CACHE_MAX:
-            _engine_cache.popitem(last=False)
+            # evict the oldest entry that holds NO resident aggregate
+            # state: the flusher only walks cached engines, so dropping
+            # one with live slots silently loses the share bytes and
+            # leaks its bytes in the resident ledger forever
+            victim = None
+            for k, e in _engine_cache.items():
+                if not (isinstance(e, EngineCache) and e.has_resident()):
+                    victim = k
+                    break
+            if victim is None:
+                # every entry holds unflushed state (bounded by
+                # RESIDENT_MAX_BYTES): keep them all until a flush
+                # pass drains one, then the next insert evicts
+                break
+            _engine_cache.pop(victim)
         metrics.engine_cache_entries.set(float(len(_engine_cache)))
     return eng
 
@@ -1647,9 +2330,27 @@ def engine_cache(inst: VdafInstance, verify_key: bytes):
 def _engine_cache_clear() -> None:
     from .. import metrics
 
+    global _resident_bytes_total
     with _engine_cache_lock:
         _engine_cache.clear()
+    # shared cross-task coalescers and the resident byte ledger follow
+    # the cache lifetime (tests clear between modules for isolation)
+    _clear_shared_coalescers()
+    with _resident_bytes_lock:
+        _resident_bytes_total = 0
+        kinds = list(_resident_buffer_counts)
+        _resident_buffer_counts.clear()
+    metrics.engine_resident_bytes.set(0.0)
+    for kind in kinds:
+        metrics.engine_resident_buffers.set(0.0, vdaf=kind)
     metrics.engine_cache_entries.set(0.0)
+
+
+def live_engines() -> list["EngineCache"]:
+    """Live DEVICE engines in the process cache (host engines hold no
+    resident state) — the resident flusher/drain walk this."""
+    with _engine_cache_lock:
+        return [e for e in _engine_cache.values() if isinstance(e, EngineCache)]
 
 
 def shutdown_engines(timeout_s: float = 2.0) -> None:
@@ -1699,6 +2400,8 @@ def engine_cache_status() -> dict:
             "sp": eng.sp,
             "tile_elems": eng.tile_elems,
             "coalesce_round_rows": eng._co_leader._max_rows,
+            "cross_task_coalesce": XTASK_COALESCE,
+            "resident": eng.resident_status(),
             "oom_history": list(eng.oom_history),
         }
         try:
@@ -1711,6 +2414,25 @@ def engine_cache_status() -> dict:
     return {"entries": len(engines), "max_entries": _ENGINE_CACHE_MAX, "engines": out}
 
 
+def resident_accumulators_status() -> dict:
+    """/statusz `resident_accumulators` section: process-wide resident
+    aggregate state (bytes, per-engine buffer counts, merge/eviction/
+    flush-take counters)."""
+    with _engine_cache_lock:
+        engines = list(_engine_cache.values())
+    return {
+        "total_bytes": resident_bytes_total(),
+        "max_bytes": EngineCache.RESIDENT_MAX_BYTES,
+        "cross_task_coalesce": XTASK_COALESCE,
+        "engines": [
+            eng.resident_status()
+            for eng in engines
+            if not isinstance(eng, HostEngineCache)
+        ],
+    }
+
+
 from ..statusz import register_status_provider as _register_status_provider
 
 _register_status_provider("engine_cache", engine_cache_status)
+_register_status_provider("resident_accumulators", resident_accumulators_status)

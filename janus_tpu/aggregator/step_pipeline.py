@@ -94,6 +94,13 @@ class StepPipelineConfig:
     # device-lane width. 1 (default) = fully serialized dispatches; >1
     # re-enables cross-job coalescing at the engine gate for small jobs
     device_lane_workers: int = 1
+    # double-buffered staging (ISSUE 12): the read stage issues job
+    # k+1's padded host->device column uploads ASYNC right after
+    # staging, so the transfer overlaps job k's dispatch on the lane
+    # instead of serializing in front of k+1's own dispatch. Staged
+    # device bytes are bounded by the same prefetch_depth window as the
+    # host columns.
+    double_buffer: bool = True
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "StepPipelineConfig":
@@ -104,6 +111,7 @@ class StepPipelineConfig:
             http_inflight=max(1, int(d.get("http_inflight", 2))),
             commit_inflight=max(1, int(d.get("commit_inflight", 2))),
             device_lane_workers=max(1, int(d.get("device_lane_workers", 1))),
+            double_buffer=bool(d.get("double_buffer", True)),
         )
 
 
@@ -445,7 +453,41 @@ class StepPipeline:
             # hold unconsumed staged columns — the staged-memory bound
             self._staging_window.acquire()
             job.staging_permit = True
-            job.state = driver.stage_init(acquired, task, jobrow, rows, reports)
+            st = driver.stage_init(acquired, task, jobrow, rows, reports)
+            job.state = st
+            if self.cfg.double_buffer:
+                # double-buffered staging: issue THIS job's padded H2D
+                # transfers async now, on the read thread — they overlap
+                # whatever dispatch currently occupies the device lane,
+                # and device_init consumes them without a host put
+                prestage = getattr(st.engine, "prestage_leader", None)
+                would_coalesce = getattr(st.engine, "would_coalesce", None)
+                if (
+                    prestage is not None
+                    and self.cfg.device_lane_workers > 1
+                    and would_coalesce is not None
+                    and would_coalesce(st.nonce_lanes.shape[0])
+                ):
+                    # a parallel device lane means coalesced rounds can
+                    # MERGE, and a merged round discards its entries'
+                    # prestages (it re-stages from concatenated host
+                    # columns) — don't pay the H2D transfer twice for
+                    # exactly the small jobs coalescing targets
+                    prestage = None
+                if prestage is not None:
+                    try:
+                        st.prestaged = prestage(
+                            st.nonce_lanes, st.public_parts, st.meas,
+                            st.proof, st.blind_lanes,
+                        )
+                    except Exception:
+                        log.warning(
+                            "prestage failed for job %s; device_init will "
+                            "stage from host",
+                            acquired.job_id,
+                            exc_info=True,
+                        )
+                        st.prestaged = None
             return (STAGE_DEVICE, self._stage_device_init)
 
     def _release_staging(self, job: _PipelinedStep) -> None:
@@ -463,10 +505,14 @@ class StepPipeline:
         finally:
             # the device consumed the staged columns (leader_init's H2D
             # transfers complete before it returns): free the host
-            # arrays and open the staging window for the next prefetch
+            # arrays — and any unconsumed prestaged device buffers —
+            # and open the staging window for the next prefetch
             st = job.state
             st.meas = st.proof = st.blind_lanes = st.public_parts = None
             st.nonce_lanes = None
+            if st.prestaged is not None:
+                st.prestaged.discard()
+                st.prestaged = None
             self._release_staging(job)
         return (STAGE_HTTP, self._stage_http_init)
 

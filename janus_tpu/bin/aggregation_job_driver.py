@@ -31,6 +31,7 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         AggregationJobDriverConfig(
             maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
             circuit_breaker=cfg.outbound_circuit_breaker,
+            resident=cfg.resident_accumulators,
         ),
         # in-flight helper retries observe SIGTERM and step back instead
         # of spending the remaining lease on a dead peer
@@ -58,15 +59,32 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:
         sampler = HealthSampler(ds, cfg.common.health_sampler_interval_s).start()
+    # resident mode: background flusher bounds the unflushed window for
+    # idle drivers and flushes a quarantined engine's state so the
+    # interim host path sees complete batch rows
+    flusher = None
+    if cfg.resident_accumulators.enabled:
+        from ..aggregator.aggregation_job_driver import ResidentFlusher
+
+        flusher = ResidentFlusher(
+            driver, cfg.resident_accumulators.flush_interval_s
+        ).start()
     try:
         jd.run()
     finally:
         if sampler is not None:
             sampler.stop()
+        if flusher is not None:
+            flusher.stop()
         if pipeline is not None:
             # jd.run() drained the in-flight chains; this only retires
             # the idle stage workers
             pipeline.close()
+        if cfg.resident_accumulators.enabled:
+            # drain contract: in-flight chains are done (jd.run()
+            # returned), so every committed delta is merged — flush the
+            # resident state through the write-tx path before exit
+            driver.flush_resident_state(reason="drain")
     log.info("aggregation job driver shut down")
 
 

@@ -608,20 +608,29 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         except Exception:
             log.exception("could not pin JAX platform %r", common.jax_platform)
 
-    if common.compilation_cache_dir:
-        # persistent XLA compile cache: restart cold-start drops from
-        # minutes (first jit of each engine step) to seconds. jax is
-        # already imported by now (sitecustomize/transitive imports), so
-        # env vars are a no-op — must go through jax.config.
-        cache_dir = os.path.expanduser(common.compilation_cache_dir)
+    # persistent XLA compile cache: restart cold-start drops from
+    # minutes (first jit of each engine step) to seconds. jax is
+    # already imported by now (sitecustomize/transitive imports), so
+    # env vars are a no-op — must go through jax.config. The `engine:`
+    # stanza's compile_cache_dir overrides the top-level knob.
+    compile_cache_dir = common.engine.compile_cache_dir or common.compilation_cache_dir
+    if compile_cache_dir:
         try:
-            import jax
-
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            enable_compile_cache(compile_cache_dir)
         except Exception:
             log.exception("could not enable the persistent compilation cache")
+
+    # engine-layer knobs (YAML `engine:` stanza). Envs are the operator
+    # override, same discipline as the watchdog knobs above.
+    if common.engine.resident_max_bytes and "JANUS_RESIDENT_MAX_BYTES" not in os.environ:
+        EngineCache.RESIDENT_MAX_BYTES = int(common.engine.resident_max_bytes)
+    if (
+        common.engine.cross_task_coalesce is not None
+        and "JANUS_XTASK_COALESCE" not in os.environ
+    ):
+        from .aggregator import engine_cache as engine_cache_mod
+
+        engine_cache_mod.XTASK_COALESCE = bool(common.engine.cross_task_coalesce)
 
     keys = parse_datastore_keys(args.datastore_keys)
     ds = open_datastore(common.database.url, Crypter(keys), RealClock())

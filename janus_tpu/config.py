@@ -15,11 +15,43 @@ import yaml
 
 from .aggregator import Config as AggregatorProtocolConfig
 from .aggregator.aggregation_job_creator import AggregationJobCreatorConfig
+from .aggregator.aggregation_job_driver import ResidentConfig
 from .aggregator.job_driver import JobDriverConfig
 from .aggregator.step_pipeline import StepPipelineConfig
 from .core.circuit_breaker import CircuitBreakerConfig
 from .slo import SloEngineConfig
 from .trace import TraceConfiguration
+
+
+@dataclass
+class EngineConfig:
+    """YAML `engine:` stanza (docs/ARCHITECTURE.md "Resident aggregate
+    state"): engine-layer knobs shared by every binary with a device
+    path."""
+
+    # persistent XLA compilation cache directory; overrides the
+    # top-level compilation_cache_dir when set (the cheap slice of the
+    # cold-start roadmap item: restarts and canary rebuilds reload
+    # compiled executables from disk instead of recompiling)
+    compile_cache_dir: str | None = None
+    # process-wide device-byte bound on resident aggregate buffers
+    # (EngineCache.RESIDENT_MAX_BYTES; LRU overflow evicts through the
+    # flush path). 0/None keeps the class default.
+    resident_max_bytes: int | None = None
+    # merge small jobs across TASKS into one device dispatch (per-lane
+    # verify keys). None keeps the process default (on).
+    cross_task_coalesce: bool | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "EngineConfig":
+        d = d or {}
+        rmb = d.get("resident_max_bytes")
+        xt = d.get("cross_task_coalesce")
+        return cls(
+            compile_cache_dir=d.get("compile_cache_dir"),
+            resident_max_bytes=int(rmb) if rmb is not None else None,
+            cross_task_coalesce=bool(xt) if xt is not None else None,
+        )
 
 
 @dataclass
@@ -121,6 +153,9 @@ class CommonConfig:
     # and alert definitions (merged over the shipped defaults by name).
     # Enabled by default — every binary answers GET /alertz.
     slo: SloEngineConfig = field(default_factory=SloEngineConfig)
+    # Engine-layer knobs (YAML `engine:` section): compile cache dir
+    # override, resident-buffer byte bound, cross-task coalescing.
+    engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -141,6 +176,7 @@ class CommonConfig:
             quarantine_canary_delay_secs=float(wd.get("canary_delay_secs", 5.0)),
             quarantine_canary_timeout_secs=float(wd.get("canary_timeout_secs", 30.0)),
             slo=SloEngineConfig.from_dict(d.get("slo")),
+            engine=EngineConfig.from_dict(d.get("engine")),
         )
 
 
@@ -337,6 +373,10 @@ class JobDriverBinaryConfig:
     # default — `step_pipeline: {enabled: false}` restores the serial
     # per-worker stepper.
     step_pipeline: StepPipelineConfig = field(default_factory=StepPipelineConfig)
+    # device-resident accumulator state (YAML `resident_accumulators:`
+    # section; docs/ARCHITECTURE.md "Resident aggregate state"). Off by
+    # default — the per-job share fetch+write stays crash-durable.
+    resident_accumulators: ResidentConfig = field(default_factory=ResidentConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "JobDriverBinaryConfig":
@@ -347,6 +387,9 @@ class JobDriverBinaryConfig:
                 d.get("outbound_circuit_breaker")
             ),
             step_pipeline=StepPipelineConfig.from_dict(d.get("step_pipeline")),
+            resident_accumulators=ResidentConfig.from_dict(
+                d.get("resident_accumulators")
+            ),
         )
 
 

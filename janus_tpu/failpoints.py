@@ -13,7 +13,7 @@ Configuration — the `JANUS_FAILPOINTS` environment variable or the
 
 Grammar (';'-separated entries):
 
-    <name>=<action>[:<arg>][,prob=<P>][,count=<N>]
+    <name>=<action>[:<arg>][,prob=<P>][,count=<N>][,after=<K>]
 
 Actions:
 
@@ -48,7 +48,11 @@ Actions:
 
 Modifiers: `prob=P` overrides the firing probability regardless of
 action arg; `count=N` is a firing budget — after N firings the
-failpoint goes inert (failures that storm and then clear).
+failpoint goes inert (failures that storm and then clear); `after=K`
+skips the first K hits of the site before arming — "let two jobs land,
+wedge the third" schedules (the resident-accumulator chaos proof
+quarantines mid-stream this way) without racing a sleep against the
+job loop.
 
 Scoped names: sites that serve many logical operations fire both their
 base name and a scoped variant — run_tx fires `datastore.commit` and
@@ -87,15 +91,25 @@ class FailpointSpecError(ValueError):
 
 
 class _Failpoint:
-    __slots__ = ("name", "action", "arg", "prob", "count", "fired")
+    __slots__ = ("name", "action", "arg", "prob", "count", "after", "fired", "hits")
 
-    def __init__(self, name: str, action: str, arg: float, prob: float, count: int | None):
+    def __init__(
+        self,
+        name: str,
+        action: str,
+        arg: float,
+        prob: float,
+        count: int | None,
+        after: int = 0,
+    ):
         self.name = name
         self.action = action
         self.arg = arg
         self.prob = prob
         self.count = count  # None = unlimited
+        self.after = after  # skip the first N hits before arming
         self.fired = 0
+        self.hits = 0
 
     def snapshot(self) -> dict:
         return {
@@ -103,6 +117,8 @@ class _Failpoint:
             "arg": self.arg,
             "prob": self.prob,
             "count": self.count,
+            "after": self.after,
+            "hits": self.hits,
             "fired": self.fired,
         }
 
@@ -162,6 +178,7 @@ def _parse_one(name: str, body: str) -> _Failpoint:
     # delay/timeout/hang it is seconds and prob defaults to always
     prob = arg if action in ("error", "crash", "oom") else 1.0
     count = None
+    after = 0
     for mod in parts[1:]:
         key, _, val = mod.partition("=")
         key = key.strip()
@@ -170,9 +187,12 @@ def _parse_one(name: str, body: str) -> _Failpoint:
                 prob = float(val)
             elif key == "count":
                 count = int(val)
+            elif key == "after":
+                after = int(val)
             else:
                 raise FailpointSpecError(
-                    f"failpoint {name!r}: unknown modifier {key!r} (expected prob=/count=)"
+                    f"failpoint {name!r}: unknown modifier {key!r} "
+                    "(expected prob=/count=/after=)"
                 )
         except ValueError:
             raise FailpointSpecError(f"failpoint {name!r}: bad modifier {mod!r}") from None
@@ -180,7 +200,9 @@ def _parse_one(name: str, body: str) -> _Failpoint:
         raise FailpointSpecError(f"failpoint {name!r}: prob {prob} outside [0, 1]")
     if count is not None and count < 0:
         raise FailpointSpecError(f"failpoint {name!r}: negative count")
-    return _Failpoint(name, action, arg, prob, count)
+    if after < 0:
+        raise FailpointSpecError(f"failpoint {name!r}: negative after")
+    return _Failpoint(name, action, arg, prob, count, after)
 
 
 def parse_spec(spec) -> dict[str, _Failpoint]:
@@ -260,6 +282,9 @@ def _lookup_and_arm(name: str) -> _Failpoint | None:
         fp = _registry.get(name)
         if fp is None:
             return None
+        fp.hits += 1
+        if fp.hits <= fp.after:
+            return None  # not armed yet (after=K skips the first K hits)
         if fp.count is not None and fp.fired >= fp.count:
             return None
         if fp.prob < 1.0 and _rng.random() >= fp.prob:

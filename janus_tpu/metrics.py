@@ -701,6 +701,42 @@ prep_resp_order_mismatch_total = REGISTRY.counter(
     "id->index dict match)",
 )
 
+# --- device-resident aggregate state + host<->device traffic (ISSUE 12;
+# docs/ARCHITECTURE.md "Resident aggregate state") ---
+engine_resident_buffers = REGISTRY.gauge(
+    "janus_engine_resident_buffers",
+    "per-(task, batch bucket) aggregate buffers currently resident in "
+    "device memory, by VDAF kind (flushed to the datastore on interval, "
+    "LRU pressure, quarantine and drain)",
+)
+engine_resident_bytes = REGISTRY.gauge(
+    "janus_engine_resident_bytes",
+    "device bytes held by resident aggregate buffers across all engines "
+    "(bounded by the engine resident_max_bytes knob; overflow evicts LRU "
+    "buffers through the flush path)",
+)
+engine_hd_bytes_total = REGISTRY.counter(
+    "janus_engine_hd_bytes_total",
+    "host<->device bytes moved by the engine layer, by direction "
+    '(direction="h2d" staging uploads + masks, direction="d2h" fetches) '
+    "— the resident-accumulator A/B divides this by rows to get "
+    "bytes/report on the accumulate leg",
+)
+engine_resident_flushes_total = REGISTRY.counter(
+    "janus_engine_resident_flushes_total",
+    "resident aggregate buffers flushed through the write-tx path, by "
+    'reason (reason="interval|eviction|quarantine|drain|merge_failed") '
+    'and outcome (outcome="flushed|lost|stale") — outcome="lost" means a '
+    "fetched share could not be persisted and is gone; alert on any",
+)
+engine_prestage_total = REGISTRY.counter(
+    "janus_engine_prestage_total",
+    "double-buffered staging outcomes: a prestaged (async H2D during the "
+    'previous dispatch) column set consumed by its dispatch (outcome="hit") '
+    'or discarded for the host re-stage path (outcome="fallback" — '
+    "coalesced multi-job round, bucket cap moved, or host fallback)",
+)
+
 # --- report-lifecycle tracing + end-to-end SLOs (ISSUE 6;
 # docs/OBSERVABILITY.md "Report-lifecycle tracing") ---
 span_errors_total = REGISTRY.counter(

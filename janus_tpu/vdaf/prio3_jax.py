@@ -227,9 +227,14 @@ class Prio3Batched:
             USAGE_JOINT_RANDOMNESS, jr_seed_lanes, [], 0, self.circ.joint_rand_len
         )
 
-    def _query_rand(self, verify_key: bytes, nonce_lanes):
+    def _query_rand(self, verify_key, nonce_lanes):
+        """verify_key: 16 bytes (one task — baked into the trace) OR a
+        [batch, 2] u64 lane array (cross-TASK coalesced dispatches: each
+        lane carries its own task's key through the XOF, exactly like
+        the per-lane nonce segment)."""
         batch = nonce_lanes.shape[0]
-        assert len(verify_key) == SEED_SIZE
+        if isinstance(verify_key, (bytes, bytearray)):
+            assert len(verify_key) == SEED_SIZE
         parts = [
             (0, self._dst(USAGE_QUERY_RANDOMNESS)),
             (DST_LANES, verify_key),
@@ -422,6 +427,29 @@ class Prio3Batched:
         jf = self.jf
         masked = fmap(lambda x: jnp.where(mask[:, None], x, jnp.zeros_like(x)), out_shares)
         return fsum(jf, masked, axis=0)
+
+    def aggregate_buckets(self, out_shares, bucket_idx, k: int):
+        """Per-bucket masked sums -> [k, output_len] field value.
+
+        bucket_idx: [batch] int32 assigning each lane to a batch bucket
+        (0..k-1); rejected lanes carry -1 and contribute nowhere. One
+        traced computation replaces k separate masked aggregates (k mask
+        uploads + k fetches) — the delta kernel of the device-resident
+        accumulator path. Field-element identical to calling
+        `aggregate(out_shares, bucket_idx == j)` per j (same adds in the
+        same lane order).
+        """
+        jf = self.jf
+        # deliberately k unrolled masked reduces, not one one-hot/segment
+        # pass: XLA schedules them sequentially so peak HBM stays at ONE
+        # bucket's working set (a [n, k, output_len] one-hot intermediate
+        # is O(k) memory — fatal at north-star output lengths), and
+        # segment_sum's plain integer adds would overflow the field
+        # limbs without jf.add's interleaved modular reduction
+        parts = [self.aggregate(out_shares, bucket_idx == j) for j in range(k)]
+        return tuple(
+            jnp.stack([p[i] for p in parts], axis=0) for i in range(jf.LIMBS)
+        )
 
     def merge_agg_shares(self, a, b):
         return self.jf.add(a, b)

@@ -83,11 +83,25 @@ XLA dispatch / tunnel stall. Invariants:
   - the driver SIGTERM-drains cleanly (release_hangs unparks the
     modeled wedge on shutdown).
 
+A fifth scenario, `--scenario resident`, proves the RESIDENT
+AGGREGATE STATE flush contract (docs/ARCHITECTURE.md "Resident
+aggregate state"): the real driver binary runs with
+`resident_accumulators` enabled, a one-slot `resident_max_bytes`, and
+`engine.dispatch=hang,count=1,after=4` armed. Invariants: an LRU
+eviction flushes through the write-tx path live
+(`janus_engine_resident_flushes_total{reason="eviction"}`), the
+mid-stream quarantine's flusher sweep writes the surviving slot out
+(`reason="quarantine"`) while the wedged job re-steps on the host
+path, a post-restore job lands resident and SIGTERM drains it, no
+flush reports `outcome="lost"`, and BOTH tasks' collections equal
+their admitted ground truths exactly.
+
 Usage:
     python scripts/chaos_run.py --smoke --json   # fast deterministic
     python scripts/chaos_run.py --json           # full schedule (slow)
     python scripts/chaos_run.py --scenario db_outage --smoke --json
     python scripts/chaos_run.py --scenario device_hang --smoke --json
+    python scripts/chaos_run.py --scenario resident --smoke --json
 
 Exit code 0 iff every invariant held; the result JSON rides on stdout
 (bench.py --dry-run embeds the smokes as its chaos_smoke and
@@ -1466,6 +1480,340 @@ def run_pipeline(
         helper_ds.close()
 
 
+# --scenario resident: the first four device dispatches (two tasks x
+# leader_init + masked-delta) land clean, the FIFTH wedges forever —
+# quarantining the engine while earlier jobs' aggregate state sits
+# resident in device memory; two canary probes fail to hold the
+# quarantine window open long enough to observe the flush live
+RESIDENT_SCHEDULE = "engine.dispatch=hang,count=1,after=4;engine.canary=error:1.0,count=2"
+
+
+def run_resident(
+    wave_sizes: tuple = (3, 3, 4, 3),
+    lease_ttl_s: int = 6,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Resident aggregate state flush contract (docs/ARCHITECTURE.md
+    "Resident aggregate state") against the REAL driver binary with
+    `resident_accumulators` enabled and an 8-byte `resident_max_bytes`
+    (one count slot). Deterministic schedule:
+
+      1. two tasks (A, B) each land one job resident; task B's merge
+         overflows the byte cap and LRU-EVICTS task A's slot through
+         the flush path (reason="eviction") — observed live;
+      2. task A's next job wedges on its device dispatch
+         (engine.dispatch hang, after=4) → watchdog abandon →
+         quarantine; the flusher's quarantine sweep writes task B's
+         resident slot out (reason="quarantine") while the wedged job
+         re-steps through the interim host engine;
+      3. after the canary restores the device path, one more task-A
+         job lands resident; SIGTERM drains it through the write-tx
+         path (drain contract) and the final collections equal BOTH
+         tasks' admitted ground truths exactly — no share bytes lost
+         across eviction, quarantine, or drain.
+
+    wave_sizes: (task A wave 1, task B wave 1, task A hang wave,
+    task A drain wave). Every `*_ok` key must be True to pass."""
+    import threading
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.binary_utils import enable_compile_cache, warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    import dataclasses
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-resident-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    clock = RealClock()
+    leader_db = os.path.join(tmp, "leader.sqlite")
+    leader_ds = Datastore(leader_db, Crypter([key_bytes]), clock)
+    helper_ds = Datastore(os.path.join(tmp, "helper.sqlite"), Crypter([key_bytes]), clock)
+
+    result: dict = {
+        "workdir": tmp,
+        "schedule": "resident_full" if full else "resident_smoke",
+    }
+    procs: list[subprocess.Popen] = []
+    leader_srv = helper_srv = None
+    try:
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+
+        vdaf = VdafInstance.count()
+        tasks = {}
+        for name, cfg_id in (("a", 210), ("b", 211)):
+            collector_kp = generate_hpke_config_and_private_key(config_id=cfg_id)
+            leader_task = (
+                TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+                .with_(
+                    leader_aggregator_endpoint=leader_srv.url,
+                    helper_aggregator_endpoint=helper_srv.url,
+                    collector_hpke_config=collector_kp.config,
+                    aggregator_auth_token=AuthenticationToken.random_bearer(),
+                    collector_auth_token=AuthenticationToken.random_bearer(),
+                    min_batch_size=1,
+                )
+                .build()
+            )
+            helper_task = dataclasses.replace(
+                leader_task,
+                role=Role.HELPER,
+                hpke_keys=(generate_hpke_config_and_private_key(config_id=4),),
+            )
+            leader_ds.run_tx(lambda tx, t=leader_task: tx.put_task(t), "provision")
+            helper_ds.run_tx(lambda tx, t=helper_task: tx.put_task(t), "provision")
+            tasks[name] = (leader_task, collector_kp)
+        enable_compile_cache()
+        warmup_engines(leader_ds)
+
+        creator = AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=100
+            ),
+        )
+        truth = {"a": [], "b": []}
+
+        def upload(task_name: str, measurements) -> None:
+            leader_task, _ = tasks[task_name]
+            http = HttpClient()
+            params = ClientParameters(
+                leader_task.task_id, leader_srv.url, helper_srv.url,
+                leader_task.time_precision,
+            )
+            client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+            for m in measurements:
+                client.upload(m)
+            truth[task_name].extend(measurements)
+            creator.run_once()
+
+        def finished_jobs() -> int:
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "resident_monitor"
+            )
+            return sum(
+                n
+                for (typ, state), n in counts.items()
+                if typ == "aggregation" and state == "finished"
+            )
+
+        def flush_samples(mtext: str) -> dict:
+            return _metric_samples(mtext, "janus_engine_resident_flushes_total")
+
+        # --- spawn the real driver: resident mode on, interval flush
+        # effectively off (3600 s) so every flush observed below is an
+        # EVICTION, QUARANTINE, or DRAIN flush — never the timer ------
+        port = _free_port()
+        cfg = _driver_cfg(
+            os.path.join(tmp, "driver.yaml"),
+            leader_db,
+            port,
+            int(lease_ttl_s),
+            1.5,
+            extra=(
+                "resident_accumulators:\n"
+                "  enabled: true\n"
+                "  flush_interval_secs: 3600\n"
+                "engine:\n"
+                "  resident_max_bytes: 8\n"  # exactly ONE count slot
+            ),
+        )
+        drv = _spawn_driver(
+            cfg,
+            key,
+            os.path.join(tmp, "driver.log"),
+            RESIDENT_SCHEDULE,
+            extra_env={
+                "JANUS_CANARY_DELAY_S": "1.5",
+                "JANUS_CANARY_TIMEOUT_S": "30",
+            },
+        )
+        procs.append(drv)
+        _wait_healthz(port)
+
+        # --- phase 1: task A then task B land resident; B's merge
+        # LRU-evicts A's slot through the flush path ------------------
+        upload("a", [1, 0, 1][: wave_sizes[0]] or [1])
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and finished_jobs() < 1:
+            time.sleep(0.05)
+        upload("b", [1, 1, 0][: wave_sizes[1]] or [1])
+        eviction_seen = False
+        while time.monotonic() < deadline and not eviction_seen:
+            if finished_jobs() >= 2:
+                samples = flush_samples(_scrape(port, "/metrics"))
+                eviction_seen = (
+                    samples.get('outcome="flushed",reason="eviction"', 0) >= 1
+                )
+            time.sleep(0.05)
+        result["eviction_flush_ok"] = eviction_seen
+
+        # --- phase 2: task A's next job wedges (hang armed after=4) ->
+        # quarantine; the flusher sweep flushes B's slot live ---------
+        upload("a", [1, 1, 1, 0][: wave_sizes[2]] or [1])
+        quarantined_seen = False
+        quarantine_flush_seen = False
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            try:
+                mtext = _scrape(port, "/metrics")
+            except Exception:
+                time.sleep(0.1)
+                continue
+            backend = _metric_samples(mtext, "janus_engine_backend")
+            if backend.get('state="quarantined",vdaf="count"') == 1.0:
+                quarantined_seen = True
+            samples = flush_samples(mtext)
+            if samples.get('outcome="flushed",reason="quarantine"', 0) >= 1:
+                quarantine_flush_seen = True
+            if quarantine_flush_seen and finished_jobs() >= 3:
+                break
+            time.sleep(0.05)
+        result["quarantined_observed_ok"] = quarantined_seen
+        result["quarantine_flush_ok"] = quarantine_flush_seen
+        step_backs = _metric_samples(
+            _scrape(port, "/metrics"), "janus_job_step_back_total"
+        )
+        result["stepped_back_device_hang_ok"] = (
+            sum(v for k, v in step_backs.items() if "device_hang" in k) >= 1
+        )
+
+        # --- phase 3: canary restores the device path; one more job
+        # lands resident and SIGTERM drains it ------------------------
+        restore_deadline = time.monotonic() + 90
+        while time.monotonic() < restore_deadline:
+            backend = _metric_samples(
+                _scrape(port, "/metrics"), "janus_engine_backend"
+            )
+            if backend.get('state="device",vdaf="count"') == 1.0:
+                break
+            time.sleep(0.1)
+        result["restored_ok"] = backend.get('state="device",vdaf="count"') == 1.0
+        upload("a", [0, 1, 1][: wave_sizes[3]] or [1])
+        resident_before_drain = 0
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if finished_jobs() >= 4:
+                statusz = json.loads(_scrape(port, "/statusz"))
+                ra = statusz.get("resident_accumulators", {})
+                resident_before_drain = sum(
+                    e.get("buffers", 0) for e in ra.get("engines", [])
+                )
+                if resident_before_drain >= 1:
+                    result["statusz_resident_bytes"] = ra.get("total_bytes")
+                    break
+            time.sleep(0.05)
+        result["resident_before_drain_ok"] = resident_before_drain >= 1
+
+        mtext = _scrape(port, "/metrics")
+        samples = flush_samples(mtext)
+        result["flush_samples"] = samples
+        result["no_lost_flushes_ok"] = not any(
+            'outcome="lost"' in k and v > 0 for k, v in samples.items()
+        )
+        hd = _metric_samples(mtext, "janus_engine_hd_bytes_total")
+        result["hd_bytes"] = hd
+        result["hd_bytes_ok"] = (
+            sum(v for k, v in hd.items() if 'direction="h2d"' in k) > 0
+        )
+
+        # --- SIGTERM drain: the resident remainder flushes through the
+        # write-tx path before exit (collection proves it landed) -----
+        drv.send_signal(signal.SIGTERM)
+        rc = drv.wait(timeout=60)
+        log_text = open(os.path.join(tmp, "driver.log"), "rb").read()
+        result["drain_rc"] = rc
+        result["drain_ok"] = rc == 0 and b"shut down" in log_text
+
+        # --- collect BOTH tasks and compare against ground truth -----
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            cjd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                cjd.run_once()
+                stop_collect.wait(0.3)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+        try:
+            for name in ("a", "b"):
+                leader_task, collector_kp = tasks[name]
+                collector = Collector(
+                    CollectorParameters(
+                        leader_task.task_id,
+                        leader_srv.url,
+                        leader_task.collector_auth_token,
+                        collector_kp,
+                    ),
+                    vdaf,
+                    HttpClient(),
+                )
+                tp = leader_task.time_precision
+                start = clock.now().to_batch_interval_start(tp)
+                query = Query.time_interval(
+                    Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+                )
+                collected = collector.collect(query, timeout_s=120.0)
+                result[f"collected_count_{name}"] = collected.report_count
+                result[f"collected_sum_{name}"] = collected.aggregate_result
+                result[f"exactly_once_{name}_ok"] = (
+                    collected.report_count == len(truth[name])
+                    and collected.aggregate_result == sum(truth[name])
+                )
+                result[f"admitted_{name}"] = len(truth[name])
+                result[f"ground_truth_sum_{name}"] = sum(truth[name])
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        failpoints_mod = sys.modules.get("janus_tpu.failpoints")
+        if failpoints_mod is not None:
+            failpoints_mod.clear()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if leader_srv is not None:
+            leader_srv.stop()
+        if helper_srv is not None:
+            helper_srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -1476,7 +1824,7 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--scenario",
-        choices=["crash_storm", "db_outage", "device_hang", "pipeline"],
+        choices=["crash_storm", "db_outage", "device_hang", "pipeline", "resident"],
         default="crash_storm",
         help="crash_storm = driver SIGKILL + helper storms (default); "
         "db_outage = datastore outage under upload load (journal spill, "
@@ -1484,7 +1832,10 @@ def main(argv=None) -> int:
         "device dispatch (watchdog abandon, quarantine + canary "
         "restore, host-fallback serving, exactly-once); pipeline = "
         "stage-pipelined stepper overlap proof (device lane busy while "
-        "a stretched helper RTT is in flight, exactly-once)",
+        "a stretched helper RTT is in flight, exactly-once); resident = "
+        "device-resident accumulator flush contract (LRU eviction, "
+        "quarantine sweep, SIGTERM drain each flush resident state; "
+        "collections exact)",
     )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
@@ -1507,6 +1858,11 @@ def main(argv=None) -> int:
     elif args.scenario == "pipeline":
         result = run_pipeline(
             n_reports=args.reports or (24 if args.smoke else 60),
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    elif args.scenario == "resident":
+        result = run_resident(
             full=not args.smoke,
             workdir=args.workdir,
         )

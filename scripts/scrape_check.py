@@ -122,6 +122,13 @@ def main(argv=None) -> int:
         # every binary, so absence is a deploy regression
         "janus_hpke_batch_size",
         "janus_ingest_decrypt_batch_seconds",
+        # device-resident aggregate state + host<->device traffic
+        # (ISSUE 12) — registered at import in every binary
+        "janus_engine_resident_buffers",
+        "janus_engine_resident_bytes",
+        "janus_engine_hd_bytes_total",
+        "janus_engine_resident_flushes_total",
+        "janus_engine_prestage_total",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -164,6 +171,22 @@ def main(argv=None) -> int:
                             errors.append(
                                 "/statusz device_watchdog stalled entry without a stack dump"
                             )
+                # resident aggregate state (ISSUE 12): process-wide
+                # byte ledger + per-engine buffer/merge/eviction counts
+                ra = snap.get("resident_accumulators")
+                if not isinstance(ra, dict):
+                    errors.append("/statusz missing the resident_accumulators section")
+                else:
+                    for key in ("total_bytes", "max_bytes", "cross_task_coalesce", "engines"):
+                        if key not in ra:
+                            errors.append(f"/statusz resident_accumulators missing {key!r}")
+                    for ent in ra.get("engines", []) or []:
+                        for key in ("vdaf", "buffers", "bytes", "merges", "evictions"):
+                            if key not in ent:
+                                errors.append(
+                                    f"/statusz resident_accumulators engine entry missing {key!r}"
+                                )
+                                break
 
     # /readyz semantics (docs/ROBUSTNESS.md "Datastore outages"): 200
     # with {"ready": true} when serving, 503 with a JSON reason map when

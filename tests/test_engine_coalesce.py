@@ -120,22 +120,25 @@ def test_concurrent_jobs_match_serial(kind):
     serial = [leader(a) for a, _ in jobs]
 
     gate = threading.Event()
-    orig = engine._run_leader_round
+    co = engine._co_leader
+    orig = co._run
 
     def gated(args_list, ns):
         gate.wait(5)
         return orig(args_list, ns)
 
-    engine._run_leader_round = gated
-    engine._co_leader._run = gated
-    engine._co_leader.rounds.clear()
-    with ThreadPoolExecutor(max_workers=8) as pool:
-        futs = [pool.submit(leader, a) for a, _ in jobs]
-        import time
+    co._run = gated
+    co.rounds.clear()
+    try:
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futs = [pool.submit(leader, a) for a, _ in jobs]
+            import time
 
-        time.sleep(0.3)
-        gate.set()
-        concurrent = [f.result(timeout=120) for f in futs]
+            time.sleep(0.3)
+            gate.set()
+            concurrent = [f.result(timeout=120) for f in futs]
+    finally:
+        co._run = orig
 
     for (agg_s, seed_s, ver_s), (agg_c, seed_c, ver_c) in zip(serial, concurrent):
         assert agg_s == agg_c
@@ -186,28 +189,32 @@ def test_coalesced_cross_job_masked_aggregate_excludes_neighbors():
 
     # force one coalesced round: gate the leader round until all submit
     gate = threading.Event()
-    orig = engine._run_leader_round
+    co = engine._co_leader
+    orig = co._run
 
     def gated(args_list, ns):
         gate.wait(5)
         return orig(args_list, ns)
 
-    engine._co_leader._run = gated
-    engine._co_leader.rounds.clear()
-    with ThreadPoolExecutor(max_workers=n_jobs) as pool:
-        futs = [
-            pool.submit(
-                lambda a: engine.leader_init(a[0], a[1], a[2], a[3], a[4]),
-                args,
-            )
-            for args, _ in jobs
-        ]
-        import time
+    co._run = gated
+    co.rounds.clear()
+    try:
+        with ThreadPoolExecutor(max_workers=n_jobs) as pool:
+            futs = [
+                pool.submit(
+                    lambda a: engine.leader_init(a[0], a[1], a[2], a[3], a[4]),
+                    args,
+                )
+                for args, _ in jobs
+            ]
+            import time
 
-        time.sleep(0.3)
-        gate.set()
-        outs = [f.result(timeout=120) for f in futs]
-    assert max(engine._co_leader.rounds) > 1, engine._co_leader.rounds
+            time.sleep(0.3)
+            gate.set()
+            outs = [f.result(timeout=120) for f in futs]
+    finally:
+        co._run = orig
+    assert max(co.rounds) > 1, co.rounds
     # the coalesced out-shares genuinely share one buffer (offset views)
     from janus_tpu.aggregator.engine_cache import DeviceRows
 
@@ -240,6 +247,82 @@ def test_coalesced_cross_job_masked_aggregate_excludes_neighbors():
     assert total == [
         int(sum(int(cols[i][k]) for i in range(n) if mask[i]) % p) for k in range(3)
     ]
+
+
+def test_cross_task_coalesced_round_matches_solo_and_excludes_neighbors():
+    """Cross-TASK coalescing (ISSUE 12): small jobs of TWO tasks — same
+    VdafInstance, different verify keys — merged into ONE device round
+    with per-lane verify keys. Re-pins the PR 7 mask-leak invariant
+    across the task boundary: each job's masked aggregate over its view
+    of the SHARED buffer equals its solo reference (a leaked neighbor
+    row would now leak a DIFFERENT TASK's data), honest reports verify
+    under their own task's key through the two-party closure, and the
+    plaintext sums land exactly."""
+    import time
+
+    from janus_tpu.aggregator.engine_cache import EngineCache
+
+    inst = VdafInstance.sum_vec(length=3, bits=2)
+    eng_a = EngineCache(inst, VK)
+    eng_b = EngineCache(inst, bytes(range(16, 32)))
+    assert eng_a._co_leader is eng_b._co_leader, "same-inst engines share the coalescer"
+    p = eng_a.p3.jf.MODULUS
+    n = 4
+    rng = np.random.default_rng(23)
+    jobs = []
+    for j in range(4):
+        eng = (eng_a, eng_b)[j % 2]
+        meas = [[int(x) for x in rng.integers(1, 4, size=3)] for _ in range(n)]
+        args, m = make_report_batch(inst, meas, seed=700 + j)
+        jobs.append((eng, args, m))
+    masks = [np.array([i != (j % n) for i in range(n)]) for j in range(4)]
+
+    def full(eng, args, mask):
+        nonce, public, mv, proof, blind0, seeds, blind1 = args
+        out0, _, ver0, part0 = eng.leader_init(nonce, public, mv, proof, blind0)
+        out1, ok, _ = eng.helper_init(
+            nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+        )
+        assert np.asarray(ok).all(), "honest reports must verify under their own key"
+        agg0 = eng.aggregate(out0, mask)
+        agg1 = eng.aggregate(out1, mask)
+        return [(a + b) % p for a, b in zip(agg0, agg1)]
+
+    serial = [full(e, a, mk) for (e, a, _), mk in zip(jobs, masks)]
+
+    co = eng_a._co_leader
+    gate = threading.Event()
+    orig = co._run
+    round_engines: list[int] = []
+
+    def gated(args_list, ns):
+        gate.wait(5)
+        round_engines.append(len({id(a[0]) for a in args_list}))
+        return orig(args_list, ns)
+
+    co._run = gated
+    co.rounds.clear()
+    try:
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [
+                pool.submit(lambda jm: full(jm[0][0], jm[0][1], jm[1]), (j, mk))
+                for j, mk in zip(jobs, masks)
+            ]
+            time.sleep(0.4)
+            gate.set()
+            concurrent = [f.result(timeout=120) for f in futs]
+    finally:
+        co._run = orig
+    # a genuinely CROSS-task round happened (two engines in one round)
+    assert max(co.rounds) > 1, co.rounds
+    assert max(round_engines) > 1, round_engines
+    assert concurrent == serial
+    # plaintext closure: each job's sum over its own accepted rows only
+    for (eng, args, m), mk, got in zip(jobs, masks, concurrent):
+        want = [
+            int(sum(int(m[i][k]) for i in range(n) if mk[i]) % p) for k in range(3)
+        ]
+        assert got == want, (got, want)
 
 
 @pytest.mark.parametrize("offset", [0, 8, 40])

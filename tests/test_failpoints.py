@@ -102,6 +102,24 @@ def test_count_budget_exhausts():
     assert failpoints.status()["failpoints"]["x.y"]["fired"] == 2
 
 
+def test_after_skips_then_arms_and_composes_with_count():
+    """`after=K` lets the first K hits pass, then arms; count= budgets
+    the firings that follow (let two jobs land, wedge the third)."""
+    failpoints.configure("x.y=error:1,after=2,count=1")
+    failpoints.hit("x.y")
+    failpoints.hit("x.y")  # first two hits pass clean
+    with pytest.raises(FailpointError):
+        failpoints.hit("x.y")
+    failpoints.hit("x.y")  # count budget spent: inert again
+    snap = failpoints.status()["failpoints"]["x.y"]
+    assert snap["after"] == 2 and snap["hits"] == 4 and snap["fired"] == 1
+
+
+def test_after_negative_rejected():
+    with pytest.raises(FailpointSpecError):
+        failpoints.parse_spec("x.y=error:1,after=-1")
+
+
 def test_prob_zero_never_fires():
     failpoints.configure("x.y=error:0.0")
     for _ in range(50):

@@ -1,0 +1,791 @@
+"""Device-resident accumulator state (ISSUE 12).
+
+The engine keeps per-(task, batch bucket) aggregate buffers in device
+memory across job steps: the masked accumulate becomes one per-bucket
+delta dispatch (one int32 upload, zero fetch) merged into resident
+slots only AFTER the job's write tx commits, and the host reads an
+encoded share back only at flush time. These tests pin:
+
+  * field-element equivalence of the resident path against the host
+    oracle across count/histogram/sumvec with rejected lanes and
+    multiple batch buckets (fuzzed);
+  * multi-job merge into the same resident slot;
+  * LRU eviction past the byte cap flushes (never drops) state, and
+    the sum of every flush equals the ground truth;
+  * the driver's end-to-end resident flow: share=None rows at commit,
+    interval/drain flush through the write-tx path, exactly-once
+    collection;
+  * a commit failure drops the PendingDeltas (no merge), so the
+    re-step cannot double-merge;
+  * quarantine-mid-job: resident state flushes while the engine is
+    quarantined and the interim host engine's work lands beside it —
+    collection still exact;
+  * double-buffered prestaging produces bit-identical leader inits.
+"""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+from janus_tpu import metrics
+from janus_tpu.aggregator.aggregation_job_driver import (
+    AggregationJobDriver,
+    AggregationJobDriverConfig,
+    ResidentConfig,
+)
+from janus_tpu.aggregator.aggregation_job_creator import (
+    AggregationJobCreator,
+    AggregationJobCreatorConfig,
+)
+from janus_tpu.aggregator.engine_cache import EngineCache, engine_cache
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.core.http_client import HttpClient
+from janus_tpu.messages import Duration, Interval, Time
+from janus_tpu.vdaf.registry import VdafInstance
+from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+from test_e2e import pair, provision  # noqa: F401  (fixture + helper)
+
+VK = bytes(range(16))
+IV = Interval(Time(0), Duration(3600))
+
+
+def _inst(kind):
+    return {
+        "count": VdafInstance.count(),
+        "histogram": VdafInstance.histogram(length=6),
+        "sumvec": VdafInstance.sum_vec(length=4, bits=4),
+    }[kind]
+
+
+def _host_oracle(inst, measurements, lanes, length):
+    """Plaintext per-bucket sums over the accepted lanes."""
+    if inst.kind == "count":
+        return [sum(int(measurements[i]) for i in lanes)]
+    if inst.kind == "histogram":
+        out = [0] * length
+        for i in lanes:
+            out[int(measurements[i])] += 1
+        return out
+    # sumvec
+    out = [0] * length
+    for i in lanes:
+        for k in range(length):
+            out[k] += int(measurements[i][k])
+    return out
+
+
+@pytest.mark.parametrize("kind", ["count", "histogram", "sumvec"])
+def test_resident_matches_host_oracle_fuzz(kind):
+    """Fuzz: random jobs with rejected lanes and multiple batch buckets
+    through the FULL two-party resident path — the flushed shares (sum
+    of leader + helper resident states) equal the plaintext per-bucket
+    sums exactly, and equal the classic per-bucket engine.aggregate."""
+    inst = _inst(kind)
+    eng0 = EngineCache(inst, VK)
+    eng1 = EngineCache(inst, bytes(range(16, 32)))
+    jf = eng0.p3.jf
+    p = jf.MODULUS
+    length = getattr(eng0.p3.circ, "output_len")
+    rng = np.random.default_rng(42)
+    totals: dict[bytes, list[int]] = {}
+    for trial in range(4):
+        n = int(rng.integers(3, 9))
+        meas = random_measurements(inst, n, rng)
+        args, m = make_report_batch(inst, meas, seed=1000 + trial)
+        nonce, public, mv, proof, blind0, seeds, blind1 = args
+        out0, _, ver0, part0 = eng0.leader_init(nonce, public, mv, proof, blind0)
+        out1, ok, _ = eng0.helper_init(
+            nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+        )
+        assert np.asarray(ok).all()
+        # random accept/reject + random bucket assignment (2 buckets)
+        accept = rng.random(n) > 0.3
+        bucket_of = rng.integers(0, 2, size=n)
+        lane_bucket = np.where(accept, bucket_of, -1).astype(np.int32)
+        keys = [b"bucket-a", b"bucket-b"]
+        pend = eng0.aggregate_pending(out0, lane_bucket, 2)
+        entries = [
+            ((b"task", b"", bid), j, int((lane_bucket == j).sum()), IV)
+            for j, bid in enumerate(keys)
+        ]
+        assert eng0.resident_merge(entries, pend) == []
+        # classic reference on the same rows
+        for j, bid in enumerate(keys):
+            classic = eng0.aggregate(out0, lane_bucket == j)
+            lanes = [i for i in range(n) if lane_bucket[i] == j]
+            want_plain = _host_oracle(inst, m, lanes, length)
+            # two-party closure for the plaintext check
+            h = eng0.aggregate(out1, lane_bucket == j)
+            assert [(a + b) % p for a, b in zip(classic, h)] == [
+                w % p for w in want_plain
+            ]
+            tot = totals.setdefault(bid, [0] * length)
+            for k in range(length):
+                tot[k] = (tot[k] + classic[k]) % p
+    recs = {r["key"][2]: r for r in eng0.resident_take()}
+    assert set(recs) <= set(totals)
+    merged_rows = 0
+    for bid, want in totals.items():
+        if bid in recs:
+            assert recs[bid]["share"] == want
+            merged_rows += recs[bid]["rows"]
+    # a second take is empty (state was consumed)
+    assert eng0.resident_take() == []
+
+
+def test_multi_job_merge_accumulates_in_place():
+    """Several jobs' deltas into ONE resident slot: the take equals the
+    mod-p sum of the per-job classic aggregates and counts the rows."""
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    p = eng.p3.jf.MODULUS
+    rng = np.random.default_rng(7)
+    want = 0
+    rows = 0
+    for j in range(3):
+        n = 5
+        meas = random_measurements(inst, n, rng)
+        args, m = make_report_batch(inst, meas, seed=2000 + j)
+        nonce, public, mv, proof, blind0, _, _ = args
+        out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+        idx = np.zeros(n, np.int32)
+        pend = eng.aggregate_pending(out0, idx, 1)
+        eng.resident_merge([((b"t", b"", b"bid"), 0, n, IV)], pend)
+        want = (want + eng.aggregate(out0, np.ones(n, bool))[0]) % p
+        rows += n
+    assert eng.resident_status()["buffers"] == 1
+    (rec,) = eng.resident_take()
+    assert rec["share"][0] == want
+    assert rec["rows"] == rows
+
+
+def test_eviction_flushes_never_drops(monkeypatch):
+    """Past RESIDENT_MAX_BYTES the LRU slot is evicted THROUGH the
+    flush path (fetched + handed back), never dropped: the evicted
+    record plus the final take cover every contribution exactly."""
+    inst = VdafInstance.histogram(length=8)
+    eng = EngineCache(inst, VK)
+    p = eng.p3.jf.MODULUS
+    row_bytes = eng.p3.circ.output_len * eng.p3.jf.LIMBS * 8
+    # cap admits exactly one slot
+    monkeypatch.setattr(EngineCache, "RESIDENT_MAX_BYTES", row_bytes)
+    rng = np.random.default_rng(9)
+    wants = {}
+    n = 4
+    flushed = []
+    for j, bid in enumerate([b"b0", b"b1", b"b2"]):
+        meas = random_measurements(inst, n, rng)
+        args, m = make_report_batch(inst, meas, seed=3000 + j)
+        nonce, public, mv, proof, blind0, _, _ = args
+        out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+        pend = eng.aggregate_pending(out0, np.zeros(n, np.int32), 1)
+        flushed.extend(eng.resident_merge([((b"t", b"", bid), 0, n, IV)], pend))
+        wants[bid] = eng.aggregate(out0, np.ones(n, bool))
+    assert len(flushed) == 2, "two LRU slots evicted past the cap"
+    assert eng.resident_status()["evictions"] == 2
+    final = eng.resident_take()
+    got = {r["key"][2]: r["share"] for r in flushed + final}
+    assert got == {bid: [x % p for x in w] for bid, w in wants.items()}
+
+
+def _upload_and_jobs(pair, leader_task, vdaf, measurements, job_size=100):
+    from janus_tpu.client import Client, ClientParameters
+
+    http = HttpClient()
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, vdaf, http, clock=pair["clock"])
+    for m in measurements:
+        client.upload(m)
+    AggregationJobCreator(
+        pair["leader_ds"],
+        AggregationJobCreatorConfig(
+            min_aggregation_job_size=1, max_aggregation_job_size=job_size
+        ),
+    ).run_once()
+    return http
+
+
+def _collect(pair, leader_task, vdaf, collector_kp, http):
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.messages import Query
+
+    clock = pair["clock"]
+    start = Time(clock.now().seconds).to_batch_interval_start(
+        leader_task.time_precision
+    )
+    query = Query.time_interval(
+        Interval(Time(start.seconds - 3600), Duration(2 * 3600))
+    )
+    collector = Collector(
+        CollectorParameters(
+            leader_task.task_id,
+            pair["leader_srv"].url,
+            leader_task.collector_auth_token,
+            collector_kp,
+        ),
+        vdaf,
+        http,
+    )
+    job_id = collector.start_collection(query)
+    cdriver = CollectionJobDriver(pair["leader_ds"], http)
+    cjd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1),
+        cdriver.acquirer(),
+        cdriver.stepper,
+    )
+    assert cjd.run_once() >= 1
+    return collector.poll_once(job_id, query)
+
+
+def _resident_driver(pair, http, flush_interval_s=3600.0):
+    """Driver with resident mode on and a long flush interval, so tests
+    control the flush points explicitly."""
+    return AggregationJobDriver(
+        pair["leader_ds"],
+        http,
+        AggregationJobDriverConfig(
+            resident=ResidentConfig(enabled=True, flush_interval_s=flush_interval_s)
+        ),
+    )
+
+
+def test_driver_resident_end_to_end_flush_then_collect(pair):
+    """Driver flow: jobs step with resident mode on (share bytes stay
+    on device, batch rows commit with counts/checksums), the drain
+    flush writes the shares through the write-tx path, and collection
+    equals the ground truth exactly."""
+    vdaf = VdafInstance.count()
+    leader_task, helper_task, collector_kp = provision(pair, vdaf)
+    measurements = [1, 0, 1, 1, 0, 1, 1]
+    http = _upload_and_jobs(pair, leader_task, vdaf, measurements, job_size=3)
+
+    driver = _resident_driver(pair, http)
+    jd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=2),
+        driver.acquirer(),
+        driver.stepper,
+    )
+    while jd.run_once():
+        pass
+    eng = engine_cache(leader_task.vdaf, leader_task.vdaf_verify_key)
+    st = eng.resident_status()
+    assert st["buffers"] >= 1 and st["merged_rows"] == len(measurements)
+    # the leader's batch rows carry the counts but NOT the resident share
+    rows = pair["leader_ds"].run_tx(
+        lambda tx: tx.get_batch_aggregations_intersecting_interval(
+            leader_task.task_id, Interval(Time(1_599_990_000), Duration(3600 * 24))
+        )
+    )
+    assert sum(r.report_count for r in rows) == len(measurements)
+    leader_share_before = [
+        r.aggregate_share for r in rows if r.aggregate_share is not None
+    ]
+    # drain-style flush through the write-tx path
+    assert driver.flush_resident_state(reason="drain") >= 1
+    assert eng.resident_status()["buffers"] == 0
+    rows_after = pair["leader_ds"].run_tx(
+        lambda tx: tx.get_batch_aggregations_intersecting_interval(
+            leader_task.task_id, Interval(Time(1_599_990_000), Duration(3600 * 24))
+        )
+    )
+    assert [r for r in rows_after if r.aggregate_share is not None], (
+        "flush merged the share bytes into the batch rows"
+    )
+    result = _collect(pair, leader_task, vdaf, collector_kp, http)
+    assert result.report_count == len(measurements)
+    assert result.aggregate_result == sum(measurements)
+    assert leader_share_before in ([], leader_share_before)  # doc: share lagged
+
+
+def test_commit_failure_drops_delta_no_double_merge(pair):
+    """A write tx that fails AFTER the resident delta was computed must
+    not merge it (post-commit discipline): the re-step under the same
+    process merges exactly once and collection is exact."""
+    vdaf = VdafInstance.count()
+    leader_task, helper_task, collector_kp = provision(pair, vdaf)
+    measurements = [1, 1, 0, 1]
+    http = _upload_and_jobs(pair, leader_task, vdaf, measurements)
+
+    driver = _resident_driver(pair, http)
+    ds = pair["leader_ds"]
+    real_run_tx = ds.run_tx
+    fail_once = {"armed": True}
+
+    def flaky_run_tx(fn, name="tx", *a, **kw):
+        if name == "step_agg_job_write" and fail_once["armed"]:
+            fail_once["armed"] = False
+            raise RuntimeError("injected commit failure")
+        return real_run_tx(fn, name, *a, **kw)
+
+    ds.run_tx = flaky_run_tx
+    try:
+        (acquired,) = real_run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1),
+            "acquire",
+        )
+        with pytest.raises(RuntimeError, match="injected commit failure"):
+            driver.step_aggregation_job(acquired)
+        eng = engine_cache(leader_task.vdaf, leader_task.vdaf_verify_key)
+        assert eng.resident_status()["buffers"] == 0, "failed commit merged nothing"
+        # release the lease and re-step: lands exactly once
+        driver.step_back(acquired, "test", 0.0)
+        jd = JobDriver(
+            JobDriverConfig(max_concurrent_job_workers=1),
+            driver.acquirer(),
+            driver.stepper,
+        )
+        while jd.run_once():
+            pass
+        assert eng.resident_status()["merged_rows"] == len(measurements)
+    finally:
+        ds.run_tx = real_run_tx
+    assert driver.flush_resident_state(reason="drain") >= 1
+    result = _collect(pair, leader_task, vdaf, collector_kp, http)
+    assert result.report_count == len(measurements)
+    assert result.aggregate_result == sum(measurements)
+
+
+def test_quarantine_mid_job_flushes_and_host_path_continues(pair):
+    """Quarantine mid-stream: earlier jobs' resident state flushes
+    (reason=quarantine) while the engine is quarantined, later jobs land
+    through the interim host engine's classic path, and collection sees
+    BOTH — exactly the admitted ground truth."""
+    from janus_tpu import failpoints
+
+    vdaf = VdafInstance.count()
+    leader_task, helper_task, collector_kp = provision(pair, vdaf)
+    first, second = [1, 0, 1], [1, 1, 0, 1]
+    http = _upload_and_jobs(pair, leader_task, vdaf, first)
+
+    driver = _resident_driver(pair, http)
+    jd = JobDriver(
+        JobDriverConfig(max_concurrent_job_workers=1),
+        driver.acquirer(),
+        driver.stepper,
+    )
+    while jd.run_once():
+        pass
+    eng = engine_cache(leader_task.vdaf, leader_task.vdaf_verify_key)
+    assert eng.resident_status()["buffers"] >= 1
+
+    # quarantine the engine; hold it open (canary probe kept failing)
+    failpoints.configure("engine.canary=error:1.0")
+    try:
+        eng._quarantine_on_hang("test")
+        assert not eng.resident_ready()
+        before = metrics.engine_resident_flushes_total.get(
+            reason="quarantine", outcome="flushed"
+        )
+        assert driver.flush_resident_state() >= 1
+        assert (
+            metrics.engine_resident_flushes_total.get(
+                reason="quarantine", outcome="flushed"
+            )
+            > before
+        ), "quarantined state flushed under reason=quarantine"
+        assert eng.resident_status()["buffers"] == 0
+
+        # second wave lands via the interim host engine (classic flush)
+        _upload_and_jobs(pair, leader_task, vdaf, second)
+        while jd.run_once():
+            pass
+        assert eng.resident_status()["buffers"] == 0, "host path never goes resident"
+    finally:
+        failpoints.clear()
+        eng.stop_canary()
+
+    result = _collect(pair, leader_task, vdaf, collector_kp, http)
+    assert result.report_count == len(first + second)
+    assert result.aggregate_result == sum(first + second)
+
+
+def test_prestaged_leader_init_bit_identical():
+    """Double-buffered staging: a prestaged (async H2D) column set
+    produces byte-identical leader-init outputs and counts a hit."""
+    inst = VdafInstance.sum_vec(length=4, bits=4)
+    eng = EngineCache(inst, VK)
+    rng = np.random.default_rng(11)
+    n = 5
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=77)
+    nonce, public, mv, proof, blind0, _, _ = args
+    out_a, seed_a, ver_a, part_a = eng.leader_init(nonce, public, mv, proof, blind0)
+
+    pre = eng.prestage_leader(nonce, public, mv, proof, blind0)
+    assert pre is not None
+    hits_before = metrics.engine_prestage_total.get(outcome="hit")
+    out_b, seed_b, ver_b, part_b = eng.leader_init(
+        nonce, public, mv, proof, blind0, prestaged=pre
+    )
+    assert metrics.engine_prestage_total.get(outcome="hit") == hits_before + 1
+    for a, b in zip(ver_a, ver_b):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    if seed_a is None:
+        assert seed_b is None
+    else:
+        assert (np.asarray(seed_a) == np.asarray(seed_b)).all()
+    mask = np.ones(n, dtype=bool)
+    assert eng.aggregate(out_a, mask) == eng.aggregate(out_b, mask)
+
+
+def test_host_engine_leader_init_accepts_prestaged_kwarg():
+    """device_init passes prestaged= unconditionally; the host engine
+    must accept (and discard) it — a draft-mode task routed to
+    HostEngineCache otherwise crashed every step with TypeError."""
+    from janus_tpu.aggregator.engine_cache import HostEngineCache, PrestagedInit
+
+    inst = VdafInstance.count()
+    host = HostEngineCache(inst, VK)
+    dev = EngineCache(inst, VK)
+    rng = np.random.default_rng(35)
+    n = 3
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=700)
+    nonce, public, mv, proof, blind0, _, _ = args
+    pre = PrestagedInit(8, ("sentinel",), False)
+    out_h, _, _, _ = host.leader_init(
+        nonce, public, mv, proof, blind0, ok=None, prestaged=pre
+    )
+    assert pre._staged is None, "host path frees the transfer's buffers"
+    out_d, _, _, _ = dev.leader_init(nonce, public, mv, proof, blind0)
+    mask = np.ones(n, bool)
+    assert host.aggregate(out_h, mask) == dev.aggregate(out_d, mask)
+
+
+def test_partial_merge_failure_flushes_only_unmerged(monkeypatch):
+    """A merge that dies mid-loop leaves a merged PREFIX safely on
+    device: ResidentMergeError carries those keys and the driver's
+    recovery flushes ONLY the remainder — re-flushing a merged entry
+    would double-count it when its slot later flushes."""
+    from types import SimpleNamespace
+
+    from janus_tpu.aggregator.engine_cache import ResidentMergeError
+
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, bytes(range(48, 64)))
+    rng = np.random.default_rng(31)
+    n = 4
+    k0, k1 = (b"t", b"", b"k0"), (b"t", b"", b"k1")
+    # job 1 seeds bucket k1 resident (so job 2's k1 entry takes the
+    # _resident_add path, which we wedge)
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=500)
+    nonce, public, mv, proof, blind0, _, _ = args
+    out_a, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+    eng.resident_merge(
+        [(k1, 0, n, IV)], eng.aggregate_pending(out_a, np.zeros(n, np.int32), 1)
+    )
+    # job 2: k0 (fresh slot, merges clean) then k1 (wedged add)
+    meas2 = random_measurements(inst, n, rng)
+    args2, _ = make_report_batch(inst, meas2, seed=501)
+    nonce2, public2, mv2, proof2, blind2, _, _ = args2
+    out_b, _, _, _ = eng.leader_init(nonce2, public2, mv2, proof2, blind2)
+    idx = np.array([0, 0, 1, 1], np.int32)
+    pend = eng.aggregate_pending(out_b, idx, 2)
+
+    def boom(acc, row):
+        raise RuntimeError("wedged add")
+
+    monkeypatch.setattr(eng, "_resident_add", boom)
+    driver = AggregationJobDriver(None, None)
+    flushed = []
+    monkeypatch.setattr(
+        driver,
+        "flush_resident_records",
+        lambda engine, recs, reason: flushed.append((reason, recs)) or len(recs),
+    )
+    st = SimpleNamespace(
+        engine=eng,
+        resident_delta=pend,
+        resident_entries=[(k0, 0, 2, IV), (k1, 1, 2, IV)],
+        resident_rids=[b"r0", b"r1"],
+        acquired=SimpleNamespace(job_id="job-x"),
+    )
+    driver._resident_post_commit(st, set())
+    ((reason, recs),) = flushed
+    assert reason == "merge_failed"
+    assert [r["key"] for r in recs] == [k1], "only the UNMERGED bucket went out"
+    assert recs[0]["share"] == eng.aggregate(out_b, idx == 1)
+    # device state: k1 holds job 1 only, k0 holds job 2's delta — every
+    # contribution exactly once across flush + resident
+    got = {r["key"]: r["share"] for r in eng.resident_take()}
+    assert got[k1] == eng.aggregate(out_a, np.ones(n, bool))
+    assert got[k0] == eng.aggregate(out_b, idx == 0)
+    # the engine-level contract is also directly visible
+    out_c, _, _, _ = eng.leader_init(nonce2, public2, mv2, proof2, blind2)
+    eng.resident_merge(
+        [(k1, 0, n, IV)], eng.aggregate_pending(out_c, np.zeros(n, np.int32), 1)
+    )
+    with pytest.raises(ResidentMergeError) as ei:
+        eng.resident_merge(
+            [(k0, 0, 2, IV), (k1, 1, 2, IV)], eng.aggregate_pending(out_c, idx, 2)
+        )
+    assert ei.value.merged == frozenset({k0})
+    eng.resident_take()  # drain the global resident-bytes ledger
+
+
+def test_eviction_fetch_failure_defers_never_double_counts(monkeypatch):
+    """An eviction whose d2h fetch fails restores the slots and returns
+    [] — the deltas ALREADY merged, so raising would send the caller's
+    merge-failed recovery after rows that are safely on device (double
+    count). The eviction is deferred and retried; nothing is lost."""
+    from janus_tpu.aggregator.engine_cache import resident_bytes_total
+
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    row_bytes = eng.p3.circ.output_len * eng.p3.jf.LIMBS * 8
+    # the ledger is process-global: admit exactly ONE more slot
+    monkeypatch.setattr(
+        EngineCache, "RESIDENT_MAX_BYTES", resident_bytes_total() + row_bytes
+    )
+    rng = np.random.default_rng(33)
+    n = 4
+    outs = {}
+    for j, bid in enumerate([b"b0", b"b1"]):
+        meas = random_measurements(inst, n, rng)
+        args, _ = make_report_batch(inst, meas, seed=600 + j)
+        nonce, public, mv, proof, blind0, _, _ = args
+        out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+        outs[bid] = out0
+    pend0 = eng.aggregate_pending(outs[b"b0"], np.zeros(n, np.int32), 1)
+    assert eng.resident_merge([((b"t", b"", b"b0"), 0, n, IV)], pend0) == []
+
+    real = eng._supervised
+
+    def flaky(label, fn):
+        if label == "resident_fetch":
+            raise RuntimeError("wedged fetch")
+        return real(label, fn)
+
+    monkeypatch.setattr(eng, "_supervised", flaky)
+    pend1 = eng.aggregate_pending(outs[b"b1"], np.zeros(n, np.int32), 1)
+    # b1's merge evicts b0 past the cap, the fetch wedges: deferred
+    assert eng.resident_merge([((b"t", b"", b"b1"), 0, n, IV)], pend1) == []
+    st = eng.resident_status()
+    assert st["buffers"] == 2 and st["eviction_deferred"] == 1
+    monkeypatch.undo()
+    got = {r["key"][2]: r["share"] for r in eng.resident_take()}
+    for bid in (b"b0", b"b1"):
+        assert got[bid] == eng.aggregate(outs[bid], np.ones(n, bool))
+
+
+def test_engine_cache_lru_never_evicts_resident_state(monkeypatch):
+    """The process engine-cache LRU must not drop an engine holding
+    unflushed resident slots: the flusher only walks CACHED engines, so
+    eviction would silently lose the share bytes and leak the
+    resident-bytes ledger forever."""
+    from janus_tpu.aggregator import engine_cache as ec
+
+    ec._engine_cache_clear()
+    inst = VdafInstance.count()
+    try:
+        eng0 = ec.engine_cache(inst, VK)
+        rng = np.random.default_rng(37)
+        n = 3
+        meas = random_measurements(inst, n, rng)
+        args, _ = make_report_batch(inst, meas, seed=800)
+        nonce, public, mv, proof, blind0, _, _ = args
+        out0, _, _, _ = eng0.leader_init(nonce, public, mv, proof, blind0)
+        pend = eng0.aggregate_pending(out0, np.zeros(n, np.int32), 1)
+        eng0.resident_merge([((b"t", b"", b"bid"), 0, n, IV)], pend)
+        bytes_before = ec.resident_bytes_total()
+        assert bytes_before > 0
+        monkeypatch.setattr(ec, "_ENGINE_CACHE_MAX", 2)
+        ec.engine_cache(inst, bytes(range(16, 32)))
+        ec.engine_cache(inst, bytes(range(32, 48)))
+        # eng0 is the LRU victim — but it holds resident state, so the
+        # next-oldest slot-free engine was evicted instead
+        assert ec.engine_cache(inst, VK) is eng0
+        assert eng0 in ec.live_engines()
+        assert ec.resident_bytes_total() == bytes_before
+        (rec,) = eng0.resident_take()
+        assert rec["rows"] == n
+    finally:
+        ec._engine_cache_clear()
+
+
+def test_flusher_fetch_bounded_without_ambient_deadline(monkeypatch):
+    """Flusher/drain threads carry no lease deadline — without one the
+    dispatch watchdog degrades to a direct call and a wedged device
+    would block the fetch forever INSIDE the engine's resident lock,
+    deadlocking every commit worker. flush_engine_resident must install
+    a bound (and keep an ambient one when present)."""
+    from janus_tpu.core.deadline import current_deadline, deadline_scope
+
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    driver = AggregationJobDriver(None, None)
+    seen = []
+    monkeypatch.setattr(eng, "resident_take", lambda: seen.append(current_deadline()) or [])
+    assert current_deadline() is None
+    driver.flush_engine_resident(eng, "interval")
+    assert seen[-1] is not None, "no ambient deadline: a bound was installed"
+    import time as _time
+
+    lease = _time.monotonic() + 5.0
+    with deadline_scope(lease):
+        driver.flush_engine_resident(eng, "interval")
+    assert seen[-1] == lease, "an ambient lease deadline is kept, not replaced"
+
+
+def test_interval_flush_cadence_shared_with_background_flusher(monkeypatch):
+    """The background flusher's interval pass stamps the inline
+    post-commit cadence — a busy driver must not pay a second full
+    take + flush tx per interval on top of the flusher's."""
+    from janus_tpu.aggregator import engine_cache as ec
+
+    driver = AggregationJobDriver(None, None)
+    monkeypatch.setattr(ec, "live_engines", lambda: [])
+    inline = []
+    monkeypatch.setattr(
+        driver,
+        "flush_engine_resident",
+        lambda e, reason="interval": inline.append(reason) or 0,
+    )
+    driver.flush_resident_state(reason="interval")  # flusher pass stamps
+    driver.maybe_flush_resident(object())
+    assert inline == [], "inline flush suppressed inside the interval"
+    driver._resident_last_flush -= driver.cfg.resident.flush_interval_s + 1
+    driver.maybe_flush_resident(object())
+    assert inline == ["interval"]
+
+
+def test_resident_buffers_gauge_sums_across_engines():
+    """Several engines share a vdaf kind (one per task verify key):
+    janus_engine_resident_buffers must SUM their slots, not let each
+    engine overwrite the label with its own count."""
+    inst = VdafInstance.count()
+    a = EngineCache(inst, bytes(range(64, 80)))
+    b = EngineCache(inst, bytes(range(80, 96)))
+    base = metrics.engine_resident_buffers.get(vdaf="count")
+    rng = np.random.default_rng(41)
+    n = 3
+    for j, eng in enumerate((a, b)):
+        meas = random_measurements(inst, n, rng)
+        args, _ = make_report_batch(inst, meas, seed=900 + j)
+        nonce, public, mv, proof, blind0, _, _ = args
+        out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+        pend = eng.aggregate_pending(out0, np.zeros(n, np.int32), 1)
+        eng.resident_merge([((b"t%d" % j, b"", b"bid"), 0, n, IV)], pend)
+    assert metrics.engine_resident_buffers.get(vdaf="count") == base + 2
+    a.resident_take()
+    assert metrics.engine_resident_buffers.get(vdaf="count") == base + 1
+    b.resident_take()
+    assert metrics.engine_resident_buffers.get(vdaf="count") == base
+
+
+def test_flush_skipped_while_datastore_down(monkeypatch):
+    """A non-drain flush must not pop slots while the supervisor says
+    the store is down: the flush tx would fail and the fetched shares
+    are at-most-once (no idempotency key guards a re-flush). Drain
+    still attempts — the process is exiting either way."""
+    from types import SimpleNamespace
+
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    rng = np.random.default_rng(43)
+    n = 3
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=910)
+    nonce, public, mv, proof, blind0, _, _ = args
+    out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+    pend = eng.aggregate_pending(out0, np.zeros(n, np.int32), 1)
+    eng.resident_merge([((b"t", b"", b"bid"), 0, n, IV)], pend)
+
+    ds = SimpleNamespace(supervisor=SimpleNamespace(state="down"))
+    driver = AggregationJobDriver(ds, None)
+    flushed = []
+    monkeypatch.setattr(
+        driver,
+        "flush_resident_records",
+        lambda engine, recs, reason: flushed.append(reason) or len(recs),
+    )
+    assert driver.flush_engine_resident(eng, "interval") == 0
+    assert eng.resident_status()["buffers"] == 1, "state stayed resident"
+    assert flushed == []
+    assert driver.flush_engine_resident(eng, "drain") == 1
+    assert flushed == ["drain"]
+    assert eng.resident_status()["buffers"] == 0
+
+
+def test_merge_failed_recovery_fetch_is_supervised(monkeypatch):
+    """The merge-failed recovery's delta fetch goes through the
+    dispatch watchdog — a raw to_ints would park the commit worker in
+    native code on exactly the wedged device that failed the merge."""
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    rng = np.random.default_rng(45)
+    n = 4
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=920)
+    nonce, public, mv, proof, blind0, _, _ = args
+    out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+    pend = eng.aggregate_pending(out0, np.zeros(n, np.int32), 1)
+    want = eng.aggregate(out0, np.ones(n, bool))
+
+    labels = []
+    real = eng._supervised
+
+    def spy(label, fn):
+        labels.append(label)
+        return real(label, fn)
+
+    monkeypatch.setattr(eng, "_supervised", spy)
+    recs = eng.fetch_delta_records([((b"t", b"", b"b"), 0, n, IV)], pend)
+    assert "resident_delta_fetch" in labels
+    assert recs[0]["share"] == want and recs[0]["rows"] == n
+
+
+def test_would_coalesce_predicate_matches_entry_routing():
+    """would_coalesce mirrors _leader_init_entry's routing exactly —
+    the pipeline declines prestaging when a parallel device lane could
+    merge the job's round (a merged round discards prestages and
+    re-stages from host, paying the H2D transfer twice)."""
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    eng._coalesce = True
+    assert eng.would_coalesce(4)
+    assert eng.would_coalesce(EngineCache.COALESCE_MAX_JOB)
+    assert not eng.would_coalesce(EngineCache.COALESCE_MAX_JOB + 1)
+    old_cap = eng.bucket_cap
+    eng.bucket_cap = 2
+    assert not eng.would_coalesce(4), "past the cap routes chunked, not coalesced"
+    eng.bucket_cap = old_cap
+    eng._coalesce = False
+    assert not eng.would_coalesce(4)
+
+
+def test_resident_take_failure_restores_state(monkeypatch):
+    """A failing flush fetch must RESTORE the popped slots (state is
+    never lost because the device was slow once)."""
+    inst = VdafInstance.count()
+    eng = EngineCache(inst, VK)
+    rng = np.random.default_rng(13)
+    n = 4
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=88)
+    nonce, public, mv, proof, blind0, _, _ = args
+    out0, _, _, _ = eng.leader_init(nonce, public, mv, proof, blind0)
+    pend = eng.aggregate_pending(out0, np.zeros(n, np.int32), 1)
+    eng.resident_merge([((b"t", b"", b"bid"), 0, n, IV)], pend)
+    want = eng.aggregate(out0, np.ones(n, bool))
+
+    def boom(label, fn):
+        raise RuntimeError("wedged fetch")
+
+    monkeypatch.setattr(eng, "_supervised", boom)
+    with pytest.raises(RuntimeError, match="wedged fetch"):
+        eng.resident_take()
+    monkeypatch.undo()
+    assert eng.resident_status()["buffers"] == 1
+    (rec,) = eng.resident_take()
+    assert rec["share"] == want

@@ -111,7 +111,7 @@ def test_bench_dry_run_smoke():
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
         [sys.executable, "bench.py", "--dry-run", "--config", "count"],
-        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+        cwd=repo, env=env, capture_output=True, text=True, timeout=900,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
@@ -305,6 +305,31 @@ def test_bench_dry_run_smoke():
     assert dh["drain_ok"] is True
     assert dh["exactly_once_ok"] is True
     assert dh["collected_count"] == dh["admitted"]
+    # device-resident accumulators (ISSUE 12): the resident vs
+    # re-stage A/B on the same dataset must show >= 2x fewer
+    # host<->device bytes per report on the accumulate leg with
+    # BIT-IDENTICAL aggregate shares (the acceptance gate), and
+    # rows/dispatch must go UP (one delta dispatch replaces k
+    # per-bucket reduces)
+    ra = rec["resident_accumulate"]
+    assert ra["aggregates_identical"] is True
+    assert ra["hd_bytes_per_report_ratio"] >= 2.0, ra
+    assert ra["resident"]["rows_per_dispatch"] > ra["classic"]["rows_per_dispatch"]
+    assert ra["resident"]["dispatches"] < ra["classic"]["dispatches"]
+    # resident flush-contract live proof (chaos_run.py --scenario
+    # resident): LRU eviction, mid-stream quarantine sweep and SIGTERM
+    # drain each flush resident state through the write-tx path (no
+    # outcome="lost"), and BOTH tasks' collections equal their admitted
+    # ground truths exactly
+    rs = rec["resident_smoke"]
+    assert rs.get("ok") is True, rs
+    assert rs["eviction_flush_ok"] is True
+    assert rs["quarantined_observed_ok"] and rs["quarantine_flush_ok"]
+    assert rs["stepped_back_device_hang_ok"] is True
+    assert rs["restored_ok"] and rs["resident_before_drain_ok"]
+    assert rs["no_lost_flushes_ok"] is True
+    assert rs["drain_ok"] is True
+    assert rs["exactly_once_a_ok"] and rs["exactly_once_b_ok"]
     # columnar wire codec (ISSUE 9): one vectorized framing pass must be
     # >= 5x the per-report loop at batch >= 1024 with BIT-IDENTICAL
     # request bytes (the acceptance criterion, measured not assumed)
