@@ -39,7 +39,6 @@ import os
 import sys
 import threading
 import time
-import traceback
 
 from ..core.deadline import DeadlineExceeded, current_deadline
 
@@ -252,7 +251,12 @@ class DispatchWatchdog:
     def status(self) -> dict:
         """/statusz `device_watchdog` section: counts, host-only flag,
         and a live STACK DUMP of every parked (stalled) thread — the
-        first thing an operator wants when a dispatch wedges."""
+        first thing an operator wants when a dispatch wedges. The dump
+        uses the continuous profiler's shared frame formatter
+        (profiler.format_stack), so this rendering and the
+        /debug/profile folded stacks cannot diverge."""
+        from ..profiler import format_stack
+
         with self._lock:
             stalled = {ident: dict(info) for ident, info in self._stalled.items()}
             host_only = self._host_only
@@ -269,9 +273,7 @@ class DispatchWatchdog:
             }
             frame = frames.get(ident)
             if frame is not None:
-                ent["stack"] = [
-                    line.rstrip() for line in traceback.format_stack(frame, limit=12)
-                ]
+                ent["stack"] = format_stack(frame, limit=12, lineno=True)
             out_stalled.append(ent)
         return {
             "abandoned_threads": len(stalled),

@@ -800,6 +800,13 @@ class EngineCache:
         # dispatch per (op, bucket) is the compile; OOM events feed the
         # /statusz engine-cache section
         self._dispatched_buckets: set[tuple[str, int]] = set()
+        # finer first-dispatch tracking for the device cost ledger:
+        # keyed by the jit specialization (variant name + bucket) the
+        # call site reports, so a classic-aggregate compile after the
+        # resident path warmed the same row bucket — or a new
+        # agg_buckets_{kk} program at an already-seen bucket — still
+        # books as phase="compile" in ITS ledger row
+        self._ledger_dispatched: set[tuple] = set()
         self._dispatch_track_lock = threading.Lock()
         self.oom_history: deque = deque(maxlen=16)
         self._publish_state()
@@ -832,7 +839,15 @@ class EngineCache:
             engine_backend_state.set(1.0 if s == state else 0.0, vdaf=self.inst.kind, state=s)
         engine_bucket_cap.set(float(self.bucket_cap or 0), vdaf=self.inst.kind)
 
-    def _record_dispatch(self, op: str, n: int, b: int, elapsed_s: float) -> None:
+    def _record_dispatch(
+        self,
+        op: str,
+        n: int,
+        b: int,
+        elapsed_s: float,
+        ledger_op: str | None = None,
+        compile_key: tuple | None = None,
+    ) -> None:
         """Per-dispatch accounting: throughput counters, padding-waste
         gauge, and the first-call-per-(op, bucket) compile histogram —
         jax.jit compiles synchronously on the first call of a shape
@@ -844,12 +859,37 @@ class EngineCache:
         metrics.engine_rows_total.add(n, op=op)
         if b > 0:
             metrics.engine_batch_fill_ratio.set(n / b, op=op)
+        lkey = compile_key if compile_key is not None else (ledger_op or op, b)
         with self._dispatch_track_lock:
             first = (op, b) not in self._dispatched_buckets
             if first:
                 self._dispatched_buckets.add((op, b))
+            ledger_first = lkey not in self._ledger_dispatched
+            if ledger_first:
+                self._ledger_dispatched.add(lkey)
         if first:
             metrics.engine_compile_seconds.observe(elapsed_s, op=op, bucket=str(b))
+        # per-dispatch device cost ledger (ISSUE 13): the first call of
+        # a jit specialization IS the trace+compile, later calls are
+        # execute; rows ride along so the µs/report attribution has a
+        # denominator. `ledger_op` splits ledger rows finer than the
+        # engine counters (the resident aggregate_pending path shares
+        # op="aggregate" in janus_engine_dispatches_total but one
+        # dispatch covers k buckets) and `compile_key` carries the
+        # variant name the call site jitted, so compile-vs-execute
+        # classification tracks the real specialization, not the
+        # engine-metric (op, bucket) approximation.
+        from ..profiler import DEVICE_COST
+
+        DEVICE_COST.record(
+            self.inst.kind,
+            ledger_op or op,
+            b,
+            "compile" if ledger_first else "execute",
+            elapsed_s,
+            rows=n,
+            dispatches=1,
+        )
 
     # Per-call row cap for joining a shared round; absolute round row
     # cap; and the rows x input_len budget one coalesced round may
@@ -1049,19 +1089,40 @@ class EngineCache:
     QUARANTINE_CANARY_TIMEOUT_SECS = float(os.environ.get("JANUS_CANARY_TIMEOUT_S", "30.0"))
     QUARANTINE_CANARY_MAX_DELAY_SECS = 60.0
 
+    # Supervised regions whose wall time the device cost ledger
+    # attributes as a whole (no finer-grained span/dispatch accounting
+    # inside them): the resident fetches are pure d2h waits. The init/
+    # aggregate labels are deliberately absent — their phases are split
+    # inside the closure (_record_dispatch + the put/fetch span hooks).
+    _LEDGER_SUPERVISED_PHASES = {
+        "fetch_resident": "d2h",
+        "resident_fetch": "d2h",
+        "resident_delta_fetch": "d2h",
+    }
+
     def _supervised(self, label: str, fn):
         """Route a device-touching closure through the process dispatch
         watchdog under the AMBIENT deadline (job drivers: lease bound;
         helper handlers: propagated request budget — core/deadline.py).
         No ambient deadline = direct call: one contextvar read, the
         bench --dry-run `watchdog_overhead` record keeps it honest."""
-        return device_watchdog.WATCHDOG.run(
-            fn,
-            deadline=current_deadline(),
-            label=label,
-            vdaf=self.inst.kind,
-            on_hang=self._quarantine_on_hang,
-        )
+        phase = self._LEDGER_SUPERVISED_PHASES.get(label)
+        t0 = time.monotonic() if phase is not None else 0.0
+        try:
+            return device_watchdog.WATCHDOG.run(
+                fn,
+                deadline=current_deadline(),
+                label=label,
+                vdaf=self.inst.kind,
+                on_hang=self._quarantine_on_hang,
+            )
+        finally:
+            if phase is not None:
+                from ..profiler import DEVICE_COST
+
+                DEVICE_COST.record(
+                    self.inst.kind, label, 0, phase, time.monotonic() - t0
+                )
 
     def _quarantine_on_hang(self, label: str) -> None:
         """Watchdog hang hook: open the device circuit. Serving moves
@@ -1339,13 +1400,16 @@ class EngineCache:
                 bucket=b,
                 coalesced=coalesced,
             ):
-                with span("engine.helper_init.put", vdaf=self.inst.kind):
+                with span("engine.helper_init.put", vdaf=self.inst.kind, bucket=b):
                     staged = put_args(args, block=True, shardings=shardings)
                 t_disp = time.monotonic()
                 with span("engine.helper_init.dispatch", vdaf=self.inst.kind):
                     out1, mask, prep_msg = fn(*staged)
-                self._record_dispatch("helper_init", n, b, time.monotonic() - t_disp)
-                with span("engine.helper_init.fetch", vdaf=self.inst.kind):
+                self._record_dispatch(
+                    "helper_init", n, b, time.monotonic() - t_disp,
+                    compile_key=(name, b),
+                )
+                with span("engine.helper_init.fetch", vdaf=self.inst.kind, bucket=b):
                     mask = np.asarray(mask)[:n]
                     prep_msg = np.asarray(prep_msg)[:n]
                     count_d2h((mask, prep_msg))
@@ -1500,7 +1564,7 @@ class EngineCache:
                 coalesced=coalesced,
                 prestaged=bool(use_prestaged),
             ):
-                with span("engine.leader_init.put", vdaf=self.inst.kind):
+                with span("engine.leader_init.put", vdaf=self.inst.kind, bucket=b):
                     if use_prestaged:
                         staged = prestaged.take()  # transfers already in flight
                         jax.block_until_ready(staged)
@@ -1509,12 +1573,15 @@ class EngineCache:
                 t_disp = time.monotonic()
                 with span("engine.leader_init.dispatch", vdaf=self.inst.kind):
                     out0, seed0, ver0, part0 = fn(*staged)
-                self._record_dispatch("leader_init", n, b, time.monotonic() - t_disp)
-                with span("engine.leader_init.fetch_seed", vdaf=self.inst.kind):
+                self._record_dispatch(
+                    "leader_init", n, b, time.monotonic() - t_disp,
+                    compile_key=(name, b),
+                )
+                with span("engine.leader_init.fetch_seed", vdaf=self.inst.kind, bucket=b):
                     seed0 = np.asarray(seed0)[:n] if seed0 is not None else None
-                with span("engine.leader_init.fetch_ver", vdaf=self.inst.kind):
+                with span("engine.leader_init.fetch_ver", vdaf=self.inst.kind, bucket=b):
                     ver0 = tuple(np.asarray(x)[:n] for x in ver0)
-                with span("engine.leader_init.fetch_part", vdaf=self.inst.kind):
+                with span("engine.leader_init.fetch_part", vdaf=self.inst.kind, bucket=b):
                     part0 = np.asarray(part0)[:n] if part0 is not None else None
                 count_d2h((seed0, ver0, part0))
             return out0, seed0, ver0, part0
@@ -1614,11 +1681,19 @@ class EngineCache:
 
         # one supervised region for the whole pipeline: every chunk's
         # block_until_ready/dispatch/fetch can park on a wedged device
+        # the dominant chunk bucket keys the cost ledger's per-bucket
+        # row for the whole pipelined pass (the tail chunk may pad to a
+        # smaller bucket; its share of the one put/fetch span can't be
+        # split out)
+        chunk_b = bucket_size(min(n, C))
+
         def device_call():
             _engine_dispatch_failpoint()
             with span("engine.leader_init", vdaf=self.inst.kind, batch=n, pipelined=len(spans_)):
                 staged = []
-                with span("engine.leader_init.put_all_async", vdaf=self.inst.kind):
+                with span(
+                    "engine.leader_init.put_all_async", vdaf=self.inst.kind, bucket=chunk_b
+                ):
                     for s, e in spans_:
                         args = pad_args(
                             bucket_size(e - s),
@@ -1639,7 +1714,7 @@ class EngineCache:
                             "leader_init", e - s, bucket_size(e - s),
                             time.monotonic() - t_disp,
                         )
-                with span("engine.leader_init.fetch", vdaf=self.inst.kind):
+                with span("engine.leader_init.fetch", vdaf=self.inst.kind, bucket=chunk_b):
                     out_chunks = [
                         DeviceRows(o[0], e - s) for (s, e), o in zip(spans_, outs)
                     ]
@@ -1755,13 +1830,15 @@ class EngineCache:
                     )
                     return p3.aggregate(v, mask)
 
-                fnv = self._jit(f"aggregate_view_{vb}", step_view)
+                jit_name = f"aggregate_view_{vb}"
+                fnv = self._jit(jit_name, step_view)
                 mask_vb = np.zeros(vb, dtype=bool)
                 mask_vb[:n] = np.asarray(mask, dtype=bool)
                 count_h2d(int(mask_vb.nbytes))
                 dispatch_b, dispatch_fixed = vb, True
                 dispatch = lambda: fnv(value, np.int32(s), mask_vb)  # noqa: E731
             else:
+                jit_name = "aggregate"
                 full = np.zeros(b, dtype=bool)
                 full[s : s + n] = np.asarray(mask, dtype=bool)
                 count_h2d(int(full.nbytes))
@@ -1785,6 +1862,7 @@ class EngineCache:
                     ]
                 return total
             b = bucket_size(n, cap)
+            jit_name = "aggregate"
             dispatch_b, dispatch_fixed = b, False
             host_args = pad_args(b, out_shares, mask)
             count_h2d(host_args)
@@ -1809,7 +1887,10 @@ class EngineCache:
                 agg = dispatch()
                 result = [int(x) for x in p3.jf.to_ints(agg)]
                 count_d2h(len(result) * p3.jf.LIMBS * 8)
-            self._record_dispatch("aggregate", n, dispatch_b, time.monotonic() - t_disp)
+            self._record_dispatch(
+                "aggregate", n, dispatch_b, time.monotonic() - t_disp,
+                compile_key=(jit_name, dispatch_b),
+            )
             return result
 
         try:
@@ -1855,7 +1936,14 @@ class EngineCache:
             t_disp = time.monotonic()
             value = self._pending_dispatch(out_shares, np.asarray(bucket_idx, np.int32), kk)
             self._record_dispatch(
-                "aggregate", n_rows, bucket_size(n_rows), time.monotonic() - t_disp
+                "aggregate",
+                n_rows,
+                bucket_size(n_rows),
+                time.monotonic() - t_disp,
+                ledger_op="aggregate_pending",
+                # the traced program specializes on the padded bucket
+                # COUNT kk (agg_buckets_{kk}), not just the row bucket
+                compile_key=("aggregate_pending", kk, bucket_size(n_rows)),
             )
             return value
 

@@ -641,7 +641,9 @@ class DapServer:
         return f"http://{host}:{port}/"
 
     def start(self) -> "DapServer":
-        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="dap-listener", daemon=True
+        )
         self._thread.start()
         return self
 

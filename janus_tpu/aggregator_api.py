@@ -311,7 +311,9 @@ class AggregatorApiServer:
                 pass
 
         self._srv = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="api-listener", daemon=True
+        )
 
     @property
     def url(self) -> str:

@@ -63,7 +63,7 @@ def run(cfg: AggregatorConfig, ds, stopper):
                     log.exception("garbage collection pass failed")
                 stopper.wait(cfg.garbage_collection_interval_s)
 
-        gc_thread = threading.Thread(target=gc_loop, daemon=True)
+        gc_thread = threading.Thread(target=gc_loop, name="gc-loop", daemon=True)
         gc_thread.start()
 
     try:

@@ -51,6 +51,9 @@ _INDEX_ENDPOINTS = (
     ("/alertz", "SLO burn-rate engine: alert state, budgets, evidence"),
     ("/debug/vars", "raw metrics-registry JSON dump"),
     ("/debug/traces", "flight recorder: recent spans, slow traces, digests"),
+    ("/debug/profile", "continuous profiler: collapsed wall-clock stacks (flamegraph.pl)"),
+    ("/debug/profile?format=json", "continuous profiler: per-role self/total shares"),
+    ("/debug/boot", "boot-phase timeline (process start to /readyz ready)"),
 )
 
 
@@ -380,6 +383,39 @@ class HealthServer:
                     self._send(
                         200, "application/json", _json.dumps(REGISTRY.snapshot()).encode()
                     )
+                elif parts.path == "/debug/profile":
+                    # always-on sampling profiler: collapsed-stack
+                    # (flamegraph.pl) folded format by default, JSON
+                    # role/frame shares with ?format=json (the POST
+                    # form of this path remains the on-demand
+                    # jax.profiler capture window)
+                    from .profiler import profile_collapsed, profile_json
+
+                    wants_json = query.get("format") == "json" or (
+                        "application/json" in (self.headers.get("Accept") or "")
+                    )
+                    if wants_json:
+                        self._send(
+                            200,
+                            "application/json",
+                            _json.dumps(profile_json(), default=str).encode(),
+                        )
+                    else:
+                        self._send(
+                            200,
+                            "text/plain; charset=utf-8",
+                            profile_collapsed().encode(),
+                        )
+                elif parts.path == "/debug/boot":
+                    # one-shot boot-phase timeline (janus_main records
+                    # the phases; sums to process-start -> ready)
+                    from .profiler import boot_snapshot
+
+                    self._send(
+                        200,
+                        "application/json",
+                        _json.dumps(boot_snapshot(), default=str).encode(),
+                    )
                 elif parts.path == "/debug/traces":
                     # always-on flight recorder: recent completed spans,
                     # captured slow traces, per-name latency digests
@@ -436,7 +472,9 @@ class HealthServer:
         # small fixed pool: scrapes and probes are cheap, and the
         # listener must never be a thread-growth vector either
         self._srv = BoundedThreadingHTTPServer((host, port), Handler, max_handler_threads=4)
-        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="health-listener", daemon=True
+        )
 
     @property
     def port(self) -> int:
@@ -558,6 +596,16 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
     )
     args = parser.parse_args(argv)
 
+    # boot-phase timeline (docs/OBSERVABILITY.md "Continuous
+    # profiling"): everything before this call — interpreter start,
+    # janus_tpu/jax imports — is the "imports" phase; each later
+    # phase_done closes the phase running since the previous mark, so
+    # the phases tile process-start -> ready exactly
+    from . import profiler as profiler_mod
+    from .profiler import BOOT
+
+    BOOT.phase_done("imports")
+
     cfg = load_config(args.config_file, config_cls)
     common: CommonConfig = cfg.common
     install_trace_subscriber(common.logging_config)
@@ -598,6 +646,7 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         EngineCache.QUARANTINE_CANARY_TIMEOUT_SECS = (
             common.quarantine_canary_timeout_secs
         )
+    BOOT.phase_done("config")
 
     if common.jax_platform:
         os.environ["JAX_PLATFORMS"] = common.jax_platform
@@ -631,6 +680,7 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         from .aggregator import engine_cache as engine_cache_mod
 
         engine_cache_mod.XTASK_COALESCE = bool(common.engine.cross_task_coalesce)
+    BOOT.phase_done("backend_init")
 
     keys = parse_datastore_keys(args.datastore_keys)
     ds = open_datastore(common.database.url, Crypter(keys), RealClock())
@@ -694,6 +744,7 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
 
     register_status_provider("process", _process_status)
     register_status_provider("tasks", _tasks_status)
+    BOOT.phase_done("datastore")
 
     if common.warmup_engines_at_boot:
         if common.warmup_buckets:
@@ -701,6 +752,7 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
             warmup_engines_background(ds, common.warmup_buckets)
         else:
             warmup_engines(ds)
+    BOOT.phase_done("engine_warm")
 
     # in-process SLO burn-rate engine (YAML `slo:` stanza; ISSUE 10):
     # evaluates the burn-rate ladder over the live registry and serves
@@ -711,15 +763,24 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
     if common.slo.enabled:
         slo_engine = slo_mod.install_slo_engine(common.slo)
 
+    # always-on sampling profiler (YAML `profiler:` stanza; ISSUE 13):
+    # wall-clock stacks behind GET /debug/profile on the listener below
+    profiler_mod.install_profiler(common.profiler)
+
     stopper = Stopper()
     if install_signals:
         setup_signal_handler(stopper)
     health = HealthServer(common.health_check_listen_address).start()
     log.info("health/metrics listener on port %d", health.port)
+    # the listener is up and every registered readiness check is live:
+    # this is the moment /readyz starts answering — seal the boot record
+    BOOT.phase_done("listener_up")
+    BOOT.mark_ready()
     try:
         return run(cfg, ds, stopper)
     finally:
         health.stop()
+        profiler_mod.uninstall_profiler()
         if slo_engine is not None:
             slo_mod.uninstall_slo_engine()
         # teardown ordering against interpreter finalization — a daemon

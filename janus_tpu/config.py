@@ -19,6 +19,7 @@ from .aggregator.aggregation_job_driver import ResidentConfig
 from .aggregator.job_driver import JobDriverConfig
 from .aggregator.step_pipeline import StepPipelineConfig
 from .core.circuit_breaker import CircuitBreakerConfig
+from .profiler import ProfilerConfig
 from .slo import SloEngineConfig
 from .trace import TraceConfiguration
 
@@ -156,6 +157,11 @@ class CommonConfig:
     # Engine-layer knobs (YAML `engine:` section): compile cache dir
     # override, resident-buffer byte bound, cross-task coalescing.
     engine: EngineConfig = field(default_factory=EngineConfig)
+    # Always-on sampling profiler (YAML `profiler:` section;
+    # docs/OBSERVABILITY.md "Continuous profiling"): wall-clock stack
+    # sampling rate and window ring behind GET /debug/profile. Enabled
+    # by default in every binary.
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -177,6 +183,7 @@ class CommonConfig:
             quarantine_canary_timeout_secs=float(wd.get("canary_timeout_secs", 30.0)),
             slo=SloEngineConfig.from_dict(d.get("slo")),
             engine=EngineConfig.from_dict(d.get("engine")),
+            profiler=ProfilerConfig.from_dict(d.get("profiler")),
         )
 
 

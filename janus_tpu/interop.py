@@ -188,7 +188,9 @@ class _JsonServer:
                 pass
 
         self._srv = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="interop-listener", daemon=True
+        )
 
     @property
     def port(self) -> int:
@@ -304,7 +306,7 @@ class InteropAggregator:
                     log.exception("interop job runner pass failed")
                 self._stopper.wait(0.3)
 
-        self._runner = threading.Thread(target=loop, daemon=True)
+        self._runner = threading.Thread(target=loop, name="interop-runner", daemon=True)
         self._runner.start()
 
     def stop(self) -> None:

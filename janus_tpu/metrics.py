@@ -789,6 +789,44 @@ slo_burn_rate = REGISTRY.gauge(
     "14.4x over 1h and tickets at 6x over 6h)",
 )
 
+# --- always-on continuous profiler + device cost ledger + boot
+# timeline (janus_tpu/profiler.py; ISSUE 13, docs/OBSERVABILITY.md
+# "Continuous profiling") ---
+profiler_samples_total = REGISTRY.counter(
+    "janus_profiler_samples_total",
+    "sampling passes completed by the wall-clock stack profiler "
+    "(each pass folds every live thread's stack into /debug/profile)",
+)
+profiler_threads = REGISTRY.gauge(
+    "janus_profiler_threads",
+    "threads captured by the profiler's most recent sampling pass",
+)
+profiler_overhead_ratio = REGISTRY.gauge(
+    "janus_profiler_overhead_ratio",
+    "measured fraction of wall time the sampling profiler spends in its "
+    "own passes over the retained windows (0 while off; alert well "
+    "before the 2% budget)",
+)
+device_cost_seconds_total = REGISTRY.counter(
+    "janus_device_cost_seconds_total",
+    "cumulative device-path wall time attributed by the per-dispatch "
+    'cost ledger, by op and phase (phase="compile|execute|h2d|d2h"; '
+    "per-(vdaf, op, bucket) detail is the /statusz device_cost section)",
+)
+device_cost_us_per_report = REGISTRY.gauge(
+    "janus_device_cost_us_per_report",
+    "live microseconds of device-path wall time per report row, by op "
+    "and phase (an op's cumulative phase seconds over its cumulative "
+    "rows — what the device-lane busy time BUYS per report)",
+)
+boot_phase_seconds = REGISTRY.gauge(
+    "janus_boot_phase_seconds",
+    "wall seconds of each named bring-up phase on the last boot "
+    "(imports, config, backend_init, datastore, engine_warm, "
+    "listener_up; the full timeline is GET /debug/boot) — the "
+    "cold-start regression gate",
+)
+
 # --- standard process/build families scrapers expect (janus_-prefixed
 # per the repo naming lint; populated by register_build_info at import
 # and refreshed by janus_main once the configured backend is known) ---

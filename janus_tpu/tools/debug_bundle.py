@@ -6,7 +6,8 @@
 
 Snapshots every introspection endpoint of one or several binaries'
 health listeners — /metrics (both exposition modes), /statusz,
-/debug/vars, /debug/traces, /alertz, /readyz, /healthz — plus the
+/debug/vars, /debug/traces, /debug/profile (collapsed + JSON),
+/debug/boot, /alertz, /readyz, /healthz — plus the
 resolved YAML config (secrets redacted) and the upload-journal
 directory state, into a timestamped tar.gz with a MANIFEST.json
 inventorying every capture (source, HTTP status, bytes, sha256). This
@@ -41,6 +42,12 @@ ENDPOINTS = (
     ("debug_vars", "/debug/vars"),
     ("debug_traces", "/debug/traces?limit=10000"),
     ("alertz", "/alertz"),
+    # continuous profiler (ISSUE 13): both renderings — the collapsed
+    # folded stacks feed flamegraph.pl directly from the bundle — plus
+    # the boot-phase timeline
+    ("debug_profile", "/debug/profile"),
+    ("debug_profile_json", "/debug/profile?format=json"),
+    ("debug_boot", "/debug/boot"),
 )
 
 _SECRET_KEY_RE = re.compile(r"(token|secret|password|key)s?$", re.IGNORECASE)
@@ -155,7 +162,11 @@ def collect_bundle(
         captured = {}
         for name, path in ENDPOINTS:
             source = base + path
-            ext = ".json" if name not in ("healthz", "metrics", "metrics_openmetrics") else ".txt"
+            ext = (
+                ".txt"
+                if name in ("healthz", "metrics", "metrics_openmetrics", "debug_profile")
+                else ".json"
+            )
             rel = f"{bundle_name}/{target}/{name}{ext}"
             try:
                 status, body = _fetch(source, timeout)

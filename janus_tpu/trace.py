@@ -523,6 +523,13 @@ def use_context(ctx):
 
 _span_metrics: dict[str, tuple] = {}
 
+# span name -> [fn(dur_s, args)] side-channel hooks: the device cost
+# ledger (janus_tpu/profiler.py) attributes the engine put/fetch spans'
+# wall time to its h2d/d2h phases through these, so the ledger and the
+# trace timeline measure the same boundaries by construction. A hook
+# must never raise into the span exit path.
+_span_hooks: dict[str, list] = {}
+
 
 def register_span_metric(
     span_name: str, histogram, labels: dict | None = None, arg_labels: tuple = ()
@@ -533,7 +540,22 @@ def register_span_metric(
     _span_metrics[span_name] = (histogram, dict(labels or {}), tuple(arg_labels))
 
 
+def register_span_hook(span_name: str, fn) -> None:
+    """Call `fn(dur_s, args)` on every exit of span `span_name`
+    (in addition to any register_span_metric binding)."""
+    _span_hooks.setdefault(span_name, []).append(fn)
+
+
 def _bridge_span(name: str, dur_s: float, args: dict, trace_id=None) -> None:
+    hooks = _span_hooks.get(name)
+    if hooks is not None:
+        for fn in hooks:
+            try:
+                fn(dur_s, args)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "span hook for %s failed", name
+                )
     reg = _span_metrics.get(name)
     if reg is None:
         return
@@ -792,7 +814,7 @@ def span(name: str, **args):
             args["error"] = err_name  # kwargs dict is per-call: safe to mutate
             _count_span_error(name)
         dur_s = (t1 - t0) / 1e9
-        if _span_metrics:
+        if _span_metrics or _span_hooks:
             _bridge_span(name, dur_s, args, trace_id)
         _flight_recorder.record(
             name, trace_id, span_id, parent[1] if parent else None,
@@ -826,7 +848,7 @@ def record_operation(name: str, dur_s: float, **args) -> None:
     which the bench's served phase reads for the p50/p95 aggregation-
     job-step SLO — must still see one observation per stepped job."""
     trace_id = _span_rng.getrandbits(128)
-    if _span_metrics:
+    if _span_metrics or _span_hooks:
         # the synthesized trace id still resolves: the recorder ring
         # entry below carries the same id, so a bridged exemplar from a
         # cross-thread operation links to its /debug/traces record

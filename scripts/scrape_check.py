@@ -129,6 +129,14 @@ def main(argv=None) -> int:
         "janus_engine_hd_bytes_total",
         "janus_engine_resident_flushes_total",
         "janus_engine_prestage_total",
+        # continuous profiler + device cost ledger + boot timeline
+        # (ISSUE 13) — registered at import in every binary
+        "janus_profiler_samples_total",
+        "janus_profiler_threads",
+        "janus_profiler_overhead_ratio",
+        "janus_device_cost_seconds_total",
+        "janus_device_cost_us_per_report",
+        "janus_boot_phase_seconds",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -185,6 +193,31 @@ def main(argv=None) -> int:
                             if key not in ent:
                                 errors.append(
                                     f"/statusz resident_accumulators engine entry missing {key!r}"
+                                )
+                                break
+                # continuous profiler + device cost ledger (ISSUE 13):
+                # the compact profiler summary (per-role shares, top
+                # frames, measured overhead) and the per-(vdaf, op,
+                # bucket) cost table with the µs/report attribution
+                prof = snap.get("profile")
+                if not isinstance(prof, dict):
+                    errors.append("/statusz missing the profile section")
+                else:
+                    for key in ("enabled", "roles", "top_frames", "overhead_ratio"):
+                        if key not in prof:
+                            errors.append(f"/statusz profile missing {key!r}")
+                dc = snap.get("device_cost")
+                if not isinstance(dc, dict):
+                    errors.append("/statusz missing the device_cost section")
+                else:
+                    for key in ("entries", "us_per_report"):
+                        if key not in dc:
+                            errors.append(f"/statusz device_cost missing {key!r}")
+                    for ent in dc.get("entries", []) or []:
+                        for key in ("vdaf", "op", "bucket", "dispatches", "rows"):
+                            if key not in ent:
+                                errors.append(
+                                    f"/statusz device_cost entry missing {key!r}"
                                 )
                                 break
 
@@ -259,6 +292,62 @@ def main(argv=None) -> int:
                     if key not in s:
                         errors.append(f"/alertz slo entry missing {key!r}: {s}")
                         break
+
+    # continuous profiler (ISSUE 13): /debug/profile must serve a
+    # well-formed collapsed-stack document (hostile thread names must
+    # not corrupt the fold — validated with the shared validator) and a
+    # JSON mode with per-role shares; every binary runs the sampler by
+    # default, so a disabled profiler is a deploy regression
+    from janus_tpu.profiler import validate_collapsed  # noqa: E402
+
+    try:
+        body, ctype = _fetch(base + "/debug/profile", args.timeout)
+    except Exception as e:
+        errors.append(f"GET /debug/profile failed: {e}")
+    else:
+        if not ctype.startswith("text/plain"):
+            errors.append(f"/debug/profile Content-Type not text/plain: {ctype!r}")
+        errors.extend(
+            f"/debug/profile collapsed: {e}" for e in validate_collapsed(body)
+        )
+    try:
+        body, ctype = _fetch(base + "/debug/profile?format=json", args.timeout)
+        prof = json.loads(body)
+    except Exception as e:
+        errors.append(f"/debug/profile?format=json not valid JSON: {e}")
+    else:
+        if not ctype.startswith("application/json"):
+            errors.append(f"/debug/profile json Content-Type: {ctype!r}")
+        for key in ("enabled", "roles", "top_frames", "overhead_ratio", "samples"):
+            if key not in prof:
+                errors.append(f"/debug/profile json missing {key!r}")
+        if prof.get("enabled") is not True:
+            errors.append(
+                "/debug/profile reports the sampler disabled (it is on by "
+                "default in every binary — a disabled profiler is a deploy "
+                "regression)"
+            )
+
+    # boot-phase timeline (ISSUE 13): /debug/boot is one contiguous,
+    # monotone phase sequence from process start
+    try:
+        body, _ = _fetch(base + "/debug/boot", args.timeout)
+        boot = json.loads(body)
+    except Exception as e:
+        errors.append(f"/debug/boot not valid JSON: {e}")
+    else:
+        for key in ("started_unix", "ready", "phases", "boot_phases_sum_s"):
+            if key not in boot:
+                errors.append(f"/debug/boot missing {key!r}")
+        last_end = 0.0
+        for p in boot.get("phases", []) or []:
+            if not {"phase", "start_s", "end_s", "seconds"} <= set(p):
+                errors.append(f"/debug/boot phase entry malformed: {p}")
+                break
+            if p["start_s"] < last_end - 1e-6 or p["end_s"] < p["start_s"] - 1e-6:
+                errors.append(f"/debug/boot phases not monotone at {p['phase']!r}")
+                break
+            last_end = p["end_s"]
 
     # the endpoint-discovery index page (GET /) must link the surface
     try:

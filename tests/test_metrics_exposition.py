@@ -177,13 +177,19 @@ def test_engine_spans_are_registered_with_dispatch_histogram():
 
 @pytest.fixture()
 def health_server():
+    from janus_tpu import profiler as prof
     from janus_tpu.binary_utils import HealthServer
 
+    # the real binaries run the continuous profiler (janus_main installs
+    # it by default) and scrape_check enforces that — the fixture
+    # matches the deploy shape
+    prof.install_profiler(prof.ProfilerConfig(hz=100.0, window_secs=10.0))
     srv = HealthServer("127.0.0.1:0").start()
     try:
         yield f"http://127.0.0.1:{srv.port}"
     finally:
         srv.stop()
+        prof.uninstall_profiler()
 
 
 def _get(url):
