@@ -2587,6 +2587,22 @@ def _resident_accumulate_record(inst=None, n: int = 256, k: int = 16, jobs: int 
     }
 
 
+def _cold_start_record(full: bool = False) -> dict:
+    """Cold-start A/B (scripts/chaos_run.py --scenario cold_start):
+    interleaved cold-cache vs warm-cache boots of the REAL driver
+    binary, restart-to-first-dispatch measured via /debug/boot (phase
+    sums proven exact in the boot-timeline tests). Both boots replay
+    the same shape manifest through the AOT prewarm before /readyz
+    flips ready; the warm boot loads serialized executables (no
+    re-trace) + the persistent XLA cache. Gates: warm under 10 s, warm
+    >= 1.5x cold in the tier-1 smoke (>= 3x in the full record), AOT
+    saves observed cold / loads observed warm."""
+    args = ["--scenario", "cold_start", "--json"]
+    if not full:
+        args.append("--smoke")
+    return _run_chaos_subprocess(args, timeout=900 if full else 420)
+
+
 def _db_outage_smoke() -> dict:
     """Datastore-outage survival smoke (scripts/chaos_run.py
     --scenario db_outage --smoke): uploads keep acking 201 through a
@@ -2675,6 +2691,10 @@ def run_dry(args, ap) -> None:
                 "chaos_smoke": _chaos_smoke(),
                 "db_outage_smoke": _db_outage_smoke(),
                 "device_hang_smoke": _device_hang_smoke(),
+                # ISSUE 14: cold-cache vs warm-cache real-binary boots —
+                # the warm number (restart-to-first-dispatch) is gated
+                # under 10 s and must beat cold by the smoke ratio
+                "cold_start": _cold_start_record(),
                 # ISSUE 12: resident vs re-stage accumulate A/B
                 # (bit-identical shares asserted; the >=2x bytes/report
                 # gate reads hd_bytes_per_report_ratio) + the live
@@ -3146,6 +3166,12 @@ def main() -> None:
         # ISSUE 12: resident vs re-stage accumulate A/B on this
         # config's circuit (the >=2x bytes/report acceptance gate)
         riders["resident_accumulate"] = _resident_accumulate_record(inst)
+    except Exception:
+        pass
+    try:
+        # ISSUE 14: the warm-vs-cold BENCH record — full form (two
+        # vdafs, 2 interleaved pairs, >= 3x gate, warm < 10 s)
+        riders["cold_start"] = _cold_start_record(full=True)
     except Exception:
         pass
     if args.mode != "served":

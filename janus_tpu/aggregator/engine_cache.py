@@ -34,6 +34,7 @@ import numpy as np
 
 from .. import failpoints
 from ..core.deadline import current_deadline
+from . import aot_cache, shape_manifest
 from ..vdaf.engine import STREAM_MIN_INPUT_LEN, stream_plan
 from ..vdaf.feasibility import device_memory_budget, feasible_bucket
 from ..vdaf.registry import VdafInstance, prio3_batched
@@ -890,6 +891,14 @@ class EngineCache:
             rows=n,
             dispatches=1,
         )
+        if ledger_first:
+            # persisted shape manifest (ISSUE 14): the first dispatch
+            # of a specialization IS the cold-start cost a restarted
+            # process would pay again — record it so the boot prewarm
+            # can compile exactly this set before /readyz flips ready
+            shape_manifest.record_dispatch(
+                self.inst, ledger_op or op, b, lkey, elapsed_s, rows=n
+            )
 
     # Per-call row cap for joining a shared round; absolute round row
     # cap; and the rows x input_len budget one coalesced round may
@@ -936,7 +945,17 @@ class EngineCache:
 
                 self._jits[name] = locked
             else:
-                self._jits[name] = jitted
+                # single-device programs ride the serialized-executable
+                # AOT cache (aot_cache.py): a restarted process — or a
+                # canary rebuild that just dropped _jits — deserializes
+                # the compiled executable instead of re-tracing. A
+                # passthrough while the cache is disarmed.
+                self._jits[name] = aot_cache.wrap(
+                    jitted,
+                    aot_cache.engine_base(
+                        self.inst.to_dict(), self.verify_key, name
+                    ),
+                )
         return self._jits[name]
 
     # --- OOM recovery (shared by every public step) ---
@@ -1215,6 +1234,30 @@ class EngineCache:
                 "engine %s restored to the device path (canary probe succeeded)",
                 self.inst.kind,
             )
+            # warm canary restore (ISSUE 14): the probe dropped every
+            # compiled executable, so re-warm this engine's recorded
+            # specializations from the shape manifest HERE, in the
+            # canary thread — with the persistent compile cache these
+            # are disk loads, and the serving path never pays a
+            # post-restore re-trace. Best-effort: serving is already
+            # restored; a failed warm just means lazier compiles.
+            if not self._canary_stop:
+                try:
+                    from .prewarm import warm_engine_from_manifest
+
+                    # stop-aware between entries: stop_canary's bounded
+                    # join must not leave this loop dispatching native
+                    # work into interpreter finalization
+                    warmed = warm_engine_from_manifest(
+                        self, should_stop=lambda: self._canary_stop
+                    )
+                    if warmed:
+                        log.info(
+                            "canary re-warmed %d recorded specialization(s) for %s",
+                            warmed, self.inst.kind,
+                        )
+                except Exception:
+                    log.warning("post-restore manifest warm failed", exc_info=True)
             return
 
     def stop_canary(self, timeout_s: float = 2.0) -> None:

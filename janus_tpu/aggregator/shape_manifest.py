@@ -1,0 +1,385 @@
+"""Persisted shape manifest: the dispatch specializations this process
+has actually compiled (docs/ARCHITECTURE.md "Cold-start and prewarm").
+
+Every first dispatch of a jit specialization — (vdaf config, op, batch
+bucket, compile_key: the variant name plus any extra geometry such as
+aggregate_pending's padded bucket count kk) — is recorded here by the
+EngineCache choke point (`_record_dispatch`), together with the wall
+time that first call cost (trace + XLA compile + execute: exactly the
+cold-start price a restarted process would pay again). At the next
+boot the prewarm engine (aggregator/prewarm.py) replays the manifest
+highest-cost-first against the provisioned tasks, so the persistent
+XLA compile cache is loaded and every observed specialization is
+traced BEFORE /readyz reports ready.
+
+File format: append-only JSONL, one record per line:
+
+    {"v": 1, "crc": <crc32 of canonical entry json>, "e": {entry}}
+
+entry = {vdaf: VdafInstance.to_dict(), op, bucket, key: [compile_key],
+cost_s, rows, seen, last_unix}. The discipline mirrors the upload
+journal's (ingest/journal.py), scaled down for advisory data:
+
+  * **Torn tails tolerated**: a crash mid-append leaves a truncated
+    final line; it fails to parse and is skipped (counted), the valid
+    prefix loads. No fsync — losing a tail entry costs one cold
+    compile later, never correctness.
+  * **Damage skipped, never fatal**: a line whose CRC or JSON is bad
+    is counted and skipped; a corrupt manifest can slow a boot, it
+    cannot break one (a manifest-less boot degrades to the legacy
+    warmup behavior).
+  * **Version skew skipped**: lines with `v` != MANIFEST_VERSION are
+    counted and ignored — an old binary's manifest never crashes a
+    new one, and vice versa.
+  * **Append-compacted and bounded**: repeated boots append duplicate
+    keys (later lines win, `seen` sums); once the file grows past
+    the compaction threshold it is rewritten (tmp + atomic
+    os.replace) with one line per live entry, truncated to
+    `max_entries` by recorded cost.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+
+log = logging.getLogger(__name__)
+
+MANIFEST_VERSION = 1
+DEFAULT_MAX_ENTRIES = 512
+DEFAULT_FILENAME = "shape_manifest.jsonl"
+
+
+def _canonical(entry: dict) -> str:
+    return json.dumps(entry, sort_keys=True, separators=(",", ":"))
+
+
+def _crc(entry: dict) -> int:
+    return zlib.crc32(_canonical(entry).encode()) & 0xFFFFFFFF
+
+
+def entry_key(entry: dict) -> tuple:
+    """Identity of one specialization: (vdaf config, op, bucket,
+    compile_key). The compile_key list is the jit variant the call
+    site specialized (engine_cache._record_dispatch), so e.g.
+    aggregate_pending's kk geometry keys separately per kk."""
+    return (
+        _canonical(entry.get("vdaf") or {}),
+        str(entry.get("op", "")),
+        int(entry.get("bucket", 0)),
+        tuple(entry.get("key") or ()),
+    )
+
+
+class ShapeManifest:
+    """See the module docstring. Thread-safe: `record` may be called
+    from any dispatch thread while `entries`/`status` snapshot for the
+    prewarm loop and /statusz."""
+
+    def __init__(
+        self,
+        path: str,
+        max_entries: int = DEFAULT_MAX_ENTRIES,
+    ):
+        self.path = path
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, dict] = {}
+        self._file_lines = 0
+        self._compactions = 0
+        self.load_stats = {
+            "lines": 0,
+            "loaded": 0,
+            "skipped_corrupt": 0,
+            "skipped_version": 0,
+        }
+
+    # -- load ----------------------------------------------------------
+    def load(self, compact: bool = True) -> dict:
+        """Read the file, tolerant of torn tails / damage / version
+        skew (each skipped and counted, valid prefix + suffix load).
+        Returns the load stats. A missing file is an empty manifest.
+        `compact=False` makes the load strictly read-only (diagnostic
+        tools must not rewrite the evidence they capture)."""
+        stats = {"lines": 0, "loaded": 0, "skipped_corrupt": 0, "skipped_version": 0}
+        entries: dict[tuple, dict] = {}
+        lines = 0
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            raw = b""
+        except OSError as e:
+            log.warning("shape manifest %s unreadable (%s); starting empty", self.path, e)
+            raw = b""
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            lines += 1
+            stats["lines"] += 1
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict):
+                    raise ValueError("not an object")
+            except (ValueError, UnicodeDecodeError):
+                stats["skipped_corrupt"] += 1
+                continue
+            if rec.get("v") != MANIFEST_VERSION:
+                stats["skipped_version"] += 1
+                continue
+            entry = rec.get("e")
+            if not isinstance(entry, dict) or rec.get("crc") != _crc(entry):
+                stats["skipped_corrupt"] += 1
+                continue
+            try:
+                # last line wins: each appended record carries the
+                # cumulative seen count, so a replace (not a sum) keeps
+                # the append-log semantics across compactions
+                entries[entry_key(entry)] = entry
+                stats["loaded"] += 1
+            except (TypeError, ValueError):
+                stats["skipped_corrupt"] += 1
+        with self._lock:
+            self._entries = entries
+            self._file_lines = lines
+            self.load_stats = stats
+            if compact and (
+                stats["skipped_corrupt"]
+                or stats["skipped_version"]
+                or lines > self._compact_threshold()
+                or len(entries) > self.max_entries
+            ):
+                self._compact_locked()
+        if stats["skipped_corrupt"] or stats["skipped_version"]:
+            log.warning(
+                "shape manifest %s: loaded %d entries, skipped %d corrupt + %d "
+                "version-skew line(s)",
+                self.path,
+                stats["loaded"],
+                stats["skipped_corrupt"],
+                stats["skipped_version"],
+            )
+        return dict(stats)
+
+    # -- record --------------------------------------------------------
+    def record(
+        self,
+        vdaf: dict,
+        op: str,
+        bucket: int,
+        compile_key,
+        cost_s: float,
+        rows: int = 0,
+    ) -> None:
+        """Record one observed specialization (called at FIRST dispatch
+        of a compile_key per process, so the append rate is bounded by
+        the number of distinct specializations). `cost_s` is that first
+        call's wall time — compile + first execute — which is what the
+        prewarm priority order sorts on; re-observations keep the MAX
+        recorded cost (a cache-hit re-record must not demote a
+        genuinely expensive compile)."""
+        entry = {
+            "vdaf": dict(vdaf),
+            "op": str(op),
+            "bucket": int(bucket),
+            "key": [
+                k if isinstance(k, (int, float)) else str(k)
+                for k in (compile_key or (op, bucket))
+            ],
+            "cost_s": round(float(cost_s), 6),
+            "rows": int(rows),
+            "seen": 1,
+            "last_unix": round(time.time(), 3),
+        }
+        key = entry_key(entry)
+        with self._lock:
+            prev = self._entries.get(key)
+            if prev is not None:
+                entry["seen"] = int(prev.get("seen", 1)) + 1
+                entry["cost_s"] = max(entry["cost_s"], float(prev.get("cost_s", 0.0)))
+            self._entries[key] = entry
+            self._append_locked(entry)
+            if (
+                self._file_lines > self._compact_threshold()
+                or len(self._entries) > self.max_entries
+            ):
+                self._compact_locked()
+
+    def _append_locked(self, entry: dict) -> None:
+        line = (
+            json.dumps(
+                {"v": MANIFEST_VERSION, "crc": _crc(entry), "e": entry},
+                sort_keys=True,
+                separators=(",", ":"),
+            )
+            + "\n"
+        )
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+            self._file_lines += 1
+        except OSError:
+            log.warning("shape manifest append to %s failed", self.path, exc_info=True)
+
+    def _compact_threshold(self) -> int:
+        return max(64, 2 * self.max_entries)
+
+    def _compact_locked(self) -> None:
+        """Rewrite the file with one line per live entry, truncated to
+        max_entries by cost (tmp + atomic replace: a crash leaves either
+        the old file or the new one, never a half-written manifest)."""
+        keep = sorted(
+            self._entries.values(), key=lambda e: -float(e.get("cost_s", 0.0))
+        )[: self.max_entries]
+        self._entries = {entry_key(e): e for e in keep}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                for e in keep:
+                    f.write(
+                        json.dumps(
+                            {"v": MANIFEST_VERSION, "crc": _crc(e), "e": e},
+                            sort_keys=True,
+                            separators=(",", ":"),
+                        )
+                        + "\n"
+                    )
+            os.replace(tmp, self.path)
+            self._file_lines = len(keep)
+            self._compactions += 1
+        except OSError:
+            log.warning("shape manifest compaction of %s failed", self.path, exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # -- queries -------------------------------------------------------
+    def entries(self) -> list[dict]:
+        """Snapshot of live entries, highest recorded cost first (the
+        prewarm priority order: the most expensive compiles must land
+        inside the boot budget)."""
+        with self._lock:
+            out = [dict(e) for e in self._entries.values()]
+        out.sort(key=lambda e: (-float(e.get("cost_s", 0.0)), str(e.get("op", ""))))
+        return out
+
+    def covers(self, vdaf: dict, op: str, bucket: int) -> bool:
+        """True when a recorded specialization matches (vdaf, op,
+        bucket) with the PLAIN jit variant — the legacy warmup uses
+        this to skip geometries the manifest-driven prewarm already
+        warms. The variant check matters: a manifest holding only
+        `leader_init_vk` (cross-task-coalesced) entries must not
+        suppress warming the plain `leader_init` program, which is a
+        distinct compile the prewarm never touched."""
+        vkey = _canonical(dict(vdaf))
+        with self._lock:
+            return any(
+                k[0] == vkey
+                and k[1] == str(op)
+                and k[2] == int(bucket)
+                and k[3]
+                and str(k[3][0]) == str(op)
+                for k in self._entries
+            )
+
+    def file_bytes(self) -> int:
+        try:
+            return os.stat(self.path).st_size
+        except OSError:
+            return 0
+
+    def status(self) -> dict:
+        with self._lock:
+            n = len(self._entries)
+            stats = dict(self.load_stats)
+            compactions = self._compactions
+            lines = self._file_lines
+        return {
+            "path": self.path,
+            "entries": n,
+            "max_entries": self.max_entries,
+            "file_lines": lines,
+            "file_bytes": self.file_bytes(),
+            "compactions": compactions,
+            "load": stats,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide installed manifest. janus_main installs it at boot (path
+# from the YAML `engine:` stanza, defaulting next to the compile cache)
+# and uninstalls at teardown; the EngineCache choke point records into
+# whatever is installed (a no-op otherwise, so bench/tests that never
+# install one pay a single None check per first-dispatch).
+# ---------------------------------------------------------------------------
+
+_installed: ShapeManifest | None = None
+_installed_lock = threading.Lock()
+
+
+def install_manifest(path: str, max_entries: int = DEFAULT_MAX_ENTRIES) -> ShapeManifest:
+    """Install (and load) the process shape manifest. Replaces any
+    previous instance."""
+    global _installed
+    m = ShapeManifest(path, max_entries=max_entries)
+    m.load()
+    with _installed_lock:
+        _installed = m
+    return m
+
+
+def uninstall_manifest() -> None:
+    global _installed
+    with _installed_lock:
+        _installed = None
+
+
+def installed() -> ShapeManifest | None:
+    return _installed
+
+
+def record_dispatch(inst, op: str, bucket: int, compile_key, cost_s: float, rows: int = 0) -> None:
+    """EngineCache choke-point hook: record a first dispatch into the
+    installed manifest, if any. Fake VDAFs are test machinery and never
+    worth a prewarm slot; failures are swallowed — manifest trouble
+    must never fail a serving dispatch."""
+    m = _installed
+    if m is None:
+        return
+    try:
+        kind = getattr(inst, "kind", "")
+        if kind.startswith("fake") or kind == "poplar1":
+            return
+        m.record(inst.to_dict(), op, bucket, compile_key, cost_s, rows=rows)
+    except Exception:
+        log.warning("shape manifest record failed", exc_info=True)
+
+
+def inspect_file(path: str, max_entries: int = DEFAULT_MAX_ENTRIES) -> tuple[list[dict], dict]:
+    """READ-ONLY parse of a manifest file: (entries, load stats) with
+    no compaction, no rewrites, no side effects — for diagnostic tools
+    (debug_bundle) that must inventory a live or damaged manifest
+    without mutating the evidence."""
+    m = ShapeManifest(path, max_entries=max_entries)  # no I/O until load
+    stats = m.load(compact=False)
+    return m.entries(), stats
+
+
+def manifest_status() -> dict:
+    """The manifest slice of the /statusz `engine_prewarm` section."""
+    m = _installed
+    if m is None:
+        return {"installed": False}
+    return {"installed": True, **m.status()}

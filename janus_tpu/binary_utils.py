@@ -22,6 +22,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .aggregator import prewarm as prewarm_mod
+from .aggregator import shape_manifest as shape_manifest_mod
 from .aggregator.job_driver import Stopper
 from .config import CommonConfig, load_config
 from .core.time_util import RealClock
@@ -511,73 +513,146 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
     vars are a no-op once jax is preimported — sitecustomize does).
     One shared helper for bench.py, the measurement scripts, the
     dryrun entry, and the CLI precompile; the serving binaries
-    configure theirs from CommonConfig.compilation_cache_dir."""
+    configure theirs from CommonConfig.compilation_cache_dir (ON by
+    default — `compilation_cache_dir: null` is the explicit
+    off-switch)."""
     import jax
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.expanduser(cache_dir or "~/.cache/jax_comp_cache"),
-    )
+    resolved = os.path.expanduser(cache_dir or "~/.cache/jax_comp_cache")
+    jax.config.update("jax_compilation_cache_dir", resolved)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    # statusz `engine_prewarm` section + the prewarm hit/miss split
+    # read the live cache dir from here
+    prewarm_mod.note_compile_cache(resolved)
 
 
-def warmup_engines_background(ds, buckets=None) -> "threading.Thread":
+def warmup_engines_background(ds, buckets=None, manifest=None) -> "threading.Thread":
     """Ahead-of-time bucket compilation OFF the boot path (VERDICT r3
     weak #8: a fresh deployment's first job on a new batch bucket still
     stalled minutes). Serving starts immediately; a daemon thread warms
     each configured bucket in ascending order, so the small buckets
-    (interactive traffic) compile first and big job buckets follow."""
+    (interactive traffic) compile first and big job buckets follow.
+    `manifest` has warmup_engines' semantics — janus_main passes
+    _NO_DEDUPE when the manifest prewarm did not run."""
     import threading
 
     buckets = sorted(buckets or (None,), key=lambda b: b or 0)
 
     def work():
         for b in buckets:
-            warmup_engines(ds, batch=b)
+            warmup_engines(ds, batch=b, manifest=manifest)
 
     t = threading.Thread(target=work, name="engine-warmup", daemon=True)
     t.start()
     return t
 
 
-def warmup_engines(ds, batch: int | None = None) -> None:
+_NO_DEDUPE = object()  # warmup sentinel: skip NO geometry (the manifest
+# prewarm did not run, so nothing "owns" the covered ones)
+
+
+def warmup_engines(ds, batch: int | None = None, manifest=None) -> dict:
     """Compile the device engine steps for every provisioned task before
     serving traffic (cold-start mitigation: a cold aggregator otherwise
     stalls for minutes on first request per task). With the persistent
     compilation cache, restarts reduce this to disk loads.
 
-    batch selects the bucket to warm (engines compile per power-of-two
-    batch bucket); default MIN_BUCKET."""
+    `batch` selects the batch size to warm (engines compile per
+    power-of-two jit bucket). Without it, each task warms the sizes of
+    its PENDING aggregation jobs — the geometry the next driver pass
+    will actually dispatch — falling back to MIN_BUCKET only when
+    there is no pending work to learn from. Geometries the shape
+    manifest already covers are SKIPPED (counted
+    `outcome="skipped_covered"`): the manifest-driven prewarm owns
+    them, so warm-up work is never duplicated — pass
+    `manifest=_NO_DEDUPE` when the prewarm did NOT run (disabled /
+    failed), so a covered-but-unwarmed geometry still warms. Returns a
+    summary dict ({"warmed": [(task_id, bucket)], "skipped_covered": n})."""
     import numpy as np
 
-    from .aggregator.engine_cache import MIN_BUCKET, engine_cache
+    from . import metrics
+    from .aggregator import shape_manifest
+    from .aggregator.engine_cache import (
+        MIN_BUCKET,
+        HostEngineCache,
+        bucket_size,
+        engine_cache,
+    )
     from .vdaf.testing import make_report_batch, random_measurements
 
-    from .aggregator.engine_cache import HostEngineCache
-
-    warm_batch = batch or MIN_BUCKET
+    if manifest is _NO_DEDUPE:
+        manifest = None
+    elif manifest is None:
+        manifest = shape_manifest.installed()
     tasks = ds.run_tx(lambda tx: tx.get_tasks(), "warmup_list_tasks")
+    pending: dict[bytes, list[int]] = {}
+    if batch is None:
+        try:
+            pending = ds.run_tx(
+                lambda tx: tx.get_pending_aggregation_job_sizes(), "warmup_job_sizes"
+            )
+        except Exception:
+            log.warning(
+                "pending aggregation job sizes unavailable; warming the "
+                "minimum bucket",
+                exc_info=True,
+            )
+    # ops a task-bucket warm compiles; a bucket is skipped only when the
+    # manifest covers ALL of them (a partial warm would still pay the
+    # leader leg the aggregate warm needs)
+    warm_ops = ("leader_init", "helper_init", "aggregate")
+    result: dict = {"warmed": [], "skipped_covered": 0}
     for task in tasks:
         if task.vdaf.kind.startswith("fake") or task.vdaf.kind == "poplar1":
             continue  # fakes and host-side Poplar1 have no device engine
-        try:
-            eng = engine_cache(task.vdaf, task.vdaf_verify_key)
-            if isinstance(eng, HostEngineCache):
-                continue  # host engines need no compile
-            rng = np.random.default_rng(0)
-            args, _ = make_report_batch(
-                task.vdaf, random_measurements(task.vdaf, warm_batch, rng), seed=0
-            )
-            nonce, parts, meas, proof, blind0, hseed, blind1 = args
-            out0, seed0, ver0, part0 = eng.leader_init(nonce, parts, meas, proof, blind0)
-            ok = np.ones(warm_batch, dtype=bool)
-            part0_l = part0 if part0 is not None else np.zeros((warm_batch, 2), dtype=np.uint64)
-            eng.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
-            eng.aggregate(out0, ok)
-            log.info("warmed engines for task %s (%s)", task.task_id, task.vdaf.kind)
-        except Exception:
-            log.exception("engine warmup failed for task %s", task.task_id)
+        if batch is not None:
+            sizes = [int(batch)]
+        else:
+            # dedupe pending job sizes by their jit bucket (the compile
+            # unit), keep ascending so interactive sizes warm first,
+            # and bound the set — one warm per bucket is enough
+            by_bucket: dict[int, int] = {}
+            for n in sorted(pending.get(task.task_id.data, [])):
+                by_bucket.setdefault(bucket_size(n), n)
+            sizes = [by_bucket[b] for b in sorted(by_bucket)][:4] or [MIN_BUCKET]
+        for warm_batch in sizes:
+            b = bucket_size(warm_batch)
+            inst_dict = task.vdaf.to_dict()
+            if manifest is not None and all(
+                manifest.covers(inst_dict, op, b) for op in warm_ops
+            ):
+                result["skipped_covered"] += 1
+                metrics.engine_prewarm_total.add(outcome="skipped_covered")
+                continue
+            try:
+                eng = engine_cache(task.vdaf, task.vdaf_verify_key)
+                if isinstance(eng, HostEngineCache):
+                    continue  # host engines need no compile
+                rng = np.random.default_rng(0)
+                args, _ = make_report_batch(
+                    task.vdaf, random_measurements(task.vdaf, warm_batch, rng), seed=0
+                )
+                nonce, parts, meas, proof, blind0, hseed, blind1 = args
+                out0, seed0, ver0, part0 = eng.leader_init(
+                    nonce, parts, meas, proof, blind0
+                )
+                ok = np.ones(warm_batch, dtype=bool)
+                part0_l = (
+                    part0
+                    if part0 is not None
+                    else np.zeros((warm_batch, 2), dtype=np.uint64)
+                )
+                eng.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
+                eng.aggregate(out0, ok)
+                result["warmed"].append((task.task_id, b))
+                log.info(
+                    "warmed engines for task %s (%s) at bucket %d",
+                    task.task_id, task.vdaf.kind, b,
+                )
+            except Exception:
+                log.exception("engine warmup failed for task %s", task.task_id)
+    return result
 
 
 def janus_main(description: str, config_cls, run, argv=None, install_signals: bool = True):
@@ -668,6 +743,22 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
             enable_compile_cache(compile_cache_dir)
         except Exception:
             log.exception("could not enable the persistent compilation cache")
+    # serialized-executable AOT cache rides beside the XLA cache: the
+    # XLA cache skips recompiles, this skips the re-TRACE — the larger
+    # half of a warm restart (docs/ARCHITECTURE.md "Cold-start and
+    # prewarm"). JANUS_AOT_CACHE env: "0" off, a path relocates —
+    # honored even with the XLA cache explicitly disabled.
+    aot_env = os.environ.get("JANUS_AOT_CACHE")
+    if aot_env != "0" and common.engine.aot_cache:
+        aot_dir = aot_env or (
+            os.path.join(os.path.expanduser(compile_cache_dir), "aot")
+            if compile_cache_dir
+            else None
+        )
+        if aot_dir:
+            from .aggregator import aot_cache
+
+            aot_cache.arm(aot_dir)
 
     # engine-layer knobs (YAML `engine:` stanza). Envs are the operator
     # override, same discipline as the watchdog knobs above.
@@ -746,12 +837,65 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
     register_status_provider("tasks", _tasks_status)
     BOOT.phase_done("datastore")
 
+    # --- persisted shape manifest + AOT prewarm (ISSUE 14; docs/
+    # ARCHITECTURE.md "Cold-start and prewarm"): load the manifest of
+    # observed dispatch specializations and compile the recorded set —
+    # highest recorded cost first, bounded by the boot budget — BEFORE
+    # the health listener is up, so /readyz never reports a replica
+    # ready that would stall its first jobs on cold compiles. The
+    # JANUS_SHAPE_MANIFEST env var is the operator override; an empty
+    # path ("" in YAML or env) disables recording and prewarm, and a
+    # manifest-less boot degrades to the legacy warmup below.
+    manifest = None
+    manifest_path = os.environ.get("JANUS_SHAPE_MANIFEST")
+    if manifest_path is None:
+        manifest_path = common.engine.shape_manifest_path
+    if manifest_path is None and compile_cache_dir:
+        manifest_path = os.path.join(
+            os.path.expanduser(compile_cache_dir), shape_manifest_mod.DEFAULT_FILENAME
+        )
+    if manifest_path:
+        try:
+            manifest = shape_manifest_mod.install_manifest(
+                manifest_path,
+                max_entries=common.engine.shape_manifest_max_entries,
+            )
+        except Exception:
+            log.exception("could not install the shape manifest at %s", manifest_path)
+    BOOT.phase_done("engine_warm_manifest")
+
+    prewarm_ready = threading.Event()
+    register_readiness_check(
+        "engine_prewarm",
+        lambda: None
+        if prewarm_ready.is_set()
+        else "boot-budget engine prewarm still compiling",
+    )
+    prewarm_ran = False
+    if common.engine.prewarm and manifest is not None:
+        try:
+            prewarm_mod.prewarm_engines(
+                ds,
+                manifest,
+                boot_budget_s=common.engine.prewarm_boot_budget_secs,
+                ready_event=prewarm_ready,
+            )
+            prewarm_ran = True
+        except Exception:
+            log.exception("manifest prewarm failed; serving cold")
+    prewarm_ready.set()  # idempotent (prewarm_engines sets it after the
+    # priority set); a disabled/failed prewarm must never wedge /readyz
     if common.warmup_engines_at_boot:
+        # dedupe against the manifest ONLY when the prewarm really
+        # warmed it — with prewarm disabled/failed, a covered geometry
+        # would otherwise be skipped by BOTH paths and serve its first
+        # job cold
+        dedupe = manifest if prewarm_ran else _NO_DEDUPE
         if common.warmup_buckets:
             # non-blocking: serve immediately, compile buckets behind
-            warmup_engines_background(ds, common.warmup_buckets)
+            warmup_engines_background(ds, common.warmup_buckets, manifest=dedupe)
         else:
-            warmup_engines(ds)
+            warmup_engines(ds, manifest=dedupe)
     BOOT.phase_done("engine_warm")
 
     # in-process SLO burn-rate engine (YAML `slo:` stanza; ISSUE 10):
@@ -792,4 +936,9 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         shutdown_engines(2.0)
         failpoints.release_hangs()
         device_watchdog.WATCHDOG.drain(2.0)
+        unregister_readiness_check("engine_prewarm")
+        shape_manifest_mod.uninstall_manifest()
+        from .aggregator import aot_cache
+
+        aot_cache.disarm()
         ds.close()

@@ -33,7 +33,10 @@ class EngineConfig:
     # persistent XLA compilation cache directory; overrides the
     # top-level compilation_cache_dir when set (the cheap slice of the
     # cold-start roadmap item: restarts and canary rebuilds reload
-    # compiled executables from disk instead of recompiling)
+    # compiled executables from disk instead of recompiling). The cache
+    # is ON by default via CommonConfig.compilation_cache_dir; set
+    # `compilation_cache_dir: null` (and no engine-level dir) to
+    # explicitly disable it.
     compile_cache_dir: str | None = None
     # process-wide device-byte bound on resident aggregate buffers
     # (EngineCache.RESIDENT_MAX_BYTES; LRU overflow evicts through the
@@ -42,6 +45,26 @@ class EngineConfig:
     # merge small jobs across TASKS into one device dispatch (per-lane
     # verify keys). None keeps the process default (on).
     cross_task_coalesce: bool | None = None
+    # --- geometry-manifest prewarm (docs/ARCHITECTURE.md "Cold-start
+    # and prewarm") ---
+    # persisted shape manifest of observed dispatch specializations.
+    # None (default) puts it next to the compile cache
+    # (<cache_dir>/shape_manifest.jsonl); "" disables recording AND
+    # manifest-driven prewarm. The JANUS_SHAPE_MANIFEST env var is the
+    # operator override.
+    shape_manifest_path: str | None = None
+    shape_manifest_max_entries: int = 512
+    # serialized-executable AOT cache (<compile cache dir>/aot): a
+    # restarted process deserializes compiled engine programs instead
+    # of re-tracing them — the layer that takes a warm restart from
+    # ~trace-per-program to ~tens of ms per program. JANUS_AOT_CACHE
+    # env overrides ("0" disables, a path relocates).
+    aot_cache: bool = True
+    # AOT-compile the manifest's recorded specializations at boot,
+    # before /readyz reports ready (highest recorded cost first,
+    # bounded by the boot budget; the remainder warms in background)
+    prewarm: bool = True
+    prewarm_boot_budget_secs: float = 30.0
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "EngineConfig":
@@ -52,6 +75,11 @@ class EngineConfig:
             compile_cache_dir=d.get("compile_cache_dir"),
             resident_max_bytes=int(rmb) if rmb is not None else None,
             cross_task_coalesce=bool(xt) if xt is not None else None,
+            shape_manifest_path=d.get("shape_manifest_path"),
+            shape_manifest_max_entries=int(d.get("shape_manifest_max_entries", 512)),
+            aot_cache=bool(d.get("aot_cache", True)),
+            prewarm=bool(d.get("prewarm", True)),
+            prewarm_boot_budget_secs=float(d.get("prewarm_boot_budget_secs", 30.0)),
         )
 
 

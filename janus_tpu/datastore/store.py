@@ -1377,6 +1377,25 @@ class Transaction:
         ).fetchall()
         return [(r[0], int(r[1])) for r in rows]
 
+    def get_pending_aggregation_job_sizes(self, limit: int = 256) -> dict[bytes, list[int]]:
+        """{task_id: [report counts]} of in-progress aggregation jobs —
+        the batch geometry the NEXT driver pass will actually dispatch.
+        Boot-time engine warmup reads this so it compiles the buckets
+        real jobs need instead of blindly warming the minimum bucket
+        (docs/ARCHITECTURE.md "Cold-start and prewarm")."""
+        rows = self._c.execute(
+            "SELECT aj.task_id, COUNT(*) FROM aggregation_jobs aj"
+            " JOIN report_aggregations ra"
+            "   ON ra.task_id = aj.task_id AND ra.job_id = aj.job_id"
+            " WHERE aj.state = 'in_progress'"
+            " GROUP BY aj.task_id, aj.job_id LIMIT ?",
+            (int(limit),),
+        ).fetchall()
+        out: dict[bytes, list[int]] = {}
+        for task_id, n in rows:
+            out.setdefault(task_id, []).append(int(n))
+        return out
+
     def count_batches_pending_collection(self) -> int:
         """Collection jobs still awaiting an aggregate result."""
         return int(

@@ -819,6 +819,23 @@ device_cost_us_per_report = REGISTRY.gauge(
     "and phase (an op's cumulative phase seconds over its cumulative "
     "rows — what the device-lane busy time BUYS per report)",
 )
+engine_prewarm_total = REGISTRY.counter(
+    "janus_engine_prewarm_total",
+    "manifest-driven engine prewarm outcomes per specialization "
+    '(outcome="warmed" compiled/loaded before use, "deferred" pushed '
+    "past the boot budget to the background warmer (each later also "
+    'counts warmed/failed), "skipped_covered" legacy warmup skipped a '
+    'geometry the manifest prewarm owns, "unsupported" a recorded '
+    'variant the warmer cannot synthesize, "no_task" no provisioned '
+    'task matches the recorded vdaf, "failed")',
+)
+engine_prewarm_seconds = REGISTRY.histogram(
+    "janus_engine_prewarm_seconds",
+    "wall seconds to warm one recorded specialization at boot (a "
+    "persistent-cache hit traces in well under a second; a miss pays "
+    "the full XLA compile — the gap IS the cache's value)",
+    buckets=COMPILE_BUCKETS,
+)
 boot_phase_seconds = REGISTRY.gauge(
     "janus_boot_phase_seconds",
     "wall seconds of each named bring-up phase on the last boot "

@@ -30,9 +30,10 @@ production binary:
 
   3. **Boot-phase timeline** (`BootTimeline`): janus_main records named
      bring-up phases (imports → config → backend init → datastore →
-     engine warm → listener up) as one contiguous sequence from the
-     kernel-reported process start to /readyz-ready; served at
-     `GET /debug/boot` and exported as
+     engine_warm_manifest (shape-manifest load) → engine_warm (the
+     boot-budget AOT prewarm + legacy warmup) → listener up) as one
+     contiguous sequence from the kernel-reported process start to
+     /readyz-ready; served at `GET /debug/boot` and exported as
      `janus_boot_phase_seconds{phase}` so cold-start work (ROADMAP
      item 1) has a live baseline and a regression gate.
 

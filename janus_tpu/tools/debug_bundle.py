@@ -2,7 +2,9 @@
 
     python scripts/debug_bundle.py --url http://127.0.0.1:9001 \\
         [--url http://127.0.0.1:9002 ...] [--config-file cfg.yaml] \\
-        [--journal-dir /var/janus/journal] [--out bundle.tar.gz]
+        [--journal-dir /var/janus/journal] \\
+        [--shape-manifest ~/.cache/janus_tpu_xla/shape_manifest.jsonl] \\
+        [--out bundle.tar.gz]
 
 Snapshots every introspection endpoint of one or several binaries'
 health listeners — /metrics (both exposition modes), /statusz,
@@ -118,11 +120,57 @@ def journal_dir_state(path: str) -> dict:
     }
 
 
+def shape_manifest_state(path: str, aot_dir: str | None = None) -> dict:
+    """Non-content inventory of the shape manifest + the AOT blob dir
+    (names/sizes only; entry counts come from a tolerant parse — a
+    corrupt manifest is evidence, not an error). `aot_dir` defaults to
+    the manifest's sibling `aot/` (the standard layout under the
+    compile cache dir); pass it explicitly for a relocated manifest."""
+    out: dict = {"path": path}
+    try:
+        st = os.stat(path)
+        out["bytes"] = st.st_size
+        out["mtime"] = st.st_mtime
+    except OSError as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    try:
+        # READ-ONLY parse: a diagnostic tool must never compact/rewrite
+        # the live manifest — the corrupt lines ARE the evidence
+        from janus_tpu.aggregator.shape_manifest import inspect_file
+
+        entries, stats = inspect_file(path)
+        out["entries"] = len(entries)
+        out["load"] = stats
+    except Exception as e:  # stdlib-only parse, but stay non-fatal
+        out["parse_error"] = f"{type(e).__name__}: {e}"
+    aot_dir = aot_dir or os.path.join(os.path.dirname(path), "aot")
+    blobs = []
+    try:
+        for name in sorted(os.listdir(aot_dir)):
+            full = os.path.join(aot_dir, name)
+            try:
+                blobs.append({"name": name, "bytes": os.stat(full).st_size})
+            except OSError:
+                continue
+        out["aot"] = {
+            "dir": aot_dir,
+            "blobs": blobs,
+            "blob_count": len(blobs),
+            "total_bytes": sum(b["bytes"] for b in blobs),
+        }
+    except OSError as e:
+        out["aot"] = {"dir": aot_dir, "error": f"{type(e).__name__}: {e}"}
+    return out
+
+
 def collect_bundle(
     urls: list[str],
     out_path: str | None = None,
     config_file: str | None = None,
     journal_dir: str | None = None,
+    shape_manifest: str | None = None,
+    aot_dir: str | None = None,
     timeout: float = 10.0,
     now: float | None = None,
 ) -> dict:
@@ -208,6 +256,14 @@ def collect_bundle(
             f"journal:{journal_dir}",
         )
 
+    if shape_manifest:
+        state = shape_manifest_state(shape_manifest, aot_dir=aot_dir)
+        add_file(
+            f"{bundle_name}/shape-manifest.json",
+            json.dumps(state, indent=2, default=str).encode(),
+            f"shape_manifest:{shape_manifest}",
+        )
+
     manifest["bundle_path"] = os.path.abspath(out_path)
     manifest_bytes = json.dumps(manifest, indent=2, default=str).encode()
 
@@ -242,6 +298,17 @@ def main(argv=None) -> int:
         "--journal-dir",
         help="upload-journal directory to inventory (names/sizes only)",
     )
+    ap.add_argument(
+        "--shape-manifest",
+        help="shape manifest file to inventory (entry counts + AOT blob "
+        "names/sizes, no contents)",
+    )
+    ap.add_argument(
+        "--aot-dir",
+        help="AOT executable-blob dir to inventory (default: the "
+        "manifest's sibling aot/ — the standard layout under the "
+        "compile cache dir)",
+    )
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
     manifest = collect_bundle(
@@ -249,6 +316,8 @@ def main(argv=None) -> int:
         out_path=args.out,
         config_file=args.config_file,
         journal_dir=args.journal_dir,
+        shape_manifest=args.shape_manifest,
+        aot_dir=args.aot_dir,
         timeout=args.timeout,
     )
     errors = [f for f in manifest["files"] if f.get("error")]

@@ -161,12 +161,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _driver_cfg(path, db, health_port, ttl_s, cooldown_s, extra: str = ""):
+def _driver_cfg(
+    path, db, health_port, ttl_s, cooldown_s, extra: str = "",
+    cache_dir: str = "~/.cache/janus_tpu_xla",
+):
     cfg = (
         f"database: {{url: {db}}}\n"
         f'health_check_listen_address: "127.0.0.1:{health_port}"\n'
         "jax_platform: cpu\n"
-        "compilation_cache_dir: ~/.cache/janus_tpu_xla\n"
+        f"compilation_cache_dir: {cache_dir}\n"
         "min_job_discovery_delay_secs: 0.1\n"
         "max_job_discovery_delay_secs: 0.5\n"
         f"worker_lease_duration_secs: {ttl_s}\n"
@@ -187,6 +190,13 @@ def _spawn_driver(cfg_path, key, log_path, failpoints: str | None, extra_env=Non
         PYTHONPATH=REPO,
         DATASTORE_KEYS=key,
         JAX_PLATFORMS="cpu",
+    )
+    # hermetic shape manifest per scenario run: a stale manifest
+    # inherited from the developer/test environment would make every
+    # driver boot pay an unrelated prewarm pass (scenarios that test
+    # the prewarm itself pass an explicit path via extra_env)
+    env["JANUS_SHAPE_MANIFEST"] = os.path.join(
+        os.path.dirname(str(cfg_path)), "shape-manifest.jsonl"
     )
     env.update(extra_env or {})
     if failpoints:
@@ -1056,6 +1066,7 @@ def run_device_hang(
         first_expiry = None
         released_at = None  # wall clock when the FIRST (hung) lease left
         quarantined_seen = False
+        quarantined_at = None  # monotonic when quarantine first observed
         stalled_stack_seen = False
         abandoned_max = 0.0
         cap = None
@@ -1076,6 +1087,8 @@ def run_device_hang(
                 mtext = _scrape(port, "/metrics")
                 backend = _metric_samples(mtext, "janus_engine_backend")
                 if backend.get('state="quarantined",vdaf="count"') == 1.0:
+                    if not quarantined_seen:
+                        quarantined_at = time.monotonic()
                     quarantined_seen = True
                 ab = _metric_samples(mtext, "janus_abandoned_dispatch_threads")
                 abandoned_max = max(abandoned_max, *(ab.values() or [0.0]))
@@ -1117,13 +1130,33 @@ def run_device_hang(
         # usually finishes on host fallback BEFORE the canary's
         # cool-down elapses; the restore is observed live) ------------
         restore_deadline = time.monotonic() + 60
+        restored_at = None
         mtext = _scrape(port, "/metrics")
         while time.monotonic() < restore_deadline:
             mtext = _scrape(port, "/metrics")
             quar = _metric_samples(mtext, "janus_engine_quarantines_total")
             if sum(v for k, v in quar.items() if 'event="restored"' in k) >= 1:
+                restored_at = time.monotonic()
                 break
             time.sleep(0.1)
+
+        # warm canary restore (ISSUE 14): with the persistent compile
+        # cache on (driver YAML) the canary's recompile+probe is a disk
+        # load, so quarantine-open -> restored must be FAST — the
+        # canary cool-down plus a bounded warm recompile, nothing like
+        # the cold multi-minute rebuild this scenario used to tolerate.
+        # 20s leaves CI headroom over the ~1.5s cool-down + warm probe.
+        restore_elapsed = (
+            None
+            if quarantined_at is None or restored_at is None
+            else restored_at - quarantined_at
+        )
+        result["restore_elapsed_s"] = (
+            round(restore_elapsed, 2) if restore_elapsed is not None else None
+        )
+        result["restore_warm_ok"] = (
+            restore_elapsed is not None and restore_elapsed <= 20.0
+        )
 
         # --- steady state: restored to device, counters tell the story --
         hung = _metric_samples(mtext, "janus_hung_dispatches_total")
@@ -1218,6 +1251,208 @@ def run_device_hang(
             helper_srv.stop()
         leader_ds.close()
         helper_ds.close()
+
+
+def run_cold_start(
+    pairs: int = 1,
+    full: bool = False,
+    warm_budget_s: float = 10.0,
+    workdir: str | None = None,
+) -> dict:
+    """Cold-start A/B (ISSUE 14): interleaved cold-cache vs warm-cache
+    boots of the REAL driver binary, restart-to-first-dispatch measured
+    via /debug/boot (phase sums proven exact by the boot-timeline
+    tests). Both boots replay the SAME shape manifest through the AOT
+    prewarm engine before /readyz flips ready — so ready means "every
+    recorded specialization compiled", and the boot total IS the
+    restart-to-first-dispatch number (the first real dispatch after
+    ready runs an already-compiled program). The only difference
+    between the two boots is the persistent XLA compile cache: empty
+    (cold — every specialization pays trace + XLA compile) vs populated
+    by the cold boot (warm — trace + disk load).
+
+    Gates: warm restart-to-first-dispatch under `warm_budget_s` (the
+    ROADMAP item 1 target: 10 s), warm at least 1.5x (smoke) / 3x
+    (full) faster than cold, prewarm observed live on the warm boot
+    (janus_engine_prewarm_total warmed > 0 AND statusz engine_prewarm
+    cache hits > 0), and /debug/boot carrying the engine_warm_manifest
+    sub-phase with ready only after the prewarm set compiled."""
+    from janus_tpu.aggregator.shape_manifest import ShapeManifest
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore
+    from janus_tpu.messages import Role
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-coldstart-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    db = os.path.join(tmp, "leader.sqlite")
+    ds = Datastore(db, Crypter([key_bytes]), RealClock())
+    result: dict = {"workdir": tmp, "schedule": "cold_start", "pairs": pairs}
+
+    # two provisioned tasks with distinct circuits, so the manifest's
+    # recorded geometry spans real production variety (count is the
+    # cheap compile, histogram carries joint randomness and costs more
+    # — its cold trace+compile is the 6-17 s/program class). The smoke
+    # drops histogram to keep the tier-1 wall time bounded; the full
+    # record (bench --mode served / standalone) measures both.
+    insts = (
+        (VdafInstance.count(), VdafInstance.histogram(length=4))
+        if full
+        else (VdafInstance.count(),)
+    )
+    for i, inst in enumerate(insts):
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), inst, Role.LEADER)
+            .with_(
+                collector_hpke_config=generate_hpke_config_and_private_key(
+                    config_id=30 + i
+                ).config,
+            )
+            .build()
+        )
+        ds.run_tx(lambda tx, t=task: tx.put_task(t), "provision")
+    ds.close()
+
+    # the manifest both boots replay: every (op, bucket) specialization
+    # a serving driver observes on these two tasks — exactly what a
+    # production restart finds on disk. Costs are descending so the
+    # priority order is deterministic.
+    def seed_manifest(path: str) -> int:
+        man = ShapeManifest(path)
+        n = 0
+        for inst in insts:
+            for b in (32, 64, 128):
+                for op in ("leader_init", "helper_init", "aggregate"):
+                    man.record(inst.to_dict(), op, b, (op, b), float(b) / 10, rows=b)
+                    n += 1
+            man.record(
+                inst.to_dict(), "aggregate_pending", 64,
+                ("aggregate_pending", 8, 64), 3.0, rows=64,
+            )
+            n += 1
+        return n
+
+    def one_boot(idx: int, label: str, cache_dir: str, manifest: str) -> dict:
+        port = _free_port()
+        cfg = _driver_cfg(
+            os.path.join(tmp, f"driver-{idx}-{label}.yaml"),
+            db,
+            port,
+            600,
+            1.5,
+            cache_dir=cache_dir,
+            extra="engine:\n  prewarm_boot_budget_secs: 300\n",
+        )
+        drv = _spawn_driver(
+            cfg,
+            key,
+            os.path.join(tmp, f"driver-{idx}-{label}.log"),
+            None,
+            extra_env={"JANUS_SHAPE_MANIFEST": manifest},
+        )
+        boot: dict = {"label": label}
+        try:
+            _wait_healthz(port, deadline_s=600.0)
+            deadline = time.monotonic() + 60
+            doc = {}
+            while time.monotonic() < deadline:
+                doc = json.loads(_scrape(port, "/debug/boot"))
+                if doc.get("ready"):
+                    break
+                time.sleep(0.1)
+            boot["ready_ok"] = bool(doc.get("ready"))
+            boot["total_s"] = doc.get("total_s")
+            boot["phases"] = {
+                p["phase"]: p["seconds"] for p in doc.get("phases", [])
+            }
+            boot["manifest_phase_ok"] = "engine_warm_manifest" in boot["phases"]
+            mtext = _scrape(port, "/metrics")
+            pw = _metric_samples(mtext, "janus_engine_prewarm_total")
+            boot["prewarm_total"] = pw
+            boot["warmed"] = sum(
+                v for k, v in pw.items() if 'outcome="warmed"' in k
+            )
+            statusz = json.loads(_scrape(port, "/statusz"))
+            ep = statusz.get("engine_prewarm", {})
+            boot["cache_hits"] = ep.get("prewarm", {}).get("cache_hits", 0)
+            boot["cache_misses"] = ep.get("prewarm", {}).get("cache_misses", 0)
+            boot["manifest_entries"] = ep.get("manifest", {}).get("entries", 0)
+            boot["aot_loads"] = ep.get("aot", {}).get("loads", 0)
+            boot["aot_saves"] = ep.get("aot", {}).get("saves", 0)
+            drv.send_signal(signal.SIGTERM)
+            boot["drain_rc"] = drv.wait(timeout=60)
+        finally:
+            if drv.poll() is None:
+                drv.kill()
+        return boot
+
+    boots: list[dict] = []
+    try:
+        for i in range(pairs):
+            cache_dir = os.path.join(tmp, f"xla-cache-{i}")
+            manifest = os.path.join(tmp, f"shape-manifest-{i}.jsonl")
+            result["manifest_seeded_entries"] = seed_manifest(manifest)
+            # interleaved: cold then warm on the same (cache, manifest)
+            # pair — the warm boot reads exactly what the cold one wrote
+            boots.append(one_boot(i, "cold", cache_dir, manifest))
+            boots.append(one_boot(i, "warm", cache_dir, manifest))
+        result["boots"] = boots
+        colds = [b for b in boots if b["label"] == "cold"]
+        warms = [b for b in boots if b["label"] == "warm"]
+        ok_shape = all(
+            b.get("ready_ok") and b.get("total_s") is not None for b in boots
+        )
+        result["boots_ready_ok"] = ok_shape
+        if ok_shape:
+            cold_s = sorted(b["total_s"] for b in colds)[len(colds) // 2]
+            warm_s = sorted(b["total_s"] for b in warms)[len(warms) // 2]
+            result["cold_restart_to_first_dispatch_s"] = round(cold_s, 3)
+            result["warm_restart_to_first_dispatch_s"] = round(warm_s, 3)
+            result["speedup"] = round(cold_s / max(1e-9, warm_s), 2)
+            # THE acceptance numbers (ISSUE 14 / ROADMAP item 1): warm
+            # restart under 10 s, and >= 3x faster than cold (the full
+            # record gate; the tier-1 smoke gates 1.5x so a CPU-starved
+            # CI run cannot flake a real regression signal)
+            result["warm_under_budget_ok"] = warm_s < warm_budget_s
+            result["speedup_gate"] = 3.0 if full else 1.5
+            result["speedup_ok"] = result["speedup"] >= result["speedup_gate"]
+            result["manifest_phase_ok"] = all(
+                b.get("manifest_phase_ok") for b in boots
+            )
+            result["prewarm_observed_ok"] = all(
+                b.get("warmed", 0) >= result["manifest_seeded_entries"]
+                for b in boots
+            )
+            result["warm_cache_hits_ok"] = all(
+                b.get("cache_hits", 0) > 0 for b in warms
+            )
+            result["cold_cache_misses_ok"] = all(
+                b.get("cache_misses", 0) > 0 for b in colds
+            )
+            # the AOT executable layer: cold boots SERIALIZE compiled
+            # programs, warm boots LOAD them (no re-trace)
+            result["cold_aot_saves_ok"] = all(
+                b.get("aot_saves", 0) > 0 for b in colds
+            )
+            result["warm_aot_loads_ok"] = all(
+                b.get("aot_loads", 0) > 0 for b in warms
+            )
+            result["drain_ok"] = all(b.get("drain_rc") == 0 for b in boots)
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = bool(boots) and all(
+            v for k, v in result.items() if k.endswith("_ok")
+        )
+        return result
+    finally:
+        # one_boot() kills any straggler in its own finally; the
+        # workdir (sqlite, caches, logs) is kept for postmortems like
+        # every other scenario's
+        pass
 
 
 def _histogram_counts(text: str, name: str) -> dict[str, float]:
@@ -1824,7 +2059,10 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--scenario",
-        choices=["crash_storm", "db_outage", "device_hang", "pipeline", "resident"],
+        choices=[
+            "crash_storm", "db_outage", "device_hang", "pipeline", "resident",
+            "cold_start",
+        ],
         default="crash_storm",
         help="crash_storm = driver SIGKILL + helper storms (default); "
         "db_outage = datastore outage under upload load (journal spill, "
@@ -1835,7 +2073,10 @@ def main(argv=None) -> int:
         "a stretched helper RTT is in flight, exactly-once); resident = "
         "device-resident accumulator flush contract (LRU eviction, "
         "quarantine sweep, SIGTERM drain each flush resident state; "
-        "collections exact)",
+        "collections exact); cold_start = interleaved cold-cache vs "
+        "warm-cache real-binary boots, restart-to-first-dispatch via "
+        "/debug/boot (manifest prewarm before ready, warm < 10 s, "
+        "speedup gated)",
     )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
@@ -1863,6 +2104,12 @@ def main(argv=None) -> int:
         )
     elif args.scenario == "resident":
         result = run_resident(
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    elif args.scenario == "cold_start":
+        result = run_cold_start(
+            pairs=1 if args.smoke else 2,
             full=not args.smoke,
             workdir=args.workdir,
         )

@@ -137,6 +137,10 @@ def main(argv=None) -> int:
         "janus_device_cost_seconds_total",
         "janus_device_cost_us_per_report",
         "janus_boot_phase_seconds",
+        # shape-manifest AOT prewarm (ISSUE 14) — registered at import
+        # in every binary
+        "janus_engine_prewarm_total",
+        "janus_engine_prewarm_seconds",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -206,6 +210,36 @@ def main(argv=None) -> int:
                     for key in ("enabled", "roles", "top_frames", "overhead_ratio"):
                         if key not in prof:
                             errors.append(f"/statusz profile missing {key!r}")
+                # shape-manifest AOT prewarm (ISSUE 14): compile cache
+                # + AOT blob state, manifest inventory and the prewarm
+                # outcome counters — the cold-start surface an operator
+                # reads after a slow boot
+                ep = snap.get("engine_prewarm")
+                if not isinstance(ep, dict):
+                    errors.append("/statusz missing the engine_prewarm section")
+                else:
+                    for key in ("compile_cache", "aot", "manifest", "prewarm"):
+                        if key not in ep:
+                            errors.append(f"/statusz engine_prewarm missing {key!r}")
+                    for key in ("enabled", "dir", "files", "bytes"):
+                        if key not in (ep.get("compile_cache") or {}):
+                            errors.append(
+                                f"/statusz engine_prewarm compile_cache missing {key!r}"
+                            )
+                    for key in ("state", "warmed", "cache_hits", "cache_misses"):
+                        if key not in (ep.get("prewarm") or {}):
+                            errors.append(
+                                f"/statusz engine_prewarm prewarm missing {key!r}"
+                            )
+                    for key in ("enabled", "blobs", "loads", "saves"):
+                        if key not in (ep.get("aot") or {}):
+                            errors.append(
+                                f"/statusz engine_prewarm aot missing {key!r}"
+                            )
+                    if "installed" not in (ep.get("manifest") or {}):
+                        errors.append(
+                            "/statusz engine_prewarm manifest missing 'installed'"
+                        )
                 dc = snap.get("device_cost")
                 if not isinstance(dc, dict):
                     errors.append("/statusz missing the device_cost section")

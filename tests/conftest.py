@@ -20,6 +20,16 @@ os.environ.setdefault(
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+# Shape-manifest hermeticity: binaries booted by tests (in-process or
+# as subprocesses inheriting this env) must not read/append the
+# developer's real manifest next to the compile cache — a stale
+# populated manifest would make every test boot pay a prewarm pass.
+import tempfile as _tempfile
+
+os.environ.setdefault(
+    "JANUS_SHAPE_MANIFEST",
+    os.path.join(_tempfile.mkdtemp(prefix="janus-shapes-"), "shape_manifest.jsonl"),
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,593 @@
+"""Shape manifest + AOT engine prewarm (ISSUE 14; docs/ARCHITECTURE.md
+"Cold-start and prewarm"): the crash-tolerance/bounding contract of the
+persisted manifest, the EngineCache first-dispatch feed, the
+manifest-driven prewarm (bit-identical to cold compiles, boot-budget
+deferral, readiness gating), the fixed warmup (pending-job buckets +
+manifest dedup) and the serialized-executable AOT cache."""
+
+import json
+import os
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from janus_tpu.aggregator import aot_cache, prewarm, shape_manifest
+from janus_tpu.aggregator.shape_manifest import MANIFEST_VERSION, ShapeManifest
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+@pytest.fixture(autouse=True)
+def _isolated_state():
+    prewarm.reset_for_tests()
+    aot_cache.reset_for_tests()
+    shape_manifest.uninstall_manifest()
+    yield
+    prewarm.reset_for_tests()
+    aot_cache.reset_for_tests()
+    shape_manifest.uninstall_manifest()
+
+
+def _count_entry(man, op="leader_init", bucket=32, cost=1.0, key=None):
+    man.record(
+        {"kind": "count"}, op, bucket, key or (op, bucket), cost, rows=bucket
+    )
+
+
+# ---------------------------------------------------------------------------
+# manifest file contract
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_last_line_wins(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    m = ShapeManifest(p)
+    _count_entry(m, "leader_init", 32, cost=1.5)
+    _count_entry(m, "aggregate", 32, cost=0.5)
+    _count_entry(m, "leader_init", 32, cost=0.9)  # re-observation
+    m2 = ShapeManifest(p)
+    m2.load()
+    es = m2.entries()
+    assert len(es) == 2
+    # priority order is cost-descending; cost keeps the MAX (a cheap
+    # cache-hit re-record must not demote a real compile), seen sums
+    assert es[0]["op"] == "leader_init"
+    assert es[0]["cost_s"] == 1.5 and es[0]["seen"] == 2
+    assert m2.covers({"kind": "count"}, "aggregate", 32)
+    assert not m2.covers({"kind": "count"}, "helper_init", 32)
+    assert not m2.covers({"kind": "sum", "bits": 8}, "aggregate", 32)
+
+
+def test_manifest_truncated_tail_loads_valid_prefix(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    m = ShapeManifest(p)
+    _count_entry(m, "leader_init", 32)
+    _count_entry(m, "helper_init", 32)
+    with open(p, "ab") as f:
+        f.write(b'{"v":1,"crc":12,"e"')  # torn mid-append
+    m2 = ShapeManifest(p)
+    stats = m2.load()
+    assert stats["skipped_corrupt"] == 1
+    assert len(m2.entries()) == 2  # valid prefix fully loaded
+
+
+def test_manifest_crc_damage_and_junk_skipped(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    m = ShapeManifest(p)
+    _count_entry(m, "leader_init", 32)
+    entry = {"vdaf": {"kind": "count"}, "op": "aggregate", "bucket": 32, "key": ["aggregate", 32]}
+    with open(p, "ab") as f:
+        # bad CRC on a well-formed line, then outright junk
+        f.write(
+            json.dumps({"v": MANIFEST_VERSION, "crc": 1, "e": entry}).encode() + b"\n"
+        )
+        f.write(b"not json at all\n")
+        f.write(b'[1,2,3]\n')
+    m2 = ShapeManifest(p)
+    stats = m2.load()
+    assert stats["skipped_corrupt"] == 3
+    assert len(m2.entries()) == 1
+    assert not m2.covers({"kind": "count"}, "aggregate", 32)
+
+
+def test_manifest_version_skew_skipped_and_counted(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    m = ShapeManifest(p)
+    _count_entry(m, "leader_init", 32)
+    entry = {"vdaf": {"kind": "count"}, "op": "x", "bucket": 64, "key": ["x", 64]}
+    line = {"v": MANIFEST_VERSION + 1, "crc": zlib.crc32(b"x"), "e": entry}
+    with open(p, "ab") as f:
+        f.write(json.dumps(line).encode() + b"\n")
+    m2 = ShapeManifest(p)
+    stats = m2.load()
+    assert stats["skipped_version"] == 1
+    assert len(m2.entries()) == 1
+
+
+def test_manifest_compaction_bounds_file_and_entries(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    m = ShapeManifest(p, max_entries=8)
+    for b in (32, 64, 128, 256, 512, 1024):
+        for op in ("leader_init", "helper_init", "aggregate"):
+            m.record({"kind": "count"}, op, b, (op, b), b / 100.0)
+    st = m.status()
+    assert st["entries"] <= 8
+    assert st["file_lines"] <= max(64, 2 * 8)
+    assert st["compactions"] >= 1
+    # highest-cost entries survive the bound
+    kept = {(e["op"], e["bucket"]) for e in m.entries()}
+    assert ("leader_init", 1024) in kept and ("leader_init", 32) not in kept
+    # the compacted file reloads clean
+    m2 = ShapeManifest(p, max_entries=8)
+    stats = m2.load()
+    assert stats["skipped_corrupt"] == 0
+    assert {(e["op"], e["bucket"]) for e in m2.entries()} == kept
+
+
+def test_manifest_covers_is_variant_aware(tmp_path):
+    """A manifest holding only the cross-task `_vk` variant of an op
+    must NOT cover the plain variant: they are distinct compiled
+    programs, and the legacy warmup warms the plain one."""
+    m = ShapeManifest(str(tmp_path / "m.jsonl"))
+    m.record({"kind": "count"}, "leader_init", 32, ("leader_init_vk", 32), 1.0)
+    assert not m.covers({"kind": "count"}, "leader_init", 32)
+    m.record({"kind": "count"}, "leader_init", 32, ("leader_init", 32), 1.0)
+    assert m.covers({"kind": "count"}, "leader_init", 32)
+
+
+def test_inspect_file_is_read_only(tmp_path):
+    """The debug-bundle inventory parse must not compact/rewrite the
+    manifest — corrupt lines are the evidence being captured."""
+    p = str(tmp_path / "m.jsonl")
+    m = ShapeManifest(p, max_entries=2)
+    for b in (32, 64, 128, 256):
+        _count_entry(m, "leader_init", b, cost=b / 100.0)
+    with open(p, "ab") as f:
+        f.write(b"torn garbage line\n")
+    before = open(p, "rb").read()
+    entries, stats = shape_manifest.inspect_file(p)
+    assert stats["skipped_corrupt"] == 1
+    assert open(p, "rb").read() == before  # byte-identical: no rewrite
+    # while a normal (product-path) load with the same bound compacts
+    m2 = ShapeManifest(p, max_entries=2)
+    m2.load()
+    assert open(p, "rb").read() != before
+
+
+def test_warmup_no_dedupe_sentinel_warms_covered_geometry(tmp_path):
+    """janus_main passes _NO_DEDUPE when the manifest prewarm did not
+    run (disabled/failed): a covered geometry must then still warm —
+    otherwise BOTH paths skip it and the first job compiles cold."""
+    from janus_tpu.binary_utils import _NO_DEDUPE, warmup_engines
+
+    eph, task = _provisioned_store()
+    try:
+        shape_manifest.install_manifest(str(tmp_path / "m.jsonl"))
+        man = shape_manifest.installed()
+        for op in ("leader_init", "helper_init", "aggregate"):
+            man.record(task.vdaf.to_dict(), op, 32, (op, 32), 1.0)
+        r = warmup_engines(eph.datastore, manifest=_NO_DEDUPE)
+        assert len(r["warmed"]) == 1 and r["skipped_covered"] == 0
+    finally:
+        eph.cleanup()
+
+
+def test_manifest_missing_file_is_empty_not_fatal(tmp_path):
+    m = ShapeManifest(str(tmp_path / "nope" / "m.jsonl"))
+    assert m.load()["loaded"] == 0
+    assert m.entries() == []
+    assert m.status()["file_bytes"] == 0
+
+
+def test_manifest_concurrent_record_while_reading_race_free(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    m = ShapeManifest(p, max_entries=32)
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(50):
+                m.record({"kind": "count"}, f"op{tid}", 32 * (1 + i % 4), (f"op{tid}", i), 0.1)
+        except Exception as e:  # pragma: no cover - the assertion
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(100):
+                m.entries()
+                m.covers({"kind": "count"}, "op0", 32)
+                m.status()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(3)]
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    # the file reloads clean after the concurrent churn (+compactions)
+    m2 = ShapeManifest(p, max_entries=32)
+    assert m2.load()["skipped_corrupt"] == 0
+
+
+# ---------------------------------------------------------------------------
+# EngineCache feed + prewarm
+# ---------------------------------------------------------------------------
+
+
+def _dispatch_once(eng, n=20, seed=1):
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    rng = np.random.default_rng(seed)
+    args, _ = make_report_batch(
+        eng.inst, random_measurements(eng.inst, n, rng), seed=seed
+    )
+    nonce, parts, meas, proof, blind0, hseed, blind1 = args
+    out0, seed0, ver0, part0 = eng.leader_init(nonce, parts, meas, proof, blind0)
+    ok = np.ones(n, dtype=bool)
+    p0 = part0 if part0 is not None else np.zeros((n, 2), dtype=np.uint64)
+    out1, mask, pm = eng.helper_init(nonce, parts, hseed, blind1, ver0, p0, ok)
+    agg = eng.aggregate(out0, ok)
+    pend = eng.aggregate_pending(out0, (np.arange(n) % 4).astype(np.int32), 4)
+    return args, (out1, mask, pm, agg, pend)
+
+
+def test_engine_first_dispatch_feeds_installed_manifest(tmp_path):
+    from janus_tpu.aggregator.engine_cache import EngineCache
+
+    man = shape_manifest.install_manifest(str(tmp_path / "m.jsonl"))
+    eng = EngineCache(VdafInstance.count(), bytes(range(16)))
+    _dispatch_once(eng)
+    ops = {(e["op"], e["bucket"]) for e in man.entries()}
+    assert {("leader_init", 32), ("helper_init", 32), ("aggregate", 32)} <= ops
+    # the resident kk-geometry records under its own compile key
+    pend = [e for e in man.entries() if e["op"] == "aggregate_pending"]
+    assert pend and pend[0]["key"] == ["aggregate_pending", 4, 32]
+    # re-dispatching the same specializations appends nothing new
+    n_entries = len(man.entries())
+    _dispatch_once(eng)
+    assert len(man.entries()) == n_entries
+
+
+def test_record_dispatch_skips_fakes_and_uninstalled():
+    # no manifest installed: a dispatch record is a silent no-op
+    shape_manifest.record_dispatch(
+        VdafInstance.count(), "leader_init", 32, ("leader_init", 32), 1.0
+    )
+    # fakes never earn a prewarm slot even when installed
+
+
+def test_prewarm_bit_identical_and_outcomes(tmp_path):
+    from janus_tpu.aggregator.engine_cache import EngineCache
+
+    man = shape_manifest.install_manifest(str(tmp_path / "m.jsonl"))
+    inst = VdafInstance.count()
+    key = bytes(range(16))
+    eng = EngineCache(inst, key)
+    args, cold = _dispatch_once(eng, seed=7)
+
+    # a FRESH engine warmed purely from the manifest...
+    eng2 = EngineCache(inst, key)
+    w = prewarm._Warmer()
+    outcomes = [w.warm(eng2, e) for e in man.entries()]
+    assert outcomes and all(o == "warmed" for o in outcomes)
+    # ...produces bit-identical results on the same real inputs
+    nonce, parts, meas, proof, blind0, hseed, blind1 = args
+    n = nonce.shape[0]
+    ok = np.ones(n, dtype=bool)
+    out0b, _, ver0b, part0b = eng2.leader_init(nonce, parts, meas, proof, blind0)
+    p0 = part0b if part0b is not None else np.zeros((n, 2), dtype=np.uint64)
+    out1b, maskb, pmb = eng2.helper_init(nonce, parts, hseed, blind1, ver0b, p0, ok)
+    aggb = eng2.aggregate(out0b, ok)
+    out1, mask, pm, agg, _ = cold
+    assert agg == aggb
+    assert (mask == maskb).all()
+    assert (np.asarray(pm) == np.asarray(pmb)).all()
+    assert all(
+        (np.asarray(a) == np.asarray(b)).all()
+        for a, b in zip(out1.to_numpy(), out1b.to_numpy())
+    )
+
+
+def test_prewarm_engines_ready_event_and_budget_deferral(tmp_path):
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Role
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+
+    man = shape_manifest.install_manifest(str(tmp_path / "m.jsonl"))
+    for op in ("leader_init", "helper_init", "aggregate"):
+        _count_entry(man, op, 32, cost=1.0)
+    eph = EphemeralDatastore()
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.HELPER)
+        .with_(
+            collector_hpke_config=generate_hpke_config_and_private_key(
+                config_id=3
+            ).config,
+        )
+        .build()
+    )
+    eph.datastore.run_tx(lambda tx: tx.put_task(task))
+    try:
+        ev = threading.Event()
+        summary = prewarm.prewarm_engines(
+            eph.datastore, man, boot_budget_s=120.0, ready_event=ev
+        )
+        assert ev.is_set()
+        assert summary["warmed"] == 3 and summary["deferred"] == 0
+        st = prewarm.engine_prewarm_status()
+        assert st["prewarm"]["state"] == "done"
+        assert st["prewarm"]["warmed"] == 3
+        assert st["manifest"]["installed"] is True
+
+        # budget 0: the priority set is empty, EVERYTHING defers to the
+        # background warmer — readiness is still released immediately
+        prewarm.reset_for_tests()
+        ev2 = threading.Event()
+        s2 = prewarm.prewarm_engines(
+            eph.datastore, man, boot_budget_s=0.0, ready_event=ev2
+        )
+        assert ev2.is_set() and s2["deferred"] == 3
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if prewarm.engine_prewarm_status()["prewarm"]["state"] == "done":
+                break
+            time.sleep(0.05)
+        assert prewarm.engine_prewarm_status()["prewarm"]["state"] == "done"
+    finally:
+        eph.cleanup()
+
+
+def test_prewarm_no_matching_task_counts_no_task(tmp_path):
+    from janus_tpu.datastore.store import EphemeralDatastore
+
+    man = shape_manifest.install_manifest(str(tmp_path / "m.jsonl"))
+    man.record({"kind": "sum", "bits": 8}, "aggregate", 32, ("aggregate", 32), 1.0)
+    eph = EphemeralDatastore()
+    try:
+        ev = threading.Event()
+        summary = prewarm.prewarm_engines(
+            eph.datastore, man, boot_budget_s=30.0, ready_event=ev
+        )
+        assert ev.is_set() and summary["warmed"] == 0
+        assert prewarm.engine_prewarm_status()["prewarm"]["no_task"] == 1
+    finally:
+        eph.cleanup()
+
+
+def test_manifest_less_prewarm_degrades_to_noop(tmp_path):
+    """A boot with no manifest (or an empty one) must behave exactly
+    like today: prewarm is a no-op that releases readiness at once."""
+    from janus_tpu.datastore.store import EphemeralDatastore
+
+    eph = EphemeralDatastore()
+    try:
+        ev = threading.Event()
+        summary = prewarm.prewarm_engines(eph.datastore, None, ready_event=ev)
+        assert ev.is_set() and summary == {
+            "entries": 0, "warmed": 0, "deferred": 0, "priority_elapsed_s": 0.0,
+        }
+    finally:
+        eph.cleanup()
+
+
+def test_unsupported_variant_counted_not_fatal(tmp_path):
+    from janus_tpu.aggregator.engine_cache import EngineCache
+
+    man = ShapeManifest(str(tmp_path / "m.jsonl"))
+    man.record({"kind": "count"}, "mystery_op", 32, ("mystery_op_vq", 32), 1.0)
+    man.record({"kind": "count"}, "leader_init", 8, ("leader_init", 8), 1.0)
+    eng = EngineCache(VdafInstance.count(), bytes(range(16)))
+    w = prewarm._Warmer()
+    assert [w.warm(eng, e) for e in man.entries()] == ["unsupported", "unsupported"]
+
+
+# ---------------------------------------------------------------------------
+# warmup_engines: real pending-job buckets + manifest dedup
+# ---------------------------------------------------------------------------
+
+
+def _provisioned_store():
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Role
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+
+    eph = EphemeralDatastore()
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.HELPER)
+        .with_(
+            collector_hpke_config=generate_hpke_config_and_private_key(
+                config_id=9
+            ).config,
+        )
+        .build()
+    )
+    eph.datastore.run_tx(lambda tx: tx.put_task(task))
+    return eph, task
+
+
+def _put_pending_job(ds, task, job_id: bytes, n_reports: int):
+    def tx_body(tx):
+        tx._c.execute(
+            "INSERT INTO aggregation_jobs (task_id, job_id,"
+            " aggregation_parameter, partial_batch_identifier,"
+            " client_interval_start, client_interval_duration, state)"
+            " VALUES (?, ?, ?, ?, 0, 3600, 'in_progress')",
+            (task.task_id.data, job_id, b"", b""),
+        )
+        for i in range(n_reports):
+            tx._c.execute(
+                "INSERT INTO report_aggregations (task_id, job_id,"
+                " report_id, client_time, ord, state)"
+                " VALUES (?, ?, ?, 0, ?, 'waiting')",
+                (task.task_id.data, job_id, job_id + bytes([i, i]), i),
+            )
+
+    ds.run_tx(tx_body)
+
+
+def test_pending_aggregation_job_sizes_tx():
+    eph, task = _provisioned_store()
+    try:
+        _put_pending_job(eph.datastore, task, b"job-aaaaaaaaaaaa", 5)
+        _put_pending_job(eph.datastore, task, b"job-bbbbbbbbbbbb", 40)
+        sizes = eph.datastore.run_tx(
+            lambda tx: tx.get_pending_aggregation_job_sizes()
+        )
+        assert sorted(sizes[task.task_id.data]) == [5, 40]
+    finally:
+        eph.cleanup()
+
+
+def test_warmup_warms_pending_job_buckets_and_skips_covered(tmp_path):
+    from janus_tpu.binary_utils import warmup_engines
+
+    eph, task = _provisioned_store()
+    try:
+        # 40 pending reports -> the 64 bucket, NOT the blind MIN_BUCKET
+        _put_pending_job(eph.datastore, task, b"job-cccccccccccc", 40)
+        man = ShapeManifest(str(tmp_path / "m.jsonl"))
+        r = warmup_engines(eph.datastore, manifest=man)
+        assert [b for _, b in r["warmed"]] == [64]
+        assert r["skipped_covered"] == 0
+        # installed manifest recorded the warm dispatches; a second
+        # warmup skips the whole covered geometry
+        shape_manifest.install_manifest(str(tmp_path / "m2.jsonl"))
+        man2 = shape_manifest.installed()
+        for op in ("leader_init", "helper_init", "aggregate"):
+            man2.record(task.vdaf.to_dict(), op, 64, (op, 64), 1.0)
+        r2 = warmup_engines(eph.datastore)  # uses the installed manifest
+        assert r2["skipped_covered"] == 1 and not r2["warmed"]
+    finally:
+        eph.cleanup()
+
+
+def test_warmup_without_pending_jobs_keeps_min_bucket():
+    from janus_tpu.aggregator.engine_cache import MIN_BUCKET
+    from janus_tpu.binary_utils import warmup_engines
+
+    eph, _ = _provisioned_store()
+    try:
+        r = warmup_engines(eph.datastore)
+        assert [b for _, b in r["warmed"]] == [MIN_BUCKET]
+    finally:
+        eph.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# serialized-executable AOT cache
+# ---------------------------------------------------------------------------
+
+
+_AOT_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax, jax.numpy as jnp
+from janus_tpu.aggregator import aot_cache
+
+aot_cache.arm(sys.argv[1])
+x = np.arange(64, dtype=np.uint64)
+w = aot_cache.wrap(jax.jit(lambda a: a * jnp.uint64(3) + jnp.uint64(1)), "base-1")
+y = np.asarray(w(x))
+st = aot_cache.status()
+print("RESULT", st["loads"], st["saves"], st["errors"], ",".join(map(str, y[:4])))
+"""
+
+
+def test_aot_cache_save_load_bit_identical_across_processes(tmp_path):
+    """The production restart semantics: process A compiles + saves the
+    serialized executable, a FRESH process B deserializes it (no
+    trace) and computes the identical result. Same-process reloads may
+    legitimately fall back (XLA:CPU resident-symbol quirk; covered by
+    the corrupt-blob test's fallback path), so each half runs in its
+    own interpreter — exactly like a restarted driver."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path / "aot")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)  # single device, like the real drivers
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", _AOT_CHILD, d],
+            env=env, capture_output=True, text=True, timeout=240, cwd=repo,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        line = [l for l in out.stdout.splitlines() if l.startswith("RESULT")][-1]
+        _, loads, saves, errors, vals = line.split(" ")
+        return int(loads), int(saves), int(errors), vals
+
+    loads1, saves1, errors1, vals1 = run()  # cold: compiles + saves
+    assert (loads1, saves1, errors1) == (0, 1, 0)
+    loads2, saves2, errors2, vals2 = run()  # warm restart: pure load
+    assert (loads2, saves2, errors2) == (1, 0, 0)
+    assert vals1 == vals2  # bit-identical across the serialize boundary
+    blobs = [n for n in os.listdir(d) if n.endswith(aot_cache.BLOB_SUFFIX)]
+    assert len(blobs) == 1
+
+
+def test_aot_cache_corrupt_blob_falls_back_and_heals(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "aot")
+    aot_cache.arm(d)
+    x = np.arange(32, dtype=np.uint64)
+
+    def fn(a):
+        return a + jnp.uint64(7)
+
+    w1 = aot_cache.wrap(jax.jit(fn), "base-c")
+    ref = np.asarray(w1(x))
+    (blob,) = [n for n in os.listdir(d) if n.endswith(aot_cache.BLOB_SUFFIX)]
+    with open(os.path.join(d, blob), "wb") as f:
+        f.write(b"garbage, not a pickled executable")
+    w2 = aot_cache.wrap(jax.jit(fn), "base-c")
+    out = np.asarray(w2(x))
+    assert (out == ref).all()
+    st = aot_cache.status()
+    assert st["errors"] >= 1
+    # the corrupt blob was deleted and re-saved by the fallback compile
+    assert st["blobs"] == 1 and st["saves"] == 2
+
+
+def test_aot_cache_disarmed_is_passthrough(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    w = aot_cache.wrap(jax.jit(lambda a: a * jnp.uint64(2)), "base-d")
+    out = np.asarray(w(np.arange(8, dtype=np.uint64)))
+    assert (out == np.arange(8, dtype=np.uint64) * 2).all()
+    st = aot_cache.status()
+    assert st["enabled"] is False and st["saves"] == 0 and st["loads"] == 0
+
+
+# ---------------------------------------------------------------------------
+# statusz section shape (what scrape_check enforces on live binaries)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_prewarm_statusz_section_shape(tmp_path):
+    man = shape_manifest.install_manifest(str(tmp_path / "m.jsonl"))
+    _count_entry(man)
+    prewarm.note_compile_cache(str(tmp_path / "cache"))
+    snap = prewarm.engine_prewarm_status()
+    assert {"compile_cache", "aot", "manifest", "prewarm"} <= set(snap)
+    assert {"enabled", "dir", "files", "bytes"} <= set(snap["compile_cache"])
+    assert {"enabled", "blobs", "loads", "saves"} <= set(snap["aot"])
+    assert snap["manifest"]["installed"] is True
+    assert snap["manifest"]["entries"] == 1
+    assert {"state", "warmed", "cache_hits", "cache_misses"} <= set(snap["prewarm"])
+    # registered as a statusz provider in every binary
+    from janus_tpu.statusz import status_snapshot
+
+    assert "engine_prewarm" in status_snapshot()
