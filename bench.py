@@ -2603,6 +2603,253 @@ def _cold_start_record(full: bool = False) -> dict:
     return _run_chaos_subprocess(args, timeout=900 if full else 420)
 
 
+def _fleet_scaling_record(full: bool = False) -> dict:
+    """Fleet scale-out record (scripts/chaos_run.py --scenario fleet):
+    N REAL driver replicas — own fleet identities and shard slices —
+    over one leader store under RTT-bound load. Carries the served-rps
+    scaling curve (1/2/4 replicas full, 1/2 smoke), the measured
+    claim-round-trips-per-job comparison vs the old per-row loop, and
+    the kill/drain/restart chaos gates (zero lease conflicts, steal
+    drain, exact collection)."""
+    args = ["--scenario", "fleet", "--json"]
+    if not full:
+        args.append("--smoke")
+    return _run_chaos_subprocess(args, timeout=900 if full else 480)
+
+
+def _fleet_smoke() -> dict:
+    """In-process fleet smoke (ISSUE 15): TWO driver replicas — each
+    with its own fleet identity and shard slice — over ONE datastore.
+    Replica A claims its shard's jobs on a 2 s lease and DIES holding
+    them (never steps, never releases: the SIGKILL analog), replica B
+    finishes its own shard immediately and STEALS A's jobs once their
+    leases expire past the steal delay. Gates: every job finishes, the
+    collection equals the admitted ground truth exactly, the
+    lease-conflict counter stays at zero (nothing double-stepped), B's
+    claims were batched (jobs per claim tx > 1), and the dead
+    replica's shard drained through the steal fallback."""
+    import dataclasses
+    import secrets as _secrets
+    import tempfile
+    import threading
+
+    from janus_tpu import metrics as _m
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.binary_utils import warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.config import FleetConfig
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore, job_shard_key
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    rec: dict = {}
+    tmp = tempfile.mkdtemp(prefix="janus-bench-fleet-")
+    key = _secrets.token_bytes(16)
+    clock = RealClock()
+    leader_ds = Datastore(os.path.join(tmp, "leader.sqlite"), Crypter([key]), clock)
+    helper_ds = Datastore(os.path.join(tmp, "helper.sqlite"), Crypter([key]), clock)
+    leader_srv = helper_srv = None
+    job_size = 2
+    try:
+        helper_srv = DapServer(DapHttpApp(Aggregator(helper_ds, clock, Config()))).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+        vdaf = VdafInstance.count()
+        collector_kp = generate_hpke_config_and_private_key(config_id=206)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=6),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+        warmup_engines(leader_ds, batch=job_size)
+
+        http = HttpClient()
+        client = Client.with_fetched_configs(
+            ClientParameters(
+                leader_task.task_id,
+                leader_srv.url,
+                helper_srv.url,
+                leader_task.time_precision,
+            ),
+            vdaf,
+            http,
+            clock=clock,
+        )
+        creator = AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=job_size
+            ),
+        )
+        measurements = []
+
+        def upload(n):
+            wave = [(i % 3 != 0) * 1 for i in range(n)]
+            for m in wave:
+                client.upload(m)
+            measurements.extend(wave)
+            creator.run_once()
+
+        def shard_census():
+            jobs = leader_ds.run_tx(
+                lambda tx: tx.get_aggregation_jobs_for_task(leader_task.task_id),
+                "fleet_smoke_census",
+            )
+            by_shard = {0: 0, 1: 0}
+            for j in jobs:
+                by_shard[
+                    job_shard_key(leader_task.task_id.data, j.job_id.data) % 2
+                ] += 1
+            return len(jobs), by_shard
+
+        upload(16)
+        # both shards must be populated for the steal proof to mean
+        # anything; random job ids make an empty shard a ~0.8% event —
+        # top up deterministically instead of flaking
+        for _ in range(6):
+            n_jobs, by_shard = shard_census()
+            if by_shard[0] and by_shard[1]:
+                break
+            upload(job_size)
+        rec["jobs"] = n_jobs
+        rec["jobs_by_shard"] = by_shard
+        rec["both_shards_populated"] = bool(by_shard[0] and by_shard[1])
+
+        fleet_a = FleetConfig(
+            replica_id="bench-fleet-a", shard_count=2, shard_index=0, steal_after_secs=1
+        )
+        fleet_b = FleetConfig(
+            replica_id="bench-fleet-b", shard_count=2, shard_index=1, steal_after_secs=1
+        )
+        conflicts0 = _m.lease_conflicts_total.total()
+        steals0 = _m.lease_steals_total.total()
+        tx0 = _m.lease_acquire_tx_total.get(kind="aggregation", outcome="claimed")
+        jobs0 = _m.lease_acquired_jobs_total.get(kind="aggregation")
+
+        # replica A: claim on a 2 s lease, then die holding the leases
+        dead = AggregationJobDriver(leader_ds, http)
+        held = dead.acquirer(2, fleet=fleet_a)(16)
+        rec["held_by_dead_replica"] = len(held)
+        del held  # nothing ever steps or releases these — SIGKILL analog
+
+        # replica B: steps its shard now, steals A's after expiry+delay
+        live = AggregationJobDriver(leader_ds, http)
+        jd = JobDriver(
+            JobDriverConfig(job_discovery_interval_s=0.05, max_concurrent_job_workers=4),
+            live.acquirer(60, fleet=fleet_b),
+            live.stepper,
+        )
+
+        def finished():
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "fleet_smoke_monitor"
+            )
+            return sum(
+                n
+                for (typ, state), n in counts.items()
+                if typ == "aggregation" and state == "finished"
+            )
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and finished() < rec["jobs"]:
+            jd.run_once()
+            time.sleep(0.05)
+        rec["jobs_finished"] = finished()
+        rec["survivor_finished_all"] = rec["jobs_finished"] >= rec["jobs"]
+        rec["lease_conflicts_delta"] = _m.lease_conflicts_total.total() - conflicts0
+        rec["zero_conflicts"] = rec["lease_conflicts_delta"] == 0
+        rec["steals_delta"] = _m.lease_steals_total.total() - steals0
+        rec["dead_shard_stolen"] = rec["steals_delta"] >= 1
+        claim_txs = _m.lease_acquire_tx_total.get(
+            kind="aggregation", outcome="claimed"
+        ) - tx0
+        claimed = _m.lease_acquired_jobs_total.get(kind="aggregation") - jobs0
+        rec["claim_txs"] = claim_txs
+        rec["jobs_claimed"] = claimed
+        rec["jobs_per_claim_tx"] = round(claimed / max(1.0, claim_txs), 2)
+        rec["batched_claims"] = claim_txs > 0 and rec["jobs_per_claim_tx"] > 1.0
+
+        # collect and compare against ground truth exactly
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            cjd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                cjd.run_once()
+                stop_collect.wait(0.2)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+        try:
+            collector = Collector(
+                CollectorParameters(
+                    leader_task.task_id,
+                    leader_srv.url,
+                    leader_task.collector_auth_token,
+                    collector_kp,
+                ),
+                vdaf,
+                HttpClient(),
+            )
+            tp = leader_task.time_precision
+            start = clock.now().to_batch_interval_start(tp)
+            query = Query.time_interval(
+                Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+            )
+            collected = collector.collect(query, timeout_s=90.0)
+            rec["admitted"] = len(measurements)
+            rec["collected_count"] = collected.report_count
+            rec["collected_sum"] = collected.aggregate_result
+            rec["exactly_once"] = (
+                collected.report_count == len(measurements)
+                and collected.aggregate_result == sum(measurements)
+            )
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+        return rec
+    finally:
+        for srv in (leader_srv, helper_srv):
+            if srv is not None:
+                srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def _db_outage_smoke() -> dict:
     """Datastore-outage survival smoke (scripts/chaos_run.py
     --scenario db_outage --smoke): uploads keep acking 201 through a
@@ -2714,6 +2961,13 @@ def run_dry(args, ap) -> None:
                 "upload_batch_speed": _upload_batch_speed_record(inst, window=256),
                 "ingest_batch_smoke": _ingest_batch_smoke(),
                 "open_loop_upload": _open_loop_upload_record(),
+                # ISSUE 15: two in-process fleet replicas over one
+                # store — one dies holding its batched claims, the
+                # survivor steals the dead shard after the delay and
+                # the collection stays exact (the full fleet_scaling
+                # record with REAL replica binaries rides measured
+                # BENCH runs and chaos_run.py --scenario fleet)
+                "fleet_smoke": _fleet_smoke(),
             }
         )
     )
@@ -3172,6 +3426,13 @@ def main() -> None:
         # ISSUE 14: the warm-vs-cold BENCH record — full form (two
         # vdafs, 2 interleaved pairs, >= 3x gate, warm < 10 s)
         riders["cold_start"] = _cold_start_record(full=True)
+    except Exception:
+        pass
+    try:
+        # ISSUE 15: fleet scale-out — served rps at 1/2/4 REAL driver
+        # replicas over one store, claim round-trips per job vs the
+        # per-row loop, kill/drain/restart chaos gates
+        riders["fleet_scaling"] = _fleet_scaling_record(full=True)
     except Exception:
         pass
     if args.mode != "served":

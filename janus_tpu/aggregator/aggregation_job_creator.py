@@ -46,9 +46,146 @@ class AggregationJobCreatorConfig:
 
 
 class AggregationJobCreator:
-    def __init__(self, ds: Datastore, cfg: AggregationJobCreatorConfig | None = None):
+    def __init__(
+        self,
+        ds: Datastore,
+        cfg: AggregationJobCreatorConfig | None = None,
+        fleet=None,
+    ):
         self.ds = ds
         self.cfg = cfg or AggregationJobCreatorConfig()
+        # fleet shard preference (config.FleetConfig; docs/
+        # ARCHITECTURE.md "Running a fleet"): a creator replica sweeps
+        # its own shard's tasks every pass, and a FOREIGN shard's task
+        # only once its unaggregated backlog has sat NONEMPTY for
+        # steal_after_secs — so creator replicas stay off each other's
+        # tasks while a dead replica's tasks still get jobs created.
+        # Report claims are atomic either way; sharding is a
+        # contention/efficiency predicate, never a correctness one.
+        self.fleet = fleet
+        # foreign-task steal timers: task_id -> (clock seconds when
+        # THIS replica started the no-progress window, the task's
+        # aggregated-report count at that moment, last probe time —
+        # the progress probe runs at steal_after cadence). The stored
+        # client_time is truncated to the task's time_precision
+        # (hours, typically), so a report's own timestamp can NOT
+        # measure how long work has been waiting — a replica-local
+        # observation clock can (the health sampler's lease-age
+        # idiom). The timer resets whenever the backlog empties OR the
+        # owner demonstrably makes PROGRESS (the aggregated count
+        # moved): under steady traffic the backlog is never observed
+        # empty, and a gate keyed on nonemptiness alone would steal
+        # every live owner's task forever.
+        self._foreign_backlog_first_seen: dict[bytes, tuple[int, int, int]] = {}
+        # the foreign-backlog lag scan itself also runs at steal_after
+        # cadence (not per sweep): the steal gate cannot fire sooner,
+        # and a healthy sharded fleet must not pay an extra index scan
+        # per replica per second just to conclude "nothing to steal"
+        self._next_lag_scan = 0.0
+        # tasks this replica is ACTIVELY stealing: once the no-progress
+        # gate fires, the task stays swept until its backlog drains —
+        # without stickiness, the STEALER's own job creation would read
+        # as "owner progress" at the next scan and restart the window,
+        # halving a dead owner's effective job-creation rate
+        self._stealing: set[bytes] = set()
+
+    def _shard_filter(self, tasks: list[Task]) -> list[Task]:
+        from ..datastore.store import job_shard_key
+
+        fleet = self.fleet
+        if fleet is None or fleet.shard_count <= 1 or not tasks:
+            return tasks
+        count = int(fleet.shard_count)
+        index = int(fleet.shard_index) % count
+        own, foreign = [], []
+        for t in tasks:
+            (own if job_shard_key(t.task_id.data, b"") % count == index else foreign).append(t)
+        if not foreign:
+            return own
+        # steal signal: the foreign task has had unaggregated reports
+        # continuously for steal_after_secs WITH NO OWNER PROGRESS (its
+        # aggregated-report count static over the whole window) — a
+        # live owner claims reports every sweep and keeps resetting the
+        # window even under sustained uploads; one that cannot (dead,
+        # or genuinely wedged) gets help. The progress probe (a
+        # COUNT/SUM scan of the task's client_reports) runs at
+        # steal_after cadence per task, NOT per sweep — the gate cannot
+        # fire sooner than steal_after anyway, and a per-sweep scan
+        # would be steady-state O(reports) load on the shared store
+        # (worst-case steal detection latency: 2x steal_after).
+        now = self.ds.clock.now().seconds
+        steal_after = max(0.0, float(fleet.steal_after_secs))
+        # sticky steals sweep EVERY pass (a dead owner's task gets full
+        # cadence, not once-per-window); membership is re-evaluated at
+        # scan cadence below
+        own.extend(t for t in foreign if t.task_id.data in self._stealing)
+        if now < self._next_lag_scan:
+            return own
+        self._next_lag_scan = now + steal_after
+        try:
+            backlog_tasks = {
+                task_id
+                for task_id, _ in self.ds.run_tx(
+                    lambda tx: tx.min_unaggregated_report_time_by_task(),
+                    "creator_lag_scan",
+                )
+            }
+        except Exception:
+            return own
+        candidates = [t for t in foreign if t.task_id.data in backlog_tasks]
+        due = [
+            t
+            for t in candidates
+            if t.task_id.data not in self._foreign_backlog_first_seen
+            or now - self._foreign_backlog_first_seen[t.task_id.data][2]
+            >= steal_after
+        ]
+        try:
+            aggregated = (
+                self.ds.run_tx(
+                    lambda tx: {
+                        t.task_id.data: tx.count_client_reports_for_task(t.task_id)[1]
+                        for t in due
+                    },
+                    "creator_progress_scan",
+                )
+                if due
+                else {}
+            )
+        except Exception:
+            return own
+        live: set[bytes] = set()
+        for t in candidates:
+            key = t.task_id.data
+            live.add(key)
+            if key in self._stealing:
+                continue  # already swept above, every pass
+            if key not in aggregated:
+                continue  # probe not due: the window verdict waits
+            agg = int(aggregated[key])
+            first, last_agg, _ = self._foreign_backlog_first_seen.setdefault(
+                key, (now, agg, now)
+            )
+            if agg != last_agg:
+                # the owner moved the aggregated count: it is alive —
+                # restart the no-progress window
+                self._foreign_backlog_first_seen[key] = (now, agg, now)
+            else:
+                self._foreign_backlog_first_seen[key] = (first, last_agg, now)
+                if now - first >= steal_after:
+                    # steal, and STAY on it until the backlog drains
+                    self._stealing.add(key)
+                    del self._foreign_backlog_first_seen[key]
+                    own.append(t)
+        # prune state for tasks no longer foreign-with-backlog
+        # (drained, deleted, or reassigned) — the health sampler's
+        # lease-age idiom; a stale entry would grow the dict with task
+        # churn and hand a RE-CREATED task id an ancient first-seen
+        for key in list(self._foreign_backlog_first_seen):
+            if key not in live:
+                del self._foreign_backlog_first_seen[key]
+        self._stealing &= live
+        return own
 
     def run_once(self) -> int:
         """Sweep all leader tasks once; returns number of jobs created.
@@ -59,15 +196,17 @@ class AggregationJobCreator:
         serialization would bound many-task deployments by the slowest
         task."""
         tasks = self.ds.run_tx(lambda tx: tx.get_tasks(), "creator_tasks")
-        eligible = [
-            t
-            for t in tasks
-            if t.role == Role.LEADER
-            # parameterized VDAFs (Poplar1): reports aggregate once PER
-            # collection parameter; jobs are created by the collection
-            # job driver when the parameter is known
-            and not t.vdaf.has_aggregation_parameter
-        ]
+        eligible = self._shard_filter(
+            [
+                t
+                for t in tasks
+                if t.role == Role.LEADER
+                # parameterized VDAFs (Poplar1): reports aggregate once
+                # PER collection parameter; jobs are created by the
+                # collection job driver when the parameter is known
+                and not t.vdaf.has_aggregation_parameter
+            ]
+        )
         if len(eligible) <= 1 or self.cfg.max_concurrent_tasks <= 1:
             return sum(self.create_jobs_for_task(t) for t in eligible)
         from concurrent.futures import ThreadPoolExecutor

@@ -223,21 +223,26 @@ class AggregationJobDriver:
         self._resident_last_flush = time.monotonic()
 
     # --- JobDriver callbacks (reference :840-894) ---
-    def acquirer(self, lease_duration_s: int = 600):
-        from .job_driver import acquire_tolerating_outage
+    def acquirer(self, lease_duration_s: int = 600, fleet=None):
+        """Batched claim acquirer. `fleet` (config.FleetConfig) adds
+        the shard predicate + steal-after fallback and stamps this
+        replica's provenance tag into every minted lease token
+        (docs/ARCHITECTURE.md "Running a fleet")."""
+        from .job_driver import make_claim_acquirer
 
-        def acquire(limit: int):
-            return acquire_tolerating_outage(
-                self.ds,
-                lambda: self.ds.run_tx(
-                    lambda tx: tx.acquire_incomplete_aggregation_jobs(
-                        Duration(lease_duration_s), limit
-                    ),
-                    "acquire_agg_jobs",
+        shard = fleet.shard_spec() if fleet is not None else None
+        holder = fleet.holder_tag() if fleet is not None else None
+        return make_claim_acquirer(
+            self.ds,
+            "aggregation",
+            lambda limit: self.ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                    Duration(lease_duration_s), limit, shard=shard, holder=holder
                 ),
-            )
-
-        return acquire
+                "acquire_agg_jobs",
+            ),
+            shard=shard,
+        )
 
     def _lease_deadline(self, acquired) -> float:
         from .job_driver import lease_deadline
@@ -321,11 +326,18 @@ class AggregationJobDriver:
             "stepping back aggregation job %s (%s): lease released, reacquirable in %ds",
             acquired.job_id, reason, delay,
         )
-        metrics.job_step_back_total.add(reason=reason)
+        metrics.job_step_back_total.add(reason=reason, **metrics.replica_labels())
+        # a shutdown drain is a clean hand-back to the REST of the
+        # fleet: backdate the eligible-since so any surviving replica
+        # claims it immediately, never waiting out the steal fence
+        handback = reason == "shutdown_drain"
         try:
             self.ds.run_tx(
                 lambda tx: tx.step_back_aggregation_job(
-                    acquired, reacquire_delay_s=delay, count_attempt=False
+                    acquired,
+                    reacquire_delay_s=delay,
+                    count_attempt=False,
+                    handback=handback,
                 ),
                 "step_back_agg_job",
             )

@@ -77,21 +77,25 @@ class CollectionJobDriver:
         )
         self.stopper = stopper
 
-    def acquirer(self, lease_duration_s: int = 600):
-        from .job_driver import acquire_tolerating_outage
+    def acquirer(self, lease_duration_s: int = 600, fleet=None):
+        """Batched claim acquirer; `fleet` adds the shard predicate +
+        steal-after fallback and the replica provenance tag (see
+        AggregationJobDriver.acquirer)."""
+        from .job_driver import make_claim_acquirer
 
-        def acquire(limit: int):
-            return acquire_tolerating_outage(
-                self.ds,
-                lambda: self.ds.run_tx(
-                    lambda tx: tx.acquire_incomplete_collection_jobs(
-                        Duration(lease_duration_s), limit
-                    ),
-                    "acquire_collection_jobs",
+        shard = fleet.shard_spec() if fleet is not None else None
+        holder = fleet.holder_tag() if fleet is not None else None
+        return make_claim_acquirer(
+            self.ds,
+            "collection",
+            lambda limit: self.ds.run_tx(
+                lambda tx: tx.acquire_incomplete_collection_jobs(
+                    Duration(lease_duration_s), limit, shard=shard, holder=holder
                 ),
-            )
-
-        return acquire
+                "acquire_collection_jobs",
+            ),
+            shard=shard,
+        )
 
     def stepper(self, acquired: AcquiredCollectionJob) -> None:
         if acquired.lease.attempts > self.cfg.maximum_attempts_before_failure:
@@ -135,11 +139,16 @@ class CollectionJobDriver:
             "stepping back collection job %s (%s): lease released, reacquirable in %ds",
             acquired.collection_job_id, reason, delay,
         )
-        metrics.job_step_back_total.add(reason=reason)
+        metrics.job_step_back_total.add(reason=reason, **metrics.replica_labels())
+        # clean hand-back on shutdown: see AggregationJobDriver.step_back
+        handback = reason == "shutdown_drain"
         try:
             self.ds.run_tx(
                 lambda tx: tx.step_back_collection_job(
-                    acquired, reacquire_delay_s=delay, count_attempt=False
+                    acquired,
+                    reacquire_delay_s=delay,
+                    count_attempt=False,
+                    handback=handback,
                 ),
                 "step_back_collection_job",
             )

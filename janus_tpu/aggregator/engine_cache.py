@@ -518,6 +518,11 @@ def _split_rows(value, offsets):
 # genuinely overlap — exactly the fleet shape ROADMAP item 2 adds.
 XTASK_COALESCE = os.environ.get("JANUS_XTASK_COALESCE", "1") != "0"
 
+# One dispatch lock for EVERY mesh program in the process (see the
+# note at EngineCache._mesh_dispatch_lock): interleaved per-device
+# enqueues deadlock across engines just like within one.
+_MESH_DISPATCH_LOCK = threading.Lock()
+
 _xtask_lock = threading.Lock()
 _xtask_coalescers: dict[tuple, "_Coalescer"] = {}
 
@@ -738,8 +743,16 @@ class EngineCache:
         self._host_fallback: "HostEngineCache | None" = None
         self._host_fallback_until: float | None = None
         self._initial_bucket_cap = self.bucket_cap
-        # serializes multi-device program dispatch (see _jit)
-        self._mesh_dispatch_lock = threading.Lock()
+        # serializes multi-device program dispatch (see _jit).
+        # PROCESS-GLOBAL, not per-engine: the single-controller
+        # interleaved-enqueue deadlock the lock prevents happens
+        # between ANY two mesh programs sharing the process's devices —
+        # two different tasks' engines dispatching concurrently (the
+        # cross-task fleet/coalesce shape) deadlocked exactly like two
+        # threads on one engine did, each device parked on the other
+        # program's collective (observed as a rare tier-1 stall in
+        # test_cross_task_coalesced_round_matches_solo_...).
+        self._mesh_dispatch_lock = _MESH_DISPATCH_LOCK
         # cross-job dispatch coalescing (VERDICT r4 item 3): calls at or
         # below COALESCE_MAX_JOB rows ride shared device dispatches;
         # bigger jobs fill a dispatch on their own and go direct. The

@@ -61,6 +61,11 @@ class HealthSampler:
         from ..datastore.models import AggregationJobState, CollectionJobState
 
         now = self.ds.clock.now().seconds
+        # per-replica labels (docs/ARCHITECTURE.md "Running a fleet"):
+        # {} in single-process deployments, {"replica": id} when a
+        # fleet identity is configured — N samplers exporting the same
+        # backlog gauges to one scrape plane stay distinguishable
+        rl = metrics.replica_labels()
 
         jobs = self.ds.run_tx(lambda tx: tx.count_jobs_by_state(), "health_jobs_by_state")
         # zero-fill the known states so a drained backlog decays to 0
@@ -70,7 +75,7 @@ class HealthSampler:
         for state in CollectionJobState:
             jobs.setdefault(("collection", state.value), 0)
         for (typ, state), count in sorted(jobs.items()):
-            metrics.jobs_gauge.set(float(count), type=typ, state=state)
+            metrics.jobs_gauge.set(float(count), type=typ, state=state, **rl)
 
         leases = self.ds.run_tx(
             lambda tx: tx.get_held_lease_expiries(), "health_held_leases"
@@ -86,7 +91,7 @@ class HealthSampler:
         for key in list(self._lease_first_seen):
             if key not in live_keys:
                 del self._lease_first_seen[key]
-        metrics.job_lease_age_seconds.set(float(max_age))
+        metrics.job_lease_age_seconds.set(float(max_age), **rl)
 
         # one scan feeds BOTH the oldest-age gauge (exact min) and the
         # freshness DISTRIBUTION — per-task p50/p95/p99 unaggregated
@@ -104,22 +109,22 @@ class HealthSampler:
             seen_tasks.add(label)
             age = float(max(0, now - min_time))
             lag_by_task[label] = age
-            metrics.oldest_unaggregated_report_age_seconds.set(age, task_id=label)
+            metrics.oldest_unaggregated_report_age_seconds.set(age, task_id=label, **rl)
             per_task = {"count": count}
             for q, t in vals.items():
                 qlabel = f"p{round(q * 100):d}"
                 qage = float(max(0, now - t))
                 per_task[qlabel] = qage
                 metrics.unaggregated_report_age_quantiles.set(
-                    qage, task_id=label, quantile=qlabel
+                    qage, task_id=label, quantile=qlabel, **rl
                 )
             freshness[label] = per_task
         for label in self._lag_tasks - seen_tasks:
-            metrics.oldest_unaggregated_report_age_seconds.set(0.0, task_id=label)
+            metrics.oldest_unaggregated_report_age_seconds.set(0.0, task_id=label, **rl)
         for label in self._quantile_tasks - seen_tasks:
             for qlabel in ("p50", "p95", "p99"):
                 metrics.unaggregated_report_age_quantiles.set(
-                    0.0, task_id=label, quantile=qlabel
+                    0.0, task_id=label, quantile=qlabel, **rl
                 )
         self._lag_tasks = seen_tasks
         self._quantile_tasks = seen_tasks
@@ -127,7 +132,7 @@ class HealthSampler:
         pending = self.ds.run_tx(
             lambda tx: tx.count_batches_pending_collection(), "health_batches_pending"
         )
-        metrics.batches_pending_collection.set(float(pending))
+        metrics.batches_pending_collection.set(float(pending), **rl)
 
         self.last_snapshot = {
             "sampled_at_clock_seconds": now,

@@ -25,6 +25,10 @@ class JobDriverConfig:
     max_concurrent_job_workers: int = 4
     worker_lease_duration_s: int = 600
     maximum_attempts_before_failure: int = 10
+    # fractional jitter applied to every discovery sleep (delay *
+    # uniform[1-j, 1+j]): a restarted fleet's replicas otherwise fall
+    # into lockstep and thundering-herd the claim query every interval
+    discovery_jitter: float = 0.25
 
 
 def lease_deadline(clock, lease, skew_s: int) -> float:
@@ -80,6 +84,81 @@ def datastore_down(ds) -> bool:
     database; the discovery loop retries on its backoff."""
     supervisor = getattr(ds, "supervisor", None)
     return supervisor is not None and supervisor.state == "down"
+
+
+def record_acquire(kind: str, jobs, shard=None) -> None:
+    """Feed the fleet claim metrics from one acquire pass: claim-tx
+    count by outcome, jobs leased, and — with a shard predicate — how
+    many of them were STOLEN from another replica's shard (the
+    steal-after-delay fallback draining a dead peer). A claim whose
+    stored shard_key is negative was a clean HAND-BACK (shutdown
+    drain released the affinity) — by design claimed cross-shard
+    immediately, and never a steal: a routine rolling restart must not
+    fire the starving-shard signal. Called by the drivers' acquirers
+    AFTER run_tx returns, never inside the tx (a busy-retried attempt
+    would double-count), and only when a claim tx actually ran."""
+    from .. import metrics
+    from ..datastore.store import job_shard_key
+
+    labels = metrics.replica_labels()
+    metrics.lease_acquire_tx_total.add(
+        kind=kind, outcome="claimed" if jobs else "empty", **labels
+    )
+    if not jobs:
+        return
+    metrics.lease_acquired_jobs_total.add(len(jobs), kind=kind, **labels)
+    if shard is not None and shard.active:
+
+        def stored_key(a) -> int:
+            sk = getattr(a, "shard_key", None)
+            if sk is None:  # legacy-constructed acquired object
+                sk = job_shard_key(a.task_id.data, _job_id_of(a).data)
+            return sk
+
+        # normalize the index like the claim SQL does, or an
+        # out-of-range shard_index would misclassify every own-shard
+        # claim as a steal
+        index = shard.shard_index % shard.shard_count
+        stolen = sum(
+            1
+            for a in jobs
+            if (sk := stored_key(a)) >= 0 and sk % shard.shard_count != index
+        )
+        if stolen:
+            metrics.lease_steals_total.add(stolen, kind=kind, **labels)
+
+
+def _job_id_of(acquired):
+    """The job-id field of either acquired-job shape."""
+    if hasattr(acquired, "job_id"):
+        return acquired.job_id
+    return acquired.collection_job_id
+
+
+def make_claim_acquirer(ds, kind: str, claim_fn, shard=None):
+    """Shared acquirer body for both drivers: run `claim_fn(limit)`
+    (the datastore claim run_tx) through the outage-tolerant wrapper
+    and feed the fleet claim metrics ONLY when a claim transaction
+    actually ran — a parked (supervisor-down) or connection-lost pass
+    ran none, and counting it would fabricate claim traffic during
+    exactly the outages the counters should stay honest through.
+    `shard` feeds the steal classification (record_acquire)."""
+
+    def acquire(limit: int):
+        ran = False
+
+        def claim_tx():
+            nonlocal ran
+            out = claim_fn(limit)
+            ran = True
+            return out
+
+        jobs = acquire_tolerating_outage(ds, claim_tx)
+        if ran:
+            record_acquire(kind, jobs, shard)
+        return jobs
+
+    return acquire
 
 
 def acquire_tolerating_outage(ds, acquire_tx):
@@ -211,9 +290,11 @@ class JobDriver:
         slow/hung job never idles the rest of the pool (reference
         job_driver.rs:119-186 acquires under a semaphore the same way).
         """
+        import random
         from concurrent.futures import FIRST_COMPLETED
 
         delay = self.cfg.job_discovery_interval_s
+        jitter = min(0.9, max(0.0, float(self.cfg.discovery_jitter)))
         in_flight: set = set()
         with ThreadPoolExecutor(max_workers=self.cfg.max_concurrent_job_workers) as pool:
             while not self.stopper.stopped:
@@ -233,11 +314,14 @@ class JobDriver:
                     delay = self.cfg.job_discovery_interval_s
                 else:
                     delay = min(delay * 2, self.cfg.max_job_discovery_interval_s)
+                # jittered sleep: N replicas restarted together must not
+                # re-land on the claim query in lockstep every interval
+                sleep = delay * random.uniform(1.0 - jitter, 1.0 + jitter)
                 if in_flight:
                     # wake as soon as any permit frees (or re-discover)
-                    wait(in_flight, timeout=delay, return_when=FIRST_COMPLETED)
+                    wait(in_flight, timeout=sleep, return_when=FIRST_COMPLETED)
                 else:
-                    self.stopper.wait(delay)
+                    self.stopper.wait(sleep)
             # shutdown: drain in-flight steps (job_driver.rs:124-142)
             if in_flight:
                 wait(in_flight)

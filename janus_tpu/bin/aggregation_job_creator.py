@@ -17,7 +17,10 @@ log = logging.getLogger(__name__)
 
 
 def run(cfg: JobCreatorConfig, ds, stopper):
-    creator = AggregationJobCreator(ds, cfg.creator_config())
+    # fleet task-shard preference (docs/ARCHITECTURE.md "Running a
+    # fleet"): sweep own-shard tasks every pass, steal a foreign
+    # shard's task only once its backlog ages past steal_after_secs
+    creator = AggregationJobCreator(ds, cfg.creator_config(), fleet=cfg.common.fleet)
     while not stopper.stopped:
         try:
             n = creator.run_once()

@@ -50,7 +50,9 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         )
     jd = JobDriver(
         cfg.job_driver,
-        driver.acquirer(cfg.job_driver.worker_lease_duration_s),
+        # fleet sharding + replica provenance on every claim
+        # (docs/ARCHITECTURE.md "Running a fleet")
+        driver.acquirer(cfg.job_driver.worker_lease_duration_s, fleet=cfg.common.fleet),
         driver.stepper,
         stopper,
         releaser=releaser,

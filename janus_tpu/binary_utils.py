@@ -687,10 +687,40 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
 
     # refresh janus_build_info with the YAML-configured backend (the
     # import-time registration guessed from the environment)
-    from .metrics import register_build_info
+    from .metrics import register_build_info, set_replica_identity
 
     register_build_info(
         backend=common.jax_platform or os.environ.get("JAX_PLATFORMS")
+    )
+
+    # fleet replica identity (docs/ARCHITECTURE.md "Running a fleet"):
+    # janus_replica_info carries it on every scrape; an EXPLICITLY
+    # configured replica_id (YAML fleet: / JANUS_REPLICA_ID) also turns
+    # on the per-replica labels of the job-driver/health-sampler/SLO
+    # families and rides every trace as a resource attribute, so N
+    # processes over one datastore stay attributable end to end.
+    fleet = common.fleet
+    replica_id = fleet.resolved_replica_id()
+    set_replica_identity(
+        replica_id=fleet.replica_id,
+        shard_index=fleet.shard_index,
+        shard_count=fleet.shard_count,
+    )
+    from .trace import set_resource_attributes
+
+    set_resource_attributes(
+        replica=replica_id,
+        shard=f"{fleet.shard_index % max(1, fleet.shard_count)}/{fleet.shard_count}",
+    )
+    register_status_provider(
+        "fleet",
+        lambda: {
+            "replica_id": replica_id,
+            "configured": fleet.replica_id is not None,
+            "shard_index": fleet.shard_index % max(1, fleet.shard_count),
+            "shard_count": fleet.shard_count,
+            "steal_after_secs": fleet.steal_after_secs,
+        },
     )
 
     # fault injection: JANUS_FAILPOINTS env wins over the YAML

@@ -25,6 +25,77 @@ from .trace import TraceConfiguration
 
 
 @dataclass
+class FleetConfig:
+    """YAML `fleet:` stanza (docs/ARCHITECTURE.md "Running a fleet"):
+    the replica's identity and its slice of the job-claim shard space.
+    Every field is env-overridable (JANUS_REPLICA_ID /
+    JANUS_SHARD_COUNT / JANUS_SHARD_INDEX / JANUS_STEAL_AFTER_S) so a
+    container fleet can stamp per-replica identity onto one shared
+    YAML file."""
+
+    # stable replica identity; None auto-generates hostname-pid (and
+    # keeps the per-replica metric labels OFF — single-process
+    # deployments keep their exact label sets)
+    replica_id: str | None = None
+    # shard predicate over the persisted job shard keys: this replica
+    # claims shard_key % shard_count == shard_index immediately, any
+    # other shard only after steal_after_secs of eligibility (a dead
+    # replica's shard drains instead of starving)
+    shard_count: int = 1
+    shard_index: int = 0
+    steal_after_secs: float = 30.0
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "FleetConfig":
+        import os
+
+        d = d or {}
+        replica_id = os.environ.get("JANUS_REPLICA_ID") or d.get("replica_id")
+        count = os.environ.get("JANUS_SHARD_COUNT") or d.get("shard_count", 1)
+        index = os.environ.get("JANUS_SHARD_INDEX") or d.get("shard_index", 0)
+        steal = os.environ.get("JANUS_STEAL_AFTER_S") or d.get(
+            "steal_after_secs", 30.0
+        )
+        return cls(
+            replica_id=str(replica_id) if replica_id else None,
+            shard_count=max(1, int(count)),
+            shard_index=int(index),
+            steal_after_secs=max(0.0, float(steal)),
+        )
+
+    def resolved_replica_id(self) -> str:
+        from .metrics import default_replica_id
+
+        return self.replica_id or default_replica_id()
+
+    def shard_spec(self):
+        """ShardSpec for the batched lease claims (None when the fleet
+        is unsharded — the predicate compiles away entirely)."""
+        from .datastore.models import ShardSpec
+
+        import math
+
+        if self.shard_count <= 1:
+            return None
+        return ShardSpec(
+            shard_count=self.shard_count,
+            shard_index=self.shard_index % self.shard_count,
+            # ceil, never truncate: the claim predicate works in whole
+            # seconds, and a fractional steal_after (0.5) must round to
+            # a 1 s fence — int() would silently DISABLE stealing
+            # fencing while the creator path honors the float
+            steal_after_s=math.ceil(max(0.0, self.steal_after_secs)),
+        )
+
+    def holder_tag(self) -> bytes:
+        """8-byte provenance tag stamped into every lease token this
+        replica mints."""
+        from .datastore.store import replica_holder_tag
+
+        return replica_holder_tag(self.resolved_replica_id())
+
+
+@dataclass
 class EngineConfig:
     """YAML `engine:` stanza (docs/ARCHITECTURE.md "Resident aggregate
     state"): engine-layer knobs shared by every binary with a device
@@ -190,6 +261,13 @@ class CommonConfig:
     # sampling rate and window ring behind GET /debug/profile. Enabled
     # by default in every binary.
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    # Fleet identity + job-claim sharding (YAML `fleet:` section;
+    # docs/ARCHITECTURE.md "Running a fleet"): replica id stamped into
+    # lease tokens/metrics/traces, and this replica's slice of the
+    # shard space for the batched lease claims. Env-overridable
+    # (JANUS_REPLICA_ID / JANUS_SHARD_COUNT / JANUS_SHARD_INDEX /
+    # JANUS_STEAL_AFTER_S) for container fleets.
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     @classmethod
     def from_dict(cls, d: dict) -> "CommonConfig":
@@ -212,6 +290,7 @@ class CommonConfig:
             slo=SloEngineConfig.from_dict(d.get("slo")),
             engine=EngineConfig.from_dict(d.get("engine")),
             profiler=ProfilerConfig.from_dict(d.get("profiler")),
+            fleet=FleetConfig.from_dict(d.get("fleet")),
         )
 
 
@@ -225,6 +304,7 @@ def _job_driver_from_dict(d: dict) -> JobDriverConfig:
         maximum_attempts_before_failure=int(
             d.get("maximum_attempts_before_failure", 10)
         ),
+        discovery_jitter=float(d.get("job_discovery_jitter", 0.25)),
     )
 
 

@@ -113,6 +113,25 @@ class AggregationJobModel:
 
 
 @dataclass(frozen=True)
+class ShardSpec:
+    """Fleet shard predicate for the batched lease claims
+    (docs/ARCHITECTURE.md "Running a fleet"): a replica owns the jobs
+    whose persisted shard_key lands on its (shard_index mod
+    shard_count); jobs OUTSIDE the shard become claimable only after
+    they have sat eligible for steal_after_s — so a dead replica's
+    shard drains instead of starving, while live replicas never
+    contend on each other's rows."""
+
+    shard_count: int = 1
+    shard_index: int = 0
+    steal_after_s: int = 30
+
+    @property
+    def active(self) -> bool:
+        return self.shard_count > 1
+
+
+@dataclass(frozen=True)
 class Lease:
     """An acquired job lease (reference models.rs:434)."""
 
@@ -123,20 +142,26 @@ class Lease:
 
 @dataclass(frozen=True)
 class AcquiredAggregationJob:
-    """reference models.rs:494."""
+    """reference models.rs:494. shard_key is the row's STORED shard
+    hash at claim time (None from legacy constructors; < 0 = the
+    affinity was released by a clean hand-back) — the steal classifier
+    reads it so a rolling restart's hand-backs never count as
+    steals."""
 
     task_id: TaskId
     job_id: AggregationJobId
     lease: Lease
+    shard_key: int | None = None
 
 
 @dataclass(frozen=True)
 class AcquiredCollectionJob:
-    """reference models.rs:540."""
+    """reference models.rs:540 (shard_key: see AcquiredAggregationJob)."""
 
     task_id: TaskId
     collection_job_id: CollectionJobId
     lease: Lease
+    shard_key: int | None = None
 
 
 @dataclass(frozen=True)

@@ -90,8 +90,11 @@ _CREATE_SCHEMA_RE = re.compile(r"^\s*CREATE\s+SCHEMA\b", re.I)
 _DROP_SCHEMA_RE = re.compile(r"^\s*DROP\s+SCHEMA\b", re.I)
 # PG row-locking clause SQLite has no parse for; recorded verbatim,
 # stripped for execution (SQLite's database-level write lock is the
-# stand-in — the real SKIP LOCKED semantics need the real-PG suite)
-_FOR_UPDATE_RE = re.compile(r"\s+FOR\s+UPDATE(\s+SKIP\s+LOCKED)?\s*$", re.I)
+# stand-in — the real SKIP LOCKED semantics need the real-PG suite).
+# Matched at statement end OR at a subquery's closing paren: the
+# batched lease claim puts it INSIDE the candidate subquery
+# (UPDATE .. WHERE (..) IN (SELECT .. FOR UPDATE SKIP LOCKED)).
+_FOR_UPDATE_RE = re.compile(r"\s+FOR\s+UPDATE(\s+SKIP\s+LOCKED)?(?=\s*\)|\s*$)", re.I)
 
 
 def _to_sqlite(sql: str) -> str:

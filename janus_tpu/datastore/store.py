@@ -80,9 +80,10 @@ from .models import (
     OutstandingBatch,
     ReportAggregationModel,
     ReportAggregationState,
+    ShardSpec,
 )
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 # POSTGRES TRANSLATION CONSTRAINTS (tests/test_pg_dialect.py enforces):
 # the Postgres engine derives its DDL from this exact text via
@@ -125,6 +126,7 @@ CREATE TABLE IF NOT EXISTS aggregation_jobs (
     step INTEGER NOT NULL DEFAULT 0,
     last_request_hash BLOB,
     trace_context TEXT,          -- W3C traceparent of the creating span
+    shard_key INTEGER NOT NULL DEFAULT 0,  -- job_shard_key(task, job)
     lease_expiry INTEGER NOT NULL DEFAULT 0,
     lease_token BLOB,
     lease_attempts INTEGER NOT NULL DEFAULT 0,
@@ -175,6 +177,7 @@ CREATE TABLE IF NOT EXISTS collection_jobs (
     leader_aggregate_share BLOB,           -- encrypted
     helper_encrypted_aggregate_share BLOB,
     trace_context TEXT,          -- W3C traceparent of the creating span
+    shard_key INTEGER NOT NULL DEFAULT 0,  -- job_shard_key(task, job)
     lease_expiry INTEGER NOT NULL DEFAULT 0,
     lease_token BLOB,
     lease_attempts INTEGER NOT NULL DEFAULT 0,
@@ -262,6 +265,74 @@ class Crypter:
 
 class TxConflict(Exception):
     pass
+
+
+class LeaseConflict(TxConflict):
+    """A token-guarded lease write (release / step-back) found the
+    token no longer matching: the lease expired and another replica
+    re-acquired it. Deterministic — classified "fatal" so run_tx
+    raises immediately instead of burning its retry budget on a
+    mismatch no retry can fix — and counted in
+    janus_lease_conflicts_total{kind,op} so a fleet losing claim races
+    is visible instead of invisible."""
+
+
+# ---------------------------------------------------------------------------
+# Fleet sharding + lease-token provenance (docs/ARCHITECTURE.md
+# "Running a fleet"). The shard key is persisted on every job row at
+# creation so the batched claim's shard predicate is plain integer
+# arithmetic — portable to sqlite, pg_fake and real Postgres alike.
+# ---------------------------------------------------------------------------
+
+# modulo space of the persisted shard hash: far above any plausible
+# shard_count, small enough that `shard_key % count` stays exact in
+# every engine's integer type
+SHARD_KEY_SPACE = 1 << 16
+
+
+def job_shard_key(task_id: bytes, job_id: bytes) -> int:
+    """Stable shard hash of a (task, job) identity, persisted on the
+    row at creation. sha256-based so every replica — any language, any
+    PYTHONHASHSEED — computes the same key."""
+    import hashlib
+
+    digest = hashlib.sha256(task_id + job_id).digest()
+    return int.from_bytes(digest[:8], "big") % SHARD_KEY_SPACE
+
+
+def replica_holder_tag(replica_id: str) -> bytes:
+    """8-byte stable provenance tag of a replica id, carried in the
+    first half of every lease token the replica mints."""
+    import hashlib
+
+    return hashlib.sha256(replica_id.encode()).digest()[:8]
+
+
+def make_lease_token(holder: bytes | None = None) -> bytes:
+    """Fresh 16-byte lease token. With a holder tag the first 8 bytes
+    carry the claiming replica's provenance (lease_holder_hex reads it
+    back off a held row) and the last 8 stay random per claim
+    transaction — token uniqueness per claim generation is what the
+    guarded release/step-back need; the row identity does the rest."""
+    if holder:
+        return bytes(holder[:8]).ljust(8, b"\0") + secrets.token_bytes(8)
+    return secrets.token_bytes(16)
+
+
+def lease_holder_hex(token: bytes | None) -> str | None:
+    """Provenance half of a lease token (hex), or None when no lease
+    is held. Only meaningful for tokens minted with a holder tag."""
+    return bytes(token[:8]).hex() if token else None
+
+
+# shard_key sentinel for a clean shutdown hand-back: the draining
+# replica RELEASES the row's shard affinity, so ANY surviving replica
+# — any shard, any steal_after — claims it immediately instead of
+# waiting out the steal fence meant for rows whose holder DIED; and
+# because the claim returns the stored shard_key, a hand-back claim is
+# distinguishable from a genuine steal (janus_lease_steals_total must
+# not fire on every routine rolling restart).
+HANDBACK_SHARD_KEY = -1
 
 
 class _PgConnAdapter:
@@ -527,7 +598,8 @@ class Transaction:
         self._c.execute(
             "INSERT INTO aggregation_jobs (task_id, job_id, aggregation_parameter,"
             " partial_batch_identifier, client_interval_start, client_interval_duration,"
-            " state, step, last_request_hash, trace_context) VALUES (?,?,?,?,?,?,?,?,?,?)",
+            " state, step, last_request_hash, trace_context, shard_key, lease_expiry)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
             (
                 job.task_id.data,
                 job.job_id.data,
@@ -539,6 +611,12 @@ class Transaction:
                 job.step,
                 job.last_request_hash,
                 job.trace_context,
+                job_shard_key(job.task_id.data, job.job_id.data),
+                # eligible-since stamp: a past-expiry value means
+                # "claimable"; the CREATION time (not 0) is what the
+                # steal-after-delay fallback measures eligibility age
+                # against — a fresh job must not look infinitely stale
+                self._clock.now().seconds,
             ),
         )
 
@@ -577,57 +655,187 @@ class Transaction:
         ).fetchall()
         return [self.get_aggregation_job(task_id, AggregationJobId(r[0])) for r in rows]
 
-    def acquire_incomplete_aggregation_jobs(
-        self, lease_duration: Duration, limit: int
-    ) -> list[AcquiredAggregationJob]:
-        """Lease-based claim (reference datastore.rs:1836: FOR UPDATE
-        SKIP LOCKED + gen_random_bytes(16) token)."""
+    def _acquire_jobs_batched(
+        self,
+        table: str,
+        id_col: str,
+        state_pred: str,
+        lease_duration: Duration,
+        limit: int,
+        shard: ShardSpec | None,
+        holder: bytes | None,
+    ) -> list[tuple[bytes, bytes, bytes, int, int, int]]:
+        """ONE claim transaction atomically leasing up to `limit` jobs
+        (the reference's FOR UPDATE SKIP LOCKED queue-pop idiom,
+        datastore.rs:1836, batched instead of per-row): a single
+        UPDATE whose candidate subquery carries the eligibility window,
+        the fleet shard predicate, and a RANDOMIZED claim order — every
+        replica walking the same ORDER BY lease_expiry scan oldest-first
+        maximized claim collisions, so candidates are ordered own-shard
+        first, then random() within the eligible window.
+
+        The randomization is WINDOWED: the inner candidate scan walks
+        the (state, lease_expiry) index oldest-first into a bounded
+        window (max(4*limit, 64) rows — never a whole-backlog sort),
+        and the shuffle happens within that window. A deep post-outage
+        backlog therefore still drains oldest-first at window
+        granularity, and the per-claim sort cost is O(W log W) bounded
+        regardless of eligible-set size.
+
+        Shard predicate (docs/ARCHITECTURE.md "Running a fleet"):
+        in-shard rows (persisted shard_key mod shard_count ==
+        shard_index) are claimable the moment their lease expires;
+        out-of-shard rows only after sitting eligible for steal_after_s
+        — a dead replica's shard drains instead of starving, while live
+        replicas stay off each other's rows.
+
+        The whole batch shares one fresh token (row identity pins the
+        guarded release; the token carries the claiming replica's
+        provenance tag and the claim-generation randomness). Postgres
+        takes the single-statement UPDATE .. IN (SELECT .. FOR UPDATE
+        SKIP LOCKED) RETURNING form; pre-3.35 sqlite takes the
+        two-statement form, exact inside the serialized transaction.
+
+        Returns [(task_id, job_id, token, expiry, lease_attempts,
+        shard_key)] — the STORED shard key rides along so the caller
+        can tell a genuine steal (foreign shard_key >= 0) from a
+        hand-back claim (shard_key < 0, affinity released)."""
         now = self._clock.now().seconds
-        out = []
-        rows = self._c.execute(
-            "SELECT task_id, job_id FROM aggregation_jobs"
-            " WHERE state = 'in_progress' AND lease_expiry <= ?"
-            " ORDER BY lease_expiry LIMIT ?" + self._lease_suffix,
-            (now, limit),
-        ).fetchall()
-        for task_id, job_id in rows:
-            token = secrets.token_bytes(16)
-            cur = self._update_returning_one(
-                "UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = ?,"
-                " lease_attempts = lease_attempts + 1"
-                " WHERE task_id = ? AND job_id = ? AND state = 'in_progress' AND lease_expiry <= ?",
-                (now + lease_duration.seconds, token, task_id, job_id, now),
-                "lease_attempts",
-                "SELECT lease_attempts FROM aggregation_jobs"
-                " WHERE task_id = ? AND job_id = ?",
-                (task_id, job_id),
+        expiry = now + lease_duration.seconds
+        token = make_lease_token(holder)
+        eligible = f"{state_pred} AND lease_expiry <= ?"
+        params: list = [now]
+        order = "random()"
+        if shard is not None and shard.active:
+            count = int(shard.shard_count)
+            index = int(shard.shard_index) % count
+            # three ways past the shard fence: it's ours; its affinity
+            # was RELEASED by a clean hand-back (shard_key < 0); or it
+            # has sat eligible past the steal delay (dead holder)
+            eligible = (
+                f"{state_pred} AND lease_expiry <= ?"
+                f" AND (shard_key % {count} = {index} OR shard_key < 0"
+                " OR lease_expiry <= ?)"
             )
-            if cur is not None:
-                out.append(
-                    AcquiredAggregationJob(
-                        TaskId(task_id),
-                        AggregationJobId(job_id),
-                        Lease(token, Time(now + lease_duration.seconds), cur[0]),
-                    )
-                )
-        return out
+            params = [now, now - max(0, int(shard.steal_after_s))]
+            order = (
+                f"CASE WHEN shard_key % {count} = {index} THEN 0 ELSE 1 END,"
+                " random()"
+            )
+        # inner: index-ordered oldest-first candidate WINDOW (bounded
+        # sort, fairness); outer: own-shard-first randomized claim
+        # order within it (collision avoidance); the PG form locks the
+        # window rows FOR UPDATE SKIP LOCKED at the inner scan
+        window = max(4 * int(limit), 64)
+        select_sql = (
+            f"SELECT task_id, {id_col} FROM ("
+            f"SELECT task_id, {id_col}, shard_key FROM {table}"
+            f" WHERE {eligible} ORDER BY lease_expiry LIMIT {window}"
+            f"{self._lease_suffix}"
+            f") AS cand ORDER BY {order} LIMIT ?"
+        )
+        set_sql = (
+            f"UPDATE {table} SET lease_expiry = ?, lease_token = ?,"
+            " lease_attempts = lease_attempts + 1"
+        )
+        if self._returning:
+            rows = self._c.execute(
+                set_sql
+                + f" WHERE (task_id, {id_col}) IN ({select_sql})"
+                + f" RETURNING task_id, {id_col}, lease_attempts, shard_key",
+                (expiry, token, *params, limit),
+            ).fetchall()
+        else:
+            cand = self._c.execute(select_sql, (*params, limit)).fetchall()
+            if not cand:
+                return []
+            marks = ",".join(["(?,?)"] * len(cand))
+            flat = [x for row in cand for x in row]
+            self._c.execute(
+                set_sql
+                + f" WHERE (task_id, {id_col}) IN (VALUES {marks}) AND {eligible}",
+                (expiry, token, *flat, *params),
+            )
+            # the fresh per-claim token identifies exactly this batch
+            rows = self._c.execute(
+                f"SELECT task_id, {id_col}, lease_attempts, shard_key FROM {table}"
+                " WHERE lease_token = ?",
+                (token,),
+            ).fetchall()
+        return [(t, j, token, expiry, att, sk) for t, j, att, sk in rows]
+
+    def acquire_incomplete_aggregation_jobs(
+        self,
+        lease_duration: Duration,
+        limit: int,
+        shard: ShardSpec | None = None,
+        holder: bytes | None = None,
+    ) -> list[AcquiredAggregationJob]:
+        """Batched lease claim (reference datastore.rs:1836; see
+        _acquire_jobs_batched for the claim-tx/shard/steal contract)."""
+        return [
+            AcquiredAggregationJob(
+                TaskId(t),
+                AggregationJobId(j),
+                Lease(token, Time(expiry), att),
+                shard_key=sk,
+            )
+            for t, j, token, expiry, att, sk in self._acquire_jobs_batched(
+                "aggregation_jobs",
+                "job_id",
+                "state = 'in_progress'",
+                lease_duration,
+                limit,
+                shard,
+                holder,
+            )
+        ]
+
+    def _lease_conflict(self, kind: str, op: str, msg: str) -> LeaseConflict:
+        """Count a token-mismatch on a guarded lease write
+        (janus_lease_conflicts_total{kind,op}) and build the
+        LeaseConflict to raise — a fleet losing claim races must be
+        visible, never a silent no-op. Counted here, not in run_tx:
+        LeaseConflict is classified fatal (deterministic), so the tx
+        never retries and the event counts exactly once."""
+        from .. import metrics
+
+        metrics.lease_conflicts_total.add(
+            kind=kind, op=op, **metrics.replica_labels()
+        )
+        return LeaseConflict(msg)
 
     def release_aggregation_job(self, acquired: AcquiredAggregationJob) -> None:
-        """reference datastore.rs:1905; raises TxConflict if the lease
-        was lost (expired + re-acquired elsewhere)."""
+        """reference datastore.rs:1905; raises LeaseConflict (counted)
+        if the lease was lost (expired + re-acquired elsewhere). The
+        release stamps NOW (not 0) as the eligible-since so the
+        steal-after fencing measures a real eligibility age, and
+        RE-STAMPS the shard affinity (derivable from the row identity)
+        so a row that crossed a restart via the hand-back sentinel
+        rejoins its shard for the rest of its multi-step life."""
         cur = self._c.execute(
-            "UPDATE aggregation_jobs SET lease_expiry = 0, lease_token = NULL, lease_attempts = 0"
+            "UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = NULL,"
+            " lease_attempts = 0, shard_key = ?"
             " WHERE task_id = ? AND job_id = ? AND lease_token = ?",
-            (acquired.task_id.data, acquired.job_id.data, acquired.lease.token),
+            (
+                self._clock.now().seconds,
+                job_shard_key(acquired.task_id.data, acquired.job_id.data),
+                acquired.task_id.data,
+                acquired.job_id.data,
+                acquired.lease.token,
+            ),
         )
         if cur.rowcount != 1:
-            raise TxConflict("lease token mismatch on release")
+            raise self._lease_conflict(
+                "aggregation", "release", "lease token mismatch on release"
+            )
 
     def step_back_aggregation_job(
         self,
         acquired: AcquiredAggregationJob,
         reacquire_delay_s: int = 0,
         count_attempt: bool = False,
+        handback: bool = False,
     ) -> None:
         """Early lease release without resetting the attempt ledger (the
         difference from release_aggregation_job, whose lease_attempts=0
@@ -636,11 +844,15 @@ class Transaction:
 
         Used when the step could not run through no fault of the job —
         outbound circuit open to the helper (wait out the cooldown) or
-        shutdown drain (delay 0: the surviving peer picks it up
-        immediately). count_attempt=False refunds the acquire's
-        lease_attempts increment so a helper outage cannot march jobs
-        to abandonment; True keeps it counted (a genuinely failed step
-        released early). Raises TxConflict if the lease was lost."""
+        shutdown drain (handback=True: the row's shard AFFINITY is
+        released, shard_key = HANDBACK_SHARD_KEY, so a surviving peer
+        of ANY shard claims it immediately — a clean hand-back must
+        not sit behind the steal fence meant for dead holders, and the
+        claim is classifiable as a hand-back, never a steal).
+        count_attempt=False refunds the acquire's lease_attempts
+        increment so a helper outage cannot march jobs to abandonment;
+        True keeps it counted (a genuinely failed step released
+        early). Raises TxConflict if the lease was lost."""
         now = self._clock.now().seconds
         # CASE instead of MAX/GREATEST: scalar max() is sqlite-only and
         # GREATEST needs sqlite >= 3.44 / postgres — CASE runs on both
@@ -649,19 +861,30 @@ class Transaction:
             if count_attempt
             else "CASE WHEN lease_attempts > 0 THEN lease_attempts - 1 ELSE 0 END"
         )
+        # hand-back releases the shard affinity; every other step-back
+        # re-stamps it (restoring a row that crossed a restart via the
+        # sentinel to its shard)
+        shard_key = (
+            HANDBACK_SHARD_KEY
+            if handback
+            else job_shard_key(acquired.task_id.data, acquired.job_id.data)
+        )
         cur = self._c.execute(
             "UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = NULL,"
-            f" lease_attempts = {attempts_sql}"
+            f" lease_attempts = {attempts_sql}, shard_key = ?"
             " WHERE task_id = ? AND job_id = ? AND lease_token = ?",
             (
                 now + max(0, int(reacquire_delay_s)),
+                shard_key,
                 acquired.task_id.data,
                 acquired.job_id.data,
                 acquired.lease.token,
             ),
         )
         if cur.rowcount != 1:
-            raise TxConflict("lease token mismatch on step-back")
+            raise self._lease_conflict(
+                "aggregation", "step_back", "lease token mismatch on step-back"
+            )
 
     # ---- report aggregations (reference datastore.rs:2052-2455) ----
     def put_report_aggregation(self, ra: ReportAggregationModel) -> None:
@@ -946,7 +1169,8 @@ class Transaction:
     def put_collection_job(self, job: CollectionJobModel) -> None:
         self._c.execute(
             "INSERT INTO collection_jobs (task_id, collection_job_id, query, aggregation_parameter,"
-            " batch_identifier, state, trace_context) VALUES (?,?,?,?,?,?,?)",
+            " batch_identifier, state, trace_context, shard_key, lease_expiry)"
+            " VALUES (?,?,?,?,?,?,?,?,?)",
             (
                 job.task_id.data,
                 job.collection_job_id.data,
@@ -955,6 +1179,8 @@ class Transaction:
                 job.batch_identifier,
                 job.state.value,
                 job.trace_context,
+                job_shard_key(job.task_id.data, job.collection_job_id.data),
+                self._clock.now().seconds,  # eligible-since (see agg jobs)
             ),
         )
 
@@ -1049,57 +1275,64 @@ class Transaction:
         )
 
     def acquire_incomplete_collection_jobs(
-        self, lease_duration: Duration, limit: int
+        self,
+        lease_duration: Duration,
+        limit: int,
+        shard: ShardSpec | None = None,
+        holder: bytes | None = None,
     ) -> list[AcquiredCollectionJob]:
-        """reference datastore.rs:2853."""
-        now = self._clock.now().seconds
-        rows = self._c.execute(
-            "SELECT task_id, collection_job_id FROM collection_jobs"
-            " WHERE state IN ('start', 'collectable') AND lease_expiry <= ?"
-            " ORDER BY lease_expiry LIMIT ?" + self._lease_suffix,
-            (now, limit),
-        ).fetchall()
-        out = []
-        for task_id, cj_id in rows:
-            token = secrets.token_bytes(16)
-            cur = self._update_returning_one(
-                "UPDATE collection_jobs SET lease_expiry = ?, lease_token = ?,"
-                " lease_attempts = lease_attempts + 1"
-                " WHERE task_id = ? AND collection_job_id = ? AND state IN ('start', 'collectable')"
-                " AND lease_expiry <= ?",
-                (now + lease_duration.seconds, token, task_id, cj_id, now),
-                "lease_attempts",
-                "SELECT lease_attempts FROM collection_jobs"
-                " WHERE task_id = ? AND collection_job_id = ?",
-                (task_id, cj_id),
+        """reference datastore.rs:2853; batched claim tx — see
+        _acquire_jobs_batched for the claim-tx/shard/steal contract."""
+        return [
+            AcquiredCollectionJob(
+                TaskId(t),
+                CollectionJobId(j),
+                Lease(token, Time(expiry), att),
+                shard_key=sk,
             )
-            if cur is not None:
-                out.append(
-                    AcquiredCollectionJob(
-                        TaskId(task_id),
-                        CollectionJobId(cj_id),
-                        Lease(token, Time(now + lease_duration.seconds), cur[0]),
-                    )
-                )
-        return out
+            for t, j, token, expiry, att, sk in self._acquire_jobs_batched(
+                "collection_jobs",
+                "collection_job_id",
+                "state IN ('start', 'collectable')",
+                lease_duration,
+                limit,
+                shard,
+                holder,
+            )
+        ]
 
     def release_collection_job(self, acquired: AcquiredCollectionJob) -> None:
         cur = self._c.execute(
-            "UPDATE collection_jobs SET lease_expiry = 0, lease_token = NULL, lease_attempts = 0"
+            "UPDATE collection_jobs SET lease_expiry = ?, lease_token = NULL,"
+            " lease_attempts = 0, shard_key = ?"
             " WHERE task_id = ? AND collection_job_id = ? AND lease_token = ?",
-            (acquired.task_id.data, acquired.collection_job_id.data, acquired.lease.token),
+            (
+                self._clock.now().seconds,  # eligible-since (see agg jobs)
+                # re-stamp affinity (see release_aggregation_job)
+                job_shard_key(
+                    acquired.task_id.data, acquired.collection_job_id.data
+                ),
+                acquired.task_id.data,
+                acquired.collection_job_id.data,
+                acquired.lease.token,
+            ),
         )
         if cur.rowcount != 1:
-            raise TxConflict("lease token mismatch on release")
+            raise self._lease_conflict(
+                "collection", "release", "lease token mismatch on release"
+            )
 
     def step_back_collection_job(
         self,
         acquired: AcquiredCollectionJob,
         reacquire_delay_s: int = 0,
         count_attempt: bool = False,
+        handback: bool = False,
     ) -> None:
         """Collection-job analog of step_back_aggregation_job (early
-        release with a reacquire delay, attempts preserved/refunded)."""
+        release with a reacquire delay, attempts preserved/refunded;
+        handback releases the row's shard affinity past any steal
+        fence)."""
         now = self._clock.now().seconds
         # CASE instead of MAX/GREATEST: scalar max() is sqlite-only and
         # GREATEST needs sqlite >= 3.44 / postgres — CASE runs on both
@@ -1108,19 +1341,29 @@ class Transaction:
             if count_attempt
             else "CASE WHEN lease_attempts > 0 THEN lease_attempts - 1 ELSE 0 END"
         )
+        shard_key = (
+            HANDBACK_SHARD_KEY
+            if handback
+            else job_shard_key(
+                acquired.task_id.data, acquired.collection_job_id.data
+            )
+        )
         cur = self._c.execute(
             "UPDATE collection_jobs SET lease_expiry = ?, lease_token = NULL,"
-            f" lease_attempts = {attempts_sql}"
+            f" lease_attempts = {attempts_sql}, shard_key = ?"
             " WHERE task_id = ? AND collection_job_id = ? AND lease_token = ?",
             (
                 now + max(0, int(reacquire_delay_s)),
+                shard_key,
                 acquired.task_id.data,
                 acquired.collection_job_id.data,
                 acquired.lease.token,
             ),
         )
         if cur.rowcount != 1:
-            raise TxConflict("lease token mismatch on step-back")
+            raise self._lease_conflict(
+                "collection", "step_back", "lease token mismatch on step-back"
+            )
 
     # ---- aggregate share jobs (reference datastore.rs:3369-3706) ----
     def put_aggregate_share_job(self, job: AggregateShareJob) -> None:
@@ -1351,19 +1594,35 @@ class Transaction:
         """[(job type, task_id, job_id, lease_expiry)] for every lease
         currently outstanding (token set, not yet expired). The sampler
         tracks first-observation time per lease to export
-        janus_job_lease_age_seconds."""
+        janus_job_lease_age_seconds. A projection of get_lease_holders
+        — ONE definition of "held" for both reads."""
+        return [
+            (typ, task_id, job_id, expiry)
+            for typ, task_id, job_id, _holder, expiry in self.get_lease_holders()
+        ]
+
+    def get_lease_holders(self) -> list[tuple[str, bytes, bytes, str, int]]:
+        """[(job type, task_id, job_id, holder provenance hex,
+        lease_expiry)] for every outstanding lease — which REPLICA
+        holds which job, read off the provenance half of the lease
+        token (docs/ARCHITECTURE.md "Running a fleet"). The fleet
+        chaos scenario's who-holds-what assertions read it, and
+        get_held_lease_expiries (the sampler's lease-age feed) is a
+        projection of it."""
         now = self._clock.now().seconds
-        out: list[tuple[str, bytes, bytes, int]] = []
+        out: list[tuple[str, bytes, bytes, str, int]] = []
         for typ, table, id_col in (
             ("aggregation", "aggregation_jobs", "job_id"),
             ("collection", "collection_jobs", "collection_job_id"),
         ):
             rows = self._c.execute(
-                f"SELECT task_id, {id_col}, lease_expiry FROM {table}"
+                f"SELECT task_id, {id_col}, lease_token, lease_expiry FROM {table}"
                 " WHERE lease_token IS NOT NULL AND lease_expiry > ?",
                 (now,),
             ).fetchall()
-            out.extend((typ, r[0], r[1], int(r[2])) for r in rows)
+            out.extend(
+                (typ, r[0], r[1], lease_holder_hex(r[2]), int(r[3])) for r in rows
+            )
         return out
 
     def min_unaggregated_report_time_by_task(self) -> list[tuple[bytes, int]]:
@@ -1667,9 +1926,14 @@ class Datastore:
           "connection"     the connection (or the database under it) is
                            gone — discard the cached connection,
                            reconnect, and tell the supervisor
-          "fatal"          schema/SQL error — retrying cannot help
+          "fatal"          schema/SQL error or a deterministic lease
+                           conflict — retrying cannot help
           "other"          anything else
         """
+        if isinstance(e, LeaseConflict):
+            # deterministic: the lease is gone; a retry re-reads the
+            # same mismatch 16 times and then raises anyway
+            return "fatal"
         if isinstance(e, TxConflict):
             return "serialization"
         if isinstance(e, sqlite3.OperationalError):
@@ -2197,6 +2461,8 @@ class PostgresDatastore(Datastore):
 
     def classify_error(self, e: BaseException) -> str:
         errs = self._driver.errors
+        if isinstance(e, LeaseConflict):
+            return "fatal"  # deterministic token mismatch — see sqlite engine
         if isinstance(
             e, (errs.SerializationFailure, errs.DeadlockDetected, TxConflict)
         ):

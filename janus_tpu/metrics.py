@@ -844,6 +844,119 @@ boot_phase_seconds = REGISTRY.gauge(
     "cold-start regression gate",
 )
 
+# --- fleet scale-out: batched sharded lease claims + replica identity
+# (ISSUE 15; docs/ARCHITECTURE.md "Running a fleet") ---
+lease_acquire_tx_total = REGISTRY.counter(
+    "janus_lease_acquire_tx_total",
+    "batched lease-claim transactions run by the job drivers, by job "
+    'kind and outcome (outcome="claimed" leased >= 1 job, "empty" '
+    "found nothing eligible) — divide janus_lease_acquired_jobs_total "
+    "by the claimed count for jobs-per-claim-roundtrip",
+)
+lease_acquired_jobs_total = REGISTRY.counter(
+    "janus_lease_acquired_jobs_total",
+    "jobs leased by the batched claim transactions, by job kind",
+)
+lease_steals_total = REGISTRY.counter(
+    "janus_lease_steals_total",
+    "leased jobs whose persisted shard key belongs to ANOTHER "
+    "replica's shard (claimed through the steal-after-delay fallback), "
+    "by job kind — a sustained nonzero rate means a replica is dead or "
+    "starving and its shard is draining through its peers. Clean "
+    "shutdown hand-backs (shard affinity released by a draining "
+    "replica) are NOT counted: a routine rolling restart stays silent",
+)
+lease_conflicts_total = REGISTRY.counter(
+    "janus_lease_conflicts_total",
+    "token-guarded lease writes (release / step-back) that found the "
+    "token no longer matching — the lease expired and another replica "
+    "re-acquired the job — by job kind and op; zero in a healthy fleet "
+    "(a nonzero rate means leases are outliving their work)",
+)
+replica_info = REGISTRY.gauge(
+    "janus_replica_info",
+    "constant 1, with this process's fleet identity as labels "
+    "(replica_id/shard_index/shard_count) — join against it when N "
+    "replicas export to one scrape plane",
+)
+
+_REPLICA_ID: str | None = None
+_REPLICA_LABELED = False
+_REPLICA_SHARD = (0, 1)  # (shard_index, shard_count)
+
+
+def _fleet_status() -> dict:
+    """Default /statusz `fleet` section (every process; janus_main
+    replaces it with the richer config-aware one)."""
+    return {
+        "replica_id": replica_id(),
+        "configured": _REPLICA_LABELED,
+        "shard_index": _REPLICA_SHARD[0],
+        "shard_count": _REPLICA_SHARD[1],
+    }
+
+
+def default_replica_id() -> str:
+    """Stable-per-process fallback replica id (hostname-pid) used when
+    no fleet identity is configured."""
+    import socket
+
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+def replica_labels() -> dict:
+    """Per-replica labels for the job-driver/health-sampler/SLO metric
+    families: {} until a fleet identity is EXPLICITLY configured
+    (fleet.replica_id YAML / JANUS_REPLICA_ID env), so single-process
+    deployments keep their exact label sets, and {"replica": id} in a
+    fleet — N processes exporting to one scrape plane stay
+    distinguishable."""
+    if _REPLICA_LABELED and _REPLICA_ID:
+        return {"replica": _REPLICA_ID}
+    return {}
+
+
+def set_replica_identity(
+    replica_id: str | None = None,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    labeled: bool | None = None,
+) -> None:
+    """(Re-)populate janus_replica_info and set the per-replica label
+    policy. `labeled` defaults to "a replica_id was explicitly given".
+    The gauge is exclusive like janus_build_info: re-registration
+    zeroes the previous label set."""
+    global _REPLICA_ID, _REPLICA_LABELED, _REPLICA_SHARD
+    explicit = replica_id is not None
+    _REPLICA_ID = replica_id or default_replica_id()
+    _REPLICA_LABELED = explicit if labeled is None else labeled
+    # normalize like the claim predicate does (shard_index mod count):
+    # the exported identity must name the shard the replica actually
+    # claims, never a nonexistent out-of-range slice
+    count = max(1, int(shard_count))
+    shard_index = int(shard_index) % count
+    shard_count = count
+    _REPLICA_SHARD = (shard_index, shard_count)
+    with replica_info._lock:
+        for key in list(replica_info._values):
+            replica_info._values[key] = 0.0
+    replica_info.set(
+        1,
+        replica_id=_REPLICA_ID,
+        shard_index=str(int(shard_index)),
+        shard_count=str(int(shard_count)),
+    )
+    from .statusz import register_status_provider
+
+    register_status_provider("fleet", _fleet_status)
+
+
+def replica_id() -> str:
+    """The process's current replica id (auto-generated until
+    set_replica_identity installs a configured one)."""
+    return _REPLICA_ID or default_replica_id()
+
+
 # --- standard process/build families scrapers expect (janus_-prefixed
 # per the repo naming lint; populated by register_build_info at import
 # and refreshed by janus_main once the configured backend is known) ---
@@ -912,6 +1025,10 @@ def register_build_info(backend: str | None = None) -> None:
 
 
 register_build_info()
+# auto identity at import (hostname-pid, UNLABELED): janus_replica_info
+# always has exactly one value-1 sample; janus_main re-registers with
+# the configured fleet identity (and turns per-replica labels on)
+set_replica_identity()
 
 
 def _register_span_bridges() -> None:

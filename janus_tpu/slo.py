@@ -661,16 +661,21 @@ class SloEngine:
             for win_s in (w.long_s, w.short_s):
                 if win_s not in burns:
                     burns[win_s] = self._burn(st, win_s * self.window_scale, now)
+        # per-replica labels ({} until a fleet identity is configured):
+        # N replicas' SLO engines exporting to one scrape plane stay
+        # truthful — each replica's burn is its own series, never a
+        # last-write-wins blend (docs/ARCHITECTURE.md "Running a fleet")
+        rl = metrics.replica_labels()
         for win_s, burn in burns.items():
             metrics.slo_burn_rate.set(
-                burn, slo=d.name, window=format_window(win_s)
+                burn, slo=d.name, window=format_window(win_s), **rl
             )
 
         # budget remaining over the budget window
         bad_d, total_d, _ = st.window_delta(self.budget_window_s, now)
         err_ratio = (bad_d / total_d) if total_d > 0 else 0.0
         metrics.slo_error_budget_remaining.set(
-            1.0 - err_ratio / d.budget, slo=d.name
+            1.0 - err_ratio / d.budget, slo=d.name, **rl
         )
 
         severity_firing: dict[str, bool] = {}
@@ -704,7 +709,7 @@ class SloEngine:
             )
         for severity, firing in severity_firing.items():
             metrics.alert_active.set(
-                1.0 if firing else 0.0, alert=d.name, severity=severity
+                1.0 if firing else 0.0, alert=d.name, severity=severity, **rl
             )
 
     def _burn(self, st: _SloState, window_s: float, now: float) -> float:

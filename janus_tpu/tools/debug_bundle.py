@@ -12,7 +12,11 @@ health listeners — /metrics (both exposition modes), /statusz,
 /debug/boot, /alertz, /readyz, /healthz — plus the
 resolved YAML config (secrets redacted) and the upload-journal
 directory state, into a timestamped tar.gz with a MANIFEST.json
-inventorying every capture (source, HTTP status, bytes, sha256). This
+inventorying every capture (source, HTTP status, bytes, sha256). One
+invocation takes ANY number of --url targets, and each target's
+MANIFEST entry records the fleet replica id read off its /statusz —
+so one incident bundle covers a whole replica fleet and stays
+attributable per process. This
 is the artifact an operator attaches to an incident: the flight
 recorder, the SLO engine's burn rates and the metric families of the
 moment, collected before the evidence scrolls out of the rings.
@@ -208,6 +212,7 @@ def collect_bundle(
         base = url.rstrip("/")
         target = _target_name(base)
         captured = {}
+        replica_id = None
         for name, path in ENDPOINTS:
             source = base + path
             ext = (
@@ -225,7 +230,21 @@ def collect_bundle(
                 continue
             add_file(rel, body, source, status=status)
             captured[name] = {"status": status, "bytes": len(body)}
-        manifest["targets"][target] = {"url": base, "endpoints": captured}
+            if name == "statusz" and status == 200:
+                # fleet replica identity per capture (ISSUE 15): one
+                # incident bundle covers the whole fleet, so every
+                # target records WHICH replica it was
+                try:
+                    replica_id = (
+                        json.loads(body).get("fleet", {}).get("replica_id")
+                    )
+                except Exception:
+                    replica_id = None
+        manifest["targets"][target] = {
+            "url": base,
+            "replica_id": replica_id,
+            "endpoints": captured,
+        }
 
     if config_file:
         try:

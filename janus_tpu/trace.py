@@ -170,6 +170,9 @@ class OtlpExporter:
                 {"key": "process.pid", "value": {"intValue": str(os.getpid())}},
             ]
         }
+        # process-wide resource attributes set before this exporter
+        # existed (fleet replica identity) still apply
+        self.apply_resource_attributes(resource_attributes())
         self._spans: list[dict] = []
         self._lock = threading.Lock()
         # a hung collector must not stall the flush loop past its own
@@ -182,6 +185,23 @@ class OtlpExporter:
         )
         self._thread.start()
         atexit.register(self.shutdown)
+
+    def apply_resource_attributes(self, attrs: dict) -> None:
+        """Merge process-wide resource attributes (replica identity)
+        into this exporter's OTLP resource, last-write-wins by key.
+        Copy-on-write: the flush thread serializes self._resource
+        concurrently, so the merged document is built aside and
+        swapped in with one atomic reference assignment — never
+        mutated in place under a running json.dumps."""
+        merged = [dict(ent) for ent in self._resource["attributes"]]
+        for k, v in attrs.items():
+            for ent in merged:
+                if ent["key"] == k:
+                    ent["value"] = {"stringValue": str(v)}
+                    break
+            else:
+                merged.append({"key": k, "value": {"stringValue": str(v)}})
+        self._resource = {"attributes": merged}
 
     # --- span intake (called from span()'s exit path) ---
     def record_span(self, name, start_unix_ns, end_unix_ns, trace_id, span_id, parent_span_id, attrs):
@@ -726,7 +746,10 @@ class FlightRecorder:
 
     def snapshot(self, recent_limit: int = 100) -> dict:
         """The /debug/traces payload: recent spans (newest last), the
-        captured slow traces, and the per-name latency digests."""
+        captured slow traces, and the per-name latency digests. Every
+        span implicitly carries the process resource attributes
+        (replica identity in a fleet) — surfaced once at the top, OTLP
+        resource-semantics style, instead of per span."""
         recent = list(self._ring)[-recent_limit:] if recent_limit > 0 else []
         with self._lock:
             digests = {name: d.doc() for name, d in sorted(self._digests.items())}
@@ -735,6 +758,7 @@ class FlightRecorder:
             "recorded_total": self._recorded,
             "capacity": self.capacity,
             "default_slow_threshold_s": self.default_slow_threshold_s,
+            "resource": dict(_resource_attributes),
             "recent": [self._entry_doc(e) for e in recent],
             "slow_traces": slow,
             "digests": digests,
@@ -761,6 +785,29 @@ _flight_recorder = FlightRecorder()
 def flight_recorder() -> FlightRecorder:
     """The process-wide always-on recorder."""
     return _flight_recorder
+
+
+# Process-wide resource attributes (OTLP resource semantics: they apply
+# to every span this process emits). janus_main stamps the fleet
+# replica identity here so traces from N replicas over one datastore
+# stay attributable; /debug/traces surfaces them in its snapshot and
+# the OTLP exporter merges them into resourceSpans.resource.
+_resource_attributes: dict[str, str] = {}
+
+
+def set_resource_attributes(**attrs) -> None:
+    """Set/overwrite process-wide trace resource attributes (e.g.
+    replica="replica-3"). Applied to the flight-recorder snapshot and
+    to any OTLP exporter installed now or later."""
+    for k, v in attrs.items():
+        _resource_attributes[str(k)] = str(v)
+    exporter = _otlp_exporter
+    if exporter is not None:
+        exporter.apply_resource_attributes(_resource_attributes)
+
+
+def resource_attributes() -> dict:
+    return dict(_resource_attributes)
 
 
 # span-error counter resolved lazily (importing metrics at module level

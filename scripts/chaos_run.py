@@ -153,6 +153,11 @@ DEVICE_HANG_SCHEDULE = "engine.dispatch=hang,count=1"
 # has a real window to overlap device work with (loopback RTTs are
 # otherwise microseconds and the overlap proof would be flaky)
 PIPELINE_RTT_SCHEDULE = "helper.request=delay:0.08"
+# --scenario fleet: stretch the helper RTT so job throughput is
+# RTT-bound — N replicas' worker pools then overlap N times the
+# sleeping round trips and the served-rps scaling curve measures FLEET
+# parallelism, not a 2-core host's CPU arithmetic
+FLEET_RTT_SCHEDULE = "helper.request=delay:0.1"
 
 
 def _free_port() -> int:
@@ -2049,6 +2054,529 @@ def run_resident(
         helper_ds.close()
 
 
+def claim_roundtrip_stats(n_jobs: int = 32, batch: int = 16) -> dict:
+    """Claim round-trips per job, measured not assumed (ISSUE 15): the
+    batched claim transaction vs a reimplementation of the old per-row
+    loop, both over the recorded-conversation pg_fake driver so every
+    statement is counted exactly as it would hit the PG wire. The
+    batched form issues ONE statement per claim transaction; the
+    per-row loop issued 1 SELECT + K guarded UPDATEs."""
+    import secrets as _secrets
+
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.models import AggregationJobModel, AggregationJobState
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import AggregationJobId, Duration, Interval, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    def seeded_store():
+        eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine="pgfake")
+        ds = eph.datastore
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+            .with_(min_batch_size=1)
+            .build()
+        )
+        ds.run_tx(lambda tx: tx.put_task(task))
+
+        def put_jobs(tx):
+            for i in range(n_jobs):
+                tx.put_aggregation_job(
+                    AggregationJobModel(
+                        task.task_id,
+                        AggregationJobId(i.to_bytes(16, "big")),
+                        b"",
+                        b"\x01",
+                        Interval(Time(1_600_000_000), Duration(1)),
+                        AggregationJobState.IN_PROGRESS,
+                        0,
+                    )
+                )
+
+        ds.run_tx(put_jobs)
+        return eph, ds
+
+    def count_statements(ds, claim_fn) -> tuple[int, int]:
+        """(statements executed, jobs claimed) draining the store."""
+        driver = ds._driver
+        driver.clear_log()
+        claimed = 0
+        while True:
+            got = ds.run_tx(lambda tx: claim_fn(tx))
+            if not got:
+                break
+            claimed += len(got)
+        return len(driver.statements("execute")), claimed
+
+    def legacy_per_row(tx):
+        """The pre-ISSUE-15 per-row claim loop, preserved here as the
+        measurement oracle (one SELECT, then a guarded UPDATE ..
+        RETURNING per candidate row)."""
+        now = tx._clock.now().seconds
+        rows = tx._c.execute(
+            "SELECT task_id, job_id FROM aggregation_jobs"
+            " WHERE state = 'in_progress' AND lease_expiry <= ?"
+            " ORDER BY lease_expiry LIMIT ?" + tx._lease_suffix,
+            (now, batch),
+        ).fetchall()
+        out = []
+        for task_id, job_id in rows:
+            token = _secrets.token_bytes(16)
+            cur = tx._c.execute(
+                "UPDATE aggregation_jobs SET lease_expiry = ?, lease_token = ?,"
+                " lease_attempts = lease_attempts + 1"
+                " WHERE task_id = ? AND job_id = ? AND state = 'in_progress'"
+                " AND lease_expiry <= ? RETURNING lease_attempts",
+                (now + 600, token, task_id, job_id, now),
+            ).fetchone()
+            if cur is not None:
+                out.append((task_id, job_id))
+        return out
+
+    eph, ds = seeded_store()
+    try:
+        batched_stmts, batched_claimed = count_statements(
+            ds,
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), batch),
+        )
+    finally:
+        eph.cleanup()
+    eph, ds = seeded_store()
+    try:
+        legacy_stmts, legacy_claimed = count_statements(ds, legacy_per_row)
+    finally:
+        eph.cleanup()
+    batched_per_job = batched_stmts / max(1, batched_claimed)
+    legacy_per_job = legacy_stmts / max(1, legacy_claimed)
+    return {
+        "jobs": n_jobs,
+        "claim_batch": batch,
+        "batched_statements": batched_stmts,
+        "batched_claimed": batched_claimed,
+        "batched_stmts_per_job": round(batched_per_job, 3),
+        "per_row_statements": legacy_stmts,
+        "per_row_claimed": legacy_claimed,
+        "per_row_stmts_per_job": round(legacy_per_job, 3),
+        # THE acceptance comparison: claim round-trips per job,
+        # batched vs the per-row loop (gate: measurably below)
+        "roundtrip_ratio": round(legacy_per_job / max(1e-9, batched_per_job), 1),
+        "claim_roundtrips_ok": (
+            batched_claimed == n_jobs
+            and legacy_claimed == n_jobs
+            and batched_per_job < legacy_per_job / 2
+        ),
+    }
+
+
+def run_fleet(
+    replicas: int = 4,
+    jobs_per_replica: int = 24,
+    job_size: int = 2,
+    lease_ttl_s: int = 5,
+    steal_after_s: int = 2,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Fleet-grade scale-out proof (ISSUE 15; docs/ARCHITECTURE.md
+    "Running a fleet"): N REAL aggregation-job-driver binaries — each
+    with its own fleet identity and shard slice — over ONE leader
+    datastore, under RTT-bound load. Phases:
+
+      1. claim-efficiency: batched claim tx vs the old per-row loop,
+         statements counted on the recorded PG wire (in-process);
+      2. scaling curve: served rps with 1, 2 and 4 replicas (2 in the
+         smoke), each phase its own driver set + fresh job wave — the
+         BENCH `fleet_scaling` record;
+      3. chaos: a full fleet under load — SIGKILL one replica while it
+         HOLDS leases (lease expires, survivors steal its shard after
+         the delay, attempt accounting intact), SIGTERM-drain another
+         (leases handed back immediately, rc 0), restart the killed
+         replica (warm-boot path) and prove it serves a fresh wave;
+      4. collection == admitted ground truth EXACTLY across every
+         wave, zero lease-token conflicts on every scraped replica
+         (no job double-stepped), and no job starves past
+         ttl + steal + margin after the kill.
+
+    Every `*_ok` key must be True to pass."""
+    import threading
+
+    import dataclasses
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.binary_utils import enable_compile_cache, warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore, replica_holder_tag
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-fleet-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    clock = RealClock()
+    leader_db = os.path.join(tmp, "leader.sqlite")
+    leader_ds = Datastore(leader_db, Crypter([key_bytes]), clock)
+    helper_ds = Datastore(os.path.join(tmp, "helper.sqlite"), Crypter([key_bytes]), clock)
+
+    result: dict = {
+        "workdir": tmp,
+        "schedule": "fleet_full" if full else "fleet_smoke",
+        "replicas": replicas,
+    }
+    procs: list[subprocess.Popen] = []
+    leader_srv = helper_srv = None
+    try:
+        # --- phase 1: claim round-trips per job, measured ------------
+        result["claim_stats"] = claim_roundtrip_stats()
+        result["claim_roundtrips_ok"] = result["claim_stats"]["claim_roundtrips_ok"]
+
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+
+        vdaf = VdafInstance.count()
+        collector_kp = generate_hpke_config_and_private_key(config_id=205)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=helper_srv.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=5),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+        enable_compile_cache()
+        warmup_engines(leader_ds, batch=job_size)
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url, leader_task.time_precision
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        creator = AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=job_size
+            ),
+        )
+        measurements: list[int] = []
+        finished_target = {"jobs": 0}
+
+        def upload_wave(n_reports: int) -> int:
+            wave = [(i % 3 != 0) * 1 for i in range(n_reports)]
+            for m in wave:
+                client.upload(m)
+            measurements.extend(wave)
+            return (n_reports + job_size - 1) // job_size
+
+        def finished_jobs() -> int:
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "fleet_monitor"
+            )
+            return sum(
+                n
+                for (typ, state), n in counts.items()
+                if typ == "aggregation" and state == "finished"
+            )
+
+        def wait_finished(deadline_s: float) -> bool:
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if finished_jobs() >= finished_target["jobs"]:
+                    return True
+                time.sleep(0.05)
+            return finished_jobs() >= finished_target["jobs"]
+
+        def spawn_replica(i: int, shard_count: int, tag: str):
+            """One REAL driver binary with fleet identity replica-i of
+            shard_count; `tag` keeps per-phase artifacts apart."""
+            port = _free_port()
+            cfg = _driver_cfg(
+                os.path.join(tmp, f"driver-{tag}-{i}.yaml"),
+                leader_db,
+                port,
+                int(lease_ttl_s),
+                1.5,
+                extra=(
+                    "max_concurrent_job_workers: 4\n"
+                    "fleet:\n"
+                    f"  replica_id: replica-{i}\n"
+                    f"  shard_count: {shard_count}\n"
+                    f"  shard_index: {i}\n"
+                    f"  steal_after_secs: {steal_after_s}\n"
+                ),
+            )
+            drv = _spawn_driver(
+                cfg, key, os.path.join(tmp, f"driver-{tag}-{i}.log"), FLEET_RTT_SCHEDULE
+            )
+            procs.append(drv)
+            return i, port, drv
+
+        def drain(replica_set, expect_rc0: bool = True) -> bool:
+            ok = True
+            for _i, _port, drv in replica_set:
+                if drv.poll() is None:
+                    drv.send_signal(signal.SIGTERM)
+            for _i, _port, drv in replica_set:
+                try:
+                    rc = drv.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    drv.kill()
+                    rc = None
+                ok = ok and (rc == 0 or not expect_rc0)
+            return ok
+
+        # --- phase 2: served-rps scaling curve -----------------------
+        phase_counts = (1, 2, 4) if full else (1, 2)
+        rps: dict[int, float] = {}
+        for n in phase_counts:
+            fleet = [spawn_replica(i, n, f"scale{n}") for i in range(n)]
+            for _i, port, _drv in fleet:
+                _wait_healthz(port)
+            jobs = upload_wave(jobs_per_replica * n * job_size)
+            finished_target["jobs"] += jobs
+            t0 = time.monotonic()
+            creator.run_once()
+            done = wait_finished(120)
+            elapsed = time.monotonic() - t0
+            result[f"scale_{n}_done_ok"] = done
+            rps[n] = (jobs_per_replica * n * job_size) / max(1e-9, elapsed)
+            result[f"drain_scale_{n}_ok"] = drain(fleet)
+        n_max = max(phase_counts)
+        result["fleet_scaling"] = {
+            "replica_counts": list(phase_counts),
+            "served_rps": {str(n): round(rps[n], 1) for n in phase_counts},
+            "speedup_max_vs_1": round(rps[n_max] / max(1e-9, rps[1]), 2),
+            "scaling_efficiency": round(
+                rps[n_max] / max(1e-9, rps[1]) / n_max, 2
+            ),
+            "claim_stats": result["claim_stats"],
+        }
+        # CI-honest gate: RTT-bound work must scale meaningfully with
+        # replica count (full 1->4: >= 1.8x; smoke 1->2: >= 1.2x) — the
+        # record carries the real efficiency number either way
+        gate = 1.8 if full else 1.2
+        result["scaling_gate"] = gate
+        result["scaling_ok"] = result["fleet_scaling"]["speedup_max_vs_1"] >= gate
+
+        # --- phase 3: kill / drain / restart under load --------------
+        chaos_n = replicas if full else 2
+        fleet = [spawn_replica(i, chaos_n, "chaos") for i in range(chaos_n)]
+        by_idx = {i: (i, port, drv) for i, port, drv in fleet}
+        for _i, port, _drv in fleet:
+            _wait_healthz(port)
+        jobs = upload_wave(jobs_per_replica * chaos_n * job_size)
+        finished_target["jobs"] += jobs
+        creator.run_once()
+
+        # wait until the victim (replica 0) HOLDS a lease mid-step,
+        # proven by the provenance tag on the held row. If a wave
+        # drains before the poll catches it (a fast machine, not a
+        # product defect), upload ANOTHER wave and keep looking — the
+        # kill must be provably mid-step, never a guess.
+        victim_tag = replica_holder_tag("replica-0").hex()
+        tags = {replica_holder_tag(f"replica-{i}").hex(): i for i in range(chaos_n)}
+        victim_holding = False
+        seen_holder_tags: set = set()
+        for _attempt in range(4):
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                holders = leader_ds.run_tx(
+                    lambda tx: tx.get_lease_holders(), "fleet_monitor"
+                )
+                seen_holder_tags.update(h[3] for h in holders)
+                if any(h[3] == victim_tag for h in holders):
+                    victim_holding = True
+                    break
+                if finished_jobs() >= finished_target["jobs"]:
+                    break  # wave drained before we caught the victim
+                time.sleep(0.01)
+            if victim_holding:
+                break
+            finished_target["jobs"] += upload_wave(jobs_per_replica * chaos_n * job_size)
+            creator.run_once()
+        result["victim_held_lease_ok"] = victim_holding
+        result["holder_tags_are_replica_tags_ok"] = bool(seen_holder_tags) and all(
+            t in tags for t in seen_holder_tags
+        )
+
+        # SIGKILL the victim MID-STEP: nothing releases its leases —
+        # they must expire and drain through TTL + steal-after
+        _, victim_port, victim = by_idx[0]
+        victim.send_signal(signal.SIGKILL)
+        t_kill = time.monotonic()
+        result["victim_killed_rc"] = victim.wait(timeout=30)
+        result["victim_sigkill_ok"] = result["victim_killed_rc"] == -signal.SIGKILL
+
+        # SIGTERM-drain another replica: clean rc 0, leases handed back
+        drain_idx = 1
+        result["drain_mid_load_ok"] = drain([by_idx[drain_idx]])
+
+        # survivors (or nobody, in the 2-replica smoke: the restarted
+        # victim) must finish the wave; no job starves past the bound
+        survivors = [by_idx[i] for i in range(chaos_n) if i not in (0, drain_idx)]
+
+        # restart the killed replica (same identity + shard; warm-boot
+        # path: shared compile cache + shape manifest)
+        restarted = spawn_replica(0, chaos_n, "restart")
+        _wait_healthz(restarted[1])
+        result["restart_boot_ok"] = True
+        survivors.append(restarted)
+
+        starvation_bound_s = lease_ttl_s + steal_after_s + 45
+        done = wait_finished(starvation_bound_s)
+        result["chaos_wave_done_ok"] = done
+        result["post_kill_drain_s"] = round(time.monotonic() - t_kill, 1)
+        result["no_starvation_ok"] = (
+            done and result["post_kill_drain_s"] <= starvation_bound_s
+        )
+
+        # a fresh wave lands with the restarted replica participating
+        jobs = upload_wave(jobs_per_replica * job_size)
+        finished_target["jobs"] += jobs
+        creator.run_once()
+        result["restart_wave_done_ok"] = wait_finished(60)
+
+        # fleet observability on every live replica: replica_info
+        # carries the configured identity, the batched claim metrics
+        # are live, and the lease-conflict counter reads ZERO — no job
+        # was ever double-stepped
+        conflicts = 0.0
+        acquired_jobs = 0.0
+        claim_txs = 0.0
+        steals = 0.0
+        replica_info_ok = True
+        for i, port, _drv in survivors:
+            mtext = _scrape(port, "/metrics")
+            info = _metric_samples(mtext, "janus_replica_info")
+            want = f'replica_id="replica-{i}"'
+            if not any(want in k and v == 1.0 for k, v in info.items()):
+                replica_info_ok = False
+            conflicts += sum(
+                _metric_samples(mtext, "janus_lease_conflicts_total").values()
+            )
+            acquired_jobs += sum(
+                _metric_samples(mtext, "janus_lease_acquired_jobs_total").values()
+            )
+            claim_txs += sum(
+                v
+                for k, v in _metric_samples(
+                    mtext, "janus_lease_acquire_tx_total"
+                ).items()
+                if 'outcome="claimed"' in k
+            )
+            steals += sum(
+                _metric_samples(mtext, "janus_lease_steals_total").values()
+            )
+            statusz = json.loads(_scrape(port, "/statusz"))
+            if statusz.get("fleet", {}).get("replica_id") != f"replica-{i}":
+                replica_info_ok = False
+        result["replica_info_ok"] = replica_info_ok
+        result["lease_conflicts_total"] = conflicts
+        result["zero_lease_conflicts_ok"] = conflicts == 0.0
+        result["fleet_acquired_jobs"] = acquired_jobs
+        result["fleet_claim_txs"] = claim_txs
+        result["batched_claims_ok"] = (
+            claim_txs > 0 and acquired_jobs / max(1.0, claim_txs) > 1.0
+        )
+        result["lease_steals"] = steals
+        result["steals_observed_ok"] = steals >= 1.0  # the dead shard drained
+
+        result["drain_final_ok"] = drain(survivors)
+
+        # --- phase 4: collect EVERYTHING vs ground truth -------------
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            cjd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                cjd.run_once()
+                stop_collect.wait(0.3)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+        try:
+            collector = Collector(
+                CollectorParameters(
+                    leader_task.task_id,
+                    leader_srv.url,
+                    leader_task.collector_auth_token,
+                    collector_kp,
+                ),
+                vdaf,
+                HttpClient(),
+            )
+            tp = leader_task.time_precision
+            start = clock.now().to_batch_interval_start(tp)
+            query = Query.time_interval(
+                Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
+            )
+            collected = collector.collect(query, timeout_s=180.0)
+            result["admitted"] = len(measurements)
+            result["ground_truth_sum"] = sum(measurements)
+            result["collected_count"] = collected.report_count
+            result["collected_sum"] = collected.aggregate_result
+            # THE invariant: every admitted report exactly once across
+            # kill, drain, steal, and restart — no loss, no double
+            result["exactly_once_ok"] = (
+                collected.report_count == len(measurements)
+                and collected.aggregate_result == sum(measurements)
+            )
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        failpoints_mod = sys.modules.get("janus_tpu.failpoints")
+        if failpoints_mod is not None:
+            failpoints_mod.clear()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if leader_srv is not None:
+            leader_srv.stop()
+        if helper_srv is not None:
+            helper_srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -2061,7 +2589,7 @@ def main(argv=None) -> int:
         "--scenario",
         choices=[
             "crash_storm", "db_outage", "device_hang", "pipeline", "resident",
-            "cold_start",
+            "cold_start", "fleet",
         ],
         default="crash_storm",
         help="crash_storm = driver SIGKILL + helper storms (default); "
@@ -2076,7 +2604,10 @@ def main(argv=None) -> int:
         "collections exact); cold_start = interleaved cold-cache vs "
         "warm-cache real-binary boots, restart-to-first-dispatch via "
         "/debug/boot (manifest prewarm before ready, warm < 10 s, "
-        "speedup gated)",
+        "speedup gated); fleet = N real driver replicas over one "
+        "store (sharded batched claims): served-rps scaling at 1/2/4 "
+        "replicas, SIGKILL + SIGTERM + restart mid-load, zero lease "
+        "conflicts, exact collection",
     )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
@@ -2110,6 +2641,11 @@ def main(argv=None) -> int:
     elif args.scenario == "cold_start":
         result = run_cold_start(
             pairs=1 if args.smoke else 2,
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    elif args.scenario == "fleet":
+        result = run_fleet(
             full=not args.smoke,
             workdir=args.workdir,
         )

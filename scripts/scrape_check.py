@@ -141,6 +141,13 @@ def main(argv=None) -> int:
         # in every binary
         "janus_engine_prewarm_total",
         "janus_engine_prewarm_seconds",
+        # fleet scale-out: batched sharded lease claims + replica
+        # identity (ISSUE 15) — registered at import in every binary
+        "janus_replica_info",
+        "janus_lease_acquire_tx_total",
+        "janus_lease_acquired_jobs_total",
+        "janus_lease_steals_total",
+        "janus_lease_conflicts_total",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -155,6 +162,22 @@ def main(argv=None) -> int:
             errors.append(
                 "janus_build_info needs exactly one value-1 sample with "
                 "version/python/jax/backend labels"
+            )
+
+    # janus_replica_info (ISSUE 15): exactly one value-1 sample with
+    # the fleet identity labels — the join key when N replicas export
+    # to one scrape plane
+    ri = families.get("janus_replica_info")
+    if ri is not None:
+        live = [(labels, v) for _, labels, v in ri.samples if v == 1]
+        if len(live) != 1 or not {
+            "replica_id",
+            "shard_index",
+            "shard_count",
+        } <= set(live[0][0]):
+            errors.append(
+                "janus_replica_info needs exactly one value-1 sample with "
+                "replica_id/shard_index/shard_count labels"
             )
 
     if args.statusz:
@@ -240,6 +263,15 @@ def main(argv=None) -> int:
                         errors.append(
                             "/statusz engine_prewarm manifest missing 'installed'"
                         )
+                # fleet identity (ISSUE 15): every process carries its
+                # replica id + shard slice on /statusz
+                fl = snap.get("fleet")
+                if not isinstance(fl, dict):
+                    errors.append("/statusz missing the fleet section")
+                else:
+                    for key in ("replica_id", "shard_index", "shard_count"):
+                        if key not in fl:
+                            errors.append(f"/statusz fleet missing {key!r}")
                 dc = snap.get("device_cost")
                 if not isinstance(dc, dict):
                     errors.append("/statusz missing the device_cost section")

@@ -51,6 +51,7 @@ def test_schedules_parse():
         chaos.STORM_SCHEDULE,
         chaos.HELPER_5XX_SCHEDULE,
         chaos.DB_OUTAGE_SCHEDULE,
+        chaos.FLEET_RTT_SCHEDULE,
     ):
         assert failpoints.parse_spec(spec)
     crash = failpoints.parse_spec(chaos.CRASH_SCHEDULE)[

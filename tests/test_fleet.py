@@ -1,0 +1,679 @@
+"""Fleet scale-out invariants (ISSUE 15; docs/ARCHITECTURE.md "Running
+a fleet"): batched sharded lease claims, steal-after-delay drain of a
+dead replica's shard, lease-token provenance, conflict counting, and
+the creator's task-shard preference."""
+
+import os
+import secrets
+import threading
+
+import pytest
+from conftest import DATASTORE_ENGINES
+
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.models import AggregationJobModel, AggregationJobState, ShardSpec
+from janus_tpu.datastore.store import (
+    SHARD_KEY_SPACE,
+    EphemeralDatastore,
+    LeaseConflict,
+    job_shard_key,
+    lease_holder_hex,
+    replica_holder_tag,
+)
+from janus_tpu.messages import AggregationJobId, Duration, Interval, Role, Time
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+
+@pytest.fixture(params=DATASTORE_ENGINES)
+def engine(request):
+    return request.param
+
+
+def make_task(ds):
+    task = (
+        TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+        .with_(min_batch_size=1)
+        .build()
+    )
+    ds.run_tx(lambda tx: tx.put_task(task))
+    return task
+
+
+def put_job(ds, task, job_id_bytes):
+    job = AggregationJobModel(
+        task.task_id,
+        AggregationJobId(job_id_bytes),
+        b"",
+        b"\x01",
+        Interval(Time(1_600_000_000), Duration(1)),
+        AggregationJobState.IN_PROGRESS,
+        0,
+    )
+    ds.run_tx(lambda tx: tx.put_aggregation_job(job))
+    return job
+
+
+def test_shard_key_is_stable_and_bounded():
+    """Same (task, job) identity -> same key, every process, any
+    PYTHONHASHSEED; keys stay inside the declared modulo space."""
+    t, j = secrets.token_bytes(32), secrets.token_bytes(16)
+    k = job_shard_key(t, j)
+    assert k == job_shard_key(t, j)
+    assert 0 <= k < SHARD_KEY_SPACE
+    # distinct jobs spread (not a collision proof, a sanity bound)
+    keys = {job_shard_key(t, i.to_bytes(16, "big")) for i in range(256)}
+    assert len(keys) > 200
+
+
+def test_batched_claim_partitions_exactly_across_racing_handles(engine):
+    """Two datastore handles racing batched claims over the same rows
+    must partition them exactly: no row claimed twice, no eligible row
+    missed — the FOR UPDATE SKIP LOCKED contract, batched."""
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        n_jobs = 24
+        for i in range(n_jobs):
+            put_job(ds, task, i.to_bytes(16, "big"))
+        acquired = []
+        lock = threading.Lock()
+
+        def worker():
+            got = ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 12),
+                "acq",
+            )
+            with lock:
+                acquired.extend(got)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        ids = [a.job_id.data for a in acquired]
+        assert len(ids) == len(set(ids)), "a job was leased to two claimers"
+        assert len(ids) == n_jobs
+        # every batch shares ONE token (identity pins the row); tokens
+        # differ BETWEEN claim transactions
+        by_token = {}
+        for a in acquired:
+            by_token.setdefault(a.lease.token, []).append(a)
+        assert len(by_token) >= 2
+    finally:
+        eph.cleanup()
+
+
+def test_expired_lease_reacquired_with_monotone_attempts(engine):
+    """The expired-lease re-acquire path through the batched claim:
+    attempts increment monotonically across generations, and the stale
+    holder's guarded writes raise LeaseConflict."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        (a1,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(10), 4)
+        )
+        assert a1.lease.attempts == 1
+        # not yet expired: nothing eligible
+        assert (
+            ds.run_tx(lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(10), 4))
+            == []
+        )
+        clock.advance(Duration(60))
+        (a2,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 4)
+        )
+        assert a2.lease.attempts == 2
+        assert a2.lease.token != a1.lease.token
+        with pytest.raises(LeaseConflict):
+            with ds.tx() as tx:
+                tx.release_aggregation_job(a1)
+        ds.run_tx(lambda tx: tx.release_aggregation_job(a2))
+    finally:
+        eph.cleanup()
+
+
+def test_shard_predicate_and_steal_after_delay(engine):
+    """Replica 0 of 2 claims only its own shard immediately; the other
+    shard's rows become claimable to it only after steal_after_s of
+    eligibility — a dead replica's shard drains, late."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        jobs = [put_job(ds, task, i.to_bytes(16, "big")) for i in range(32)]
+        count = 2
+        own = {
+            j.job_id.data
+            for j in jobs
+            if job_shard_key(task.task_id.data, j.job_id.data) % count == 0
+        }
+        assert 0 < len(own) < len(jobs)  # both shards populated
+        shard0 = ShardSpec(shard_count=2, shard_index=0, steal_after_s=30)
+        got = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 64, shard=shard0
+            )
+        )
+        assert {a.job_id.data for a in got} == own, "claimed outside the shard"
+        # before the steal delay: the foreign shard stays foreign
+        clock.advance(Duration(10))
+        assert (
+            ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                    Duration(600), 64, shard=shard0
+                )
+            )
+            == []
+        )
+        # past the steal delay: the dead replica's shard drains
+        clock.advance(Duration(31))
+        stolen = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 64, shard=shard0
+            )
+        )
+        assert {a.job_id.data for a in stolen} == {
+            j.job_id.data for j in jobs
+        } - own
+    finally:
+        eph.cleanup()
+
+
+def test_own_shard_claims_before_stolen_rows(engine):
+    """With both own and stealable rows eligible, the claim order
+    prefers the replica's own shard (the CASE priority ahead of the
+    random() shuffle)."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        jobs = [put_job(ds, task, i.to_bytes(16, "big")) for i in range(32)]
+        count = 2
+        own = {
+            j.job_id.data
+            for j in jobs
+            if job_shard_key(task.task_id.data, j.job_id.data) % count == 0
+        }
+        clock.advance(Duration(60))  # everything past any steal delay
+        shard0 = ShardSpec(shard_count=2, shard_index=0, steal_after_s=1)
+        got = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), len(own), shard=shard0
+            )
+        )
+        assert {a.job_id.data for a in got} == own
+    finally:
+        eph.cleanup()
+
+
+def test_shutdown_handback_is_instantly_stealable(engine):
+    """A clean shutdown hand-back (step_back handback=True) RELEASES
+    the row's shard affinity: a FOREIGN-shard survivor claims the job
+    immediately — and the claim classifies as a hand-back (never a
+    steal: rolling restarts must not fire the starving-shard alert) —
+    while a plain step-back stays fenced for steal_after_s."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        jobs = [put_job(ds, task, i.to_bytes(16, "big")) for i in range(16)]
+        shard0 = ShardSpec(shard_count=2, shard_index=0, steal_after_s=30)
+        shard1 = ShardSpec(shard_count=2, shard_index=1, steal_after_s=30)
+        own1 = {
+            j.job_id.data
+            for j in jobs
+            if job_shard_key(task.task_id.data, j.job_id.data) % 2 == 1
+        }
+        assert own1  # P(empty) = 2^-16 over the random task id
+        got = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_collection_jobs(Duration(600), 1)
+        )  # no collection jobs; keep the claim paths exercised symmetrically
+        assert got == []
+        held = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 64, shard=shard1
+            )
+        )
+        assert {a.job_id.data for a in held} == own1
+        # some hand back cleanly (shutdown drain), the rest plain
+        # step-back — disjoint slices, at least one handed back
+        half = max(1, len(held) // 2)
+        handed, fenced = held[:half], held[half:]
+
+        def give_back(tx):
+            for a in handed:
+                tx.step_back_aggregation_job(a, 0, handback=True)
+            for a in fenced:
+                tx.step_back_aggregation_job(a, 0)
+
+        ds.run_tx(give_back)
+        crossed = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 64, shard=shard0
+            )
+        )
+        crossed_foreign = {
+            a.job_id.data
+            for a in crossed
+            if job_shard_key(task.task_id.data, a.job_id.data) % 2 == 1
+        }
+        # the handed-back jobs crossed the shard fence IMMEDIATELY; the
+        # plain step-backs stayed fenced
+        assert crossed_foreign == {a.job_id.data for a in handed}
+        # ...and they carry the released-affinity sentinel, so the
+        # steal classifier never counts a hand-back as a steal
+        from janus_tpu import metrics
+        from janus_tpu.aggregator.job_driver import record_acquire
+
+        handed_claims = [a for a in crossed if a.job_id.data in crossed_foreign]
+        assert all(a.shard_key is not None and a.shard_key < 0 for a in handed_claims)
+        steals0 = metrics.lease_steals_total.get(kind="aggregation")
+        record_acquire("aggregation", crossed, shard0)
+        assert metrics.lease_steals_total.get(kind="aggregation") == steals0
+    finally:
+        eph.cleanup()
+
+
+def test_parked_acquirer_records_no_claim_tx(engine):
+    """An acquirer parked on a datastore outage ran NO claim
+    transaction and must not count one (the fleet claim counters stay
+    honest through exactly the outages they should measure)."""
+    import types
+
+    from janus_tpu import metrics
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        acquire = AggregationJobDriver(ds, http=None).acquirer(600)
+        before = (
+            metrics.lease_acquire_tx_total.get(kind="aggregation", outcome="empty"),
+            metrics.lease_acquire_tx_total.get(kind="aggregation", outcome="claimed"),
+        )
+        ds.supervisor = types.SimpleNamespace(state="down", stop=lambda: None)
+        assert acquire(4) == []  # parked, no tx
+        after = (
+            metrics.lease_acquire_tx_total.get(kind="aggregation", outcome="empty"),
+            metrics.lease_acquire_tx_total.get(kind="aggregation", outcome="claimed"),
+        )
+        assert after == before
+        ds.supervisor = None
+        assert len(acquire(4)) == 1  # healthy again: the claim counts
+        assert (
+            metrics.lease_acquire_tx_total.get(kind="aggregation", outcome="claimed")
+            == before[1] + 1
+        )
+    finally:
+        eph.cleanup()
+
+
+def test_claim_order_is_randomized_within_the_window(engine):
+    """Satellite: the deterministic ORDER BY lease_expiry scan is gone
+    — single-row claims over a fresh 20-row store must not always hand
+    out the same row (P[all equal] = 20^-7 under random order)."""
+    seen = set()
+    for _ in range(8):
+        eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
+        ds = eph.datastore
+        try:
+            task = make_task(ds)
+            for i in range(20):
+                put_job(ds, task, i.to_bytes(16, "big"))
+            (a,) = ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+            )
+            seen.add(a.job_id.data)
+        finally:
+            eph.cleanup()
+    assert len(seen) > 1, "claim order is still deterministic"
+
+
+def test_claim_window_prefers_oldest_under_deep_backlog(engine):
+    """The randomization is WINDOWED: with far more eligible rows than
+    the candidate window, a claim only ever picks from the oldest
+    window — a deep post-outage backlog drains oldest-first at window
+    granularity instead of losing all fairness to the shuffle."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        # 96 jobs with staggered eligible-since stamps (creation time)
+        by_age = []
+        for i in range(96):
+            by_age.append(put_job(ds, task, i.to_bytes(16, "big")).job_id.data)
+            clock.advance(Duration(1))
+        claimed = 0
+        for _ in range(6):
+            # the window covers the oldest 64 STILL-ELIGIBLE rows, so
+            # after `claimed` rows left the pool it can reach at most
+            # rank 64 + claimed of the original age order
+            allowed = set(by_age[: 64 + claimed])  # window = max(4*limit, 64)
+            got = ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 4)
+            )
+            assert got, "eligible rows must keep claiming"
+            assert {a.job_id.data for a in got} <= allowed
+            claimed += len(got)
+    finally:
+        eph.cleanup()
+
+
+def test_lease_conflict_counted_and_fatal(engine):
+    """Satellite: a token mismatch on release/step-back counts in
+    janus_lease_conflicts_total{kind,op} and classifies fatal — run_tx
+    raises immediately instead of burning 16 retries."""
+    from janus_tpu import metrics
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        (a1,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(10), 1)
+        )
+        clock.advance(Duration(60))
+        (a2,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 1)
+        )
+        before_rel = metrics.lease_conflicts_total.get(
+            kind="aggregation", op="release"
+        )
+        before_sb = metrics.lease_conflicts_total.get(
+            kind="aggregation", op="step_back"
+        )
+        with pytest.raises(LeaseConflict):
+            ds.run_tx(lambda tx: tx.release_aggregation_job(a1))
+        with pytest.raises(LeaseConflict):
+            ds.run_tx(lambda tx: tx.step_back_aggregation_job(a1))
+        assert (
+            metrics.lease_conflicts_total.get(kind="aggregation", op="release")
+            == before_rel + 1
+        ), "one conflict event must count exactly once (no retry amplification)"
+        assert (
+            metrics.lease_conflicts_total.get(kind="aggregation", op="step_back")
+            == before_sb + 1
+        )
+        assert ds.classify_error(LeaseConflict("x")) == "fatal"
+        ds.run_tx(lambda tx: tx.release_aggregation_job(a2))
+    finally:
+        eph.cleanup()
+
+
+def test_lease_token_carries_replica_provenance(engine):
+    """The tokens a fleet-configured acquirer mints carry the replica's
+    8-byte provenance tag, readable off the held rows."""
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        tag = replica_holder_tag("replica-7")
+        (a,) = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                Duration(600), 1, holder=tag
+            )
+        )
+        assert a.lease.token[:8] == tag
+        holders = ds.run_tx(lambda tx: tx.get_lease_holders())
+        assert [(h[0], h[3]) for h in holders] == [("aggregation", tag.hex())]
+        assert lease_holder_hex(a.lease.token) == tag.hex()
+    finally:
+        eph.cleanup()
+
+
+def test_fleet_config_yaml_and_env_overrides(monkeypatch):
+    """fleet: stanza parses; env vars (container fleets) win over YAML."""
+    from janus_tpu.config import FleetConfig
+
+    cfg = FleetConfig.from_dict(
+        {"replica_id": "r-1", "shard_count": 4, "shard_index": 2, "steal_after_secs": 5}
+    )
+    assert cfg.replica_id == "r-1" and cfg.shard_count == 4 and cfg.shard_index == 2
+    spec = cfg.shard_spec()
+    assert spec is not None and spec.active and spec.steal_after_s == 5
+    assert cfg.holder_tag() == replica_holder_tag("r-1")
+
+    monkeypatch.setenv("JANUS_REPLICA_ID", "env-r")
+    monkeypatch.setenv("JANUS_SHARD_COUNT", "8")
+    monkeypatch.setenv("JANUS_SHARD_INDEX", "5")
+    monkeypatch.setenv("JANUS_STEAL_AFTER_S", "2.5")
+    cfg = FleetConfig.from_dict({"replica_id": "yaml-r", "shard_count": 2})
+    assert cfg.replica_id == "env-r"
+    assert cfg.shard_count == 8 and cfg.shard_index == 5
+    assert cfg.steal_after_secs == 2.5
+    # unsharded default: the predicate compiles away
+    for var in (
+        "JANUS_REPLICA_ID",
+        "JANUS_SHARD_COUNT",
+        "JANUS_SHARD_INDEX",
+        "JANUS_STEAL_AFTER_S",
+    ):
+        monkeypatch.delenv(var)
+    assert FleetConfig.from_dict(None).shard_spec() is None
+
+
+def test_replica_labels_off_by_default_on_when_configured():
+    """metrics.replica_labels() stays {} until an explicit identity is
+    installed (single-process label sets unchanged), then carries the
+    replica id; janus_replica_info re-registration is exclusive."""
+    from janus_tpu import metrics
+
+    try:
+        metrics.set_replica_identity()  # auto id: UNLABELED
+        assert metrics.replica_labels() == {}
+        metrics.set_replica_identity("fleet-a", shard_index=1, shard_count=4)
+        assert metrics.replica_labels() == {"replica": "fleet-a"}
+        live = [
+            (k, v)
+            for k, v in metrics.replica_info._values.items()
+            if v == 1.0
+        ]
+        assert len(live) == 1
+        labels = dict(live[0][0])
+        assert labels == {
+            "replica_id": "fleet-a",
+            "shard_index": "1",
+            "shard_count": "4",
+        }
+    finally:
+        metrics.set_replica_identity()  # restore the unlabeled default
+
+
+def test_acquirer_records_claim_and_steal_metrics(engine):
+    """The driver acquirer feeds janus_lease_acquire_tx_total /
+    janus_lease_acquired_jobs_total / janus_lease_steals_total —
+    including steals through the steal-after fallback."""
+    from janus_tpu import metrics
+    from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+    from janus_tpu.config import FleetConfig
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        for i in range(16):
+            put_job(ds, task, i.to_bytes(16, "big"))
+        clock.advance(Duration(60))  # everything stealable
+        fleet = FleetConfig(replica_id="r-0", shard_count=2, shard_index=0,
+                            steal_after_secs=1)
+        drv = AggregationJobDriver(ds, http=None)
+        acquire = drv.acquirer(600, fleet=fleet)
+        tx0 = metrics.lease_acquire_tx_total.get(
+            kind="aggregation", outcome="claimed", replica="r-0"
+        )
+        jobs0 = metrics.lease_acquired_jobs_total.get(
+            kind="aggregation", replica="r-0"
+        )
+        steals0 = metrics.lease_steals_total.get(kind="aggregation", replica="r-0")
+        # replica labels ride the families only while configured
+        metrics.set_replica_identity("r-0", shard_index=0, shard_count=2)
+        try:
+            got = acquire(16)
+        finally:
+            metrics.set_replica_identity()
+        assert len(got) == 16
+        own = sum(
+            1
+            for a in got
+            if job_shard_key(a.task_id.data, a.job_id.data) % 2 == 0
+        )
+        assert (
+            metrics.lease_acquire_tx_total.get(
+                kind="aggregation", outcome="claimed", replica="r-0"
+            )
+            == tx0 + 1
+        )
+        assert (
+            metrics.lease_acquired_jobs_total.get(kind="aggregation", replica="r-0")
+            == jobs0 + 16
+        )
+        assert (
+            metrics.lease_steals_total.get(kind="aggregation", replica="r-0")
+            == steals0 + (16 - own)
+        )
+    finally:
+        eph.cleanup()
+
+
+def test_creator_shard_preference_with_steal(engine):
+    """A creator replica sweeps only its own shard's tasks until a
+    foreign task's unaggregated backlog ages past the steal delay."""
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.config import FleetConfig
+    from janus_tpu.datastore.models import LeaderStoredReport
+    from janus_tpu.messages import HpkeCiphertext, HpkeConfigId, ReportId
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        # find two tasks landing on opposite creator shards
+        tasks = []
+        while len(tasks) < 2:
+            t = make_task(ds)
+            shard = job_shard_key(t.task_id.data, b"") % 2
+            if not any(
+                job_shard_key(x.task_id.data, b"") % 2 == shard for x in tasks
+            ):
+                tasks.append(t)
+        tasks.sort(key=lambda t: job_shard_key(t.task_id.data, b"") % 2)
+        now = clock.now().seconds
+
+        def put_reports(tx):
+            for t in tasks:
+                for _ in range(3):
+                    tx.put_client_report(
+                        LeaderStoredReport(
+                            t.task_id,
+                            ReportId(secrets.token_bytes(16)),
+                            Time(now),
+                            b"",
+                            b"x",
+                            HpkeCiphertext(HpkeConfigId(0), b"", b""),
+                        )
+                    )
+
+        ds.run_tx(put_reports)
+        creator = AggregationJobCreator(
+            ds,
+            AggregationJobCreatorConfig(min_aggregation_job_size=1),
+            fleet=FleetConfig(
+                replica_id="c-0", shard_count=2, shard_index=0, steal_after_secs=30
+            ),
+        )
+        assert creator.run_once() == 1  # own-shard task only
+        jobs_own = ds.run_tx(
+            lambda tx: tx.get_aggregation_jobs_for_task(tasks[0].task_id)
+        )
+        jobs_foreign = ds.run_tx(
+            lambda tx: tx.get_aggregation_jobs_for_task(tasks[1].task_id)
+        )
+        assert len(jobs_own) == 1 and len(jobs_foreign) == 0
+        # owner progress resets the window: the "owner" claims a report
+        # (aggregated count moves), so even past the steal delay the
+        # foreign replica must NOT steal yet
+        ds.run_tx(
+            lambda tx: tx.get_unaggregated_client_reports_for_task(
+                tasks[1].task_id, 1
+            ),
+            "owner_progress",
+        )
+        clock.advance(Duration(60))
+        assert creator.run_once() == 0
+        # no further progress across the whole window: stolen
+        clock.advance(Duration(60))
+        assert creator.run_once() == 1
+        jobs_foreign = ds.run_tx(
+            lambda tx: tx.get_aggregation_jobs_for_task(tasks[1].task_id)
+        )
+        assert len(jobs_foreign) == 1
+        # backlog drained -> the steal timer AND the sticky-steal set
+        # reset: the next sweep neither steals nor keeps stale state
+        clock.advance(Duration(60))
+        assert creator.run_once() == 0
+        assert creator._foreign_backlog_first_seen == {}
+        assert creator._stealing == set()
+    finally:
+        eph.cleanup()
+
+
+@pytest.mark.skipif(os.name != "posix", reason="posix-only")
+def test_collection_job_claims_shard_and_partition(engine):
+    """The collection-job claim shares the batched/sharded contract."""
+    from janus_tpu.datastore.models import CollectionJobModel, CollectionJobState
+    from janus_tpu.messages import CollectionJobId
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock, engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+
+        def put_cj(tx, i):
+            tx.put_collection_job(
+                CollectionJobModel(
+                    task.task_id,
+                    CollectionJobId(i.to_bytes(16, "big")),
+                    b"q%d" % i,
+                    b"",
+                    b"b",
+                    CollectionJobState.START,
+                )
+            )
+
+        for i in range(16):
+            ds.run_tx(lambda tx, i=i: put_cj(tx, i))
+        shard0 = ShardSpec(shard_count=2, shard_index=0, steal_after_s=30)
+        own = {
+            i.to_bytes(16, "big")
+            for i in range(16)
+            if job_shard_key(task.task_id.data, i.to_bytes(16, "big")) % 2 == 0
+        }
+        got = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_collection_jobs(
+                Duration(600), 32, shard=shard0
+            )
+        )
+        assert {a.collection_job_id.data for a in got} == own
+    finally:
+        eph.cleanup()

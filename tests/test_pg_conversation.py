@@ -81,9 +81,12 @@ def test_connection_setup(pg):
 
 
 def test_lease_acquire_wire_form(pg):
-    """The lease claim is SELECT ... FOR UPDATE SKIP LOCKED + guarded
-    UPDATE ... RETURNING with a fresh 16-byte token, all with %s
-    placeholders (never sqlite's qmark)."""
+    """The batched lease claim is ONE statement: UPDATE .. WHERE
+    (task_id, job_id) IN (<randomized pick from an index-ordered
+    oldest-first window locked FOR UPDATE SKIP LOCKED>) RETURNING ..,
+    with a fresh 16-byte token and %s placeholders (never sqlite's
+    qmark) — the queue-pop idiom, claiming K jobs per claim
+    round-trip instead of per row."""
     ds, driver = pg
     from tests.test_datastore import _aggjob, mktask
 
@@ -97,18 +100,30 @@ def test_lease_acquire_wire_form(pg):
         lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)
     )
     assert len(acquired) == 1
-    sqls = _sqls(driver)
-    sel = [s for s in sqls if s.lstrip().startswith("SELECT task_id, job_id FROM aggregation_jobs")]
-    assert len(sel) == 1
-    assert sel[0].rstrip().endswith("FOR UPDATE SKIP LOCKED")
-    assert "?" not in sel[0] and "%s" in sel[0]
-    upd = [e for e in driver.statements() if e[1].lstrip().startswith("UPDATE aggregation_jobs SET lease_expiry")]
-    assert len(upd) == 1
-    assert "RETURNING lease_attempts" in upd[0][1]
-    expiry, token, t_id, j_id, now = upd[0][2]
+    upd = [
+        e
+        for e in driver.statements()
+        if e[1].lstrip().startswith("UPDATE aggregation_jobs SET lease_expiry")
+    ]
+    assert len(upd) == 1, "the batched claim must be ONE statement"
+    sql = upd[0][1]
+    # inner: bounded oldest-first window over the lease index, locked
+    # FOR UPDATE SKIP LOCKED; outer: RANDOMIZED claim order within it
+    # (never the whole-backlog collision-maximizing deterministic scan)
+    assert "IN (SELECT task_id, job_id FROM (SELECT task_id, job_id," in sql
+    import re as _re
+
+    assert _re.search(
+        r"ORDER BY lease_expiry LIMIT \d+ FOR UPDATE SKIP LOCKED\)", sql
+    ), sql
+    assert _re.search(r"\) AS cand ORDER BY random\(\) LIMIT %s\)", sql), sql
+    assert "RETURNING task_id, job_id, lease_attempts, shard_key" in sql
+    assert "?" not in sql and "%s" in sql
+    expiry, token, now, limit = upd[0][2]
     assert expiry == now + 600
+    assert limit == 10
     assert isinstance(token, bytes) and len(token) == 16
-    assert t_id == task.task_id.data and j_id == job.job_id.data
+    assert acquired[0].lease.token == token
 
 
 def test_lease_release_guarded_and_conflict(pg):
@@ -128,7 +143,9 @@ def test_lease_release_guarded_and_conflict(pg):
     rel = [e for e in driver.statements() if "lease_token = NULL" in e[1]]
     assert len(rel) == 1
     assert rel[0][1].rstrip().endswith("lease_token = %s")
-    assert rel[0][2][2] == acq.lease.token
+    # params: (eligible-since stamp, re-stamped shard affinity,
+    # task_id, job_id, guarding token)
+    assert rel[0][2][4] == acq.lease.token
 
     # releasing again: token no longer matches -> TxConflict
     with pytest.raises(TxConflict):

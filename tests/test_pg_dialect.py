@@ -139,47 +139,47 @@ def test_pg_ddl_statement_count_matches_sqlite():
 
 
 def test_lease_suffix_lands_in_lease_selects():
-    """The Transaction built with the postgres dialect appends
-    FOR UPDATE SKIP LOCKED to its lease-acquisition SELECTs; validate
-    the suffixed statements still parse (PG grammar accepts the suffix
-    exactly where sqlite's complete_statement sees a complete SELECT)."""
+    """The postgres-dialect batched lease claim embeds FOR UPDATE SKIP
+    LOCKED inside its candidate subquery (the queue-pop idiom:
+    UPDATE .. WHERE (..) IN (SELECT .. LIMIT n FOR UPDATE SKIP
+    LOCKED) RETURNING ..). Drive BOTH claim ops through the recorded
+    pg_fake conversation and validate the wire form: the suffix sits
+    right after the subquery's LIMIT, and the statement with the
+    PG-only clause stripped still parses as complete sqlite SQL."""
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.pg_fake import _to_sqlite
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Time
+
     src = STORE_PATH.read_text()
-    uses = src.count("self._lease_suffix")
-    assert uses >= 2, "lease suffix no longer used where leases are claimed"
-    # reconstruct the suffixed form of each statement that embeds it:
-    # the ops append it via `"..." + self._lease_suffix`, i.e. a BinOp
-    # whose right side is the attribute access
-    tree = ast.parse(src)
-    suffixed = []
-
-    def flat(node):
-        """Concatenated string value of a BinOp(+) chain of constants."""
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            return node.value
-        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
-            left, right = flat(node.left), flat(node.right)
-            if left is not None and right is not None:
-                return left + right
-        if (
-            isinstance(node, ast.Attribute)
-            and node.attr == "_lease_suffix"
-        ):
-            return " FOR UPDATE SKIP LOCKED"
-        return None
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
-            s = flat(node)
-            if s is not None and "FOR UPDATE SKIP LOCKED" in s and SQL_HEAD.match(s):
-                suffixed.append(s)
-    assert len(suffixed) >= 2
-    for sql in suffixed:
-        # sqlite's grammar does not know SKIP LOCKED; strip the suffix
-        # and require the remainder to be a complete SELECT, and the
-        # suffix to sit at the very end (the only spot PG allows)
-        assert sql.endswith(" FOR UPDATE SKIP LOCKED"), sql[-60:]
-        base = sql[: -len(" FOR UPDATE SKIP LOCKED")]
-        assert sqlite3.complete_statement(base.replace("?", "1") + ";"), sql[:120]
+    assert src.count("self._lease_suffix") >= 2, (
+        "lease suffix no longer used where leases are claimed"
+    )
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine="pgfake")
+    try:
+        eph.datastore.run_tx(
+            lambda tx: (
+                tx.acquire_incomplete_aggregation_jobs(Duration(600), 4),
+                tx.acquire_incomplete_collection_jobs(Duration(600), 4),
+            ),
+            "lease_wire_probe",
+        )
+        claims = [
+            e[1]
+            for e in eph.datastore._driver.statements()
+            if "lease_attempts = lease_attempts + 1" in e[1]
+        ]
+        assert len(claims) == 2, claims
+        for sql in claims:
+            # the lock clause sits at the inner index-ordered window
+            assert re.search(r"LIMIT \d+ FOR UPDATE SKIP LOCKED\)", sql), sql
+            assert "RETURNING" in sql
+            assert "%s" in sql and "?" not in sql
+            base = _to_sqlite(sql)
+            probe = re.sub(r"\s+RETURNING\s.+$", "", base, flags=re.S)
+            assert sqlite3.complete_statement(probe.replace("?", "1") + ";"), sql[:160]
+    finally:
+        eph.cleanup()
 
 
 def test_pg_adapter_rewrite_matches_reference_behavior():

@@ -400,6 +400,21 @@ def test_bench_dry_run_smoke():
     assert ps["drain_ok"] is True
     assert ps["exactly_once_ok"] is True
     assert ps["collected_count"] == ps["admitted"]
+    # fleet scale-out (ISSUE 15): two in-process replicas over one
+    # store — replica A dies HOLDING its batched claims (the SIGKILL
+    # analog), replica B finishes its own shard and steals the dead
+    # shard after the delay; nothing is ever double-stepped (conflict
+    # counter 0), the claims are batched (jobs per claim tx > 1), and
+    # the collection equals the admitted ground truth exactly
+    fs = rec["fleet_smoke"]
+    assert fs["both_shards_populated"] is True
+    assert fs["held_by_dead_replica"] >= 1
+    assert fs["survivor_finished_all"] is True, fs
+    assert fs["zero_conflicts"] is True
+    assert fs["dead_shard_stolen"] is True
+    assert fs["batched_claims"] and fs["jobs_per_claim_tx"] > 1.0
+    assert fs["exactly_once"] is True
+    assert fs["collected_count"] == fs["admitted"]
 
 
 def test_collect_cli_end_to_end(capsys):
@@ -586,6 +601,11 @@ def test_debug_bundle_collects_endpoints_config_and_journal(tmp_path):
     target = next(iter(manifest["targets"].values()))
     assert set(target["endpoints"]) == {name for name, _ in ENDPOINTS}
     assert all("error" not in e for e in target["endpoints"].values())
+    # fleet attribution (ISSUE 15): every capture target records WHICH
+    # replica it was, read off the /statusz fleet section
+    from janus_tpu import metrics as _metrics
+
+    assert target["replica_id"] == _metrics.replica_id()
     with tarfile.open(out) as tar:
         names = tar.getnames()
         top = names[0].split("/")[0]
@@ -614,3 +634,4 @@ def test_debug_bundle_collects_endpoints_config_and_journal(tmp_path):
     )
     t2 = next(iter(manifest2["targets"].values()))
     assert all("error" in e for e in t2["endpoints"].values())
+    assert t2["replica_id"] is None  # unreachable: attribution degrades
