@@ -2587,6 +2587,147 @@ def _resident_accumulate_record(inst=None, n: int = 256, k: int = 16, jobs: int 
     }
 
 
+_MESH_SMOKE_MARK = "JANUS_MESH_SMOKE:"
+
+_MESH_SMOKE_CHILD = r'''
+import json, time
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+from janus_tpu.aggregator import engine_cache as ec
+from janus_tpu.aggregator.engine_cache import EngineCache, mesh_status
+from janus_tpu.messages import Duration, Interval, Time
+from janus_tpu.vdaf.registry import VdafInstance
+from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+inst = VdafInstance.sum_vec(length=4, bits=2)
+n = 64
+rng = np.random.default_rng(0xE5)
+args, _ = make_report_batch(inst, random_measurements(inst, n, rng), seed=0xE5)
+nonce, parts, meas, proof, blind0, hseed, blind1 = args
+eng = EngineCache(inst, bytes(range(16)))
+ok = np.ones(n, dtype=bool); ok[::9] = False
+
+def round_once():
+    out0, _s, ver0, part0 = eng.leader_init(nonce, parts, meas, proof, blind0)
+    part0_l = part0 if part0 is not None else np.zeros((n, 2), dtype=np.uint64)
+    out1, _m, _p = eng.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
+    return out0, eng.aggregate(out0, ok), eng.aggregate(out1, ok)
+
+round_once()  # compile round, untimed
+t0 = time.monotonic()
+out0, agg0, agg1 = round_once()
+dt = time.monotonic() - t0
+deltas = eng.aggregate_pending(out0, (np.arange(n) % 2).astype(np.int32), 2)
+iv = Interval(Time(0), Duration(3600))
+eng.resident_merge([(("s", 0), 0, n // 2, iv), (("s", 1), 1, n // 2, iv)], deltas)
+res = sorted((str(r["key"]), [str(x) for x in r["share"]]) for r in eng.resident_take())
+q = mesh_status()["queue"]
+print("JANUS_MESH_SMOKE:" + json.dumps({
+    "devices": len(jax.devices()), "dp": eng.dp, "sp": eng.sp,
+    "agg0": [str(x) for x in agg0], "agg1": [str(x) for x in agg1],
+    "resident": res, "rps": round(n / dt, 2) if dt > 0 else 0.0,
+    "queue_submitted": q["submitted"], "queue_errors": q["errors"],
+    "lane_alive": q["lane_alive"],
+    "dispatch_lock_removed": not hasattr(ec, "_MESH_DISPATCH_LOCK"),
+}), flush=True)
+'''
+
+
+def _mesh_serving_smoke() -> dict:
+    """Mesh serving smoke (ISSUE 16): ONE subprocess with 4 forced
+    virtual CPU devices drives the SERVING EngineCache path — leader +
+    helper init, masked aggregate with rejected lanes, sharded
+    resident accumulate + flush — over a (dp, sp) mesh behind the
+    single-controller dispatch queue; the parent recomputes the SAME
+    batch on its single-device engine and asserts every aggregate and
+    resident share BIT-IDENTICAL. Gates: bit_identical, mesh active
+    (dp*sp > 1), queue submitted > 0 with zero errors, the old
+    process-global dispatch lock gone, rps > 0."""
+    import subprocess
+
+    import numpy as np
+
+    from janus_tpu.aggregator.engine_cache import EngineCache
+    from janus_tpu.messages import Duration, Interval, Time
+    from janus_tpu.vdaf.registry import VdafInstance
+    from janus_tpu.vdaf.testing import make_report_batch, random_measurements
+
+    rec: dict = {"ok": False}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count=4".strip()
+    env.pop("JANUS_MESH_DP", None)
+    env.pop("JANUS_MESH_SP", None)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/jax_comp_cache")
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _MESH_SMOKE_CHILD],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=420,
+        )
+    except subprocess.TimeoutExpired:
+        rec["error"] = "mesh smoke child timeout"
+        return rec
+    rec["rc"] = proc.returncode
+    child = None
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith(_MESH_SMOKE_MARK):
+            child = json.loads(line[len(_MESH_SMOKE_MARK):])
+            break
+    if child is None:
+        rec["error"] = "no mesh smoke record in child stdout"
+        rec["stderr_tail"] = proc.stderr[-1500:]
+        return rec
+    rec.update(child)
+
+    # single-device reference through the SAME serving entry points
+    inst = VdafInstance.sum_vec(length=4, bits=2)
+    n = 64
+    rng = np.random.default_rng(0xE5)
+    args, _ = make_report_batch(inst, random_measurements(inst, n, rng), seed=0xE5)
+    nonce, parts, meas, proof, blind0, hseed, blind1 = args
+    ref = EngineCache(inst, bytes(range(16)))
+    ok = np.ones(n, dtype=bool)
+    ok[::9] = False
+    out0, _s, ver0, part0 = ref.leader_init(nonce, parts, meas, proof, blind0)
+    part0_l = part0 if part0 is not None else np.zeros((n, 2), dtype=np.uint64)
+    out1, _m, _p = ref.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
+    agg0 = [str(x) for x in ref.aggregate(out0, ok)]
+    agg1 = [str(x) for x in ref.aggregate(out1, ok)]
+    deltas = ref.aggregate_pending(out0, (np.arange(n) % 2).astype(np.int32), 2)
+    iv = Interval(Time(0), Duration(3600))
+    ref.resident_merge([(("s", 0), 0, n // 2, iv), (("s", 1), 1, n // 2, iv)], deltas)
+    res = sorted(
+        (str(r["key"]), [str(x) for x in r["share"]]) for r in ref.resident_take()
+    )
+    # the child's record crossed JSON, so its resident tuples are lists
+    rec["bit_identical"] = (
+        rec.get("agg0") == agg0
+        and rec.get("agg1") == agg1
+        and rec.get("resident") == [list(t) for t in res]
+    )
+    rec["ok"] = bool(
+        rec["bit_identical"]
+        and rec.get("rc") == 0
+        and rec.get("dp", 1) * rec.get("sp", 1) > 1
+        and rec.get("queue_submitted", 0) > 0
+        and rec.get("queue_errors", 1) == 0
+        and rec.get("dispatch_lock_removed")
+        and rec.get("rps", 0) > 0
+    )
+    return rec
+
+
 def _cold_start_record(full: bool = False) -> dict:
     """Cold-start A/B (scripts/chaos_run.py --scenario cold_start):
     interleaved cold-cache vs warm-cache boots of the REAL driver
@@ -2968,6 +3109,12 @@ def run_dry(args, ap) -> None:
                 # record with REAL replica binaries rides measured
                 # BENCH runs and chaos_run.py --scenario fleet)
                 "fleet_smoke": _fleet_smoke(),
+                # ISSUE 16: mesh serving smoke — 4 forced virtual
+                # devices drive the serving EngineCache path through
+                # the single-controller dispatch queue; aggregates and
+                # resident shares bit-identical to the single-device
+                # reference computed in this process
+                "mesh_serving_smoke": _mesh_serving_smoke(),
             }
         )
     )

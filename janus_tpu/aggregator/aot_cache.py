@@ -14,19 +14,32 @@ all. Deserialized executables are the same compiled bytes, so results
 are bit-identical by construction (pinned by test).
 
 Keying: blobs are named by a digest over (jax version, backend
-platform + device count, the engine identity — vdaf config + a verify
-key digest, since single-task programs close over the key as a trace
-constant — the jit variant name, and the argument avals
+platform + device count, the HOST target-machine fingerprint — CPU
+feature flags, see below — the engine identity — vdaf config + a
+verify key digest, since single-task programs close over the key as a
+trace constant — the jit variant name, the mesh geometry
+`(dp, sp, device count)` for mesh programs, and the argument avals
 (shape + dtype tree)). Anything the digest misses — a jax upgrade
 changing the wire format, a corrupted blob — surfaces as a
 deserialization error: the blob is deleted and the call falls back to
 the plain jit, so the cache can only ever cost a cold compile, never
 correctness.
 
-Scope: single-device jits only (mesh programs keep the plain jit —
-their sharding metadata makes serialization brittle), and only while
-ARMED (janus_main arms it next to the compile cache; bare
-tests/bench processes see byte-identical behavior to before).
+Cross-machine poison (MULTICHIP_r05, rc 124): XLA:CPU AOT executables
+embed the COMPILE machine's CPU features ("Target machine feature
++prefer-no-gather is not supported on the host machine"), and a blob
+compiled elsewhere could stall the loader rather than raise cleanly.
+Two defenses: the host fingerprint in the digest means a foreign blob
+is never even looked up, and each blob carries the writer's
+fingerprint, checked BEFORE the native deserialize — a mismatch
+deletes the blob and falls back to the jit without ever entering the
+loader.
+
+Scope: single-device AND mesh jits (mesh digests carry their
+(dp, sp, device count) geometry, so a blob only loads on its own
+topology), and only while ARMED (janus_main arms it next to the
+compile cache; bare tests/bench processes see byte-identical behavior
+to before).
 """
 
 from __future__ import annotations
@@ -132,9 +145,46 @@ def _args_sig(args) -> str:
     return "|".join(parts)
 
 
-def engine_base(inst_dict: dict, verify_key: bytes, name: str) -> str:
+_HOST_FP: str | None = None
+
+
+def host_fingerprint() -> str:
+    """Digest of the host's target-machine identity: architecture plus
+    the CPU feature flags XLA:CPU bakes into AOT executables. Part of
+    every blob digest AND stored inside each blob (checked before the
+    native deserialize) — the MULTICHIP_r05 cross-machine poison fix."""
+    global _HOST_FP
+    if _HOST_FP is None:
+        import platform
+
+        parts = [platform.system(), platform.machine()]
+        flags = ""
+        try:
+            with open("/proc/cpuinfo", encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    # x86 "flags", arm64 "Features" — first hit is the
+                    # boot CPU; features are uniform across cores on
+                    # the machines we serve from
+                    if line.lower().startswith(("flags", "features")):
+                        flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                        break
+        except OSError:
+            flags = platform.processor() or ""
+        parts.append(flags)
+        _HOST_FP = hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+    return _HOST_FP
+
+
+def engine_base(
+    inst_dict: dict,
+    verify_key: bytes,
+    name: str,
+    mesh: tuple[int, int, int] | None = None,
+) -> str:
     """Digest base identifying one engine's jit variant across
-    processes (see the module docstring for what it must cover)."""
+    processes (see the module docstring for what it must cover).
+    `mesh` is the (dp, sp, device count) geometry for mesh programs —
+    a blob must only ever load on its own topology."""
     import json
 
     import jax
@@ -144,9 +194,11 @@ def engine_base(inst_dict: dict, verify_key: bytes, name: str) -> str:
             jax.__version__,
             jax.default_backend(),
             str(len(jax.local_devices())),
+            host_fingerprint(),
             json.dumps(inst_dict, sort_keys=True, separators=(",", ":")),
             hashlib.sha256(verify_key).hexdigest()[:16],
             name,
+            "mesh:%dx%d/%d" % mesh if mesh is not None else "single",
         )
     )
 
@@ -221,9 +273,9 @@ class AotJit:
             return comp(*args)
         except Exception:
             # the first execution of a DESERIALIZED executable is the
-            # last place a bad blob can surface (e.g. a cache dir
-            # copied across hosts with different CPU features — the
-            # digest covers jax/backend/devices, not microarch): it
+            # last place a bad blob can surface (the digest + envelope
+            # fingerprint catch cross-machine blobs up front, but a
+            # same-machine blob can still be stale or corrupt): it
             # must cost a recompile, never a failed serving dispatch
             log.warning(
                 "AOT blob %s loaded but faulted on first execution; "
@@ -236,7 +288,21 @@ class AotJit:
 
         try:
             with open(path, "rb") as f:
-                serialized, in_tree, out_tree = pickle.loads(f.read())
+                blob = pickle.loads(f.read())
+            # v2 blob envelope: the writer's host fingerprint rides
+            # along and is checked BEFORE the native deserialize — a
+            # foreign-machine executable must fall back here, not
+            # stall inside the XLA:CPU loader (MULTICHIP_r05). A
+            # legacy 3-tuple blob has no fingerprint: treat it as
+            # foreign (its digest scheme is gone anyway).
+            if not (isinstance(blob, dict) and blob.get("v") == 2):
+                raise ValueError("legacy AOT blob envelope (no fingerprint)")
+            if blob.get("fp") != host_fingerprint():
+                raise ValueError(
+                    f"AOT blob compiled on another machine "
+                    f"(fp {blob.get('fp')!r} != host {host_fingerprint()!r})"
+                )
+            serialized, in_tree, out_tree = blob["payload"]
         except FileNotFoundError:
             return None
         except Exception:
@@ -286,11 +352,12 @@ class AotJit:
             # jax config — the module lock keeps a concurrent wrapper's
             # compile from racing the disable/restore window and
             # serializing a cache-hit (poisoned) executable. Accepted
-            # tradeoff: an UNRELATED compile on another thread (a mesh
-            # program, which never takes this lock) that lands inside
-            # the window skips the persistent cache once and recompiles
-            # on the next restart — rare (concurrent first-compiles
-            # only), self-limited, and never a correctness issue.
+            # tradeoff: an UNRELATED first compile on another thread
+            # that lands inside the window skips the persistent cache
+            # once and recompiles on the next restart — rare
+            # (concurrent first-compiles only; mesh programs all
+            # compile on the single dispatch lane, so they can't race
+            # each other), self-limited, and never a correctness issue.
             with _compile_flag_lock:
                 cache_was_on = bool(jax.config.jax_enable_compilation_cache)
                 if cache_was_on:
@@ -323,7 +390,13 @@ class AotJit:
                     os.unlink(old)
                 except OSError:
                     pass
-            blob = pickle.dumps(serialize_executable.serialize(comp))
+            blob = pickle.dumps(
+                {
+                    "v": 2,
+                    "fp": host_fingerprint(),
+                    "payload": serialize_executable.serialize(comp),
+                }
+            )
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
                 f.write(blob)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import threading
 import time
 from collections import OrderedDict, deque
@@ -518,10 +519,185 @@ def _split_rows(value, offsets):
 # genuinely overlap — exactly the fleet shape ROADMAP item 2 adds.
 XTASK_COALESCE = os.environ.get("JANUS_XTASK_COALESCE", "1") != "0"
 
-# One dispatch lock for EVERY mesh program in the process (see the
-# note at EngineCache._mesh_dispatch_lock): interleaved per-device
-# enqueues deadlock across engines just like within one.
-_MESH_DISPATCH_LOCK = threading.Lock()
+class _MeshDispatch:
+    """One queued mesh enqueue: the wrapped jit, its args, and the
+    rendezvous the submitting thread blocks on."""
+
+    __slots__ = (
+        "fn", "args", "kwargs", "vdaf", "program", "t_submit",
+        "done", "result", "error",
+    )
+
+    def __init__(self, fn, args, kwargs, vdaf, program):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.vdaf = vdaf
+        self.program = program
+        self.t_submit = time.monotonic()
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class MeshDispatchQueue:
+    """Single-controller dispatch lane for EVERY mesh program in the
+    process (replaces the PR 14 process-global _MESH_DISPATCH_LOCK).
+
+    Single-controller multi-device programs deadlock when two threads
+    interleave their per-device enqueues: each device ends up parked on
+    the other program's collective. That happens between ANY two mesh
+    programs sharing the process's devices — two different tasks'
+    engines dispatching concurrently (the cross-task fleet/coalesce
+    shape) deadlocked exactly like two threads on one engine did
+    (observed as a rare tier-1 stall in
+    test_cross_task_coalesced_round_matches_solo_...). The lock fixed
+    correctness but became the throughput ceiling: it woke waiters in
+    arbitrary order (starvation under contention) and hid the
+    cross-engine serialization cost inside each caller's dispatch wall
+    time, invisible to the cost ledger.
+
+    The queue keeps the invariant — exactly ONE thread (the
+    "mesh-dispatch" lane, profiled under the device_lane role) performs
+    every mesh enqueue — and adds what a lock cannot: FIFO fairness,
+    janus_mesh_dispatch_* queue-depth/wait-time metrics, and a
+    cost-ledger row per mesh program. Only the ENQUEUE is serialized;
+    execution stays async on the devices, so concurrent jobs keep
+    coalescing and pipelining safely. Exceptions (OOM recovery depends
+    on them) re-raise in the submitting thread, original object intact
+    — _handle_engine_error's type checks and the _janus_oom_handled
+    dedup marker keep working."""
+
+    def __init__(self):
+        self._q: "queue.SimpleQueue[_MeshDispatch]" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._pid: int | None = None
+        self._depth = 0
+        self._seen: set[tuple[str, str]] = set()
+        self._stats = {
+            "submitted": 0,
+            "completed": 0,
+            "errors": 0,
+            "max_depth": 0,
+            "max_wait_s": 0.0,
+            "busy_s": 0.0,
+        }
+
+    def submit(self, fn, args, kwargs, vdaf: str = "", program: str = ""):
+        """Run fn(*args, **kwargs) on the dispatch lane; block until the
+        enqueue returns; re-raise its exception in the caller."""
+        from .. import metrics
+
+        self._ensure_thread()
+        item = _MeshDispatch(fn, args, kwargs, vdaf, program)
+        with self._lock:
+            self._depth += 1
+            depth = self._depth
+            self._stats["submitted"] += 1
+            if depth > self._stats["max_depth"]:
+                self._stats["max_depth"] = depth
+        metrics.mesh_dispatch_queue_depth.set(float(depth))
+        self._q.put(item)
+        item.done.wait()
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _ensure_thread(self) -> None:
+        pid = os.getpid()
+        t = self._thread
+        if t is not None and t.is_alive() and self._pid == pid:
+            return
+        with self._lock:
+            t = self._thread
+            if t is not None and t.is_alive() and self._pid == pid:
+                return
+            if self._pid is not None and self._pid != pid:
+                # forked child: the parent's lane thread didn't survive
+                # the fork and its queue may hold the parent's items —
+                # start clean (submitters in the child re-enqueue)
+                self._q = queue.SimpleQueue()
+                self._depth = 0
+            self._pid = pid
+            q = self._q
+            t = threading.Thread(
+                target=self._run, args=(q,), name="mesh-dispatch", daemon=True
+            )
+            self._thread = t
+            t.start()
+
+    def _run(self, q) -> None:
+        from .. import metrics
+        from ..profiler import DEVICE_COST
+
+        while True:
+            item = q.get()
+            wait = time.monotonic() - item.t_submit
+            with self._lock:
+                self._depth -= 1
+                depth = self._depth
+                if wait > self._stats["max_wait_s"]:
+                    self._stats["max_wait_s"] = wait
+                first = (item.vdaf, item.program) not in self._seen
+                if first:
+                    self._seen.add((item.vdaf, item.program))
+            metrics.mesh_dispatch_queue_depth.set(float(depth))
+            metrics.mesh_dispatch_wait_seconds.observe(wait)
+            t0 = time.monotonic()
+            try:
+                item.result = item.fn(*item.args, **item.kwargs)
+            except BaseException as e:  # noqa: BLE001 - belongs to the caller
+                item.error = e
+            finally:
+                dt = time.monotonic() - t0
+                with self._lock:
+                    self._stats["busy_s"] += dt
+                    self._stats["completed"] += 1
+                    if item.error is not None:
+                        self._stats["errors"] += 1
+                metrics.mesh_dispatch_busy_seconds.add(dt)
+                metrics.mesh_dispatch_total.add(program=item.program or "unknown")
+                if item.vdaf:
+                    # per-mesh-program ledger row: the lane's enqueue
+                    # wall (first call of a program = trace+compile or
+                    # AOT deserialize; distinct from the engine's own
+                    # per-specialization rows, which include queue wait)
+                    DEVICE_COST.record(
+                        item.vdaf,
+                        f"mesh:{item.program}",
+                        0,
+                        "compile" if first else "execute",
+                        dt,
+                        dispatches=1,
+                    )
+                item.done.set()
+
+    def status(self) -> dict:
+        with self._lock:
+            t = self._thread
+            return {
+                "depth": self._depth,
+                "lane_alive": bool(t is not None and t.is_alive()),
+                "programs": len(self._seen),
+                **dict(self._stats),
+            }
+
+    def reset_for_tests(self) -> None:
+        """Zero counters between test modules; the lane thread (if any)
+        keeps running — it is stateless outside these counters."""
+        with self._lock:
+            self._seen.clear()
+            self._stats.update(
+                submitted=0, completed=0, errors=0,
+                max_depth=0, max_wait_s=0.0, busy_s=0.0,
+            )
+
+
+# the process-wide lane: one queue for every engine's mesh programs,
+# mirroring the lock it replaced (the interleaved-enqueue deadlock is a
+# process-level hazard, not a per-engine one)
+_MESH_QUEUE = MeshDispatchQueue()
 
 _xtask_lock = threading.Lock()
 _xtask_coalescers: dict[tuple, "_Coalescer"] = {}
@@ -674,38 +850,57 @@ class EngineCache:
     # tensors, not report count, dominate
     SP_MIN_INPUT_LEN = STREAM_MIN_INPUT_LEN
 
+    # mesh geometry overrides (the `engine: mesh: {dp, sp}` config
+    # stanza; None = auto-select from device count + circuit shape).
+    # Class attributes so janus_main applies the YAML once; the
+    # JANUS_MESH_DP / JANUS_MESH_SP env vars win over both (operator
+    # override, read per-engine so subprocess benches can force shapes).
+    MESH_DP: int | None = None
+    MESH_SP: int | None = None
+
+    @classmethod
+    def _configured_geometry(cls) -> tuple[int | None, int | None]:
+        def pick(env: str, fallback: int | None) -> int | None:
+            v = os.environ.get(env)
+            if v is None or not v.strip():
+                return fallback
+            try:
+                return int(v)
+            except ValueError:
+                log.warning("ignoring non-integer %s=%r", env, v)
+                return fallback
+
+        return pick("JANUS_MESH_DP", cls.MESH_DP), pick("JANUS_MESH_SP", cls.MESH_SP)
+
     def __init__(self, inst: VdafInstance, verify_key: bytes):
         self.inst = inst
         self.verify_key = verify_key
         self.p3 = prio3_batched(inst)
         self._jits: dict[str, object] = {}
         ndev = len(jax.devices())
-        if ndev > 1:
-            from ..parallel.api import make_mesh
+        self._ndev = ndev
+        # geometry: auto-selected from device count and circuit shape
+        # (dp = report batch axis, sp = measurement/out-share column
+        # axis for long-vector tasks — SURVEY §2.10 P4 / §5
+        # long-context analog), or pinned by the `engine: mesh:` config
+        # stanza / JANUS_MESH_DP/SP overrides. One device (or an
+        # override pinning 1x1) = the single-device path, no mesh.
+        from ..parallel.api import choose_mesh_geometry, make_mesh
 
-            dp = 1 << (ndev.bit_length() - 1)  # largest power of two <= ndev
-            sp = 1
-            circ = self.p3.circ
-            in_len = getattr(circ, "input_len", 0)
-            out_len = getattr(circ, "output_len", 0)
-            if (
-                dp >= 2
-                and in_len >= self.SP_MIN_INPUT_LEN
-                and in_len % 2 == 0
-                and out_len % 2 == 0
-            ):
-                # long-vector tasks: shard the measurement/out-share
-                # columns too (SURVEY §2.10 P4 / §5 long-context analog)
-                sp = 2
-                dp //= 2
-            dp = min(dp, MIN_BUCKET)  # every bucket must divide by dp
-            self.mesh = make_mesh(dp, sp)
-            self.dp = dp
-            self.sp = sp
-        else:
-            self.mesh = None
-            self.dp = 1
-            self.sp = 1
+        cfg_dp, cfg_sp = self._configured_geometry()
+        circ = self.p3.circ
+        dp, sp = choose_mesh_geometry(
+            ndev,
+            getattr(circ, "input_len", 0),
+            getattr(circ, "output_len", 0),
+            self.SP_MIN_INPUT_LEN,
+            MIN_BUCKET,  # every bucket must divide by dp
+            dp=cfg_dp,
+            sp=cfg_sp,
+        )
+        self.mesh = make_mesh(dp, sp) if dp * sp > 1 else None
+        self.dp = dp
+        self.sp = sp
         # HBM feasibility bound (ISSUE r6): the largest power-of-two
         # bucket the device budget supports for this circuit, from the
         # bytes model in vdaf.feasibility (staged share + proofs +
@@ -743,16 +938,10 @@ class EngineCache:
         self._host_fallback: "HostEngineCache | None" = None
         self._host_fallback_until: float | None = None
         self._initial_bucket_cap = self.bucket_cap
-        # serializes multi-device program dispatch (see _jit).
-        # PROCESS-GLOBAL, not per-engine: the single-controller
-        # interleaved-enqueue deadlock the lock prevents happens
-        # between ANY two mesh programs sharing the process's devices —
-        # two different tasks' engines dispatching concurrently (the
-        # cross-task fleet/coalesce shape) deadlocked exactly like two
-        # threads on one engine did, each device parked on the other
-        # program's collective (observed as a rare tier-1 stall in
-        # test_cross_task_coalesced_round_matches_solo_...).
-        self._mesh_dispatch_lock = _MESH_DISPATCH_LOCK
+        # multi-device program dispatch rides the process-wide
+        # single-controller lane (_MESH_QUEUE — see MeshDispatchQueue
+        # for the interleaved-enqueue deadlock it prevents and the
+        # queue-depth/wait metrics it adds over the lock it replaced)
         # cross-job dispatch coalescing (VERDICT r4 item 3): calls at or
         # below COALESCE_MAX_JOB rows ride shared device dispatches;
         # bigger jobs fill a dispatch on their own and go direct. The
@@ -874,6 +1063,12 @@ class EngineCache:
         if b > 0:
             metrics.engine_batch_fill_ratio.set(n / b, op=op)
         lkey = compile_key if compile_key is not None else (ledger_op or op, b)
+        if self.mesh is not None:
+            # mesh specializations are keyed by geometry too: the shape
+            # manifest must never hand a (dp, sp) program to a boot with
+            # a different device topology (prewarm checks this suffix),
+            # and the AOT digest carries the same triple
+            lkey = tuple(lkey) + ("mesh", self.dp, self.sp, self._ndev)
         with self._dispatch_track_lock:
             first = (op, b) not in self._dispatched_buckets
             if first:
@@ -938,37 +1133,46 @@ class EngineCache:
 
         return tuple(one(nd) for nd in batch_ndims)
 
-    def _jit(self, name: str, fn, in_shardings=None):
+    def _jit(self, name: str, fn, in_shardings=None, out_shardings=None):
         if name not in self._jits:
-            if self.mesh is not None and in_shardings is not None:
-                jitted = jax.jit(fn, in_shardings=in_shardings)
-            else:
-                jitted = jax.jit(fn)
+            kwargs = {}
             if self.mesh is not None:
-                # Single-controller multi-device programs deadlock when
-                # two threads interleave their per-device enqueues (each
-                # device then waits on the other program's collective).
-                # Serialize the DISPATCH only — execution stays async —
-                # so concurrent jobs keep coalescing/pipelining safely.
-                lock = self._mesh_dispatch_lock
+                if in_shardings is not None:
+                    kwargs["in_shardings"] = in_shardings
+                if out_shardings is not None:
+                    kwargs["out_shardings"] = out_shardings
+            jitted = jax.jit(fn, **kwargs)
+            # every program — single-device AND mesh — rides the
+            # serialized-executable AOT cache (aot_cache.py): a
+            # restarted process, or a canary rebuild that just dropped
+            # _jits, deserializes the compiled executable instead of
+            # re-tracing. Mesh digests carry (dp, sp, device count) so
+            # a blob only ever loads on its own topology; a passthrough
+            # while the cache is disarmed.
+            wrapped = aot_cache.wrap(
+                jitted,
+                aot_cache.engine_base(
+                    self.inst.to_dict(),
+                    self.verify_key,
+                    name,
+                    mesh=(self.dp, self.sp, self._ndev)
+                    if self.mesh is not None
+                    else None,
+                ),
+            )
+            if self.mesh is not None:
+                # multi-device enqueues are owned by the process-wide
+                # single-controller lane (MeshDispatchQueue): submit
+                # blocks this thread until the lane ran the enqueue,
+                # execution stays async on the devices
+                vdaf = self.inst.kind
 
-                def locked(*a, _jitted=jitted, **k):
-                    with lock:
-                        return _jitted(*a, **k)
+                def queued(*a, _fn=wrapped, _name=name, _vdaf=vdaf, **k):
+                    return _MESH_QUEUE.submit(_fn, a, k, vdaf=_vdaf, program=_name)
 
-                self._jits[name] = locked
+                self._jits[name] = queued
             else:
-                # single-device programs ride the serialized-executable
-                # AOT cache (aot_cache.py): a restarted process — or a
-                # canary rebuild that just dropped _jits — deserializes
-                # the compiled executable instead of re-tracing. A
-                # passthrough while the cache is disarmed.
-                self._jits[name] = aot_cache.wrap(
-                    jitted,
-                    aot_cache.engine_base(
-                        self.inst.to_dict(), self.verify_key, name
-                    ),
-                )
+                self._jits[name] = wrapped
         return self._jits[name]
 
     # --- OOM recovery (shared by every public step) ---
@@ -1973,6 +2177,25 @@ class EngineCache:
         immediately (the quarantine-mid-job contract)."""
         return self._host() is None
 
+    def _delta_shardings(self, ndim: int = 2):
+        """out_shardings for pending-delta values ([kk, output_len], or
+        [output_len] rows when ndim=1): the out-share COLUMNS shard over
+        'sp' when the engine has a vector axis, so the resident
+        accumulator lives sharded per device — scatter merges stay
+        sharded and the gather happens only at the flush/take fetch
+        (the parallel/api.py design note, now on the serving path).
+        Engines without a vector axis keep the delta replicated; None on
+        the single-device path."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out_len = getattr(self.p3.circ, "output_len", 0)
+        col = "sp" if (self.sp > 1 and out_len % self.sp == 0) else None
+        spec = P(*((None,) * (ndim - 1) + (col,)))
+        sh = NamedSharding(self.mesh, spec)
+        return tuple(sh for _ in range(self.p3.jf.LIMBS))
+
     def aggregate_pending(self, out_shares, bucket_idx, k: int) -> PendingDeltas:
         """Per-bucket masked sums of one job's out shares as a DEVICE
         [k, output_len] value — ONE dispatch, one [n] int32 upload,
@@ -2041,7 +2264,11 @@ class EngineCache:
                     )
                     return p3.aggregate_buckets(v, idx, _kk)
 
-                fn = self._jit(f"agg_buckets_view_{kk}_{vb}", step_view)
+                fn = self._jit(
+                    f"agg_buckets_view_{kk}_{vb}",
+                    step_view,
+                    out_shardings=self._delta_shardings(),
+                )
                 count_h2d(int(idx.nbytes))
                 return fn(value, np.int32(s), idx)
             idx = np.full(b, -1, np.int32)
@@ -2050,7 +2277,9 @@ class EngineCache:
             def step_full(value, idx, _kk=kk):
                 return p3.aggregate_buckets(value, idx, _kk)
 
-            fn = self._jit(f"agg_buckets_{kk}", step_full)
+            fn = self._jit(
+                f"agg_buckets_{kk}", step_full, out_shardings=self._delta_shardings()
+            )
             count_h2d(int(idx.nbytes))
             return fn(value, idx)
         # host limb rows (a round that degraded to host currency):
@@ -2065,16 +2294,23 @@ class EngineCache:
         def step_host(value, idx, _kk=kk):
             return p3.aggregate_buckets(value, idx, _kk)
 
-        fn = self._jit(f"agg_buckets_{kk}", step_host)
+        fn = self._jit(
+            f"agg_buckets_{kk}", step_host, out_shardings=self._delta_shardings()
+        )
         return fn(padded, idx)
 
     def _resident_add(self, acc, row):
         """acc + row on device. Single-device: the accumulator buffer
         is DONATED so the merge is in place (no HBM growth per merge);
-        CPU ignores donation, mesh dispatches go through the serialized
-        _jit wrapper instead."""
+        CPU ignores donation, mesh dispatches ride the single-controller
+        lane via _jit and keep the slot's column sharding — the merged
+        accumulator never gathers until flush."""
         if self.mesh is not None:
-            fn = self._jit("resident_add", lambda a, r: self.p3.jf.add(a, r))
+            fn = self._jit(
+                "resident_add",
+                lambda a, r: self.p3.jf.add(a, r),
+                out_shardings=self._delta_shardings(ndim=1),
+            )
             return fn(acc, row)
         name = "resident_add"
         if name not in self._jits:
@@ -2477,9 +2713,11 @@ def _engine_cache_clear() -> None:
     global _resident_bytes_total
     with _engine_cache_lock:
         _engine_cache.clear()
-    # shared cross-task coalescers and the resident byte ledger follow
-    # the cache lifetime (tests clear between modules for isolation)
+    # shared cross-task coalescers, the mesh dispatch lane's counters
+    # and the resident byte ledger follow the cache lifetime (tests
+    # clear between modules for isolation)
     _clear_shared_coalescers()
+    _MESH_QUEUE.reset_for_tests()
     with _resident_bytes_lock:
         _resident_bytes_total = 0
         kinds = list(_resident_buffer_counts)
@@ -2576,7 +2814,41 @@ def resident_accumulators_status() -> dict:
     }
 
 
+def mesh_status() -> dict:
+    """/statusz `mesh` section: device topology, per-engine (dp, sp)
+    geometry, and the single-controller dispatch lane's live counters
+    (scripts/scrape_check.py pins the shape)."""
+    devices = None
+    try:
+        from jax._src import xla_bridge
+
+        # report the topology only if some engine already initialized
+        # the backend — a bare statusz probe must not pay (or trigger)
+        # device discovery
+        if getattr(xla_bridge, "_backends", None):
+            devices = len(jax.devices())
+    except Exception:
+        devices = None
+    with _engine_cache_lock:
+        engines = [e for e in _engine_cache.values() if isinstance(e, EngineCache)]
+    return {
+        "devices": devices,
+        "queue": _MESH_QUEUE.status(),
+        "engines": [
+            {
+                "vdaf": e.inst.kind,
+                "dp": e.dp,
+                "sp": e.sp,
+                "mesh": e.mesh is not None,
+                "sharded_resident": e.sp > 1,
+            }
+            for e in engines
+        ],
+    }
+
+
 from ..statusz import register_status_provider as _register_status_provider
 
 _register_status_provider("engine_cache", engine_cache_status)
 _register_status_provider("resident_accumulators", resident_accumulators_status)
+_register_status_provider("mesh", mesh_status)

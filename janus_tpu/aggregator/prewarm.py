@@ -50,6 +50,7 @@ _STATE: dict = {
     "unsupported": 0,
     "no_task": 0,
     "deferred": 0,
+    "geometry_mismatch": 0,
     "boot_budget_s": None,
     "priority_elapsed_s": None,
 }
@@ -89,7 +90,14 @@ def _bump(outcome: str, n: int = 1) -> None:
 
     metrics.engine_prewarm_total.add(n, outcome=outcome)
     with _state_lock:
-        if outcome in ("warmed", "failed", "unsupported", "no_task", "deferred"):
+        if outcome in (
+            "warmed",
+            "failed",
+            "unsupported",
+            "no_task",
+            "deferred",
+            "geometry_mismatch",
+        ):
             key = outcome
             _STATE[key] = _STATE.get(key, 0) + n
 
@@ -212,6 +220,19 @@ class _Warmer:
         if isinstance(eng, HostEngineCache) or eng._host() is not None:
             return "unsupported"  # nothing to compile on the host path
         key = [str(k) if not isinstance(k, (int, float)) else k for k in entry.get("key") or ()]
+        # a specialization recorded under a different mesh topology is
+        # a DIFFERENT program: replaying it here would trace something
+        # serving never dispatches and burn the boot budget on it
+        # (e.g. a single-device boot reading a (dp=4, sp=1) manifest,
+        # or a pod reading a laptop's) — skip, distinctly counted
+        from .shape_manifest import entry_geometry
+
+        recorded = entry_geometry(key)
+        current = (
+            (eng.dp, eng.sp, eng._ndev) if eng.mesh is not None else None
+        )
+        if recorded != current:
+            return "geometry_mismatch"
         variant = str(key[0]) if key else str(entry.get("op", ""))
         bucket = int(entry.get("bucket", 0))
         if bucket < max(MIN_BUCKET, eng.dp) or (

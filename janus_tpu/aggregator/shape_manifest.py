@@ -75,6 +75,23 @@ def entry_key(entry: dict) -> tuple:
     )
 
 
+def entry_geometry(key) -> tuple[int, int, int] | None:
+    """The mesh geometry a specialization was recorded under:
+    engine_cache._record_dispatch suffixes mesh compile keys with
+    ("mesh", dp, sp, device count). Returns (dp, sp, ndev), or None
+    for a single-device entry. Prewarm and the legacy warmup use this
+    to skip entries whose topology doesn't match the booting process —
+    a single-device boot replaying a (4, 2, 8) program (or vice versa)
+    would spend its boot budget tracing programs serving never runs."""
+    k = tuple(key or ())
+    if len(k) >= 4 and str(k[-4]) == "mesh":
+        try:
+            return int(k[-3]), int(k[-2]), int(k[-1])
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
 class ShapeManifest:
     """See the module docstring. Thread-safe: `record` may be called
     from any dispatch thread while `entries`/`status` snapshot for the
@@ -275,14 +292,24 @@ class ShapeManifest:
         out.sort(key=lambda e: (-float(e.get("cost_s", 0.0)), str(e.get("op", ""))))
         return out
 
-    def covers(self, vdaf: dict, op: str, bucket: int) -> bool:
+    def covers(
+        self,
+        vdaf: dict,
+        op: str,
+        bucket: int,
+        geometry: tuple[int, int, int] | None = None,
+    ) -> bool:
         """True when a recorded specialization matches (vdaf, op,
         bucket) with the PLAIN jit variant — the legacy warmup uses
         this to skip geometries the manifest-driven prewarm already
         warms. The variant check matters: a manifest holding only
         `leader_init_vk` (cross-task-coalesced) entries must not
         suppress warming the plain `leader_init` program, which is a
-        distinct compile the prewarm never touched."""
+        distinct compile the prewarm never touched. `geometry` is the
+        caller's (dp, sp, ndev) mesh triple (None = single-device): an
+        entry recorded under a DIFFERENT topology must not claim
+        coverage — the prewarm will skip it, so warmup still owes the
+        compile."""
         vkey = _canonical(dict(vdaf))
         with self._lock:
             return any(
@@ -291,6 +318,7 @@ class ShapeManifest:
                 and k[2] == int(bucket)
                 and k[3]
                 and str(k[3][0]) == str(op)
+                and entry_geometry(k[3]) == geometry
                 for k in self._entries
             )
 

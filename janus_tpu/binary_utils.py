@@ -619,16 +619,24 @@ def warmup_engines(ds, batch: int | None = None, manifest=None) -> dict:
         for warm_batch in sizes:
             b = bucket_size(warm_batch)
             inst_dict = task.vdaf.to_dict()
-            if manifest is not None and all(
-                manifest.covers(inst_dict, op, b) for op in warm_ops
-            ):
-                result["skipped_covered"] += 1
-                metrics.engine_prewarm_total.add(outcome="skipped_covered")
-                continue
             try:
                 eng = engine_cache(task.vdaf, task.vdaf_verify_key)
                 if isinstance(eng, HostEngineCache):
                     continue  # host engines need no compile
+                # coverage is per mesh topology: a manifest recorded
+                # under a different (dp, sp, ndev) — another machine
+                # class, or a single-device run — names programs this
+                # process never dispatches, so it doesn't cover these
+                geometry = (
+                    (eng.dp, eng.sp, eng._ndev) if eng.mesh is not None else None
+                )
+                if manifest is not None and all(
+                    manifest.covers(inst_dict, op, b, geometry=geometry)
+                    for op in warm_ops
+                ):
+                    result["skipped_covered"] += 1
+                    metrics.engine_prewarm_total.add(outcome="skipped_covered")
+                    continue
                 rng = np.random.default_rng(0)
                 args, _ = make_report_batch(
                     task.vdaf, random_measurements(task.vdaf, warm_batch, rng), seed=0
@@ -801,6 +809,14 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         from .aggregator import engine_cache as engine_cache_mod
 
         engine_cache_mod.XTASK_COALESCE = bool(common.engine.cross_task_coalesce)
+    # mesh serving geometry (`engine: mesh: {dp, sp}`): pins the
+    # (dp, sp) axes engines build instead of auto-selecting from the
+    # device count; validated per-engine (single-device processes fall
+    # back to the unsharded path regardless). JANUS_MESH_DP/SP envs win.
+    if common.engine.mesh_dp is not None and "JANUS_MESH_DP" not in os.environ:
+        EngineCache.MESH_DP = int(common.engine.mesh_dp)
+    if common.engine.mesh_sp is not None and "JANUS_MESH_SP" not in os.environ:
+        EngineCache.MESH_SP = int(common.engine.mesh_sp)
     BOOT.phase_done("backend_init")
 
     keys = parse_datastore_keys(args.datastore_keys)

@@ -136,12 +136,24 @@ class EngineConfig:
     # bounded by the boot budget; the remainder warms in background)
     prewarm: bool = True
     prewarm_boot_budget_secs: float = 30.0
+    # --- mesh serving geometry (docs/ARCHITECTURE.md "Multi-chip
+    # serving") ---
+    # `mesh: {dp, sp}` pins the serving mesh axes (dp = report batch,
+    # sp = measurement/out-share columns) instead of auto-selecting
+    # from the device count. Validated per engine — a single-device
+    # process, or a request for more devices than exist, falls back to
+    # the unsharded path. JANUS_MESH_DP / JANUS_MESH_SP envs override.
+    mesh_dp: int | None = None
+    mesh_sp: int | None = None
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "EngineConfig":
         d = d or {}
         rmb = d.get("resident_max_bytes")
         xt = d.get("cross_task_coalesce")
+        mesh = d.get("mesh") or {}
+        mdp = mesh.get("dp")
+        msp = mesh.get("sp")
         return cls(
             compile_cache_dir=d.get("compile_cache_dir"),
             resident_max_bytes=int(rmb) if rmb is not None else None,
@@ -151,6 +163,8 @@ class EngineConfig:
             aot_cache=bool(d.get("aot_cache", True)),
             prewarm=bool(d.get("prewarm", True)),
             prewarm_boot_budget_secs=float(d.get("prewarm_boot_budget_secs", 30.0)),
+            mesh_dp=int(mdp) if mdp is not None else None,
+            mesh_sp=int(msp) if msp is not None else None,
         )
 
 

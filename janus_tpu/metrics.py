@@ -701,6 +701,33 @@ prep_resp_order_mismatch_total = REGISTRY.counter(
     "id->index dict match)",
 )
 
+# --- single-controller mesh dispatch queue (aggregator/engine_cache.py
+# MeshDispatchQueue; docs/ARCHITECTURE.md "Multi-chip serving") ---
+mesh_dispatch_total = REGISTRY.counter(
+    "janus_mesh_dispatch_total",
+    "mesh programs dispatched through the single-controller queue, by "
+    "program (the jit variant name) — every multi-device enqueue in the "
+    "process rides this lane",
+)
+mesh_dispatch_queue_depth = REGISTRY.gauge(
+    "janus_mesh_dispatch_queue_depth",
+    "mesh dispatches submitted to the single-controller lane and not yet "
+    "executing (sustained >0 = the dispatch lane, not the devices, is "
+    "the ceiling — compare with wait_seconds)",
+)
+mesh_dispatch_wait_seconds = REGISTRY.histogram(
+    "janus_mesh_dispatch_wait_seconds",
+    "time a mesh dispatch spent queued behind other programs before the "
+    "lane thread picked it up (the cross-engine serialization cost the "
+    "old process-global lock hid inside dispatch wall time)",
+)
+mesh_dispatch_busy_seconds = REGISTRY.counter(
+    "janus_mesh_dispatch_busy_seconds_total",
+    "cumulative seconds the mesh dispatch lane spent enqueueing programs "
+    "(execution stays async on the devices; rate() vs wall clock gives "
+    "the lane's own saturation)",
+)
+
 # --- device-resident aggregate state + host<->device traffic (ISSUE 12;
 # docs/ARCHITECTURE.md "Resident aggregate state") ---
 engine_resident_buffers = REGISTRY.gauge(

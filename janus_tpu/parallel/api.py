@@ -41,6 +41,62 @@ def make_mesh(dp: int, sp: int = 1, devices=None) -> Mesh:
     return Mesh(arr, axis_names=("dp", "sp"))
 
 
+def choose_mesh_geometry(
+    ndev: int,
+    input_len: int,
+    output_len: int,
+    sp_min_input_len: int,
+    max_dp: int,
+    dp: int | None = None,
+    sp: int | None = None,
+) -> tuple[int, int]:
+    """Pick the (dp, sp) serving geometry for a circuit on `ndev` devices.
+
+    Auto (dp/sp None): dp = largest power of two <= ndev, capped at
+    `max_dp` (every batch bucket must divide by dp); long-vector tasks
+    (input_len >= sp_min_input_len, even input/output lengths) trade one
+    dp factor for sp=2 so the measurement/out-share columns shard too.
+
+    Explicit dp/sp (the `engine: mesh:` config stanza / JANUS_MESH_DP/SP
+    overrides) are validated, not trusted: non-power-of-two dp rounds
+    down (bucket divisibility), dp*sp is clamped to the devices that
+    exist, and sp>1 on a circuit whose input/output lengths can't split
+    evenly falls back to sp=1. One device — or an override forcing
+    dp=sp=1 — means the single-device path: callers get (1, 1) and build
+    no mesh.
+    """
+    if ndev <= 1:
+        return 1, 1
+    auto_dp = 1 << (ndev.bit_length() - 1)  # largest power of two <= ndev
+    if sp is not None:
+        sp = max(1, int(sp))
+    if dp is not None:
+        dp = max(1, int(dp))
+        dp = 1 << (dp.bit_length() - 1)  # buckets must divide by dp
+    vec_ok = (
+        input_len >= sp_min_input_len and input_len % 2 == 0 and output_len % 2 == 0
+    )
+    if dp is None and sp is None:
+        dp, sp = auto_dp, 1
+        if dp >= 2 and vec_ok:
+            sp = 2
+            dp //= 2
+    else:
+        if sp is None:
+            sp = 1
+        if sp > 1 and not (input_len % sp == 0 and output_len % sp == 0):
+            sp = 1
+        if dp is None:
+            dp = max(1, auto_dp // sp)
+            dp = 1 << (dp.bit_length() - 1)
+    while dp > 1 and dp * sp > ndev:
+        dp //= 2
+    if dp * sp > ndev:
+        return 1, 1  # override asks for more devices than exist
+    dp = min(dp, max_dp)
+    return max(1, dp), max(1, sp)
+
+
 def two_party_step(inst: VdafInstance, verify_key: bytes):
     """The full two-party device step over one report batch.
 

@@ -128,6 +128,7 @@ ROLE_PREFIXES: tuple[tuple[str, str], ...] = (
     ("device-lane-gauge", "flusher"),   # low-cadence gauge refresher
     ("device-lane", "device_lane"),     # the pipeline's serialized lane
     ("device-watchdog", "device_lane"), # supervised dispatches run here
+    ("mesh-dispatch", "device_lane"),   # single-controller mesh enqueue lane
     ("step-read", "prefetch"),          # pipeline read/staging stage
     ("step-commit", "commit"),          # pipeline commit stage
     ("step-http", "http_client"),       # pipeline helper-HTTP stage

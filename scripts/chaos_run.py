@@ -2474,6 +2474,7 @@ def run_fleet(
         claim_txs = 0.0
         steals = 0.0
         replica_info_ok = True
+        mesh_statusz_ok = True
         for i, port, _drv in survivors:
             mtext = _scrape(port, "/metrics")
             info = _metric_samples(mtext, "janus_replica_info")
@@ -2499,7 +2500,15 @@ def run_fleet(
             statusz = json.loads(_scrape(port, "/statusz"))
             if statusz.get("fleet", {}).get("replica_id") != f"replica-{i}":
                 replica_info_ok = False
+            # every replica — including the restart that replaced the
+            # killed one — must publish the mesh dispatch section (the
+            # single-controller lane is per-process state; a restart
+            # that lost it would dispatch mesh programs unserialized)
+            mesh = statusz.get("mesh")
+            if not (isinstance(mesh, dict) and isinstance(mesh.get("queue"), dict)):
+                mesh_statusz_ok = False
         result["replica_info_ok"] = replica_info_ok
+        result["mesh_statusz_ok"] = mesh_statusz_ok
         result["lease_conflicts_total"] = conflicts
         result["zero_lease_conflicts_ok"] = conflicts == 0.0
         result["fleet_acquired_jobs"] = acquired_jobs

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Multi-chip serving benchmark CLI.
+
+Runs the hardened serving benchmark (__graft_entry__.serving_multichip):
+rps through the EngineCache serving path at 1 vs N devices, with
+bit-identity, mesh-active, and dispatch-lock-removed gates. Each phase
+runs in its own subprocess with a timeout; a failed phase still yields
+an ``"ok": false`` partial record, so the output is always one
+parseable JSON line (schema ``janus_multichip_serving/v1``).
+
+Usage:
+    python scripts/multichip_bench.py --devices 4 --out MULTICHIP_r06.json
+
+Exit code 0 iff the record's top-level ``ok`` is true.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=4, help="mesh device count")
+    ap.add_argument("--batch", type=int, default=256, help="reports per round")
+    ap.add_argument("--iters", type=int, default=8, help="timed rounds per phase")
+    ap.add_argument(
+        "--phase-timeout",
+        type=float,
+        default=900.0,
+        help="per-phase subprocess timeout (seconds)",
+    )
+    ap.add_argument("--out", default=None, help="also write the record here")
+    args = ap.parse_args()
+
+    import __graft_entry__ as g
+
+    record = g.serving_multichip(
+        n_devices=args.devices,
+        out_path=args.out,
+        batch=args.batch,
+        iters=args.iters,
+        phase_timeout_s=args.phase_timeout,
+    )
+    return 0 if record.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,12 @@ def main(argv=None) -> int:
         "janus_lease_acquired_jobs_total",
         "janus_lease_steals_total",
         "janus_lease_conflicts_total",
+        # single-controller mesh dispatch queue (ISSUE 16) — registered
+        # at import in every binary, so absence is a deploy regression
+        "janus_mesh_dispatch_total",
+        "janus_mesh_dispatch_queue_depth",
+        "janus_mesh_dispatch_wait_seconds",
+        "janus_mesh_dispatch_busy_seconds_total",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -272,6 +278,24 @@ def main(argv=None) -> int:
                     for key in ("replica_id", "shard_index", "shard_count"):
                         if key not in fl:
                             errors.append(f"/statusz fleet missing {key!r}")
+                # multi-chip serving (ISSUE 16): mesh geometry + the
+                # single-controller dispatch-queue accounting — present
+                # (devices may be null pre-backend-init) on every binary
+                mesh = snap.get("mesh")
+                if not isinstance(mesh, dict):
+                    errors.append("/statusz missing the mesh section")
+                else:
+                    for key in ("devices", "queue", "engines"):
+                        if key not in mesh:
+                            errors.append(f"/statusz mesh missing {key!r}")
+                    for key in ("depth", "lane_alive", "submitted", "completed", "errors"):
+                        if key not in (mesh.get("queue") or {}):
+                            errors.append(f"/statusz mesh queue missing {key!r}")
+                    for ent in mesh.get("engines", []) or []:
+                        for key in ("vdaf", "dp", "sp", "mesh"):
+                            if key not in ent:
+                                errors.append(f"/statusz mesh engine entry missing {key!r}")
+                                break
                 dc = snap.get("device_cost")
                 if not isinstance(dc, dict):
                     errors.append("/statusz missing the device_cost section")

@@ -244,9 +244,13 @@ def test_engine_first_dispatch_feeds_installed_manifest(tmp_path):
     _dispatch_once(eng)
     ops = {(e["op"], e["bucket"]) for e in man.entries()}
     assert {("leader_init", 32), ("helper_init", 32), ("aggregate", 32)} <= ops
-    # the resident kk-geometry records under its own compile key
+    # the resident kk-geometry records under its own compile key; a
+    # mesh engine (the conftest provisions 8 virtual devices) suffixes
+    # the key with its ("mesh", dp, sp, ndev) topology (ISSUE 16)
     pend = [e for e in man.entries() if e["op"] == "aggregate_pending"]
-    assert pend and pend[0]["key"] == ["aggregate_pending", 4, 32]
+    assert pend and pend[0]["key"][:3] == ["aggregate_pending", 4, 32]
+    geom = (eng.dp, eng.sp, eng._ndev) if eng.mesh is not None else None
+    assert shape_manifest.entry_geometry(pend[0]["key"]) == geom
     # re-dispatching the same specializations appends nothing new
     n_entries = len(man.entries())
     _dispatch_once(eng)
@@ -293,7 +297,12 @@ def test_prewarm_bit_identical_and_outcomes(tmp_path):
     )
 
 
-def test_prewarm_engines_ready_event_and_budget_deferral(tmp_path):
+def test_prewarm_engines_ready_event_and_budget_deferral(tmp_path, monkeypatch):
+    # plain (op, bucket) manifest keys are single-device entries; pin
+    # the engines to 1x1 so the geometry gate matches them (mesh
+    # coverage lives in tests/test_mesh_dispatch.py)
+    monkeypatch.setenv("JANUS_MESH_DP", "1")
+    monkeypatch.setenv("JANUS_MESH_SP", "1")
     from janus_tpu.core.hpke import generate_hpke_config_and_private_key
     from janus_tpu.datastore.store import EphemeralDatastore
     from janus_tpu.messages import Role
@@ -376,9 +385,14 @@ def test_manifest_less_prewarm_degrades_to_noop(tmp_path):
         eph.cleanup()
 
 
-def test_unsupported_variant_counted_not_fatal(tmp_path):
+def test_unsupported_variant_counted_not_fatal(tmp_path, monkeypatch):
     from janus_tpu.aggregator.engine_cache import EngineCache
 
+    # single-device engine: the geometry gate runs before op support,
+    # so a mesh engine would report geometry_mismatch for these plain
+    # keys instead of exercising the unsupported path
+    monkeypatch.setenv("JANUS_MESH_DP", "1")
+    monkeypatch.setenv("JANUS_MESH_SP", "1")
     man = ShapeManifest(str(tmp_path / "m.jsonl"))
     man.record({"kind": "count"}, "mystery_op", 32, ("mystery_op_vq", 32), 1.0)
     man.record({"kind": "count"}, "leader_init", 8, ("leader_init", 8), 1.0)
@@ -445,8 +459,13 @@ def test_pending_aggregation_job_sizes_tx():
         eph.cleanup()
 
 
-def test_warmup_warms_pending_job_buckets_and_skips_covered(tmp_path):
+def test_warmup_warms_pending_job_buckets_and_skips_covered(tmp_path, monkeypatch):
     from janus_tpu.binary_utils import warmup_engines
+
+    # pin 1x1 so the hand-recorded plain (op, bucket) keys in m2 cover
+    # the warm dispatches (covers() matches per-geometry)
+    monkeypatch.setenv("JANUS_MESH_DP", "1")
+    monkeypatch.setenv("JANUS_MESH_SP", "1")
 
     eph, task = _provisioned_store()
     try:

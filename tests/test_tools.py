@@ -415,6 +415,20 @@ def test_bench_dry_run_smoke():
     assert fs["batched_claims"] and fs["jobs_per_claim_tx"] > 1.0
     assert fs["exactly_once"] is True
     assert fs["collected_count"] == fs["admitted"]
+    # multi-chip serving (ISSUE 16): a subprocess forced to 4 virtual
+    # devices drives the serving EngineCache path over a (dp, sp) mesh
+    # behind the single-controller dispatch queue; its aggregates and
+    # resident shares are bit-identical to the single-device reference
+    # computed in THIS process, the old process-global dispatch lock is
+    # gone, and the mesh round sustained a measurable rate
+    ms = rec["mesh_serving_smoke"]
+    assert ms.get("ok") is True, ms
+    assert ms["bit_identical"] is True
+    assert ms["devices"] == 4 and ms["dp"] * ms["sp"] > 1
+    assert ms["queue_submitted"] > 0 and ms["queue_errors"] == 0
+    assert ms["lane_alive"] is True
+    assert ms["dispatch_lock_removed"] is True
+    assert ms["rps"] > 0
 
 
 def test_collect_cli_end_to_end(capsys):
