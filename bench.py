@@ -580,6 +580,13 @@ def _make_inst(args, ap):
         "sumvec": VdafInstance.sum_vec(length=L or 1000, bits=16),
         "histogram": VdafInstance.histogram(length=L or 10000),
         "fixedpoint": VdafInstance.fixed_point_vec(length=L or 1000, bits=16),
+        # block-sparse north star (ISSUE 17): logical len-1M accumulator,
+        # each report carries <= 16 live blocks of 64 — device work rides
+        # the COMPACT encoding (1024 lanes), the scatter-merge owns the
+        # logical length
+        "sparse": VdafInstance.sparse_sumvec(
+            bits=16, length=L or 1_000_000, block_size=64, max_blocks=16
+        ),
     }[args.config]
     if args.xof_mode != "fast":
         inst = dataclasses.replace(inst, xof_mode=args.xof_mode)
@@ -639,6 +646,84 @@ def _oom_fallback_smoke() -> dict:
         "halved_retry_ok": retry_ok,
         "bucket_cap_after_retry": eng.bucket_cap,
         "host_fallback_ok": fallback_ok,
+    }
+
+
+def _sparse_scatter_smoke() -> dict:
+    """Block-sparse scatter-merge end to end on a toy geometry (CPU
+    backend): two-party prepare over sparse reports, then scatter-add of
+    each verified report's blocks into the dense logical accumulator via
+    BOTH device paths — the classic per-bucket aggregate_sparse reduce
+    and the pending-delta resident_merge — asserting the released
+    aggregate is bit-identical to the dense oracle computed by expanding
+    the plaintext measurements on host. Also proves the scatter path
+    actually ran (engine scatter counters + a scatter_merge cost-ledger
+    op with nonzero rows)."""
+    import numpy as np
+
+    from janus_tpu.aggregator.engine_cache import EngineCache
+    from janus_tpu.messages import Duration, Interval, Time
+    from janus_tpu.profiler import DEVICE_COST
+    from janus_tpu.vdaf.registry import VdafInstance, circuit_for
+    from janus_tpu.vdaf.testing import (
+        make_report_batch,
+        random_measurements,
+        sparse_compact_batch,
+    )
+    from janus_tpu.vdaf.wire import flat_scatter_indices
+
+    inst = VdafInstance.sparse_sumvec(bits=3, length=48, block_size=4, max_blocks=3)
+    circ = circuit_for(inst)
+    rng = np.random.default_rng(11)
+    n = 8
+    meas = random_measurements(inst, n, rng)
+    (nonce, public, mv, proof, blind0, seeds, blind1), _ = make_report_batch(
+        inst, meas, seed=3
+    )
+    _, block_idx = sparse_compact_batch(inst, meas)
+    flat_idx = flat_scatter_indices(block_idx, circ)
+    ok = np.ones(n, dtype=bool)
+
+    eng = EngineCache(inst, bytes(range(16)))
+    out0, _, ver0, part0 = eng.leader_init(nonce, public, mv, proof, blind0)
+    out1, accept, _ = eng.helper_init(nonce, public, seeds, blind1, ver0, part0, ok)
+    share0 = eng.aggregate_sparse(out0, accept, flat_idx)
+    share1 = eng.aggregate_sparse(out1, accept, flat_idx)
+    p = circ.FIELD.MODULUS
+    got = [(int(x) + int(y)) % p for x, y in zip(share0, share1)]
+    # dense oracle: expand each plaintext pair-measurement and sum mod p
+    want = [0] * circ.logical_length
+    for m in meas:
+        for bi, block in m:
+            for off, v in enumerate(block):
+                k = bi * circ.block_size + off
+                want[k] = (want[k] + v) % p
+    classic_identical = got == want and bool(accept.all())
+
+    # resident path: the deltas defer the scatter to merge time, then a
+    # take releases the logical-length share
+    deltas = eng.aggregate_pending(out0, np.zeros(n, dtype=np.int32), 1, flat_idx=flat_idx)
+    iv = Interval(Time(0), Duration(3600))
+    eng.resident_merge([((b"task", b"", b"bid"), 0, n, iv)], deltas)
+    recs = eng.resident_take()
+    deltas1 = eng.aggregate_pending(out1, np.zeros(n, dtype=np.int32), 1, flat_idx=flat_idx)
+    recs1 = eng.fetch_delta_records([((b"task", b"", b"bid"), 0, n, iv)], deltas1)
+    resident = [
+        (int(x) + int(y)) % p
+        for x, y in zip(recs[0]["share"], recs1[0]["share"])
+    ]
+    resident_identical = resident == want
+    ledger = DEVICE_COST.status()["entries"]
+    scatter_rows = sum(
+        e["rows"] for e in ledger if e["op"] == "scatter_merge" and e["vdaf"] == inst.kind
+    )
+    return {
+        "classic_identical": classic_identical,
+        "resident_identical": resident_identical,
+        "scatter_path_observed": eng._scatter_rows > 0 and scatter_rows > 0,
+        "scatter_rows": eng._scatter_rows,
+        "block_occupancy": eng._sparse_last_occupancy,
+        "mesh_fallback_reason": eng.mesh_fallback_reason,
     }
 
 
@@ -3115,6 +3200,10 @@ def run_dry(args, ap) -> None:
                 # resident shares bit-identical to the single-device
                 # reference computed in this process
                 "mesh_serving_smoke": _mesh_serving_smoke(),
+                # ISSUE 17: block-sparse scatter-merge — sparse vs the
+                # dense expanded oracle, bit-identical on both the
+                # classic and resident paths, scatter ledger rows proven
+                "sparse_scatter": _sparse_scatter_smoke(),
             }
         )
     )
@@ -3129,7 +3218,7 @@ def main() -> None:
     ap.add_argument(
         "--config",
         default="sumvec",
-        choices=["count", "sum", "sumvec", "histogram", "fixedpoint", "poplar1"],
+        choices=["count", "sum", "sumvec", "histogram", "fixedpoint", "sparse", "poplar1"],
     )
     ap.add_argument("--batch", type=int, default=0, help="0 = auto per backend")
     ap.add_argument(
@@ -3314,9 +3403,9 @@ def main() -> None:
     # BASELINE.md measurement configs
     inst = _make_inst(args, ap)
     batch = args.batch or (
-        {"count": 8192, "sum": 16384, "sumvec": 2048, "histogram": 1024, "fixedpoint": 1024}[args.config]
+        {"count": 8192, "sum": 16384, "sumvec": 2048, "histogram": 1024, "fixedpoint": 1024, "sparse": 1024}[args.config]
         if on_accel
-        else {"count": 256, "sum": 128, "sumvec": 16, "histogram": 16, "fixedpoint": 16}[args.config]
+        else {"count": 256, "sum": 128, "sumvec": 16, "histogram": 16, "fixedpoint": 16, "sparse": 16}[args.config]
     )
 
     rng = np.random.default_rng(0xBE7C)
@@ -3351,8 +3440,14 @@ def main() -> None:
         on device OOM so long-vector configs always produce a number
         unattended. Returns (device_rps, batch, compile_s)."""
         # stage in prove-sized sub-batches for long vectors (the prove
-        # graph peaks at [chunk, arity, n2]; prepare has no such tensor)
-        shard_chunk = 8 if getattr(inst, "length", 0) * max(inst.bits, 1) > (1 << 18) else 0
+        # graph peaks at [chunk, arity, n2]; prepare has no such tensor).
+        # Sparse configs stage at the COMPACT width, not the logical one.
+        eff_len = (
+            inst.max_blocks * inst.block_size
+            if inst.kind == "sparse_sumvec"
+            else getattr(inst, "length", 0)
+        )
+        shard_chunk = 8 if eff_len * max(inst.bits, 1) > (1 << 18) else 0
         while True:
             try:
                 meas = random_measurements(inst, batch, rng)
@@ -3472,6 +3567,102 @@ def main() -> None:
                 if attempt < 2:
                     time.sleep(30)
 
+    def measure_sparse(sp_batch: int, sp_iters: int) -> dict:
+        """The block-sparse north-star (ISSUE 17): two-party prepare at
+        the compact width PLUS the gather/scatter-add of every verified
+        report's blocks into one dense logical len-1M resident
+        accumulator — the full serving device path, timed end to end.
+        µs/report comes from the device cost ledger's scatter_merge op;
+        the resident HBM figure is the one dense logical row the
+        accumulator owns regardless of report count."""
+        from janus_tpu.aggregator.engine_cache import EngineCache
+        from janus_tpu.profiler import DEVICE_COST
+        from janus_tpu.vdaf.registry import circuit_for
+        from janus_tpu.vdaf.testing import sparse_compact_batch
+        from janus_tpu.vdaf.wire import flat_scatter_indices
+
+        sp_inst = (
+            inst
+            if inst.kind == "sparse_sumvec"
+            else VdafInstance.sparse_sumvec(
+                bits=16, length=1_000_000, block_size=64, max_blocks=16
+            )
+        )
+        circ = circuit_for(sp_inst)
+        sp_meas = random_measurements(sp_inst, sp_batch, rng)
+        t0 = time.time()
+        (nonce, public, mv, proof, blind0, seeds, blind1), _ = make_report_batch(
+            sp_inst, sp_meas, seed=2
+        )
+        _, block_idx = sparse_compact_batch(sp_inst, sp_meas)
+        flat_idx = flat_scatter_indices(block_idx, circ)
+        progress["t"] = time.monotonic()
+        print(
+            f"[bench] sparse shard: {time.time()-t0:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        eng = EngineCache(sp_inst, verify_key)
+        ok = np.ones(sp_batch, dtype=bool)
+
+        def step():
+            out0, _, ver0, part0 = eng.leader_init(nonce, public, mv, proof, blind0)
+            _, accept, _ = eng.helper_init(
+                nonce, public, seeds, blind1, ver0, part0, ok
+            )
+            assert bool(accept.all()), "sparse bench reports rejected"
+            return eng.aggregate_sparse(out0, accept, flat_idx)
+
+        t0 = time.time()
+        step()  # compile + first dispatch
+        compile_s = time.time() - t0
+        progress["t"] = time.monotonic()
+        print(
+            f"[bench] sparse step compile+first: {compile_s:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        t0 = time.time()
+        for _ in range(sp_iters):
+            step()
+            progress["t"] = time.monotonic()
+        rps = sp_batch * sp_iters / (time.time() - t0)
+        return {
+            "metric": "prio3_sparse_sumvec_len1m_two_party_prepare_scatter",
+            "value": round(rps, 2),
+            "unit": "report_shares_per_sec_per_chip",
+            "batch": sp_batch,
+            "iters": sp_iters,
+            "compile_s": round(compile_s, 1),
+            "logical_length": circ.logical_length,
+            "block_size": circ.block_size,
+            "max_blocks": circ.max_blocks,
+            "resident_hbm_bytes": circ.logical_length * eng.p3.jf.LIMBS * 8,
+            "scatter_rows": eng._scatter_rows,
+            "block_occupancy": eng._sparse_last_occupancy,
+            "us_per_report": DEVICE_COST.us_per_report().get("scatter_merge"),
+            "mesh_fallback_reason": eng.mesh_fallback_reason,
+        }
+
+    # the block-sparse north-star rides the default driver run (like
+    # north_star_len100k) and IS the main measurement for --config sparse
+    sparse_northstar = None
+    if args.config == "sparse" or (
+        args.config == "sumvec"
+        and not args.length
+        and args.mode == "device"
+        and on_accel
+        and args.xof_mode == "fast"
+    ):
+        try:
+            sparse_northstar = measure_sparse(
+                batch if args.config == "sparse" else (1024 if on_accel else 16),
+                args.iters if args.config == "sparse" else max(2, args.iters // 2),
+            )
+        except Exception as e:  # never lose the main record to the rider
+            sparse_northstar = {"error": str(e)[:300]}
+            progress["t"] = time.monotonic()
+
     served = None
     if args.mode == "served":
         served = run_served(inst, args.reports, min(batch, 512), progress)
@@ -3495,7 +3686,10 @@ def main() -> None:
     t0 = time.time()
     for i in range(args.host_reports):
         mi = host_meas[i]
-        m = mi.tolist() if getattr(mi, "ndim", 0) else int(mi)
+        if isinstance(mi, list):  # sparse pair-measurement, pass as-is
+            m = mi
+        else:
+            m = mi.tolist() if getattr(mi, "ndim", 0) else int(mi)
         nonce = bytes(16)
         public, (ls, hs) = host.shard(m, nonce)
         st0, ps0 = host.prepare_init(verify_key, 0, nonce, public, ls)
@@ -3604,6 +3798,7 @@ def main() -> None:
                 "host_oracle_rps": round(host_rps, 3),
                 "host_oracle_extrapolated": host_scale != 1.0,
                 **({"north_star_len100k": north_star} if north_star else {}),
+                **({"sparse_northstar": sparse_northstar} if sparse_northstar else {}),
                 **({"served": served} if served else {}),
                 **hbm,
                 **riders,

@@ -76,6 +76,7 @@ from ..vdaf.wire import (
     encode_field_rows,
     encode_pingpong,
     encode_pingpong_share_column,
+    flat_scatter_indices,
     pingpong_finish_frame_matches,
     seeds_to_lanes,
 )
@@ -190,6 +191,12 @@ class InitStepState:
     resident_delta: object = None
     resident_entries: list | None = None
     resident_rids: list | None = None
+    # block-sparse tasks (ISSUE 17): per-lane PUBLIC block indices from
+    # the decoded public shares ([n, max_blocks] int32, -1 padding /
+    # failed lanes) — the accumulate stages expand them to flat scatter
+    # targets. NOT cleared by the pipeline's device-init stage: the
+    # accumulate leg runs after HTTP, long after staging columns drop.
+    block_idx: object = None
 
 
 class AggregationJobDriver:
@@ -363,6 +370,7 @@ class AggregationJobDriver:
         part_rows1: list[bytes | None] = [None] * n
         failed = [None] * n  # PrepareError or None
         circ = wire.circ
+        idx_rows: list | None = [None] * n if wire.sparse else None
         mlen = circ.input_len * wire.enc_size
         plen = circ.proof_len * wire.enc_size
         for i, ra in enumerate(pending):
@@ -381,6 +389,10 @@ class AggregationJobDriver:
                 try:
                     parts = wire.decode_public_share(rep.public_share)
                     part_rows0[i], part_rows1[i] = parts
+                    if idx_rows is not None:
+                        # validated PUBLIC block indices (the sparse
+                        # decode rejects out-of-range / unsorted rows)
+                        idx_rows[i] = parts.indices
                 except DecodeError:
                     failed[i] = PrepareError.INVALID_MESSAGE
 
@@ -405,7 +417,14 @@ class AggregationJobDriver:
         else:
             blind_lanes = None
             public_parts = None
-        return meas, proof, nonce_lanes, blind_lanes, public_parts, ok, failed
+        if idx_rows is not None:
+            block_idx = np.full((n, circ.max_blocks), -1, dtype=np.int32)
+            for i, row in enumerate(idx_rows):
+                if row is not None:
+                    block_idx[i] = row
+        else:
+            block_idx = None
+        return meas, proof, nonce_lanes, blind_lanes, public_parts, ok, failed, block_idx
 
     # --- the step (reference :102-726), decomposed into the stage
     # methods the step_pipeline schedules across its executors. The
@@ -523,6 +542,7 @@ class AggregationJobDriver:
                 public_parts,
                 ok,
                 failed,
+                block_idx,
             ) = self._stage_pending(task, wire, engine, pending, reports)
         return InitStepState(
             acquired=acquired,
@@ -540,6 +560,7 @@ class AggregationJobDriver:
             public_parts=public_parts,
             ok=ok,
             failed=failed,
+            block_idx=block_idx,
         )
 
     def device_init(self, st: "InitStepState") -> None:
@@ -761,7 +782,16 @@ class AggregationJobDriver:
                 st.accept,
                 metadatas,
                 batch_identifier=bid_fixed,
+                flat_idx=self._flat_idx(st),
             )
+
+    @staticmethod
+    def _flat_idx(st: "InitStepState"):
+        """[n, compact_len] int32 scatter targets for a sparse job's
+        staged block indices; None on dense tasks."""
+        if not st.wire.sparse or st.block_idx is None:
+            return None
+        return flat_scatter_indices(st.block_idx, st.wire.circ)
 
     def _device_accumulate_resident(self, st, metadatas, bid_fixed) -> bool:
         """Resident accumulate attempt. True = st.accumulator holds
@@ -776,7 +806,9 @@ class AggregationJobDriver:
         for j, bid in enumerate(keys):
             lane_bucket[buckets[bid]] = j
         try:
-            delta = st.engine.aggregate_pending(st.out0, lane_bucket, len(keys))
+            delta = st.engine.aggregate_pending(
+                st.out0, lane_bucket, len(keys), flat_idx=self._flat_idx(st)
+            )
         except (DeviceHangError, DeadlineExceeded):
             raise  # step-back semantics, identical to the classic path
         except Exception:

@@ -64,9 +64,11 @@ from ..vdaf.wire import (
     PP_FINISH,
     PP_INITIALIZE,
     Prio3Wire,
+    decode_index_columns,
     decode_pingpong,
     encode_field_rows,
     encode_pingpong,
+    flat_scatter_indices,
     lanes_to_seed_rows,
     seeds_to_lanes,
     split_prep_share_columns,
@@ -280,8 +282,22 @@ class TaskAggregator:
         max_time = now.add(task.tolerable_clock_skew).seconds
         expiry = task.task_expiration.seconds if task.task_expiration else None
         kp_cache: dict[int, object] = {}
+        # sparse tasks: the index predicate over the whole window in one
+        # vectorized pass (reject-divergence vs the per-report reference
+        # decoder is fuzz-pinned by tests/test_sparse_vdaf.py); a lane
+        # with a wrong total length gets None -> ok=False, matching the
+        # reference decoder's length check
+        sparse_ok = None
+        if self.poplar is None and self.wire.sparse:
+            rows = [
+                col.public_shares[i]
+                if len(col.public_shares[i]) == self.wire.public_share_len
+                else None
+                for i in idxs
+            ]
+            _, sparse_ok = decode_index_columns(rows, self.wire.circ)
         out: list = []
-        for i in idxs:
+        for k, i in enumerate(idxs):
             t = col.times[i]
             if t > max_time:
                 out.append(errors.ReportTooEarly("report from the future", task.task_id))
@@ -293,14 +309,25 @@ class TaskAggregator:
                 out.append(errors.ReportRejected("report expired", task.task_id))
                 continue
             if self.poplar is None:
-                try:
-                    self.wire.decode_public_share(col.public_shares[i])
-                except DecodeError as e:
-                    metrics.upload_decode_failure_counter.add()
-                    out.append(
-                        errors.InvalidMessage(f"bad public share: {e}", task.task_id)
-                    )
-                    continue
+                if sparse_ok is not None:
+                    if not sparse_ok[k]:
+                        metrics.upload_decode_failure_counter.add()
+                        out.append(
+                            errors.InvalidMessage(
+                                "bad public share: invalid sparse block indices",
+                                task.task_id,
+                            )
+                        )
+                        continue
+                else:
+                    try:
+                        self.wire.decode_public_share(col.public_shares[i])
+                    except DecodeError as e:
+                        metrics.upload_decode_failure_counter.add()
+                        out.append(
+                            errors.InvalidMessage(f"bad public share: {e}", task.task_id)
+                        )
+                        continue
             cfg = col.leader_config_ids[i]
             if cfg not in kp_cache:
                 kp_cache[cfg] = self._hpke_keypair(HpkeConfigId(cfg))
@@ -505,6 +532,8 @@ class TaskAggregator:
         part_rows0: list[bytes | None] = [None] * n  # public part 0
         part_rows1: list[bytes | None] = [None] * n
         leader_prep_rows: list[bytes | None] = [None] * n
+        # block-sparse tasks: validated PUBLIC block indices per lane
+        idx_rows: list | None = [None] * n if self.wire.sparse else None
         with span("helper.hpke_stage", batch=n):
             # pass 1: cheap per-report checks + keypair lookup; HPKE
             # lanes collect per config id for the batched opens
@@ -571,6 +600,8 @@ class TaskAggregator:
                 if self.wire.uses_jr:
                     part_rows0[i] = parts[0]
                     part_rows1[i] = parts[1]
+                if idx_rows is not None:
+                    idx_rows[i] = parts.indices
                 leader_prep_rows[i] = prep_share
 
         # replay check against prior aggregations (reference replay
@@ -673,6 +704,13 @@ class TaskAggregator:
         accumulator = Accumulator(task, self.cfg.batch_aggregation_shard_count)
         fixed_bid = fixed_size_batch_id(req.partial_batch_selector)
         if not multi_round:
+            flat_idx = None
+            if idx_rows is not None:
+                block_idx = np.full((n, self.wire.circ.max_blocks), -1, dtype=np.int32)
+                for i, row in enumerate(idx_rows):
+                    if row is not None:
+                        block_idx[i] = row
+                flat_idx = flat_scatter_indices(block_idx, self.wire.circ)
             with span("helper.accumulate", batch=n):
                 accumulate_batched(
                     task,
@@ -682,6 +720,7 @@ class TaskAggregator:
                     accept,
                     [pi.report_share.metadata for pi in inits],
                     batch_identifier=fixed_bid,
+                    flat_idx=flat_idx,
                 )
 
         times = [pi.report_share.metadata.time.seconds for pi in inits]

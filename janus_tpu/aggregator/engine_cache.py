@@ -38,6 +38,7 @@ from ..core.deadline import current_deadline
 from . import aot_cache, shape_manifest
 from ..vdaf.engine import STREAM_MIN_INPUT_LEN, stream_plan
 from ..vdaf.feasibility import device_memory_budget, feasible_bucket
+from ..vdaf.reference import SparseSumVec
 from ..vdaf.registry import VdafInstance, prio3_batched
 from . import device_watchdog
 from .device_watchdog import DeviceHangError  # noqa: F401 - re-export: the
@@ -318,6 +319,34 @@ class PendingDeltas:
     def row(self, j: int):
         """Row j as a device field value (lazy jnp slice — no fetch)."""
         return tuple(x[j] for x in self.value)
+
+
+class SparsePendingDeltas:
+    """Sparse-job pending state (ISSUE 17). Unlike PendingDeltas the
+    per-bucket reduction CANNOT run at dispatch time: two reports of
+    the same batch bucket carry different block indices, so a
+    compact-width pre-sum would add values living at unrelated logical
+    coordinates. Instead the job's raw out shares (device rows) ride to
+    merge time together with each report's flat scatter indices, and
+    resident_merge scatter-adds report blocks straight into the dense
+    logical slot — HBM holds ONE [logical_len] accumulator per slot
+    while per-report device work stays O(nonzero lanes). Same commit
+    discipline as PendingDeltas: dropped uncommitted, merged after.
+
+    flat_idx: [n, compact_len] host int32 scatter targets, sentinel =
+    logical_len for padding lanes (the scatter drops them);
+    bucket_idx: [n] host int32 bucket per report, -1 = rejected.
+    row_nbytes is the DENSE logical row size (what a slot occupies)."""
+
+    __slots__ = ("out_shares", "flat_idx", "bucket_idx", "k", "row_nbytes", "logical_len")
+
+    def __init__(self, out_shares, flat_idx, bucket_idx, k: int, row_nbytes: int, logical_len: int):
+        self.out_shares = out_shares
+        self.flat_idx = flat_idx
+        self.bucket_idx = bucket_idx
+        self.k = k
+        self.row_nbytes = row_nbytes
+        self.logical_len = logical_len
 
 
 # process-wide resident accounting (the HBM the resident layer holds
@@ -898,6 +927,15 @@ class EngineCache:
             dp=cfg_dp,
             sp=cfg_sp,
         )
+        # block-sparse tasks (ISSUE 17) force the single-device path:
+        # the scatter-merge kernel writes one donated logical
+        # accumulator per slot, and sharding its write axis over 'sp'
+        # is future work. The reason is explicit in /statusz mesh.
+        self.sparse = isinstance(self.p3.circ, SparseSumVec)
+        self.mesh_fallback_reason: str | None = None
+        if self.sparse and dp * sp > 1:
+            dp, sp = 1, 1
+            self.mesh_fallback_reason = "sparse_scatter_single_device"
         self.mesh = make_mesh(dp, sp) if dp * sp > 1 else None
         self.dp = dp
         self.sp = sp
@@ -984,6 +1022,13 @@ class EngineCache:
             "eviction_deferred": 0,
             "takes": 0,
         }
+        # sparse scatter accounting (ISSUE 17): total reports scattered
+        # into dense logical accumulators + the last dispatch's mean
+        # block occupancy — surfaced on the statusz `sparse` line and
+        # the janus_engine_scatter_rows_total / _sparse_block_occupancy
+        # metrics
+        self._scatter_rows = 0
+        self._sparse_last_occupancy: float | None = None
         # device-circuit quarantine (ISSUE 8; docs/ROBUSTNESS.md "Device
         # hangs & deadlines"): a watchdog-abandoned dispatch opens the
         # circuit — serving moves to the host engine immediately (the
@@ -2159,6 +2204,71 @@ class EngineCache:
             _annotate_dispatch_bucket(e, dispatch_b, fixed=dispatch_fixed)
             raise
 
+    def aggregate_sparse(self, out_shares, mask, flat_idx):
+        """Masked sparse aggregate: scatter-add every accepted report's
+        blocks into a dense logical accumulator and fetch it — the
+        classic-path analogue of the resident scatter-merge (helper
+        accumulate and the resident-disabled leader land here). An OOM
+        degrades to a host scatter over fetched rows instead of failing
+        the job; other errors propagate like aggregate's."""
+        from .. import metrics
+
+        host = self._host()
+        if host is not None:
+            if isinstance(out_shares, (DeviceRows, DeviceRowsChunks)):
+                rows = self._supervised("fetch_resident", out_shares.to_numpy)
+                return host.aggregate_sparse(rows, np.asarray(mask), flat_idx)
+            return host.aggregate_sparse(out_shares, mask, flat_idx)
+        p3 = self.p3
+        L = p3.circ.agg_output_len
+        accept = np.asarray(mask, bool)
+        idx = np.where(
+            accept[:, None], np.asarray(flat_idx, np.int32), np.int32(L)
+        ).astype(np.int32)
+        n = idx.shape[0]
+        n_rows = int(accept.sum())
+        live = int((idx < L).sum())
+
+        def device_call():
+            _engine_dispatch_failpoint()
+            t_disp = time.monotonic()
+            acc = self._scatter_dispatch(self._zeros_row(L), out_shares, idx)
+            result = [int(x) for x in p3.jf.to_ints(acc)]
+            count_d2h(len(result) * p3.jf.LIMBS * 8)
+            self._record_dispatch(
+                "aggregate",
+                n,
+                bucket_size(n),
+                time.monotonic() - t_disp,
+                ledger_op="scatter_merge",
+                compile_key=("scatter_merge", bucket_size(n)),
+            )
+            metrics.engine_scatter_rows_total.add(n_rows, vdaf=self.inst.kind)
+            self._scatter_rows += n_rows
+            if n_rows:
+                occ = live / (n_rows * idx.shape[1])
+                self._sparse_last_occupancy = occ
+                metrics.engine_sparse_block_occupancy.set(occ, vdaf=self.inst.kind)
+            return result
+
+        try:
+            return self._supervised("aggregate_sparse", device_call)
+        except Exception as e:
+            if not is_oom_error(e):
+                _annotate_dispatch_bucket(e, bucket_size(n), fixed=True)
+                raise
+            log.warning(
+                "sparse aggregate OOM at bucket %d; scattering on host",
+                bucket_size(n),
+                exc_info=True,
+            )
+            rows = (
+                self._supervised("fetch_resident", out_shares.to_numpy)
+                if isinstance(out_shares, (DeviceRows, DeviceRowsChunks))
+                else out_shares
+            )
+            return _host_scatter_rows(p3.jf, rows, idx, L)
+
     # --- device-resident aggregate state (ISSUE 12; docs/ARCHITECTURE.md
     # "Resident aggregate state"). The engine owns the per-(task, batch
     # bucket) buffers and the device ops; the DRIVER owns flush policy
@@ -2196,15 +2306,30 @@ class EngineCache:
         sh = NamedSharding(self.mesh, spec)
         return tuple(sh for _ in range(self.p3.jf.LIMBS))
 
-    def aggregate_pending(self, out_shares, bucket_idx, k: int) -> PendingDeltas:
+    def aggregate_pending(self, out_shares, bucket_idx, k: int, flat_idx=None):
         """Per-bucket masked sums of one job's out shares as a DEVICE
         [k, output_len] value — ONE dispatch, one [n] int32 upload,
         nothing fetched (the classic path uploads a full n-bool mask
         and fetches the aggregate per bucket). k pads to the next power
         of two so the traced program specializes O(log k) times.
         Errors propagate: the driver falls back to the classic
-        accumulate for OOM-class failures and steps back on hangs."""
+        accumulate for OOM-class failures and steps back on hangs.
+
+        `flat_idx` ([n, compact_len] int32 scatter targets) marks a
+        block-sparse job: no device work happens here — the per-bucket
+        scatter into the dense logical accumulator runs at merge time
+        (SparsePendingDeltas explains why a compact pre-sum is wrong)."""
         p3 = self.p3
+        if flat_idx is not None:
+            L = p3.circ.agg_output_len
+            return SparsePendingDeltas(
+                out_shares,
+                np.asarray(flat_idx, np.int32),
+                np.asarray(bucket_idx, np.int32),
+                k,
+                L * p3.jf.LIMBS * 8,
+                L,
+            )
         kk = 1 << max(0, int(k - 1).bit_length())
         row_nbytes = p3.circ.output_len * p3.jf.LIMBS * 8
 
@@ -2321,6 +2446,97 @@ class EngineCache:
             )
         return self._jits[name](acc, row)
 
+    # --- block-sparse scatter-merge (ISSUE 17; docs/ARCHITECTURE.md
+    # "Block-sparse aggregation"): verified reports' compact blocks
+    # scatter-add into the dense logical accumulator by their PUBLIC
+    # block indices. Sparse engines are single-device (see __init__). ---
+
+    def _zeros_row(self, length: int):
+        """Fresh dense logical accumulator: a zero field row on device."""
+        return tuple(
+            jnp.zeros(length, dtype=jnp.uint64) for _ in range(self.p3.jf.LIMBS)
+        )
+
+    def _scatter_fn(self):
+        """Jitted scatter-add of per-report compact blocks into a dense
+        [logical_len] accumulator (the ISSUE 17 headline kernel —
+        vdaf.prio3_jax.scatter_rows). The accumulator is DONATED on
+        real devices so repeated merges into one slot stay in place;
+        jax.jit respecializes per (bucket, compact_len, logical_len)
+        shape on its own."""
+        name = "scatter_merge"
+        if name not in self._jits:
+            p3 = self.p3
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._jits[name] = jax.jit(
+                lambda acc, values, idx: p3.scatter_rows(acc, values, idx),
+                donate_argnums=donate,
+            )
+        return self._jits[name]
+
+    def _scatter_dispatch(self, acc, out_shares, idx):
+        """Scatter-add every report row of `out_shares` whose idx row is
+        live into acc. idx: [n, compact_len] host int32, sentinel =
+        logical_len drops a lane. Handles the three out-share
+        currencies like _pending_dispatch; padding rows inside a device
+        bucket get all-sentinel idx rows so their garbage never lands."""
+        L = acc[0].shape[0]
+        fn = self._scatter_fn()
+        if isinstance(out_shares, DeviceRowsChunks):
+            off = 0
+            for chunk in out_shares.chunks:
+                acc = self._scatter_dispatch(acc, chunk, idx[off : off + chunk.n])
+                off += chunk.n
+            return acc
+        if isinstance(out_shares, DeviceRows):
+            n = out_shares.n
+            value = out_shares.value
+            b = value[0].shape[0]
+            s = out_shares.offset
+            full = np.full((b, idx.shape[1]), np.int32(L), np.int32)
+            full[s : s + n] = idx
+            count_h2d(int(full.nbytes))
+            return fn(acc, value, full)
+        # host limb rows (a round that degraded to host currency)
+        n = idx.shape[0]
+        bb = bucket_size(n)
+        (padded,) = pad_args(bb, out_shares)
+        full = np.full((bb, idx.shape[1]), np.int32(L), np.int32)
+        full[:n] = idx
+        count_h2d((padded, full))
+        return fn(acc, padded, full)
+
+    def _sparse_slot_value(self, slot, deltas: "SparsePendingDeltas", j: int):
+        """Scatter-add bucket j's report blocks into the slot's dense
+        logical accumulator (zeros for a fresh slot / a raw delta
+        fetch). One device dispatch, booked as a scatter_merge cost-
+        ledger row; feeds the scatter metrics."""
+        from .. import metrics
+
+        L = deltas.logical_len
+        sel = deltas.bucket_idx == j
+        idx = np.where(sel[:, None], deltas.flat_idx, np.int32(L)).astype(np.int32)
+        acc = self._zeros_row(L) if slot is None else slot.value
+        n_rows = int(sel.sum())
+        live = int((idx < L).sum())
+        t_disp = time.monotonic()
+        value = self._scatter_dispatch(acc, deltas.out_shares, idx)
+        self._record_dispatch(
+            "aggregate",
+            n_rows,
+            bucket_size(len(sel)),
+            time.monotonic() - t_disp,
+            ledger_op="scatter_merge",
+            compile_key=("scatter_merge", bucket_size(len(sel))),
+        )
+        metrics.engine_scatter_rows_total.add(n_rows, vdaf=self.inst.kind)
+        self._scatter_rows += n_rows
+        if n_rows:
+            occ = live / (n_rows * deltas.flat_idx.shape[1])
+            self._sparse_last_occupancy = occ
+            metrics.engine_sparse_block_occupancy.set(occ, vdaf=self.inst.kind)
+        return value
+
     def resident_merge(self, entries, deltas: PendingDeltas) -> list[dict]:
         """Merge one job's committed deltas into the resident slots.
 
@@ -2334,20 +2550,33 @@ class EngineCache:
         """
         from ..messages import Interval
 
+        sparse = isinstance(deltas, SparsePendingDeltas)
         evicted: list[ResidentSlot] = []
         merged: set = set()
         with self._resident_lock:
             try:
                 for key, j, rows, interval in entries:
                     slot = self._resident.get(key)
+                    if sparse:
+                        # scatter-merge: blocks land straight in the
+                        # (fresh or existing) dense logical accumulator
+                        value = self._sparse_slot_value(slot, deltas, j)
                     if slot is None:
                         slot = ResidentSlot(
-                            key, deltas.row(j), interval, rows, deltas.row_nbytes
+                            key,
+                            value if sparse else deltas.row(j),
+                            interval,
+                            rows,
+                            deltas.row_nbytes,
                         )
                         self._resident[key] = slot
                         _resident_bytes_add(slot.nbytes, self.inst.kind, +1)
                     else:
-                        slot.value = self._resident_add(slot.value, deltas.row(j))
+                        slot.value = (
+                            value
+                            if sparse
+                            else self._resident_add(slot.value, deltas.row(j))
+                        )
                         slot.interval = Interval.merged(slot.interval, interval)
                         slot.rows += rows
                         self._resident.move_to_end(key)
@@ -2414,21 +2643,28 @@ class EngineCache:
             self._resident_stats["takes"] += len(slots)
             return recs
 
-    def fetch_delta_records(self, entries, deltas: PendingDeltas) -> list[dict]:
+    def fetch_delta_records(self, entries, deltas) -> list[dict]:
         """Supervised d2h fetch of a job's raw delta rows — the driver's
         merge-failed recovery path. Bounded like every other resident
         fetch: a raw to_ints() here would park the commit worker in
         native code forever on exactly the wedged device that likely
-        just failed the merge."""
+        just failed the merge. Sparse deltas scatter into a zero dense
+        logical row first (the flush currency is always dense)."""
         p3 = self.p3
+        sparse = isinstance(deltas, SparsePendingDeltas)
 
         def fetch():
             out = []
             for key, j, rows, interval in entries:
+                value = (
+                    self._sparse_slot_value(None, deltas, j)
+                    if sparse
+                    else deltas.row(j)
+                )
                 out.append(
                     {
                         "key": key,
-                        "share": [int(x) for x in p3.jf.to_ints(deltas.row(j))],
+                        "share": [int(x) for x in p3.jf.to_ints(value)],
                         "rows": rows,
                         "interval": interval,
                     }
@@ -2485,12 +2721,38 @@ class EngineCache:
 
     def resident_status(self) -> dict:
         with self._resident_lock:
-            return {
+            out = {
                 "vdaf": self.inst.kind,
                 "buffers": len(self._resident),
                 "bytes": sum(s.nbytes for s in self._resident.values()),
                 **dict(self._resident_stats),
             }
+            if self.sparse:
+                circ = self.p3.circ
+                out["sparse"] = {
+                    "logical_length": circ.logical_length,
+                    "block_size": circ.block_size,
+                    "max_blocks": circ.max_blocks,
+                    "scatter_rows": self._scatter_rows,
+                    "block_occupancy": self._sparse_last_occupancy,
+                }
+            return out
+
+
+def _host_scatter_rows(jf, rows, idx, L: int) -> list[int]:
+    """Host scatter-add over fetched [n, compact_len] limb rows — the
+    OOM degrade for EngineCache.aggregate_sparse. idx carries the same
+    sentinel convention as the device kernel (>= L drops the lane)."""
+    vals = jf.to_ints(tuple(np.asarray(r) for r in rows))
+    p = jf.MODULUS
+    agg = [0] * L
+    n, cm = idx.shape
+    for i in range(n):
+        for c in range(cm):
+            fx = int(idx[i, c])
+            if 0 <= fx < L:
+                agg[fx] = (agg[fx] + int(vals[i, c])) % p
+    return agg
 
 
 class _HostP3:
@@ -2635,6 +2897,24 @@ class HostEngineCache:
                 continue
             row = self._row_ints(out_shares, i)
             agg = [(a + b) % p for a, b in zip(agg, row)]
+        return agg
+
+    def aggregate_sparse(self, out_shares, mask, flat_idx):
+        """Host scatter-add of accepted reports' compact rows into a
+        dense logical aggregate (same contract as the device
+        EngineCache.aggregate_sparse)."""
+        p = self.circ.FIELD.MODULUS
+        L = getattr(self.circ, "agg_output_len", self.circ.output_len)
+        agg = [0] * L
+        idx = np.asarray(flat_idx)
+        for i in range(mask.shape[0]):
+            if not mask[i]:
+                continue
+            row = self._row_ints(out_shares, i)
+            for v, fx in zip(row, idx[i]):
+                fx = int(fx)
+                if 0 <= fx < L:
+                    agg[fx] = (agg[fx] + int(v)) % p
         return agg
 
 
@@ -2802,15 +3082,18 @@ def resident_accumulators_status() -> dict:
     flush-take counters)."""
     with _engine_cache_lock:
         engines = list(_engine_cache.values())
+    device_engines = [e for e in engines if not isinstance(e, HostEngineCache)]
     return {
         "total_bytes": resident_bytes_total(),
         "max_bytes": EngineCache.RESIDENT_MAX_BYTES,
         "cross_task_coalesce": XTASK_COALESCE,
-        "engines": [
-            eng.resident_status()
-            for eng in engines
-            if not isinstance(eng, HostEngineCache)
-        ],
+        # block-sparse rollup (ISSUE 17): scatter-merge activity across
+        # every sparse engine — scrape_check pins this line's presence
+        "sparse": {
+            "engines": sum(1 for e in device_engines if getattr(e, "sparse", False)),
+            "scatter_rows": sum(getattr(e, "_scatter_rows", 0) for e in device_engines),
+        },
+        "engines": [eng.resident_status() for eng in device_engines],
     }
 
 
@@ -2841,6 +3124,7 @@ def mesh_status() -> dict:
                 "sp": e.sp,
                 "mesh": e.mesh is not None,
                 "sharded_resident": e.sp > 1,
+                "fallback_reason": e.mesh_fallback_reason,
             }
             for e in engines
         ],

@@ -293,6 +293,18 @@ class _Warmer:
             idx = (np.arange(n, dtype=np.int32) % max(1, kk)).astype(np.int32)
             eng.aggregate_pending(out0, idx, max(1, kk))
             return "warmed"
+        if variant == "scatter_merge":
+            # block-sparse scatter-add into a dense logical accumulator
+            # (ISSUE 17): the program specializes on (row bucket,
+            # compact_len, logical_len) — replay with every compact
+            # lane live, which traces the same shapes serving uses
+            if not getattr(eng, "sparse", False):
+                return "unsupported"
+            cm = eng.p3.circ.output_len
+            _, (out0, _, _, _) = self._leader_out(eng, n)
+            flat = np.tile(np.arange(cm, dtype=np.int32), (n, 1))
+            eng.aggregate_sparse(out0, np.ones(n, dtype=bool), flat)
+            return "warmed"
         return "unsupported"
 
 

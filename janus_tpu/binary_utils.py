@@ -603,63 +603,88 @@ def warmup_engines(ds, batch: int | None = None, manifest=None) -> dict:
     # leader leg the aggregate warm needs)
     warm_ops = ("leader_init", "helper_init", "aggregate")
     result: dict = {"warmed": [], "skipped_covered": 0}
-    for task in tasks:
-        if task.vdaf.kind.startswith("fake") or task.vdaf.kind == "poplar1":
-            continue  # fakes and host-side Poplar1 have no device engine
-        if batch is not None:
-            sizes = [int(batch)]
-        else:
-            # dedupe pending job sizes by their jit bucket (the compile
-            # unit), keep ascending so interactive sizes warm first,
-            # and bound the set — one warm per bucket is enough
-            by_bucket: dict[int, int] = {}
-            for n in sorted(pending.get(task.task_id.data, [])):
-                by_bucket.setdefault(bucket_size(n), n)
-            sizes = [by_bucket[b] for b in sorted(by_bucket)][:4] or [MIN_BUCKET]
-        for warm_batch in sizes:
-            b = bucket_size(warm_batch)
-            inst_dict = task.vdaf.to_dict()
-            try:
-                eng = engine_cache(task.vdaf, task.vdaf_verify_key)
-                if isinstance(eng, HostEngineCache):
-                    continue  # host engines need no compile
-                # coverage is per mesh topology: a manifest recorded
-                # under a different (dp, sp, ndev) — another machine
-                # class, or a single-device run — names programs this
-                # process never dispatches, so it doesn't cover these
-                geometry = (
-                    (eng.dp, eng.sp, eng._ndev) if eng.mesh is not None else None
-                )
-                if manifest is not None and all(
-                    manifest.covers(inst_dict, op, b, geometry=geometry)
-                    for op in warm_ops
-                ):
-                    result["skipped_covered"] += 1
-                    metrics.engine_prewarm_total.add(outcome="skipped_covered")
-                    continue
-                rng = np.random.default_rng(0)
-                args, _ = make_report_batch(
-                    task.vdaf, random_measurements(task.vdaf, warm_batch, rng), seed=0
-                )
-                nonce, parts, meas, proof, blind0, hseed, blind1 = args
-                out0, seed0, ver0, part0 = eng.leader_init(
-                    nonce, parts, meas, proof, blind0
-                )
-                ok = np.ones(warm_batch, dtype=bool)
-                part0_l = (
-                    part0
-                    if part0 is not None
-                    else np.zeros((warm_batch, 2), dtype=np.uint64)
-                )
-                eng.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
-                eng.aggregate(out0, ok)
-                result["warmed"].append((task.task_id, b))
-                log.info(
-                    "warmed engines for task %s (%s) at bucket %d",
-                    task.task_id, task.vdaf.kind, b,
-                )
-            except Exception:
-                log.exception("engine warmup failed for task %s", task.task_id)
+    # warm dispatches are infrastructure, not the serving path a chaos
+    # schedule drills: keep armed failpoints inert so `after=K` anchors
+    # stay pinned to SERVING dispatch counts (failpoints.suppressed)
+    from . import failpoints
+
+    with failpoints.suppressed():
+        for task in tasks:
+            if task.vdaf.kind.startswith("fake") or task.vdaf.kind == "poplar1":
+                continue  # fakes and host-side Poplar1 have no device engine
+            if batch is not None:
+                sizes = [int(batch)]
+            else:
+                # dedupe pending job sizes by their jit bucket (the compile
+                # unit), keep ascending so interactive sizes warm first,
+                # and bound the set — one warm per bucket is enough
+                by_bucket: dict[int, int] = {}
+                for n in sorted(pending.get(task.task_id.data, [])):
+                    by_bucket.setdefault(bucket_size(n), n)
+                sizes = [by_bucket[b] for b in sorted(by_bucket)][:4] or [MIN_BUCKET]
+            for warm_batch in sizes:
+                b = bucket_size(warm_batch)
+                inst_dict = task.vdaf.to_dict()
+                try:
+                    eng = engine_cache(task.vdaf, task.vdaf_verify_key)
+                    if isinstance(eng, HostEngineCache):
+                        continue  # host engines need no compile
+                    # coverage is per mesh topology: a manifest recorded
+                    # under a different (dp, sp, ndev) — another machine
+                    # class, or a single-device run — names programs this
+                    # process never dispatches, so it doesn't cover these
+                    geometry = (
+                        (eng.dp, eng.sp, eng._ndev) if eng.mesh is not None else None
+                    )
+                    if manifest is not None and all(
+                        manifest.covers(inst_dict, op, b, geometry=geometry)
+                        for op in warm_ops
+                    ):
+                        result["skipped_covered"] += 1
+                        metrics.engine_prewarm_total.add(outcome="skipped_covered")
+                        continue
+                    rng = np.random.default_rng(0)
+                    args, _ = make_report_batch(
+                        task.vdaf, random_measurements(task.vdaf, warm_batch, rng), seed=0
+                    )
+                    nonce, parts, meas, proof, blind0, hseed, blind1 = args
+                    out0, seed0, ver0, part0 = eng.leader_init(
+                        nonce, parts, meas, proof, blind0
+                    )
+                    ok = np.ones(warm_batch, dtype=bool)
+                    part0_l = (
+                        part0
+                        if part0 is not None
+                        else np.zeros((warm_batch, 2), dtype=np.uint64)
+                    )
+                    eng.helper_init(nonce, parts, hseed, blind1, ver0, part0_l, ok)
+                    if task.vdaf.kind == "sparse_sumvec":
+                        # block-sparse tasks never dispatch the dense
+                        # aggregate: warm the gather/scatter program the
+                        # resident merge and the classic sparse path share
+                        # (compile_key ("scatter_merge", bucket)) —
+                        # aggregate_sparse is stateless, so no resident
+                        # slot is polluted (docs/ARCHITECTURE.md
+                        # "Block-sparse aggregation")
+                        from .vdaf.registry import circuit_for
+                        from .vdaf.testing import sparse_compact_batch
+                        from .vdaf.wire import flat_scatter_indices
+
+                        meas_pairs = random_measurements(task.vdaf, warm_batch, rng)
+                        _, block_idx = sparse_compact_batch(task.vdaf, meas_pairs)
+                        flat_idx = flat_scatter_indices(
+                            block_idx, circuit_for(task.vdaf)
+                        )
+                        eng.aggregate_sparse(out0, ok, flat_idx)
+                    else:
+                        eng.aggregate(out0, ok)
+                    result["warmed"].append((task.task_id, b))
+                    log.info(
+                        "warmed engines for task %s (%s) at bucket %d",
+                        task.task_id, task.vdaf.kind, b,
+                    )
+                except Exception:
+                    log.exception("engine warmup failed for task %s", task.task_id)
     return result
 
 

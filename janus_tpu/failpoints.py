@@ -66,6 +66,7 @@ to a no-op on every production hot path unless explicitly armed.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import random
@@ -263,6 +264,31 @@ def clear() -> None:
     configure(None)
 
 
+# Boot-warmup suppression (ISSUE 17): engine warmup dispatches are
+# infrastructure, not the serving path a chaos schedule drills. When a
+# binary boots with failpoints armed AND warmup_engines_at_boot, the
+# warmup's dispatches would otherwise consume `after=K` anchors and
+# `count=` budgets, shifting where a scheduled fault lands — the
+# suppression window keeps every site inert (hits not even counted) so
+# schedules stay anchored to SERVING dispatch counts. Process-global
+# on purpose: warm dispatches run on watchdog/lane worker threads, not
+# the caller's, and boot warmup completes before serving starts.
+_suppressed = 0
+
+
+@contextlib.contextmanager
+def suppressed():
+    """Context manager: every failpoint site is a no-op inside."""
+    global _suppressed
+    with _lock:
+        _suppressed += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _suppressed -= 1
+
+
 def status() -> dict:
     """Snapshot for /statusz: active failpoints with remaining budgets."""
     with _lock:
@@ -347,7 +373,7 @@ def hit(name: str, error_factory=None, timeout_factory=None) -> None:
     probability/budget and performs its action. `error_factory` /
     `timeout_factory` let the site raise its own realistic exception
     types for the error/timeout actions."""
-    if not ENABLED:
+    if not ENABLED or _suppressed:
         return
     fp = _lookup_and_arm(name)
     if fp is not None:
@@ -358,7 +384,7 @@ def hit_scoped(base: str, scope: str, error_factory=None, timeout_factory=None) 
     """Fire `base` and `base.scope` (e.g. `datastore.commit` and
     `datastore.commit.step_agg_job_write`) so schedules can target
     either every operation through a seam or one specific one."""
-    if not ENABLED:
+    if not ENABLED or _suppressed:
         return
     fp = _lookup_and_arm(base)
     if fp is not None:

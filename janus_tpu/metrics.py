@@ -756,6 +756,21 @@ engine_resident_flushes_total = REGISTRY.counter(
     'and outcome (outcome="flushed|lost|stale") — outcome="lost" means a '
     "fetched share could not be persisted and is gone; alert on any",
 )
+engine_scatter_rows_total = REGISTRY.counter(
+    "janus_engine_scatter_rows_total",
+    "verified sparse reports scatter-added into a dense logical "
+    "accumulator (resident scatter-merge or the classic sparse "
+    "aggregate), by VDAF kind — the block-sparse analogue of "
+    "aggregated rows; zero on a sparse task means the scatter path "
+    "never ran",
+)
+engine_sparse_block_occupancy = REGISTRY.gauge(
+    "janus_engine_sparse_block_occupancy",
+    "mean fraction of a sparse report's max_blocks block slots that "
+    "carried a real (non-padding) block in the most recent scatter "
+    "dispatch, by VDAF kind — near 1.0 means clients saturate the "
+    "compact encoding and the task geometry should grow max_blocks",
+)
 engine_prestage_total = REGISTRY.counter(
     "janus_engine_prestage_total",
     "double-buffered staging outcomes: a prestaged (async H2D during the "

@@ -64,6 +64,7 @@ from .reference import (
     Count,
     FixedPointVec,
     Histogram,
+    SparseSumVec,
     Sum,
     SumVec,
     next_pow2,
@@ -352,6 +353,10 @@ _ADAPTERS = {
     Count: BCount,
     Sum: BSum,
     SumVec: BSumVec,
+    # the sparse FLP is SumVec over the COMPACT encoding — the device
+    # prepare/verify legs reuse BSumVec verbatim; only aggregation
+    # differs (the scatter-merge kernel in aggregator.engine_cache)
+    SparseSumVec: BSumVec,
     Histogram: BHistogram,
     FixedPointVec: BFixedPointVec,
 }
@@ -512,7 +517,7 @@ def _chunked_X(bc: BatchedCircuit, inp_share):
 
 def flp_query_batched(bc: BatchedCircuit, inp_share, proof_share, query_rand, joint_rand, num_shares: int):
     """verifier share [batch, verifier_len] matching reference.flp_query."""
-    if _QUERY_MM and type(bc.circ) in (SumVec, Histogram):
+    if _QUERY_MM and type(bc.circ) in (SumVec, SparseSumVec, Histogram):
         return _flp_query_batched_mm(
             bc, inp_share, proof_share, query_rand, joint_rand, num_shares
         )
@@ -656,7 +661,7 @@ def stream_plan(
     import math
 
     circ = bc.circ
-    if type(circ) not in (SumVec, Histogram):
+    if type(circ) not in (SumVec, SparseSumVec, Histogram):
         return None
     if bc.jf.LIMBS != 2:
         return None  # block alignment below assumes 7 F128 elements/block

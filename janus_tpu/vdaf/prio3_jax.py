@@ -21,6 +21,7 @@ break the batch (SURVEY.md section 7 "Ragged/failure-laden batches").
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..fields.jfield import fmap
@@ -453,3 +454,41 @@ class Prio3Batched:
 
     def merge_agg_shares(self, a, b):
         return self.jf.add(a, b)
+
+    def scatter_rows(self, acc, values, flat_idx):
+        """Scatter-add each report's compact lanes into a dense logical
+        accumulator — the sparse-sumvec aggregation kernel (ISSUE 17).
+
+        acc: [L] logical accumulator (field limb tuple, L = logical
+        length); values: [b, cm] compact out-share rows; flat_idx:
+        [b, cm] int32 flat logical positions with DROPPED lanes (padding
+        blocks, rejected reports, other buckets) set to the
+        out-of-bounds sentinel L. The sentinel is POSITIVE on purpose:
+        a negative index would wrap under jnp gather/scatter semantics
+        and silently corrupt lane L-1.
+
+        A lax.scan over reports keeps peak memory at one report's
+        gather (a one-hot matmul would materialize [b, cm, L]); each
+        step is gather -> modular add -> unique-index scatter. Within a
+        report the valid flat indices are unique by construction (block
+        indices are validated strictly increasing), so the
+        gather/set pair is an exact modular scatter-ADD; cross-report
+        duplicates are handled by the scan's sequencing. Dropped lanes
+        read clamped garbage and then DROP the write (mode="drop"), so
+        they contribute nothing. Field-element identical to
+        reference.Prio3Sparse.aggregate_sparse over the same rows.
+        """
+        jf = self.jf
+
+        def step(carry, xs):
+            ix = xs[-1]
+            v = tuple(xs[:-1])
+            cur = tuple(x[ix] for x in carry)
+            s = jf.add(cur, v)
+            new = tuple(
+                c.at[ix].set(sv, mode="drop") for c, sv in zip(carry, s)
+            )
+            return new, None
+
+        acc, _ = jax.lax.scan(step, acc, (*values, flat_idx))
+        return acc

@@ -396,6 +396,166 @@ def neg_share_const(field: type[Field], shares_inv: int) -> int:
     return shares_inv
 
 
+def validate_block_indices(indices, n_logical_blocks: int, max_blocks: int) -> str | None:
+    """The sparse block-index predicate (PREAMBLE-style compact
+    encoding, PAPERS.md arXiv:2503.11897): indices are PUBLIC — both
+    aggregators validate the same deterministic predicate on the same
+    bytes, which is exactly as binding as proving it in the FLP would
+    be (there is nothing secret to prove about public data). Rules:
+
+      * exactly `max_blocks` entries;
+      * each entry is either −1 (a padding lane) or in
+        [0, n_logical_blocks);
+      * the non-padding prefix is STRICTLY increasing (no duplicates,
+        no descending runs — a duplicate index would let one report
+        scatter twice into the same logical block);
+      * once a padding lane appears, every later lane must also be
+        padding (the compact layout is front-packed).
+
+    Returns None when valid, else a short reason string.
+    """
+    indices = list(indices)
+    if len(indices) != max_blocks:
+        return f"expected {max_blocks} block indices, got {len(indices)}"
+    prev = -1
+    padding = False
+    for t, ix in enumerate(indices):
+        if ix == -1:
+            padding = True
+            continue
+        if padding:
+            return f"block index at lane {t} follows a padding lane"
+        if not 0 <= ix < n_logical_blocks:
+            return f"block index {ix} at lane {t} out of range [0, {n_logical_blocks})"
+        if ix <= prev:
+            return (
+                f"block index {ix} at lane {t} not strictly increasing "
+                f"(previous {prev})"
+            )
+        prev = ix
+    return None
+
+
+class SparsePublicShare(list):
+    """Public share of a sparse report: the joint-randomness parts (the
+    list payload, so every parts-only consumer — `list(public_share)`,
+    unpacking, np.stack of the elements — keeps working) PLUS the
+    public block indices. Carried intact from wire decode to the
+    accumulate stage; the indices never enter the FLP."""
+
+    __slots__ = ("indices",)
+
+    def __init__(self, parts, indices):
+        super().__init__(parts)
+        self.indices = tuple(int(i) for i in indices)
+
+
+class SparseSumVec(SumVec):
+    """Block-sparse vector sum: a logical `length`-dim vector carried
+    as up to `max_blocks` (block_index, dense `block_size`-value block)
+    pairs (the PREAMBLE compact encoding, PAPERS.md arXiv:2503.11897).
+
+    The FLP runs ENTIRELY at the compact length `max_blocks *
+    block_size` — it is a plain SumVec bit-range check over the packed
+    block values, so proof size and prepare cost scale with nonzeros,
+    never the logical dimension. Block indices are PUBLIC (the
+    documented PREAMBLE trade-off: the sparsity PATTERN leaks to the
+    aggregators while every value stays secret-shared) and are
+    validated by `validate_block_indices` at wire decode and
+    prepare-init on both aggregators. Padding lanes carry index −1 and
+    all-zero values; the zero values pass the bit check and scatter
+    nothing.
+
+    Aggregation is the part that differs: an output share is compact,
+    and aggregating means SCATTERING each report's blocks into a dense
+    logical accumulator by block index (`agg_output_len` =
+    `length`) — the engine's scatter-merge kernel on device,
+    `Prio3Sparse.aggregate_sparse` on the host."""
+
+    algo_id = 0x000000F2  # outside the draft-registry range: janus_tpu extension
+
+    def __init__(
+        self,
+        length: int,
+        block_size: int,
+        max_blocks: int,
+        bits: int,
+        chunk_length: int | None = None,
+    ):
+        if length <= 0 or block_size <= 0 or max_blocks <= 0:
+            raise ValueError("sparse_sumvec geometry must be positive")
+        if length % block_size:
+            raise ValueError(
+                f"logical length {length} must be a multiple of block_size {block_size}"
+            )
+        self.logical_length = length
+        self.block_size = block_size
+        self.max_blocks = max_blocks
+        self.n_logical_blocks = length // block_size
+        if max_blocks > self.n_logical_blocks:
+            raise ValueError(
+                f"max_blocks {max_blocks} exceeds the {self.n_logical_blocks} "
+                "logical blocks"
+            )
+        super().__init__(length=max_blocks * block_size, bits=bits, chunk_length=chunk_length)
+
+    # dense aggregate/unshard length: the logical dimension, not the
+    # compact FLP width (Prio3.aggregate/unshard read this; the base
+    # Circuit default is output_len)
+    @property
+    def agg_output_len(self) -> int:
+        return self.logical_length
+
+    def encode(self, measurement):
+        """measurement: iterable of (block_index, block_values) pairs,
+        block indices strictly increasing. Returns the COMPACT bit
+        encoding (front-packed blocks, zero padding)."""
+        values, indices = self.compact_values(measurement)
+        del indices
+        return super().encode(values)
+
+    def compact_values(self, measurement):
+        """(compact value row of `max_blocks * block_size` ints,
+        front-packed indices of `max_blocks` ints with −1 padding)."""
+        pairs = sorted((int(ix), list(vals)) for ix, vals in measurement)
+        if len(pairs) > self.max_blocks:
+            raise ValueError(f"more than {self.max_blocks} blocks")
+        indices = [ix for ix, _ in pairs] + [-1] * (self.max_blocks - len(pairs))
+        reason = validate_block_indices(indices, self.n_logical_blocks, self.max_blocks)
+        if reason is not None:
+            raise ValueError(reason)
+        values = []
+        for ix, vals in pairs:
+            if len(vals) != self.block_size:
+                raise ValueError(
+                    f"block {ix} has {len(vals)} values, expected {self.block_size}"
+                )
+            values.extend(int(v) for v in vals)
+        values.extend([0] * (self.block_size * (self.max_blocks - len(pairs))))
+        return values, indices
+
+    def encode_indices(self, measurement):
+        """Front-packed public block indices (−1 padding) for the wire
+        public share."""
+        _, indices = self.compact_values(measurement)
+        return indices
+
+    def expand(self, indices, compact_row):
+        """Scatter one compact row (length `max_blocks * block_size`)
+        to the logical vector by its public indices — the host oracle
+        for the device scatter kernel."""
+        out = [0] * self.logical_length
+        F = self.FIELD
+        for t, ix in enumerate(indices):
+            if ix == -1:
+                continue
+            base = ix * self.block_size
+            seg = compact_row[t * self.block_size : (t + 1) * self.block_size]
+            for o, v in enumerate(seg):
+                out[base + o] = F.add(out[base + o], v)
+        return out
+
+
 class Histogram(Circuit):
     """One-hot vector of `length` buckets.
 
@@ -915,16 +1075,24 @@ class Prio3:
         return state.out_share
 
     # --- aggregation / unsharding ---
+    # aggregate/unshard run at the circuit's DENSE aggregate length:
+    # output_len for every dense kind, the logical length for sparse
+    # circuits (SparseSumVec.agg_output_len) whose aggregate shares are
+    # scattered to the logical dimension before they reach here
+    @property
+    def agg_output_len(self) -> int:
+        return getattr(self.circuit, "agg_output_len", self.circuit.output_len)
+
     def aggregate(self, out_shares: list[list[int]]) -> list[int]:
         F = self.circuit.FIELD
-        agg = [0] * self.circuit.output_len
+        agg = [0] * self.agg_output_len
         for s in out_shares:
             agg = [F.add(a, b) for a, b in zip(agg, s)]
         return agg
 
     def unshard(self, agg_shares: list[list[int]], num_measurements: int):
         F = self.circuit.FIELD
-        agg = [0] * self.circuit.output_len
+        agg = [0] * self.agg_output_len
         for s in agg_shares:
             agg = [F.add(a, b) for a, b in zip(agg, s)]
         return self.circuit.decode(agg, num_measurements)
@@ -953,6 +1121,52 @@ class Prio3:
 
     def _encode_vec(self, vec: list[int]) -> bytes:
         return self.circuit.FIELD.encode_vec(vec)
+
+
+class Prio3Sparse(Prio3):
+    """Host Prio3 over a SparseSumVec circuit. The FLP legs are the
+    plain compact-length Prio3; what changes is the PUBLIC SHARE (it
+    carries the block indices alongside the joint-randomness parts)
+    and aggregation (compact out shares scatter to the logical
+    dimension by those indices)."""
+
+    def shard(self, measurement, nonce: bytes, rand: bytes | None = None):
+        indices = self.circuit.encode_indices(measurement)
+        parts, shares = super().shard(measurement, nonce, rand)
+        return SparsePublicShare(parts, indices), shares
+
+    def prepare_init(self, verify_key, agg_id, nonce, public_share, input_share):
+        # wire decode already validated client-originated indices; a
+        # direct caller (tests, fuzz) still gets the same predicate
+        if isinstance(public_share, SparsePublicShare):
+            reason = validate_block_indices(
+                public_share.indices,
+                self.circuit.n_logical_blocks,
+                self.circuit.max_blocks,
+            )
+            if reason is not None:
+                raise VdafError(f"invalid sparse block indices: {reason}")
+        return super().prepare_init(
+            verify_key, agg_id, nonce, list(public_share), input_share
+        )
+
+    def aggregate_sparse(self, pairs) -> list[int]:
+        """Aggregate [(indices, compact_out_share)] pairs into one
+        LOGICAL-length aggregate share (the host oracle for the device
+        scatter-merge kernel)."""
+        circ = self.circuit
+        F = circ.FIELD
+        agg = [0] * circ.logical_length
+        for indices, out_share in pairs:
+            row = circ.expand(indices, out_share)
+            agg = [F.add(a, b) for a, b in zip(agg, row)]
+        return agg
+
+    def aggregate(self, out_shares):
+        raise VdafError(
+            "sparse aggregation needs the public block indices: use "
+            "aggregate_sparse([(indices, out_share), ...])"
+        )
 
 
 class VdafError(Exception):

@@ -18,6 +18,8 @@ from .reference import (
     FixedPointVec,
     Histogram,
     Prio3,
+    Prio3Sparse,
+    SparseSumVec,
     Sum,
     SumVec,
     optimal_chunk_length,
@@ -30,10 +32,18 @@ VERIFY_KEY_LENGTH = 16  # reference core/src/task.rs:15
 class VdafInstance:
     """One VDAF configuration; hashable so dispatch results are cached."""
 
-    kind: str  # "count" | "sum" | "sumvec" | "histogram" | "fixedpoint" | "countvec"
+    kind: str  # "count" | "sum" | "sumvec" | "sparse_sumvec" | "histogram" | ...
     bits: int = 0
     length: int = 0
     chunk_length: int = 0  # 0 -> sqrt heuristic (core/src/task.rs:84-86)
+    # block-sparse geometry (kind == "sparse_sumvec" only): the logical
+    # vector is `length`-dim, a report carries up to `max_blocks` dense
+    # blocks of `block_size` values. Serialized by to_dict whenever
+    # nonzero — these fields are part of every shape-manifest / AOT /
+    # prewarm key derived from the instance, so a sparse geometry can
+    # never collide with a dense one at the same compact width.
+    block_size: int = 0
+    max_blocks: int = 0
     # XOF framing mode: "fast" = TPU counter-mode framing (default;
     # SECURITY-NOTES.md), "draft" = VDAF-07 sequential-sponge framing
     # (host-only, for spec conformance / cross-implementation pairing).
@@ -54,6 +64,29 @@ class VdafInstance:
     @classmethod
     def sum_vec(cls, length: int, bits: int, chunk_length: int = 0) -> "VdafInstance":
         return cls("sumvec", bits=bits, length=length, chunk_length=chunk_length)
+
+    @classmethod
+    def sparse_sumvec(
+        cls,
+        bits: int,
+        length: int,
+        block_size: int,
+        max_blocks: int,
+        chunk_length: int = 0,
+    ) -> "VdafInstance":
+        """Block-sparse vector sum (ISSUE 17): a logical `length`-dim
+        vector carried as up to `max_blocks` (block_index, dense
+        `block_size`-value block) pairs. The FLP runs at the compact
+        length `max_blocks * block_size`; aggregation scatters into a
+        dense logical accumulator by the PUBLIC block indices."""
+        return cls(
+            "sparse_sumvec",
+            bits=bits,
+            length=length,
+            chunk_length=chunk_length,
+            block_size=block_size,
+            max_blocks=max_blocks,
+        )
 
     @classmethod
     def histogram(cls, length: int, chunk_length: int = 0) -> "VdafInstance":
@@ -144,7 +177,7 @@ class VdafInstance:
 
     def to_dict(self) -> dict:
         d = {"kind": self.kind}
-        for k in ("bits", "length", "chunk_length"):
+        for k in ("bits", "length", "chunk_length", "block_size", "max_blocks"):
             if getattr(self, k):
                 d[k] = getattr(self, k)
         if self.xof_mode != "fast":
@@ -159,6 +192,8 @@ class VdafInstance:
             length=d.get("length", 0),
             chunk_length=d.get("chunk_length", 0),
             xof_mode=d.get("xof_mode", "fast"),
+            block_size=d.get("block_size", 0),
+            max_blocks=d.get("max_blocks", 0),
         )
 
 
@@ -172,6 +207,14 @@ def circuit_for(inst: VdafInstance) -> Circuit:
         return Sum(bits=inst.bits)
     if inst.kind == "sumvec":
         return SumVec(length=inst.length, bits=inst.bits, chunk_length=ch)
+    if inst.kind == "sparse_sumvec":
+        return SparseSumVec(
+            length=inst.length,
+            block_size=inst.block_size,
+            max_blocks=inst.max_blocks,
+            bits=inst.bits,
+            chunk_length=ch,
+        )
     if inst.kind == "histogram":
         return Histogram(length=inst.length, chunk_length=ch)
     if inst.kind == "countvec":
@@ -192,6 +235,8 @@ def circuit_for(inst: VdafInstance) -> Circuit:
 @lru_cache(maxsize=None)
 def prio3_host(inst: VdafInstance) -> Prio3:
     """Host (scalar) implementation: clients, tools, oracles."""
+    if inst.kind == "sparse_sumvec":
+        return Prio3Sparse(circuit_for(inst), mode=inst.xof_mode)
     return Prio3(circuit_for(inst), mode=inst.xof_mode)
 
 

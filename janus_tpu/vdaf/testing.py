@@ -22,6 +22,22 @@ def random_measurements(inst: VdafInstance, batch: int, rng: np.random.Generator
     if inst.kind == "sumvec":
         hi = min(inst.bits, 62)
         return rng.integers(0, 1 << hi, size=(batch, inst.length))
+    if inst.kind == "sparse_sumvec":
+        # per-report list of (block_index, dense block) pairs, sorted by
+        # index — the sparse measurement currency (vdaf.reference)
+        hi = min(inst.bits, 62)
+        n_blocks = inst.length // inst.block_size
+        out = []
+        for _ in range(batch):
+            nb = int(rng.integers(1, inst.max_blocks + 1))
+            idxs = sorted(rng.choice(n_blocks, size=nb, replace=False).tolist())
+            out.append(
+                [
+                    (int(b), [int(v) for v in rng.integers(0, 1 << hi, size=inst.block_size)])
+                    for b in idxs
+                ]
+            )
+        return out
     if inst.kind == "histogram":
         return rng.integers(0, inst.length, size=batch)
     if inst.kind == "countvec":
@@ -32,6 +48,26 @@ def random_measurements(inst: VdafInstance, batch: int, rng: np.random.Generator
         hi = max(1, int(offset / (inst.length**0.5)) // 2)
         return rng.integers(-hi, hi, size=(batch, inst.length))
     raise ValueError(inst.kind)
+
+
+def sparse_compact_batch(inst: VdafInstance, measurements):
+    """Convert sparse pair-measurements to the device currency:
+    ([batch, compact_len] uint64 compact value rows, [batch, max_blocks]
+    int32 block indices, -1 padding). The value rows feed the batched
+    engine exactly like dense SumVec rows; the indices ride the public
+    share / scatter path."""
+    from .registry import circuit_for
+
+    circ = circuit_for(inst)
+    vals, idxs = [], []
+    for m in measurements:
+        v, ix = circ.compact_values(m)
+        vals.append(v)
+        idxs.append(list(ix))
+    return (
+        np.asarray(vals, dtype=np.uint64),
+        np.asarray(idxs, dtype=np.int32),
+    )
 
 
 def make_wire_reports(
@@ -65,6 +101,11 @@ def make_wire_reports(
 
     p3 = prio3_batched(inst)
     wire = Prio3Wire(circuit_for(inst))
+    sparse = inst.kind == "sparse_sumvec"
+    if sparse:
+        from .reference import SparsePublicShare
+
+        _, block_idx = sparse_compact_batch(inst, measurements)
     args, _ = make_report_batch(inst, measurements, seed=seed)
     nonce_lanes, public_parts, leader_meas, leader_proof, blind0, helper_seed, blind1 = args
     n = nonce_lanes.shape[0]
@@ -81,15 +122,21 @@ def make_wire_reports(
         report_id = ReportId(nonce_lanes[i].astype("<u8").tobytes())
         metadata = ReportMetadata(report_id, time)
         if p3.uses_joint_rand:
-            public_share = wire.encode_public_share(list(part_rows[i]))
+            parts = list(part_rows[i])
             leader_payload = wire.encode_leader_share_raw(
                 meas_rows[i] + proof_rows[i], blind0_rows[i]
             )
             helper_payload = wire.encode_helper_share(seed_rows[i], blind1_rows[i])
         else:
-            public_share = b""
+            parts = []
             leader_payload = meas_rows[i] + proof_rows[i]
             helper_payload = wire.encode_helper_share(seed_rows[i], None)
+        if sparse:
+            public_share = wire.encode_public_share(SparsePublicShare(parts, block_idx[i]))
+        elif p3.uses_joint_rand:
+            public_share = wire.encode_public_share(parts)
+        else:
+            public_share = b""
         aad = InputShareAad(task_id, metadata, public_share).to_bytes()
         leader_ct = hpke_seal(
             leader_hpke_config,
@@ -129,7 +176,14 @@ def make_report_batch(inst: VdafInstance, measurements, seed: int = 0, shard_chu
     rand_lanes = rng.integers(0, 1 << 63, size=(batch, n_seeds, 2), dtype=np.uint64)
 
     def shard_slice(lo: int, hi: int):
-        inp_np = p3.bc.encode_batch(measurements[lo:hi])
+        if inst.kind == "sparse_sumvec":
+            # the device engine runs the COMPACT encoding: convert pair
+            # measurements to compact value rows (the engine never sees
+            # the logical length; indices ride the public share)
+            vals, _ = sparse_compact_batch(inst, measurements[lo:hi])
+            inp_np = p3.bc.encode_batch(vals)
+        else:
+            inp_np = p3.bc.encode_batch(measurements[lo:hi])
         inp = p3.jf.from_ints(inp_np.astype(object))
         return p3.shard_jit(inp, nonce_lanes[lo:hi], rand_lanes[lo:hi])
 

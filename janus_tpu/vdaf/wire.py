@@ -36,9 +36,89 @@ from ..messages.codec import (
     Encoder,
 )
 from .prio3_jax import Prio3Batched
-from .reference import Circuit
+from .reference import (
+    Circuit,
+    SparsePublicShare,
+    SparseSumVec,
+    validate_block_indices,
+)
 
 SEED_SIZE = 16
+
+# sparse block indices on the wire: one big-endian u32 per lane,
+# 0xFFFFFFFF encoding the padding index -1
+IDX_ENC_SIZE = 4
+IDX_PADDING = 0xFFFFFFFF
+
+
+def encode_block_indices(indices) -> bytes:
+    """Front-packed block indices (-1 padding) -> the public-share
+    prefix blob."""
+    out = bytearray()
+    for ix in indices:
+        out += (IDX_PADDING if int(ix) == -1 else int(ix)).to_bytes(4, "big")
+    return bytes(out)
+
+
+def decode_block_indices(blob: bytes, circ: "SparseSumVec") -> tuple[int, ...]:
+    """Reference decoder for the index blob: parse + the full
+    `validate_block_indices` predicate. Raises DecodeError — the
+    existing per-report/per-lane rejection plumbing at every
+    decode_public_share call site (upload, leader staging, helper
+    aggregate-init) handles sparse index rejection with no new code."""
+    if len(blob) != circ.max_blocks * IDX_ENC_SIZE:
+        raise DecodeError("bad sparse index blob length")
+    raw = np.frombuffer(blob, dtype=">u4")
+    indices = [-1 if int(v) == IDX_PADDING else int(v) for v in raw]
+    reason = validate_block_indices(indices, circ.n_logical_blocks, circ.max_blocks)
+    if reason is not None:
+        raise DecodeError(f"invalid sparse block indices: {reason}")
+    return tuple(indices)
+
+
+def decode_index_columns(rows: list[bytes | None], circ: "SparseSumVec"):
+    """Vectorized fast path of `decode_block_indices` over a batch of
+    raw PUBLIC SHARE rows: -> ([n, max_blocks] int32 block indices
+    (padding -1), ok mask). A row failing any predicate gets False and
+    all-padding indices, landing the rejection on exactly that lane.
+    Bit-equivalent to the reference decoder per row (pinned by the
+    reject-divergence fuzz in tests/test_sparse_vdaf.py)."""
+    n = len(rows)
+    mb = circ.max_blocks
+    blob_len = mb * IDX_ENC_SIZE
+    lanes = np.zeros((n, mb), dtype=np.int64)
+    ok = np.zeros(n, dtype=bool)
+    for i, row in enumerate(rows):
+        if row is None or len(row) < blob_len:
+            continue
+        lanes[i] = np.frombuffer(row[:blob_len], dtype=">u4").astype(np.int64)
+        ok[i] = True
+    pad = lanes == IDX_PADDING
+    lanes = np.where(pad, np.int64(-1), lanes)
+    in_range = pad | ((lanes >= 0) & (lanes < circ.n_logical_blocks))
+    ok &= in_range.all(axis=1)
+    if mb > 1:
+        # strictly increasing over the non-padding prefix, and padding
+        # only ever followed by padding
+        both = ~pad[:, 1:] & ~pad[:, :-1]
+        ok &= (~both | (lanes[:, 1:] > lanes[:, :-1])).all(axis=1)
+        ok &= (~pad[:, :-1] | pad[:, 1:]).all(axis=1)
+    lanes[~ok] = -1
+    return lanes.astype(np.int32), ok
+
+
+def flat_scatter_indices(block_idx: np.ndarray, circ: "SparseSumVec") -> np.ndarray:
+    """[n, max_blocks] block indices -> [n, compact_len] int32 flat
+    logical positions for the engine scatter kernel. Padding/rejected
+    lanes map to the out-of-bounds sentinel `logical_length` (POSITIVE
+    on purpose: a negative index would wrap under jnp scatter indexing
+    instead of dropping)."""
+    bs = circ.block_size
+    L = circ.logical_length
+    bi = np.asarray(block_idx, dtype=np.int64)
+    flat = bi[:, :, None] * bs + np.arange(bs, dtype=np.int64)[None, None, :]
+    flat = np.where(bi[:, :, None] < 0, np.int64(L), flat)
+    return flat.reshape(bi.shape[0], -1).astype(np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -242,6 +322,11 @@ class Prio3Wire:
         self.circ = circ
         self.enc_size = circ.FIELD.ENCODED_SIZE
         self.uses_jr = circ.joint_rand_len > 0
+        # sparse circuits prefix the public share with the PUBLIC block
+        # indices (PREAMBLE trade-off: the sparsity pattern is
+        # aggregator-visible; values stay secret-shared)
+        self.sparse = isinstance(circ, SparseSumVec)
+        self.idx_len = circ.max_blocks * IDX_ENC_SIZE if self.sparse else 0
 
     # sizes
     @property
@@ -255,7 +340,7 @@ class Prio3Wire:
 
     @property
     def public_share_len(self) -> int:
-        return 2 * SEED_SIZE if self.uses_jr else 0
+        return self.idx_len + (2 * SEED_SIZE if self.uses_jr else 0)
 
     @property
     def prep_share_len(self) -> int:
@@ -313,11 +398,25 @@ class Prio3Wire:
         return raw[:SEED_SIZE], (raw[SEED_SIZE:] if self.uses_jr else None)
 
     def encode_public_share(self, parts: list[bytes]) -> bytes:
+        if self.sparse:
+            indices = getattr(parts, "indices", None)
+            if indices is None:
+                raise ValueError(
+                    "sparse public share needs block indices: pass the "
+                    "SparsePublicShare from Prio3Sparse.shard"
+                )
+            blob = encode_block_indices(indices)
+            return blob + (b"".join(parts) if self.uses_jr else b"")
         return b"".join(parts) if self.uses_jr else b""
 
     def decode_public_share(self, raw: bytes) -> list[bytes]:
         if len(raw) != self.public_share_len:
             raise DecodeError("bad public share length")
+        if self.sparse:
+            indices = decode_block_indices(raw[: self.idx_len], self.circ)
+            rest = raw[self.idx_len :]
+            parts = [rest[:SEED_SIZE], rest[SEED_SIZE:]] if self.uses_jr else []
+            return SparsePublicShare(parts, indices)
         if not self.uses_jr:
             return []
         return [raw[:SEED_SIZE], raw[SEED_SIZE:]]

@@ -1720,11 +1720,13 @@ def run_pipeline(
         helper_ds.close()
 
 
-# --scenario resident: the first four device dispatches (two tasks x
-# leader_init + masked-delta) land clean, the FIFTH wedges forever —
-# quarantining the engine while earlier jobs' aggregate state sits
-# resident in device memory; two canary probes fail to hold the
-# quarantine window open long enough to observe the flush live
+# --scenario resident: the first four SERVING device dispatches (two
+# count tasks x leader_init + masked-delta) land clean, the FIFTH
+# wedges forever — quarantining the engine while earlier jobs'
+# aggregate state sits resident in device memory; two canary probes
+# fail to hold the quarantine window open long enough to observe the
+# flush live. The driver's boot warmup dispatches don't shift the
+# anchor: warmup runs under failpoints.suppressed()
 RESIDENT_SCHEDULE = "engine.dispatch=hang,count=1,after=4;engine.canary=error:1.0,count=2"
 
 
@@ -1749,9 +1751,18 @@ def run_resident(
          re-steps through the interim host engine;
       3. after the canary restores the device path, one more task-A
          job lands resident; SIGTERM drains it through the write-tx
-         path (drain contract) and the final collections equal BOTH
+         path (drain contract) and the final collections equal ALL
          tasks' admitted ground truths exactly — no share bytes lost
          across eviction, quarantine, or drain.
+
+    A block-sparse sumvec task ("s", ISSUE 17) rides the same run: its
+    first wave uploads inside the quarantine window (the sparse engine
+    keeps dispatching while the count engine is wedged), its logical
+    len-48 slot always overflows the 8-byte cap so every merge exits
+    through the eviction flush, a second wave rides the restore->drain
+    window, and its collection must equal the dense expansion of the
+    admitted (block, values) pairs exactly — with the scatter row
+    counter proving the gather/scatter kernel carried the deltas.
 
     wave_sizes: (task A wave 1, task B wave 1, task A hang wave,
     task A drain wave). Every `*_ok` key must be True to pass."""
@@ -1804,11 +1815,22 @@ def run_resident(
         ).start()
 
         vdaf = VdafInstance.count()
+        # ISSUE 17: a block-sparse task rides the same chaos phases as
+        # the count tasks — its 768-byte slot always overflows the
+        # 8-byte cap, so every merge exits through the eviction flush
+        # path, and collection must still be exact
+        sparse_vdaf = VdafInstance.sparse_sumvec(
+            bits=3, length=48, block_size=4, max_blocks=3
+        )
         tasks = {}
-        for name, cfg_id in (("a", 210), ("b", 211)):
+        for name, cfg_id, task_vdaf in (
+            ("a", 210, vdaf),
+            ("b", 211, vdaf),
+            ("s", 212, sparse_vdaf),
+        ):
             collector_kp = generate_hpke_config_and_private_key(config_id=cfg_id)
             leader_task = (
-                TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+                TaskBuilder(QueryTypeConfig.time_interval(), task_vdaf, Role.LEADER)
                 .with_(
                     leader_aggregator_endpoint=leader_srv.url,
                     helper_aggregator_endpoint=helper_srv.url,
@@ -1826,8 +1848,14 @@ def run_resident(
             )
             leader_ds.run_tx(lambda tx, t=leader_task: tx.put_task(t), "provision")
             helper_ds.run_tx(lambda tx, t=helper_task: tx.put_task(t), "provision")
-            tasks[name] = (leader_task, collector_kp)
-        enable_compile_cache()
+            tasks[name] = (leader_task, collector_kp, task_vdaf)
+        # warm into the DRIVER's default persistent cache dir (NOT
+        # enable_compile_cache's own default — a different path) so the
+        # subprocess loads compiled programs from disk instead of
+        # paying cold compiles against the lease watchdog: the sparse
+        # leader_init compile alone (~15 s on CPU) would wedge past the
+        # 6 s budget and spuriously quarantine the sparse engine
+        enable_compile_cache(os.path.expanduser("~/.cache/janus_tpu_xla"))
         warmup_engines(leader_ds)
 
         creator = AggregationJobCreator(
@@ -1836,16 +1864,16 @@ def run_resident(
                 min_aggregation_job_size=1, max_aggregation_job_size=100
             ),
         )
-        truth = {"a": [], "b": []}
+        truth = {"a": [], "b": [], "s": []}
 
         def upload(task_name: str, measurements) -> None:
-            leader_task, _ = tasks[task_name]
+            leader_task, _, task_vdaf = tasks[task_name]
             http = HttpClient()
             params = ClientParameters(
                 leader_task.task_id, leader_srv.url, helper_srv.url,
                 leader_task.time_precision,
             )
-            client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+            client = Client.with_fetched_configs(params, task_vdaf, http, clock=clock)
             for m in measurements:
                 client.upload(m)
             truth[task_name].extend(measurements)
@@ -1880,6 +1908,12 @@ def run_resident(
                 "  flush_interval_secs: 3600\n"
                 "engine:\n"
                 "  resident_max_bytes: 8\n"  # exactly ONE count slot
+                # blocking engine warmup BEFORE the health listener: the
+                # sparse leader_init/scatter compiles must not race the
+                # lease watchdog mid-phase (the in-process warmup above
+                # seeds the shared compile cache, so boot pays disk
+                # loads, not cold compiles)
+                "warmup_engines_at_boot: true\n"
             ),
         )
         drv = _spawn_driver(
@@ -1942,6 +1976,26 @@ def run_resident(
             sum(v for k, v in step_backs.items() if "device_hang" in k) >= 1
         )
 
+        # --- sparse wave 1: uploaded inside the quarantine window (the
+        # count engine is still wedged; the sparse engine dispatches on
+        # its own device path).  Its 768-byte slot overflows the 8-byte
+        # cap at merge time, so the state exits through the EVICTION
+        # flush — observed via the flush counter delta plus the scatter
+        # row counter proving the gather/scatter kernel ran (ISSUE 17)
+        pre_sparse_evictions = flush_samples(_scrape(port, "/metrics")).get(
+            'outcome="flushed",reason="eviction"', 0
+        )
+        upload(
+            "s",
+            [
+                [(0, [1, 2, 3, 4]), (5, [7, 0, 1, 2])],
+                [(0, [0, 1, 0, 1]), (3, [2, 2, 2, 2]), (11, [5, 0, 0, 6])],
+            ],
+        )
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and finished_jobs() < 4:
+            time.sleep(0.05)
+
         # --- phase 3: canary restores the device path; one more job
         # lands resident and SIGTERM drains it ------------------------
         restore_deadline = time.monotonic() + 90
@@ -1953,11 +2007,19 @@ def run_resident(
                 break
             time.sleep(0.1)
         result["restored_ok"] = backend.get('state="device",vdaf="count"') == 1.0
+        # sparse wave 2 rides the restore->drain window; it merges (and
+        # self-evicts through the flush path) BEFORE task A's final job
+        # lands resident, so the LRU sweep cannot evict A's slot and
+        # the drain contract below stays deterministic
+        upload("s", [[(2, [1, 0, 0, 3]), (7, [0, 4, 0, 0])]])
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and finished_jobs() < 5:
+            time.sleep(0.05)
         upload("a", [0, 1, 1][: wave_sizes[3]] or [1])
         resident_before_drain = 0
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
-            if finished_jobs() >= 4:
+            if finished_jobs() >= 6:
                 statusz = json.loads(_scrape(port, "/statusz"))
                 ra = statusz.get("resident_accumulators", {})
                 resident_before_drain = sum(
@@ -1974,6 +2036,23 @@ def run_resident(
         result["flush_samples"] = samples
         result["no_lost_flushes_ok"] = not any(
             'outcome="lost"' in k and v > 0 for k, v in samples.items()
+        )
+        # sparse ride-along (ISSUE 17), judged cumulatively before the
+        # drain: the count choreography contributes exactly ONE
+        # eviction flush, so any excess over the pre-sparse count is
+        # the sparse slot exiting through the eviction path, and the
+        # scatter row counter proves the gather/scatter kernel (not a
+        # dense or host detour) carried the sparse deltas
+        scatter_samples = _metric_samples(
+            mtext, "janus_engine_scatter_rows_total"
+        )
+        result["sparse_scatter_rows"] = sum(scatter_samples.values())
+        result["sparse_scatter_observed_ok"] = (
+            scatter_samples.get('vdaf="sparse_sumvec"', 0) > 0
+        )
+        result["sparse_eviction_flush_ok"] = (
+            samples.get('outcome="flushed",reason="eviction"', 0)
+            > pre_sparse_evictions
         )
         hd = _metric_samples(mtext, "janus_engine_hd_bytes_total")
         result["hd_bytes"] = hd
@@ -2006,8 +2085,8 @@ def run_resident(
         ct = threading.Thread(target=collect_loop, daemon=True)
         ct.start()
         try:
-            for name in ("a", "b"):
-                leader_task, collector_kp = tasks[name]
+            for name in ("a", "b", "s"):
+                leader_task, collector_kp, task_vdaf = tasks[name]
                 collector = Collector(
                     CollectorParameters(
                         leader_task.task_id,
@@ -2015,7 +2094,7 @@ def run_resident(
                         leader_task.collector_auth_token,
                         collector_kp,
                     ),
-                    vdaf,
+                    task_vdaf,
                     HttpClient(),
                 )
                 tp = leader_task.time_precision
@@ -2024,14 +2103,25 @@ def run_resident(
                     Interval(Time(start.seconds - tp.seconds), Duration(3 * tp.seconds))
                 )
                 collected = collector.collect(query, timeout_s=120.0)
+                if name == "s":
+                    # ground truth at the LOGICAL length: expand every
+                    # (block, values) pair onto the dense vector
+                    want = [0] * sparse_vdaf.length
+                    for m in truth["s"]:
+                        for blk, vals in m:
+                            for j, v in enumerate(vals):
+                                want[blk * sparse_vdaf.block_size + j] += v
+                    got = list(collected.aggregate_result)
+                else:
+                    want = sum(truth[name])
+                    got = collected.aggregate_result
                 result[f"collected_count_{name}"] = collected.report_count
-                result[f"collected_sum_{name}"] = collected.aggregate_result
+                result[f"collected_sum_{name}"] = got
                 result[f"exactly_once_{name}_ok"] = (
-                    collected.report_count == len(truth[name])
-                    and collected.aggregate_result == sum(truth[name])
+                    collected.report_count == len(truth[name]) and got == want
                 )
                 result[f"admitted_{name}"] = len(truth[name])
-                result[f"ground_truth_sum_{name}"] = sum(truth[name])
+                result[f"ground_truth_sum_{name}"] = want
         finally:
             stop_collect.set()
             ct.join(timeout=10)

@@ -154,6 +154,10 @@ def main(argv=None) -> int:
         "janus_mesh_dispatch_queue_depth",
         "janus_mesh_dispatch_wait_seconds",
         "janus_mesh_dispatch_busy_seconds_total",
+        # block-sparse scatter-merge (ISSUE 17) — registered at import
+        # in every binary, so absence is a deploy regression
+        "janus_engine_scatter_rows_total",
+        "janus_engine_sparse_block_occupancy",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -218,9 +222,19 @@ def main(argv=None) -> int:
                 if not isinstance(ra, dict):
                     errors.append("/statusz missing the resident_accumulators section")
                 else:
-                    for key in ("total_bytes", "max_bytes", "cross_task_coalesce", "engines"):
+                    # `sparse` rides the section unconditionally (ISSUE
+                    # 17): the process-wide scatter-merge rollup must be
+                    # present even with zero sparse engines provisioned
+                    for key in ("total_bytes", "max_bytes", "cross_task_coalesce", "sparse", "engines"):
                         if key not in ra:
                             errors.append(f"/statusz resident_accumulators missing {key!r}")
+                    sp = ra.get("sparse")
+                    if isinstance(sp, dict):
+                        for key in ("engines", "scatter_rows"):
+                            if key not in sp:
+                                errors.append(
+                                    f"/statusz resident_accumulators sparse missing {key!r}"
+                                )
                     for ent in ra.get("engines", []) or []:
                         for key in ("vdaf", "buffers", "bytes", "merges", "evictions"):
                             if key not in ent:

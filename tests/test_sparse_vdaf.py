@@ -1,0 +1,502 @@
+"""Block-sparse vector aggregation (ISSUE 17).
+
+A PREAMBLE-style sparse VDAF: each measurement is up to `max_blocks`
+(block_index, dense block) pairs over a logical length-L vector. The
+FLP legs run at the COMPACT length (max_blocks * block_size); the
+block indices are PUBLIC (they ride the public share, bound by the
+AAD) and aggregation scatters each verified report's compact blocks
+into a dense logical accumulator. These tests pin:
+
+  * the host reference: shard -> wire codec round trip -> two-party
+    prepare -> aggregate_sparse -> unshard equals the expanded
+    plaintext sum;
+  * reject-divergence fuzz between the per-report reference index
+    decoder (decode_block_indices) and the vectorized batch fast path
+    (decode_index_columns) used by the batched upload validation;
+  * out-of-range / duplicate / descending / mid-padding index
+    rejection lands on exactly the offending lane;
+  * rejected-lane equivalence fuzz: the device scatter path over a
+    batch with rejected lanes equals the dense expanded oracle over
+    the accepted lanes only, with two-party closure;
+  * the resident scatter-merge path (aggregate_pending ->
+    resident_merge -> resident_take) including multi-job merges and
+    LRU eviction flush — nothing lost, sums exact;
+  * prewarm/shape-manifest key separation: a sparse config and the
+    dense config with the same compact geometry produce distinct
+    manifest keys, and the scatter_merge prewarm variant warms only
+    sparse engines;
+  * the scatter observability surface: janus_engine_scatter_rows_total,
+    janus_engine_sparse_block_occupancy, and the `sparse` sections of
+    resident_status / resident_accumulators_status.
+"""
+
+import numpy as np
+import pytest
+
+from janus_tpu import metrics
+from janus_tpu.aggregator.engine_cache import (
+    EngineCache,
+    HostEngineCache,
+    resident_accumulators_status,
+)
+from janus_tpu.messages import Duration, Interval, Time
+from janus_tpu.messages.codec import DecodeError
+from janus_tpu.vdaf.reference import (
+    Prio3Sparse,
+    SparsePublicShare,
+    SparseSumVec,
+    VdafError,
+    validate_block_indices,
+)
+from janus_tpu.vdaf.registry import VdafInstance, circuit_for, prio3_host
+from janus_tpu.vdaf.testing import (
+    make_report_batch,
+    random_measurements,
+    sparse_compact_batch,
+)
+from janus_tpu.vdaf.wire import (
+    IDX_ENC_SIZE,
+    Prio3Wire,
+    decode_block_indices,
+    decode_index_columns,
+    encode_block_indices,
+    flat_scatter_indices,
+)
+
+VK = bytes(range(16))
+IV = Interval(Time(0), Duration(3600))
+
+
+def _inst(**kw):
+    d = dict(bits=3, length=48, block_size=4, max_blocks=3)
+    d.update(kw)
+    return VdafInstance.sparse_sumvec(**d)
+
+
+def _expanded_oracle(circ, meas, lanes):
+    """Plaintext logical-length sums (mod p) over the given lanes."""
+    p = circ.FIELD.MODULUS
+    want = [0] * circ.logical_length
+    for i in lanes:
+        for bi, block in meas[i]:
+            for off, v in enumerate(block):
+                k = bi * circ.block_size + off
+                want[k] = (want[k] + int(v)) % p
+    return want
+
+
+# ---------------------------------------------------------------------------
+# host reference + wire codec
+# ---------------------------------------------------------------------------
+
+
+def test_registry_round_trip_and_circuit():
+    inst = _inst()
+    assert inst.kind == "sparse_sumvec"
+    d = inst.to_dict()
+    assert d["block_size"] == 4 and d["max_blocks"] == 3
+    assert VdafInstance.from_dict(d) == inst
+    circ = circuit_for(inst)
+    assert isinstance(circ, SparseSumVec)
+    assert circ.logical_length == 48
+    assert circ.output_len == 12  # compact: max_blocks * block_size
+    assert circ.agg_output_len == 48  # aggregation is logical-length
+    assert isinstance(prio3_host(inst), Prio3Sparse)
+
+
+def test_host_two_party_through_wire_codec():
+    """shard -> encode/decode the public share (indices on the wire) ->
+    prepare both parties -> aggregate_sparse -> unshard == plaintext."""
+    inst = _inst()
+    host = prio3_host(inst)
+    circ = host.circuit
+    wire = Prio3Wire(circ)
+    rng = np.random.default_rng(7)
+    meas = random_measurements(inst, 5, rng)
+    pairs0, pairs1 = [], []
+    for i, m in enumerate(meas):
+        nonce = bytes([i]) * 16
+        public, (ls, hs) = host.shard(m, nonce)
+        raw = wire.encode_public_share(public)
+        assert len(raw) == wire.public_share_len
+        decoded = wire.decode_public_share(raw)
+        assert isinstance(decoded, SparsePublicShare)
+        assert tuple(decoded.indices) == tuple(public.indices)
+        assert list(decoded) == list(public)
+        st0, ps0 = host.prepare_init(VK, 0, nonce, decoded, ls)
+        st1, ps1 = host.prepare_init(VK, 1, nonce, decoded, hs)
+        prep = host.prepare_shares_to_prep([ps0, ps1])
+        out0 = host.prepare_next(st0, prep)
+        out1 = host.prepare_next(st1, prep)
+        pairs0.append((decoded.indices, out0))
+        pairs1.append((decoded.indices, out1))
+    agg0 = host.aggregate_sparse(pairs0)
+    agg1 = host.aggregate_sparse(pairs1)
+    got = host.unshard([agg0, agg1], len(meas))
+    want = _expanded_oracle(circ, meas, range(len(meas)))
+    assert [int(x) for x in got] == want
+    # dense aggregate() without indices must refuse, not mis-aggregate
+    with pytest.raises(VdafError):
+        host.aggregate([out0])
+
+
+def test_host_prepare_rejects_invalid_indices():
+    inst = _inst()
+    host = prio3_host(inst)
+    m = [(0, [1, 0, 0, 0]), (3, [0, 2, 0, 0])]
+    nonce = bytes(16)
+    public, (ls, _) = host.shard(m, nonce)
+    for bad in ([0, 0, -1], [3, 0, -1], [99, -1, -1], [0, -1, 1]):
+        with pytest.raises(VdafError):
+            host.prepare_init(VK, 0, nonce, SparsePublicShare(list(public), bad), ls)
+
+
+def test_index_blob_codec_goldens():
+    inst = _inst()
+    circ = circuit_for(inst)
+    blob = encode_block_indices([2, 7, -1])
+    assert blob == (2).to_bytes(4, "big") + (7).to_bytes(4, "big") + b"\xff" * 4
+    assert decode_block_indices(blob, circ) == (2, 7, -1)
+    with pytest.raises(DecodeError):
+        decode_block_indices(blob + b"\x00", circ)  # wrong length
+    with pytest.raises(DecodeError):
+        decode_block_indices(encode_block_indices([7, 2, -1]), circ)  # descending
+
+
+def test_wire_reject_divergence_fuzz():
+    """Mutational fuzz: the vectorized batch index decoder must agree
+    with the per-report reference decoder on accept/reject for every
+    mutated row, and on the decoded indices whenever both accept."""
+    inst = _inst(length=64, block_size=4, max_blocks=4)
+    circ = circuit_for(inst)
+    rng = np.random.default_rng(21)
+    blob_len = circ.max_blocks * IDX_ENC_SIZE
+    rows, want_ok, want_idx = [], [], []
+    for trial in range(300):
+        nb = int(rng.integers(1, circ.max_blocks + 1))
+        idxs = sorted(rng.choice(circ.n_logical_blocks, size=nb, replace=False).tolist())
+        blob = bytearray(
+            encode_block_indices(idxs + [-1] * (circ.max_blocks - nb))
+        )
+        # mutate: random byte flips, lane swaps, truncation to padding
+        for _ in range(int(rng.integers(0, 3))):
+            kind = int(rng.integers(0, 3))
+            if kind == 0:
+                blob[int(rng.integers(0, blob_len))] = int(rng.integers(0, 256))
+            elif kind == 1:
+                a, b = rng.integers(0, circ.max_blocks, size=2)
+                a, b = int(a) * 4, int(b) * 4
+                blob[a : a + 4], blob[b : b + 4] = blob[b : b + 4], blob[a : a + 4]
+            else:
+                k = int(rng.integers(0, circ.max_blocks)) * 4
+                blob[k : k + 4] = b"\xff" * 4
+        blob = bytes(blob)
+        try:
+            ref = decode_block_indices(blob, circ)
+            want_ok.append(True)
+            want_idx.append(tuple(ref))
+        except DecodeError:
+            want_ok.append(False)
+            want_idx.append(None)
+        rows.append(blob)
+    got_idx, got_ok = decode_index_columns(rows, circ)
+    assert got_ok.tolist() == want_ok
+    for i, ok in enumerate(want_ok):
+        if ok:
+            assert tuple(int(x) for x in got_idx[i]) == want_idx[i]
+        else:
+            assert (got_idx[i] == -1).all()  # rejected lanes scatter nothing
+    # length divergence: short/None rows reject in the fast path exactly
+    # like the reference's length check
+    _, ok2 = decode_index_columns([rows[0][:-1], None, rows[0]], circ)
+    assert ok2.tolist() == [False, False, True]
+
+
+def test_rejection_lands_on_offending_lane_only():
+    inst = _inst()
+    circ = circuit_for(inst)
+    good = encode_block_indices([1, 5, -1])
+    bad_rows = [
+        encode_block_indices([2, 2, -1]),  # duplicate
+        encode_block_indices([5, 1, -1]),  # descending
+        encode_block_indices([0, 12, -1]),  # out of range (12 blocks: 0..11)
+        encode_block_indices([0, -1, 3]),  # value after padding
+    ]
+    rows = [good, *bad_rows, good]
+    idx, ok = decode_index_columns(rows, circ)
+    assert ok.tolist() == [True, False, False, False, False, True]
+    assert (idx[1:5] == -1).all()
+    assert [int(x) for x in idx[0]] == [1, 5, -1]
+
+
+# ---------------------------------------------------------------------------
+# device engine: scatter paths
+# ---------------------------------------------------------------------------
+
+
+def test_engine_scatter_matches_oracle_with_rejected_lanes_fuzz():
+    """Two-party batched engine with random accept/reject: the classic
+    aggregate_sparse per-bucket scatter reduce equals the expanded
+    oracle over accepted lanes only (closure mod p), and rejected lanes
+    contribute nothing."""
+    inst = _inst()
+    eng = EngineCache(inst, VK)
+    circ = eng.p3.circ
+    p = eng.p3.jf.MODULUS
+    rng = np.random.default_rng(99)
+    for trial in range(3):
+        n = int(rng.integers(4, 9))
+        meas = random_measurements(inst, n, rng)
+        args, m = make_report_batch(inst, meas, seed=50 + trial)
+        nonce, public, mv, proof, blind0, seeds, blind1 = args
+        _, block_idx = sparse_compact_batch(inst, meas)
+        flat_idx = flat_scatter_indices(block_idx, circ)
+        out0, _, ver0, part0 = eng.leader_init(nonce, public, mv, proof, blind0)
+        out1, ok, _ = eng.helper_init(
+            nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+        )
+        assert np.asarray(ok).all()
+        accept = rng.random(n) > 0.4
+        if not accept.any():
+            accept[0] = True
+        a = eng.aggregate_sparse(out0, accept, flat_idx)
+        b = eng.aggregate_sparse(out1, accept, flat_idx)
+        assert len(a) == circ.logical_length
+        got = [(int(x) + int(y)) % p for x, y in zip(a, b)]
+        want = _expanded_oracle(circ, m, [i for i in range(n) if accept[i]])
+        assert got == want
+
+
+def test_engine_matches_host_engine_fallback():
+    """The HostEngineCache fallback's aggregate_sparse is bit-identical
+    to the device engine's."""
+    inst = _inst()
+    eng = EngineCache(inst, VK)
+    host = HostEngineCache(inst, VK)
+    rng = np.random.default_rng(3)
+    n = 5
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=9)
+    nonce, public, mv, proof, blind0, seeds, blind1 = args
+    _, block_idx = sparse_compact_batch(inst, meas)
+    flat_idx = flat_scatter_indices(block_idx, circuit_for(inst))
+    out0, _, ver0, part0 = eng.leader_init(nonce, public, mv, proof, blind0)
+    accept = np.array([True, False, True, True, False])
+    dev = eng.aggregate_sparse(out0, accept, flat_idx)
+    hst = host.aggregate_sparse(
+        tuple(np.asarray(x) for x in out0.to_numpy())
+        if hasattr(out0, "to_numpy")
+        else out0,
+        accept,
+        flat_idx,
+    )
+    assert [int(x) for x in dev] == [int(x) for x in hst]
+
+
+def test_resident_scatter_merge_multi_job_and_eviction():
+    """Pending sparse deltas merge into resident slots across jobs and
+    buckets; LRU eviction past the byte cap FLUSHES (never drops) — the
+    sum of all flushed + taken shares equals the plaintext total."""
+    inst = _inst()
+    eng0 = EngineCache(inst, VK)
+    circ = eng0.p3.circ
+    p = eng0.p3.jf.MODULUS
+    rng = np.random.default_rng(17)
+    keys = [(b"task", b"", b"bucket-a"), (b"task", b"", b"bucket-b")]
+    flushed: dict[tuple, list[int]] = {k: [0] * circ.logical_length for k in keys}
+    truth: dict[tuple, list[int]] = {k: [0] * circ.logical_length for k in keys}
+
+    def add_into(acc, share):
+        for i, v in enumerate(share):
+            acc[i] = (acc[i] + int(v)) % p
+
+    for trial in range(3):
+        n = int(rng.integers(3, 7))
+        meas = random_measurements(inst, n, rng)
+        args, m = make_report_batch(inst, meas, seed=80 + trial)
+        nonce, public, mv, proof, blind0, seeds, blind1 = args
+        _, block_idx = sparse_compact_batch(inst, meas)
+        flat_idx = flat_scatter_indices(block_idx, circ)
+        out0, _, ver0, part0 = eng0.leader_init(nonce, public, mv, proof, blind0)
+        _, ok, _ = eng0.helper_init(
+            nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+        )
+        assert np.asarray(ok).all()
+        lane_bucket = rng.integers(0, 2, size=n).astype(np.int32)
+        pend = eng0.aggregate_pending(out0, lane_bucket, 2, flat_idx=flat_idx)
+        entries = [
+            (keys[j], j, int((lane_bucket == j).sum()), IV) for j in range(2)
+        ]
+        for rec in eng0.resident_merge(entries, pend):
+            add_into(flushed[rec["key"]], rec["share"])
+        for j in range(2):
+            lanes = [i for i in range(n) if lane_bucket[i] == j]
+            add_into(truth[keys[j]], _expanded_oracle(circ, m, lanes))
+    for rec in eng0.resident_take():
+        add_into(flushed[rec["key"]], rec["share"])
+    # leader-share-only comparison: truth here is the plaintext, and the
+    # leader share alone is NOT the plaintext — so instead assert via
+    # the helper closure on a fresh single-job run below; for the
+    # multi-job path assert slot arithmetic consistency instead
+    # (flushed leader state must equal the classic leader aggregate)
+    eng1 = EngineCache(inst, VK)
+    check: dict[tuple, list[int]] = {k: [0] * circ.logical_length for k in keys}
+    rng = np.random.default_rng(17)
+    for trial in range(3):
+        n = int(rng.integers(3, 7))
+        meas = random_measurements(inst, n, rng)
+        args, m = make_report_batch(inst, meas, seed=80 + trial)
+        nonce, public, mv, proof, blind0, seeds, blind1 = args
+        _, block_idx = sparse_compact_batch(inst, meas)
+        flat_idx = flat_scatter_indices(block_idx, circ)
+        out0, _, ver0, part0 = eng1.leader_init(nonce, public, mv, proof, blind0)
+        eng1.helper_init(
+            nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+        )
+        lane_bucket = rng.integers(0, 2, size=n).astype(np.int32)
+        for j in range(2):
+            add_into(
+                check[keys[j]], eng1.aggregate_sparse(out0, lane_bucket == j, flat_idx)
+            )
+    assert flushed == check
+
+
+def test_resident_two_party_closure():
+    """Leader and helper engines both run the resident scatter-merge
+    path; their taken shares sum (mod p) to the plaintext expansion."""
+    inst = _inst()
+    eng = EngineCache(inst, VK)
+    circ = eng.p3.circ
+    p = eng.p3.jf.MODULUS
+    rng = np.random.default_rng(23)
+    n = 6
+    meas = random_measurements(inst, n, rng)
+    args, m = make_report_batch(inst, meas, seed=5)
+    nonce, public, mv, proof, blind0, seeds, blind1 = args
+    _, block_idx = sparse_compact_batch(inst, meas)
+    flat_idx = flat_scatter_indices(block_idx, circ)
+    out0, _, ver0, part0 = eng.leader_init(nonce, public, mv, proof, blind0)
+    out1, ok, _ = eng.helper_init(
+        nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+    )
+    assert np.asarray(ok).all()
+    key = (b"task", b"", b"bid")
+    shares = []
+    for out in (out0, out1):
+        pend = eng.aggregate_pending(out, np.zeros(n, dtype=np.int32), 1, flat_idx=flat_idx)
+        assert eng.resident_merge([(key, 0, n, IV)], pend) == []
+        recs = eng.resident_take()
+        assert len(recs) == 1 and recs[0]["rows"] == n
+        shares.append(recs[0]["share"])
+    got = [(int(x) + int(y)) % p for x, y in zip(*shares)]
+    assert got == _expanded_oracle(circ, m, range(n))
+    # the resident slot held ONE dense logical row, not per-report state
+    assert eng._scatter_rows >= 2 * n
+
+
+def test_sparse_engine_forces_single_device_mesh_fallback():
+    """Under the 8-virtual-device test topology a sparse engine must
+    fall back to single-device dispatch with an explicit reason (the
+    scatter kernel is not mesh-sharded yet); dense engines keep their
+    mesh."""
+    import jax
+
+    eng = EngineCache(_inst(), VK)
+    assert eng.sparse
+    if len(jax.devices()) > 1:
+        assert eng.mesh is None
+        assert eng.mesh_fallback_reason == "sparse_scatter_single_device"
+    else:
+        assert eng.mesh_fallback_reason is None
+
+
+# ---------------------------------------------------------------------------
+# prewarm / shape-manifest key separation (satellite 5)
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_keys_distinguish_sparse_from_dense():
+    """A sparse config and the dense SumVec with the SAME compact
+    geometry (so the same bucket sizes and jit shapes) must produce
+    different shape-manifest/prewarm keys — a prewarm replay must never
+    hand a dense program to a sparse engine or vice versa."""
+    from janus_tpu.aggregator.prewarm import _vdaf_key
+
+    sparse = _inst()  # compact length 12
+    dense = VdafInstance.sum_vec(length=12, bits=3)
+    assert sparse.to_dict() != dense.to_dict()
+    assert _vdaf_key(sparse.to_dict()) != _vdaf_key(dense.to_dict())
+    # and two sparse configs differing only in block geometry at the
+    # same compact length are ALSO distinct prewarm keys
+    other = _inst(length=96, block_size=2, max_blocks=6)  # compact 12 too
+    assert _vdaf_key(sparse.to_dict()) != _vdaf_key(other.to_dict())
+
+
+def test_prewarm_scatter_variant_gates_on_sparse():
+    """The scatter_merge prewarm variant warms sparse engines (tracing
+    the same shapes serving uses) and reports unsupported for dense."""
+    from janus_tpu.aggregator.prewarm import _Warmer
+
+    warmer = _Warmer()
+    sp = EngineCache(_inst(), VK)
+    dn = EngineCache(VdafInstance.sum_vec(length=12, bits=3), VK)
+    entry = {"op": "aggregate", "bucket": 32, "key": ["scatter_merge", 32]}
+    # dense: never warmed by the sparse variant (a meshed dense engine
+    # fails the geometry gate first; a single-device one the sparse gate)
+    assert warmer.warm(dn, entry) in ("unsupported", "geometry_mismatch")
+    before = sp._scatter_rows
+    assert warmer.warm(sp, entry) == "warmed"
+    assert sp._scatter_rows > before
+
+
+# ---------------------------------------------------------------------------
+# observability (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_scatter_metrics_and_statusz_sections():
+    from janus_tpu.aggregator.engine_cache import engine_cache
+
+    inst = _inst(length=80, block_size=4, max_blocks=3)
+    base_rows = metrics.engine_scatter_rows_total.get(vdaf=inst.kind)
+    # through the REGISTERED cache so the process-wide statusz rollups
+    # (resident_accumulators, mesh) see this engine
+    eng = engine_cache(inst, VK)
+    rng = np.random.default_rng(31)
+    n = 4
+    meas = random_measurements(inst, n, rng)
+    args, _ = make_report_batch(inst, meas, seed=13)
+    nonce, public, mv, proof, blind0, seeds, blind1 = args
+    _, block_idx = sparse_compact_batch(inst, meas)
+    flat_idx = flat_scatter_indices(block_idx, circuit_for(inst))
+    out0, _, ver0, part0 = eng.leader_init(nonce, public, mv, proof, blind0)
+    _, ok, _ = eng.helper_init(
+        nonce, public, seeds, blind1, ver0, part0, np.ones(n, dtype=bool)
+    )
+    eng.aggregate_sparse(out0, np.asarray(ok), flat_idx)
+    assert metrics.engine_scatter_rows_total.get(vdaf=inst.kind) == base_rows + n
+    occ = metrics.engine_sparse_block_occupancy.get(vdaf=inst.kind)
+    assert 0.0 < occ <= 1.0
+    assert eng._sparse_last_occupancy == occ
+    st = eng.resident_status()
+    assert st["sparse"]["logical_length"] == 80
+    assert st["sparse"]["block_size"] == 4
+    assert st["sparse"]["max_blocks"] == 3
+    assert st["sparse"]["scatter_rows"] == eng._scatter_rows >= n
+    assert st["sparse"]["block_occupancy"] == occ
+    agg_st = resident_accumulators_status()
+    assert agg_st["sparse"]["engines"] >= 1
+    assert agg_st["sparse"]["scatter_rows"] >= n
+    # mesh statusz carries the sparse fallback reason field
+    from janus_tpu.aggregator.engine_cache import mesh_status
+
+    ms = mesh_status()
+    ours = [
+        e
+        for e in ms.get("engines", [])
+        if e.get("fallback_reason") == "sparse_scatter_single_device"
+    ]
+    import jax
+
+    if len(jax.devices()) > 1:
+        assert ours, ms

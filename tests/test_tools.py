@@ -429,6 +429,16 @@ def test_bench_dry_run_smoke():
     assert ms["lane_alive"] is True
     assert ms["dispatch_lock_removed"] is True
     assert ms["rps"] > 0
+    # block-sparse scatter-merge (ISSUE 17): sparse aggregates
+    # bit-identical to the dense expanded oracle on BOTH device paths
+    # (classic per-bucket reduce and resident pending-delta merge), and
+    # the scatter path provably ran (engine counter + cost-ledger rows)
+    sp = rec["sparse_scatter"]
+    assert sp["classic_identical"] is True, sp
+    assert sp["resident_identical"] is True, sp
+    assert sp["scatter_path_observed"] is True
+    assert sp["scatter_rows"] > 0
+    assert 0.0 < sp["block_occupancy"] <= 1.0
 
 
 def test_collect_cli_end_to_end(capsys):
