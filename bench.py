@@ -1550,6 +1550,15 @@ def _observability_smoke() -> dict:
 
     _prof.install_profiler(_prof.ProfilerConfig(hz=47.0, window_secs=10.0))
 
+    # the telemetry flight recorder likewise runs like in the real
+    # binaries — scrape_check validates the /statusz flight section and
+    # its last-snapshot freshness against this listener
+    from janus_tpu import flight_recorder as _flight
+
+    _flight.install_flight_recorder(
+        _flight.FlightRecorderConfig(interval_s=0.5)
+    ).snapshot_once()
+
     # the report-lifecycle tracing smoke runs FIRST so its e2e series
     # and flight-recorder state are live in the scrape below
     trace_lifecycle = _trace_lifecycle_smoke()
@@ -1743,6 +1752,7 @@ def _observability_smoke() -> dict:
             "profile_host_trace_loadable": host_trace_loadable,
             "debug_traces_ok": debug_traces_ok,
             "statusz_flight_recorder_present": "flight_recorder" in statusz,
+            "statusz_flight_present": "flight" in statusz,
             "scrape_check_rc": check.returncode,
             "scrape_check_err": check.stderr[-500:] if check.returncode else "",
             # continuous profiler over live HTTP (ISSUE 13): collapsed
@@ -1758,6 +1768,7 @@ def _observability_smoke() -> dict:
     finally:
         srv.stop()
         eph.cleanup()
+        _flight.uninstall_flight_recorder()
         _prof.uninstall_profiler()
 
 
@@ -3089,6 +3100,65 @@ def _db_outage_smoke() -> dict:
     )
 
 
+def _soak_smoke() -> dict:
+    """Endurance-soak smoke (scripts/chaos_run.py --scenario soak
+    --smoke): sustained open-loop load with per-epoch task churn and GC
+    really deleting expired rows, every epoch collected EXACTLY while
+    churn continues, judged by the flight recorder — zero-slope
+    verdicts on rss/datastore-rows from the clean driver with recorder
+    self-overhead <= 1%, and the injected synthetic leak on the second
+    driver flipping janus_flight_leak_active and firing the
+    resource_trend SLO alert through the window_scale-shrunk ladder."""
+    return _run_chaos_subprocess(
+        ["--scenario", "soak", "--smoke", "--json"], timeout=560
+    )
+
+
+def _flight_rider() -> dict:
+    """ISSUE 18: the measured run's flight-recorder view — top trend
+    slopes, leak verdicts, and the ring's on-disk bytes/hour — from the
+    recorder sampling THIS process since bench start."""
+    from janus_tpu import flight_recorder as _fr
+
+    fr = _fr.get_flight_recorder()
+    if fr is None:
+        return {"enabled": False}
+    analysis = fr.analyze()
+    st = fr.status()
+    series = analysis.get("series", {})
+    top = sorted(
+        (
+            (n, d)
+            for n, d in series.items()
+            if isinstance(d.get("slope_per_s"), (int, float))
+        ),
+        key=lambda kv: -abs(kv[1]["slope_per_s"]),
+    )[:5]
+    covered = max(
+        (d.get("covered_s") or 0.0 for d in series.values()), default=0.0
+    )
+    ring = st.get("ring") or {}
+    return {
+        "enabled": True,
+        "snapshots": st.get("snapshots"),
+        "overhead_ratio": st.get("overhead_ratio"),
+        "top_slopes": [
+            {
+                "series": n,
+                "slope_per_s": d["slope_per_s"],
+                "verdict": d.get("verdict"),
+            }
+            for n, d in top
+        ],
+        "leak_verdicts": {n: d.get("verdict") for n, d in series.items()},
+        "leaking": analysis.get("leaking", []),
+        "ring_bytes": ring.get("bytes"),
+        "ring_bytes_per_hour": (
+            round(ring.get("bytes", 0) * 3600.0 / covered, 1) if covered else None
+        ),
+    }
+
+
 # Planning default when the backend reports no memory budget (the axon
 # tunnel; CPU): the v5e HBM size the BASELINE.md measurements ran on.
 V5E_HBM_BYTES = int(15.75 * (1 << 30))
@@ -3204,6 +3274,11 @@ def run_dry(args, ap) -> None:
                 # dense expanded oracle, bit-identical on both the
                 # classic and resident paths, scatter ledger rows proven
                 "sparse_scatter": _sparse_scatter_smoke(),
+                # ISSUE 18: endurance soak under churn + GC, judged by
+                # flight-recorder trend verdicts (zero-slope clean
+                # driver, injected leak fires the trend alert, recorder
+                # self-overhead <= 1%)
+                "soak_smoke": _soak_smoke(),
             }
         )
     )
@@ -3287,6 +3362,26 @@ def main() -> None:
             ap.error("--dry-run models Prio3 prepare; poplar1 has no FLP circuit")
         run_dry(args, ap)
         return
+
+    # ISSUE 18: sample this process for the whole measured run so the
+    # BENCH json carries the flight rider (top trend slopes, leak
+    # verdicts, ring bytes/hour) — never let the recorder kill the run
+    try:
+        import tempfile as _tempfile
+
+        from janus_tpu import flight_recorder as _fr_mod
+
+        _fr_mod.install_flight_recorder(
+            _fr_mod.FlightRecorderConfig(
+                interval_s=1.0,
+                window_s=1800.0,
+                dir=os.path.join(
+                    _tempfile.mkdtemp(prefix="janus-bench-flight-"), "ring"
+                ),
+            )
+        )
+    except Exception:
+        pass
 
     # bring-up clock: starts in the first process and survives every
     # re-exec (stall retries, OOM halving) via the environment
@@ -3774,6 +3869,11 @@ def main() -> None:
         # replicas over one store, claim round-trips per job vs the
         # per-row loop, kill/drain/restart chaos gates
         riders["fleet_scaling"] = _fleet_scaling_record(full=True)
+    except Exception:
+        pass
+    try:
+        # ISSUE 18: the flight recorder's trend view of this very run
+        riders["flight"] = _flight_rider()
     except Exception:
         pass
     if args.mode != "served":

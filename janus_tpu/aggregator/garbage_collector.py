@@ -10,8 +10,10 @@ without one are skipped (nothing ever expires).
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 
+from .. import metrics
 from ..datastore.store import Datastore
 
 log = logging.getLogger(__name__)
@@ -31,18 +33,48 @@ class GarbageCollector:
         self.ds = ds
         self.clock = clock
         self.cfg = cfg or GarbageCollectorConfig()
+        self._last_pass_unix: float | None = None
+        metrics.gc_lag_seconds.set(-1.0)
 
     def run_once(self) -> dict[str, int]:
-        """One GC pass over every task; returns rows deleted by kind."""
+        """One GC pass over every task; returns rows deleted by kind.
+        Progress is exported for the flight recorder's endurance gates:
+        janus_gc_deleted_rows_total{kind} and janus_gc_tasks_scanned_
+        total rise with the work, janus_gc_lag_seconds tracks the age
+        of the last COMPLETED pass (a growing lag with GC configured on
+        means passes are stuck or erroring)."""
         totals = {"reports": 0, "aggregation": 0, "collection": 0}
-        tasks = self.ds.run_tx(lambda tx: tx.get_tasks(), "gc_list_tasks")
-        for task in tasks:
-            if task.report_expiry_age is None:
-                continue
-            deleted = self.gc_task(task)
-            for k, v in deleted.items():
-                totals[k] += v
+        try:
+            tasks = self.ds.run_tx(lambda tx: tx.get_tasks(), "gc_list_tasks")
+            for task in tasks:
+                if task.report_expiry_age is None:
+                    continue
+                metrics.gc_tasks_scanned_total.add()
+                deleted = self.gc_task(task)
+                for k, v in deleted.items():
+                    totals[k] += v
+        except Exception:
+            metrics.gc_runs_total.add(outcome="error")
+            if self._last_pass_unix is not None:
+                metrics.gc_lag_seconds.set(time.time() - self._last_pass_unix)
+            raise
+        for k, v in totals.items():
+            if v:
+                metrics.gc_deleted_rows_total.add(v, kind=k)
+        metrics.gc_runs_total.add(outcome="ok")
+        self._last_pass_unix = time.time()
+        metrics.gc_lag_seconds.set(0.0)
         return totals
+
+    def observe_lag(self) -> float:
+        """Refresh + return janus_gc_lag_seconds (the health sampler
+        calls this between passes so the gauge moves even when the GC
+        loop is wedged and never reaches run_once's own update)."""
+        if self._last_pass_unix is None:
+            return -1.0
+        lag = time.time() - self._last_pass_unix
+        metrics.gc_lag_seconds.set(lag)
+        return lag
 
     def gc_task(self, task) -> dict[str, int]:
         cutoff = self.clock.now().sub(task.report_expiry_age)

@@ -28,6 +28,7 @@ visible for one sampling period).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 
 from ..metrics import task_id_label as _b64_task_id
@@ -35,13 +36,62 @@ from ..metrics import task_id_label as _b64_task_id
 log = logging.getLogger(__name__)
 
 
+def _path_bytes(path: str) -> int:
+    """On-disk bytes of a file, or the recursive total of a directory
+    (one level of nesting is enough for the journal/AOT blob dirs).
+    Missing paths are 0 — an artifact that was never created is empty,
+    not an error."""
+    path = os.path.expanduser(path)
+    try:
+        if os.path.isdir(path):
+            total = 0
+            for root, _dirs, files in os.walk(path):
+                for name in files:
+                    try:
+                        total += os.path.getsize(os.path.join(root, name))
+                    except OSError:
+                        pass
+            return total
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def artifact_paths_from_config(common, aggregator=None) -> dict[str, str]:
+    """{artifact label: path} for janus_artifact_bytes, derived from a
+    CommonConfig (+ optionally the AggregatorConfig for the upload
+    journal): the spill journal dir, the shape manifest and the AOT
+    blob dir — the locally persisted state that can leak bytes."""
+    out = {}
+    if aggregator is not None and getattr(aggregator, "upload_journal_path", None):
+        out["upload_journal"] = aggregator.upload_journal_path
+    cache_dir = common.engine.compile_cache_dir or common.compilation_cache_dir
+    manifest = common.engine.shape_manifest_path
+    if manifest is None and cache_dir:
+        manifest = os.path.join(cache_dir, "shape_manifest.jsonl")
+    if manifest:
+        out["shape_manifest"] = manifest
+    if cache_dir and common.engine.aot_cache:
+        out["aot_cache"] = os.path.join(cache_dir, "aot")
+    return out
+
+
 class HealthSampler:
     """Thread-per-process sampler over one datastore. `run_once()` is
     the unit of work (tests and the bench smoke call it directly);
-    `start()` spawns the periodic daemon thread."""
+    `start()` spawns the periodic daemon thread.
 
-    def __init__(self, ds, interval_s: float = 15.0):
+    `artifact_paths` ({label: path}, see artifact_paths_from_config)
+    adds on-disk artifact size sampling (janus_artifact_bytes);
+    `gc` (a GarbageCollector) adds janus_gc_lag_seconds refreshes
+    between GC passes. Both feed the flight recorder's leak-gated
+    series; the table row counts (janus_datastore_table_rows) are
+    always sampled."""
+
+    def __init__(self, ds, interval_s: float = 15.0, artifact_paths=None, gc=None):
         self.ds = ds
+        self.artifact_paths = dict(artifact_paths or {})
+        self.gc = gc
         self.interval_s = float(interval_s)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -134,6 +184,22 @@ class HealthSampler:
         )
         metrics.batches_pending_collection.set(float(pending), **rl)
 
+        # long-horizon state the flight recorder trends: per-table row
+        # counts (flat under load + GC is the endurance gate), on-disk
+        # artifact bytes, and a GC-lag refresh between GC passes
+        table_rows = self.ds.run_tx(
+            lambda tx: tx.count_table_rows(), "health_table_rows"
+        )
+        for table, count in sorted(table_rows.items()):
+            metrics.datastore_table_rows.set(float(count), table=table, **rl)
+        artifact_bytes = {}
+        for label, path in sorted(self.artifact_paths.items()):
+            size = _path_bytes(path)
+            artifact_bytes[label] = size
+            metrics.artifact_bytes.set(float(size), artifact=label, **rl)
+        if self.gc is not None:
+            self.gc.observe_lag()
+
         self.last_snapshot = {
             "sampled_at_clock_seconds": now,
             "jobs": {f"{typ}/{state}": n for (typ, state), n in sorted(jobs.items())},
@@ -142,6 +208,8 @@ class HealthSampler:
             "oldest_unaggregated_report_age_seconds": lag_by_task,
             "unaggregated_report_age_quantiles": freshness,
             "batches_pending_collection": pending,
+            "datastore_table_rows": table_rows,
+            "artifact_bytes": artifact_bytes,
             "interval_s": self.interval_s,
         }
         return self.last_snapshot

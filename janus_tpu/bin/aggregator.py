@@ -12,7 +12,7 @@ import threading
 
 from ..aggregator import Aggregator
 from ..aggregator.garbage_collector import GarbageCollector
-from ..aggregator.health_sampler import HealthSampler
+from ..aggregator.health_sampler import HealthSampler, artifact_paths_from_config
 from ..aggregator.http_handlers import DapHttpApp, DapServer
 from ..binary_utils import _split_hostport, janus_main
 from ..config import AggregatorConfig
@@ -47,13 +47,19 @@ def run(cfg: AggregatorConfig, ds, stopper):
         api_server = AggregatorApiServer(api, host=api_host, port=api_port).start()
         log.info("aggregator API listening on %s", api_server.url)
 
+    gc = GarbageCollector(ds, clock) if cfg.garbage_collection_interval_s else None
+
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:
-        sampler = HealthSampler(ds, cfg.common.health_sampler_interval_s).start()
+        sampler = HealthSampler(
+            ds,
+            cfg.common.health_sampler_interval_s,
+            artifact_paths=artifact_paths_from_config(cfg.common, cfg),
+            gc=gc,
+        ).start()
 
     gc_thread = None
-    if cfg.garbage_collection_interval_s:
-        gc = GarbageCollector(ds, clock)
+    if gc is not None:
 
         def gc_loop():
             while not stopper.stopped:

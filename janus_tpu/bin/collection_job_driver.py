@@ -22,7 +22,7 @@ log = logging.getLogger(__name__)
 
 
 def run(cfg: JobDriverBinaryConfig, ds, stopper):
-    from ..aggregator.health_sampler import HealthSampler
+    from ..aggregator.health_sampler import HealthSampler, artifact_paths_from_config
 
     driver = CollectionJobDriver(
         ds,
@@ -44,7 +44,11 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
     )
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:
-        sampler = HealthSampler(ds, cfg.common.health_sampler_interval_s).start()
+        sampler = HealthSampler(
+            ds,
+            cfg.common.health_sampler_interval_s,
+            artifact_paths=artifact_paths_from_config(cfg.common),
+        ).start()
     try:
         jd.run()
     finally:

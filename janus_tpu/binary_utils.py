@@ -56,6 +56,7 @@ _INDEX_ENDPOINTS = (
     ("/debug/profile", "continuous profiler: collapsed wall-clock stacks (flamegraph.pl)"),
     ("/debug/profile?format=json", "continuous profiler: per-role self/total shares"),
     ("/debug/boot", "boot-phase timeline (process start to /readyz ready)"),
+    ("/debug/flight", "telemetry flight recorder: resource history, trend slopes, leak verdicts"),
 )
 
 
@@ -433,6 +434,29 @@ class HealthServer:
                         "application/json",
                         _json.dumps(
                             flight_recorder().snapshot(recent_limit=limit),
+                            default=str,
+                        ).encode(),
+                    )
+                elif parts.path == "/debug/flight":
+                    # telemetry flight recorder: recent resource/metric
+                    # history + live trend analysis (?window_secs=N
+                    # narrows the judged window, ?max_points=N bounds
+                    # the snapshot list)
+                    from .flight_recorder import flight_document
+
+                    try:
+                        window_s = float(query["window_secs"])
+                    except (KeyError, ValueError):
+                        window_s = None
+                    try:
+                        max_points = max(1, min(int(query.get("max_points", "500")), 10_000))
+                    except ValueError:
+                        max_points = 500
+                    self._send(
+                        200,
+                        "application/json",
+                        _json.dumps(
+                            flight_document(window_s=window_s, max_points=max_points),
                             default=str,
                         ).encode(),
                     )
@@ -982,6 +1006,13 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
     # wall-clock stacks behind GET /debug/profile on the listener below
     profiler_mod.install_profiler(common.profiler)
 
+    # telemetry flight recorder (YAML `flight:` stanza; ISSUE 18):
+    # low-cadence resource/metric history + trend/leak verdicts behind
+    # GET /debug/flight, feeding the `trend` SLO signal above
+    from . import flight_recorder as flight_mod
+
+    flight_mod.install_flight_recorder(common.flight)
+
     stopper = Stopper()
     if install_signals:
         setup_signal_handler(stopper)
@@ -995,6 +1026,7 @@ def janus_main(description: str, config_cls, run, argv=None, install_signals: bo
         return run(cfg, ds, stopper)
     finally:
         health.stop()
+        flight_mod.uninstall_flight_recorder()
         profiler_mod.uninstall_profiler()
         if slo_engine is not None:
             slo_mod.uninstall_slo_engine()

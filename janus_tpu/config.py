@@ -19,6 +19,7 @@ from .aggregator.aggregation_job_driver import ResidentConfig
 from .aggregator.job_driver import JobDriverConfig
 from .aggregator.step_pipeline import StepPipelineConfig
 from .core.circuit_breaker import CircuitBreakerConfig
+from .flight_recorder import FlightRecorderConfig
 from .profiler import ProfilerConfig
 from .slo import SloEngineConfig
 from .trace import TraceConfiguration
@@ -275,6 +276,12 @@ class CommonConfig:
     # sampling rate and window ring behind GET /debug/profile. Enabled
     # by default in every binary.
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    # Telemetry flight recorder (YAML `flight:` section;
+    # docs/OBSERVABILITY.md "Flight recorder and trend alerts"):
+    # low-cadence resource/metric history ring behind GET /debug/flight
+    # plus the trend/leak analyzer feeding the `trend` SLO signal.
+    # Enabled by default in every binary (memory-only until `dir` set).
+    flight: FlightRecorderConfig = field(default_factory=FlightRecorderConfig)
     # Fleet identity + job-claim sharding (YAML `fleet:` section;
     # docs/ARCHITECTURE.md "Running a fleet"): replica id stamped into
     # lease tokens/metrics/traces, and this replica's slice of the
@@ -304,6 +311,7 @@ class CommonConfig:
             slo=SloEngineConfig.from_dict(d.get("slo")),
             engine=EngineConfig.from_dict(d.get("engine")),
             profiler=ProfilerConfig.from_dict(d.get("profiler")),
+            flight=FlightRecorderConfig.from_dict(d.get("flight")),
             fleet=FleetConfig.from_dict(d.get("fleet")),
         )
 

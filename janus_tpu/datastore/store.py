@@ -584,6 +584,31 @@ class Transaction:
         ).fetchone()
         return row[0], row[1]
 
+    # The durable tables the health sampler's periodic row-count tx
+    # samples into janus_datastore_table_rows{table} — the flight
+    # recorder's datastore_rows series (flat under load + GC is the
+    # endurance gate). COUNT(*) per table in one read tx: cheap at the
+    # row counts a healthy GC maintains, and the point is to notice
+    # when they stop being cheap.
+    COUNTED_TABLES = (
+        "tasks",
+        "client_reports",
+        "aggregation_jobs",
+        "report_aggregations",
+        "batch_aggregations",
+        "collection_jobs",
+        "aggregate_share_jobs",
+        "batches",
+        "outstanding_batches",
+    )
+
+    def count_table_rows(self) -> dict[str, int]:
+        """{table: row count} over COUNTED_TABLES."""
+        return {
+            t: self._c.execute(f"SELECT COUNT(*) FROM {t}").fetchone()[0]  # noqa: S608
+            for t in self.COUNTED_TABLES
+        }
+
     def delete_expired_client_reports(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
         cur = self._c.execute(
             "DELETE FROM client_reports WHERE (task_id, report_id) IN ("

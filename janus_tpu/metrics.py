@@ -886,6 +886,89 @@ boot_phase_seconds = REGISTRY.gauge(
     "cold-start regression gate",
 )
 
+# --- flight recorder: telemetry history + trend/leak verdicts
+# (ISSUE 18; docs/OBSERVABILITY.md "Flight recorder and trend alerts") ---
+flight_slope = REGISTRY.gauge(
+    "janus_flight_slope",
+    "robust (Theil-Sen) linear-regression slope of each leak-gated "
+    "flight-recorder series over its trend window, in the series' "
+    "units per second (bytes/s for the resource curves, rows/s for "
+    "datastore_rows) — the number the endurance gates want at ~zero",
+)
+flight_leak_active = REGISTRY.gauge(
+    "janus_flight_leak_active",
+    "1 while a leak-gated flight-recorder series has a sustained "
+    "positive trend clearing BOTH the residual noise band and the "
+    "relative growth floor, else 0 — the `trend` SLO signal reads "
+    "this, so a leak pages through the burn-rate ladder (/alertz)",
+)
+flight_p99_ratio = REGISTRY.gauge(
+    "janus_flight_p99_ratio",
+    "late-half over early-half p99 of each tracked latency family "
+    "across the flight-recorder trend window (bucket-delta estimate) "
+    "— the hour-1-vs-hour-N latency-stability gate; ~1.0 is stable",
+)
+flight_snapshots_total = REGISTRY.counter(
+    "janus_flight_snapshots_total",
+    "flight-recorder snapshot passes taken since process start",
+)
+flight_ring_bytes = REGISTRY.gauge(
+    "janus_flight_ring_bytes",
+    "on-disk bytes held by the flight-recorder JSONL segment ring "
+    "(bounded by flight.max_total_bytes; 0 when memory-only)",
+)
+flight_ring_segments = REGISTRY.gauge(
+    "janus_flight_ring_segments",
+    "segment files in the flight-recorder on-disk ring",
+)
+flight_overhead_ratio = REGISTRY.gauge(
+    "janus_flight_overhead_ratio",
+    "measured fraction of wall time the flight recorder spends in its "
+    "own snapshot + analysis passes (same self-accounting contract as "
+    "janus_profiler_overhead_ratio; alert > 0.01)",
+)
+
+# --- lifecycle gauges the flight recorder tracks: GC progress,
+# datastore row counts, on-disk artifact sizes (ISSUE 18 satellites) ---
+gc_deleted_rows_total = REGISTRY.counter(
+    "janus_gc_deleted_rows_total",
+    "rows deleted by the garbage collector since process start, by "
+    'kind ("reports" expired client reports, "aggregation" '
+    'aggregation artifacts, "collection" collection artifacts) — '
+    "under steady load this rises while janus_datastore_table_rows "
+    "stays flat; both flat means GC is not keeping up is false, both "
+    "rising means it is not running",
+)
+gc_tasks_scanned_total = REGISTRY.counter(
+    "janus_gc_tasks_scanned_total",
+    "tasks examined by garbage-collector passes since process start",
+)
+gc_runs_total = REGISTRY.counter(
+    "janus_gc_runs_total",
+    'garbage-collector passes, by outcome ("ok" | "error")',
+)
+gc_lag_seconds = REGISTRY.gauge(
+    "janus_gc_lag_seconds",
+    "seconds since the last completed garbage-collector pass (-1 "
+    "until the first pass finishes) — a growing value with GC "
+    "configured on means the pass is stuck or erroring",
+)
+datastore_table_rows = REGISTRY.gauge(
+    "janus_datastore_table_rows",
+    "rows per datastore table, sampled by the health sampler's "
+    "periodic count transaction — the flight recorder's "
+    "datastore_rows series sums this; flat under sustained load + GC "
+    "is ROADMAP endurance gate #1",
+)
+artifact_bytes = REGISTRY.gauge(
+    "janus_artifact_bytes",
+    "on-disk bytes of each locally persisted artifact, sampled by the "
+    'health sampler (artifact="upload_journal" spill-journal dir, '
+    '"shape_manifest" dispatch-specialization manifest, "aot_cache" '
+    "serialized-executable blob dir) — the flight recorder trends "
+    "each for unbounded-growth leaks",
+)
+
 # --- fleet scale-out: batched sharded lease claims + replica identity
 # (ISSUE 15; docs/ARCHITECTURE.md "Running a fleet") ---
 lease_acquire_tx_total = REGISTRY.counter(

@@ -153,6 +153,7 @@ ROLE_PREFIXES: tuple[tuple[str, str], ...] = (
     ("interop-runner", "other"),
     ("gc-loop", "gc"),
     ("janus-profiler", "profiler"),
+    ("flight-recorder", "flight"),      # telemetry history snapshotter
     ("MainThread", "main"),
 )
 

@@ -293,10 +293,69 @@ class ConditionSignal:
         return out
 
 
+@dataclass(frozen=True)
+class TrendSignal:
+    """Time-based SLO over the flight recorder's leak verdicts
+    (docs/OBSERVABILITY.md "Flight recorder and trend alerts"): every
+    evaluation tick is one event, bad while any matched series of
+    `metric` (default janus_flight_leak_active, 1 while a leak-gated
+    series shows a sustained positive trend) is above zero. A slow
+    resource leak is invisible to every point-in-time signal — this is
+    how it pages through the same burn-rate ladder. The verdict gauges
+    are only born once the recorder's first analysis pass runs, so a
+    process without a recorder reports no_data rather than fake
+    health."""
+
+    kind = "trend"
+    metric: str = "janus_flight_leak_active"
+    labels: tuple = ()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrendSignal":
+        return cls(
+            metric=str(d.get("metric", "janus_flight_leak_active")),
+            labels=compile_matchers(d.get("labels")),
+        )
+
+    def _read_raw(self) -> tuple[float, int]:
+        m = REGISTRY.get(self.metric)
+        if m is None or not hasattr(m, "sum_matching"):
+            return 0.0, 0
+        return m.sum_matching(self.labels)
+
+    def read(self, engine) -> tuple[float, float, bool]:
+        st = engine._condition_state.setdefault(
+            id(self), {"bad": 0.0, "total": 0.0, "prev": {}}
+        )
+        v, n = self._read_raw()
+        if n == 0:
+            return st["bad"], st["total"], st["total"] > 0
+        st["total"] += 1.0
+        if v > 0:
+            st["bad"] += 1.0
+        return st["bad"], st["total"], True
+
+    def evidence(self) -> dict:
+        desc = Selector(self.metric, self.labels).describe()
+        v, n = self._read_raw()
+        out = {f"{desc} leaking series": v if n else None}
+        leak, slopes = REGISTRY.get(self.metric), REGISTRY.get("janus_flight_slope")
+        if v > 0 and hasattr(leak, "_values") and hasattr(slopes, "_values"):
+            with leak._lock:
+                leak_vals = dict(leak._values)
+            with slopes._lock:
+                slope_vals = dict(slopes._values)
+            for key, active in sorted(leak_vals.items()):
+                if active > 0:
+                    out[f"slope{dict(key)}"] = slope_vals.get(key)
+        return out
+
+
 _SIGNAL_KINDS = {
     "counter_ratio": RatioSignal,
     "histogram_latency": LatencySignal,
     "condition": ConditionSignal,
+    "trend": TrendSignal,
 }
 
 
@@ -473,6 +532,17 @@ def BUILTIN_SLOS() -> list[SloDefinition]:
                     ),
                 )
             ),
+        ),
+        SloDefinition(
+            name="resource_trend",
+            description=(
+                "no leak-gated flight-recorder series (RSS, engine "
+                "resident bytes, datastore rows, journal/manifest/AOT "
+                "artifact bytes) shows a sustained positive trend "
+                "(janus_flight_leak_active)"
+            ),
+            objective=0.99,
+            signal=TrendSignal(),
         ),
     ]
 

@@ -9,7 +9,7 @@
 Snapshots every introspection endpoint of one or several binaries'
 health listeners — /metrics (both exposition modes), /statusz,
 /debug/vars, /debug/traces, /debug/profile (collapsed + JSON),
-/debug/boot, /alertz, /readyz, /healthz — plus the
+/debug/boot, /debug/flight, /alertz, /readyz, /healthz — plus the
 resolved YAML config (secrets redacted) and the upload-journal
 directory state, into a timestamped tar.gz with a MANIFEST.json
 inventorying every capture (source, HTTP status, bytes, sha256). One
@@ -54,6 +54,10 @@ ENDPOINTS = (
     ("debug_profile", "/debug/profile"),
     ("debug_profile_json", "/debug/profile?format=json"),
     ("debug_boot", "/debug/boot"),
+    # telemetry flight recorder (ISSUE 18): the recent window + the
+    # slope/leak report — the long-horizon evidence a point-in-time
+    # snapshot can't reconstruct
+    ("debug_flight", "/debug/flight"),
 )
 
 _SECRET_KEY_RE = re.compile(r"(token|secret|password|key)s?$", re.IGNORECASE)
@@ -168,6 +172,62 @@ def shape_manifest_state(path: str, aot_dir: str | None = None) -> dict:
     return out
 
 
+def flight_dir_state(path: str) -> dict:
+    """Non-content inventory of the flight-recorder segment ring:
+    segment names/sizes/mtimes plus per-segment record/torn-line counts
+    from a READ-ONLY tolerant parse (`inspect_file` discipline — never
+    compact or rewrite what you are capturing as evidence; the torn
+    tail IS the evidence)."""
+    entries = []
+    total = 0
+    try:
+        names = sorted(os.listdir(path))
+    except OSError as e:
+        return {"path": path, "error": f"{type(e).__name__}: {e}"}
+    for name in names:
+        if not (name.startswith("flight-") and name.endswith(".jsonl")):
+            continue
+        full = os.path.join(path, name)
+        try:
+            st = os.stat(full)
+        except OSError:
+            continue
+        records = 0
+        torn = 0
+        tiers: dict[str, int] = {}
+        try:
+            with open(full, "rb") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        torn += 1
+                        continue
+                    records += 1
+                    tier = str(rec.get("tier", "?"))
+                    tiers[tier] = tiers.get(tier, 0) + 1
+        except OSError:
+            pass
+        entries.append(
+            {
+                "name": name,
+                "bytes": st.st_size,
+                "mtime": st.st_mtime,
+                "records": records,
+                "torn_lines": torn,
+                "tiers": tiers,
+            }
+        )
+        total += st.st_size
+    return {
+        "path": path,
+        "segments": entries,
+        "segment_count": len(entries),
+        "total_bytes": total,
+        "torn_lines": sum(e["torn_lines"] for e in entries),
+    }
+
+
 def collect_bundle(
     urls: list[str],
     out_path: str | None = None,
@@ -175,6 +235,7 @@ def collect_bundle(
     journal_dir: str | None = None,
     shape_manifest: str | None = None,
     aot_dir: str | None = None,
+    flight_dir: str | None = None,
     timeout: float = 10.0,
     now: float | None = None,
 ) -> dict:
@@ -283,6 +344,14 @@ def collect_bundle(
             f"shape_manifest:{shape_manifest}",
         )
 
+    if flight_dir:
+        state = flight_dir_state(flight_dir)
+        add_file(
+            f"{bundle_name}/flight-ring.json",
+            json.dumps(state, indent=2).encode(),
+            f"flight:{flight_dir}",
+        )
+
     manifest["bundle_path"] = os.path.abspath(out_path)
     manifest_bytes = json.dumps(manifest, indent=2, default=str).encode()
 
@@ -328,6 +397,11 @@ def main(argv=None) -> int:
         "manifest's sibling aot/ — the standard layout under the "
         "compile cache dir)",
     )
+    ap.add_argument(
+        "--flight-dir",
+        help="flight-recorder segment-ring dir to inventory (segment "
+        "names/sizes + record/torn-line counts, read-only)",
+    )
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
     manifest = collect_bundle(
@@ -337,6 +411,7 @@ def main(argv=None) -> int:
         journal_dir=args.journal_dir,
         shape_manifest=args.shape_manifest,
         aot_dir=args.aot_dir,
+        flight_dir=args.flight_dir,
         timeout=args.timeout,
     )
     errors = [f for f in manifest["files"] if f.get("error")]

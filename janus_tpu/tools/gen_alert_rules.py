@@ -20,6 +20,9 @@ docs/OBSERVABILITY.md):
     threshold alerts with `for:` set to the rung's short window —
     PromQL has no cheap equivalent of the engine's bad-tick ratio, and
     a threshold alert is what an operator wants from these anyway.
+  - trend SLOs (resource leaks) alert while the flight recorder's
+    janus_flight_leak_active verdict gauge is nonzero for the rung's
+    short window — the slope/noise analysis already ran in-process.
 """
 
 from __future__ import annotations
@@ -33,6 +36,7 @@ from ..slo import (
     LatencySignal,
     RatioSignal,
     SloDefinition,
+    TrendSignal,
     format_window,
 )
 
@@ -125,6 +129,13 @@ def rules_for(defs: list[SloDefinition]) -> dict:
                 for_ = None
             elif isinstance(d.signal, ConditionSignal):
                 expr = _condition_expr(d.signal, short_w)
+                for_ = short_w
+            elif isinstance(d.signal, TrendSignal):
+                # like conditions: the leak-verdict gauge is already a
+                # debounced boolean, so a threshold alert held for the
+                # rung's short window is the faithful translation
+                sel = f"{d.signal.metric}{_matchers_promql(d.signal.labels)}"
+                expr = f"(sum({sel}) > 0)"
                 for_ = short_w
             else:  # pragma: no cover - new signal kinds must be added here
                 raise TypeError(f"no PromQL translation for {type(d.signal).__name__}")

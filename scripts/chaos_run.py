@@ -2676,6 +2676,469 @@ def run_fleet(
         helper_ds.close()
 
 
+def run_soak(
+    epochs: int = 4,
+    reports_per_epoch: int = 8,
+    job_size: int = 4,
+    report_expiry_s: float = 30.0,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Endurance soak (ISSUE 18; docs/OBSERVABILITY.md "Flight recorder
+    and trend alerts"): sustained open-loop load with TIME-INTERVAL TASK
+    CHURN and GC actually deleting collected rows, judged by the flight
+    recorder's trend verdicts instead of a single end-state snapshot.
+
+      - one epoch = a fresh time-interval task (short report_expiry_age)
+        + an upload wave with known ground truth + aggregation by two
+        REAL driver binaries + an EXACT collection of that epoch + a GC
+        pass (old epochs' rows are expired by then and really deleted);
+      - driver A runs clean: its /debug/flight analysis must call
+        rss_bytes and datastore_rows FLAT over the trailing window (no
+        leak-gated series leaking), p99 families stable, recorder
+        self-overhead <= 1%, ring inside its byte budget, statusz
+        `flight` section fresh;
+      - driver B runs with the flight.synthetic_leak failpoint armed:
+        the injected leak must flip janus_flight_leak_active, land the
+        series in analysis.leaking, and fire the resource_trend SLO
+        alert on /alertz within the window_scale-shrunk ladder.
+
+    The smoke runs on sqlite in tier-1 minutes; the full run targets
+    PostgreSQL when JANUS_TEST_DATABASE_URL points at the server from
+    docker-compose.pg.yaml (falls back to sqlite otherwise). Every
+    `*_ok` key must be True to pass."""
+    import threading
+
+    import dataclasses
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+    from janus_tpu.aggregator.garbage_collector import GarbageCollector
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+    from janus_tpu.binary_utils import enable_compile_cache, warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, open_datastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-soak-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    clock = RealClock()
+    # the full run soaks the real PostgreSQL datastore when the
+    # docker-compose.pg.yaml server is up (JANUS_TEST_DATABASE_URL);
+    # the smoke — and a full run without the server — uses sqlite
+    pg_url = os.environ.get("JANUS_TEST_DATABASE_URL") if full else None
+    leader_db = pg_url or os.path.join(tmp, "leader.sqlite")
+    leader_ds = open_datastore(leader_db, Crypter([key_bytes]), clock)
+    helper_ds = open_datastore(
+        os.path.join(tmp, "helper.sqlite"), Crypter([key_bytes]), clock
+    )
+
+    # flight/SLO cadences: production-shaped in the full run, shrunk to
+    # tier-1 seconds in the smoke (window_scale turns the 1h/5m page
+    # ladder into 36s/3s — the injected leak fires the trend page in
+    # seconds instead of an hour)
+    flight_interval_s = 2.0 if full else 0.5
+    # a TRAILING window: long enough for robust slopes, short enough
+    # that by verdict time it covers steady state instead of the boot
+    # ramp (a window spanning the whole run would honestly — and
+    # uselessly — report "rows grew" for the fill phase)
+    flight_window_s = 600.0 if full else 15.0
+    window_scale = 0.1 if full else 0.01
+
+    def soak_extra(flight_dir: str) -> str:
+        return (
+            "max_concurrent_job_workers: 4\n"
+            "health_sampler_interval_secs: 0.5\n"
+            "flight:\n"
+            f"  dir: {flight_dir}\n"
+            f"  interval_secs: {flight_interval_s}\n"
+            "  analyze_every: 3\n"
+            f"  window_secs: {flight_window_s}\n"
+            "  min_points: 10\n"
+            "  rollup_secs: [2, 10]\n"
+            "  max_segment_bytes: 65536\n"
+            "  max_total_bytes: 262144\n"
+            "  latency_families: [janus_database_transaction_duration_seconds]\n"
+            "slo:\n"
+            "  evaluation_interval_secs: 0.25\n"
+            f"  window_scale: {window_scale}\n"
+        )
+
+    result: dict = {
+        "workdir": tmp,
+        "schedule": "soak_full" if full else "soak_smoke",
+        "engine": "postgres" if pg_url else "sqlite",
+        "epochs": epochs,
+        "reports_per_epoch": reports_per_epoch,
+    }
+    procs: list[subprocess.Popen] = []
+    leader_srv = helper_srv = None
+    try:
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+
+        vdaf = VdafInstance.count()
+
+        def provision_epoch_task(e: int):
+            """Task churn: each epoch gets its OWN time-interval task
+            with a short report_expiry_age, so by the time later epochs
+            run, earlier epochs' collected rows are expired and GC has
+            real rows to delete."""
+            collector_kp = generate_hpke_config_and_private_key(
+                config_id=100 + (e % 100)
+            )
+            leader_task = (
+                TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+                .with_(
+                    leader_aggregator_endpoint=leader_srv.url,
+                    helper_aggregator_endpoint=helper_srv.url,
+                    collector_hpke_config=collector_kp.config,
+                    aggregator_auth_token=AuthenticationToken.random_bearer(),
+                    collector_auth_token=AuthenticationToken.random_bearer(),
+                    min_batch_size=1,
+                    # a fine time precision keeps the report-timestamp
+                    # round-down well inside the short expiry window
+                    # (the default 1h precision would round every
+                    # report to "already expired")
+                    time_precision=Duration(5),
+                    report_expiry_age=Duration(int(report_expiry_s)),
+                )
+                .build()
+            )
+            helper_task = dataclasses.replace(
+                leader_task,
+                role=Role.HELPER,
+                hpke_keys=(generate_hpke_config_and_private_key(config_id=5),),
+            )
+            leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+            helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+            return leader_task, collector_kp
+
+        # provision epoch 0 before boot so the harness can pre-warm the
+        # engine programs into the shared compile cache (warm driver
+        # boots; the cache covers every later epoch's identical shapes)
+        epoch_tasks = [provision_epoch_task(0)]
+        enable_compile_cache()
+        warmup_engines(leader_ds, batch=job_size)
+
+        flight_dirs = {
+            "A": os.path.join(tmp, "flight-A"),
+            "B": os.path.join(tmp, "flight-B"),
+        }
+        ports: dict[str, int] = {}
+        for tag, failpoints in (("A", None), ("B", "flight.synthetic_leak=error:1.0")):
+            port = _free_port()
+            ports[tag] = port
+            cfg = _driver_cfg(
+                os.path.join(tmp, f"driver-{tag}.yaml"),
+                leader_db,
+                port,
+                8,
+                1.5,
+                extra=soak_extra(flight_dirs[tag]),
+            )
+            procs.append(
+                _spawn_driver(
+                    cfg, key, os.path.join(tmp, f"driver-{tag}.log"), failpoints
+                )
+            )
+        for port in ports.values():
+            _wait_healthz(port)
+
+        creator = AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=job_size
+            ),
+        )
+        gc_leader = GarbageCollector(leader_ds, clock)
+        gc_helper = GarbageCollector(helper_ds, clock)
+        http = HttpClient()
+
+        # background collection-job driver (the leader side of collect)
+        cdrv = CollectionJobDriver(leader_ds, HttpClient())
+        stop_collect = threading.Event()
+
+        def collect_loop():
+            cjd = JobDriver(
+                JobDriverConfig(job_discovery_interval_s=0.2),
+                cdrv.acquirer(60),
+                cdrv.stepper,
+            )
+            while not stop_collect.is_set():
+                cjd.run_once()
+                stop_collect.wait(0.3)
+
+        ct = threading.Thread(target=collect_loop, daemon=True)
+        ct.start()
+
+        def aggregation_idle(deadline_s: float) -> bool:
+            """Wait until no aggregation job is in a non-finished state
+            (GC-deleted jobs simply vanish from the counts)."""
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                counts = leader_ds.run_tx(
+                    lambda tx: tx.count_jobs_by_state(), "soak_monitor"
+                )
+                pending = sum(
+                    n
+                    for (typ, state), n in counts.items()
+                    if typ == "aggregation" and state != "finished"
+                )
+                if pending == 0:
+                    return True
+                time.sleep(0.1)
+            return False
+
+        gc_deleted_total = 0
+        epochs_exact = []
+        epoch_details = []
+        rows_by_epoch = []
+        try:
+            for e in range(epochs):
+                if e >= len(epoch_tasks):
+                    epoch_tasks.append(provision_epoch_task(e))
+                leader_task, collector_kp = epoch_tasks[e]
+                params = ClientParameters(
+                    leader_task.task_id,
+                    leader_srv.url,
+                    helper_srv.url,
+                    leader_task.time_precision,
+                )
+                client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+                t_epoch = clock.now()
+                wave = [(i % 3 != 0) * 1 for i in range(reports_per_epoch)]
+                for m in wave:
+                    client.upload(m)
+                creator.run_once()
+                # the drivers must finish this epoch's jobs before the
+                # collect — a collection issued mid-aggregation honestly
+                # reports only the shares aggregated so far
+                aggregation_idle(90.0)
+                # collection == admitted ground truth, CONTINUOUSLY:
+                # every epoch is collected exactly while churn and GC
+                # keep running around it (the collect itself polls the
+                # leader until the drivers finish the epoch's jobs).
+                # The batch interval anchors at the epoch's UPLOAD time
+                # — the fine precision means "now" at collect time can
+                # be several batch units past the wave.
+                tp = leader_task.time_precision
+                start = t_epoch.to_batch_interval_start(tp)
+                query = Query.time_interval(
+                    Interval(
+                        Time(start.seconds - tp.seconds), Duration(6 * tp.seconds)
+                    )
+                )
+                collector = Collector(
+                    CollectorParameters(
+                        leader_task.task_id,
+                        leader_srv.url,
+                        leader_task.collector_auth_token,
+                        collector_kp,
+                    ),
+                    vdaf,
+                    HttpClient(),
+                )
+                collected = collector.collect(query, timeout_s=120.0)
+                exact = (
+                    collected.report_count == len(wave)
+                    and collected.aggregate_result == sum(wave)
+                )
+                epochs_exact.append(exact)
+                epoch_details.append(
+                    {
+                        "admitted": len(wave),
+                        "sum": sum(wave),
+                        "collected_count": collected.report_count,
+                        "collected_sum": collected.aggregate_result,
+                    }
+                )
+                # GC pass after every epoch: earlier epochs' rows age
+                # past report_expiry_age mid-run and must REALLY vanish
+                deleted = gc_leader.run_once()
+                gc_helper.run_once()
+                gc_deleted_total += sum(deleted.values())
+                rows_by_epoch.append(
+                    sum(
+                        leader_ds.run_tx(
+                            lambda tx: tx.count_table_rows(), "soak_monitor"
+                        ).values()
+                    )
+                )
+        finally:
+            stop_collect.set()
+            ct.join(timeout=10)
+
+        result["epochs_exact"] = epochs_exact
+        result["epoch_details"] = epoch_details
+        result["epochs_exact_ok"] = bool(epochs_exact) and all(epochs_exact)
+        result["leader_rows_by_epoch"] = rows_by_epoch
+
+        # keep GC pressure on until expiry has provably deleted rows
+        # (the last epochs' reports only expire after the loop)
+        gc_deadline = time.monotonic() + (60 if full else 30)
+        while gc_deleted_total == 0 and time.monotonic() < gc_deadline:
+            time.sleep(1.0)
+            gc_deleted_total += sum(gc_leader.run_once().values())
+            gc_helper.run_once()
+        result["gc_deleted_rows"] = gc_deleted_total
+        result["gc_deleted_ok"] = gc_deleted_total > 0
+
+        # --- verdict phase: the drivers idle on steady state while the
+        # recorder's trailing window sheds the boot/ramp-up slope ------
+        def flight_doc(tag: str, window_s: float | None = None) -> dict:
+            q = f"?window_secs={window_s:g}" if window_s else ""
+            return json.loads(_scrape(ports[tag], f"/debug/flight{q}"))
+
+        judge_window_s = 6 * flight_interval_s + 2.0  # >= min_points span
+        deadline = time.monotonic() + (120 if full else 45)
+        fa: dict = {}
+        while time.monotonic() < deadline:
+            fa = flight_doc("A", judge_window_s)
+            sv = fa.get("analysis", {}).get("series", {})
+            # settle poll: the first trailing windows still straddle the
+            # final epoch's churn; the steady-state question is whether
+            # the series SETTLE to flat, not the first verdict computed
+            if all(
+                sv.get(n, {}).get("verdict") == "flat"
+                for n in ("rss_bytes", "datastore_rows")
+            ) and not fa.get("analysis", {}).get("leaking"):
+                break
+            time.sleep(1.0)
+        series_a = fa.get("analysis", {}).get("series", {})
+        result["flight_a_verdicts"] = {
+            n: d.get("verdict") for n, d in series_a.items()
+        }
+        result["flight_a_slopes"] = {
+            n: d.get("slope_per_s") for n, d in series_a.items()
+        }
+        # THE soak invariant: sustained load + churn + GC leaves the
+        # leak-gated resource series FLAT over the trailing window
+        result["zero_slope_ok"] = all(
+            series_a.get(n, {}).get("verdict") == "flat"
+            for n in ("rss_bytes", "datastore_rows")
+        ) and not fa.get("analysis", {}).get("leaking")
+        # p99 window-vs-window over the FULL recorder window (the 5s
+        # judge window has too few txs per half for a stable quantile)
+        latency_a = flight_doc("A").get("analysis", {}).get("latency", {})
+        result["p99_verdicts"] = {f: d.get("verdict") for f, d in latency_a.items()}
+        result["p99_stable_ok"] = all(
+            d.get("verdict") != "degraded" for d in latency_a.values()
+        )
+        result["recorder_overhead_ratio"] = fa.get("overhead_ratio")
+        result["overhead_ok"] = (
+            fa.get("overhead_ratio") is not None and fa["overhead_ratio"] <= 0.01
+        )
+        ring = fa.get("ring") or {}
+        result["ring"] = ring
+        result["ring_budget_ok"] = (
+            ring.get("segments", 0) >= 1
+            and ring.get("bytes", 1 << 60) <= 262144
+        )
+        statusz = json.loads(_scrape(ports["A"], "/statusz"))
+        fl = statusz.get("flight", {})
+        age = fl.get("last_snapshot_age_s")
+        result["statusz_flight_fresh_ok"] = (
+            fl.get("enabled") is True
+            and fl.get("running") is True
+            and age is not None
+            and age <= 3 * flight_interval_s + 2.0
+        )
+        # the gauge follows the PERIODIC analysis over the full window;
+        # right after the last epoch that window can still contain the
+        # fill ramp — the clean-driver claim is that it settles to zero
+        no_leak = False
+        settle_deadline = time.monotonic() + (60 if full else 30)
+        while time.monotonic() < settle_deadline:
+            leak_a = _metric_samples(
+                _scrape(ports["A"], "/metrics"), "janus_flight_leak_active"
+            )
+            no_leak = sum(leak_a.values()) == 0.0
+            if no_leak:
+                break
+            time.sleep(1.0)
+        result["clean_driver_no_leak_ok"] = no_leak
+
+        # --- injected-leak negative control: driver B ----------------
+        leak_seen = alert_fired = False
+        deadline = time.monotonic() + (120 if full else 45)
+        fb: dict = {}
+        while time.monotonic() < deadline:
+            fb = flight_doc("B")
+            leak_seen = "synthetic_leak_bytes" in (
+                fb.get("analysis", {}).get("leaking") or []
+            )
+            if leak_seen:
+                alertz = json.loads(_scrape(ports["B"], "/alertz"))
+                alert_fired = any(
+                    f.startswith("resource_trend/")
+                    for f in alertz.get("firing", [])
+                )
+                if alert_fired:
+                    break
+            time.sleep(0.5)
+        result["leak_detected_ok"] = leak_seen
+        result["trend_alert_fired_ok"] = alert_fired
+        leak_b = _metric_samples(
+            _scrape(ports["B"], "/metrics"), "janus_flight_leak_active"
+        )
+        result["leak_gauge_ok"] = any(
+            'series="synthetic_leak_bytes"' in k and v == 1.0
+            for k, v in leak_b.items()
+        )
+        result["flight_b_leaking"] = fb.get("analysis", {}).get("leaking")
+
+        # drain both drivers cleanly
+        drain_ok = True
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                rc = p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rc = None
+            drain_ok = drain_ok and rc == 0
+        result["drain_ok"] = drain_ok
+
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        failpoints_mod = sys.modules.get("janus_tpu.failpoints")
+        if failpoints_mod is not None:
+            failpoints_mod.clear()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if leader_srv is not None:
+            leader_srv.stop()
+        if helper_srv is not None:
+            helper_srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -2688,7 +3151,7 @@ def main(argv=None) -> int:
         "--scenario",
         choices=[
             "crash_storm", "db_outage", "device_hang", "pipeline", "resident",
-            "cold_start", "fleet",
+            "cold_start", "fleet", "soak",
         ],
         default="crash_storm",
         help="crash_storm = driver SIGKILL + helper storms (default); "
@@ -2706,7 +3169,11 @@ def main(argv=None) -> int:
         "speedup gated); fleet = N real driver replicas over one "
         "store (sharded batched claims): served-rps scaling at 1/2/4 "
         "replicas, SIGKILL + SIGTERM + restart mid-load, zero lease "
-        "conflicts, exact collection",
+        "conflicts, exact collection; soak = endurance soak under task "
+        "churn + GC deletion, judged by flight-recorder trend verdicts "
+        "(zero-slope on clean driver, injected leak fires the trend "
+        "alert; full run targets PostgreSQL via docker-compose.pg.yaml "
+        "when JANUS_TEST_DATABASE_URL is set)",
     )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
@@ -2745,6 +3212,14 @@ def main(argv=None) -> int:
         )
     elif args.scenario == "fleet":
         result = run_fleet(
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    elif args.scenario == "soak":
+        result = run_soak(
+            epochs=4 if args.smoke else 12,
+            reports_per_epoch=args.reports or (8 if args.smoke else 24),
+            report_expiry_s=30.0 if args.smoke else 120.0,
             full=not args.smoke,
             workdir=args.workdir,
         )

@@ -158,6 +158,22 @@ def main(argv=None) -> int:
         # in every binary, so absence is a deploy regression
         "janus_engine_scatter_rows_total",
         "janus_engine_sparse_block_occupancy",
+        # flight recorder: telemetry history + trend/leak verdicts
+        # (ISSUE 18) — registered at import in every binary
+        "janus_flight_slope",
+        "janus_flight_leak_active",
+        "janus_flight_p99_ratio",
+        "janus_flight_snapshots_total",
+        "janus_flight_ring_bytes",
+        "janus_flight_ring_segments",
+        "janus_flight_overhead_ratio",
+        # lifecycle gauges the recorder trends (ISSUE 18 satellites)
+        "janus_gc_deleted_rows_total",
+        "janus_gc_tasks_scanned_total",
+        "janus_gc_runs_total",
+        "janus_gc_lag_seconds",
+        "janus_datastore_table_rows",
+        "janus_artifact_bytes",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -324,6 +340,40 @@ def main(argv=None) -> int:
                                     f"/statusz device_cost entry missing {key!r}"
                                 )
                                 break
+                # telemetry flight recorder (ISSUE 18): every binary
+                # installs it by default; a running recorder whose last
+                # snapshot has gone stale is a deploy regression — the
+                # long-horizon evidence trail has silently stopped
+                fr = snap.get("flight")
+                if not isinstance(fr, dict):
+                    errors.append("/statusz missing the flight section")
+                else:
+                    for key in (
+                        "enabled",
+                        "running",
+                        "series_tracked",
+                        "last_snapshot_age_s",
+                        "leaks_active",
+                    ):
+                        if key not in fr:
+                            errors.append(f"/statusz flight missing {key!r}")
+                    if fr.get("enabled") and fr.get("running"):
+                        age = fr.get("last_snapshot_age_s")
+                        stale_after = max(3 * float(fr.get("interval_s") or 10.0), 30.0)
+                        if age is None:
+                            errors.append(
+                                "/statusz flight recorder running but never snapshotted"
+                            )
+                        elif float(age) > stale_after:
+                            errors.append(
+                                f"/statusz flight last snapshot {age}s old "
+                                f"(stale after {stale_after:g}s) — the recorder "
+                                "has stopped recording"
+                            )
+                    elif fr.get("enabled") and not fr.get("running"):
+                        errors.append(
+                            "/statusz flight recorder enabled but not running"
+                        )
 
     # /readyz semantics (docs/ROBUSTNESS.md "Datastore outages"): 200
     # with {"ready": true} when serving, 503 with a JSON reason map when
@@ -432,6 +482,30 @@ def main(argv=None) -> int:
                 "regression)"
             )
 
+    # telemetry flight recorder (ISSUE 18): /debug/flight must serve a
+    # well-formed history + trend-analysis document on every binary
+    # (the recorder is on by default; even a disabled one answers
+    # enabled: false with the document shape intact)
+    try:
+        body, ctype = _fetch(base + "/debug/flight", args.timeout)
+        flight = json.loads(body)
+    except Exception as e:
+        errors.append(f"/debug/flight not valid JSON: {e}")
+    else:
+        if not ctype.startswith("application/json"):
+            errors.append(f"/debug/flight Content-Type: {ctype!r}")
+        for key in ("enabled", "series_tracked", "snapshots", "analysis"):
+            if key not in flight:
+                errors.append(f"/debug/flight missing {key!r}")
+        if flight.get("enabled"):
+            for key in ("window_s", "snapshots_total", "overhead_ratio", "ring"):
+                if key not in flight:
+                    errors.append(f"/debug/flight missing {key!r}")
+            analysis = flight.get("analysis") or {}
+            for key in ("series", "latency", "leaking"):
+                if key not in analysis:
+                    errors.append(f"/debug/flight analysis missing {key!r}")
+
     # boot-phase timeline (ISSUE 13): /debug/boot is one contiguous,
     # monotone phase sequence from process start
     try:
@@ -461,7 +535,14 @@ def main(argv=None) -> int:
     else:
         if not ctype.startswith("text/html"):
             errors.append(f"GET / Content-Type not HTML: {ctype!r}")
-        for link in ("/metrics", "/statusz", "/alertz", "/debug/traces", "/readyz"):
+        for link in (
+            "/metrics",
+            "/statusz",
+            "/alertz",
+            "/debug/traces",
+            "/debug/flight",
+            "/readyz",
+        ):
             if link not in body:
                 errors.append(f"GET / index page does not link {link}")
 

@@ -1,0 +1,523 @@
+"""Flight recorder (janus_tpu/flight_recorder.py): config parsing, the
+Theil-Sen trend estimator, the bounded on-disk ring, rollup tiers, leak
+and p99 verdicts (including the injected-leak failpoint), and the
+process-wide install surface (statusz `flight` section, /debug/flight
+document)."""
+
+import json
+import os
+import types
+
+import pytest
+
+from janus_tpu import failpoints
+from janus_tpu import flight_recorder as flight
+from janus_tpu import metrics, slo, statusz
+from janus_tpu.flight_recorder import (
+    BUILTIN_SERIES,
+    FlightRecorder,
+    FlightRecorderConfig,
+    SeriesSpec,
+    _p99_from_bucket_delta,
+    _Ring,
+    _RollupTier,
+    theil_sen,
+)
+
+
+class FakeTime:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+def test_config_from_dict_defaults():
+    cfg = FlightRecorderConfig.from_dict(None)
+    assert cfg.enabled is True
+    assert cfg.interval_s == 10.0
+    assert cfg.dir is None
+    assert cfg.window_s == 3600.0
+    assert cfg.rollup_secs == (60.0, 600.0)
+    assert cfg.analyze_every == 3
+    assert cfg.p99_min_samples == 16
+    assert cfg.latency_families == ("janus_http_request_duration_seconds",)
+
+
+def test_config_from_dict_yaml_keys_and_clamps():
+    cfg = FlightRecorderConfig.from_dict(
+        {
+            "enabled": False,
+            "interval_secs": 2,
+            "dir": "/tmp/fr",
+            "max_total_bytes": 1 << 20,
+            "max_segment_bytes": 1 << 16,
+            "window_secs": 120,
+            "rollup_secs": [5, 30],
+            "analyze_every": 0,  # clamped to 1
+            "min_points": 4,
+            "noise_mult": 2.0,
+            "min_growth_ratio": 0.1,
+            "p99_max_ratio": 3.0,
+            "p99_min_samples": 8,
+            "latency_families": ["janus_database_transaction_duration_seconds"],
+        }
+    )
+    assert cfg.enabled is False
+    assert cfg.interval_s == 2.0
+    assert cfg.dir == "/tmp/fr"
+    assert cfg.window_s == 120.0
+    assert cfg.rollup_secs == (5.0, 30.0)
+    assert cfg.analyze_every == 1
+    assert cfg.p99_min_samples == 8
+    assert cfg.latency_families == ("janus_database_transaction_duration_seconds",)
+
+
+def test_series_spec_rejects_unknown_source():
+    with pytest.raises(ValueError):
+        SeriesSpec.from_dict({"name": "x", "source": "proc"})
+
+
+def test_build_series_merges_yaml_over_builtins_by_name():
+    builtin_names = [s.name for s in BUILTIN_SERIES()]
+    assert "rss_bytes" in builtin_names
+    assert "datastore_rows" in builtin_names
+    # gc counter is recorded but never leak-gated
+    gc = {s.name: s for s in BUILTIN_SERIES()}["gc_deleted_rows"]
+    assert gc.leak is False
+    cfg = FlightRecorderConfig(
+        series=(
+            # override a builtin by name (turn off its leak gate)
+            {"name": "rss_bytes", "source": "rss", "leak": False},
+            # add a custom one
+            {"name": "queue_depth", "metric": "janus_dispatch_queue_depth"},
+        )
+    )
+    specs = {s.name: s for s in cfg.build_series()}
+    assert len(specs) == len(builtin_names) + 1
+    assert specs["rss_bytes"].leak is False
+    assert specs["queue_depth"].metric == "janus_dispatch_queue_depth"
+    assert specs["queue_depth"].leak is True
+
+
+# ---------------------------------------------------------------------------
+# trend estimation
+# ---------------------------------------------------------------------------
+
+
+def test_theil_sen_exact_on_linear_data():
+    pts = [(float(t), 3.0 * t + 7.0) for t in range(20)]
+    slope, intercept, mad = theil_sen(pts)
+    assert slope == pytest.approx(3.0)
+    assert intercept == pytest.approx(7.0)
+    assert mad == pytest.approx(0.0)
+
+
+def test_theil_sen_robust_to_outliers():
+    # one wild outlier (a GC pause spike) must not move the slope the
+    # way least squares would
+    pts = [(float(t), 2.0 * t) for t in range(21)]
+    pts[10] = (10.0, 1e6)
+    slope, _, mad = theil_sen(pts)
+    assert slope == pytest.approx(2.0, rel=0.05)
+    assert mad < 1.0
+
+
+def test_theil_sen_degenerate_inputs():
+    assert theil_sen([]) == (0.0, 0.0, 0.0)
+    assert theil_sen([(1.0, 5.0)]) == (0.0, 5.0, 0.0)
+    # >60 points decimates but stays exact on linear data
+    pts = [(float(t), 0.5 * t) for t in range(500)]
+    slope, _, _ = theil_sen(pts)
+    assert slope == pytest.approx(0.5)
+
+
+def test_p99_from_bucket_delta():
+    bounds = (0.01, 0.1, 1.0)
+    # cumulative [b<=0.01, b<=0.1, b<=1.0, total]
+    early = [0.0, 0.0, 0.0, 0.0]
+    late = [100.0, 100.0, 100.0, 100.0]
+    assert _p99_from_bucket_delta(bounds, early, late) == 0.01
+    # everything past the last bound -> +Inf
+    assert _p99_from_bucket_delta(bounds, [0, 0, 0, 0], [0, 0, 0, 50]) == float("inf")
+    # no observations in the delta window
+    assert _p99_from_bucket_delta(bounds, late, late) is None
+
+
+# ---------------------------------------------------------------------------
+# the on-disk ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_rotation_budget_and_read(tmp_path):
+    ring = _Ring(str(tmp_path / "ring"), max_segment_bytes=1, max_total_bytes=8192)
+    assert ring.max_segment_bytes == 4096  # clamped floor
+    pad = "x" * 80
+    for i in range(300):
+        ring.append({"t": float(i), "tier": "raw", "v": {"s": float(i)}, "pad": pad})
+    st = ring.state()
+    assert set(st) == {"dir", "segments", "bytes", "dropped_segments", "torn_lines_skipped"}
+    assert st["dropped_segments"] > 0
+    # enforcement runs at rotation; the filled active segment can sit on
+    # top of the budget but never a whole extra segment beyond that
+    assert st["bytes"] <= 8192 + 4096
+    recs = ring.read()
+    assert recs, "oldest segments dropped but recent records survive"
+    assert recs[-1]["v"]["s"] == 299.0
+    # read() filters by time and tier
+    assert all(r["t"] >= 290.0 for r in ring.read(since_unix=290.0))
+    assert ring.read(tier="60") == []
+    ring.close()
+
+
+def test_ring_torn_tail_tolerated(tmp_path):
+    ring = _Ring(str(tmp_path / "ring"), max_segment_bytes=4096, max_total_bytes=65536)
+    for i in range(3):
+        ring.append({"t": float(i), "tier": "raw", "v": {}})
+    # simulate a crash mid-append: garbage tail on the active segment
+    ring._fh.write(b'{"t": 99, "tier": "raw", "v"')
+    ring._fh.flush()
+    recs = ring.read()
+    assert [r["t"] for r in recs] == [0.0, 1.0, 2.0]
+    assert ring.state()["torn_lines_skipped"] == 1
+    ring.close()
+
+
+def test_rollup_tier_emits_bucket_stats():
+    tier = _RollupTier(10.0)
+    assert tier.feed(0.0, {"a": 1.0}) is None
+    assert tier.feed(4.0, {"a": 3.0}) is None
+    assert tier.feed(8.0, {"a": 2.0}) is None
+    emitted = tier.feed(12.0, {"a": 9.0})  # bucket 0 -> 1 completes bucket 0
+    assert emitted == {
+        "t": 0.0,
+        "tier": "10",
+        "v": {"a": {"mean": 2.0, "min": 1.0, "max": 3.0, "n": 3}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# snapshot + verdicts
+# ---------------------------------------------------------------------------
+
+
+def _recorder(fake, gauge_name, **cfg_kw):
+    """A recorder tracking exactly one leak-gated test gauge (the
+    builtin series read live process state and would be noise here)."""
+    cfg_kw.setdefault("window_s", 100.0)
+    cfg_kw.setdefault("min_points", 5)
+    cfg_kw.setdefault("latency_families", ())
+    fr = FlightRecorder(FlightRecorderConfig(**cfg_kw), time_fn=fake)
+    fr.series = [SeriesSpec(name="test_series", metric=gauge_name, leak=True)]
+    return fr
+
+
+def test_leak_verdict_on_growing_series():
+    fake = FakeTime()
+    g = metrics.REGISTRY.gauge("janus_test_flight_growing")
+    fr = _recorder(fake, "janus_test_flight_growing")
+    for i in range(20):
+        g.set(1000.0 + 500.0 * i)
+        fr.snapshot_once()
+        fake.advance(5.0)
+    analysis = fr.analyze()
+    doc = analysis["series"]["test_series"]
+    assert doc["verdict"] == "leak"
+    assert doc["slope_per_s"] == pytest.approx(100.0, rel=0.01)
+    assert "test_series" in analysis["leaking"]
+    assert metrics.flight_leak_active.get(series="test_series") == 1.0
+    assert metrics.flight_slope.get(series="test_series") == pytest.approx(
+        100.0, rel=0.01
+    )
+
+
+def test_flat_verdict_on_stable_series():
+    fake = FakeTime()
+    g = metrics.REGISTRY.gauge("janus_test_flight_flat")
+    fr = _recorder(fake, "janus_test_flight_flat")
+    for i in range(20):
+        g.set(1000.0 + (1.0 if i % 2 else -1.0))  # bounded jitter
+        fr.snapshot_once()
+        fake.advance(5.0)
+    analysis = fr.analyze()
+    assert analysis["series"]["test_series"]["verdict"] == "flat"
+    assert analysis["leaking"] == []
+    assert metrics.flight_leak_active.get(series="test_series") == 0.0
+
+
+def test_relative_floor_ignores_tiny_drift_on_large_level():
+    # 0.1/s drift on a ~1e9 level: projected window growth is far below
+    # min_growth_ratio * level, so it's flat even though the slope is
+    # cleanly positive
+    fake = FakeTime()
+    g = metrics.REGISTRY.gauge("janus_test_flight_drift")
+    fr = _recorder(fake, "janus_test_flight_drift")
+    for i in range(20):
+        g.set(1e9 + 0.1 * 5.0 * i)
+        fr.snapshot_once()
+        fake.advance(5.0)
+    doc = fr.analyze()["series"]["test_series"]
+    assert doc["slope_per_s"] > 0
+    assert doc["verdict"] == "flat"
+
+
+def test_insufficient_data_below_min_points():
+    fake = FakeTime()
+    g = metrics.REGISTRY.gauge("janus_test_flight_sparse")
+    fr = _recorder(fake, "janus_test_flight_sparse", min_points=8)
+    for i in range(3):
+        g.set(float(i))
+        fr.snapshot_once()
+        fake.advance(5.0)
+    doc = fr.analyze()["series"]["test_series"]
+    assert doc["verdict"] == "insufficient_data"
+    assert doc["points"] == 3
+
+
+def test_synthetic_leak_failpoint_drives_detector():
+    """The injected-leak negative test: arming flight.synthetic_leak
+    grows a synthetic leak-gated series every snapshot, the analyzer
+    calls it a leak, and janus_flight_leak_active flips to 1."""
+    fake = FakeTime()
+    fr = _recorder(fake, "janus_test_flight_unused")
+    failpoints.configure("flight.synthetic_leak=error:1.0")
+    try:
+        for _ in range(15):
+            fr.snapshot_once()
+            fake.advance(5.0)
+    finally:
+        failpoints.clear()
+    analysis = fr.analyze()
+    assert "synthetic_leak_bytes" in analysis["leaking"]
+    assert analysis["series"]["synthetic_leak_bytes"]["verdict"] == "leak"
+    assert metrics.flight_leak_active.get(series="synthetic_leak_bytes") == 1.0
+    # trend SLO signal sees the live gauge
+    sig = slo.TrendSignal()
+    engine = types.SimpleNamespace(_condition_state={})
+    bad, total, has_data = sig.read(engine)
+    assert has_data is True and bad == 1.0 and total == 1.0
+    evidence = sig.evidence()
+    assert any("synthetic_leak_bytes" in k for k in evidence)
+    # disarmed + flat window clears the gauge again
+    fr2 = _recorder(fake, "janus_test_flight_unused")
+    fr2._synthetic_bytes = fr._synthetic_bytes
+    for _ in range(15):
+        fr2.snapshot_once()
+        fake.advance(5.0)
+    assert fr2.analyze()["leaking"] == []
+    assert metrics.flight_leak_active.get(series="synthetic_leak_bytes") == 0.0
+
+
+def test_ring_receives_raw_and_rollup_records(tmp_path):
+    fake = FakeTime()
+    g = metrics.REGISTRY.gauge("janus_test_flight_ringed")
+    fr = _recorder(
+        fake,
+        "janus_test_flight_ringed",
+        dir=str(tmp_path / "ring"),
+        rollup_secs=(20.0,),
+    )
+    g.set(5.0)
+    for _ in range(10):
+        fr.snapshot_once()
+        fake.advance(5.0)
+    raw = fr._ring.read(tier="raw")
+    rollups = fr._ring.read(tier="20")
+    assert len(raw) == 10
+    assert rollups, "completed 20s buckets emit rollup records"
+    assert rollups[0]["v"]["test_series"] == {
+        "mean": 5.0,
+        "min": 5.0,
+        "max": 5.0,
+        "n": 4,
+    }
+    fr.stop()
+
+
+# ---------------------------------------------------------------------------
+# p99 window-vs-window
+# ---------------------------------------------------------------------------
+
+
+def _latency_recorder(fake, family, **cfg_kw):
+    cfg_kw.setdefault("window_s", 100.0)
+    fr = FlightRecorder(
+        FlightRecorderConfig(latency_families=(family,), **cfg_kw), time_fn=fake
+    )
+    fr.series = []
+    return fr
+
+
+def test_p99_degraded_when_late_window_slows():
+    fake = FakeTime()
+    h = metrics.REGISTRY.histogram("janus_test_flight_lat_degraded_seconds")
+    fr = _latency_recorder(fake, "janus_test_flight_lat_degraded_seconds")
+    fr.snapshot_once()  # baseline
+    for _ in range(32):
+        h.observe(0.005)  # early window: fast
+    fake.advance(10.0)
+    fr.snapshot_once()  # mid
+    for _ in range(32):
+        h.observe(5.0)  # late window: slow
+    fake.advance(10.0)
+    fr.snapshot_once()
+    doc = fr.analyze()["latency"]["janus_test_flight_lat_degraded_seconds"]
+    assert doc["verdict"] == "degraded"
+    assert doc["p99_ratio"] > 2.0
+    assert metrics.flight_p99_ratio.get(family="janus_test_flight_lat_degraded_seconds") > 2.0
+
+
+def test_p99_stable_when_both_windows_match():
+    fake = FakeTime()
+    h = metrics.REGISTRY.histogram("janus_test_flight_lat_stable_seconds")
+    fr = _latency_recorder(fake, "janus_test_flight_lat_stable_seconds")
+    fr.snapshot_once()  # baseline
+    for _ in range(32):
+        h.observe(0.02)
+    fake.advance(10.0)
+    fr.snapshot_once()  # mid
+    for _ in range(32):
+        h.observe(0.02)
+    fake.advance(10.0)
+    fr.snapshot_once()
+    doc = fr.analyze()["latency"]["janus_test_flight_lat_stable_seconds"]
+    assert doc["verdict"] == "stable"
+    assert doc["p99_ratio"] == pytest.approx(1.0)
+
+
+def test_p99_insufficient_below_min_samples():
+    # a handful of observations per half is pure noise, not a verdict
+    fake = FakeTime()
+    h = metrics.REGISTRY.histogram("janus_test_flight_lat_sparse_seconds")
+    fr = _latency_recorder(fake, "janus_test_flight_lat_sparse_seconds", p99_min_samples=16)
+    fr.snapshot_once()  # baseline
+    for _ in range(4):
+        h.observe(0.005)
+    fake.advance(10.0)
+    fr.snapshot_once()  # mid
+    for _ in range(4):
+        h.observe(5.0)
+    fake.advance(10.0)
+    fr.snapshot_once()
+    doc = fr.analyze()["latency"]["janus_test_flight_lat_sparse_seconds"]
+    assert doc["verdict"] == "insufficient_data"
+    assert doc["early_n"] == 4 and doc["late_n"] == 4
+
+
+# ---------------------------------------------------------------------------
+# serving surface
+# ---------------------------------------------------------------------------
+
+
+def test_document_and_status_shapes(tmp_path):
+    fake = FakeTime()
+    g = metrics.REGISTRY.gauge("janus_test_flight_doc")
+    fr = _recorder(fake, "janus_test_flight_doc", dir=str(tmp_path / "ring"))
+    g.set(1.0)
+    for _ in range(6):
+        fr.snapshot_once()
+        fake.advance(5.0)
+    doc = fr.document()
+    assert doc["enabled"] is True
+    assert doc["series_tracked"] == ["test_series"]
+    assert doc["snapshots_total"] == 6
+    assert len(doc["snapshots"]) == 6
+    assert doc["ring"]["segments"] >= 1
+    assert doc["analysis"]["series"]["test_series"]["verdict"] in ("flat", "leak")
+    # document decimates to max_points evenly
+    small = fr.document(max_points=3)
+    assert len(small["snapshots"]) == 3
+    st = fr.status()
+    assert st["running"] is False
+    assert st["snapshots"] == 6
+    assert st["last_snapshot_age_s"] == pytest.approx(5.0)
+    assert st["leaks_active"] == {}
+    fr.stop()
+
+
+def test_install_uninstall_and_statusz_section():
+    prev = flight.get_flight_recorder()
+    try:
+        fr = flight.install_flight_recorder(
+            FlightRecorderConfig(interval_s=60.0), start=False
+        )
+        assert flight.get_flight_recorder() is fr
+        fr.snapshot_once()
+        snap = statusz.status_snapshot()
+        assert "flight" in snap
+        assert snap["flight"]["enabled"] is True
+        assert snap["flight"]["snapshots"] == 1
+        doc = flight.flight_document()
+        assert doc["enabled"] is True and doc["snapshots_total"] == 1
+        flight.uninstall_flight_recorder()
+        assert flight.get_flight_recorder() is None
+        assert "flight" not in statusz.status_snapshot()
+        assert flight.flight_document() == {
+            "enabled": False,
+            "series_tracked": [],
+            "snapshots": [],
+            "analysis": {},
+        }
+    finally:
+        flight.uninstall_flight_recorder()
+        if prev is not None:
+            flight.install_flight_recorder(prev.cfg, start=False)
+
+
+def test_disabled_config_still_installs_statusz_section():
+    prev = flight.get_flight_recorder()
+    try:
+        flight.install_flight_recorder(
+            FlightRecorderConfig(enabled=False), start=True
+        )
+        fr = flight.get_flight_recorder()
+        assert fr is not None and fr.running is False
+        assert statusz.status_snapshot()["flight"]["enabled"] is False
+    finally:
+        flight.uninstall_flight_recorder()
+        if prev is not None:
+            flight.install_flight_recorder(prev.cfg, start=False)
+
+
+def test_recorder_loop_start_stop():
+    fr = flight.FlightRecorder(FlightRecorderConfig(interval_s=0.02, min_points=2))
+    fr.series = []
+    fr.start()
+    try:
+        deadline = 50
+        while fr._snapshots < 2 and deadline:
+            import time as _time
+
+            _time.sleep(0.02)
+            deadline -= 1
+        assert fr._snapshots >= 2
+        assert fr.running is True
+    finally:
+        fr.stop()
+    assert fr.running is False
+    assert fr.status()["overhead_ratio"] < 0.5  # trivially cheap series set
+
+
+def test_ring_records_are_valid_jsonl(tmp_path):
+    fake = FakeTime()
+    fr = _recorder(fake, "janus_test_flight_jsonl", dir=str(tmp_path / "ring"))
+    for _ in range(3):
+        fr.snapshot_once()
+        fake.advance(1.0)
+    fr.stop()
+    files = sorted(os.listdir(tmp_path / "ring"))
+    assert files and all(f.startswith("flight-") and f.endswith(".jsonl") for f in files)
+    with open(tmp_path / "ring" / files[0]) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            assert rec["tier"] == "raw" and "t" in rec and "v" in rec

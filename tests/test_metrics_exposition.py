@@ -180,15 +180,20 @@ def health_server():
     from janus_tpu import profiler as prof
     from janus_tpu.binary_utils import HealthServer
 
-    # the real binaries run the continuous profiler (janus_main installs
-    # it by default) and scrape_check enforces that — the fixture
-    # matches the deploy shape
+    from janus_tpu import flight_recorder as flight
+
+    # the real binaries run the continuous profiler and the flight
+    # recorder (janus_main installs both by default) and scrape_check
+    # enforces that — the fixture matches the deploy shape
     prof.install_profiler(prof.ProfilerConfig(hz=100.0, window_secs=10.0))
+    fr = flight.install_flight_recorder(flight.FlightRecorderConfig(interval_s=0.2))
+    fr.snapshot_once()
     srv = HealthServer("127.0.0.1:0").start()
     try:
         yield f"http://127.0.0.1:{srv.port}"
     finally:
         srv.stop()
+        flight.uninstall_flight_recorder()
         prof.uninstall_profiler()
 
 

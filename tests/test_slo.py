@@ -322,6 +322,7 @@ def test_builtin_definitions_cover_the_paper_surface():
         "collect_latency",
         "datastore_up",
         "device_health",
+        "resource_trend",
     }
     for d in slo.BUILTIN_SLOS():
         assert 0 < d.objective < 1
@@ -396,7 +397,7 @@ def test_install_uninstall_and_alertz_snapshot():
         engine.evaluate_once()
         doc = slo.alertz_snapshot()
         assert doc["enabled"] is True
-        assert len(doc["slos"]) == 5
+        assert len(doc["slos"]) == 6
         assert all("burn_rates" in s for s in doc["slos"])
         # the statusz section is registered and compact
         from janus_tpu.statusz import status_snapshot

@@ -111,7 +111,7 @@ def test_bench_dry_run_smoke():
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
         [sys.executable, "bench.py", "--dry-run", "--config", "count"],
-        cwd=repo, env=env, capture_output=True, text=True, timeout=900,
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1200,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
@@ -215,6 +215,7 @@ def test_bench_dry_run_smoke():
         "debug_profile",
         "debug_profile_json",
         "debug_boot",
+        "debug_flight",
     }
     obs = rec["observability_smoke"]
     assert obs["scrape_valid"] is True, obs.get("scrape_errors")
@@ -439,6 +440,19 @@ def test_bench_dry_run_smoke():
     assert sp["scatter_path_observed"] is True
     assert sp["scatter_rows"] > 0
     assert 0.0 < sp["block_occupancy"] <= 1.0
+    # ISSUE 18: the endurance-soak smoke — churn + GC + exact per-epoch
+    # collection, flight-recorder zero-slope verdicts on the clean
+    # driver (self-overhead <= 1%), injected leak fires the trend alert
+    soak = rec["soak_smoke"]
+    assert soak["ok"] is True, {
+        k: v for k, v in soak.items() if k.endswith("_ok") and not v
+    } or soak
+    assert soak["epochs_exact_ok"] is True
+    assert soak["gc_deleted_rows"] > 0
+    assert soak["zero_slope_ok"] is True
+    assert soak["recorder_overhead_ratio"] <= 0.01
+    assert soak["leak_detected_ok"] is True
+    assert soak["trend_alert_fired_ok"] is True
 
 
 def test_collect_cli_end_to_end(capsys):
