@@ -3087,6 +3087,21 @@ def _fleet_smoke() -> dict:
         helper_ds.close()
 
 
+def _peer_outage_smoke() -> dict:
+    """Peer-outage survival smoke (scripts/chaos_run.py --scenario
+    peer_outage --smoke): the real aggregation + collection driver
+    binaries reach the helper only through a netsim fault proxy; a
+    blackhole past the breaker-open threshold keeps uploads at 201
+    while BOTH binaries park (claim transactions frozen,
+    janus_peer_parked=1, zero lease conflicts), a cheap half-open
+    probe resumes them when the wire heals, slow-drip and mid-body
+    truncation lanes recover without wedging a worker, and the
+    collections equal the admitted ground truth exactly."""
+    return _run_chaos_subprocess(
+        ["--scenario", "peer_outage", "--smoke", "--json"], timeout=480
+    )
+
+
 def _db_outage_smoke() -> dict:
     """Datastore-outage survival smoke (scripts/chaos_run.py
     --scenario db_outage --smoke): uploads keep acking 201 through a
@@ -3233,6 +3248,10 @@ def run_dry(args, ap) -> None:
                 "profiler_overhead": _profiler_overhead_record(),
                 "chaos_smoke": _chaos_smoke(),
                 "db_outage_smoke": _db_outage_smoke(),
+                # ISSUE 19: the other aggregator behind a hostile wire
+                # (netsim fault proxy) — peer-outage parking, half-open
+                # probe recovery, slow-drip/truncation survival
+                "peer_outage_smoke": _peer_outage_smoke(),
                 "device_hang_smoke": _device_hang_smoke(),
                 # ISSUE 14: cold-cache vs warm-cache real-binary boots —
                 # the warm number (restart-to-first-dispatch) is gated

@@ -209,6 +209,7 @@ class AggregationJobDriver:
         cfg: AggregationJobDriverConfig | None = None,
         breakers: OutboundCircuitBreakers | None = None,
         stopper=None,
+        peer_health=None,
     ):
         self.ds = ds
         self.http = http
@@ -218,6 +219,9 @@ class AggregationJobDriver:
         self.breakers = (
             breakers if breakers is not None else default_breakers(self.cfg.circuit_breaker)
         )
+        # peer-outage parking tracker (peer_health.PeerHealthTracker);
+        # None = no parking, per-step breaker step-backs only
+        self.peer_health = peer_health
         # shutdown Stopper: in-flight helper retries abort on SIGTERM so
         # the step can step back instead of spending the whole lease
         self.stopper = stopper
@@ -249,6 +253,9 @@ class AggregationJobDriver:
                 "acquire_agg_jobs",
             ),
             shard=shard,
+            peer_gate=self.peer_health.park_gate()
+            if self.peer_health is not None
+            else None,
         )
 
     def _lease_deadline(self, acquired) -> float:
@@ -1381,6 +1388,11 @@ class AggregationJobDriver:
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
         peer = peer_label(task.helper_aggregator_endpoint)
+        if self.peer_health is not None:
+            # register the endpoint BEFORE any attempt: the tracker can
+            # aim its half-open probes even at a peer that never once
+            # answered (first contact during an outage)
+            self.peer_health.observe_endpoint(task.helper_aggregator_endpoint)
         payload = req.to_bytes()  # encode once, not once per retry attempt
 
         def attempt():

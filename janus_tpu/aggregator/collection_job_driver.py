@@ -68,6 +68,7 @@ class CollectionJobDriver:
         cfg: CollectionJobDriverConfig | None = None,
         breakers: OutboundCircuitBreakers | None = None,
         stopper=None,
+        peer_health=None,
     ):
         self.ds = ds
         self.http = http
@@ -76,6 +77,9 @@ class CollectionJobDriver:
             breakers if breakers is not None else default_breakers(self.cfg.circuit_breaker)
         )
         self.stopper = stopper
+        # peer-outage parking tracker (peer_health.PeerHealthTracker);
+        # None = no parking, per-step breaker step-backs only
+        self.peer_health = peer_health
 
     def acquirer(self, lease_duration_s: int = 600, fleet=None):
         """Batched claim acquirer; `fleet` adds the shard predicate +
@@ -95,6 +99,9 @@ class CollectionJobDriver:
                 "acquire_collection_jobs",
             ),
             shard=shard,
+            peer_gate=self.peer_health.park_gate()
+            if self.peer_health is not None
+            else None,
         )
 
     def stepper(self, acquired: AcquiredCollectionJob) -> None:
@@ -435,6 +442,10 @@ class CollectionJobDriver:
         if task.aggregator_auth_token:
             headers.update(task.aggregator_auth_token.request_headers())
         peer = peer_label(task.helper_aggregator_endpoint)
+        if self.peer_health is not None:
+            # register before any attempt so the tracker can probe a
+            # peer that never once answered (see aggregation_job_driver)
+            self.peer_health.observe_endpoint(task.helper_aggregator_endpoint)
 
         def attempt():
             # circuit gate per attempt; see aggregation_job_driver.py

@@ -62,19 +62,34 @@ def lease_deadline(clock, lease, skew_s: int) -> float:
     return time.monotonic() + max(min(1.0, remaining), bound)
 
 
-def deadline_request_timeout(deadline: float | None) -> float | None:
+def deadline_request_timeout(
+    deadline: float | None, attempt_cap_s: float | None = None
+) -> float | None:
     """Per-attempt socket timeout capped to the remaining deadline.
     A deadline already in the past raises DeadlineExceeded — firing a
     doomed 0.1 s network attempt on a dead budget (the old floor) only
-    burned helper admission and masked the step-back signal."""
-    if deadline is None:
-        return None
-    remaining = deadline - time.monotonic()
-    if remaining <= 0:
-        from ..core.deadline import DeadlineExceeded
+    burned helper admission and masked the step-back signal.
 
-        raise DeadlineExceeded("request budget exhausted before the attempt")
-    return remaining
+    `attempt_cap_s` is the overall-deadline/per-attempt split
+    (docs/ARCHITECTURE.md "Surviving the other aggregator"): without a
+    cap, one blackholed attempt legally consumes the ENTIRE remaining
+    lease before the retry loop ever sees a second attempt — the cap
+    bounds each attempt so the loop gets multiple swings (and the
+    breaker multiple observations) inside one lease. The HttpClient's
+    own `timeout` applies the same cap when built from the
+    `helper_http:` stanza; this parameter makes the split explicit for
+    callers with a bare client."""
+    cap = None
+    if deadline is not None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            from ..core.deadline import DeadlineExceeded
+
+            raise DeadlineExceeded("request budget exhausted before the attempt")
+        cap = remaining
+    if attempt_cap_s is not None:
+        cap = attempt_cap_s if cap is None else min(cap, attempt_cap_s)
+    return cap
 
 
 def datastore_down(ds) -> bool:
@@ -135,16 +150,25 @@ def _job_id_of(acquired):
     return acquired.collection_job_id
 
 
-def make_claim_acquirer(ds, kind: str, claim_fn, shard=None):
+def make_claim_acquirer(ds, kind: str, claim_fn, shard=None, peer_gate=None):
     """Shared acquirer body for both drivers: run `claim_fn(limit)`
     (the datastore claim run_tx) through the outage-tolerant wrapper
     and feed the fleet claim metrics ONLY when a claim transaction
     actually ran — a parked (supervisor-down) or connection-lost pass
     ran none, and counting it would fabricate claim traffic during
     exactly the outages the counters should stay honest through.
-    `shard` feeds the steal classification (record_acquire)."""
+    `shard` feeds the steal classification (record_acquire).
+
+    `peer_gate` is the PEER-outage analog of the supervisor park
+    (aggregator/peer_health.py): a callable returning True while every
+    known helper peer's circuit is open. A parked pass returns []
+    without running the claim tx — a helper down for minutes must not
+    have every replica claim-churning jobs it cannot step (steal-fence
+    noise + wasted claim transactions across the whole fleet)."""
 
     def acquire(limit: int):
+        if peer_gate is not None and peer_gate():
+            return []
         ran = False
 
         def claim_tx():

@@ -1,0 +1,309 @@
+"""Peer-outage parking: stop claiming jobs while the other aggregator
+is down, and resume them with a cheap half-open probe.
+
+The outbound circuit breaker (core/circuit_breaker.py) already makes a
+dead helper cheap *per step*: a claimed job fails fast with
+CircuitOpenError and steps back. But step-backs still churn — every
+driver worker keeps acquiring leases, opening transactions, releasing
+with reason `circuit_open`, and re-sleeping, for as long as the outage
+lasts. The datastore outage discipline (job_driver.py
+`acquire_tolerating_outage`) showed the better shape: when the
+dependency is KNOWN to be down, park the acquirer itself — no claim
+transaction, no lease, no churn — and let a cheap probe resume it.
+
+This module extends that discipline to the peer:
+
+* `PeerHealthTracker.observe_endpoint(url)` — both job drivers register
+  the helper endpoint of every task they step, so the tracker knows the
+  peer universe and where to aim probes.
+* `park_gate()` — plugs into `make_claim_acquirer(..., peer_gate=...)`.
+  Claims park while EVERY known peer's breaker is not closed: in the
+  common single-helper deployment one dead peer parks the driver
+  outright; with several helpers a partial outage falls back to the
+  per-step breaker step-backs (a claim might target a healthy peer, so
+  parking would strand live work — documented limitation).
+* a background prober (`start()`/`stop()`) ticks every
+  `probe_interval_s`: it accrues `janus_peer_outage_seconds_total`,
+  publishes `janus_peer_parked`, and issues the half-open probe itself —
+  one cheap GET through the breaker's single probe slot
+  (`check()` admits it, any HTTP status counts as alive) so recovery
+  does not wait for a parked driver to stumble into the peer.
+
+State exports as `janus_peer_parked{peer}` /
+`janus_peer_outage_seconds_total{peer}` / `janus_peer_probes_total`
+plus a `peer_health` /statusz section; slo.py's `peer_reachable`
+builtin burns while any peer is parked. docs/ARCHITECTURE.md
+"Surviving the other aggregator" has the full contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+from ..core.circuit_breaker import CLOSED, OutboundCircuitBreakers, peer_label
+
+log = logging.getLogger(__name__)
+
+PROBE_ALIVE = "alive"
+PROBE_DEAD = "dead"
+PROBE_REJECTED = "rejected"
+
+
+@dataclass(frozen=True)
+class PeerHealthConfig:
+    """YAML `peer_health:` section of the job driver binaries
+    (config.py JobDriverBinaryConfig)."""
+
+    enabled: bool = True
+    # park claim acquisition while all known peers are non-closed; off =
+    # probe + export state only, keep the per-step breaker step-backs
+    park: bool = True
+    # background prober cadence (also the outage-seconds accrual grain)
+    probe_interval_s: float = 5.0
+    # budget for one probe GET; probes are cheap by contract
+    probe_timeout_s: float = 5.0
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "PeerHealthConfig":
+        d = d or {}
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            park=bool(d.get("park", True)),
+            probe_interval_s=float(d.get("probe_interval_secs", 5.0)),
+            probe_timeout_s=float(d.get("probe_timeout_secs", 5.0)),
+        )
+
+
+class PeerHealthTracker:
+    """Shared by both job drivers in one process (like the breaker
+    registry it wraps): a helper that is down for aggregation steps is
+    down for aggregate-share fetches too, and both acquirers park
+    together."""
+
+    def __init__(
+        self,
+        breakers: OutboundCircuitBreakers,
+        cfg: PeerHealthConfig | None = None,
+        http=None,
+    ):
+        self.breakers = breakers
+        self.cfg = cfg or PeerHealthConfig()
+        # fetch_any_status-compatible override for tests; None = the
+        # real core.http_client.fetch_any_status
+        self._http = http
+        self._lock = threading.Lock()
+        # peer label -> probe URL (the task's helper endpoint; any HTTP
+        # answer from it — 404 included — proves the peer routes and
+        # talks protocol)
+        self._endpoints: dict[str, str] = {}
+        # peer label -> monotonic timestamp of the last outage accrual
+        self._last_accrual: dict[str, float] = {}
+        self._parked_since: float | None = None
+        self._outage_started: dict[str, float] = {}
+        self._probe_counts: dict[str, dict[str, int]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    # driver-facing surface
+    # ------------------------------------------------------------------
+    def observe_endpoint(self, url: str) -> str:
+        """Register a helper endpoint (called from the drivers' send
+        paths before the breaker check, so even a peer that never
+        answered once is probeable). Returns its peer label."""
+        peer = peer_label(url)
+        with self._lock:
+            self._endpoints.setdefault(peer, url)
+        return peer
+
+    def parked_peers(self) -> list[str]:
+        """Peers whose breaker is currently not closed."""
+        states = self.breakers.peer_states()
+        return sorted(p for p, s in states.items() if s != CLOSED)
+
+    def should_park(self) -> bool:
+        """True while claim acquisition should park: parking enabled,
+        at least one peer known, and EVERY known peer non-closed."""
+        if not (self.cfg.enabled and self.cfg.park):
+            return False
+        states = self.breakers.peer_states()
+        if not states:
+            return False
+        return all(s != CLOSED for s in states.values())
+
+    def park_gate(self):
+        """The callable for make_claim_acquirer(..., peer_gate=...)."""
+        return self.should_park
+
+    # ------------------------------------------------------------------
+    # the prober
+    # ------------------------------------------------------------------
+    def tick(self, now: float | None = None) -> None:
+        """One prober beat: accrue outage seconds, publish the parked
+        gauge, probe whatever is probeable. Exposed for tests and for
+        the chaos harness; the background thread just loops it."""
+        from .. import metrics
+
+        if now is None:
+            now = time.monotonic()
+        states = self.breakers.peer_states()
+        parked = self.should_park()
+        with self._lock:
+            self._parked_since = (
+                (self._parked_since or now) if parked else None
+            )
+            for peer, state in states.items():
+                down = state != CLOSED
+                metrics.peer_parked.set(1.0 if down else 0.0, peer=peer)
+                last = self._last_accrual.get(peer)
+                if down:
+                    self._outage_started.setdefault(peer, now)
+                    if last is not None:
+                        metrics.peer_outage_seconds_total.add(
+                            max(0.0, now - last), peer=peer
+                        )
+                    self._last_accrual[peer] = now
+                else:
+                    self._outage_started.pop(peer, None)
+                    self._last_accrual.pop(peer, None)
+        for peer, state in states.items():
+            if state != CLOSED and self.breakers.retry_in_s(peer) == 0.0:
+                self.probe(peer)
+
+    def probe(self, peer: str) -> str:
+        """One cheap half-open probe through the breaker's single probe
+        slot. Returns the outcome ("alive"/"dead"/"rejected")."""
+        from ..core.circuit_breaker import CircuitOpenError
+        from .. import metrics
+
+        with self._lock:
+            url = self._endpoints.get(peer)
+        if url is None:
+            return PROBE_REJECTED
+        try:
+            self.breakers.check(peer)
+        except CircuitOpenError:
+            # cooldown not elapsed, or another probe (possibly a real
+            # driver step) holds the half-open slot — don't stampede
+            outcome = PROBE_REJECTED
+        else:
+            try:
+                fetch = self._http
+                if fetch is None:
+                    from ..core.http_client import fetch_any_status as fetch
+                status, _ = fetch(url, timeout=self.cfg.probe_timeout_s)
+            except Exception as e:
+                log.warning("peer probe %s (%s) failed: %s", peer, url, e)
+                self.breakers.record_failure(peer)
+                outcome = PROBE_DEAD
+            else:
+                # ANY status is a live peer: it routed, accepted the
+                # connection, and spoke HTTP — 404/405 on the task
+                # endpoint is normal for a GET probe
+                log.info("peer probe %s answered %d: resuming", peer, status)
+                self.breakers.record_success(peer)
+                outcome = PROBE_ALIVE
+        metrics.peer_probes_total.add(peer=peer, outcome=outcome)
+        with self._lock:
+            counts = self._probe_counts.setdefault(
+                peer, {PROBE_ALIVE: 0, PROBE_DEAD: 0, PROBE_REJECTED: 0}
+            )
+            counts[outcome] += 1
+        return outcome
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.probe_interval_s):
+            try:
+                self.tick()
+            except Exception:
+                log.exception("peer health tick failed")
+
+    def start(self) -> None:
+        if not self.cfg.enabled or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="peer-health-prober", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.cfg.probe_interval_s + 5.0)
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        """/statusz `peer_health` section body. Must never raise."""
+        now = time.monotonic()
+        states = self.breakers.peer_states()
+        with self._lock:
+            endpoints = dict(self._endpoints)
+            outage_started = dict(self._outage_started)
+            probe_counts = {p: dict(c) for p, c in self._probe_counts.items()}
+            parked_since = self._parked_since
+        parked = self.should_park()
+        return {
+            "config": {
+                "enabled": self.cfg.enabled,
+                "park": self.cfg.park,
+                "probe_interval_s": self.cfg.probe_interval_s,
+                "probe_timeout_s": self.cfg.probe_timeout_s,
+            },
+            "parked": parked,
+            "parked_for_s": round(now - parked_since, 3)
+            if parked and parked_since is not None
+            else 0.0,
+            "peers": {
+                peer: {
+                    "state": states.get(peer, "unknown"),
+                    "endpoint": endpoints.get(peer),
+                    "outage_for_s": round(now - outage_started[peer], 3)
+                    if peer in outage_started
+                    else 0.0,
+                    "probes": probe_counts.get(
+                        peer,
+                        {PROBE_ALIVE: 0, PROBE_DEAD: 0, PROBE_REJECTED: 0},
+                    ),
+                }
+                for peer in sorted(set(states) | set(endpoints))
+            },
+        }
+
+
+# Process-wide default tracker, shared by both job drivers (mirrors
+# default_breakers: the first caller's config wins) and exposed on
+# /statusz as `peer_health`.
+_default_lock = threading.Lock()
+_default: PeerHealthTracker | None = None
+
+
+def default_tracker(
+    breakers: OutboundCircuitBreakers,
+    cfg: PeerHealthConfig | None = None,
+) -> PeerHealthTracker:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = PeerHealthTracker(breakers, cfg)
+            from ..statusz import register_status_provider
+
+            register_status_provider("peer_health", _default.status)
+        elif cfg is not None and _default.cfg == PeerHealthConfig():
+            _default.cfg = cfg
+        return _default
+
+
+def reset_default_tracker() -> None:
+    """Test hook: stop the prober and drop the process-wide tracker."""
+    global _default
+    with _default_lock:
+        if _default is not None:
+            _default.stop()
+        _default = None

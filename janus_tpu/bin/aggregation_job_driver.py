@@ -16,18 +16,28 @@ from ..aggregator.aggregation_job_driver import (
 from ..aggregator.job_driver import JobDriver
 from ..binary_utils import janus_main
 from ..config import JobDriverBinaryConfig
-from ..core.http_client import HttpClient
 
 log = logging.getLogger(__name__)
 
 
 def run(cfg: JobDriverBinaryConfig, ds, stopper):
     from ..aggregator.health_sampler import HealthSampler, artifact_paths_from_config
+    from ..aggregator.peer_health import default_tracker
     from ..aggregator.step_pipeline import StepPipeline
+    from ..core.circuit_breaker import default_breakers
 
+    # peer-outage parking + background half-open probing, sharing the
+    # process-wide breaker registry with the driver below
+    tracker = default_tracker(
+        default_breakers(cfg.outbound_circuit_breaker), cfg.peer_health
+    )
+    tracker.start()
     driver = AggregationJobDriver(
         ds,
-        HttpClient(),
+        # per-attempt timeout / body budget / size cap from the
+        # `helper_http:` stanza (the overall budget stays the lease
+        # deadline, recomputed per request)
+        cfg.helper_http.build(),
         AggregationJobDriverConfig(
             maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
             circuit_breaker=cfg.outbound_circuit_breaker,
@@ -36,6 +46,7 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         # in-flight helper retries observe SIGTERM and step back instead
         # of spending the remaining lease on a dead peer
         stopper=stopper,
+        peer_health=tracker if cfg.peer_health.enabled else None,
     )
     # a step failing during shutdown releases its lease immediately
     # (reacquirable by the surviving peer, attempts preserved)
@@ -78,6 +89,7 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
     try:
         jd.run()
     finally:
+        tracker.stop()
         if sampler is not None:
             sampler.stop()
         if flusher is not None:

@@ -16,22 +16,32 @@ from ..aggregator.collection_job_driver import (
 from ..aggregator.job_driver import JobDriver
 from ..binary_utils import janus_main
 from ..config import JobDriverBinaryConfig
-from ..core.http_client import HttpClient
 
 log = logging.getLogger(__name__)
 
 
 def run(cfg: JobDriverBinaryConfig, ds, stopper):
     from ..aggregator.health_sampler import HealthSampler, artifact_paths_from_config
+    from ..aggregator.peer_health import default_tracker
+    from ..core.circuit_breaker import default_breakers
 
+    # peer-outage parking + background half-open probing, sharing the
+    # process-wide breaker registry with the driver below
+    tracker = default_tracker(
+        default_breakers(cfg.outbound_circuit_breaker), cfg.peer_health
+    )
+    tracker.start()
     driver = CollectionJobDriver(
         ds,
-        HttpClient(),
+        # per-attempt timeout / body budget / size cap from the
+        # `helper_http:` stanza (overall budget = the lease deadline)
+        cfg.helper_http.build(),
         CollectionJobDriverConfig(
             maximum_attempts_before_failure=cfg.job_driver.maximum_attempts_before_failure,
             circuit_breaker=cfg.outbound_circuit_breaker,
         ),
         stopper=stopper,
+        peer_health=tracker if cfg.peer_health.enabled else None,
     )
     jd = JobDriver(
         cfg.job_driver,
@@ -52,6 +62,7 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
     try:
         jd.run()
     finally:
+        tracker.stop()
         if sampler is not None:
             sampler.stop()
     log.info("collection job driver shut down")

@@ -17,8 +17,10 @@ from .aggregator import Config as AggregatorProtocolConfig
 from .aggregator.aggregation_job_creator import AggregationJobCreatorConfig
 from .aggregator.aggregation_job_driver import ResidentConfig
 from .aggregator.job_driver import JobDriverConfig
+from .aggregator.peer_health import PeerHealthConfig
 from .aggregator.step_pipeline import StepPipelineConfig
 from .core.circuit_breaker import CircuitBreakerConfig
+from .core.http_client import HttpClientConfig
 from .flight_recorder import FlightRecorderConfig
 from .profiler import ProfilerConfig
 from .slo import SloEngineConfig
@@ -505,6 +507,12 @@ class JobDriverBinaryConfig:
     outbound_circuit_breaker: CircuitBreakerConfig = field(
         default_factory=CircuitBreakerConfig
     )
+    # peer-outage parking + half-open probing (YAML `peer_health:`
+    # section; docs/ARCHITECTURE.md "Surviving the other aggregator")
+    peer_health: PeerHealthConfig = field(default_factory=PeerHealthConfig)
+    # per-attempt timeout / body budget / size cap for the outbound
+    # helper client (YAML `helper_http:` section)
+    helper_http: HttpClientConfig = field(default_factory=HttpClientConfig)
     # stage-pipelined leader stepper knobs (YAML `step_pipeline:`
     # section; docs/ARCHITECTURE.md "The stepper pipeline"). Enabled by
     # default — `step_pipeline: {enabled: false}` restores the serial
@@ -523,6 +531,8 @@ class JobDriverBinaryConfig:
             outbound_circuit_breaker=CircuitBreakerConfig.from_dict(
                 d.get("outbound_circuit_breaker")
             ),
+            peer_health=PeerHealthConfig.from_dict(d.get("peer_health")),
+            helper_http=HttpClientConfig.from_dict(d.get("helper_http")),
             step_pipeline=StepPipelineConfig.from_dict(d.get("step_pipeline")),
             resident_accumulators=ResidentConfig.from_dict(
                 d.get("resident_accumulators")

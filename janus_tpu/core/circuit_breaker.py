@@ -211,6 +211,13 @@ class OutboundCircuitBreakers:
         with self._lock:
             return self._get(peer).state
 
+    def peer_states(self) -> dict[str, str]:
+        """Snapshot of every known peer's state — the peer-health
+        tracker's parking input (aggregator/peer_health.py). Read-only:
+        never creates a peer entry."""
+        with self._lock:
+            return {p: pc.state for p, pc in self._peers.items()}
+
     def retry_in_s(self, peer: str) -> float:
         """Seconds until the peer's circuit will admit a probe (0 when
         closed/half-open) — the job drivers' step-back reacquire delay."""

@@ -5,13 +5,76 @@ send_request_to_helper); this wraps urllib for the same purpose.
 
 from __future__ import annotations
 
+import http.client
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
+from dataclasses import dataclass
 
 from .. import failpoints
 from . import deadline
+
+# chunked body reads: each recv is bounded by the socket timeout AND the
+# whole body by the wall-clock budget below
+_READ_CHUNK = 65536
+
+
+class PeerResponseTooLarge(Exception):
+    """The peer's response body exceeded the configured size cap. NOT an
+    OSError on purpose: retry_http_request must let it propagate — a
+    peer streaming gigabytes is misbehaving, and replaying the request
+    would just stream them again. The driver step fails (attempt
+    counted) instead of the process OOMing."""
+
+    def __init__(self, url: str, limit_bytes: int):
+        super().__init__(
+            f"response body from {url} exceeded the {limit_bytes}-byte cap"
+        )
+        self.url = url
+        self.limit_bytes = limit_bytes
+
+
+@dataclass(frozen=True)
+class HttpClientConfig:
+    """YAML `helper_http:` stanza of the job driver binaries: the
+    per-ATTEMPT half of the overall-deadline/per-attempt-timeout split.
+    The retry loop's overall budget stays the lease deadline
+    (job_driver.py deadline_request_timeout); each attempt is
+    additionally capped here so a blackholed peer burns seconds per
+    attempt, not the whole lease on attempt one."""
+
+    # connect + per-read socket timeout AND the default body budget of
+    # one attempt. The default stays as generous as HttpClient's (a cold
+    # aggregator's first request per task legitimately takes minutes of
+    # XLA compile); deployments that pre-warm engines should tighten it.
+    attempt_timeout_s: float = 300.0
+    # wall-clock budget for reading ONE response body (None = the
+    # attempt timeout): a slow-drip peer feeds a byte per read and
+    # resets the per-read socket timer forever — only a wall clock
+    # bounds it
+    body_budget_s: float | None = None
+    # response body size cap (a misbehaving peer must reject cleanly,
+    # not OOM the driver)
+    max_response_bytes: int = 64 << 20
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "HttpClientConfig":
+        d = d or {}
+        budget = d.get("body_budget_secs")
+        return cls(
+            attempt_timeout_s=float(d.get("attempt_timeout_secs", 300.0)),
+            body_budget_s=None if budget is None else float(budget),
+            max_response_bytes=int(float(d.get("max_response_mb", 64.0)) * (1 << 20)),
+        )
+
+    def build(self) -> "HttpClient":
+        return HttpClient(
+            timeout=self.attempt_timeout_s,
+            body_budget_s=self.body_budget_s,
+            max_response_bytes=self.max_response_bytes,
+        )
 
 
 def _injected_transport_error() -> urllib.error.URLError:
@@ -49,10 +112,63 @@ class HttpClient:
     # Default generous: a cold aggregator's first request per task can
     # legitimately take minutes (XLA engine compile). The job drivers
     # cap per-request timeouts by lease remaining (job_driver.py
-    # deadline_request_timeout), so hot paths stay bounded.
-    def __init__(self, timeout: float = 300.0):
+    # deadline_request_timeout) and configure the per-attempt split via
+    # the `helper_http:` stanza (HttpClientConfig), so hot paths stay
+    # bounded.
+    def __init__(
+        self,
+        timeout: float = 300.0,
+        body_budget_s: float | None = None,
+        max_response_bytes: int = 64 << 20,
+    ):
         self.timeout = timeout
+        # wall-clock budget for one response body read; None = the
+        # effective per-attempt timeout (socket timeouts are per READ —
+        # a slow-drip peer resets that timer on every byte, so only a
+        # wall clock bounds the whole body)
+        self.body_budget_s = body_budget_s
+        self.max_response_bytes = max_response_bytes
         self._local = threading.local()
+
+    def _read_body(self, resp, url: str, budget_s: float | None) -> bytes:
+        """Chunked body read under a WALL-CLOCK budget and a size cap.
+        A budget breach surfaces as a URLError-wrapped timeout (a
+        transport failure: retryable, breaker-counted); a size breach
+        as PeerResponseTooLarge (non-retryable by construction). A
+        truncated/garbled body (http.client.IncompleteRead and kin are
+        HTTPException, not OSError) is normalized to URLError too, so
+        a mid-body FIN retries like any torn connection instead of
+        escaping the retry loop as a raw stdlib internal."""
+        chunks: list[bytes] = []
+        total = 0
+        t0 = time.monotonic()
+        while True:
+            if budget_s is not None and time.monotonic() - t0 > budget_s:
+                raise urllib.error.URLError(
+                    socket.timeout(
+                        f"response body read exceeded the {budget_s:g}s "
+                        f"wall-clock budget ({total} bytes in)"
+                    )
+                )
+            try:
+                chunk = resp.read(_READ_CHUNK)
+            except http.client.HTTPException as e:
+                raise urllib.error.URLError(e) from e
+            if not chunk:
+                # stdlib quirk: read(amt) returns b"" on a premature FIN
+                # instead of raising IncompleteRead (only the readall
+                # path raises) — check the undelivered Content-Length
+                # residue ourselves, or a truncated wire would surface
+                # as a silently short body
+                remaining = getattr(resp, "length", None)
+                if remaining:
+                    short = http.client.IncompleteRead(b"", remaining)
+                    raise urllib.error.URLError(short)
+                return b"".join(chunks)
+            total += len(chunk)
+            if self.max_response_bytes and total > self.max_response_bytes:
+                raise PeerResponseTooLarge(url, self.max_response_bytes)
+            chunks.append(chunk)
 
     @property
     def last_response_headers(self) -> dict:
@@ -102,19 +218,29 @@ class HttpClient:
             if dl is not None:
                 headers[deadline.DEADLINE_HEADER] = dl
         req = urllib.request.Request(url, data=body, method=method, headers=headers)
+        effective_timeout = (
+            self.timeout if timeout is None else min(self.timeout, timeout)
+        )
+        # the body budget defaults to the per-attempt timeout: one
+        # attempt (connect + headers + WHOLE body) is then wall-clock
+        # bounded even against a slow-drip peer
+        budget = self.body_budget_s
+        if budget is None:
+            budget = effective_timeout
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout if timeout is None else min(self.timeout, timeout)
-            ) as resp:
+            with urllib.request.urlopen(req, timeout=effective_timeout) as resp:
                 self.last_response_headers = dict(resp.headers.items())
                 # slow-body injection: the peer answered but trickles
                 # the payload
                 failpoints.hit("helper.response", timeout_factory=_injected_timeout)
-                return resp.status, resp.read()
+                return resp.status, self._read_body(resp, url, budget)
         except urllib.error.HTTPError as e:
             self.last_response_headers = dict(e.headers.items())
             try:
-                err_body = e.read()
+                # the error body rides the same budget + size cap: a
+                # slow-dripped 503 page pins a worker exactly like a
+                # slow-dripped 200 would
+                err_body = self._read_body(e, url, budget)
             except OSError as read_err:
                 # connection reset while draining the error body: this
                 # is a transport failure, not a conclusive response —

@@ -662,6 +662,25 @@ job_step_back_total = REGISTRY.counter(
     "instead of failing, by reason",
 )
 
+# --- peer-outage parking + half-open probing (aggregator/peer_health.py;
+# docs/ARCHITECTURE.md "Surviving the other aggregator") ---
+peer_parked = REGISTRY.gauge(
+    "janus_peer_parked",
+    "1 while job claims targeting this peer are parked (the peer's outbound "
+    "circuit is open and the cheap half-open probe has not yet seen it alive)",
+)
+peer_outage_seconds_total = REGISTRY.counter(
+    "janus_peer_outage_seconds_total",
+    "cumulative seconds each peer's outbound circuit spent not-closed "
+    "(open or half-open), accumulated by the peer-health prober tick",
+)
+peer_probes_total = REGISTRY.counter(
+    "janus_peer_probes_total",
+    "cheap half-open peer probes issued by the peer-health prober, by peer "
+    'and outcome (outcome="alive|dead|rejected"; rejected = another probe '
+    "held the single half-open slot)",
+)
+
 # --- stage-pipelined leader stepper (aggregator/step_pipeline.py;
 # docs/ARCHITECTURE.md "The stepper pipeline", ISSUE 9) ---
 step_pipeline_stage_seconds = REGISTRY.histogram(

@@ -534,6 +534,24 @@ def BUILTIN_SLOS() -> list[SloDefinition]:
             ),
         ),
         SloDefinition(
+            name="peer_reachable",
+            description=(
+                "the other aggregator is reachable: no peer is parked by "
+                "the peer-health tracker (janus_peer_parked; "
+                "aggregator/peer_health.py)"
+            ),
+            objective=0.999,
+            signal=ConditionSignal(
+                conditions=(
+                    Condition(
+                        selector=Selector("janus_peer_parked", ()),
+                        op=">",
+                        value=0.0,
+                    ),
+                )
+            ),
+        ),
+        SloDefinition(
             name="resource_trend",
             description=(
                 "no leak-gated flight-recorder series (RSS, engine "

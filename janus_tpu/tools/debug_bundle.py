@@ -274,6 +274,7 @@ def collect_bundle(
         target = _target_name(base)
         captured = {}
         replica_id = None
+        peer_health = None
         for name, path in ENDPOINTS:
             source = base + path
             ext = (
@@ -296,9 +297,21 @@ def collect_bundle(
                 # incident bundle covers the whole fleet, so every
                 # target records WHICH replica it was
                 try:
-                    replica_id = (
-                        json.loads(body).get("fleet", {}).get("replica_id")
-                    )
+                    snap = json.loads(body)
+                    replica_id = snap.get("fleet", {}).get("replica_id")
+                    # peer-outage state per capture (ISSUE 19): a
+                    # "helper down?" incident bundle answers at the top
+                    # of the manifest, not three files deep
+                    ph = snap.get("peer_health")
+                    if isinstance(ph, dict):
+                        peer_health = {
+                            "parked": ph.get("parked"),
+                            "parked_peers": sorted(
+                                p
+                                for p, ent in (ph.get("peers") or {}).items()
+                                if (ent or {}).get("state") not in ("closed", None)
+                            ),
+                        }
                 except Exception:
                     replica_id = None
         manifest["targets"][target] = {
@@ -306,6 +319,8 @@ def collect_bundle(
             "replica_id": replica_id,
             "endpoints": captured,
         }
+        if peer_health is not None:
+            manifest["targets"][target]["peer_health"] = peer_health
 
     if config_file:
         try:

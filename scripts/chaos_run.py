@@ -96,12 +96,39 @@ path, a post-restore job lands resident and SIGTERM drains it, no
 flush reports `outcome="lost"`, and BOTH tasks' collections equal
 their admitted ground truths exactly.
 
+A further scenario, `--scenario peer_outage`, proves PEER-outage
+survival (docs/ARCHITECTURE.md "Surviving the other aggregator"): the
+REAL aggregation + collection job driver binaries reach the in-process
+helper only through a core/netsim.py FaultProxy, and the wire is
+degraded toxiproxy-style. Invariants:
+
+  - clean baseline traffic flows through the proxy and aggregates
+    exactly;
+  - a full blackhole longer than the breaker-open threshold keeps
+    uploads at 201 (the leader is untouched) while BOTH driver
+    binaries open their breakers, step back (`reason="circuit_open"`,
+    bounded), then PARK: claim transactions stop cold
+    (`janus_lease_acquire_tx_total` frozen), `janus_peer_parked` = 1,
+    `janus_peer_outage_seconds_total` grows, `/statusz` grows a
+    `peer_health` section, and `janus_lease_conflicts_total` stays 0;
+  - when the wire heals, the cheap half-open probe
+    (`janus_peer_probes_total{outcome="alive"}`) closes the breaker,
+    both drivers resume, and the parked work drains;
+  - a slow-drip (slicer) response trips the wall-clock body budget and
+    a mid-body truncation retries as a torn connection — neither
+    wedges a worker, both lanes complete;
+  - (full schedule) latency+jitter and flaky mid-request reset lanes
+    also complete;
+  - the final collections equal the admitted ground truth EXACTLY and
+    both binaries SIGTERM-drain cleanly.
+
 Usage:
     python scripts/chaos_run.py --smoke --json   # fast deterministic
     python scripts/chaos_run.py --json           # full schedule (slow)
     python scripts/chaos_run.py --scenario db_outage --smoke --json
     python scripts/chaos_run.py --scenario device_hang --smoke --json
     python scripts/chaos_run.py --scenario resident --smoke --json
+    python scripts/chaos_run.py --scenario peer_outage --smoke --json
 
 Exit code 0 iff every invariant held; the result JSON rides on stdout
 (bench.py --dry-run embeds the smokes as its chaos_smoke and
@@ -189,7 +216,10 @@ def _driver_cfg(
     return str(path)
 
 
-def _spawn_driver(cfg_path, key, log_path, failpoints: str | None, extra_env=None):
+def _spawn_driver(
+    cfg_path, key, log_path, failpoints: str | None, extra_env=None,
+    module: str = "janus_tpu.bin.aggregation_job_driver",
+):
     env = dict(
         os.environ,
         PYTHONPATH=REPO,
@@ -213,7 +243,7 @@ def _spawn_driver(cfg_path, key, log_path, failpoints: str | None, extra_env=Non
         [
             sys.executable,
             "-m",
-            "janus_tpu.bin.aggregation_job_driver",
+            module,
             "--config-file",
             str(cfg_path),
         ],
@@ -3139,6 +3169,434 @@ def run_soak(
         helper_ds.close()
 
 
+def run_peer_outage(
+    n_reports: int = 4,
+    lease_ttl_s: int = 8,
+    breaker_cooldown_s: float = 1.5,
+    full: bool = False,
+    workdir: str | None = None,
+) -> dict:
+    """Peer-outage survival schedule (see module docstring): REAL
+    aggregation + collection driver binaries reach the in-process
+    helper only through a netsim FaultProxy; every `*_ok` key must be
+    True for the run to pass."""
+    import threading
+
+    from janus_tpu.aggregator import Aggregator, Config
+    from janus_tpu.aggregator.aggregation_job_creator import (
+        AggregationJobCreator,
+        AggregationJobCreatorConfig,
+    )
+    from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+    from janus_tpu.binary_utils import enable_compile_cache, warmup_engines
+    from janus_tpu.client import Client, ClientParameters
+    from janus_tpu.collector import Collector, CollectorParameters
+    from janus_tpu.core.auth import AuthenticationToken
+    from janus_tpu.core.hpke import generate_hpke_config_and_private_key
+    from janus_tpu.core.http_client import HttpClient
+    from janus_tpu.core.netsim import FaultProxy
+    from janus_tpu.core.time_util import RealClock
+    from janus_tpu.datastore.store import Crypter, Datastore
+    from janus_tpu.messages import Duration, Interval, Query, Role, Time
+    from janus_tpu.task import QueryTypeConfig, TaskBuilder
+    from janus_tpu.vdaf.registry import VdafInstance
+
+    import dataclasses
+
+    t_run0 = time.monotonic()
+    tmp = workdir or tempfile.mkdtemp(prefix="janus-peerout-")
+    os.makedirs(tmp, exist_ok=True)
+    key_bytes = secrets.token_bytes(16)
+    key = base64.urlsafe_b64encode(key_bytes).decode().rstrip("=")
+    clock = RealClock()
+    leader_db = os.path.join(tmp, "leader.sqlite")
+    leader_ds = Datastore(leader_db, Crypter([key_bytes]), clock)
+    helper_ds = Datastore(
+        os.path.join(tmp, "helper.sqlite"), Crypter([key_bytes]), clock
+    )
+
+    result: dict = {
+        "workdir": tmp,
+        "schedule": "peer_outage_full" if full else "peer_outage_smoke",
+    }
+    procs: list[subprocess.Popen] = []
+    leader_srv = helper_srv = proxy = None
+    try:
+        helper_srv = DapServer(
+            DapHttpApp(Aggregator(helper_ds, clock, Config()))
+        ).start()
+        leader_srv = DapServer(
+            DapHttpApp(Aggregator(leader_ds, clock, Config(collection_retry_after_s=1)))
+        ).start()
+        # the hostile wire: driver traffic to the helper crosses this
+        # proxy (the task's helper endpoint below points at it); client
+        # + collector traffic goes direct so proxy stats are driver-only
+        from urllib.parse import urlsplit
+
+        helper_netloc = urlsplit(helper_srv.url).netloc
+        hhost, hport = helper_netloc.split(":")
+        proxy = FaultProxy(hhost, int(hport)).start()
+
+        vdaf = VdafInstance.count()
+        collector_kp = generate_hpke_config_and_private_key(config_id=202)
+        leader_task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), vdaf, Role.LEADER)
+            .with_(
+                leader_aggregator_endpoint=leader_srv.url,
+                helper_aggregator_endpoint=proxy.url,
+                collector_hpke_config=collector_kp.config,
+                aggregator_auth_token=AuthenticationToken.random_bearer(),
+                collector_auth_token=AuthenticationToken.random_bearer(),
+                min_batch_size=1,
+                # small buckets so the waves before and after the
+                # blackhole land in disjoint batch intervals and the
+                # two collections partition the ground truth exactly
+                time_precision=Duration(2),
+            )
+            .build()
+        )
+        helper_task = dataclasses.replace(
+            leader_task,
+            role=Role.HELPER,
+            hpke_keys=(generate_hpke_config_and_private_key(config_id=3),),
+        )
+        leader_ds.run_tx(lambda tx: tx.put_task(leader_task), "provision")
+        helper_ds.run_tx(lambda tx: tx.put_task(helper_task), "provision")
+        enable_compile_cache()
+        warmup_engines(leader_ds)
+        # warm the helper too: the drivers run with a tight per-attempt
+        # timeout, so the helper must not pay a cold compile on the
+        # first proxied init
+        warmup_engines(helper_ds)
+
+        # tight split so the schedule's clock stays short: 2 s attempts
+        # against an 8 s lease, breaker opens after 3 failures, 1.5 s
+        # cooldown, prober every 0.5 s
+        extra = (
+            "peer_health:\n"
+            "  probe_interval_secs: 0.5\n"
+            "  probe_timeout_secs: 1.0\n"
+            "helper_http:\n"
+            "  attempt_timeout_secs: 2.0\n"
+            "  body_budget_secs: 2.0\n"
+            "  max_response_mb: 8\n"
+        )
+        ttl = int(lease_ttl_s)
+        port_a = _free_port()
+        cfg_a = _driver_cfg(
+            os.path.join(tmp, "agg_driver.yaml"), leader_db, port_a, ttl,
+            breaker_cooldown_s, extra=extra,
+        )
+        drv_a = _spawn_driver(
+            cfg_a, key, os.path.join(tmp, "agg_driver.log"), None
+        )
+        procs.append(drv_a)
+        port_c = _free_port()
+        cfg_c = _driver_cfg(
+            os.path.join(tmp, "collect_driver.yaml"), leader_db, port_c, ttl,
+            breaker_cooldown_s, extra=extra,
+        )
+        drv_c = _spawn_driver(
+            cfg_c, key, os.path.join(tmp, "collect_driver.log"), None,
+            module="janus_tpu.bin.collection_job_driver",
+        )
+        procs.append(drv_c)
+        _wait_healthz(port_a)
+        _wait_healthz(port_c)
+        ports = (port_a, port_c)
+
+        http = HttpClient()
+        params = ClientParameters(
+            leader_task.task_id, leader_srv.url, helper_srv.url,
+            leader_task.time_precision,
+        )
+        client = Client.with_fetched_configs(params, vdaf, http, clock=clock)
+        creator = AggregationJobCreator(
+            leader_ds,
+            AggregationJobCreatorConfig(
+                min_aggregation_job_size=1, max_aggregation_job_size=100
+            ),
+        )
+
+        acked: list[int] = []
+        upload_errors: list[str] = []
+
+        def upload_wave(measurements) -> None:
+            for m in measurements:
+                try:
+                    client.upload(m)
+                    acked.append(m)
+                except Exception as e:
+                    upload_errors.append(f"{type(e).__name__}: {e}")
+
+        def agg_jobs_by_state() -> dict:
+            counts = leader_ds.run_tx(
+                lambda tx: tx.count_jobs_by_state(), "peerout_monitor"
+            )
+            return {
+                s: n for (t, s), n in counts.items() if t == "aggregation"
+            }
+
+        def wait_agg_done(deadline_s: float) -> bool:
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                st = agg_jobs_by_state()
+                if st and st.get("in_progress", 0) == 0:
+                    return True
+                time.sleep(0.1)
+            return False
+
+        def family_sum(port: int, name: str) -> float:
+            return sum(
+                _metric_samples(_scrape(port, "/metrics"), name).values()
+            )
+
+        def parked_value(port: int) -> float:
+            samples = _metric_samples(
+                _scrape(port, "/metrics"), "janus_peer_parked"
+            )
+            return max(samples.values()) if samples else 0.0
+
+        def wait_parked(value: float, deadline_s: float) -> bool:
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if all(parked_value(p) == value for p in ports):
+                    return True
+                time.sleep(0.2)
+            return False
+
+        tp = leader_task.time_precision
+
+        def bucket_now() -> int:
+            return clock.now().to_batch_interval_start(tp).seconds
+
+        def cross_bucket_boundary() -> int:
+            """Sleep into a FRESH bucket; returns its start. Everything
+            uploaded before the call stays strictly below it."""
+            last = bucket_now()
+            while bucket_now() <= last:
+                time.sleep(0.1)
+            return bucket_now()
+
+        # --- phase 1: clean baseline through the proxy ----------------
+        interval_start = bucket_now()
+        upload_wave([(i % 3 != 0) * 1 for i in range(n_reports)])
+        wave_a_count, wave_a_sum = len(acked), sum(acked)
+        creator.run_once()
+        result["baseline_agg_ok"] = wait_agg_done(120)
+        result["proxy_connections_baseline"] = proxy.stats["connections_total"]
+        result["proxied_baseline_ok"] = proxy.stats["connections_total"] >= 1
+        boundary = cross_bucket_boundary()
+
+        # --- phase 2: blackhole past the breaker-open threshold -------
+        proxy.set_toxics("up", [{"kind": "blackhole"}])
+        proxy.set_toxics("down", [{"kind": "blackhole"}])
+        # uploads only touch the leader: they must keep acking 201
+        upload_wave([1] * 3)
+        result["uploads_during_blackhole_ok"] = not upload_errors
+        creator.run_once()  # the agg driver now steps into the blackhole
+        # a mid-outage collection over the BASELINE interval drives the
+        # collection binary into the blackhole too (wave A is already
+        # aggregated, so its step reaches the helper dial)
+        collector = Collector(
+            CollectorParameters(
+                leader_task.task_id,
+                leader_srv.url,
+                leader_task.collector_auth_token,
+                collector_kp,
+            ),
+            vdaf,
+            HttpClient(),
+        )
+        q1 = Query.time_interval(
+            Interval(Time(interval_start), Duration(boundary - interval_start))
+        )
+        collect1: dict = {}
+
+        def collect1_loop():
+            try:
+                c = collector.collect(q1, timeout_s=240.0)
+                collect1["count"] = c.report_count
+                collect1["sum"] = c.aggregate_result
+            except Exception as e:
+                collect1["error"] = f"{type(e).__name__}: {e}"
+
+        c1t = threading.Thread(target=collect1_loop, daemon=True)
+        c1t.start()
+
+        # both binaries must PARK: breaker opens, acquirers gate off
+        result["both_parked_ok"] = wait_parked(1.0, 90)
+        # while parked: claim transactions stop cold and circuit_open
+        # step-backs stay bounded (no churn — that's the whole point)
+        pre = {
+            p: (
+                family_sum(p, "janus_lease_acquire_tx_total"),
+                sum(
+                    v
+                    for k, v in _metric_samples(
+                        _scrape(p, "/metrics"), "janus_job_step_back_total"
+                    ).items()
+                    if "circuit_open" in k
+                ),
+            )
+            for p in ports
+        }
+        time.sleep(2.0)
+        frozen = True
+        bounded = True
+        for p in ports:
+            claims_then, backs_then = pre[p]
+            claims_now = family_sum(p, "janus_lease_acquire_tx_total")
+            backs_now = sum(
+                v
+                for k, v in _metric_samples(
+                    _scrape(p, "/metrics"), "janus_job_step_back_total"
+                ).items()
+                if "circuit_open" in k
+            )
+            frozen = frozen and claims_now == claims_then
+            bounded = bounded and (backs_now - backs_then) <= 1
+        result["claims_frozen_while_parked_ok"] = frozen
+        result["step_backs_bounded_ok"] = bounded
+        result["outage_seconds_counted_ok"] = all(
+            family_sum(p, "janus_peer_outage_seconds_total") > 0 for p in ports
+        )
+        statusz = json.loads(_scrape(port_a, "/statusz"))
+        ph = statusz.get("peer_health", {})
+        result["statusz_peer_health_ok"] = (
+            ph.get("parked") is True and bool(ph.get("peers"))
+        )
+
+        # --- phase 3: heal the wire; probes resume both drivers -------
+        proxy.clear()
+        result["unparked_ok"] = wait_parked(0.0, 60)
+        result["recovery_agg_ok"] = wait_agg_done(120)
+        c1t.join(timeout=240)
+        result["collect1"] = collect1
+        result["collect1_exact_ok"] = (
+            collect1.get("count") == wave_a_count
+            and collect1.get("sum") == wave_a_sum
+        )
+
+        if full:
+            # --- latency + jitter lane --------------------------------
+            lat = [{"kind": "latency", "latency_s": 0.08, "jitter_s": 0.04}]
+            proxy.set_toxics("up", lat)
+            proxy.set_toxics("down", lat)
+            upload_wave([1] * 3)
+            creator.run_once()
+            result["latency_lane_ok"] = wait_agg_done(120)
+            proxy.clear()
+            # --- flaky mid-request resets -----------------------------
+            proxy.set_toxics(
+                "up", [{"kind": "reset", "after_bytes": 120, "count": 2}]
+            )
+            upload_wave([1] * 2)
+            creator.run_once()
+            result["reset_lane_ok"] = (
+                wait_agg_done(120) and proxy.stats["resets"] >= 1
+            )
+            proxy.clear()
+
+        # --- phase 4: slow-drip (slicer) lane -------------------------
+        # one connection's responses drip in 24-byte slices, 0.7 s
+        # apart: each slice resets a per-read socket timer, so only the
+        # client's wall-clock body budget can end the attempt; the
+        # retry rides a fresh (clean) connection
+        proxy.set_toxics(
+            "down",
+            [{"kind": "slicer", "slice_bytes": 24, "delay_s": 0.7, "count": 1}],
+        )
+        upload_wave([1] * 2)
+        creator.run_once()
+        result["slicer_lane_ok"] = (
+            wait_agg_done(150)
+            and proxy.stats["toxic_fired"].get("slicer", 0) >= 1
+        )
+        # --- phase 5: mid-request truncation lane ---------------------
+        # cut one connection's REQUEST 150 bytes in — mid-headers for
+        # any HTTP request, so the fire is deterministic regardless of
+        # DAP body sizes (helper responses can be under ~200 bytes
+        # total, which made a response-side cut point flaky). The
+        # driver sees the connection die before a response and retries
+        # on a fresh (clean) wire; the helper never got a full request,
+        # so no state moved. Response-side mid-body truncation (the
+        # short-body-under-Content-Length detection) is pinned by
+        # tests/test_netsim.py against the same proxy.
+        proxy.set_toxics(
+            "up", [{"kind": "truncate", "after_bytes": 150, "count": 1}]
+        )
+        upload_wave([1] * 2)
+        creator.run_once()
+        result["truncate_lane_ok"] = (
+            wait_agg_done(150) and proxy.stats["truncates"] >= 1
+        )
+        proxy.clear()
+        result["upload_errors"] = upload_errors[:5]
+        result["uploads_all_acked_ok"] = not upload_errors
+
+        # --- phase 6: collect everything after the baseline boundary --
+        end = cross_bucket_boundary()
+        q2 = Query.time_interval(
+            Interval(Time(boundary), Duration(end - boundary))
+        )
+        collected = collector.collect(q2, timeout_s=240.0)
+        result["collect2"] = {
+            "count": collected.report_count,
+            "sum": collected.aggregate_result,
+        }
+        # THE invariant: the two disjoint collections partition the
+        # admitted ground truth exactly — through a blackhole, parking,
+        # probing, slow-drip and truncation
+        result["exactly_once_ok"] = (
+            collect1.get("count", 0) + collected.report_count == len(acked)
+            and collect1.get("sum", 0) + collected.aggregate_result == sum(acked)
+        )
+        result["admitted"] = len(acked)
+        result["ground_truth_sum"] = sum(acked)
+
+        # --- final gates + drain --------------------------------------
+        result["lease_conflicts_ok"] = all(
+            family_sum(p, "janus_lease_conflicts_total") == 0 for p in ports
+        )
+        result["probes_alive_ok"] = all(
+            sum(
+                v
+                for k, v in _metric_samples(
+                    _scrape(p, "/metrics"), "janus_peer_probes_total"
+                ).items()
+                if 'outcome="alive"' in k
+            )
+            >= 1
+            for p in ports
+        )
+        result["proxy_stats"] = {
+            k: v for k, v in proxy.stats.items() if k != "toxic_fired"
+        } | {"toxic_fired": dict(proxy.stats["toxic_fired"])}
+        drains = []
+        for p, logname in ((drv_a, "agg_driver.log"), (drv_c, "collect_driver.log")):
+            p.send_signal(signal.SIGTERM)
+            rc = p.wait(timeout=60)
+            body = open(os.path.join(tmp, logname), "rb").read()
+            drains.append(rc == 0 and b"shut down" in body)
+        result["drain_ok"] = all(drains)
+
+        result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
+        result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
+        return result
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if proxy is not None:
+            proxy.stop()
+        for srv in (leader_srv, helper_srv):
+            if srv is not None:
+                srv.stop()
+        leader_ds.close()
+        helper_ds.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -3151,7 +3609,7 @@ def main(argv=None) -> int:
         "--scenario",
         choices=[
             "crash_storm", "db_outage", "device_hang", "pipeline", "resident",
-            "cold_start", "fleet", "soak",
+            "cold_start", "fleet", "soak", "peer_outage",
         ],
         default="crash_storm",
         help="crash_storm = driver SIGKILL + helper storms (default); "
@@ -3173,7 +3631,11 @@ def main(argv=None) -> int:
         "churn + GC deletion, judged by flight-recorder trend verdicts "
         "(zero-slope on clean driver, injected leak fires the trend "
         "alert; full run targets PostgreSQL via docker-compose.pg.yaml "
-        "when JANUS_TEST_DATABASE_URL is set)",
+        "when JANUS_TEST_DATABASE_URL is set); peer_outage = helper "
+        "behind a netsim fault proxy (blackhole past the breaker "
+        "threshold parks BOTH real driver binaries, a cheap probe "
+        "resumes them, slow-drip + truncation lanes recover, "
+        "collections exact)",
     )
     ap.add_argument("--reports", type=int, default=0, help="0 = schedule default")
     ap.add_argument("--json", action="store_true", help="print the result record as JSON")
@@ -3220,6 +3682,12 @@ def main(argv=None) -> int:
             epochs=4 if args.smoke else 12,
             reports_per_epoch=args.reports or (8 if args.smoke else 24),
             report_expiry_s=30.0 if args.smoke else 120.0,
+            full=not args.smoke,
+            workdir=args.workdir,
+        )
+    elif args.scenario == "peer_outage":
+        result = run_peer_outage(
+            n_reports=args.reports or (4 if args.smoke else 8),
             full=not args.smoke,
             workdir=args.workdir,
         )

@@ -174,6 +174,12 @@ def main(argv=None) -> int:
         "janus_gc_lag_seconds",
         "janus_datastore_table_rows",
         "janus_artifact_bytes",
+        # peer-outage parking + half-open probing (ISSUE 19) —
+        # registered at import in every binary, so absence is a deploy
+        # regression (labeled families render even with zero samples)
+        "janus_peer_parked",
+        "janus_peer_outage_seconds_total",
+        "janus_peer_probes_total",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -308,6 +314,25 @@ def main(argv=None) -> int:
                     for key in ("replica_id", "shard_index", "shard_count"):
                         if key not in fl:
                             errors.append(f"/statusz fleet missing {key!r}")
+                # peer-outage parking (ISSUE 19): the peer-health
+                # tracker registers its section only in the job driver
+                # binaries, so it is validated when present rather than
+                # required
+                ph = snap.get("peer_health")
+                if ph is not None:
+                    if not isinstance(ph, dict):
+                        errors.append("/statusz peer_health is not an object")
+                    else:
+                        for key in ("config", "parked", "peers"):
+                            if key not in ph:
+                                errors.append(f"/statusz peer_health missing {key!r}")
+                        for peer, ent in (ph.get("peers") or {}).items():
+                            for key in ("state", "probes"):
+                                if key not in (ent or {}):
+                                    errors.append(
+                                        f"/statusz peer_health peer {peer} missing {key!r}"
+                                    )
+                                    break
                 # multi-chip serving (ISSUE 16): mesh geometry + the
                 # single-controller dispatch-queue accounting — present
                 # (devices may be null pre-backend-init) on every binary

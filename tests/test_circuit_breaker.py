@@ -263,3 +263,102 @@ def test_concurrent_checks_race_safely():
         t.join(timeout=5)
     assert not errors
     assert br.state("p") in ("closed", "open", "half_open")
+
+
+def test_half_open_admits_exactly_one_probe_across_threads():
+    """Probe-slot stampede: after the cooldown, N workers (driver steps
+    and the peer-health prober alike) race check() — exactly ONE gets
+    the half-open slot, everyone else fails fast. One success then
+    closes the breaker for all of them."""
+    br = OutboundCircuitBreakers(
+        CircuitBreakerConfig(failure_threshold=1, open_cooldown_s=0.02)
+    )
+    br.record_failure("p")
+    time.sleep(0.03)
+
+    n = 8
+    barrier = threading.Barrier(n)
+    admitted: list = []
+    rejected: list = []
+    lock = threading.Lock()
+
+    def worker():
+        barrier.wait()
+        try:
+            br.check("p")
+        except CircuitOpenError:
+            with lock:
+                rejected.append(1)
+        else:
+            with lock:
+                admitted.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(admitted) == 1 and len(rejected) == n - 1
+    br.record_success("p")
+    assert br.state("p") == "closed"
+
+
+def test_retry_after_paces_attempts_under_the_deadline_split():
+    """Retry-After steers the inter-attempt sleep (no exponential
+    growth, no jitter) while the overall deadline still owns the loop —
+    the server paces us, the lease bounds us."""
+    from janus_tpu.core.retries import Backoff, retry_http_request
+
+    sleeps: list = []
+    calls = {"n": 0}
+
+    def do_request():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            return 429, b"", {"Retry-After": "0.8"}
+        return 201, b"ok"
+
+    status, body = retry_http_request(
+        do_request,
+        backoff=Backoff(initial=0.01, max_interval=2.0, max_elapsed=30.0),
+        sleep=sleeps.append,
+        deadline=time.monotonic() + 60.0,
+    )
+    assert (status, body) == (201, b"ok") and calls["n"] == 3
+    assert sleeps == [0.8, 0.8]  # server-paced, not 0.01 then 0.02
+
+
+def test_retry_after_never_outlives_the_lease_deadline():
+    """A huge Retry-After is clamped to max_interval, and a sleep that
+    would cross the lease deadline is never started — the loop raises
+    DeadlineExceeded instead of parking the worker past its lease."""
+    from janus_tpu.core.deadline import DeadlineExceeded
+    from janus_tpu.core.retries import Backoff, retry_http_request
+
+    def do_request():
+        return 429, b"", {"Retry-After": "9999"}
+
+    slept: list = []
+    with pytest.raises(DeadlineExceeded):
+        retry_http_request(
+            do_request,
+            backoff=Backoff(initial=0.01, max_interval=5.0, max_elapsed=600.0),
+            sleep=slept.append,
+            deadline=time.monotonic() + 0.05,
+        )
+    assert slept == []  # the doomed sleep was never taken
+
+
+def test_deadline_request_timeout_attempt_cap():
+    """The overall-deadline/per-attempt split: each attempt's socket
+    timeout is min(remaining lease, attempt cap), so a blackholed peer
+    burns attempt_cap seconds per swing, never the whole lease."""
+    from janus_tpu.aggregator.job_driver import deadline_request_timeout
+
+    dl = time.monotonic() + 100.0
+    assert deadline_request_timeout(dl) == pytest.approx(100.0, abs=1.0)
+    assert deadline_request_timeout(dl, attempt_cap_s=2.0) == pytest.approx(
+        2.0, abs=0.01
+    )
+    assert deadline_request_timeout(None, attempt_cap_s=7.0) == 7.0
+    assert deadline_request_timeout(None) is None

@@ -306,7 +306,7 @@ def test_http_error_body_read_reset_is_retryable(monkeypatch):
     from janus_tpu.core.http_client import HttpClient
 
     class _ResettingBody:
-        def read(self):
+        def read(self, amt=None):
             raise ConnectionResetError(104, "Connection reset by peer")
 
         def close(self):

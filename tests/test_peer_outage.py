@@ -1,0 +1,297 @@
+"""Peer-outage parking (aggregator/peer_health.py): while EVERY known
+helper's breaker is open both drivers' claim acquirers park — no claim
+transaction, no lease churn — and the background half-open probe
+resumes them; state exports as the janus_peer_* metric families and
+the /statusz `peer_health` section (docs/ARCHITECTURE.md "Surviving
+the other aggregator")."""
+
+import time
+
+import pytest
+
+from conftest import DATASTORE_ENGINES
+from janus_tpu import metrics
+from janus_tpu.aggregator.job_driver import make_claim_acquirer
+from janus_tpu.aggregator.peer_health import (
+    PROBE_ALIVE,
+    PROBE_DEAD,
+    PROBE_REJECTED,
+    PeerHealthConfig,
+    PeerHealthTracker,
+    default_tracker,
+    reset_default_tracker,
+)
+from janus_tpu.core.circuit_breaker import (
+    CircuitBreakerConfig,
+    OutboundCircuitBreakers,
+)
+
+PEER_URL = "http://helper.test:9999/dap/"
+PEER = "helper.test:9999"
+
+
+def _breakers(threshold=1, cooldown=0.01):
+    return OutboundCircuitBreakers(
+        CircuitBreakerConfig(failure_threshold=threshold, open_cooldown_s=cooldown)
+    )
+
+
+class _FakeFetch:
+    """fetch_any_status stand-in: records calls, answers a status or
+    raises."""
+
+    def __init__(self, status=404, error=None):
+        self.status = status
+        self.error = error
+        self.calls = 0
+
+    def __call__(self, url, timeout=None, **kw):
+        self.calls += 1
+        if self.error is not None:
+            raise self.error
+        return self.status, b""
+
+
+# ----------------------------------------------------------------------
+# the parking predicate
+# ----------------------------------------------------------------------
+def test_parks_only_when_every_known_peer_is_down():
+    br = _breakers()
+    tr = PeerHealthTracker(br)
+    assert not tr.should_park()  # no peers known yet: never park
+    br.record_success("helper-b:80")  # b known and healthy
+    br.record_failure("helper-a:80")
+    assert not tr.should_park()  # partial outage: per-step step-backs
+    assert tr.parked_peers() == ["helper-a:80"]
+    br.record_failure("helper-b:80")
+    assert tr.should_park()  # EVERY known peer down: park outright
+
+
+def test_park_knob_and_enabled_knob_disable_parking():
+    br = _breakers()
+    br.record_failure(PEER)
+    assert not PeerHealthTracker(
+        br, PeerHealthConfig(park=False)
+    ).should_park()
+    assert not PeerHealthTracker(
+        br, PeerHealthConfig(enabled=False)
+    ).should_park()
+
+
+def test_observe_endpoint_returns_label_and_dedups():
+    tr = PeerHealthTracker(_breakers())
+    assert tr.observe_endpoint(PEER_URL) == PEER
+    assert tr.observe_endpoint(PEER_URL + "tasks/x") == PEER
+    assert tr.status()["peers"][PEER]["endpoint"] == PEER_URL
+
+
+# ----------------------------------------------------------------------
+# the acquirer gate: parked = NO claim transaction
+# ----------------------------------------------------------------------
+@pytest.fixture(params=DATASTORE_ENGINES)
+def engine(request):
+    return request.param
+
+
+def test_park_gate_skips_claim_transactions(engine):
+    """A parked pass returns [] without opening a claim tx or feeding
+    the claim metrics; recovery resumes real claims — the lease metrics
+    stay honest through the outage (janus_lease_acquire_tx_total is
+    exactly how the chaos gate asserts the freeze)."""
+    from janus_tpu.core.time_util import MockClock
+    from janus_tpu.datastore.store import EphemeralDatastore
+    from janus_tpu.messages import Duration, Time
+    from test_lease_invariants import make_task, put_job
+
+    eph = EphemeralDatastore(clock=MockClock(Time(1_600_000_000)), engine=engine)
+    ds = eph.datastore
+    try:
+        task = make_task(ds)
+        put_job(ds, task, bytes(16))
+        br = _breakers(cooldown=0.01)
+        tr = PeerHealthTracker(br)
+        tr.observe_endpoint(PEER_URL)
+
+        acquire = make_claim_acquirer(
+            ds,
+            "aggregation",
+            lambda limit: ds.run_tx(
+                lambda tx: tx.acquire_incomplete_aggregation_jobs(
+                    Duration(600), limit
+                ),
+                "acq",
+            ),
+            peer_gate=tr.park_gate(),
+        )
+
+        br.record_failure(PEER)  # helper down: breaker open
+        assert tr.should_park()
+        tx_before = metrics.lease_acquire_tx_total.total()
+        assert acquire(8) == []
+        assert metrics.lease_acquire_tx_total.total() == tx_before
+
+        # recovery: half-open probe slot + success closes the breaker
+        time.sleep(0.02)
+        br.check(PEER)
+        br.record_success(PEER)
+        got = acquire(8)
+        assert len(got) == 1
+        assert metrics.lease_acquire_tx_total.total() == tx_before + 1
+    finally:
+        eph.cleanup()
+
+
+# ----------------------------------------------------------------------
+# the probe
+# ----------------------------------------------------------------------
+def test_probe_any_http_status_resumes():
+    """404 on the task endpoint is a LIVE peer: it routed, accepted the
+    connection and spoke HTTP — the probe closes the breaker."""
+    br = _breakers(cooldown=0.01)
+    fetch = _FakeFetch(status=404)
+    tr = PeerHealthTracker(br, http=fetch)
+    tr.observe_endpoint(PEER_URL)
+    br.record_failure(PEER)
+    probes_before = metrics.peer_probes_total.get(peer=PEER, outcome=PROBE_ALIVE)
+    time.sleep(0.02)
+    assert tr.probe(PEER) == PROBE_ALIVE
+    assert fetch.calls == 1
+    assert br.state(PEER) == "closed"
+    assert not tr.should_park()
+    assert (
+        metrics.peer_probes_total.get(peer=PEER, outcome=PROBE_ALIVE)
+        == probes_before + 1
+    )
+    assert tr.status()["peers"][PEER]["probes"][PROBE_ALIVE] >= 1
+
+
+def test_probe_transport_failure_restarts_cooldown():
+    br = _breakers(cooldown=0.01)
+    tr = PeerHealthTracker(
+        br, http=_FakeFetch(error=ConnectionError("still dead"))
+    )
+    tr.observe_endpoint(PEER_URL)
+    br.record_failure(PEER)
+    time.sleep(0.02)
+    assert tr.probe(PEER) == PROBE_DEAD
+    assert br.state(PEER) == "open"
+    assert br.retry_in_s(PEER) > 0  # full cooldown restarted
+
+
+def test_probe_does_not_stampede_the_half_open_slot():
+    """If a real driver step already holds the single half-open probe
+    slot, the tracker's probe is rejected WITHOUT touching the wire."""
+    br = _breakers(cooldown=0.01)
+    fetch = _FakeFetch()
+    tr = PeerHealthTracker(br, http=fetch)
+    tr.observe_endpoint(PEER_URL)
+    br.record_failure(PEER)
+    time.sleep(0.02)
+    br.check(PEER)  # the driver's own attempt takes the slot
+    assert tr.probe(PEER) == PROBE_REJECTED
+    assert fetch.calls == 0
+
+
+def test_probe_before_cooldown_is_rejected():
+    br = _breakers(cooldown=60.0)
+    fetch = _FakeFetch()
+    tr = PeerHealthTracker(br, http=fetch)
+    tr.observe_endpoint(PEER_URL)
+    br.record_failure(PEER)
+    assert tr.probe(PEER) == PROBE_REJECTED
+    assert fetch.calls == 0
+
+
+def test_probe_without_endpoint_is_rejected():
+    br = _breakers(cooldown=0.01)
+    br.record_failure(PEER)
+    time.sleep(0.02)
+    tr = PeerHealthTracker(br, http=_FakeFetch())
+    assert tr.probe(PEER) == PROBE_REJECTED  # nowhere to aim
+
+
+# ----------------------------------------------------------------------
+# the tick: gauge + outage-seconds accrual
+# ----------------------------------------------------------------------
+def test_tick_accrues_outage_seconds_and_parked_gauge():
+    br = _breakers(cooldown=3600.0)  # cooldown never elapses: no probes
+    tr = PeerHealthTracker(br, http=_FakeFetch())
+    tr.observe_endpoint(PEER_URL)
+    br.record_failure(PEER)
+    outage_before = metrics.peer_outage_seconds_total.get(peer=PEER)
+
+    t0 = 1000.0
+    tr.tick(now=t0)  # first beat: establishes the accrual anchor
+    tr.tick(now=t0 + 5.0)
+    tr.tick(now=t0 + 7.5)
+    assert metrics.peer_parked.get(peer=PEER) == 1.0
+    accrued = metrics.peer_outage_seconds_total.get(peer=PEER) - outage_before
+    assert accrued == pytest.approx(7.5)
+    st = tr.status()
+    assert st["parked"] is True
+    assert st["peers"][PEER]["outage_for_s"] >= 0.0
+
+    # recovery: half-open probe succeeds, next tick clears the gauge
+    # and stops the accrual
+    br._peers[PEER].opened_at -= 7200.0  # test hook: fast-forward
+    br.check(PEER)
+    br.record_success(PEER)
+    tr.tick(now=t0 + 9.0)
+    assert metrics.peer_parked.get(peer=PEER) == 0.0
+    assert (
+        metrics.peer_outage_seconds_total.get(peer=PEER) - outage_before
+        == pytest.approx(7.5)
+    )
+    assert tr.status()["parked"] is False
+
+
+def test_tick_probes_after_cooldown():
+    br = _breakers(cooldown=0.01)
+    fetch = _FakeFetch(status=405)
+    tr = PeerHealthTracker(br, http=fetch)
+    tr.observe_endpoint(PEER_URL)
+    br.record_failure(PEER)
+    time.sleep(0.02)
+    tr.tick()
+    assert fetch.calls == 1
+    assert br.state(PEER) == "closed"
+
+
+# ----------------------------------------------------------------------
+# the process-wide default + /statusz
+# ----------------------------------------------------------------------
+def test_default_tracker_shared_and_on_statusz():
+    from janus_tpu.statusz import status_snapshot
+
+    reset_default_tracker()
+    try:
+        br = _breakers()
+        tr = default_tracker(br, PeerHealthConfig(probe_interval_s=123.0))
+        assert default_tracker(br) is tr  # both drivers share one
+        tr.observe_endpoint(PEER_URL)
+        section = status_snapshot()["peer_health"]
+        assert section["config"]["probe_interval_s"] == 123.0
+        assert section["parked"] is False
+        assert PEER in section["peers"]
+    finally:
+        reset_default_tracker()
+
+
+def test_background_prober_start_stop():
+    br = _breakers(cooldown=0.01)
+    fetch = _FakeFetch(status=404)
+    tr = PeerHealthTracker(
+        br, PeerHealthConfig(probe_interval_s=0.05, probe_timeout_s=0.5), http=fetch
+    )
+    tr.observe_endpoint(PEER_URL)
+    br.record_failure(PEER)
+    tr.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while br.state(PEER) != "closed" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert br.state(PEER) == "closed"  # prober resumed it on its own
+        assert fetch.calls >= 1
+    finally:
+        tr.stop()
+    assert tr._thread is None

@@ -322,6 +322,7 @@ def test_builtin_definitions_cover_the_paper_surface():
         "collect_latency",
         "datastore_up",
         "device_health",
+        "peer_reachable",
         "resource_trend",
     }
     for d in slo.BUILTIN_SLOS():
@@ -397,7 +398,7 @@ def test_install_uninstall_and_alertz_snapshot():
         engine.evaluate_once()
         doc = slo.alertz_snapshot()
         assert doc["enabled"] is True
-        assert len(doc["slos"]) == 6
+        assert len(doc["slos"]) == len(slo.BUILTIN_SLOS())
         assert all("burn_rates" in s for s in doc["slos"])
         # the statusz section is registered and compact
         from janus_tpu.statusz import status_snapshot

@@ -305,6 +305,28 @@ def test_bench_dry_run_smoke():
     assert dbout["uploads_all_acked_ok"] is True, dbout["upload_errors"]
     assert dbout["exactly_once_ok"] is True
     assert dbout["collected_count"] == dbout["admitted"]
+    # peer-outage survival (ISSUE 19; chaos_run.py --scenario
+    # peer_outage): the helper sits behind a netsim fault proxy; a
+    # blackhole past the breaker-open threshold keeps uploads at 201
+    # while BOTH real driver binaries park (claim txes frozen,
+    # janus_peer_parked=1, zero lease conflicts), the cheap half-open
+    # probe resumes them on heal, the slow-drip + truncation lanes
+    # recover without wedging a worker, and the two disjoint
+    # collections partition the admitted ground truth exactly
+    po = rec["peer_outage_smoke"]
+    assert po.get("ok") is True, po
+    assert po["uploads_during_blackhole_ok"] is True
+    assert po["both_parked_ok"] is True
+    assert po["claims_frozen_while_parked_ok"] is True
+    assert po["step_backs_bounded_ok"] is True
+    assert po["outage_seconds_counted_ok"] is True
+    assert po["statusz_peer_health_ok"] is True
+    assert po["unparked_ok"] and po["recovery_agg_ok"]
+    assert po["collect1_exact_ok"] is True, po.get("collect1")
+    assert po["slicer_lane_ok"] and po["truncate_lane_ok"]
+    assert po["lease_conflicts_ok"] and po["probes_alive_ok"]
+    assert po["exactly_once_ok"] is True
+    assert po["drain_ok"] is True
     # deadline-aware device path (ISSUE 8): the disarmed dispatch
     # watchdog is one contextvar read — the acceptance bound is
     # ≤ 1 µs/dispatch (the record carries the real numbers)
