@@ -44,7 +44,7 @@ from ..datastore.models import (
     AggregationJobState,
     ReportAggregationState,
 )
-from .. import metrics
+from .. import ledger, metrics
 from ..datastore.store import Datastore
 from ..messages import (
     AggregationJobContinueReq,
@@ -747,9 +747,15 @@ class AggregationJobDriver:
 
         acquired = st.acquired
 
+        task_id = st.task.task_id
+
         def write_waiting(tx):
             for ra in new_ras:
                 tx.update_report_aggregation(ra)
+            # conservation ledger: FAILED rows reach their terminal here
+            # (parked WAITING rows stay in-flight) — booked in the same
+            # tx so a run_tx retry can't double-count
+            ledger.count_ra_outcomes(tx, task_id, new_ras)
             tx.release_aggregation_job(acquired)
 
         self.ds.run_tx(write_waiting, "step_agg_job_park")
@@ -883,6 +889,10 @@ class AggregationJobDriver:
                 if ra.report_id.data in unmerged:
                     ra = ra.failed(PrepareError.BATCH_COLLECTED)
                 tx.update_report_aggregation(ra)
+            # conservation ledger: every row is terminal in this tx —
+            # FINISHED books aggregated, FINISHED-but-unmerged books
+            # rejected:batch_collected, FAILED books rejected:<err>
+            ledger.count_ra_outcomes(tx, job.task_id, new_ras, unmerged)
             tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
             tx.release_aggregation_job(acquired)
 
@@ -946,6 +956,7 @@ class AggregationJobDriver:
                     metrics.engine_resident_flushes_total.add(
                         len(remaining), reason="merge_failed", outcome="lost"
                     )
+                    ledger.count_lost(self.ds, st.task.task_id, len(remaining))
                     log.exception(
                         "resident delta fetch also failed; %d bucket "
                         "contribution(s) of job %s are LOST",
@@ -1087,6 +1098,10 @@ class AggregationJobDriver:
                     flushed_n += 1
                 for acc in accs.values():
                     acc.flush_to_datastore(tx)
+                if lost:
+                    # first-class ledger terminal for share-mass loss,
+                    # booked in the SAME tx that established the loss
+                    tx.increment_task_counters(task.task_id, {"lost": lost})
                 cell["lost"] = lost
                 cell["flushed"] = flushed_n
 
@@ -1102,6 +1117,7 @@ class AggregationJobDriver:
                 metrics.engine_resident_flushes_total.add(
                     len(rows), reason=reason, outcome="lost"
                 )
+                ledger.count_lost(self.ds, TaskId(task_id_bytes), len(rows))
                 continue
             for outcome in ("flushed", "lost", "stale"):
                 n = outcome_cell.get(outcome, 0)
@@ -1234,6 +1250,7 @@ class AggregationJobDriver:
         def write_waiting(tx):
             for ra in new_ras:
                 tx.update_report_aggregation(ra)
+            ledger.count_ra_outcomes(tx, task.task_id, new_ras)
             tx.release_aggregation_job(acquired)
 
         self.ds.run_tx(write_waiting, "step_p1_job_park")
@@ -1322,6 +1339,7 @@ class AggregationJobDriver:
                 if ra.report_id.data in unmerged:
                     ra = ra.failed(PrepareError.BATCH_COLLECTED)
                 tx.update_report_aggregation(ra)
+            ledger.count_ra_outcomes(tx, task.task_id, new_ras, unmerged)
             tx.update_aggregation_job(new_job)
             tx.release_aggregation_job(acquired)
 

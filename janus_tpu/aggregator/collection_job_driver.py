@@ -27,7 +27,7 @@ from ..datastore.models import (
     AggregateShareJob,
     CollectionJobState,
 )
-from .. import metrics
+from .. import ledger, metrics
 from ..datastore.store import Datastore
 from ..messages import (
     AggregateShare,
@@ -310,6 +310,10 @@ class CollectionJobDriver:
                 tx.mark_batch_aggregations_collected(
                     task.task_id, row.batch_identifier, row.aggregation_parameter
                 )
+            # conservation ledger: only rows still uncollected at gather
+            # time book `collected`, so re-collections of a batch
+            # (max_batch_query_count > 1) add nothing
+            ledger.count_collected(tx, task.task_id, rows)
             tx.update_collection_job(
                 dataclasses.replace(
                     job,
@@ -352,6 +356,65 @@ class CollectionJobDriver:
             float(max(0, self.ds.clock.now().seconds - batch_close)),
             stage="collect",
         )
+        # cross-aggregator reconciliation (ledger.py): after the books
+        # close on our side, ask the helper for its per-batch aggregated
+        # counts and export any divergence — the observability analog of
+        # a linear tag. Best-effort: the collection is already released.
+        self._reconcile_with_helper(task, rows)
+
+    def _reconcile_with_helper(self, task: Task, rows) -> None:
+        """Fetch the helper's per-batch report counts (the authenticated
+        GET /tasks/{id}/ledger debug endpoint) and compare against the
+        batches this collection just covered. Divergence exports as
+        janus_ledger_peer_divergence and feeds the conservation SLO via
+        the installed evaluator's breach tracking (stage="peer")."""
+        ev = ledger.installed_ledger()
+        if ev is None or not ev.cfg.reconcile_peer:
+            return
+        ours: dict[str, int] = {}
+        for row in rows:
+            key = row.batch_identifier.hex()
+            ours[key] = ours.get(key, 0) + int(row.report_count)
+        if not ours:
+            return
+        try:
+            theirs = self._fetch_helper_ledger(task)
+        except Exception:
+            # an unreachable debug endpoint must never fail a finished
+            # collection; the divergence gauge just keeps its last value
+            log.warning(
+                "peer ledger reconciliation fetch failed for task %s",
+                task.task_id,
+                exc_info=True,
+            )
+            return
+        divergence = ev.record_peer_divergence(task.task_id, ours, theirs)
+        if divergence:
+            log.error(
+                "cross-aggregator ledger divergence for task %s: %d report(s) "
+                "differ between our batch aggregations and the helper's",
+                task.task_id,
+                divergence,
+            )
+
+    def _fetch_helper_ledger(self, task: Task) -> dict[str, int]:
+        import base64
+        import json
+
+        url = (
+            task.helper_aggregator_endpoint.rstrip("/")
+            + f"/tasks/{base64.urlsafe_b64encode(task.task_id.data).decode().rstrip('=')}/ledger"
+        )
+        headers = {}
+        if task.aggregator_auth_token:
+            headers.update(task.aggregator_auth_token.request_headers())
+        status, body = self.http.get(url, headers, timeout=30.0)
+        if status != 200:
+            raise RuntimeError(f"helper ledger endpoint returned HTTP {status}")
+        doc = json.loads(body.decode("utf-8"))
+        return {
+            str(k): int(v) for k, v in (doc.get("batch_counts") or {}).items()
+        }
 
     def _ensure_param_aggregation(self, task: Task, job) -> bool:
         """Create aggregation jobs for the collection's parameter over

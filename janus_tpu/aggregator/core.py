@@ -13,7 +13,7 @@ import hashlib
 import secrets
 from dataclasses import dataclass, field
 
-from .. import failpoints, metrics
+from .. import failpoints, ledger, metrics
 from ..core import deadline as deadline_mod
 from ..core.hpke import HpkeApplicationInfo, HpkeError, Label, hpke_open, hpke_seal
 from ..core.time_util import Clock, RealClock
@@ -750,6 +750,14 @@ class TaskAggregator:
                 if ra.report_id.data in unmerged:
                     ra = ra.failed(PrepareError.BATCH_COLLECTED)
                 tx.put_report_aggregation(ra)
+            # conservation ledger, helper side: the RA rows ARE the
+            # admission record (no client_reports on the helper); rows
+            # terminal in this same tx book their outcome too. A replayed
+            # init never reaches here (request-hash check above), and a
+            # racing duplicate dies on the plain-INSERT PK conflict
+            # before these counters commit.
+            ledger.count_admitted(tx, task.task_id, len(report_aggs))
+            ledger.count_ra_outcomes(tx, task.task_id, report_aggs, unmerged)
             return unmerged
 
         # last pre-commit deadline check: a budget that died during the
@@ -920,6 +928,11 @@ class TaskAggregator:
             tx.put_aggregation_job(job)
             for ra in report_aggs:
                 tx.put_report_aggregation(ra)
+            # conservation ledger (see handle_aggregate_init): RA rows
+            # are the helper's admission record; FAILED rows are
+            # terminal already, WAITING_HELPER rows stay in-flight
+            ledger.count_admitted(tx, task.task_id, len(report_aggs))
+            ledger.count_ra_outcomes(tx, task.task_id, report_aggs)
 
         ds.run_tx(write, "aggregate_init_p1")
         return AggregationJobResp(tuple(resps))
@@ -1141,16 +1154,24 @@ class TaskAggregator:
                     last_request_hash=request_hash,
                 )
             )
-            for ra in dropped:
+            dropped_terminal = [
+                ra.failed(PrepareError.REPORT_DROPPED) for ra in dropped
+            ]
+            for ra in dropped_terminal:
                 # waiting rows the leader omitted (failed on its side):
                 # reference marks them ReportDropped (:72-81)
-                tx.update_report_aggregation(ra.failed(PrepareError.REPORT_DROPPED))
+                tx.update_report_aggregation(ra)
             for ra in updated:
                 tx.update_report_aggregation(
                     ra.failed(PrepareError.BATCH_COLLECTED)
                     if ra.report_id.data in unmerged
                     else ra
                 )
+            # conservation ledger: every addressed/omitted row reaches a
+            # terminal in this tx (replays return above, before this)
+            ledger.count_ra_outcomes(
+                tx, task.task_id, updated + dropped_terminal, unmerged
+            )
             if unmerged:
                 resps = [
                     PrepareResp(
@@ -1437,6 +1458,11 @@ class TaskAggregator:
                 tx.mark_batch_aggregations_collected(
                     task.task_id, row.batch_identifier, row.aggregation_parameter
                 )
+            # conservation ledger: only rows still uncollected at gather
+            # time book `collected` — a re-query of the batch
+            # (max_batch_query_count > 1) adds nothing, and a failed tx
+            # (mismatch/size errors below) books nothing
+            ledger.count_collected(tx, task.task_id, rows)
             if share is None:
                 raise errors.BatchInvalid("no aggregated reports in batch", task.task_id)
             # leader/helper consistency (reference checksum/count match)

@@ -81,16 +81,32 @@ class GarbageCollector:
         cfg = self.cfg
 
         def tx_fn(tx):
+            unclaimed, claimed = tx.delete_expired_client_reports(
+                task.task_id, cutoff, cfg.report_limit
+            )
+            jobs, pending_ras = tx.delete_expired_aggregation_artifacts(
+                task.task_id, cutoff, cfg.aggregation_limit
+            )
+            collection = tx.delete_expired_collection_artifacts(
+                task.task_id, cutoff, cfg.collection_limit
+            )
+            # conservation ledger attribution, in the SAME tx as the
+            # deletes (exactly-once under run_tx retries): an expired
+            # never-claimed report leaves the pending pool for the
+            # `expired` terminal, and so does a claimed report whose
+            # report_aggregations row died non-terminal with its
+            # expired job. Claimed rows whose RA already resolved were
+            # booked aggregated/rejected at resolution — deleting their
+            # storage is not a lifecycle event, only `expired_reclaimed`
+            # bookkeeping for /debug/ledger.
+            tx.increment_task_counters(
+                task.task_id,
+                {"expired": unclaimed + pending_ras, "expired_reclaimed": claimed},
+            )
             return {
-                "reports": tx.delete_expired_client_reports(
-                    task.task_id, cutoff, cfg.report_limit
-                ),
-                "aggregation": tx.delete_expired_aggregation_artifacts(
-                    task.task_id, cutoff, cfg.aggregation_limit
-                ),
-                "collection": tx.delete_expired_collection_artifacts(
-                    task.task_id, cutoff, cfg.collection_limit
-                ),
+                "reports": unclaimed + claimed,
+                "aggregation": jobs,
+                "collection": collection,
             }
 
         deleted = self.ds.run_tx(tx_fn, "gc_task")

@@ -88,10 +88,16 @@ class HealthSampler:
     series; the table row counts (janus_datastore_table_rows) are
     always sampled."""
 
-    def __init__(self, ds, interval_s: float = 15.0, artifact_paths=None, gc=None):
+    def __init__(
+        self, ds, interval_s: float = 15.0, artifact_paths=None, gc=None, ledger=None
+    ):
         self.ds = ds
         self.artifact_paths = dict(artifact_paths or {})
         self.gc = gc
+        # conservation-ledger evaluator (janus_tpu/ledger.py): balance
+        # evaluation rides the sampler cadence so "the books close
+        # within one sampler interval" is literally one run_once
+        self.ledger = ledger
         self.interval_s = float(interval_s)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -199,6 +205,10 @@ class HealthSampler:
             metrics.artifact_bytes.set(float(size), artifact=label, **rl)
         if self.gc is not None:
             self.gc.observe_lag()
+        if self.ledger is not None:
+            # evaluate_once never raises (errors keep the previous
+            # balance document and count as outcome="error")
+            self.ledger.evaluate_once()
 
         self.last_snapshot = {
             "sampled_at_clock_seconds": now,

@@ -65,6 +65,10 @@ _ROUTES = [
     ("POST", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "collection_poll"),
     ("DELETE", re.compile(r"^/tasks/([^/]+)/collection_jobs/([^/]+)$"), "collection_delete"),
     ("POST", re.compile(r"^/tasks/([^/]+)/aggregate_shares$"), "aggregate_share"),
+    # cross-aggregator ledger reconciliation (janus_tpu/ledger.py): the
+    # leader's collection driver reads the helper's per-batch counts
+    # over the same aggregator-auth channel as the DAP steps
+    ("GET", re.compile(r"^/tasks/([^/]+)/ledger$"), "ledger"),
 ]
 
 # Admission route classes (docs/INGEST.md shed policy): client uploads
@@ -507,6 +511,29 @@ class DapHttpApp:
         req = AggregateShareReq.from_bytes(body)
         resp = ta.handle_aggregate_share(self.agg.ds, req)
         return 200, "application/dap-aggregate-share", resp.to_bytes()
+
+    def h_ledger(self, match, query, headers, body):
+        """Cross-aggregator reconciliation read (janus_tpu/ledger.py):
+        this aggregator's per-batch aggregated report counts plus its
+        lifecycle counters for the task, behind the same leader->helper
+        aggregator auth as the DAP aggregation steps. The payload is
+        the peer's half of the conservation comparison — the
+        observability analog of a linear tag over the batch."""
+        import json
+
+        task_id = TaskId(_b64dec(match.group(1), 32))
+        taskprov_config = self._taskprov_config(task_id, headers)
+        ta = self.agg.task_aggregator_for(
+            task_id, taskprov_config, headers, peer_role=Role.LEADER
+        )
+        self._check_helper_auth(ta, task_id, headers, taskprov_config)
+
+        def read(tx):
+            return tx.ledger_batch_counts(task_id), tx.get_task_counters(task_id)
+
+        batch_counts, counters = self.agg.ds.run_tx(read, "ledger_peer_read")
+        doc = {"batch_counts": batch_counts, "counters": counters}
+        return 200, "application/json", json.dumps(doc, sort_keys=True).encode()
 
 
 class DapServer:

@@ -37,6 +37,39 @@ from ..datastore.store import Datastore
 log = logging.getLogger(__name__)
 
 
+def _ledger_book_admitted(tx, reports, results) -> None:
+    """Book fresh admissions in the conservation ledger, INSIDE the
+    same transaction as the puts (run_tx retries re-run the whole
+    closure, so the count is exactly-once; replays book nothing). Then
+    give the `ledger.drop_report` chaos failpoint its window: it
+    deletes one just-admitted row AFTER the counter booked it — a
+    silent loss the ledger's ingest equation must surface within one
+    sampler interval."""
+    from .. import failpoints, ledger
+
+    per_task: dict = {}
+    for r, fresh in zip(reports, results):
+        if fresh:
+            per_task[r.task_id] = per_task.get(r.task_id, 0) + 1
+    for task_id, n in per_task.items():
+        ledger.count_admitted(tx, task_id, n)
+    if not per_task:
+        return
+    try:
+        failpoints.hit("ledger.drop_report")
+    except failpoints.FailpointError:
+        for r, fresh in zip(reports, results):
+            if fresh:
+                tx.delete_client_report(r.task_id, r.report_id)
+                log.error(
+                    "failpoint ledger.drop_report: silently dropped admitted"
+                    " report %s of task %s",
+                    r.report_id,
+                    r.task_id,
+                )
+                break
+
+
 class _Pending:
     __slots__ = ("report", "event", "fresh", "error", "on_done")
 
@@ -151,7 +184,9 @@ class ReportWriteBatcher:
         raises on failure."""
 
         def tx_fn(tx):
-            return [tx.put_client_report(r) for r in reports]
+            results = [tx.put_client_report(r) for r in reports]
+            _ledger_book_admitted(tx, reports, results)
+            return results
 
         return self.ds.run_tx(tx_fn, "upload_journal_replay")
 
@@ -199,7 +234,9 @@ class ReportWriteBatcher:
                 return
 
             def tx_fn(tx):
-                return [tx.put_client_report(p.report) for p in batch]
+                results = [tx.put_client_report(p.report) for p in batch]
+                _ledger_book_admitted(tx, [p.report for p in batch], results)
+                return results
 
             t0 = time.monotonic()
             try:

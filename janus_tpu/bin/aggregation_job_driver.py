@@ -69,12 +69,17 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         releaser=releaser,
         pipeline=pipeline,
     )
+    # conservation-ledger evaluation rides the sampler (ledger.py)
+    from ..ledger import install_ledger
+
+    ledger_ev = install_ledger(ds, cfg.common.ledger)
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:
         sampler = HealthSampler(
             ds,
             cfg.common.health_sampler_interval_s,
             artifact_paths=artifact_paths_from_config(cfg.common),
+            ledger=ledger_ev,
         ).start()
     # resident mode: background flusher bounds the unflushed window for
     # idle drivers and flushes a quarantined engine's state so the

@@ -49,6 +49,13 @@ def run(cfg: AggregatorConfig, ds, stopper):
 
     gc = GarbageCollector(ds, clock) if cfg.garbage_collection_interval_s else None
 
+    # report-flow conservation ledger (janus_tpu/ledger.py): balance
+    # evaluation rides the health sampler; /debug/ledger + the `ledger`
+    # statusz section read the installed evaluator ambiently
+    from ..ledger import install_ledger
+
+    ledger_ev = install_ledger(ds, cfg.common.ledger)
+
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:
         sampler = HealthSampler(
@@ -56,6 +63,7 @@ def run(cfg: AggregatorConfig, ds, stopper):
             cfg.common.health_sampler_interval_s,
             artifact_paths=artifact_paths_from_config(cfg.common, cfg),
             gc=gc,
+            ledger=ledger_ev,
         ).start()
 
     gc_thread = None

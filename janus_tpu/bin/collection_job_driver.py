@@ -52,12 +52,19 @@ def run(cfg: JobDriverBinaryConfig, ds, stopper):
         stopper,
         releaser=lambda acquired: driver.step_back(acquired, "shutdown_drain", 0.0),
     )
+    # conservation-ledger evaluation rides the sampler, and the
+    # installed evaluator also powers this driver's cross-aggregator
+    # reconciliation after each finished collection (ledger.py)
+    from ..ledger import install_ledger
+
+    ledger_ev = install_ledger(ds, cfg.common.ledger)
     sampler = None
     if cfg.common.health_sampler_interval_s > 0:
         sampler = HealthSampler(
             ds,
             cfg.common.health_sampler_interval_s,
             artifact_paths=artifact_paths_from_config(cfg.common),
+            ledger=ledger_ev,
         ).start()
     try:
         jd.run()

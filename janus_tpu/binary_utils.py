@@ -57,6 +57,7 @@ _INDEX_ENDPOINTS = (
     ("/debug/profile?format=json", "continuous profiler: per-role self/total shares"),
     ("/debug/boot", "boot-phase timeline (process start to /readyz ready)"),
     ("/debug/flight", "telemetry flight recorder: resource history, trend slopes, leak verdicts"),
+    ("/debug/ledger", "report-flow conservation ledger: per-task balance, imbalance, breaches"),
 )
 
 
@@ -459,6 +460,17 @@ class HealthServer:
                             flight_document(window_s=window_s, max_points=max_points),
                             default=str,
                         ).encode(),
+                    )
+                elif parts.path == "/debug/ledger":
+                    # report-flow conservation ledger: latest complete
+                    # per-task balance document (torn-read tolerant —
+                    # the evaluator hands out the last COMPLETE doc)
+                    from .ledger import ledger_document
+
+                    self._send(
+                        200,
+                        "application/json",
+                        _json.dumps(ledger_document(), default=str).encode(),
                     )
                 else:
                     self._send(404, "text/plain", b"not found")

@@ -22,6 +22,7 @@ from .aggregator.step_pipeline import StepPipelineConfig
 from .core.circuit_breaker import CircuitBreakerConfig
 from .core.http_client import HttpClientConfig
 from .flight_recorder import FlightRecorderConfig
+from .ledger import LedgerConfig
 from .profiler import ProfilerConfig
 from .slo import SloEngineConfig
 from .trace import TraceConfiguration
@@ -284,6 +285,13 @@ class CommonConfig:
     # plus the trend/leak analyzer feeding the `trend` SLO signal.
     # Enabled by default in every binary (memory-only until `dir` set).
     flight: FlightRecorderConfig = field(default_factory=FlightRecorderConfig)
+    # Report-flow conservation ledger (YAML `ledger:` section;
+    # docs/OBSERVABILITY.md "Conservation accounting"): per-task balance
+    # evaluation at health-sampler cadence behind GET /debug/ledger,
+    # grace window before an imbalance pages, and the leader collection
+    # driver's cross-aggregator reconciliation fetch. Enabled by default
+    # in every datastore-owning binary.
+    ledger: LedgerConfig = field(default_factory=LedgerConfig)
     # Fleet identity + job-claim sharding (YAML `fleet:` section;
     # docs/ARCHITECTURE.md "Running a fleet"): replica id stamped into
     # lease tokens/metrics/traces, and this replica's slice of the
@@ -314,6 +322,7 @@ class CommonConfig:
             engine=EngineConfig.from_dict(d.get("engine")),
             profiler=ProfilerConfig.from_dict(d.get("profiler")),
             flight=FlightRecorderConfig.from_dict(d.get("flight")),
+            ledger=LedgerConfig.from_dict(d.get("ledger")),
             fleet=FleetConfig.from_dict(d.get("fleet")),
         )
 

@@ -83,7 +83,7 @@ from .models import (
     ShardSpec,
 )
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 # POSTGRES TRANSLATION CONSTRAINTS (tests/test_pg_dialect.py enforces):
 # the Postgres engine derives its DDL from this exact text via
@@ -227,6 +227,19 @@ CREATE TABLE IF NOT EXISTS taskprov_peer_aggregators (
     role INTEGER NOT NULL,
     doc BLOB NOT NULL,           -- encrypted serialized PeerAggregator
     PRIMARY KEY (endpoint, role)
+);
+
+-- Report-flow conservation ledger (janus_tpu/ledger.py): monotone
+-- per-task lifecycle counters, incremented INSIDE the same transaction
+-- as the state change they count — run_tx retries re-run the whole
+-- closure, so a counter updated in the tx is exactly-once, and every
+-- process (listener, driver fleet, GC) sees one consistent set of
+-- books. Bounded: O(tasks x counter names), never per-report.
+CREATE TABLE IF NOT EXISTS task_counters (
+    task_id BLOB NOT NULL,
+    counter_name TEXT NOT NULL,
+    amount INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (task_id, counter_name)
 );
 """
 
@@ -507,6 +520,18 @@ class Transaction:
         )
         return cur.rowcount == 1
 
+    def delete_client_report(self, task_id: TaskId, report_id: ReportId) -> bool:
+        """Delete one stored report row. Production code never calls
+        this — it exists for the `ledger.drop_report` chaos failpoint
+        (inject a silent loss AFTER the admission counter booked the
+        report, so the conservation ledger must catch it) and for test
+        harnesses. Returns True if a row was deleted."""
+        cur = self._c.execute(
+            "DELETE FROM client_reports WHERE task_id = ? AND report_id = ?",
+            (task_id.data, report_id.data),
+        )
+        return cur.rowcount == 1
+
     def get_client_report(self, task_id: TaskId, report_id: ReportId) -> LeaderStoredReport | None:
         row = self._c.execute(
             "SELECT client_time, public_share, leader_input_share, helper_encrypted_input_share"
@@ -600,6 +625,7 @@ class Transaction:
         "aggregate_share_jobs",
         "batches",
         "outstanding_batches",
+        "task_counters",
     )
 
     def count_table_rows(self) -> dict[str, int]:
@@ -609,14 +635,171 @@ class Transaction:
             for t in self.COUNTED_TABLES
         }
 
-    def delete_expired_client_reports(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
-        cur = self._c.execute(
-            "DELETE FROM client_reports WHERE (task_id, report_id) IN ("
-            " SELECT task_id, report_id FROM client_reports"
-            " WHERE task_id = ? AND client_time < ? LIMIT ?)",
-            (task_id.data, cutoff.seconds, limit),
+    def delete_expired_client_reports(self, task_id: TaskId, cutoff: Time, limit: int) -> tuple[int, int]:
+        """(never-claimed, claimed) expired rows deleted — split by
+        aggregation_started so the GC can attribute expiry in the
+        conservation ledger: a never-claimed report leaves the pending
+        pool for the `expired` terminal, while a claimed one already
+        resolved (or will resolve) through its report_aggregations row
+        and only its storage is reclaimed here."""
+        out = []
+        for started in (0, 1):
+            cur = self._c.execute(
+                "DELETE FROM client_reports WHERE (task_id, report_id) IN ("
+                " SELECT task_id, report_id FROM client_reports"
+                " WHERE task_id = ? AND client_time < ? AND aggregation_started = ? LIMIT ?)",
+                (task_id.data, cutoff.seconds, started, max(0, limit - sum(out))),
+            )
+            out.append(cur.rowcount)
+        return out[0], out[1]
+
+    # ---- report-flow conservation ledger (janus_tpu/ledger.py) ----
+    def increment_task_counters(self, task_id: TaskId, deltas: dict[str, int]) -> None:
+        """Upsert-add monotone lifecycle counters for a task. MUST be
+        called inside the same transaction as the state change being
+        counted: run_tx re-runs the whole closure on a retry, so an
+        in-tx increment is exactly-once where an in-process counter
+        would double-count (the documented run_tx retry discipline)."""
+        rows = [(task_id.data, name, int(n)) for name, n in deltas.items() if n]
+        if not rows:
+            return
+        self._c.executemany(
+            "INSERT INTO task_counters (task_id, counter_name, amount) VALUES (?,?,?)"
+            " ON CONFLICT (task_id, counter_name) DO UPDATE SET"
+            " amount = task_counters.amount + excluded.amount",
+            rows,
         )
-        return cur.rowcount
+
+    def get_task_counters(self, task_id: TaskId) -> dict[str, int]:
+        rows = self._c.execute(
+            "SELECT counter_name, amount FROM task_counters WHERE task_id = ?",
+            (task_id.data,),
+        ).fetchall()
+        return {str(r[0]): int(r[1]) for r in rows}
+
+    def get_all_task_counters(self) -> dict[bytes, dict[str, int]]:
+        """{task_id: {counter: amount}} over every task with books."""
+        out: dict[bytes, dict[str, int]] = {}
+        for task_id, name, amount in self._c.execute(
+            "SELECT task_id, counter_name, amount FROM task_counters"
+        ).fetchall():
+            out.setdefault(bytes(task_id), {})[str(name)] = int(amount)
+        return out
+
+    def ledger_inflight_by_task(self) -> dict[bytes, dict[str, int]]:
+        """{task_id: {category: count}} of attributably in-flight
+        reports, read in one transaction so the ledger's balance
+        evaluates against a single snapshot:
+
+        - pending_reports: admitted client_reports no aggregation job
+          has claimed yet (aggregation_started = 0)
+        - pending_aggregation: report_aggregations still in a
+          non-terminal state (start / waiting_*) — claimed, outcome due
+        - awaiting_collection: aggregated report mass sitting in
+          uncollected batch_aggregations shards
+        """
+        out: dict[bytes, dict[str, int]] = {}
+        for task_id, n in self._c.execute(
+            "SELECT task_id, COUNT(*) FROM client_reports"
+            " WHERE aggregation_started = 0 GROUP BY task_id"
+        ).fetchall():
+            out.setdefault(bytes(task_id), {})["pending_reports"] = int(n)
+        # only RAs of live jobs: abandon_job releases a job's START rows
+        # back to the unclaimed pool without rewriting them, so counting
+        # an abandoned job's rows would double-book those reports (and a
+        # WAITING row stuck in an abandoned job SHOULD read as imbalance
+        # — it will never resolve, which is exactly a lost report)
+        for task_id, n in self._c.execute(
+            "SELECT ra.task_id, COUNT(*) FROM report_aggregations ra"
+            " JOIN aggregation_jobs aj"
+            "   ON aj.task_id = ra.task_id AND aj.job_id = ra.job_id"
+            " WHERE ra.state IN ('start', 'waiting_leader', 'waiting_helper')"
+            " AND aj.state = 'in_progress' GROUP BY ra.task_id"
+        ).fetchall():
+            out.setdefault(bytes(task_id), {})["pending_aggregation"] = int(n)
+        for task_id, n in self._c.execute(
+            "SELECT task_id, COALESCE(SUM(report_count), 0) FROM batch_aggregations"
+            " WHERE state <> 'collected' GROUP BY task_id"
+        ).fetchall():
+            out.setdefault(bytes(task_id), {})["awaiting_collection"] = int(n)
+        return out
+
+    def ledger_batch_counts(self, task_id: TaskId) -> dict[str, int]:
+        """{batch_identifier hex: aggregated report count} for a task —
+        the cross-aggregator reconciliation payload (both aggregators
+        persist batch_aggregations; equal per-batch counts mean neither
+        side silently dropped or double-counted a report the other
+        aggregated — the observability analog of a linear tag)."""
+        rows = self._c.execute(
+            "SELECT batch_identifier, COALESCE(SUM(report_count), 0)"
+            " FROM batch_aggregations WHERE task_id = ?"
+            " GROUP BY batch_identifier",
+            (task_id.data,),
+        ).fetchall()
+        return {bytes(r[0]).hex(): int(r[1]) for r in rows}
+
+    def ledger_report_trace(self, task_id: TaskId, report_id: ReportId) -> dict:
+        """One report's whereabouts across every pipeline table — the
+        per-report drill-down behind tools/report_trace.py (the ledger
+        says HOW MANY are unaccounted; this answers WHICH stage one
+        specific report reached). Read-only; single snapshot."""
+        out: dict = {"client_report": None, "report_aggregations": [], "batch_aggregations": []}
+        row = self._c.execute(
+            "SELECT client_time, aggregation_started FROM client_reports"
+            " WHERE task_id = ? AND report_id = ?",
+            (task_id.data, report_id.data),
+        ).fetchone()
+        client_time = None
+        if row is not None:
+            client_time = int(row[0])
+            out["client_report"] = {
+                "client_time": client_time,
+                "aggregation_started": bool(row[1]),
+            }
+        for r in self._c.execute(
+            "SELECT ra.job_id, ra.ord, ra.state, ra.prepare_error, ra.client_time,"
+            " aj.state, aj.step, aj.lease_attempts"
+            " FROM report_aggregations ra"
+            " LEFT JOIN aggregation_jobs aj"
+            "   ON aj.task_id = ra.task_id AND aj.job_id = ra.job_id"
+            " WHERE ra.task_id = ? AND ra.report_id = ?"
+            " ORDER BY ra.job_id, ra.ord",
+            (task_id.data, report_id.data),
+        ).fetchall():
+            if client_time is None:
+                client_time = int(r[4])
+            out["report_aggregations"].append(
+                {
+                    "job_id": bytes(r[0]).hex(),
+                    "ord": int(r[1]),
+                    "state": str(r[2]),
+                    "prepare_error": None if r[3] is None else int(r[3]),
+                    "job_state": None if r[5] is None else str(r[5]),
+                    "job_step": None if r[6] is None else int(r[6]),
+                    "job_attempts": None if r[7] is None else int(r[7]),
+                }
+            )
+        if client_time is not None:
+            # every accumulator shard whose client interval covers this
+            # report's timestamp — collected shards mean the report's
+            # mass (if it FINISHED) has left through a collection
+            for r in self._c.execute(
+                "SELECT batch_identifier, ord, state, report_count"
+                " FROM batch_aggregations WHERE task_id = ?"
+                " AND client_interval_start <= ?"
+                " AND client_interval_start + client_interval_duration > ?"
+                " ORDER BY batch_identifier, ord",
+                (task_id.data, client_time, client_time),
+            ).fetchall():
+                out["batch_aggregations"].append(
+                    {
+                        "batch_identifier": bytes(r[0]).hex(),
+                        "ord": int(r[1]),
+                        "state": str(r[2]),
+                        "report_count": int(r[3]),
+                    }
+                )
+        return out
 
     # ---- aggregation jobs (reference datastore.rs:1724-2051) ----
     def put_aggregation_job(self, job: AggregationJobModel) -> None:
@@ -1775,14 +1958,27 @@ class Transaction:
         return [str(r[0]) for r in rows]
 
     # ---- GC (reference datastore.rs:4162-4315) ----
-    def delete_expired_aggregation_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
+    def delete_expired_aggregation_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> tuple[int, int]:
+        """(jobs deleted, non-terminal report_aggregations deleted).
+        The second count is the GC's ledger attribution: a start/
+        waiting row deleted here would otherwise sit in the in-flight
+        pool forever (its job expired before resolving), so the GC
+        books it as `expired` in the same transaction."""
         rows = self._c.execute(
             "SELECT job_id FROM aggregation_jobs WHERE task_id = ?"
             " AND client_interval_start + client_interval_duration < ? LIMIT ?",
             (task_id.data, cutoff.seconds, limit),
         ).fetchall()
-        n = 0
+        n = pending = 0
         for (job_id,) in rows:
+            pending += int(
+                self._c.execute(
+                    "SELECT COUNT(*) FROM report_aggregations"
+                    " WHERE task_id = ? AND job_id = ?"
+                    " AND state IN ('start', 'waiting_leader', 'waiting_helper')",
+                    (task_id.data, job_id),
+                ).fetchone()[0]
+            )
             self._c.execute(
                 "DELETE FROM report_aggregations WHERE task_id = ? AND job_id = ?",
                 (task_id.data, job_id),
@@ -1792,7 +1988,7 @@ class Transaction:
                 (task_id.data, job_id),
             )
             n += cur.rowcount
-        return n
+        return n, pending
 
     def delete_expired_collection_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
         # aggregate_share_jobs carry no client-time column in this schema;

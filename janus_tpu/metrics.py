@@ -988,6 +988,41 @@ artifact_bytes = REGISTRY.gauge(
     "each for unbounded-growth leaks",
 )
 
+# --- report-flow conservation ledger (ISSUE 20; janus_tpu/ledger.py;
+# docs/OBSERVABILITY.md "Conservation accounting") ---
+ledger_imbalance = REGISTRY.gauge(
+    "janus_ledger_imbalance",
+    "per-(task, stage) report-flow conservation residual, evaluated "
+    "at health-sampler cadence from the datastore-backed lifecycle "
+    'counters: stage="ingest" is admitted - aggregated - rejected - '
+    'expired - in-flight, stage="collect" is aggregated - collected - '
+    "awaiting-collection. 0 means the books close; a sustained "
+    "positive value is a silently lost report, a sustained negative "
+    "one a double-count",
+)
+ledger_breach_active = REGISTRY.gauge(
+    "janus_ledger_breach_active",
+    "1 per (task, stage) whose conservation imbalance (or peer "
+    'divergence, stage="peer") has been continuously nonzero longer '
+    "than the ledger grace window — the conservation SLO signal's "
+    "feed; transient read-snapshot skew between the counter and "
+    "in-flight reads clears within the grace window and never sets it",
+)
+ledger_peer_divergence = REGISTRY.gauge(
+    "janus_ledger_peer_divergence",
+    "absolute difference between this leader's and the helper's "
+    "per-batch aggregated report counts for the batches covered by a "
+    "finished collection, from the helper's authenticated ledger "
+    "reconciliation endpoint — the observability analog of a linear "
+    "tag: 0 means both aggregators aggregated the same report mass",
+)
+ledger_evaluations_total = REGISTRY.counter(
+    "janus_ledger_evaluations_total",
+    'conservation-ledger evaluation passes, by outcome ("ok" | '
+    '"error") — error passes keep the previous balance document and '
+    "retry next tick",
+)
+
 # --- fleet scale-out: batched sharded lease claims + replica identity
 # (ISSUE 15; docs/ARCHITECTURE.md "Running a fleet") ---
 lease_acquire_tx_total = REGISTRY.counter(

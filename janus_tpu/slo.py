@@ -351,11 +351,72 @@ class TrendSignal:
         return out
 
 
+@dataclass(frozen=True)
+class ConservationSignal:
+    """Time-based SLO over the report-flow conservation ledger
+    (janus_tpu/ledger.py; docs/OBSERVABILITY.md "Conservation
+    accounting"): every evaluation tick is one event, bad while any
+    matched series of `metric` (default janus_ledger_breach_active — 1
+    once a per-(task, stage) imbalance has stayed nonzero past the
+    ledger's grace window) is above zero. A silently lost or
+    double-counted report moves no rate and no latency histogram — the
+    unbalanced books are the only signal, and this is how they page
+    through the same burn-rate ladder. The breach gauges are only born
+    once an installed evaluator's first pass runs, so a process without
+    a ledger reports no_data rather than fake health."""
+
+    kind = "conservation"
+    metric: str = "janus_ledger_breach_active"
+    labels: tuple = ()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ConservationSignal":
+        return cls(
+            metric=str(d.get("metric", "janus_ledger_breach_active")),
+            labels=compile_matchers(d.get("labels")),
+        )
+
+    def _read_raw(self) -> tuple[float, int]:
+        m = REGISTRY.get(self.metric)
+        if m is None or not hasattr(m, "sum_matching"):
+            return 0.0, 0
+        return m.sum_matching(self.labels)
+
+    def read(self, engine) -> tuple[float, float, bool]:
+        st = engine._condition_state.setdefault(
+            id(self), {"bad": 0.0, "total": 0.0, "prev": {}}
+        )
+        v, n = self._read_raw()
+        if n == 0:
+            return st["bad"], st["total"], st["total"] > 0
+        st["total"] += 1.0
+        if v > 0:
+            st["bad"] += 1.0
+        return st["bad"], st["total"], True
+
+    def evidence(self) -> dict:
+        desc = Selector(self.metric, self.labels).describe()
+        v, n = self._read_raw()
+        out = {f"{desc} breached series": v if n else None}
+        breach = REGISTRY.get(self.metric)
+        imbalance = REGISTRY.get("janus_ledger_imbalance")
+        if v > 0 and hasattr(breach, "_values") and hasattr(imbalance, "_values"):
+            with breach._lock:
+                breach_vals = dict(breach._values)
+            with imbalance._lock:
+                imbalance_vals = dict(imbalance._values)
+            for key, active in sorted(breach_vals.items()):
+                if active > 0:
+                    out[f"imbalance{dict(key)}"] = imbalance_vals.get(key)
+        return out
+
+
 _SIGNAL_KINDS = {
     "counter_ratio": RatioSignal,
     "histogram_latency": LatencySignal,
     "condition": ConditionSignal,
     "trend": TrendSignal,
+    "conservation": ConservationSignal,
 }
 
 
@@ -547,6 +608,41 @@ def BUILTIN_SLOS() -> list[SloDefinition]:
                         selector=Selector("janus_peer_parked", ()),
                         op=">",
                         value=0.0,
+                    ),
+                )
+            ),
+        ),
+        SloDefinition(
+            name="report_conservation",
+            description=(
+                "the report-flow books close: no per-(task, stage) "
+                "conservation imbalance — lost or double-counted reports "
+                "— sustained past the ledger grace window, and no "
+                "cross-aggregator divergence (janus_ledger_breach_active)"
+            ),
+            objective=0.999,
+            signal=ConservationSignal(),
+        ),
+        SloDefinition(
+            name="resident_lost",
+            description=(
+                "no resident aggregate share was lost on the flush path "
+                '(janus_engine_resident_flushes_total{outcome="lost"}): '
+                "count books still balance (counts are durable at job "
+                "commit), but the lost share mass silently skews the "
+                "released aggregate"
+            ),
+            objective=0.999,
+            signal=ConditionSignal(
+                conditions=(
+                    Condition(
+                        selector=Selector(
+                            "janus_engine_resident_flushes_total",
+                            compile_matchers({"outcome": "lost"}),
+                        ),
+                        op=">",
+                        value=0.0,
+                        mode="delta",
                     ),
                 )
             ),

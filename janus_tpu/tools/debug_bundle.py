@@ -9,7 +9,8 @@
 Snapshots every introspection endpoint of one or several binaries'
 health listeners — /metrics (both exposition modes), /statusz,
 /debug/vars, /debug/traces, /debug/profile (collapsed + JSON),
-/debug/boot, /debug/flight, /alertz, /readyz, /healthz — plus the
+/debug/boot, /debug/flight, /debug/ledger, /alertz, /readyz,
+/healthz — plus the
 resolved YAML config (secrets redacted) and the upload-journal
 directory state, into a timestamped tar.gz with a MANIFEST.json
 inventorying every capture (source, HTTP status, bytes, sha256). One
@@ -58,6 +59,10 @@ ENDPOINTS = (
     # slope/leak report — the long-horizon evidence a point-in-time
     # snapshot can't reconstruct
     ("debug_flight", "/debug/flight"),
+    # report-flow conservation ledger: the per-task balance document —
+    # whether the books closed at capture time, and where the
+    # imbalance sits if they didn't
+    ("debug_ledger", "/debug/ledger"),
 )
 
 _SECRET_KEY_RE = re.compile(r"(token|secret|password|key)s?$", re.IGNORECASE)

@@ -23,6 +23,10 @@ docs/OBSERVABILITY.md):
   - trend SLOs (resource leaks) alert while the flight recorder's
     janus_flight_leak_active verdict gauge is nonzero for the rung's
     short window — the slope/noise analysis already ran in-process.
+  - conservation SLOs (report-flow ledger) alert while any
+    janus_ledger_breach_active series is nonzero for the rung's short
+    window — the ledger evaluator already debounced the imbalance
+    through its grace window, so the gauge is a settled verdict.
 """
 
 from __future__ import annotations
@@ -33,6 +37,7 @@ import sys
 from ..slo import (
     BUILTIN_SLOS,
     ConditionSignal,
+    ConservationSignal,
     LatencySignal,
     RatioSignal,
     SloDefinition,
@@ -129,6 +134,13 @@ def rules_for(defs: list[SloDefinition]) -> dict:
                 for_ = None
             elif isinstance(d.signal, ConditionSignal):
                 expr = _condition_expr(d.signal, short_w)
+                for_ = short_w
+            elif isinstance(d.signal, ConservationSignal):
+                # the ledger already held the residual through its
+                # grace window before raising the breach gauge, so a
+                # threshold alert on the debounced verdict is faithful
+                sel = f"{d.signal.metric}{_matchers_promql(d.signal.labels)}"
+                expr = f"(sum({sel}) > 0)"
                 for_ = short_w
             elif isinstance(d.signal, TrendSignal):
                 # like conditions: the leak-verdict gauge is already a

@@ -2360,6 +2360,30 @@ def run_fleet(
     }
     procs: list[subprocess.Popen] = []
     leader_srv = helper_srv = None
+    # report-flow conservation gate (ISSUE 20): the ledger evaluates
+    # against the shared leader store at every quiesce point — the
+    # books must close (imbalance 0) after every wave, through the
+    # kill, the drain, the steal and the restart. grace 0: a nonzero
+    # residual at a quiesce point breaches immediately. The installed
+    # evaluator also powers the in-process collection driver's
+    # cross-aggregator reconciliation in phase 4.
+    from janus_tpu import ledger as ledger_mod
+
+    ledger_ev = ledger_mod.install_ledger(
+        leader_ds, ledger_mod.LedgerConfig(grace_s=0.0)
+    )
+    conservation: dict[str, dict] = {}
+
+    def conservation_check(tag: str) -> bool:
+        doc = ledger_ev.evaluate_once()
+        imb = {
+            label: dict(t["imbalance"]) for label, t in doc.get("tasks", {}).items()
+        }
+        conservation[tag] = imb
+        return bool(imb) and all(
+            v.get("ingest") == 0 and v.get("collect") == 0 for v in imb.values()
+        )
+
     try:
         # --- phase 1: claim round-trips per job, measured ------------
         result["claim_stats"] = claim_roundtrip_stats()
@@ -2490,6 +2514,9 @@ def run_fleet(
             result[f"scale_{n}_done_ok"] = done
             rps[n] = (jobs_per_replica * n * job_size) / max(1e-9, elapsed)
             result[f"drain_scale_{n}_ok"] = drain(fleet)
+            # quiesce point: the wave is finished and the replicas are
+            # drained — every admitted report must be accounted for
+            result[f"conservation_scale_{n}_ok"] = conservation_check(f"scale_{n}")
         n_max = max(phase_counts)
         result["fleet_scaling"] = {
             "replica_counts": list(phase_counts),
@@ -2640,6 +2667,9 @@ def run_fleet(
         result["steals_observed_ok"] = steals >= 1.0  # the dead shard drained
 
         result["drain_final_ok"] = drain(survivors)
+        # quiesce point: kill + drain + steal + restart are behind us
+        # and every wave is finished — the books must still close
+        result["conservation_chaos_ok"] = conservation_check("chaos")
 
         # --- phase 4: collect EVERYTHING vs ground truth -------------
         cdrv = CollectionJobDriver(leader_ds, HttpClient())
@@ -2688,6 +2718,56 @@ def run_fleet(
             stop_collect.set()
             ct.join(timeout=10)
 
+        # quiesce point: post-collection BOTH stages must close —
+        # ingest (admitted == aggregated) and collect (aggregated ==
+        # collected, nothing left awaiting)
+        result["conservation_collected_ok"] = conservation_check("collected")
+        result["conservation"] = conservation
+        # cross-aggregator reconciliation ran inside the collection
+        # driver's step (the installed evaluator + the helper's
+        # authenticated /tasks/{id}/ledger endpoint): on this clean
+        # lane the per-batch counts must AGREE — divergence 0
+        from janus_tpu.metrics import task_id_label
+
+        label = task_id_label(leader_task.task_id.data)
+        peer = ledger_ev.document().get("tasks", {}).get(label, {}).get("peer")
+        result["peer_reconciliation"] = peer
+        result["peer_reconciled_ok"] = (
+            peer is not None and peer.get("divergence") == 0
+        )
+
+        # --- phase 5: injected-loss lane -----------------------------
+        # the ledger.drop_report failpoint silently deletes ONE
+        # admitted report AFTER its admission tx counted it — the
+        # tamper no throughput metric can see. The next ledger
+        # evaluation (one sampler tick) must book a +1 ingest
+        # imbalance, breach immediately (grace 0), and turn the
+        # `conservation` SLO signal bad.
+        from janus_tpu import failpoints as failpoints_inproc
+        from janus_tpu.slo import ConservationSignal
+
+        class _SigState:
+            _condition_state: dict = {}
+
+        sig_engine = _SigState()
+        sig = ConservationSignal()
+        slo_bad_before, _, _ = sig.read(sig_engine)
+        failpoints_inproc.configure("ledger.drop_report=error:1.0,count=1")
+        try:
+            client.upload(1)
+        finally:
+            failpoints_inproc.clear()
+        loss_doc = ledger_ev.evaluate_once()
+        loss_imb = loss_doc.get("tasks", {}).get(label, {}).get("imbalance", {})
+        slo_bad_after, _, _ = sig.read(sig_engine)
+        result["loss_injected_imbalance"] = loss_imb.get("ingest")
+        result["loss_breaches"] = list(loss_doc.get("breaches", []))
+        result["loss_detected_ok"] = (
+            loss_imb.get("ingest") == 1
+            and any(s.endswith("/ingest") for s in loss_doc.get("breaches", []))
+            and slo_bad_after > slo_bad_before
+        )
+
         result["elapsed_s"] = round(time.monotonic() - t_run0, 1)
         result["ok"] = all(v for k, v in result.items() if k.endswith("_ok"))
         return result
@@ -2695,6 +2775,7 @@ def run_fleet(
         failpoints_mod = sys.modules.get("janus_tpu.failpoints")
         if failpoints_mod is not None:
             failpoints_mod.clear()
+        ledger_mod.uninstall_ledger()
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -2818,6 +2899,29 @@ def run_soak(
     }
     procs: list[subprocess.Popen] = []
     leader_srv = helper_srv = None
+    # continuous conservation gate (ISSUE 20): the books must close at
+    # EVERY epoch quiesce point — through task churn, GC really
+    # deleting expired rows (expiry attribution keeps the equation
+    # balanced), and continuous collection. grace 0: any residual at a
+    # quiesce point is an immediate breach. The installed evaluator
+    # also powers the collect loop's cross-aggregator reconciliation.
+    from janus_tpu import ledger as ledger_mod
+
+    ledger_ev = ledger_mod.install_ledger(
+        leader_ds, ledger_mod.LedgerConfig(grace_s=0.0)
+    )
+    conservation_by_epoch: list[dict] = []
+
+    def conservation_check() -> bool:
+        doc = ledger_ev.evaluate_once()
+        imb = {
+            label: dict(t["imbalance"]) for label, t in doc.get("tasks", {}).items()
+        }
+        conservation_by_epoch.append(imb)
+        return bool(imb) and all(
+            v.get("ingest") == 0 and v.get("collect") == 0 for v in imb.values()
+        )
+
     try:
         helper_srv = DapServer(
             DapHttpApp(Aggregator(helper_ds, clock, Config()))
@@ -2943,6 +3047,7 @@ def run_soak(
         epochs_exact = []
         epoch_details = []
         rows_by_epoch = []
+        epochs_balanced: list[bool] = []
         try:
             for e in range(epochs):
                 if e >= len(epoch_tasks):
@@ -3014,6 +3119,10 @@ def run_soak(
                         ).values()
                     )
                 )
+                # epoch quiesce point: the epoch is collected and GC
+                # has run — every task's books (including earlier,
+                # partially GC'd epochs) must close
+                epochs_balanced.append(conservation_check())
         finally:
             stop_collect.set()
             ct.join(timeout=10)
@@ -3032,6 +3141,15 @@ def run_soak(
             gc_helper.run_once()
         result["gc_deleted_rows"] = gc_deleted_total
         result["gc_deleted_ok"] = gc_deleted_total > 0
+
+        # final quiesce: even after the late GC passes expired the last
+        # epochs' rows, every epoch's books still close — expiry is an
+        # ATTRIBUTED terminal, not silent row loss
+        final_balanced = conservation_check()
+        result["conservation_by_epoch"] = conservation_by_epoch
+        result["conservation_ok"] = (
+            bool(epochs_balanced) and all(epochs_balanced) and final_balanced
+        )
 
         # --- verdict phase: the drivers idle on steady state while the
         # recorder's trailing window sheds the boot/ramp-up slope ------
@@ -3165,6 +3283,7 @@ def run_soak(
             leader_srv.stop()
         if helper_srv is not None:
             helper_srv.stop()
+        ledger_mod.uninstall_ledger()
         leader_ds.close()
         helper_ds.close()
 

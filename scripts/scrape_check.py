@@ -180,6 +180,12 @@ def main(argv=None) -> int:
         "janus_peer_parked",
         "janus_peer_outage_seconds_total",
         "janus_peer_probes_total",
+        # report-flow conservation ledger (ISSUE 20) — registered at
+        # import in every binary, so absence is a deploy regression
+        "janus_ledger_imbalance",
+        "janus_ledger_breach_active",
+        "janus_ledger_peer_divergence",
+        "janus_ledger_evaluations_total",
     ):
         if fam not in families:
             errors.append(f"/metrics missing the {fam} family")
@@ -399,6 +405,29 @@ def main(argv=None) -> int:
                         errors.append(
                             "/statusz flight recorder enabled but not running"
                         )
+                # report-flow conservation ledger (ISSUE 20): every
+                # binary that owns a datastore installs it by default; a
+                # listener without the section means report-loss
+                # accounting is dark on that replica
+                lg = snap.get("ledger")
+                if not isinstance(lg, dict):
+                    errors.append("/statusz missing the ledger section")
+                else:
+                    for key in (
+                        "enabled",
+                        "evaluations",
+                        "grace_s",
+                        "breaches",
+                        "imbalance",
+                    ):
+                        if key not in lg:
+                            errors.append(f"/statusz ledger missing {key!r}")
+                    if lg.get("enabled") and lg.get("breaches"):
+                        errors.append(
+                            f"/statusz ledger reports active conservation "
+                            f"breaches: {lg.get('breaches')} — reports are "
+                            "leaking between pipeline stages"
+                        )
 
     # /readyz semantics (docs/ROBUSTNESS.md "Datastore outages"): 200
     # with {"ready": true} when serving, 503 with a JSON reason map when
@@ -439,6 +468,22 @@ def main(argv=None) -> int:
         for key in ("recent", "slow_traces", "digests", "recorded_total"):
             if key not in traces:
                 errors.append(f"/debug/traces missing {key!r}")
+
+    # conservation ledger (ISSUE 20): /debug/ledger answers the full
+    # balance document on every binary — {"enabled": false} when no
+    # evaluator is installed, the per-task books otherwise
+    try:
+        body, _ = _fetch(base + "/debug/ledger", args.timeout)
+        ledger_doc = json.loads(body)
+    except Exception as e:
+        errors.append(f"/debug/ledger not valid JSON: {e}")
+    else:
+        if not isinstance(ledger_doc, dict) or "enabled" not in ledger_doc:
+            errors.append("/debug/ledger JSON missing 'enabled'")
+        elif ledger_doc["enabled"]:
+            for key in ("evaluations", "tasks", "breaches"):
+                if key not in ledger_doc:
+                    errors.append(f"/debug/ledger missing {key!r}")
 
     # /alertz (ISSUE 10): every binary answers the SLO engine state as
     # well-formed JSON — enabled or not — with the alert/slo lists; a

@@ -116,8 +116,10 @@ def test_client_reports(eph):
     assert n == 3
     total, started = ds.run_tx(lambda tx: tx.count_client_reports_for_task(task.task_id))
     assert total == 5 and started == 5
+    # (never-claimed, claimed) split for ledger expiry attribution; both
+    # expired rows here were claimed above, so they count as reclaimed.
     deleted = ds.run_tx(lambda tx: tx.delete_expired_client_reports(task.task_id, Time(1002), 10))
-    assert deleted == 2
+    assert deleted == (0, 2)
 
 
 def _aggjob(task, jid=1):
@@ -349,8 +351,10 @@ def test_gc_deletes(eph):
         )
     )
     # cutoff before end: nothing deleted
-    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1050), 10)) == 0
-    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1200), 10)) == 1
+    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1050), 10)) == (0, 0)
+    # (jobs deleted, non-terminal report_aggregations deleted): the START
+    # row dies with its job, so the GC books one in-flight expiry.
+    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1200), 10)) == (1, 1)
     assert ds.run_tx(lambda tx: tx.get_aggregation_job(task.task_id, job.job_id)) is None
     assert ds.run_tx(lambda tx: tx.get_report_aggregations_for_job(task.task_id, job.job_id)) == []
 

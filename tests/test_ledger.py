@@ -1,0 +1,432 @@
+"""Report-flow conservation ledger (janus_tpu/ledger.py; ISSUE 20).
+
+Balance closure through the REAL pipeline — live leader+helper pair
+over loopback HTTP, upload -> aggregate -> collect — on every datastore
+engine; terminal attribution for the rejected and expired lanes;
+exactly-once booking under a replayed helper job step plus detection of
+a simulated double-count; cross-aggregator reconciliation against a
+tampered helper; and torn-read safety of the /debug/ledger document
+under concurrent evaluation.
+"""
+
+import base64
+import threading
+
+import pytest
+from conftest import DATASTORE_ENGINES
+from test_e2e import provision
+
+from janus_tpu import ledger
+from janus_tpu.aggregator import Aggregator, Config
+from janus_tpu.aggregator.aggregation_job_creator import (
+    AggregationJobCreator,
+    AggregationJobCreatorConfig,
+)
+from janus_tpu.aggregator.aggregation_job_driver import AggregationJobDriver
+from janus_tpu.aggregator.collection_job_driver import CollectionJobDriver
+from janus_tpu.aggregator.garbage_collector import GarbageCollector
+from janus_tpu.aggregator.http_handlers import DapHttpApp, DapServer
+from janus_tpu.aggregator.job_driver import JobDriver, JobDriverConfig
+from janus_tpu.client import Client, ClientParameters
+from janus_tpu.collector import Collector, CollectorParameters
+from janus_tpu.core.http_client import HttpClient
+from janus_tpu.core.time_util import MockClock
+from janus_tpu.datastore.models import LeaderStoredReport
+from janus_tpu.datastore.store import EphemeralDatastore
+from janus_tpu.messages import (
+    Duration,
+    HpkeCiphertext,
+    HpkeConfigId,
+    Interval,
+    Query,
+    ReportId,
+    Role,
+    Time,
+)
+from janus_tpu.metrics import task_id_label
+from janus_tpu.task import QueryTypeConfig, TaskBuilder
+from janus_tpu.vdaf.registry import VdafInstance
+
+# every per-task entry in the balance document carries this shape; the
+# torn-read test asserts no reader ever sees a partial one
+TASK_DOC_KEYS = {
+    "admitted",
+    "aggregated",
+    "rejected",
+    "expired",
+    "expired_reclaimed",
+    "lost",
+    "collected",
+    "in_flight",
+    "imbalance",
+    "peer",
+}
+DOC_KEYS = {"enabled", "evaluations", "tasks", "breaches"}
+
+
+class _LivePair:
+    """test_e2e's `pair` fixture as a context manager so the engine can
+    be parameterized per test instead of per fixture instantiation."""
+
+    def __init__(self, engine: str = "sqlite"):
+        self.engine = engine
+
+    def __enter__(self):
+        clock = MockClock(Time(1_600_000_000))
+        self._leader_eph = EphemeralDatastore(clock=clock, engine=self.engine)
+        self._helper_eph = EphemeralDatastore(clock=clock, engine=self.engine)
+        leader_agg = Aggregator(self._leader_eph.datastore, clock, Config())
+        helper_agg = Aggregator(self._helper_eph.datastore, clock, Config())
+        self._leader_srv = DapServer(DapHttpApp(leader_agg)).start()
+        self._helper_srv = DapServer(DapHttpApp(helper_agg)).start()
+        return {
+            "clock": clock,
+            "leader": leader_agg,
+            "helper": helper_agg,
+            "leader_srv": self._leader_srv,
+            "helper_srv": self._helper_srv,
+            "leader_ds": self._leader_eph.datastore,
+            "helper_ds": self._helper_eph.datastore,
+        }
+
+    def __exit__(self, *exc):
+        ledger.uninstall_ledger()
+        self._leader_srv.stop()
+        self._helper_srv.stop()
+        self._leader_eph.cleanup()
+        self._helper_eph.cleanup()
+        return False
+
+
+def _upload(pair, leader_task, vdaf, measurements):
+    http = HttpClient()
+    params = ClientParameters(
+        leader_task.task_id,
+        pair["leader_srv"].url,
+        pair["helper_srv"].url,
+        leader_task.time_precision,
+    )
+    client = Client.with_fetched_configs(params, vdaf, http, clock=pair["clock"])
+    for m in measurements:
+        client.upload(m)
+
+
+def _drive_aggregation(pair):
+    AggregationJobCreator(
+        pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+    ).run_once()
+    driver = AggregationJobDriver(pair["leader_ds"], HttpClient())
+    JobDriver(JobDriverConfig(), driver.acquirer(), driver.stepper).run_once()
+
+
+def _drive_collection(pair, leader_task, collector_kp, vdaf):
+    http = HttpClient()
+    clock = pair["clock"]
+    start = Time(clock.now().seconds).to_batch_interval_start(leader_task.time_precision)
+    query = Query.time_interval(Interval(Time(start.seconds - 3600), Duration(2 * 3600)))
+    collector = Collector(
+        CollectorParameters(
+            leader_task.task_id,
+            pair["leader_srv"].url,
+            leader_task.collector_auth_token,
+            collector_kp,
+        ),
+        vdaf,
+        http,
+    )
+    job_id = collector.start_collection(query)
+    cdriver = CollectionJobDriver(pair["leader_ds"], http)
+    JobDriver(JobDriverConfig(), cdriver.acquirer(), cdriver.stepper).run_once()
+    return collector.poll_once(job_id, query)
+
+
+@pytest.mark.parametrize("engine", DATASTORE_ENGINES)
+def test_balance_closure_upload_aggregate_collect(engine):
+    """The books close at EVERY pipeline stage, on every engine: after
+    upload (all mass pending), after aggregation (all mass awaiting
+    collection), after collection (all mass terminal) — zero imbalance
+    and zero breaches throughout, on both aggregators, with the in-line
+    peer reconciliation reporting zero divergence."""
+    vdaf = VdafInstance.count()
+    with _LivePair(engine) as pair:
+        leader_task, helper_task, collector_kp = provision(pair, vdaf)
+        ev = ledger.install_ledger(pair["leader_ds"], ledger.LedgerConfig(grace_s=0.0))
+        label = task_id_label(leader_task.task_id.data)
+
+        _upload(pair, leader_task, vdaf, [1, 0, 1, 1])
+        t = ev.evaluate_once()["tasks"][label]
+        assert t["admitted"] == 4
+        assert t["in_flight"]["pending_reports"] == 4
+        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+
+        _drive_aggregation(pair)
+        doc = ev.evaluate_once()
+        t = doc["tasks"][label]
+        assert t["aggregated"] == 4
+        assert t["in_flight"]["pending_reports"] == 0
+        assert t["in_flight"]["pending_aggregation"] == 0
+        assert t["in_flight"]["awaiting_collection"] == 4
+        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert doc["breaches"] == []
+
+        result = _drive_collection(pair, leader_task, collector_kp, vdaf)
+        assert result.report_count == 4 and result.aggregate_result == 3
+        doc = ev.evaluate_once()
+        t = doc["tasks"][label]
+        assert t["collected"] == 4
+        assert t["in_flight"]["awaiting_collection"] == 0
+        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert doc["breaches"] == []
+        # the collection driver reconciled with the helper in-line
+        assert t["peer"] is not None
+        assert t["peer"]["divergence"] == 0
+        assert t["peer"]["batches_compared"] >= 1
+
+        # the helper keeps its own books from its own choke points
+        # (aggregate init/continue + aggregate_share) — they close too
+        hev = ledger.LedgerEvaluator(pair["helper_ds"], ledger.LedgerConfig(grace_s=0.0))
+        ht = hev.evaluate_once()["tasks"][label]
+        assert ht["admitted"] == 4 and ht["aggregated"] == 4 and ht["collected"] == 4
+        assert ht["imbalance"] == {"ingest": 0, "collect": 0}
+
+
+def test_rejected_lane_attribution():
+    """A report whose shares cannot be decoded reaches the
+    rejected:<reason> terminal instead of lingering as imbalance: the
+    books still close, with the rejection attributed per-reason."""
+    vdaf = VdafInstance.count()
+    with _LivePair() as pair:
+        leader_task, _, _ = provision(pair, vdaf)
+        ev = ledger.LedgerEvaluator(pair["leader_ds"], ledger.LedgerConfig(grace_s=0.0))
+        label = task_id_label(leader_task.task_id.data)
+
+        _upload(pair, leader_task, vdaf, [1, 1])
+        # one garbage report admitted straight into the store (and
+        # booked, as the report writer would): undecodable leader share
+        clock = pair["clock"]
+
+        def put_garbage(tx):
+            tx.put_client_report(
+                LeaderStoredReport(
+                    leader_task.task_id,
+                    ReportId(b"\xaa" * 16),
+                    Time(clock.now().seconds - 60),
+                    b"",
+                    b"\xff" * 8,
+                    HpkeCiphertext(HpkeConfigId(13), b"enc", b"garbage"),
+                )
+            )
+            ledger.count_admitted(tx, leader_task.task_id, 1)
+
+        pair["leader_ds"].run_tx(put_garbage)
+        _drive_aggregation(pair)
+
+        doc = ev.evaluate_once()
+        t = doc["tasks"][label]
+        assert t["admitted"] == 3
+        assert t["aggregated"] == 2
+        assert sum(t["rejected"].values()) == 1, t["rejected"]
+        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert doc["breaches"] == []
+
+
+def test_expired_attribution_through_gc():
+    """GC deleting an expired never-claimed report books it to the
+    `expired` terminal inside the delete transaction — the report
+    leaves the pending pool and the books stay closed."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    try:
+        ds = eph.datastore
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+            .with_(min_batch_size=1, report_expiry_age=Duration(3600))
+            .build()
+        )
+        label = task_id_label(task.task_id.data)
+
+        def put(tx):
+            tx.put_task(task)
+            tx.put_client_report(
+                LeaderStoredReport(
+                    task.task_id,
+                    ReportId(b"\x01" * 16),
+                    Time(clock.now().seconds - 60),
+                    b"",
+                    b"share",
+                    HpkeCiphertext(HpkeConfigId(0), b"enc", b"payload"),
+                )
+            )
+            ledger.count_admitted(tx, task.task_id, 1)
+
+        ds.run_tx(put)
+        ev = ledger.LedgerEvaluator(ds, ledger.LedgerConfig(grace_s=0.0))
+        t = ev.evaluate_once()["tasks"][label]
+        assert t["in_flight"]["pending_reports"] == 1
+        assert t["imbalance"]["ingest"] == 0
+
+        clock.advance(Duration(2 * 3600))
+        deleted = GarbageCollector(ds, clock).run_once()
+        assert deleted["reports"] == 1
+
+        doc = ev.evaluate_once()
+        t = doc["tasks"][label]
+        assert t["expired"] == 1
+        assert t["in_flight"]["pending_reports"] == 0
+        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert doc["breaches"] == []
+    finally:
+        eph.cleanup()
+
+
+def test_replayed_job_step_books_exactly_once():
+    """Replaying a helper aggregation step verbatim (leader retry after
+    a lost response) must not move the helper's counters — booking
+    rides inside the step's transaction, and the request-hash replay
+    short-circuit never re-runs it. A counter bumped OUTSIDE a
+    transaction (the bug this ledger exists to catch) shows up as a
+    negative residual and breaches."""
+    vdaf = VdafInstance.count()
+    with _LivePair() as pair:
+        leader_task, helper_task, _ = provision(pair, vdaf)
+        _upload(pair, leader_task, vdaf, [1, 0, 1])
+        AggregationJobCreator(
+            pair["leader_ds"], AggregationJobCreatorConfig(min_aggregation_job_size=1)
+        ).run_once()
+
+        captured = {}
+
+        class CapturingHttp(HttpClient):
+            def put(self, url, body, headers=None, timeout=None):
+                if "aggregation_jobs" in url:
+                    captured["url"] = url
+                    captured["body"] = body
+                    captured["headers"] = headers
+                return super().put(url, body, headers, timeout=timeout)
+
+        driver = AggregationJobDriver(pair["leader_ds"], CapturingHttp())
+        assert JobDriver(JobDriverConfig(), driver.acquirer(), driver.stepper).run_once() == 1
+        assert "body" in captured
+
+        counters = lambda: pair["helper_ds"].run_tx(
+            lambda tx: tx.get_task_counters(helper_task.task_id)
+        )
+        before = counters()
+        assert before.get(ledger.ADMITTED) == 3
+
+        # identical replay: same response, identical books
+        status, _ = HttpClient().put(captured["url"], captured["body"], captured["headers"])
+        assert status == 200
+        assert counters() == before
+
+        hev = ledger.LedgerEvaluator(pair["helper_ds"], ledger.LedgerConfig(grace_s=0.0))
+        label = task_id_label(helper_task.task_id.data)
+        doc = hev.evaluate_once()
+        assert doc["tasks"][label]["imbalance"]["ingest"] == 0
+        assert doc["breaches"] == []
+
+        # simulate the double-count this test guards against: an
+        # out-of-tx increment goes negative and breaches immediately
+        pair["helper_ds"].run_tx(
+            lambda tx: tx.increment_task_counters(helper_task.task_id, {ledger.AGGREGATED: 1})
+        )
+        doc = hev.evaluate_once()
+        assert doc["tasks"][label]["imbalance"]["ingest"] == -1
+        assert f"{label}/ingest" in doc["breaches"]
+
+
+def test_peer_divergence_with_tampered_helper_count():
+    """Cross-aggregator reconciliation: identical per-batch counts read
+    as zero divergence; a helper under-reporting one report per batch
+    (tampering, or a silent helper-side loss) exports a nonzero
+    janus_ledger_peer_divergence and breaches stage="peer". The
+    endpoint itself sits behind aggregator auth."""
+    vdaf = VdafInstance.count()
+    with _LivePair() as pair:
+        leader_task, _, collector_kp = provision(pair, vdaf)
+        ev = ledger.install_ledger(pair["leader_ds"], ledger.LedgerConfig(grace_s=0.0))
+        label = task_id_label(leader_task.task_id.data)
+
+        _upload(pair, leader_task, vdaf, [1, 1, 0])
+        _drive_aggregation(pair)
+        result = _drive_collection(pair, leader_task, collector_kp, vdaf)
+        assert result.report_count == 3
+
+        # the collection step already reconciled: clean lanes diverge by 0
+        peer = ev.evaluate_once()["tasks"][label]["peer"]
+        assert peer is not None and peer["divergence"] == 0
+
+        cdriver = CollectionJobDriver(pair["leader_ds"], HttpClient())
+        theirs = cdriver._fetch_helper_ledger(leader_task)
+        assert theirs and sum(theirs.values()) == 3
+
+        tampered = {bid: n - 1 for bid, n in theirs.items()}
+        divergence = ev.record_peer_divergence(leader_task.task_id, dict(theirs), tampered)
+        assert divergence == len(theirs)
+        doc = ev.evaluate_once()
+        assert doc["tasks"][label]["peer"]["divergence"] == divergence
+        assert doc["tasks"][label]["peer"]["mismatched"]
+        assert f"{label}/peer" in doc["breaches"]
+
+        # unauthenticated read is refused (it is the helper's books)
+        b64 = base64.urlsafe_b64encode(leader_task.task_id.data).decode().rstrip("=")
+        status, body = HttpClient().get(
+            pair["helper_srv"].url.rstrip("/") + f"/tasks/{b64}/ledger",
+            {"Authorization": "Bearer wrong"},
+        )
+        assert status == 400 and b"unauthorizedRequest" in body
+
+
+def test_debug_ledger_reads_never_torn():
+    """GET /debug/ledger and the statusz section read the last COMPLETE
+    balance document: with evaluations continuously swapping the doc on
+    other threads, every read still carries the full key shape and
+    internally consistent per-task entries."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    ev = ledger.install_ledger(eph.datastore, ledger.LedgerConfig(grace_s=0.0))
+    try:
+        # two balanced tasks' worth of counters (no live rows: all mass
+        # terminal, books close at admitted == aggregated == collected)
+        def seed(tx):
+            from janus_tpu.messages import TaskId
+
+            for b in (b"\x01", b"\x02"):
+                tx.increment_task_counters(
+                    TaskId(b * 32), {ledger.ADMITTED: 5, ledger.AGGREGATED: 5, ledger.COLLECTED: 5}
+                )
+
+        eph.datastore.run_tx(seed)
+
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def evaluator_loop():
+            while not stop.is_set():
+                try:
+                    ev.evaluate_once()
+                except BaseException as e:  # pragma: no cover - surfaced below
+                    errors.append(e)
+                    return
+
+        writers = [threading.Thread(target=evaluator_loop) for _ in range(2)]
+        for w in writers:
+            w.start()
+        try:
+            for _ in range(300):
+                doc = ledger.ledger_document()
+                assert DOC_KEYS <= set(doc), doc.keys()
+                for label, t in doc["tasks"].items():
+                    assert set(t) == TASK_DOC_KEYS, (label, set(t))
+                    assert t["imbalance"] == {"ingest": 0, "collect": 0}
+                st = ev.status()
+                assert {"enabled", "evaluations", "grace_s", "breaches", "imbalance"} <= set(st)
+        finally:
+            stop.set()
+            for w in writers:
+                w.join()
+        assert not errors, errors
+        assert ev.document()["evaluations"] >= 1
+    finally:
+        ledger.uninstall_ledger()
+        eph.cleanup()

@@ -324,6 +324,8 @@ def test_builtin_definitions_cover_the_paper_surface():
         "device_health",
         "peer_reachable",
         "resource_trend",
+        "report_conservation",
+        "resident_lost",
     }
     for d in slo.BUILTIN_SLOS():
         assert 0 < d.objective < 1

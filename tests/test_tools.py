@@ -216,6 +216,7 @@ def test_bench_dry_run_smoke():
         "debug_profile_json",
         "debug_boot",
         "debug_flight",
+        "debug_ledger",
     }
     obs = rec["observability_smoke"]
     assert obs["scrape_valid"] is True, obs.get("scrape_errors")
@@ -475,6 +476,25 @@ def test_bench_dry_run_smoke():
     assert soak["recorder_overhead_ratio"] <= 0.01
     assert soak["leak_detected_ok"] is True
     assert soak["trend_alert_fired_ok"] is True
+    # ISSUE 20: report-flow conservation ledger — the real admission
+    # path leaves the books balanced; an injected silent loss
+    # (ledger.drop_report deletes an admitted report AFTER its tx
+    # counted it) is a +1 ingest imbalance on the very next
+    # evaluation, breaching immediately (grace 0) and turning the
+    # `conservation` SLO signal bad on the same tick
+    lg = rec["ledger_smoke"]
+    assert lg["balanced_ok"] is True, lg
+    assert lg["balanced_breaches"] == []
+    assert lg["loss_imbalance_total"] == 1
+    assert lg["loss_detected_in_one_evaluation"] is True
+    assert lg["breach_fired"] is True
+    assert lg["slo_fired"] is True, lg
+    # the observability smoke runs the ledger like the real binaries:
+    # statusz section present, /debug/ledger well-formed, zero breaches
+    obs = rec["observability_smoke"]
+    assert obs["statusz_ledger_present"] is True
+    assert obs["debug_ledger_ok"] is True, obs
+    assert obs["ledger_breaches"] == []
 
 
 def test_collect_cli_end_to_end(capsys):
