@@ -755,7 +755,10 @@ class AggregationJobDriver:
             # conservation ledger: FAILED rows reach their terminal here
             # (parked WAITING rows stay in-flight) — booked in the same
             # tx so a run_tx retry can't double-count
-            ledger.count_ra_outcomes(tx, task_id, new_ras)
+            ledger.count_ra_outcomes(
+                tx, task_id, new_ras,
+                aggregation_parameter=st.job.aggregation_parameter,
+            )
             tx.release_aggregation_job(acquired)
 
         self.ds.run_tx(write_waiting, "step_agg_job_park")
@@ -892,7 +895,12 @@ class AggregationJobDriver:
             # conservation ledger: every row is terminal in this tx —
             # FINISHED books aggregated, FINISHED-but-unmerged books
             # rejected:batch_collected, FAILED books rejected:<err>
-            ledger.count_ra_outcomes(tx, job.task_id, new_ras, unmerged)
+            # (param-fanout jobs book their own lane: one report
+            # finishes once PER parameter)
+            ledger.count_ra_outcomes(
+                tx, job.task_id, new_ras, unmerged,
+                aggregation_parameter=job.aggregation_parameter,
+            )
             tx.update_aggregation_job(job.with_state(AggregationJobState.FINISHED))
             tx.release_aggregation_job(acquired)
 
@@ -1250,7 +1258,10 @@ class AggregationJobDriver:
         def write_waiting(tx):
             for ra in new_ras:
                 tx.update_report_aggregation(ra)
-            ledger.count_ra_outcomes(tx, task.task_id, new_ras)
+            ledger.count_ra_outcomes(
+                tx, task.task_id, new_ras,
+                aggregation_parameter=job.aggregation_parameter,
+            )
             tx.release_aggregation_job(acquired)
 
         self.ds.run_tx(write_waiting, "step_p1_job_park")
@@ -1339,7 +1350,10 @@ class AggregationJobDriver:
                 if ra.report_id.data in unmerged:
                     ra = ra.failed(PrepareError.BATCH_COLLECTED)
                 tx.update_report_aggregation(ra)
-            ledger.count_ra_outcomes(tx, task.task_id, new_ras, unmerged)
+            ledger.count_ra_outcomes(
+                tx, task.task_id, new_ras, unmerged,
+                aggregation_parameter=job.aggregation_parameter,
+            )
             tx.update_aggregation_job(new_job)
             tx.release_aggregation_job(acquired)
 

@@ -363,9 +363,13 @@ class CollectionJobDriver:
         self._reconcile_with_helper(task, rows)
 
     def _reconcile_with_helper(self, task: Task, rows) -> None:
-        """Fetch the helper's per-batch report counts (the authenticated
-        GET /tasks/{id}/ledger debug endpoint) and compare against the
-        batches this collection just covered. Divergence exports as
+        """Fetch the helper's aggregated report counts (the
+        authenticated GET /tasks/{id}/ledger debug endpoint) and compare
+        against the batches this collection just covered. Keys are
+        "<batch hex>:<aggregation parameter hex>" on both sides: the
+        rows here carry a single collection's parameter, and a helper
+        payload summed across parameters would read as false divergence
+        on any multi-parameter task. Divergence exports as
         janus_ledger_peer_divergence and feeds the conservation SLO via
         the installed evaluator's breach tracking (stage="peer")."""
         ev = ledger.installed_ledger()
@@ -373,7 +377,7 @@ class CollectionJobDriver:
             return
         ours: dict[str, int] = {}
         for row in rows:
-            key = row.batch_identifier.hex()
+            key = f"{row.batch_identifier.hex()}:{row.aggregation_parameter.hex()}"
             ours[key] = ours.get(key, 0) + int(row.report_count)
         if not ours:
             return
@@ -476,6 +480,14 @@ class CollectionJobDriver:
                             None,
                         )
                     )
+            # conservation ledger, param-fanout lane: creating the
+            # (report, param) rows IS the lane's admission (the per-
+            # param replay check above makes this exactly-once per
+            # (report, param); the canonical `admitted` was booked at
+            # upload and must not be debited by per-param outcomes)
+            ledger.count_admitted(
+                tx, task.task_id, len(todo), aggregation_parameter=param
+            )
             if todo:
                 return False  # fresh jobs: not ready this pass
             # ready once no job for this param is still in progress

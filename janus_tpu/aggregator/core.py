@@ -755,9 +755,22 @@ class TaskAggregator:
             # terminal in this same tx book their outcome too. A replayed
             # init never reaches here (request-hash check above), and a
             # racing duplicate dies on the plain-INSERT PK conflict
-            # before these counters commit.
-            ledger.count_admitted(tx, task.task_id, len(report_aggs))
-            ledger.count_ra_outcomes(tx, task.task_id, report_aggs, unmerged)
+            # before these counters commit. A non-empty aggregation
+            # parameter routes both to the param-fanout lane (one
+            # admission + one terminal per (report, param)).
+            ledger.count_admitted(
+                tx,
+                task.task_id,
+                len(report_aggs),
+                aggregation_parameter=req.aggregation_parameter,
+            )
+            ledger.count_ra_outcomes(
+                tx,
+                task.task_id,
+                report_aggs,
+                unmerged,
+                aggregation_parameter=req.aggregation_parameter,
+            )
             return unmerged
 
         # last pre-commit deadline check: a budget that died during the
@@ -930,9 +943,21 @@ class TaskAggregator:
                 tx.put_report_aggregation(ra)
             # conservation ledger (see handle_aggregate_init): RA rows
             # are the helper's admission record; FAILED rows are
-            # terminal already, WAITING_HELPER rows stay in-flight
-            ledger.count_admitted(tx, task.task_id, len(report_aggs))
-            ledger.count_ra_outcomes(tx, task.task_id, report_aggs)
+            # terminal already, WAITING_HELPER rows stay in-flight.
+            # Poplar1 always carries a parameter, so both bookings land
+            # in the param-fanout lane.
+            ledger.count_admitted(
+                tx,
+                task.task_id,
+                len(report_aggs),
+                aggregation_parameter=req.aggregation_parameter,
+            )
+            ledger.count_ra_outcomes(
+                tx,
+                task.task_id,
+                report_aggs,
+                aggregation_parameter=req.aggregation_parameter,
+            )
 
         ds.run_tx(write, "aggregate_init_p1")
         return AggregationJobResp(tuple(resps))
@@ -1168,9 +1193,14 @@ class TaskAggregator:
                     else ra
                 )
             # conservation ledger: every addressed/omitted row reaches a
-            # terminal in this tx (replays return above, before this)
+            # terminal in this tx (replays return above, before this);
+            # the job's parameter routes param-fanout rows to their lane
             ledger.count_ra_outcomes(
-                tx, task.task_id, updated + dropped_terminal, unmerged
+                tx,
+                task.task_id,
+                updated + dropped_terminal,
+                unmerged,
+                aggregation_parameter=job.aggregation_parameter,
             )
             if unmerged:
                 resps = [

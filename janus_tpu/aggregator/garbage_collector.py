@@ -84,7 +84,7 @@ class GarbageCollector:
             unclaimed, claimed = tx.delete_expired_client_reports(
                 task.task_id, cutoff, cfg.report_limit
             )
-            jobs, pending_ras = tx.delete_expired_aggregation_artifacts(
+            jobs, pending_ras, pending_param_ras = tx.delete_expired_aggregation_artifacts(
                 task.task_id, cutoff, cfg.aggregation_limit
             )
             collection = tx.delete_expired_collection_artifacts(
@@ -95,13 +95,21 @@ class GarbageCollector:
             # never-claimed report leaves the pending pool for the
             # `expired` terminal, and so does a claimed report whose
             # report_aggregations row died non-terminal with its
-            # expired job. Claimed rows whose RA already resolved were
-            # booked aggregated/rejected at resolution — deleting their
-            # storage is not a lifecycle event, only `expired_reclaimed`
-            # bookkeeping for /debug/ledger.
+            # expired job (abandoned jobs' released START rows excluded
+            # — their reports resolve through the unclaimed pool).
+            # Param-fanout rows book their own lane (`expired_param`):
+            # they debited `admitted_param`, never `admitted`. Claimed
+            # rows whose RA already resolved were booked aggregated/
+            # rejected at resolution — deleting their storage is not a
+            # lifecycle event, only `expired_reclaimed` bookkeeping for
+            # /debug/ledger.
             tx.increment_task_counters(
                 task.task_id,
-                {"expired": unclaimed + pending_ras, "expired_reclaimed": claimed},
+                {
+                    "expired": unclaimed + pending_ras,
+                    "expired_param": pending_param_ras,
+                    "expired_reclaimed": claimed,
+                },
             )
             return {
                 "reports": unclaimed + claimed,

@@ -695,6 +695,9 @@ class Transaction:
           has claimed yet (aggregation_started = 0)
         - pending_aggregation: report_aggregations still in a
           non-terminal state (start / waiting_*) — claimed, outcome due
+        - pending_aggregation_param: same, but for jobs carrying a
+          non-empty aggregation parameter (the param-fanout lane —
+          those rows debit `admitted_param`, never `admitted`)
         - awaiting_collection: aggregated report mass sitting in
           uncollected batch_aggregations shards
         """
@@ -709,14 +712,19 @@ class Transaction:
         # an abandoned job's rows would double-book those reports (and a
         # WAITING row stuck in an abandoned job SHOULD read as imbalance
         # — it will never resolve, which is exactly a lost report)
-        for task_id, n in self._c.execute(
-            "SELECT ra.task_id, COUNT(*) FROM report_aggregations ra"
+        for task_id, param, n in self._c.execute(
+            "SELECT ra.task_id, aj.aggregation_parameter <> ?, COUNT(*)"
+            " FROM report_aggregations ra"
             " JOIN aggregation_jobs aj"
             "   ON aj.task_id = ra.task_id AND aj.job_id = ra.job_id"
             " WHERE ra.state IN ('start', 'waiting_leader', 'waiting_helper')"
-            " AND aj.state = 'in_progress' GROUP BY ra.task_id"
+            " AND aj.state = 'in_progress'"
+            " GROUP BY 1, 2",
+            (b"",),
         ).fetchall():
-            out.setdefault(bytes(task_id), {})["pending_aggregation"] = int(n)
+            key = "pending_aggregation_param" if param else "pending_aggregation"
+            t = out.setdefault(bytes(task_id), {})
+            t[key] = t.get(key, 0) + int(n)
         for task_id, n in self._c.execute(
             "SELECT task_id, COALESCE(SUM(report_count), 0) FROM batch_aggregations"
             " WHERE state <> 'collected' GROUP BY task_id"
@@ -725,18 +733,26 @@ class Transaction:
         return out
 
     def ledger_batch_counts(self, task_id: TaskId) -> dict[str, int]:
-        """{batch_identifier hex: aggregated report count} for a task —
-        the cross-aggregator reconciliation payload (both aggregators
-        persist batch_aggregations; equal per-batch counts mean neither
-        side silently dropped or double-counted a report the other
-        aggregated — the observability analog of a linear tag)."""
+        """{"<batch_identifier hex>:<aggregation_parameter hex>":
+        aggregated report count} for a task — the cross-aggregator
+        reconciliation payload (both aggregators persist
+        batch_aggregations; equal per-key counts mean neither side
+        silently dropped or double-counted a report the other
+        aggregated — the observability analog of a linear tag). Keyed
+        per (batch, param): a multi-parameter task accumulates the same
+        batch once per collection parameter, and summing across params
+        would inflate the helper's count against a leader comparison
+        that covers a single collection's parameter."""
         rows = self._c.execute(
-            "SELECT batch_identifier, COALESCE(SUM(report_count), 0)"
+            "SELECT batch_identifier, aggregation_parameter,"
+            " COALESCE(SUM(report_count), 0)"
             " FROM batch_aggregations WHERE task_id = ?"
-            " GROUP BY batch_identifier",
+            " GROUP BY batch_identifier, aggregation_parameter",
             (task_id.data,),
         ).fetchall()
-        return {bytes(r[0]).hex(): int(r[1]) for r in rows}
+        return {
+            f"{bytes(r[0]).hex()}:{bytes(r[1]).hex()}": int(r[2]) for r in rows
+        }
 
     def ledger_report_trace(self, task_id: TaskId, report_id: ReportId) -> dict:
         """One report's whereabouts across every pipeline table — the
@@ -1958,27 +1974,49 @@ class Transaction:
         return [str(r[0]) for r in rows]
 
     # ---- GC (reference datastore.rs:4162-4315) ----
-    def delete_expired_aggregation_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> tuple[int, int]:
-        """(jobs deleted, non-terminal report_aggregations deleted).
-        The second count is the GC's ledger attribution: a start/
-        waiting row deleted here would otherwise sit in the in-flight
-        pool forever (its job expired before resolving), so the GC
-        books it as `expired` in the same transaction."""
+    def delete_expired_aggregation_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> tuple[int, int, int]:
+        """(jobs deleted, never-resolved rows of the canonical lane,
+        never-resolved rows of the param-fanout lane). The row counts
+        are the GC's ledger attribution: a non-terminal row deleted
+        here would otherwise sit unaccounted forever (its job expired
+        before resolving), so the GC books it `expired` /
+        `expired_param` in the same transaction.
+
+        Abandoned jobs need care: abandon_job returns a canonical job's
+        START rows to the unclaimed pool (mark_reports_unaggregated),
+        so those reports reach a real terminal later (re-aggregated,
+        rejected, or expired as unclaimed client_reports) — booking the
+        stale START rows again here would double-debit `admitted` and
+        latch a false negative residual. Only an abandoned canonical
+        job's waiting_* rows really are lost. Param-fanout jobs have no
+        pool to return to (the per-param replay check treats ANY row as
+        done), so ALL their non-terminal rows are lost on abandonment
+        and book `expired_param` here."""
         rows = self._c.execute(
-            "SELECT job_id FROM aggregation_jobs WHERE task_id = ?"
+            "SELECT job_id, state, aggregation_parameter FROM aggregation_jobs"
+            " WHERE task_id = ?"
             " AND client_interval_start + client_interval_duration < ? LIMIT ?",
             (task_id.data, cutoff.seconds, limit),
         ).fetchall()
-        n = pending = 0
-        for (job_id,) in rows:
-            pending += int(
+        n = pending = pending_param = 0
+        for job_id, job_state, agg_param in rows:
+            is_param = bytes(agg_param or b"") != b""
+            if str(job_state) == "abandoned" and not is_param:
+                states = "('waiting_leader', 'waiting_helper')"
+            else:
+                states = "('start', 'waiting_leader', 'waiting_helper')"
+            lost = int(
                 self._c.execute(
                     "SELECT COUNT(*) FROM report_aggregations"
                     " WHERE task_id = ? AND job_id = ?"
-                    " AND state IN ('start', 'waiting_leader', 'waiting_helper')",
+                    f" AND state IN {states}",
                     (task_id.data, job_id),
                 ).fetchone()[0]
             )
+            if is_param:
+                pending_param += lost
+            else:
+                pending += lost
             self._c.execute(
                 "DELETE FROM report_aggregations WHERE task_id = ? AND job_id = ?",
                 (task_id.data, job_id),
@@ -1988,7 +2026,7 @@ class Transaction:
                 (task_id.data, job_id),
             )
             n += cur.rowcount
-        return n, pending
+        return n, pending, pending_param
 
     def delete_expired_collection_artifacts(self, task_id: TaskId, cutoff: Time, limit: int) -> int:
         # aggregate_share_jobs carry no client-time column in this schema;

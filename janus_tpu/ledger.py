@@ -12,7 +12,10 @@ close per (task, stage):
 
     stage="ingest":  admitted - aggregated - rejected - expired
                      - pending_reports - pending_aggregation  == 0
-    stage="collect": aggregated - collected - awaiting_collection == 0
+    stage="param":   admitted_param - aggregated_param - rejected_param
+                     - expired_param - pending_aggregation_param == 0
+    stage="collect": aggregated + aggregated_param - collected
+                     - awaiting_collection == 0
 
 A sustained positive residual is a silently lost report; a sustained
 negative one is a double-count (e.g. a replayed job step whose
@@ -63,6 +66,28 @@ EXPIRED_RECLAIMED = "expired_reclaimed"
 LOST = "lost"
 REJECTED_PREFIX = "rejected:"
 
+# Parameter-fanout lane (VDAFs with nontrivial aggregation parameters,
+# e.g. Poplar1): one admitted report legitimately aggregates once PER
+# collection parameter, so booking those FINISHED rows as `aggregated`
+# would debit a single `admitted` several times and drive the ingest
+# residual permanently negative. The fanout keeps its own books —
+# admission is the creation of the (report, param) report_aggregations
+# row (leader: _ensure_param_aggregation; helper: the init handler) and
+# every such admission must reach exactly one param-lane terminal:
+#
+#   stage="param": admitted_param - aggregated_param
+#                  - Σ rejected_param:<reason> - expired_param
+#                  - pending_aggregation_param               == 0
+#
+# The canonical ingest equation never sees the fanout (a param task's
+# client_reports stay in pending_reports until GC expiry), while the
+# collect equation uses aggregated + aggregated_param: batch
+# aggregation rows carry the param mass, and collections drain it.
+ADMITTED_PARAM = "admitted_param"
+AGGREGATED_PARAM = "aggregated_param"
+EXPIRED_PARAM = "expired_param"
+REJECTED_PARAM_PREFIX = "rejected_param:"
+
 
 @dataclass
 class LedgerConfig:
@@ -92,33 +117,45 @@ class LedgerConfig:
 # ---------------------------------------------------------------------------
 
 
-def count_admitted(tx, task_id, n: int) -> None:
-    """A report became durable in client_reports (fresh put, not a
-    replay) — report_writer flush / journal replay."""
+def count_admitted(tx, task_id, n: int, aggregation_parameter: bytes = b"") -> None:
+    """A report became durable (fresh put, not a replay): leader
+    report_writer flush / journal replay, or the helper's init handler
+    writing the job's report_aggregations rows (the helper has no
+    client_reports — the RA rows ARE its admission record). A non-empty
+    aggregation parameter books into the param-fanout lane instead
+    (one admission per (report, param))."""
     if n > 0:
-        tx.increment_task_counters(task_id, {ADMITTED: n})
+        key = ADMITTED_PARAM if aggregation_parameter else ADMITTED
+        tx.increment_task_counters(task_id, {key: n})
 
 
-def count_ra_outcomes(tx, task_id, ras, unmerged=frozenset()) -> None:
+def count_ra_outcomes(
+    tx, task_id, ras, unmerged=frozenset(), aggregation_parameter: bytes = b""
+) -> None:
     """Book the terminal outcomes of a report_aggregations write batch:
     FINISHED rows whose share merged are `aggregated`, FINISHED rows in
     the flush's unmergeable set are rejected:batch_collected (the
     caller rewrites the row the same way), FAILED rows are
     rejected:<reason>. Non-terminal (waiting) rows stay in-flight and
-    are not booked."""
+    are not booked. Rows of a job with a non-empty aggregation
+    parameter book into the param-fanout lane (`aggregated_param` /
+    `rejected_param:<reason>`): a report FINISHES once per parameter,
+    so those terminals must never debit the single `admitted`."""
     from .datastore.models import ReportAggregationState
 
+    agg_key = AGGREGATED_PARAM if aggregation_parameter else AGGREGATED
+    rej_prefix = REJECTED_PARAM_PREFIX if aggregation_parameter else REJECTED_PREFIX
     deltas: dict[str, int] = {}
     for ra in ras:
         if ra.state == ReportAggregationState.FINISHED:
             if ra.report_id.data in unmerged:
-                key = REJECTED_PREFIX + "batch_collected"
+                key = rej_prefix + "batch_collected"
             else:
-                key = AGGREGATED
+                key = agg_key
         elif ra.state == ReportAggregationState.FAILED:
             err = getattr(ra, "prepare_error", None)
             name = err.name.lower() if err is not None else "unknown"
-            key = REJECTED_PREFIX + name
+            key = rej_prefix + name
         else:
             continue
         deltas[key] = deltas.get(key, 0) + 1
@@ -204,8 +241,10 @@ class LedgerEvaluator:
     def record_peer_divergence(
         self, task_id, ours: dict[str, int], theirs: dict[str, int]
     ) -> int:
-        """Compare our per-batch aggregated counts against the helper's
-        (both restricted to the batches WE cover — the helper may not
+        """Compare our aggregated counts against the helper's, keyed by
+        (batch identifier, aggregation parameter) — per-param keys keep
+        a multi-parameter task's fanout from inflating one batch's
+        count — and restricted to the keys WE cover (the helper may not
         have created rows for a batch still aggregating on its side).
         Returns the total absolute divergence and exports it."""
         label = task_id_label(task_id.data)
@@ -250,6 +289,8 @@ class LedgerEvaluator:
         now_mono = time.monotonic()
         rl = metrics.replica_labels()
         self._evaluations += 1
+        with self._lock:
+            peer_snapshot = dict(self._peer)
         tasks_doc: dict[str, dict] = {}
         for task_id_bytes in sorted(set(counters) | set(inflight)):
             c = counters.get(task_id_bytes, {})
@@ -266,8 +307,17 @@ class LedgerEvaluator:
                 if k.startswith(REJECTED_PREFIX)
             }
             rejected_total = sum(rejected.values())
+            admitted_param = c.get(ADMITTED_PARAM, 0)
+            aggregated_param = c.get(AGGREGATED_PARAM, 0)
+            expired_param = c.get(EXPIRED_PARAM, 0)
+            rejected_param = {
+                k[len(REJECTED_PARAM_PREFIX):]: v
+                for k, v in c.items()
+                if k.startswith(REJECTED_PARAM_PREFIX)
+            }
             pending_reports = f.get("pending_reports", 0)
             pending_aggregation = f.get("pending_aggregation", 0)
+            pending_aggregation_param = f.get("pending_aggregation_param", 0)
             awaiting_collection = f.get("awaiting_collection", 0)
 
             ingest = (
@@ -278,10 +328,23 @@ class LedgerEvaluator:
                 - pending_reports
                 - pending_aggregation
             )
-            collect = aggregated - collected - awaiting_collection
+            param = (
+                admitted_param
+                - aggregated_param
+                - sum(rejected_param.values())
+                - expired_param
+                - pending_aggregation_param
+            )
+            # collect balances COUNT mass through batch_aggregations,
+            # which carries both lanes (param tasks' shards are keyed by
+            # their aggregation parameter but drain through the same
+            # collected/awaiting accounting)
+            collect = aggregated + aggregated_param - collected - awaiting_collection
             metrics.ledger_imbalance.set(float(ingest), task_id=label, stage="ingest", **rl)
+            metrics.ledger_imbalance.set(float(param), task_id=label, stage="param", **rl)
             metrics.ledger_imbalance.set(float(collect), task_id=label, stage="collect", **rl)
             self._breach_update(label, "ingest", float(ingest), now_mono)
+            self._breach_update(label, "param", float(param), now_mono)
             self._breach_update(label, "collect", float(collect), now_mono)
 
             tasks_doc[label] = {
@@ -292,20 +355,41 @@ class LedgerEvaluator:
                 "expired_reclaimed": c.get(EXPIRED_RECLAIMED, 0),
                 "lost": lost,
                 "collected": collected,
+                "param": {
+                    "admitted": admitted_param,
+                    "aggregated": aggregated_param,
+                    "rejected": rejected_param,
+                    "expired": expired_param,
+                },
                 "in_flight": {
                     "pending_reports": pending_reports,
                     "pending_aggregation": pending_aggregation,
+                    "pending_aggregation_param": pending_aggregation_param,
                     "awaiting_collection": awaiting_collection,
                 },
-                "imbalance": {"ingest": ingest, "collect": collect},
-                "peer": self._peer.get(label),
+                "imbalance": {"ingest": ingest, "param": param, "collect": collect},
+                "peer": peer_snapshot.get(label),
             }
 
-        breaches = sorted(
-            f"{label}/{stage}"
-            for (label, stage), tr in self._tracks.items()
-            if self._breached(tr, now_mono)
-        )
+        # peer tracks only gain fresh values when a collection finishes
+        # (record_peer_divergence); re-evaluating them here keeps the
+        # breach gauge and the breach list advancing every sampler tick
+        # even when no collection runs during the grace window.
+        with self._lock:
+            peer_tracks = [
+                (label, tr.value)
+                for (label, stage), tr in self._tracks.items()
+                if stage == "peer"
+            ]
+        for label, value in peer_tracks:
+            self._breach_update(label, "peer", value, now_mono)
+
+        with self._lock:
+            breaches = sorted(
+                f"{label}/{stage}"
+                for (label, stage), tr in self._tracks.items()
+                if self._breached(tr, now_mono)
+            )
         return {
             "enabled": True,
             "evaluations": self._evaluations,
@@ -317,13 +401,17 @@ class LedgerEvaluator:
 
     # -- breach tracking -----------------------------------------------
     def _breach_update(self, label: str, stage: str, value: float, now_mono: float) -> None:
-        tr = self._tracks.setdefault((label, stage), _BreachTrack())
-        tr.value = value
-        if value == 0:
-            tr.first_nonzero = None
-        elif tr.first_nonzero is None:
-            tr.first_nonzero = now_mono
-        breached = self._breached(tr, now_mono)
+        # _tracks is shared between the sampler thread (_evaluate) and
+        # collection-driver threads (record_peer_divergence): mutate it
+        # only under the lock, and do the metric/log I/O outside it.
+        with self._lock:
+            tr = self._tracks.setdefault((label, stage), _BreachTrack())
+            tr.value = value
+            if value == 0:
+                tr.first_nonzero = None
+            elif tr.first_nonzero is None:
+                tr.first_nonzero = now_mono
+            breached = self._breached(tr, now_mono)
         metrics.ledger_breach_active.set(
             1.0 if breached else 0.0,
             task_id=label,

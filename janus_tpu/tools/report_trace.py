@@ -180,6 +180,11 @@ def main(argv=None) -> int:
         for k, v in counters.items()
         if k.startswith(_ledger.REJECTED_PREFIX)
     }
+    rejected_param = {
+        k[len(_ledger.REJECTED_PARAM_PREFIX):]: v
+        for k, v in counters.items()
+        if k.startswith(_ledger.REJECTED_PARAM_PREFIX)
+    }
     books = {
         "admitted": counters.get(_ledger.ADMITTED, 0),
         "aggregated": counters.get(_ledger.AGGREGATED, 0),
@@ -187,7 +192,16 @@ def main(argv=None) -> int:
         "expired": counters.get(_ledger.EXPIRED, 0),
         "lost": counters.get(_ledger.LOST, 0),
         "rejected": rejected,
+        "param": {
+            "admitted": counters.get(_ledger.ADMITTED_PARAM, 0),
+            "aggregated": counters.get(_ledger.AGGREGATED_PARAM, 0),
+            "rejected": rejected_param,
+            "expired": counters.get(_ledger.EXPIRED_PARAM, 0),
+        },
         "in_flight": inflight,
+        # the same three balance equations the evaluator exports
+        # (janus_tpu/ledger.py): param fanout keeps its own lane, and
+        # collect drains both lanes' mass through batch_aggregations
         "imbalance": {
             "ingest": counters.get(_ledger.ADMITTED, 0)
             - counters.get(_ledger.AGGREGATED, 0)
@@ -195,7 +209,13 @@ def main(argv=None) -> int:
             - counters.get(_ledger.EXPIRED, 0)
             - inflight.get("pending_reports", 0)
             - inflight.get("pending_aggregation", 0),
+            "param": counters.get(_ledger.ADMITTED_PARAM, 0)
+            - counters.get(_ledger.AGGREGATED_PARAM, 0)
+            - sum(rejected_param.values())
+            - counters.get(_ledger.EXPIRED_PARAM, 0)
+            - inflight.get("pending_aggregation_param", 0),
             "collect": counters.get(_ledger.AGGREGATED, 0)
+            + counters.get(_ledger.AGGREGATED_PARAM, 0)
             - counters.get(_ledger.COLLECTED, 0)
             - inflight.get("awaiting_collection", 0),
         },

@@ -351,10 +351,11 @@ def test_gc_deletes(eph):
         )
     )
     # cutoff before end: nothing deleted
-    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1050), 10)) == (0, 0)
-    # (jobs deleted, non-terminal report_aggregations deleted): the START
-    # row dies with its job, so the GC books one in-flight expiry.
-    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1200), 10)) == (1, 1)
+    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1050), 10)) == (0, 0, 0)
+    # (jobs deleted, non-terminal canonical rows, non-terminal param
+    # rows): the START row dies with its job, so the GC books one
+    # in-flight expiry in the canonical lane.
+    assert ds.run_tx(lambda tx: tx.delete_expired_aggregation_artifacts(task.task_id, Time(1200), 10)) == (1, 1, 0)
     assert ds.run_tx(lambda tx: tx.get_aggregation_job(task.task_id, job.job_id)) is None
     assert ds.run_tx(lambda tx: tx.get_report_aggregations_for_job(task.task_id, job.job_id)) == []
 

@@ -11,6 +11,8 @@ under concurrent evaluation.
 
 import base64
 import threading
+import time
+from dataclasses import replace
 
 import pytest
 from conftest import DATASTORE_ENGINES
@@ -31,16 +33,25 @@ from janus_tpu.client import Client, ClientParameters
 from janus_tpu.collector import Collector, CollectorParameters
 from janus_tpu.core.http_client import HttpClient
 from janus_tpu.core.time_util import MockClock
-from janus_tpu.datastore.models import LeaderStoredReport
+from janus_tpu.datastore.models import (
+    AggregationJobModel,
+    AggregationJobState,
+    LeaderStoredReport,
+    ReportAggregationModel,
+    ReportAggregationState,
+)
 from janus_tpu.datastore.store import EphemeralDatastore
 from janus_tpu.messages import (
+    AggregationJobId,
     Duration,
     HpkeCiphertext,
     HpkeConfigId,
     Interval,
+    PrepareError,
     Query,
     ReportId,
     Role,
+    TaskId,
     Time,
 )
 from janus_tpu.metrics import task_id_label
@@ -57,11 +68,13 @@ TASK_DOC_KEYS = {
     "expired_reclaimed",
     "lost",
     "collected",
+    "param",
     "in_flight",
     "imbalance",
     "peer",
 }
 DOC_KEYS = {"enabled", "evaluations", "tasks", "breaches"}
+BALANCED = {"ingest": 0, "param": 0, "collect": 0}
 
 
 class _LivePair:
@@ -157,7 +170,7 @@ def test_balance_closure_upload_aggregate_collect(engine):
         t = ev.evaluate_once()["tasks"][label]
         assert t["admitted"] == 4
         assert t["in_flight"]["pending_reports"] == 4
-        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert t["imbalance"] == BALANCED
 
         _drive_aggregation(pair)
         doc = ev.evaluate_once()
@@ -166,7 +179,7 @@ def test_balance_closure_upload_aggregate_collect(engine):
         assert t["in_flight"]["pending_reports"] == 0
         assert t["in_flight"]["pending_aggregation"] == 0
         assert t["in_flight"]["awaiting_collection"] == 4
-        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert t["imbalance"] == BALANCED
         assert doc["breaches"] == []
 
         result = _drive_collection(pair, leader_task, collector_kp, vdaf)
@@ -175,7 +188,7 @@ def test_balance_closure_upload_aggregate_collect(engine):
         t = doc["tasks"][label]
         assert t["collected"] == 4
         assert t["in_flight"]["awaiting_collection"] == 0
-        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert t["imbalance"] == BALANCED
         assert doc["breaches"] == []
         # the collection driver reconciled with the helper in-line
         assert t["peer"] is not None
@@ -187,7 +200,7 @@ def test_balance_closure_upload_aggregate_collect(engine):
         hev = ledger.LedgerEvaluator(pair["helper_ds"], ledger.LedgerConfig(grace_s=0.0))
         ht = hev.evaluate_once()["tasks"][label]
         assert ht["admitted"] == 4 and ht["aggregated"] == 4 and ht["collected"] == 4
-        assert ht["imbalance"] == {"ingest": 0, "collect": 0}
+        assert ht["imbalance"] == BALANCED
 
 
 def test_rejected_lane_attribution():
@@ -226,7 +239,7 @@ def test_rejected_lane_attribution():
         assert t["admitted"] == 3
         assert t["aggregated"] == 2
         assert sum(t["rejected"].values()) == 1, t["rejected"]
-        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert t["imbalance"] == BALANCED
         assert doc["breaches"] == []
 
 
@@ -273,7 +286,7 @@ def test_expired_attribution_through_gc():
         t = doc["tasks"][label]
         assert t["expired"] == 1
         assert t["in_flight"]["pending_reports"] == 0
-        assert t["imbalance"] == {"ingest": 0, "collect": 0}
+        assert t["imbalance"] == BALANCED
         assert doc["breaches"] == []
     finally:
         eph.cleanup()
@@ -418,7 +431,7 @@ def test_debug_ledger_reads_never_torn():
                 assert DOC_KEYS <= set(doc), doc.keys()
                 for label, t in doc["tasks"].items():
                     assert set(t) == TASK_DOC_KEYS, (label, set(t))
-                    assert t["imbalance"] == {"ingest": 0, "collect": 0}
+                    assert t["imbalance"] == BALANCED
                 st = ev.status()
                 assert {"enabled", "evaluations", "grace_s", "breaches", "imbalance"} <= set(st)
         finally:
@@ -430,3 +443,294 @@ def test_debug_ledger_reads_never_torn():
     finally:
         ledger.uninstall_ledger()
         eph.cleanup()
+
+def test_param_fanout_lane_books_and_inflight_split():
+    """The parameter-fanout lane (Poplar1-style: one report aggregates
+    once PER collection parameter) keeps its own books: param-scoped
+    admissions/terminals never debit the single canonical `admitted`,
+    in-flight rows split by lane on the job's aggregation parameter,
+    and all three balance equations close simultaneously."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    try:
+        ds = eph.datastore
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+            .with_(min_batch_size=1)
+            .build()
+        )
+        label = task_id_label(task.task_id.data)
+        param = b"\x01level2"
+
+        def seed(tx):
+            tx.put_task(task)
+            now = Time(clock.now().seconds - 60)
+            # three admitted reports; for a param task these stay in
+            # pending_reports (never claimed canonically) for life
+            for i in range(3):
+                tx.put_client_report(
+                    LeaderStoredReport(
+                        task.task_id,
+                        ReportId(bytes([i + 1]) * 16),
+                        now,
+                        b"",
+                        b"share",
+                        HpkeCiphertext(HpkeConfigId(0), b"enc", b"payload"),
+                    )
+                )
+            ledger.count_admitted(tx, task.task_id, 3)
+            # two completed fanout levels over those reports, collected
+            tx.increment_task_counters(
+                task.task_id,
+                {ledger.ADMITTED_PARAM: 6, ledger.AGGREGATED_PARAM: 6, ledger.COLLECTED: 6},
+            )
+            # a third level mid-flight: 2 rows pending under an
+            # in-progress param job, 1 already failed (booked terminal)
+            job_id = AggregationJobId(b"\x0a" * 16)
+            tx.put_aggregation_job(
+                AggregationJobModel(
+                    task.task_id,
+                    job_id,
+                    param,
+                    b"",
+                    Interval(now, Duration(60)),
+                    AggregationJobState.IN_PROGRESS,
+                    0,
+                )
+            )
+            for ord_ in range(2):
+                tx.put_report_aggregation(
+                    ReportAggregationModel(
+                        task.task_id,
+                        job_id,
+                        ReportId(bytes([ord_ + 1]) * 16),
+                        now,
+                        ord_,
+                        ReportAggregationState.START,
+                    )
+                )
+            failed = ReportAggregationModel(
+                task.task_id,
+                job_id,
+                ReportId(b"\x03" * 16),
+                now,
+                2,
+                ReportAggregationState.FAILED,
+                b"",
+                PrepareError.VDAF_PREP_ERROR,
+            )
+            tx.put_report_aggregation(failed)
+            ledger.count_admitted(tx, task.task_id, 3, aggregation_parameter=param)
+            ledger.count_ra_outcomes(
+                tx, task.task_id, [failed], aggregation_parameter=param
+            )
+
+        ds.run_tx(seed)
+        ev = ledger.LedgerEvaluator(ds, ledger.LedgerConfig(grace_s=0.0))
+        doc = ev.evaluate_once()
+        t = doc["tasks"][label]
+        assert t["admitted"] == 3 and t["aggregated"] == 0 and t["rejected"] == {}
+        assert t["param"] == {
+            "admitted": 9,
+            "aggregated": 6,
+            "rejected": {"vdaf_prep_error": 1},
+            "expired": 0,
+        }
+        assert t["in_flight"]["pending_reports"] == 3
+        assert t["in_flight"]["pending_aggregation"] == 0
+        assert t["in_flight"]["pending_aggregation_param"] == 2
+        assert t["imbalance"] == BALANCED
+        assert doc["breaches"] == []
+    finally:
+        eph.cleanup()
+
+
+def test_abandoned_job_start_rows_not_double_booked_by_gc():
+    """abandon_job returns a job's START rows to the unclaimed pool —
+    those reports retry under a fresh job, so GC must NOT also book
+    their rows `expired` when it deletes the abandoned job's storage
+    (double terminal -> permanently negative ingest residual). Only the
+    waiting rows, whose claims die with the job, are genuinely gone."""
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    try:
+        ds = eph.datastore
+        task = (
+            TaskBuilder(QueryTypeConfig.time_interval(), VdafInstance.count(), Role.LEADER)
+            .with_(min_batch_size=1, report_expiry_age=Duration(3600))
+            .build()
+        )
+        label = task_id_label(task.task_id.data)
+
+        def put(tx):
+            tx.put_task(task)
+            for i in range(3):
+                tx.put_client_report(
+                    LeaderStoredReport(
+                        task.task_id,
+                        ReportId(bytes([i + 1]) * 16),
+                        Time(clock.now().seconds - 60),
+                        b"",
+                        b"share",
+                        HpkeCiphertext(HpkeConfigId(0), b"enc", b"payload"),
+                    )
+                )
+            ledger.count_admitted(tx, task.task_id, 3)
+
+        ds.run_tx(put)
+        AggregationJobCreator(
+            ds, AggregationJobCreatorConfig(min_aggregation_job_size=1)
+        ).run_once()
+        acquired = ds.run_tx(
+            lambda tx: tx.acquire_incomplete_aggregation_jobs(Duration(600), 10)
+        )
+        assert len(acquired) == 1
+
+        # one row has already advanced past START when the job dies:
+        # its claim is lost with the job (no retry path), the other two
+        # START rows go back to the unclaimed pool
+        def advance_one(tx):
+            ras = tx.get_report_aggregations_for_job(task.task_id, acquired[0].job_id)
+            tx.update_report_aggregation(
+                replace(ras[0], state=ReportAggregationState.WAITING_LEADER)
+            )
+
+        ds.run_tx(advance_one)
+        AggregationJobDriver(ds, HttpClient()).abandon_job(acquired[0])
+
+        # grace large enough that the wedged waiting row (visible as a
+        # +1 residual until GC attributes it) never counts as a breach
+        ev = ledger.LedgerEvaluator(ds, ledger.LedgerConfig(grace_s=60.0))
+        t = ev.evaluate_once()["tasks"][label]
+        assert t["in_flight"]["pending_reports"] == 2  # back in the pool
+        assert t["in_flight"]["pending_aggregation"] == 0  # job not in progress
+        assert t["imbalance"]["ingest"] == 1  # the wedged waiting row
+
+        clock.advance(Duration(2 * 3600))
+        deleted = GarbageCollector(ds, clock).run_once()
+        assert deleted["reports"] == 3 and deleted["aggregation"] == 1
+
+        doc = ev.evaluate_once()
+        t = doc["tasks"][label]
+        # 2 unclaimed reports + 1 dead waiting row — NOT 5 (the
+        # abandoned job's returned START rows must not be re-booked)
+        assert t["expired"] == 3
+        assert t["expired_reclaimed"] == 1
+        assert t["imbalance"] == BALANCED
+        assert doc["breaches"] == []
+    finally:
+        eph.cleanup()
+
+
+def test_peer_breach_gauge_advances_without_new_sample():
+    """A nonzero peer divergence recorded ONCE must flip
+    janus_ledger_breach_active{stage="peer"} after the grace window
+    elapses even when no further collection (hence no further
+    record_peer_divergence call) happens — the evaluator re-runs the
+    peer tracks every tick."""
+    from janus_tpu import metrics
+
+    clock = MockClock(Time(1_600_000_000))
+    eph = EphemeralDatastore(clock=clock)
+    try:
+        ev = ledger.LedgerEvaluator(eph.datastore, ledger.LedgerConfig(grace_s=0.5))
+        task_id = TaskId(b"\x07" * 32)
+        label = task_id_label(task_id.data)
+        key = "aa" * 32 + ":01"
+        assert ev.record_peer_divergence(task_id, {key: 3}, {key: 2}) == 1
+        assert f"{label}/peer" not in ev.evaluate_once()["breaches"]
+        assert (
+            metrics.ledger_breach_active.get(
+                task_id=label, stage="peer", **metrics.replica_labels()
+            )
+            == 0.0
+        )
+        time.sleep(0.6)
+        doc = ev.evaluate_once()
+        assert f"{label}/peer" in doc["breaches"]
+        assert (
+            metrics.ledger_breach_active.get(
+                task_id=label, stage="peer", **metrics.replica_labels()
+            )
+            == 1.0
+        )
+    finally:
+        eph.cleanup()
+
+
+def test_poplar1_multi_param_books_close():
+    """Multi-parameter (Poplar1) task through the LIVE pair: each
+    report aggregates once per collection parameter, and the books on
+    BOTH aggregators close via the param-fanout lane — the canonical
+    `admitted` is never debited by per-param terminals, and the
+    (batch, parameter)-keyed peer reconciliation reads zero divergence
+    where batch-only keys would sum the fanout and false-alarm."""
+    BITS = 2
+    vdaf = VdafInstance.poplar1(bits=BITS)
+    from janus_tpu.vdaf.poplar1 import Poplar1AggParam
+
+    with _LivePair() as pair:
+        leader_task, helper_task, collector_kp = provision(
+            pair, vdaf, max_batch_query_count=BITS + 1
+        )
+        ev = ledger.install_ledger(pair["leader_ds"], ledger.LedgerConfig(grace_s=0.0))
+        label = task_id_label(leader_task.task_id.data)
+        measurements = [0b10, 0b10, 0b01]
+        _upload(pair, leader_task, vdaf, measurements)
+
+        http = HttpClient()
+        clock = pair["clock"]
+        start = clock.now().to_batch_interval_start(leader_task.time_precision)
+        query = Query.time_interval(Interval(Time(start.seconds - 3600), Duration(2 * 3600)))
+        collector = Collector(
+            CollectorParameters(
+                leader_task.task_id,
+                pair["leader_srv"].url,
+                leader_task.collector_auth_token,
+                collector_kp,
+            ),
+            vdaf,
+            http,
+        )
+        adriver = AggregationJobDriver(pair["leader_ds"], http)
+        ajd = JobDriver(
+            JobDriverConfig(max_concurrent_job_workers=1), adriver.acquirer(), adriver.stepper
+        )
+        cdriver = CollectionJobDriver(pair["leader_ds"], http)
+        cjd = JobDriver(
+            JobDriverConfig(max_concurrent_job_workers=1), cdriver.acquirer(), cdriver.stepper
+        )
+        expected = {0: [1, 2], 1: [0, 1, 2, 0]}
+        for level, prefixes in ((0, (0, 1)), (1, (0, 1, 2, 3))):
+            agg_param = Poplar1AggParam(level, prefixes).encode()
+            job_id = collector.start_collection(query, agg_param=agg_param)
+            for _ in range(8):
+                if not (cjd.run_once() + ajd.run_once()):
+                    break
+            result = collector.poll_once(job_id, query, agg_param=agg_param)
+            assert result.report_count == len(measurements)
+            assert result.aggregate_result == expected[level]
+
+        doc = ev.evaluate_once()
+        t = doc["tasks"][label]
+        # canonical lane: 3 uploads admitted, never claimed (param
+        # tasks' client_reports stay pending until GC expiry)
+        assert t["admitted"] == 3 and t["aggregated"] == 0
+        assert t["in_flight"]["pending_reports"] == 3
+        # fanout lane: 3 reports x 2 levels, all finished + collected
+        assert t["param"]["admitted"] == 6 and t["param"]["aggregated"] == 6
+        assert t["collected"] == 6
+        assert t["imbalance"] == BALANCED
+        assert doc["breaches"] == []
+        # in-line reconciliation with composite keys sees no divergence
+        assert t["peer"] is not None and t["peer"]["divergence"] == 0
+        assert t["peer"]["batches_compared"] >= 1
+
+        # the helper admits per init request — i.e. per (report, param),
+        # entirely in the fanout lane; its books close the same way
+        hev = ledger.LedgerEvaluator(pair["helper_ds"], ledger.LedgerConfig(grace_s=0.0))
+        ht = hev.evaluate_once()["tasks"][label]
+        assert ht["admitted"] == 0 and ht["aggregated"] == 0
+        assert ht["param"]["admitted"] == 6 and ht["param"]["aggregated"] == 6
+        assert ht["collected"] == 6
+        assert ht["imbalance"] == BALANCED
